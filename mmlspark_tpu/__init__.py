@@ -1,0 +1,49 @@
+"""mmlspark_tpu — a TPU-native distributed ML pipeline framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of MMLSpark
+(Microsoft ML for Apache Spark): declarative fit/transform pipeline stages over
+partitioned columnar DataFrames, distributed DNN inference and image featurization,
+gradient-boosted trees, online linear learning, HTTP integration and low-latency
+serving, interpretability, recommendation, AutoML, and a featurization library —
+running SPMD over TPU device meshes instead of Spark executors.
+"""
+
+__version__ = "0.1.0"
+
+from .core.dataframe import DataFrame
+from .core.params import (
+    ComplexParam,
+    Param,
+    Params,
+    ServiceParam,
+)
+from .core.pipeline import (
+    Estimator,
+    Evaluator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    PipelineStage,
+    Transformer,
+    pipeline_model,
+)
+from .core.schema import ColType, ImageSchema, Schema
+
+__all__ = [
+    "ColType",
+    "ComplexParam",
+    "DataFrame",
+    "Estimator",
+    "Evaluator",
+    "ImageSchema",
+    "Model",
+    "Param",
+    "Params",
+    "Pipeline",
+    "PipelineModel",
+    "PipelineStage",
+    "Schema",
+    "ServiceParam",
+    "Transformer",
+    "pipeline_model",
+]

@@ -1,0 +1,6 @@
+from .dataframe import DataFrame
+from .params import Param, Params, ComplexParam, ServiceParam
+from .pipeline import (
+    Estimator, Evaluator, Model, Pipeline, PipelineModel, PipelineStage, Transformer,
+)
+from .schema import ColType, ImageSchema, Schema

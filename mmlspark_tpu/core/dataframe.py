@@ -1,0 +1,362 @@
+"""Partitioned columnar DataFrame — the distributed-data substrate.
+
+The reference runs on Spark DataFrames; every stage is column-to-column over partitioned
+data (SURVEY §1). This module provides the TPU-native substrate: a table is a list of
+*partitions*, each partition a dict of equal-length numpy column arrays. Partitions map
+onto input shards of a device mesh's data axis; numeric columns convert zero-copy into
+device arrays, and the minibatcher (parallel/batching.py) handles static-shape padding.
+
+Design choices vs Spark:
+  - Eager, host-resident numpy columns (Arrow-compatible layout). Stage graphs in the
+    reference are eager too (each transform materializes); laziness lives in XLA, where
+    per-stage jitted fns fuse, not in the table layer.
+  - ``map_partitions`` is the single distribution primitive, exactly like the reference's
+    universal ``df.mapPartitions`` pattern (SURVEY §1 "key structural fact").
+  - Ragged/object columns (strings, images, variable-length vectors) are object arrays;
+    fixed-width numeric matrices stay dense 2-D.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .schema import ColType, Schema, infer_coltype
+
+Partition = Dict[str, np.ndarray]
+
+
+def _as_column(values: Any, n: Optional[int] = None) -> np.ndarray:
+    """Normalize per-row values into a column array (object array when ragged)."""
+    if isinstance(values, np.ndarray):
+        if values.dtype.kind in ("U", "S"):
+            return values.astype(object)
+        return values
+    values = list(values)
+    if n is not None and len(values) != n:
+        raise ValueError(f"Column length {len(values)} != partition length {n}")
+    probe = next((v for v in values if v is not None), None)
+    if values and isinstance(probe, (np.ndarray, dict, bytes, bytearray, str, list, tuple)):
+        out = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            out[i] = np.asarray(v) if isinstance(v, (list, tuple)) else v
+        return out
+    arr = np.asarray(values)
+    if arr.dtype.kind in ("U", "S"):
+        return arr.astype(object)
+    return arr
+
+
+def _partition_len(part: Partition) -> int:
+    for v in part.values():
+        return len(v)
+    return 0
+
+
+class DataFrame:
+    """Immutable partitioned columnar table."""
+
+    def __init__(self, partitions: List[Partition], schema: Optional[Schema] = None):
+        self._partitions = [dict(p) for p in partitions]
+        names: List[str] = list(self._partitions[0]) if self._partitions else (
+            schema.names if schema else [])
+        for p in self._partitions:
+            if list(p) != names:
+                raise ValueError(f"Inconsistent partition columns: {list(p)} vs {names}")
+        if schema is None:
+            types: Dict[str, str] = {}
+            for name in names:
+                col = next((p[name] for p in self._partitions if len(p[name])), None)
+                types[name] = infer_coltype(col) if col is not None else ColType.OBJECT
+            schema = Schema(types)
+        self._schema = schema
+
+    # -- construction ----------------------------------------------------
+    @staticmethod
+    def from_dict(data: Dict[str, Any], num_partitions: int = 1) -> "DataFrame":
+        cols = {k: _as_column(v) for k, v in data.items()}
+        lens = {k: len(v) for k, v in cols.items()}
+        if len(set(lens.values())) > 1:
+            raise ValueError(f"Column lengths differ: {lens}")
+        df = DataFrame([cols])
+        return df.repartition(num_partitions) if num_partitions > 1 else df
+
+    @staticmethod
+    def from_rows(rows: Sequence[Dict[str, Any]], num_partitions: int = 1) -> "DataFrame":
+        if not rows:
+            return DataFrame([])
+        names = list(rows[0])
+        return DataFrame.from_dict(
+            {n: [r.get(n) for r in rows] for n in names}, num_partitions)
+
+    @staticmethod
+    def from_pandas(pdf, num_partitions: int = 1) -> "DataFrame":
+        return DataFrame.from_dict(
+            {c: pdf[c].to_numpy() for c in pdf.columns}, num_partitions)
+
+    @staticmethod
+    def empty(columns: Sequence[str]) -> "DataFrame":
+        return DataFrame([{c: np.empty(0, dtype=object) for c in columns}])
+
+    # -- basic properties ------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._schema.names)
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._partitions)
+
+    @property
+    def partitions(self) -> List[Partition]:
+        return self._partitions
+
+    def count(self) -> int:
+        return sum(_partition_len(p) for p in self._partitions)
+
+    def __len__(self) -> int:
+        return self.count()
+
+    def is_empty(self) -> bool:
+        return self.count() == 0
+
+    # -- materialization -------------------------------------------------
+    def collect(self) -> Partition:
+        """Concatenate all partitions into one column dict."""
+        if not self._partitions:
+            return {}
+        out: Partition = {}
+        for name in self.columns:
+            cols = [p[name] for p in self._partitions if len(p[name])]
+            if not cols:
+                out[name] = np.empty(0, dtype=object)
+            elif any(c.dtype == object for c in cols):
+                out[name] = np.concatenate([c.astype(object) for c in cols])
+            else:
+                out[name] = np.concatenate(cols)
+        return out
+
+    def column(self, name: str) -> np.ndarray:
+        self._schema.require(name)
+        return self.collect()[name]
+
+    def rows(self) -> List[Dict[str, Any]]:
+        data = self.collect()
+        names = self.columns
+        return [{n: data[n][i] for n in names} for i in range(len(self))]
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame({k: list(v) for k, v in self.collect().items()})
+
+    def head(self, n: int = 5) -> List[Dict[str, Any]]:
+        return self.limit(n).rows()
+
+    def show(self, n: int = 10) -> None:
+        for row in self.head(n):
+            print({k: (f"<{type(v).__name__}>" if isinstance(v, (np.ndarray, bytes, dict))
+                       else v) for k, v in row.items()})
+
+    # -- columnar ops ----------------------------------------------------
+    def select(self, *names: str) -> "DataFrame":
+        names = tuple(n for group in names for n in (group if isinstance(group, (list, tuple)) else [group]))
+        for n in names:
+            self._schema.require(n)
+        parts = [{n: p[n] for n in names} for p in self._partitions]
+        import copy as _c
+        return DataFrame(parts, Schema({n: self._schema[n] for n in names},
+                                       {n: _c.deepcopy(self._schema.metadata[n]) for n in names
+                                        if n in self._schema.metadata}))
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [c for c in self.columns if c not in names]
+        return self.select(*keep)
+
+    def with_column(self, name: str, fn_or_values: Union[Callable[[Partition], Any], Any]
+                    ) -> "DataFrame":
+        """Add/replace a column.
+
+        ``fn_or_values`` is either a callable mapping a partition dict to per-row values,
+        or a full-length array of values (split across partitions by position).
+        """
+        if callable(fn_or_values):
+            parts = []
+            for p in self._partitions:
+                vals = _as_column(fn_or_values(p), _partition_len(p))
+                q = dict(p)
+                q[name] = vals
+                parts.append(q)
+        else:
+            vals = _as_column(fn_or_values)
+            if len(vals) != self.count():
+                raise ValueError(f"Values length {len(vals)} != row count {self.count()}")
+            parts, off = [], 0
+            for p in self._partitions:
+                n = _partition_len(p)
+                q = dict(p)
+                q[name] = vals[off:off + n]
+                parts.append(q)
+                off += n
+        return self._carry_meta(DataFrame(parts))
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        self._schema.require(old)
+        parts = [{(new if k == old else k): v for k, v in p.items()}
+                 for p in self._partitions]
+        return self._carry_meta(DataFrame(parts), rename={old: new})
+
+    def map_rows(self, name: str, fn: Callable[[Dict[str, Any]], Any]) -> "DataFrame":
+        """Add a column computed row-by-row (UDF parity). Prefer vectorized with_column."""
+        def part_fn(p: Partition) -> List[Any]:
+            n = _partition_len(p)
+            return [fn({k: p[k][i] for k in p}) for i in range(n)]
+        return self.with_column(name, part_fn)
+
+    # -- row ops ---------------------------------------------------------
+    def filter(self, predicate: Callable[[Partition], np.ndarray]) -> "DataFrame":
+        """Keep rows where ``predicate(partition)`` (a boolean mask per partition) is True."""
+        parts = []
+        for p in self._partitions:
+            mask = np.asarray(predicate(p), dtype=bool)
+            parts.append({k: v[mask] for k, v in p.items()})
+        return DataFrame(parts, self._schema.copy())
+
+    def limit(self, n: int) -> "DataFrame":
+        parts, remaining = [], n
+        for p in self._partitions:
+            if remaining <= 0:
+                break
+            take = min(remaining, _partition_len(p))
+            parts.append({k: v[:take] for k, v in p.items()})
+            remaining -= take
+        return DataFrame(parts or [{c: np.empty(0, dtype=object) for c in self.columns}],
+                         self._schema.copy())
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        if self.columns != other.columns:
+            raise ValueError(f"Union columns mismatch: {self.columns} vs {other.columns}")
+        return self._carry_meta(DataFrame(self._partitions + other._partitions))
+
+    def sort(self, *by: str, ascending: bool = True) -> "DataFrame":
+        data = self.collect()
+        order = np.lexsort(tuple(data[c] for c in reversed(by)))
+        if not ascending:
+            order = order[::-1]
+        return DataFrame([{k: v[order] for k, v in data.items()}], self._schema.copy())
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
+        rng = np.random.default_rng(seed)
+        return self.filter(lambda p: rng.random(_partition_len(p)) < fraction)
+
+    def random_split(self, weights: Sequence[float], seed: int = 0
+                     ) -> List["DataFrame"]:
+        total = float(sum(weights))
+        bounds = np.cumsum([w / total for w in weights])
+        rng = np.random.default_rng(seed)
+        draws = [rng.random(_partition_len(p)) for p in self._partitions]
+        out = []
+        lo = 0.0
+        for hi in bounds:
+            parts = []
+            for p, d in zip(self._partitions, draws):
+                mask = (d >= lo) & (d < hi)
+                parts.append({k: v[mask] for k, v in p.items()})
+            out.append(DataFrame(parts, self._schema.copy()))
+            lo = hi
+        return out
+
+    def dropna(self, subset: Optional[Sequence[str]] = None) -> "DataFrame":
+        cols = list(subset) if subset else self.columns
+
+        def mask(p: Partition) -> np.ndarray:
+            n = _partition_len(p)
+            keep = np.ones(n, dtype=bool)
+            for c in cols:
+                v = p[c]
+                if v.dtype == object:
+                    keep &= np.array([x is not None for x in v], dtype=bool)
+                elif v.dtype.kind == "f":
+                    keep &= ~np.isnan(v) if v.ndim == 1 else ~np.isnan(v).any(axis=tuple(range(1, v.ndim)))
+            return keep
+        return self.filter(mask)
+
+    # -- partitioning ----------------------------------------------------
+    def repartition(self, n: int) -> "DataFrame":
+        """Evenly re-split rows into ``n`` partitions (round-robin by contiguous chunks)."""
+        if n <= 0:
+            raise ValueError("num partitions must be positive")
+        data = self.collect()
+        total = len(next(iter(data.values()))) if data else 0
+        bounds = [round(i * total / n) for i in range(n + 1)]
+        parts = [{k: v[bounds[i]:bounds[i + 1]] for k, v in data.items()}
+                 for i in range(n)]
+        return DataFrame(parts, self._schema.copy())
+
+    def coalesce(self, n: int) -> "DataFrame":
+        """Reduce partition count without a full shuffle (merge adjacent partitions)."""
+        if n >= self.num_partitions:
+            return self
+        groups = np.array_split(np.arange(self.num_partitions), n)
+        parts = []
+        for g in groups:
+            merged: Partition = {}
+            for name in self.columns:
+                cols = [self._partitions[i][name] for i in g]
+                obj = any(c.dtype == object for c in cols)
+                merged[name] = (np.concatenate([c.astype(object) for c in cols])
+                                if obj else np.concatenate(cols))
+            parts.append(merged)
+        return DataFrame(parts, self._schema.copy())
+
+    def map_partitions(self, fn: Callable[[Partition], Partition]) -> "DataFrame":
+        """THE distribution primitive (reference: df.mapPartitions everywhere, SURVEY §1)."""
+        return self._carry_meta(DataFrame([fn(dict(p)) for p in self._partitions]))
+
+    def partition_by_key(self, key: str, n: Optional[int] = None) -> "DataFrame":
+        """Hash-partition rows by a key column (shuffle)."""
+        n = n or self.num_partitions
+        data = self.collect()
+        keys = data[key]
+        hashes = np.array([_stable_hash(k) % n for k in keys])
+        parts = [{c: v[hashes == i] for c, v in data.items()} for i in range(n)]
+        return DataFrame(parts, self._schema)
+
+    def cache(self) -> "DataFrame":
+        return self  # eager: already materialized
+
+    def _carry_meta(self, new_df: "DataFrame", rename: Optional[Dict[str, str]] = None
+                    ) -> "DataFrame":
+        """Copy per-column metadata (categorical levels etc.) onto a derived frame."""
+        import copy as _c
+        for name, meta in self._schema.metadata.items():
+            tgt = (rename or {}).get(name, name)
+            if meta and tgt in new_df._schema.types:
+                new_df._schema.metadata[tgt] = _c.deepcopy(meta)
+        return new_df
+
+    # -- sugar (FluentAPI parity: core/spark/FluentAPI.scala:13-30) ------
+    def ml_transform(self, stage) -> "DataFrame":
+        return stage.transform(self)
+
+    def ml_fit(self, estimator):
+        return estimator.fit(self)
+
+    def __repr__(self) -> str:
+        return (f"DataFrame(rows={self.count()}, partitions={self.num_partitions}, "
+                f"schema={self._schema.types})")
+
+
+def _stable_hash(key: Any) -> int:
+    """Process-stable key hash for shuffles (builtin hash() is salted per process)."""
+    import zlib
+    if isinstance(key, (int, np.integer)):
+        return int(key) & 0x7FFFFFFF
+    if isinstance(key, bytes):
+        return zlib.crc32(key)
+    return zlib.crc32(str(key).encode("utf-8"))

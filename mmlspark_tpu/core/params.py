@@ -1,0 +1,291 @@
+"""Typed parameter system for pipeline stages.
+
+TPU-native re-design of the reference's SparkML param layer:
+  - ``Param``/``Params``  ~ org.apache.spark.ml.param + core/contracts/Params.scala:9-177
+  - ``ComplexParam``      ~ core/serialize/ComplexParam.scala:13-35 (params holding non-JSON
+    objects: weights, models, functions, DataFrames), persisted by the stage serializer.
+  - ``ServiceParam``      ~ cognitive/CognitiveServiceBase.scala:29-151 (value-or-column).
+
+Unlike the JVM reference there is no reflection-based codegen step needed for Python —
+stages ARE Python — but the same metadata (`Params.params`) drives doc generation and the
+fuzzing test harness (tests enforce every stage exposes its params).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Type
+
+
+class Param:
+    """A named, documented, typed parameter attached to a stage class.
+
+    Mirrors org.apache.spark.ml.param.Param (reference core/contracts/Params.scala): a
+    JSON-serializable value with a validator. Non-JSON values belong in ComplexParam.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        doc: str = "",
+        default: Any = None,
+        validator: Optional[Callable[[Any], bool]] = None,
+        ptype: Optional[type] = None,
+    ):
+        self.name = name
+        self.doc = doc
+        self.default = default
+        self.validator = validator
+        self.ptype = ptype
+        self.is_complex = False
+        self.is_service = False
+
+    def validate(self, value: Any) -> None:
+        if value is None:
+            return
+        if self.ptype is not None:
+            if self.ptype is float and isinstance(value, int) and not isinstance(value, bool):
+                value = float(value)
+            elif not isinstance(value, self.ptype):
+                expected = (" or ".join(t.__name__ for t in self.ptype)
+                            if isinstance(self.ptype, tuple) else self.ptype.__name__)
+                raise TypeError(
+                    f"Param '{self.name}' expects {expected}, "
+                    f"got {type(value).__name__}: {value!r}"
+                )
+        if self.validator is not None and not self.validator(value):
+            raise ValueError(f"Param '{self.name}' failed validation with value {value!r}")
+
+    def coerce(self, value: Any) -> Any:
+        if value is not None and self.ptype is float and isinstance(value, int) and not isinstance(value, bool):
+            return float(value)
+        return value
+
+    def __repr__(self) -> str:
+        return f"Param({self.name!r}, default={self.default!r})"
+
+
+class ComplexParam(Param):
+    """Param whose value is a non-JSON object (arrays, models, callables, DataFrames).
+
+    Persisted out-of-band by the serializer (see core/serialize.py), matching the
+    reference's ComplexParam + org/apache/spark/ml/Serializer.scala:1-203 design where
+    each complex param saves to its own subdirectory.
+    """
+
+    def __init__(self, name: str, doc: str = "", default: Any = None,
+                 validator: Optional[Callable[[Any], bool]] = None):
+        super().__init__(name, doc, default, validator, ptype=None)
+        self.is_complex = True
+
+
+class ServiceParam(Param):
+    """Value-or-column param (reference cognitive/CognitiveServiceBase.scala:29-151).
+
+    Holds either a literal value applied to every row, or the name of an input column
+    supplying a per-row value. Stored as {"value": v} or {"col": name}.
+    """
+
+    def __init__(self, name: str, doc: str = "", default: Any = None,
+                 validator: Optional[Callable[[Any], bool]] = None,
+                 ptype: Optional[type] = None):
+        super().__init__(name, doc, default, None, ptype=None)
+        self._inner_validator = validator
+        self._inner_ptype = ptype
+        self.is_service = True
+
+    def validate(self, value: Any) -> None:
+        if value is None:
+            return
+        if not (isinstance(value, dict) and (set(value) <= {"value", "col"}) and len(value) == 1):
+            raise TypeError(
+                f"ServiceParam '{self.name}' expects {{'value': v}} or {{'col': name}}, got {value!r}"
+            )
+        if "col" in value and not isinstance(value["col"], str):
+            raise TypeError(f"ServiceParam '{self.name}' column name must be str")
+
+
+class Params:
+    """Base for anything carrying params (stages, models, evaluators).
+
+    Param declaration is class-level: subclasses declare ``Param`` instances as class
+    attributes. Instance values live in ``self._param_map``; lookup order is instance
+    value -> declared default (same two-level scheme as SparkML paramMap/defaultParamMap).
+    """
+
+    def __init__(self, **kwargs: Any):
+        self._param_map: Dict[str, Any] = {}
+        self.set_params(**kwargs)
+
+    # -- param discovery -------------------------------------------------
+    @classmethod
+    def params(cls) -> Dict[str, Param]:
+        out: Dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for k, v in vars(klass).items():
+                if isinstance(v, Param):
+                    out[v.name] = v
+        return out
+
+    @classmethod
+    def param(cls, name: str) -> Param:
+        p = cls.params().get(name)
+        if p is None:
+            raise KeyError(f"{cls.__name__} has no param '{name}'")
+        return p
+
+    @classmethod
+    def has_param(cls, name: str) -> bool:
+        return name in cls.params()
+
+    # -- get/set ---------------------------------------------------------
+    def set(self, name: str, value: Any) -> "Params":
+        p = self.param(name)
+        p.validate(value)
+        self._param_map[name] = p.coerce(value)
+        return self
+
+    def set_params(self, **kwargs: Any) -> "Params":
+        for k, v in kwargs.items():
+            self.set(k, v)
+        return self
+
+    def get(self, name: str) -> Any:
+        if name in self._param_map:
+            return self._param_map[name]
+        return self.param(name).default
+
+    def get_or_throw(self, name: str) -> Any:
+        v = self.get(name)
+        if v is None:
+            raise ValueError(f"Param '{name}' is required but not set on {type(self).__name__}")
+        return v
+
+    def is_set(self, name: str) -> bool:
+        return name in self._param_map
+
+    def is_defined(self, name: str) -> bool:
+        return self.is_set(name) or self.param(name).default is not None
+
+    def clear(self, name: str) -> "Params":
+        self._param_map.pop(name, None)
+        return self
+
+    # -- service param helpers (value-or-column) ------------------------
+    def set_scalar(self, name: str, value: Any) -> "Params":
+        """Set a ServiceParam to a literal value."""
+        return self.set(name, {"value": value})
+
+    def set_col(self, name: str, col: str) -> "Params":
+        """Set a ServiceParam to read from a column."""
+        return self.set(name, {"col": col})
+
+    def get_service_value(self, name: str, partition: Dict[str, Any], i: int) -> Any:
+        """Resolve a ServiceParam for row ``i`` of a partition."""
+        v = self.get(name)
+        if v is None:
+            return None
+        if "value" in v:
+            return v["value"]
+        return partition[v["col"]][i]
+
+    # -- introspection ---------------------------------------------------
+    def explain_params(self) -> str:
+        lines = []
+        for name, p in sorted(self.params().items()):
+            cur = self._param_map.get(name, p.default)
+            lines.append(f"{name}: {p.doc} (default: {p.default!r}, current: {cur!r})")
+        return "\n".join(lines)
+
+    def extract_param_map(self) -> Dict[str, Any]:
+        out = {name: p.default for name, p in self.params().items()}
+        out.update(self._param_map)
+        return out
+
+    def simple_params(self) -> Dict[str, Any]:
+        """Set (non-default) JSON-serializable params, for persistence."""
+        cls_params = self.params()
+        return {
+            k: v for k, v in self._param_map.items()
+            if not cls_params[k].is_complex
+        }
+
+    def complex_params(self) -> Dict[str, Any]:
+        cls_params = self.params()
+        return {
+            k: v for k, v in self._param_map.items()
+            if cls_params[k].is_complex
+        }
+
+    def copy(self, extra: Optional[Dict[str, Any]] = None) -> "Params":
+        new = _copy.copy(self)
+        new._param_map = dict(self._param_map)
+        if extra:
+            for k, v in extra.items():
+                new.set(k, v)
+        return new
+
+    def _fluent(self) -> "Params":
+        return self
+
+    def __repr__(self) -> str:
+        shown = ", ".join(f"{k}={v!r}" for k, v in sorted(self._param_map.items())
+                          if not isinstance(v, (bytes, bytearray)))
+        return f"{type(self).__name__}({shown})"
+
+
+def _make_setter(pname: str):
+    def setter(self, value):
+        return self.set(pname, value)
+    return setter
+
+
+def _mixin(param_name: str, doc: str, default: Any = None, ptype: Optional[type] = None,
+           validator=None) -> type:
+    """Build a Has<X>Col-style mixin class (reference core/contracts/Params.scala:9-177)."""
+    p = Param(param_name, doc, default, validator, ptype)
+    ns = {
+        param_name: p,
+        f"set_{_snake(param_name)}": _make_setter(param_name),
+        f"get_{_snake(param_name)}": (lambda self, _n=param_name: self.get(_n)),
+    }
+    return type(f"Has{param_name[0].upper()}{param_name[1:]}", (Params,), ns)
+
+
+def _snake(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+# Shared column-param mixins, mirroring the reference's contracts
+# (core/contracts/Params.scala:9-177).
+HasInputCol = _mixin("inputCol", "The name of the input column", None, str)
+HasOutputCol = _mixin("outputCol", "The name of the output column", None, str)
+HasInputCols = _mixin("inputCols", "The names of the input columns", None, (list, tuple))
+HasOutputCols = _mixin("outputCols", "The names of the output columns", None, (list, tuple))
+HasLabelCol = _mixin("labelCol", "The name of the label column", "label", str)
+HasFeaturesCol = _mixin("featuresCol", "The name of the features column", "features", str)
+HasWeightCol = _mixin("weightCol", "The name of the weight column", None, str)
+HasScoresCol = _mixin("scoresCol", "The name of the scores column", "scores", str)
+HasScoredLabelsCol = _mixin(
+    "scoredLabelsCol", "The name of the scored-labels column", "scored_labels", str)
+HasScoredProbabilitiesCol = _mixin(
+    "scoredProbabilitiesCol", "The name of the scored-probabilities column",
+    "scored_probabilities", str)
+HasEvaluationMetric = _mixin("evaluationMetric", "Metric to evaluate models with", None, str)
+HasValidationIndicatorCol = _mixin(
+    "validationIndicatorCol", "Boolean column marking validation rows", None, str)
+HasInitScoreCol = _mixin("initScoreCol", "Column with initial model scores", None, str)
+HasGroupCol = _mixin("groupCol", "Group/query id column (ranking)", None, str)
+HasBatchSize = _mixin("batchSize", "Rows per minibatch", 32, int, lambda v: v > 0)
+HasSeed = _mixin("seed", "Random seed", 0, int)
+HasParallelism = _mixin("parallelism", "Max concurrent evaluations", 1, int, lambda v: v > 0)
+HasHandleInvalid = _mixin(
+    "handleInvalid", "Strategy for invalid entries: 'error', 'skip', or 'keep'", "error", str,
+    lambda v: v in ("error", "skip", "keep"))

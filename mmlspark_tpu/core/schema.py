@@ -1,0 +1,221 @@
+"""Column dtypes and structured schemas for the columnar DataFrame.
+
+Re-designs the reference's Spark schema layer (core/schema/SparkBindings.scala:13-47,
+core/schema/ImageSchemaUtils.scala, core/schema/Categoricals.scala) for a numpy/Arrow
+columnar substrate:
+
+  - ``ColType``     — logical column types (scalar, vector, tensor, struct, binary, string).
+  - ``ImageSchema`` — the image struct layout (path, height, width, channels, mode, data),
+    matching Spark's ImageSchema that ImageTransformer/UnrollImage consume.
+  - ``Binding``     — dataclass <-> column-struct codec (SparkBindings parity) so typed
+    request/response records (HTTP, cognitive services) round-trip through columns.
+  - categorical metadata helpers (CategoricalUtilities parity): per-column level maps
+    carried in DataFrame metadata instead of Spark column metadata.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, fields, is_dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+
+class ColType:
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    INT32 = "int32"
+    INT64 = "int64"
+    BOOL = "bool"
+    STRING = "string"
+    BINARY = "binary"
+    VECTOR = "vector"      # 1-D float array per row (ragged allowed; object-backed)
+    TENSOR = "tensor"      # n-D array per row
+    STRUCT = "struct"      # dict per row
+    OBJECT = "object"      # anything else
+
+    NUMERIC = (FLOAT32, FLOAT64, INT32, INT64, BOOL)
+
+
+def infer_coltype(col: np.ndarray) -> str:
+    """Infer the logical type of a column (a numpy array of per-row values)."""
+    if col.dtype == np.float32:
+        return ColType.FLOAT32
+    if col.dtype == np.float64:
+        return ColType.FLOAT64
+    if col.dtype in (np.int32,):
+        return ColType.INT32
+    if col.dtype in (np.int64,):
+        return ColType.INT64
+    if col.dtype == np.bool_:
+        return ColType.BOOL
+    if col.dtype.kind in ("U", "S"):
+        return ColType.STRING
+    if col.dtype == object:
+        for v in col:
+            if v is None:
+                continue
+            if isinstance(v, str):
+                return ColType.STRING
+            if isinstance(v, (bytes, bytearray)):
+                return ColType.BINARY
+            if isinstance(v, np.ndarray):
+                return ColType.VECTOR if v.ndim == 1 else ColType.TENSOR
+            if isinstance(v, dict):
+                return ColType.STRUCT
+            if isinstance(v, (float, int)):
+                return ColType.FLOAT64
+            return ColType.OBJECT
+        return ColType.OBJECT
+    if col.ndim > 1:
+        return ColType.VECTOR if col.ndim == 2 else ColType.TENSOR
+    return ColType.OBJECT
+
+
+@dataclass
+class Schema:
+    """Ordered mapping of column name -> logical type, plus per-column metadata."""
+
+    types: Dict[str, str]
+    metadata: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
+
+    @property
+    def names(self) -> List[str]:
+        return list(self.types)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.types
+
+    def __getitem__(self, name: str) -> str:
+        return self.types[name]
+
+    def require(self, name: str, *allowed: str) -> None:
+        if name not in self.types:
+            raise KeyError(f"Column '{name}' not found; have {self.names}")
+        if allowed and self.types[name] not in allowed:
+            raise TypeError(
+                f"Column '{name}' has type {self.types[name]}, expected one of {allowed}")
+
+    def meta(self, name: str) -> Dict[str, Any]:
+        return self.metadata.setdefault(name, {})
+
+    def copy(self) -> "Schema":
+        import copy as _c
+        return Schema(dict(self.types), _c.deepcopy(self.metadata))
+
+
+def find_unused_column_name(prefix: str, schema: "Schema | Sequence[str]") -> str:
+    """Reference core/schema/DatasetExtensions.findUnusedColumnName."""
+    names = set(schema.names if isinstance(schema, Schema) else schema)
+    name, i = prefix, 0
+    while name in names:
+        i += 1
+        name = f"{prefix}_{i}"
+    return name
+
+
+# ---------------------------------------------------------------------------
+# Image schema (Spark ImageSchema parity; consumed by image stages)
+# ---------------------------------------------------------------------------
+
+class ImageSchema:
+    """Row layout for decoded images, as a per-row dict (STRUCT column).
+
+    Fields mirror Spark's ImageSchema struct that the reference's image stages read
+    (core/schema/ImageSchemaUtils.scala, opencv/ImageTransformer.scala:26-150):
+    origin, height, width, nChannels, mode, data. ``data`` here is an HWC uint8
+    (or float32) numpy array instead of flattened BGR bytes — TPU-friendlier, and
+    converters handle the flat-bytes form at IO boundaries.
+    """
+
+    FIELDS = ("origin", "height", "width", "nChannels", "mode", "data")
+
+    OCV_8UC1 = 0
+    OCV_8UC3 = 16
+    OCV_8UC4 = 24
+    UNDEFINED = -1
+
+    @staticmethod
+    def make(data: np.ndarray, origin: str = "") -> Dict[str, Any]:
+        if data.ndim == 2:
+            data = data[:, :, None]
+        h, w, c = data.shape
+        mode = {1: ImageSchema.OCV_8UC1, 3: ImageSchema.OCV_8UC3,
+                4: ImageSchema.OCV_8UC4}.get(c, ImageSchema.UNDEFINED)
+        return {"origin": origin, "height": int(h), "width": int(w),
+                "nChannels": int(c), "mode": mode, "data": data}
+
+    @staticmethod
+    def is_image(value: Any) -> bool:
+        return isinstance(value, dict) and set(ImageSchema.FIELDS) <= set(value)
+
+    @staticmethod
+    def to_array(row: Dict[str, Any]) -> np.ndarray:
+        d = row["data"]
+        if isinstance(d, (bytes, bytearray)):
+            arr = np.frombuffer(bytes(d), dtype=np.uint8)
+            return arr.reshape(row["height"], row["width"], row["nChannels"])
+        return np.asarray(d)
+
+
+# ---------------------------------------------------------------------------
+# Dataclass <-> columns codec (SparkBindings parity)
+# ---------------------------------------------------------------------------
+
+class Binding:
+    """Typed record <-> STRUCT-column codec.
+
+    Reference: core/schema/SparkBindings.scala:13-47 generates Row<->case-class codecs
+    from ExpressionEncoders; here dataclasses play the case-class role and rows are
+    per-element dicts in an object column.
+    """
+
+    @staticmethod
+    def to_row(obj: Any) -> Any:
+        if obj is None or isinstance(obj, (str, bytes, int, float, bool, np.ndarray)):
+            return obj
+        if is_dataclass(obj):
+            return {f.name: Binding.to_row(getattr(obj, f.name)) for f in fields(obj)}
+        if isinstance(obj, (list, tuple)):
+            return [Binding.to_row(v) for v in obj]
+        if isinstance(obj, dict):
+            return {k: Binding.to_row(v) for k, v in obj.items()}
+        return obj
+
+    @staticmethod
+    def from_row(cls: Type, row: Any) -> Any:
+        if row is None:
+            return None
+        if is_dataclass(cls):
+            kwargs = {}
+            hints = {f.name: f.type for f in fields(cls)}
+            for f in fields(cls):
+                v = row.get(f.name) if isinstance(row, dict) else getattr(row, f.name, None)
+                kwargs[f.name] = Binding._coerce_field(hints[f.name], v)
+            return cls(**kwargs)
+        return row
+
+    @staticmethod
+    def _coerce_field(hint: Any, v: Any) -> Any:
+        if v is None:
+            return None
+        origin = getattr(hint, "__origin__", None)
+        if origin in (list, List):
+            (inner,) = hint.__args__
+            return [Binding.from_row(inner, x) if is_dataclass(inner) else x for x in v]
+        if is_dataclass(hint) if isinstance(hint, type) else False:
+            return Binding.from_row(hint, v)
+        return v
+
+
+# ---------------------------------------------------------------------------
+# Categorical metadata (Categoricals.scala parity)
+# ---------------------------------------------------------------------------
+
+def set_categorical_levels(schema: Schema, col: str, levels: Sequence[Any]) -> None:
+    schema.meta(col)["categorical_levels"] = list(levels)
+
+
+def get_categorical_levels(schema: Schema, col: str) -> Optional[List[Any]]:
+    return schema.metadata.get(col, {}).get("categorical_levels")

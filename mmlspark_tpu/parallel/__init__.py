@@ -1,0 +1,5 @@
+from .batching import Batch, Minibatcher, concat_outputs, next_bucket, pad_batch, stack_rows
+from .mesh import (
+    DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, SEQ_AXIS, TENSOR_AXIS,
+    MeshContext, MeshSpec, data_sharding, make_mesh, num_data_shards, replicated_sharding,
+)

@@ -1,0 +1,143 @@
+"""Static-shape minibatching: the ragged-rows -> XLA bridge.
+
+The reference batches rows for native eval via MiniBatchTransformer/Batchers
+(stages/MiniBatchTransformer.scala:14-200, stages/Batchers.scala:12-160). On TPU this
+layer is *the* cross-cutting design problem (SURVEY §7 hard part #2): XLA wants static
+shapes, rows are ragged. Strategy:
+
+  - ``pad_to_bucket``: round batch size up to a bucket (powers of two by default) so jit
+    recompiles O(log n) times, not O(n); excess rows masked out.
+  - ``Minibatcher``: slice a column dict into fixed-size padded device batches + mask.
+  - ``unbatch``: concatenate per-batch outputs and strip padding (FlattenBatch parity).
+
+All stages that touch devices go through this module, so padding/bucketing policy is
+defined once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Partition = Dict[str, np.ndarray]
+
+
+def next_bucket(n: int, buckets: Optional[Sequence[int]] = None, multiple: int = 8) -> int:
+    """Smallest allowed static size >= n. Default: next power of two >= max(n, multiple)."""
+    if n <= 0:
+        return multiple
+    if buckets:
+        for b in buckets:
+            if b >= n:
+                return b
+        return buckets[-1]
+    return max(multiple, 1 << (n - 1).bit_length())
+
+
+def pad_batch(arr: np.ndarray, target: int, pad_value: float = 0.0) -> np.ndarray:
+    """Pad leading dim of ``arr`` up to ``target`` rows by repeating zeros."""
+    n = arr.shape[0]
+    if n == target:
+        return arr
+    if n > target:
+        raise ValueError(f"batch of {n} rows exceeds target {target}")
+    pad_width = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width, constant_values=pad_value)
+
+
+def stack_rows(col: np.ndarray, dtype=np.float32) -> np.ndarray:
+    """Stack a column of per-row arrays/scalars into one dense [N, ...] array.
+
+    Ragged rows are an error here — resize/pad upstream (images are resized before
+    unroll in the reference too, image/ImageFeaturizer.scala:141-165).
+    """
+    if col.dtype != object:
+        return np.ascontiguousarray(col, dtype=dtype)
+    rows = [np.asarray(v, dtype=dtype) for v in col]
+    shapes = {r.shape for r in rows}
+    if len(shapes) > 1:
+        raise ValueError(f"Ragged rows (shapes {shapes}); resize/pad before batching")
+    return np.stack(rows)
+
+
+@dataclasses.dataclass
+class Batch:
+    """One padded, static-shape batch: arrays + validity mask."""
+
+    arrays: Dict[str, np.ndarray]
+    mask: np.ndarray          # [B] bool, True = real row
+    num_valid: int
+
+    @property
+    def size(self) -> int:
+        return len(self.mask)
+
+
+class Minibatcher:
+    """FixedMiniBatchTransformer-equivalent over column dicts.
+
+    With ``bucket=True`` the final short batch is padded to a bucket size so compiled
+    shapes repeat across partitions; per-row outputs are later cropped by ``num_valid``.
+    """
+
+    def __init__(self, batch_size: int = 32, bucket: bool = True,
+                 dtype=np.float32, pad_value: float = 0.0):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.batch_size = batch_size
+        self.bucket = bucket
+        self.dtype = dtype
+        self.pad_value = pad_value
+
+    def batches(self, part: Partition, cols: Sequence[str]) -> Iterator[Batch]:
+        n = len(next(iter(part.values()))) if part else 0
+        dense = {c: stack_rows(part[c], self.dtype) for c in cols}
+        for start in range(0, n, self.batch_size):
+            stop = min(start + self.batch_size, n)
+            m = stop - start
+            target = self.batch_size if (m == self.batch_size or not self.bucket) \
+                else next_bucket(m)
+            target = min(target, self.batch_size) if m < self.batch_size else target
+            arrays = {c: pad_batch(dense[c][start:stop], target, self.pad_value)
+                      for c in cols}
+            mask = np.zeros(target, dtype=bool)
+            mask[:m] = True
+            yield Batch(arrays, mask, m)
+
+    def map_batches(self, part: Partition, cols: Sequence[str],
+                    fn: Callable[[Dict[str, np.ndarray]], Any]) -> List[Any]:
+        """Apply ``fn`` per padded batch, crop each output's leading dim to num_valid."""
+        outs = []
+        for b in self.batches(part, cols):
+            res = fn(b.arrays)
+            outs.append(_crop(res, b.num_valid))
+        return outs
+
+
+def _crop(res: Any, n: int) -> Any:
+    if isinstance(res, dict):
+        return {k: _crop(v, n) for k, v in res.items()}
+    if isinstance(res, (list, tuple)):
+        return type(res)(_crop(v, n) for v in res)
+    arr = np.asarray(res)
+    return arr[:n]
+
+
+def concat_outputs(outs: List[Any]) -> Any:
+    """FlattenBatch parity: merge per-batch outputs back into full-length columns."""
+    if not outs:
+        return outs
+    first = outs[0]
+    if isinstance(first, dict):
+        return {k: concat_outputs([o[k] for o in outs]) for k in first}
+    if isinstance(first, (list, tuple)) and not isinstance(first, np.ndarray):
+        return type(first)(concat_outputs([o[i] for o in outs]) for i in range(len(first)))
+    return np.concatenate([np.asarray(o) for o in outs], axis=0)
+
+
+def pad_to_multiple_of_shards(n: int, shards: int) -> int:
+    """Rows needed so a global batch splits evenly across data shards."""
+    return int(math.ceil(n / shards) * shards)

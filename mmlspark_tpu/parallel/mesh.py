@@ -1,0 +1,149 @@
+"""Device mesh runtime: discovery, construction, topology.
+
+Replaces the reference's cluster-topology layer (core/utils/ClusterUtil.scala:13-90 —
+executor/core counting from BlockManager state; lightgbm/LightGBMUtils.scala:105-173 —
+driver-socket rendezvous) with the TPU-native equivalents:
+
+  - device discovery         = jax.devices()
+  - rendezvous               = jax.distributed.initialize (multi-host; ICI needs none)
+  - worker count             = mesh axis sizes
+  - barrier gang start       = SPMD launch (inherent on TPU pods)
+
+Standard axis names follow the scaling-book convention: ``data`` (DP over ICI/DCN),
+``fsdp`` (param sharding), ``tensor`` (TP), ``seq`` (sequence/context parallel),
+``expert`` (EP). Single-chip meshes are 1-sized on every axis, so all code paths are
+mesh-agnostic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger("mmlspark_tpu")
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+TENSOR_AXIS = "tensor"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+
+def devices(backend: Optional[str] = None) -> List:
+    import jax
+    return jax.devices(backend) if backend else jax.devices()
+
+
+def local_device_count() -> int:
+    import jax
+    return jax.local_device_count()
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap (replaces driver-socket rendezvous,
+    LightGBMUtils.scala:105-173). No-op when single-process."""
+    if num_processes in (None, 1):
+        return
+    import jax
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    """Declarative mesh shape; -1 on one axis absorbs remaining devices."""
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = dataclasses.asdict(self)
+        fixed = 1
+        wild = None
+        for k, v in sizes.items():
+            if v == -1:
+                if wild is not None:
+                    raise ValueError("Only one mesh axis may be -1")
+                wild = k
+            else:
+                fixed *= v
+        if wild is not None:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wild] = n_devices // fixed
+        else:
+            total = int(np.prod(list(sizes.values())))
+            if total != n_devices:
+                raise ValueError(f"Mesh {sizes} needs {total} devices, have {n_devices}")
+        return sizes
+
+
+def make_mesh(spec: Optional[MeshSpec] = None, device_list: Optional[Sequence] = None):
+    """Build a jax.sharding.Mesh over the available devices.
+
+    Axes with size 1 are kept in the mesh (harmless; lets sharding rules name them
+    unconditionally). Uses jax.make_mesh so device order follows physical topology
+    (ICI-contiguous) rather than enumeration order.
+    """
+    import jax
+
+    spec = spec or MeshSpec()
+    devs = list(device_list) if device_list is not None else jax.devices()
+    sizes = spec.resolve(len(devs))
+    axis_names = tuple(sizes.keys())
+    shape = tuple(sizes[a] for a in axis_names)
+    if device_list is not None:
+        arr = np.asarray(devs).reshape(shape)
+        return jax.sharding.Mesh(arr, axis_names)
+    return jax.make_mesh(shape, axis_names, devices=devs)
+
+
+def data_sharding(mesh, *batch_axes: str):
+    """NamedSharding that shards the leading (batch) dim over the data axes."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    axes = batch_axes or (DATA_AXIS,)
+    return NamedSharding(mesh, P(axes))
+
+
+def replicated_sharding(mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())
+
+
+def num_data_shards(mesh) -> int:
+    return int(mesh.shape.get(DATA_AXIS, 1) * mesh.shape.get(FSDP_AXIS, 1))
+
+
+class MeshContext:
+    """Process-wide default mesh (lazily built single-axis DP mesh).
+
+    Stages that dispatch to devices consult this unless given an explicit mesh —
+    the analogue of the reference stages consulting ClusterUtil for worker counts
+    (lightgbm/LightGBMBase.scala:120-128).
+    """
+
+    _default = None
+
+    @classmethod
+    def get(cls):
+        if cls._default is None:
+            cls._default = make_mesh()
+        return cls._default
+
+    @classmethod
+    def set(cls, mesh) -> None:
+        cls._default = mesh
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._default = None
