@@ -1,0 +1,19 @@
+"""Image pipeline stages: transform, resize, unroll, augment, featurize.
+
+Parity targets: opencv/ImageTransformer.scala, image/ResizeImageTransformer.scala,
+image/UnrollImage.scala, image/ImageSetAugmenter.scala, image/ImageFeaturizer.scala.
+"""
+
+from .stages import (
+    ImageSetAugmenter,
+    ImageTransformer,
+    ResizeImageTransformer,
+    UnrollBinaryImage,
+    UnrollImage,
+)
+from .featurizer import ImageFeaturizer
+
+__all__ = [
+    "ImageFeaturizer", "ImageSetAugmenter", "ImageTransformer",
+    "ResizeImageTransformer", "UnrollBinaryImage", "UnrollImage",
+]

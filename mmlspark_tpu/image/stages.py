@@ -1,0 +1,262 @@
+"""Image pipeline stages (ImageTransformer / Resize / Unroll / Augment parity).
+
+All stages read/write ImageSchema struct columns (core/schema.py) — per-row dicts of
+{origin, height, width, nChannels, mode, data} with an HWC numpy array payload.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import HasInputCol, HasOutputCol, Param
+from ..core.pipeline import Transformer
+from ..core.schema import ColType, ImageSchema, Schema
+from ..ops import image as ops
+
+
+class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Composable image-op pipeline on an image column.
+
+    Reference: opencv/ImageTransformer.scala:26-150 — an ordered list of OpenCV
+    stages (ResizeImage/CropImage/ColorFormat/Flip/Blur/Threshold/GaussianKernel)
+    applied per image. Here each op is a dict {"op": name, ...params} executed by
+    the numpy kernels in ops/image.py (jit-batched resize happens downstream in
+    DNNModel where shapes are uniform).
+    """
+
+    stages = Param("stages", "Ordered list of image ops", None, ptype=list)
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("inputCol", "image")
+        kwargs.setdefault("outputCol", "image")
+        kwargs.setdefault("stages", [])
+        super().__init__(**kwargs)
+
+    # -- fluent op builders (mirroring the reference's .resize(...) etc.) --
+    def _add(self, **op) -> "ImageTransformer":
+        st = list(self.get("stages"))
+        st.append(op)
+        return self.set("stages", st)
+
+    def resize(self, height: int, width: int) -> "ImageTransformer":
+        return self._add(op="resize", height=height, width=width)
+
+    def crop(self, x: int, y: int, height: int, width: int) -> "ImageTransformer":
+        return self._add(op="crop", x=x, y=y, height=height, width=width)
+
+    def color_format(self, format: str) -> "ImageTransformer":
+        return self._add(op="colorFormat", format=format)
+
+    def flip(self, flip_code: int = 1) -> "ImageTransformer":
+        return self._add(op="flip", flipCode=flip_code)
+
+    def blur(self, height: int, width: int) -> "ImageTransformer":
+        return self._add(op="blur", height=height, width=width)
+
+    def threshold(self, threshold: float, max_val: float = 255.0,
+                  threshold_type: str = "binary") -> "ImageTransformer":
+        return self._add(op="threshold", threshold=threshold, maxVal=max_val,
+                         type=threshold_type)
+
+    def gaussian_kernel(self, applied_width: int, sigma: float) -> "ImageTransformer":
+        return self._add(op="gaussianKernel", appliedWidth=applied_width, sigma=sigma)
+
+    # -- execution ---------------------------------------------------------
+    @staticmethod
+    def _apply_op(img: np.ndarray, op: Dict[str, Any]) -> np.ndarray:
+        kind = op["op"]
+        if kind == "resize":
+            return ops.resize(img, op["height"], op["width"])
+        if kind == "crop":
+            return ops.crop(img, op["x"], op["y"], op["height"], op["width"])
+        if kind == "colorFormat":
+            return ops.color_format(img, op["format"])
+        if kind == "flip":
+            return ops.flip(img, op.get("flipCode", 1))
+        if kind == "blur":
+            return ops.box_blur(img, op["height"], op["width"])
+        if kind == "threshold":
+            return ops.threshold(img, op["threshold"], op.get("maxVal", 255.0),
+                                 op.get("type", "binary"))
+        if kind == "gaussianKernel":
+            return ops.gaussian_blur(img, op["sigma"], op.get("appliedWidth"))
+        raise ValueError(f"Unknown image op {kind!r}")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get_or_throw("inputCol")
+        out_col = self.get_or_throw("outputCol")
+        stage_list = self.get("stages")
+
+        def fn(part):
+            col = part[in_col]
+            out = np.empty(len(col), dtype=object)
+            for i, row in enumerate(col):
+                if row is None:
+                    out[i] = None
+                    continue
+                img = ImageSchema.to_array(row) if ImageSchema.is_image(row) else np.asarray(row)
+                origin = row.get("origin", "") if isinstance(row, dict) else ""
+                for op in stage_list:
+                    img = self._apply_op(img, op)
+                out[i] = ImageSchema.make(np.asarray(img), origin)
+            return out
+
+        return df.with_column(out_col, fn)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        schema.require(self.get_or_throw("inputCol"))
+        out = schema.copy()
+        out.types[self.get_or_throw("outputCol")] = ColType.STRUCT
+        return out
+
+
+class ResizeImageTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Resize an image column (reference image/ResizeImageTransformer.scala — AWT resize)."""
+
+    height = Param("height", "Target height", None, lambda v: v > 0, int)
+    width = Param("width", "Target width", None, lambda v: v > 0, int)
+    nChannels = Param("nChannels", "Force channel count (1 or 3)", None, ptype=int)
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("inputCol", "image")
+        kwargs.setdefault("outputCol", "image")
+        super().__init__(**kwargs)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get_or_throw("inputCol")
+        out_col = self.get_or_throw("outputCol")
+        h, w = self.get_or_throw("height"), self.get_or_throw("width")
+        nch = self.get("nChannels")
+
+        def fn(part):
+            col = part[in_col]
+            out = np.empty(len(col), dtype=object)
+            for i, row in enumerate(col):
+                if row is None:
+                    out[i] = None
+                    continue
+                img = ImageSchema.to_array(row) if ImageSchema.is_image(row) else np.asarray(row)
+                img = ops.resize(img, h, w)
+                if nch == 1 and (img.ndim == 3 and img.shape[2] != 1):
+                    img = ops.color_format(img, "gray")
+                elif nch == 3 and (img.ndim == 2 or img.shape[2] == 1):
+                    img = np.repeat(img.reshape(h, w, 1), 3, axis=2)
+                origin = row.get("origin", "") if isinstance(row, dict) else ""
+                out[i] = ImageSchema.make(np.asarray(img), origin)
+            return out
+
+        return df.with_column(out_col, fn)
+
+
+class UnrollImage(Transformer, HasInputCol, HasOutputCol):
+    """Image struct column -> flat CHW float vector column
+    (reference image/UnrollImage.scala:28-53)."""
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("inputCol", "image")
+        kwargs.setdefault("outputCol", "unrolled")
+        super().__init__(**kwargs)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get_or_throw("inputCol")
+        out_col = self.get_or_throw("outputCol")
+
+        def fn(part):
+            col = part[in_col]
+            out = np.empty(len(col), dtype=object)
+            for i, row in enumerate(col):
+                if row is None:
+                    out[i] = None
+                    continue
+                img = ImageSchema.to_array(row) if ImageSchema.is_image(row) else np.asarray(row)
+                out[i] = ops.unroll_chw(img)
+            return out
+
+        return df.with_column(out_col, fn)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        schema.require(self.get_or_throw("inputCol"))
+        out = schema.copy()
+        out.types[self.get_or_throw("outputCol")] = ColType.VECTOR
+        return out
+
+
+class UnrollBinaryImage(Transformer, HasInputCol, HasOutputCol):
+    """Binary (encoded bytes) column -> decode -> optional resize -> flat CHW vector
+    (reference image/UnrollImage.scala UnrollBinaryImage)."""
+
+    height = Param("height", "Resize height (optional)", None, ptype=int)
+    width = Param("width", "Resize width (optional)", None, ptype=int)
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("inputCol", "value")
+        kwargs.setdefault("outputCol", "unrolled")
+        super().__init__(**kwargs)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get_or_throw("inputCol")
+        out_col = self.get_or_throw("outputCol")
+        h, w = self.get("height"), self.get("width")
+
+        def fn(part):
+            col = part[in_col]
+            out = np.empty(len(col), dtype=object)
+            for i, raw in enumerate(col):
+                if raw is None:
+                    out[i] = None
+                    continue
+                img = ops.decode_image(bytes(raw)) if isinstance(raw, (bytes, bytearray)) \
+                    else np.asarray(raw)
+                if img is None:
+                    out[i] = None
+                    continue
+                if h is not None and w is not None:
+                    img = ops.resize(img, h, w)
+                out[i] = ops.unroll_chw(img)
+            return out
+
+        return df.with_column(out_col, fn)
+
+
+class ImageSetAugmenter(Transformer, HasInputCol, HasOutputCol):
+    """Dataset augmentation by flips (reference image/ImageSetAugmenter.scala):
+    emits the original rows plus one extra copy per enabled flip."""
+
+    flipLeftRight = Param("flipLeftRight", "Add horizontally-flipped copies", True, ptype=bool)
+    flipUpDown = Param("flipUpDown", "Add vertically-flipped copies", False, ptype=bool)
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("inputCol", "image")
+        kwargs.setdefault("outputCol", "image")
+        super().__init__(**kwargs)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get_or_throw("inputCol")
+        out_col = self.get_or_throw("outputCol")
+        dfs = [df.with_column(out_col, lambda p: p[in_col])]
+
+        def flipper(code):
+            def fn(part):
+                col = part[in_col]
+                out = np.empty(len(col), dtype=object)
+                for i, row in enumerate(col):
+                    if row is None:
+                        out[i] = None
+                        continue
+                    img = ImageSchema.to_array(row) if ImageSchema.is_image(row) else np.asarray(row)
+                    origin = row.get("origin", "") if isinstance(row, dict) else ""
+                    out[i] = ImageSchema.make(ops.flip(img, code), origin)
+                return out
+            return fn
+
+        if self.get("flipLeftRight"):
+            dfs.append(df.with_column(out_col, flipper(1)))
+        if self.get("flipUpDown"):
+            dfs.append(df.with_column(out_col, flipper(0)))
+        result = dfs[0]
+        for d in dfs[1:]:
+            result = result.union(d.select(*result.columns))
+        return result

@@ -1,0 +1,25 @@
+"""Model layer: functional NN modules, flagship architectures, DNN inference stage."""
+
+from .module import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Fn,
+    FunctionModel,
+    GlobalAvgPool,
+    MaxPool,
+    Module,
+    Residual,
+    Sequential,
+    flatten,
+    relu,
+)
+from .resnet import build_resnet, param_shardings, resnet, resnet18, resnet50
+from .dnn_model import DNNModel
+
+__all__ = [
+    "BatchNorm", "Conv2D", "DNNModel", "Dense", "Fn", "FunctionModel",
+    "GlobalAvgPool", "MaxPool", "Module", "Residual", "Sequential",
+    "build_resnet", "flatten", "param_shardings", "relu", "resnet",
+    "resnet18", "resnet50",
+]

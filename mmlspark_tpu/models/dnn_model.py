@@ -1,0 +1,141 @@
+"""DNNModel — distributed DNN inference as a pipeline stage (CNTKModel parity).
+
+The reference's north-star path (SURVEY §3.1, cntk/CNTKModel.scala:30-540):
+broadcast a serialized CNTK graph to executors, minibatch rows, evaluate through JNI
+per batch, unbatch, coerce outputs to vectors. The TPU-native redesign:
+
+  - broadcast                → params resident on device(s); with a mesh, replicated
+                               (or tensor-sharded) via NamedSharding once per transform.
+  - per-row JNI eval loop    → one ``jax.jit``-compiled forward over a padded [B, ...]
+                               batch; compile cache keyed by (output node, shape, dtype).
+  - minibatcher              → parallel/batching.Minibatcher with power-of-two bucket
+                               padding so XLA compiles O(log n) shapes (CNTKModel's
+                               FixedMiniBatchTransformer default of batch 10 becomes a
+                               static-shape batch: cntk/CNTKModel.scala:374,496-500).
+  - feedDict/fetchDict       → input column -> model argument; output column <- named
+                               node or OUTPUT_i (cntk/CNTKModel.scala:204-223 and
+                               CNTK/SerializableFunction.scala:61-63,115-129).
+  - output coercion          → per-row float32 vectors (CNTKModel.scala:462-483).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.params import ComplexParam, HasBatchSize, HasInputCol, HasOutputCol, Param
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Model
+from ..core.schema import ColType, Schema
+from ..parallel.batching import Minibatcher, concat_outputs
+from ..parallel.mesh import DATA_AXIS, MeshContext, data_sharding, replicated_sharding
+from .module import FunctionModel
+
+
+class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
+    """Evaluate a FunctionModel over an input column of arrays/images.
+
+    Mirrors CNTKModel's public surface: setModel, setInputCol/setOutputCol (the
+    1-input/1-output case of feedDict/fetchDict — CNTKModel.scala:204-260),
+    setOutputNode/setOutputNodeIndex (SerializableFunction node addressing),
+    setMiniBatchSize.
+    """
+
+    model = ComplexParam("model", "The FunctionModel to evaluate")
+    outputNode = Param("outputNode", "Named layer to fetch (None = final output)", None, ptype=str)
+    batchSize = Param("batchSize", "Rows per evaluation minibatch", 64, lambda v: v > 0, int)
+    useMesh = Param("useMesh", "Shard eval batches over the default mesh data axis", False,
+                    ptype=bool)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._jit_cache: Dict[Tuple, Any] = {}
+
+    # -- fluent setters mirroring the reference API -----------------------
+    def set_model(self, model: FunctionModel) -> "DNNModel":
+        self._jit_cache.clear()  # compiled closures capture the model
+        return self.set("model", model)
+
+    def get_model(self) -> FunctionModel:
+        return self.get_or_throw("model")
+
+    def set_output_node(self, node: str) -> "DNNModel":
+        return self.set("outputNode", node)
+
+    def set_output_node_index(self, i: int) -> "DNNModel":
+        return self.set("outputNode", f"OUTPUT_{i}")
+
+    def set_mini_batch_size(self, n: int) -> "DNNModel":
+        return self.set("batchSize", n)
+
+    # -- compiled forward -------------------------------------------------
+    def _compiled(self, tap: Optional[str]):
+        """jit-compiled (params, x) -> activations for one fetch node."""
+        import jax
+
+        model = self.get_model()
+        key = ("fwd", id(model), tap)
+        if key not in self._jit_cache:
+
+            def fwd(params, x):
+                live = FunctionModel(model.module, params, model.input_shape,
+                                     model.layer_names, model.name)
+                return live.apply(x, tap=tap)
+
+            self._jit_cache[key] = jax.jit(fwd)
+        return self._jit_cache[key]
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        schema.require(self.get_or_throw("inputCol"))
+        out = schema.copy()
+        out.types[self.get_or_throw("outputCol")] = ColType.VECTOR
+        return out
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        import jax
+
+        in_col = self.get_or_throw("inputCol")
+        out_col = self.get_or_throw("outputCol")
+        model = self.get_model()
+        tap = model.resolve_output(self.get("outputNode"))
+        fwd = self._compiled(tap)
+        batcher = Minibatcher(self.get("batchSize"), bucket=True, dtype=np.float32)
+
+        params_dev = jax.device_put(model.params)  # resident once (broadcast parity)
+
+        mesh = MeshContext.get() if self.get("useMesh") else None
+        sharding = None
+        if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
+            sharding = data_sharding(mesh)
+            params_dev = jax.device_put(params_dev, replicated_sharding(mesh))
+
+        def eval_partition(part):
+            n = len(part[in_col])
+            col = np.empty(n, dtype=object)
+            if n == 0:
+                part[out_col] = col
+                return part
+            # null inputs produce null outputs (CNTKModel emits null rows for
+            # undecodable inputs rather than failing the partition)
+            in_vals = part[in_col]
+            valid_idx = np.array([i for i in range(n) if in_vals[i] is not None],
+                                 dtype=np.int64)
+            if len(valid_idx) == 0:
+                part[out_col] = col
+                return part
+            sub = {in_col: in_vals[valid_idx]}
+            outs = []
+            for batch in batcher.batches(sub, [in_col]):
+                x = batch.arrays[in_col]
+                if sharding is not None and x.shape[0] % mesh.shape[DATA_AXIS] == 0:
+                    x = jax.device_put(x, sharding)
+                y = np.asarray(fwd(params_dev, x), dtype=np.float32)
+                outs.append(y[: batch.num_valid])
+            full = concat_outputs(outs)
+            for j, i in enumerate(valid_idx):
+                col[i] = full[j]
+            part[out_col] = col
+            return part
+
+        return df.map_partitions(eval_partition)

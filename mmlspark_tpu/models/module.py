@@ -1,0 +1,408 @@
+"""Minimal functional NN module system with named-layer addressability.
+
+TPU-native replacement for the reference's CNTK graph engine (the C++ evaluation
+engine driven through CNTK/SerializableFunction.scala:23-143). Design goals:
+
+  - **Pure-functional**: a module is a pair of pure functions ``init(rng, shape)`` and
+    ``apply(params, x)``; params are pytrees of jax/numpy arrays, so the whole forward
+    pass jits and shards with `jax.jit`/`shard_map` — no graph VM, XLA *is* the engine.
+  - **Named-layer tap points**: every layer has a path name ("stem/conv", "layer4/2/relu").
+    ``apply(..., taps={...})`` returns intermediate activations by name. This gives the
+    reference's node-addressing semantics (`SerializableFunction.scala:61-63,115-129`:
+    name-based feed/fetch plus positional ``ARGUMENT_i``/``OUTPUT_i``) and powers
+    ImageFeaturizer's ``cutOutputLayers`` (image/ImageFeaturizer.scala:133-178).
+  - **bf16 compute, f32 params**: matmul/conv inputs cast to bfloat16 for the MXU;
+    accumulation and parameters stay float32.
+
+No flax dependency: the module tree is plain Python objects (picklable = serializable
+via core/serialize.py), params are plain nested dicts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+def _rng_split(rng, n):
+    import jax
+    return jax.random.split(rng, n)
+
+
+class Module:
+    """Base module. Subclasses implement init/apply; both must be jit-pure."""
+
+    name: str = ""
+
+    def init(self, rng, in_shape: Tuple[int, ...]) -> Tuple[Params, Tuple[int, ...]]:
+        """Returns (params, out_shape). Shapes exclude the batch dim."""
+        raise NotImplementedError
+
+    def apply(self, params: Params, x, train: bool = False):
+        raise NotImplementedError
+
+    # -- graph introspection ------------------------------------------------
+    def layer_paths(self, prefix: str = "") -> List[str]:
+        """All addressable layer names under this module (depth-first)."""
+        return [prefix or self.name or type(self).__name__.lower()]
+
+    def num_params(self, params: Params) -> int:
+        import jax
+        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+
+
+class Sequential(Module):
+    """Named chain of modules; the unit of layer addressing.
+
+    ``apply`` optionally records activations for tap names into ``taps_out`` and
+    batch statistics (from BatchNorm layers in train mode) into ``stats_out``,
+    keyed by layer path — the side channel the train step uses for EMA updates.
+    """
+
+    is_container = True
+
+    def __init__(self, layers: Sequence[Tuple[str, Module]], name: str = ""):
+        self.layers: List[Tuple[str, Module]] = list(layers)
+        self.name = name
+
+    def init(self, rng, in_shape):
+        params: Params = {}
+        keys = _rng_split(rng, max(len(self.layers), 1))
+        shape = in_shape
+        for (lname, layer), k in zip(self.layers, keys):
+            p, shape = layer.init(k, shape)
+            if p:
+                params[lname] = p
+        return params, shape
+
+    def apply(self, params, x, train: bool = False,
+              taps: Optional[Set[str]] = None, taps_out: Optional[Dict[str, Any]] = None,
+              stats_out: Optional[Dict[str, Any]] = None, _prefix: str = ""):
+        for lname, layer in self.layers:
+            path = f"{_prefix}{lname}"
+            p = params.get(lname, {})
+            if getattr(layer, "is_container", False):
+                x = layer.apply(p, x, train=train, taps=taps, taps_out=taps_out,
+                                stats_out=stats_out, _prefix=path + "/")
+            elif isinstance(layer, BatchNorm):
+                x = layer.apply(p, x, train=train, stats_out=stats_out, _path=path)
+            else:
+                x = layer.apply(p, x, train=train)
+            if taps is not None and taps_out is not None and path in taps:
+                taps_out[path] = x
+        return x
+
+    def layer_paths(self, prefix: str = "") -> List[str]:
+        out: List[str] = []
+        for lname, layer in self.layers:
+            path = f"{prefix}{lname}"
+            if getattr(layer, "is_container", False):
+                out.extend(layer.layer_paths(path + "/"))
+            out.append(path)
+        return out
+
+
+class Fn(Module):
+    """Stateless elementwise/shape op from a pure function."""
+
+    def __init__(self, fn: Callable, out_shape_fn: Optional[Callable] = None):
+        self.fn = fn
+        self.out_shape_fn = out_shape_fn
+
+    def init(self, rng, in_shape):
+        if self.out_shape_fn is not None:
+            return {}, self.out_shape_fn(in_shape)
+        # probe with a zero array (host, cheap)
+        probe = np.zeros((1,) + tuple(in_shape), dtype=np.float32)
+        out = np.asarray(self.fn(probe))
+        return {}, tuple(out.shape[1:])
+
+    def apply(self, params, x, train: bool = False):
+        return self.fn(x)
+
+
+def _relu_fn(x):
+    import jax.numpy as jnp
+    return jnp.maximum(x, 0)
+
+
+def _identity_shape(s):
+    return s
+
+
+def _flatten_fn(x):
+    import jax.numpy as jnp
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+def _flat_shape(s):
+    return (int(np.prod(s)),)
+
+
+# module-level fns (not lambdas) so Fn modules pickle for persistence
+def relu() -> Fn:
+    return Fn(_relu_fn, _identity_shape)
+
+
+def flatten() -> Fn:
+    return Fn(_flatten_fn, _flat_shape)
+
+
+class Conv2D(Module):
+    """NHWC conv on the MXU: bf16 inputs/kernel, f32 accumulation (preferred_element_type)."""
+
+    def __init__(self, features: int, kernel: Tuple[int, int] = (3, 3),
+                 strides: Tuple[int, int] = (1, 1), padding: str = "SAME",
+                 use_bias: bool = False):
+        self.features = features
+        self.kernel = kernel
+        self.strides = strides
+        self.padding = padding
+        self.use_bias = use_bias
+
+    def init(self, rng, in_shape):
+        import jax
+        h, w, c = in_shape
+        kh, kw = self.kernel
+        fan_in = kh * kw * c
+        wkey, _ = _rng_split(rng, 2)
+        kernel = jax.random.normal(wkey, (kh, kw, c, self.features), dtype=np.float32)
+        kernel = kernel * np.float32(math.sqrt(2.0 / fan_in))
+        params = {"kernel": kernel}
+        if self.use_bias:
+            params["bias"] = np.zeros((self.features,), dtype=np.float32)
+        if self.padding == "SAME":
+            oh = -(-h // self.strides[0])
+            ow = -(-w // self.strides[1])
+        else:
+            oh = (h - kh) // self.strides[0] + 1
+            ow = (w - kw) // self.strides[1] + 1
+        return params, (oh, ow, self.features)
+
+    def apply(self, params, x, train: bool = False):
+        import jax
+        import jax.numpy as jnp
+        y = jax.lax.conv_general_dilated(
+            x.astype(jnp.bfloat16),
+            jnp.asarray(params["kernel"]).astype(jnp.bfloat16),
+            window_strides=self.strides,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )  # bf16 activations end-to-end: half the HBM traffic; MXU accumulates f32
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y
+
+
+class Dense(Module):
+    def __init__(self, features: int, use_bias: bool = True):
+        self.features = features
+        self.use_bias = use_bias
+
+    def init(self, rng, in_shape):
+        import jax
+        (d,) = in_shape
+        wkey, _ = _rng_split(rng, 2)
+        w = jax.random.normal(wkey, (d, self.features), dtype=np.float32)
+        w = w * np.float32(1.0 / math.sqrt(d))
+        params = {"kernel": w}
+        if self.use_bias:
+            params["bias"] = np.zeros((self.features,), dtype=np.float32)
+        return params, (self.features,)
+
+    def apply(self, params, x, train: bool = False):
+        import jax.numpy as jnp
+        y = jnp.dot(x.astype(jnp.bfloat16),
+                    jnp.asarray(params["kernel"]).astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32)
+        y = y.astype(jnp.float32)
+        if self.use_bias:
+            y = y + params["bias"]
+        return y
+
+
+class BatchNorm(Module):
+    """Inference-style batchnorm (scale/bias/moving stats).
+
+    Train-mode uses batch statistics; the cross-device mean/var reduction is left to
+    XLA (inside pjit, reductions over the batch dim are automatically global when the
+    batch is sharded — no explicit psum needed under jit-of-sharded-computation).
+    """
+
+    def __init__(self, momentum: float = 0.9, eps: float = 1e-5):
+        self.momentum = momentum
+        self.eps = eps
+
+    def init(self, rng, in_shape):
+        c = in_shape[-1]
+        params = {
+            "scale": np.ones((c,), dtype=np.float32),
+            "bias": np.zeros((c,), dtype=np.float32),
+            "mean": np.zeros((c,), dtype=np.float32),
+            "var": np.ones((c,), dtype=np.float32),
+        }
+        return params, in_shape
+
+    def apply(self, params, x, train: bool = False,
+              stats_out: Optional[Dict[str, Any]] = None, _path: str = ""):
+        import jax
+        import jax.numpy as jnp
+        if train:
+            axes = tuple(range(x.ndim - 1))
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            if stats_out is not None:
+                # stop_gradient: stats feed EMA updates, not the loss
+                stats_out[_path] = (jax.lax.stop_gradient(mean),
+                                    jax.lax.stop_gradient(var))
+        else:
+            mean, var = params["mean"], params["var"]
+        inv = params["scale"] * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        shift = params["bias"] - mean * inv
+        return x * inv.astype(x.dtype) + shift.astype(x.dtype)
+
+
+class MaxPool(Module):
+    def __init__(self, window: Tuple[int, int] = (2, 2),
+                 strides: Optional[Tuple[int, int]] = None, padding: str = "SAME"):
+        self.window = window
+        self.strides = strides or window
+        self.padding = padding
+
+    def init(self, rng, in_shape):
+        h, w, c = in_shape
+        if self.padding == "SAME":
+            oh = -(-h // self.strides[0])
+            ow = -(-w // self.strides[1])
+        else:
+            oh = (h - self.window[0]) // self.strides[0] + 1
+            ow = (w - self.window[1]) // self.strides[1] + 1
+        return {}, (oh, ow, c)
+
+    def apply(self, params, x, train: bool = False):
+        import jax
+        import jax.numpy as jnp
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            (1,) + self.window + (1,), (1,) + self.strides + (1,), self.padding)
+
+
+class GlobalAvgPool(Module):
+    def init(self, rng, in_shape):
+        return {}, (in_shape[-1],)
+
+    def apply(self, params, x, train: bool = False):
+        import jax.numpy as jnp
+        return jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+
+
+# ---------------------------------------------------------------------------
+# Residual blocks (used by resnet.py)
+# ---------------------------------------------------------------------------
+
+class Residual(Module):
+    """y = relu(body(x) + shortcut(x)); shortcut projects when shapes change."""
+
+    is_container = True
+
+    def __init__(self, body: Sequential, shortcut: Optional[Sequential] = None):
+        self.body = body
+        self.shortcut = shortcut
+
+    def init(self, rng, in_shape):
+        k1, k2 = _rng_split(rng, 2)
+        bp, out_shape = self.body.init(k1, in_shape)
+        params = {"body": bp}
+        if self.shortcut is not None:
+            sp, s_shape = self.shortcut.init(k2, in_shape)
+            if s_shape != out_shape:
+                raise ValueError(f"Residual shapes differ: {s_shape} vs {out_shape}")
+            params["shortcut"] = sp
+        elif in_shape != out_shape:
+            raise ValueError(
+                f"Residual needs a projection shortcut: {in_shape} -> {out_shape}")
+        return params, out_shape
+
+    def apply(self, params, x, train: bool = False,
+              taps: Optional[Set[str]] = None, taps_out: Optional[Dict[str, Any]] = None,
+              stats_out: Optional[Dict[str, Any]] = None, _prefix: str = ""):
+        import jax.numpy as jnp
+        y = self.body.apply(params["body"], x, train=train, taps=taps,
+                            taps_out=taps_out, stats_out=stats_out,
+                            _prefix=_prefix + "body/")
+        s = x
+        if self.shortcut is not None:
+            s = self.shortcut.apply(params["shortcut"], x, train=train, taps=taps,
+                                    taps_out=taps_out, stats_out=stats_out,
+                                    _prefix=_prefix + "shortcut/")
+        return jnp.maximum(y + s, 0)
+
+    def layer_paths(self, prefix: str = "") -> List[str]:
+        out = self.body.layer_paths(prefix + "body/")
+        if self.shortcut is not None:
+            out.extend(self.shortcut.layer_paths(prefix + "shortcut/"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# FunctionModel: the SerializableFunction-equivalent handle
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FunctionModel:
+    """A (module, params) pair with named inputs/outputs — the unit DNNModel evaluates.
+
+    Plays the role of the reference's ``SerializableFunction`` wrapper around a native
+    CNTK ``Function`` (CNTK/SerializableFunction.scala:85-143): a self-contained,
+    persistable model handle with addressable argument/output nodes. Serialization is
+    structural (module tree pickles; params pytree saved as npz by core/serialize.py)
+    instead of opaque native bytes.
+
+    ``layer_names``: orderd list of tap paths from the classifier head backwards, used
+    by ImageFeaturizer's cutOutputLayers (reference downloader/Schema.scala:44-100).
+    """
+
+    module: Module
+    params: Params
+    input_shape: Tuple[int, ...]
+    layer_names: List[str] = dataclasses.field(default_factory=list)
+    name: str = "model"
+
+    def argument_names(self) -> List[str]:
+        return ["ARGUMENT_0"]
+
+    def output_names(self) -> List[str]:
+        return ["OUTPUT_0"] + list(self.layer_names)
+
+    def resolve_output(self, node: Optional[str]) -> Optional[str]:
+        """Resolve a fetch-node spec to a tap path (None = final output).
+
+        Accepts a layer path, ``OUTPUT_i`` positional addressing, or None.
+        (Reference: SerializableFunction.scala:61-63,115-129.)
+        """
+        if node is None or node == "OUTPUT_0" or node == self.name:
+            return None
+        if node.startswith("OUTPUT_"):
+            i = int(node.split("_", 1)[1])
+            return self.layer_names[i - 1] if i > 0 else None
+        paths = set(self.module.layer_paths())
+        if node in paths:
+            return node
+        raise KeyError(f"Unknown output node {node!r}; known: OUTPUT_i, {sorted(paths)[:20]}...")
+
+    def apply(self, x, tap: Optional[str] = None, train: bool = False):
+        """Forward pass; if ``tap`` is a layer path, return that activation instead."""
+        if tap is None:
+            return self.module.apply(self.params, x, train=train)
+        taps_out: Dict[str, Any] = {}
+        assert isinstance(self.module, Sequential), "taps need a Sequential root"
+        self.module.apply(self.params, x, train=train, taps={tap}, taps_out=taps_out)
+        if tap not in taps_out:
+            raise KeyError(f"Tap {tap!r} not produced; known {self.module.layer_paths()[:20]}")
+        return taps_out[tap]

@@ -1,0 +1,156 @@
+"""ResNet family, TPU-first (NHWC, bf16 matmuls, static shapes).
+
+The flagship DNN for the framework's north-star path (SURVEY §3.1/§3.5): the
+reference featurizes images through pretrained CNTK CNNs (ResNet-50 in
+`notebooks/samples` and `downloader/Schema.scala` model repo); here the ResNet is a
+native JAX module whose intermediate layers are addressable by name so
+ImageFeaturizer's ``cutOutputLayers`` works identically
+(image/ImageFeaturizer.scala:133-178).
+
+Builders return a :class:`~mmlspark_tpu.models.module.FunctionModel` with
+``layer_names`` ordered head-first: ``["fc", "avgpool", "layer4", ...]`` — so
+``cutOutputLayers=1`` yields the 2048-d pooled embedding, matching the reference's
+convention of cutting N layers off the top (downloader/Schema.scala:44-100).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .module import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Fn,
+    FunctionModel,
+    GlobalAvgPool,
+    MaxPool,
+    Residual,
+    Sequential,
+    flatten,
+    relu,
+)
+
+
+def _bottleneck(in_ch: int, mid_ch: int, stride: int) -> Residual:
+    out_ch = mid_ch * 4
+    body = Sequential([
+        ("conv1", Conv2D(mid_ch, (1, 1))),
+        ("bn1", BatchNorm()),
+        ("relu1", relu()),
+        ("conv2", Conv2D(mid_ch, (3, 3), (stride, stride))),
+        ("bn2", BatchNorm()),
+        ("relu2", relu()),
+        ("conv3", Conv2D(out_ch, (1, 1))),
+        ("bn3", BatchNorm()),
+    ])
+    shortcut = None
+    if stride != 1 or in_ch != out_ch:
+        shortcut = Sequential([
+            ("conv", Conv2D(out_ch, (1, 1), (stride, stride))),
+            ("bn", BatchNorm()),
+        ])
+    return Residual(body, shortcut)
+
+
+def _basic(in_ch: int, out_ch: int, stride: int) -> Residual:
+    body = Sequential([
+        ("conv1", Conv2D(out_ch, (3, 3), (stride, stride))),
+        ("bn1", BatchNorm()),
+        ("relu1", relu()),
+        ("conv2", Conv2D(out_ch, (3, 3))),
+        ("bn2", BatchNorm()),
+    ])
+    shortcut = None
+    if stride != 1 or in_ch != out_ch:
+        shortcut = Sequential([
+            ("conv", Conv2D(out_ch, (1, 1), (stride, stride))),
+            ("bn", BatchNorm()),
+        ])
+    return Residual(body, shortcut)
+
+
+_CONFIGS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+}
+
+
+def build_resnet(depth: int = 50, num_classes: int = 1000,
+                 image_size: int = 224, channels: int = 3,
+                 width: int = 64) -> Sequential:
+    kind, blocks = _CONFIGS[depth]
+    expansion = 4 if kind == "bottleneck" else 1
+    layers: List[Tuple[str, "Sequential"]] = [
+        ("stem", Sequential([
+            ("conv", Conv2D(width, (7, 7), (2, 2))),
+            ("bn", BatchNorm()),
+            ("relu", relu()),
+            ("pool", MaxPool((3, 3), (2, 2), "SAME")),
+        ])),
+    ]
+    in_ch = width
+    for i, n in enumerate(blocks):
+        ch = width * (2 ** i)
+        stage = []
+        for j in range(n):
+            stride = 2 if (i > 0 and j == 0) else 1
+            if kind == "bottleneck":
+                stage.append((str(j), _bottleneck(in_ch, ch, stride)))
+                in_ch = ch * expansion
+            else:
+                stage.append((str(j), _basic(in_ch, ch, stride)))
+                in_ch = ch
+        layers.append((f"layer{i + 1}", Sequential(stage)))
+    layers.append(("avgpool", GlobalAvgPool()))
+    layers.append(("fc", Dense(num_classes)))
+    return Sequential(layers, name=f"resnet{depth}")
+
+
+def resnet(depth: int = 50, num_classes: int = 1000, image_size: int = 224,
+           channels: int = 3, seed: int = 0, width: int = 64) -> FunctionModel:
+    """Build + initialize a ResNet FunctionModel."""
+    import jax
+
+    module = build_resnet(depth, num_classes, image_size, channels, width)
+    rng = jax.random.PRNGKey(seed)
+    params, out_shape = module.init(rng, (image_size, image_size, channels))
+    assert out_shape == (num_classes,), out_shape
+    layer_names = ["fc", "avgpool", "layer4", "layer3", "layer2", "layer1", "stem"]
+    return FunctionModel(module=module, params=params,
+                         input_shape=(image_size, image_size, channels),
+                         layer_names=layer_names, name=f"resnet{depth}")
+
+
+def resnet50(num_classes: int = 1000, image_size: int = 224, seed: int = 0) -> FunctionModel:
+    return resnet(50, num_classes, image_size, seed=seed)
+
+
+def resnet18(num_classes: int = 1000, image_size: int = 224, seed: int = 0) -> FunctionModel:
+    return resnet(18, num_classes, image_size, seed=seed)
+
+
+def param_shardings(params, mesh):
+    """NamedSharding rules for ResNet params on a mesh.
+
+    Conv kernels [kh,kw,cin,cout] shard cout over the ``tensor`` axis; dense kernels
+    [din,dout] shard dout; 1-D vectors replicate. With tensor=1 meshes this degrades
+    to full replication — the mesh-agnostic default (scaling-book style: annotate,
+    let XLA insert collectives).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def rule(leaf):
+        if leaf.ndim == 4:
+            return NamedSharding(mesh, P(None, None, None, "tensor"))
+        if leaf.ndim == 2:
+            return NamedSharding(mesh, P(None, "tensor"))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(rule, params)

@@ -1,0 +1,194 @@
+"""Training step: loss, optimizer wiring, mesh-sharded train step.
+
+The reference trains DNNs outside the framework (CNTK models arrive pretrained via
+ModelDownloader) and trains heads with LightGBM/VW. The TPU build makes DNN training
+first-class because transfer learning *is* the north-star benchmark (BASELINE.md):
+a jitted, mesh-sharded train step over (data, fsdp, tensor) axes, scaling-book style
+— annotate shardings, let XLA insert the collectives.
+
+  - batch sharded over ("data", "fsdp")    — DP; fsdp axis also feeds batch so FSDP
+    all-gathers amortize (standard ZeRO-3 layout).
+  - conv kernels sharded cin->fsdp, cout->tensor; dense din->fsdp, dout->tensor.
+    Dims not divisible by the axis stay replicated (mesh-agnostic degradation).
+  - bf16 activations/matmuls (module layer property), f32 params + optimizer state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .module import Module, Sequential
+from ..parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
+
+
+def cross_entropy_loss(logits, labels, mask=None):
+    """Mean softmax cross-entropy; labels are int class ids. Padded rows masked out."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0] - lse
+    if mask is not None:
+        m = mask.astype(jnp.float32)
+        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return -ll.mean()
+
+
+def accuracy(logits, labels, mask=None):
+    import jax.numpy as jnp
+
+    pred = jnp.argmax(logits, axis=-1)
+    hit = (pred == labels).astype(jnp.float32)
+    if mask is not None:
+        m = mask.astype(jnp.float32)
+        return (hit * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return hit.mean()
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: Any
+
+
+def _register_train_state():
+    import jax
+
+    jax.tree_util.register_dataclass(
+        TrainState, data_fields=["params", "opt_state", "step"], meta_fields=[])
+
+
+_register_train_state()
+
+
+def _decay_mask(params):
+    """Weight decay only touches matmul/conv kernels — never biases, BN scale/shift,
+    or BN moving statistics (decaying `var` toward 0 explodes 1/sqrt(var+eps))."""
+    import jax
+
+    return jax.tree.map(lambda leaf: np.ndim(leaf) >= 2, params)
+
+
+def make_optimizer(learning_rate: float = 0.1, momentum: float = 0.9,
+                   weight_decay: float = 0.0):
+    import optax
+
+    txs = []
+    if weight_decay:
+        txs.append(optax.add_decayed_weights(weight_decay, mask=_decay_mask))
+    txs.append(optax.sgd(learning_rate, momentum=momentum))
+    return optax.chain(*txs)
+
+
+def _apply_bn_ema(params, stats: Dict[str, Any], momentum: float):
+    """Fold batch statistics into the BatchNorm moving mean/var params.
+
+    ``stats`` is keyed by layer path ("stem/bn", "layer1/0/body/bn1", ...); each
+    path addresses a nested params dict holding {"mean", "var"}.
+    """
+    for path, (mean, var) in stats.items():
+        node = params
+        keys = path.split("/")
+        for k in keys[:-1]:
+            node = node[k]
+        bn = dict(node[keys[-1]])
+        bn["mean"] = momentum * bn["mean"] + (1 - momentum) * mean
+        bn["var"] = momentum * bn["var"] + (1 - momentum) * var
+        node[keys[-1]] = bn
+    return params
+
+
+def make_train_step(module: Module, optimizer, bn_momentum: float = 0.9) -> Callable:
+    """Pure (state, batch) -> (state, metrics) step; jit/pjit-ready.
+
+    BatchNorm layers use batch statistics in the forward pass and their moving
+    mean/var params are EMA-updated from the same statistics (side-channel via
+    ``stats_out``), so eval-mode inference after training is correct.
+    """
+
+    def step(state: TrainState, batch: Dict[str, Any]) -> Tuple[TrainState, Dict]:
+        import jax
+        import optax
+
+        x, y = batch["x"], batch["y"]
+        mask = batch.get("mask")
+
+        def loss_fn(params):
+            stats: Dict[str, Any] = {}
+            if isinstance(module, Sequential):
+                logits = module.apply(params, x, train=True, stats_out=stats)
+            else:
+                logits = module.apply(params, x, train=True)
+            return cross_entropy_loss(logits, y, mask), (logits, stats)
+
+        (loss, (logits, stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        if stats:
+            params = _apply_bn_ema(jax.tree.map(lambda v: v, params), stats, bn_momentum)
+        metrics = {"loss": loss, "accuracy": accuracy(logits, y, mask)}
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    return step
+
+
+def param_sharding_rules(params, mesh):
+    """NamedSharding tree: cin->fsdp, cout->tensor for matmul/conv kernels,
+    replicate the rest; any non-divisible dim falls back to replicated."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    fsdp = mesh.shape.get(FSDP_AXIS, 1)
+    tens = mesh.shape.get(TENSOR_AXIS, 1)
+
+    def rule(leaf):
+        shape = leaf.shape
+        if len(shape) == 4:  # conv kernel [kh,kw,cin,cout]
+            spec = [None, None,
+                    FSDP_AXIS if fsdp > 1 and shape[2] % fsdp == 0 else None,
+                    TENSOR_AXIS if tens > 1 and shape[3] % tens == 0 else None]
+            return NamedSharding(mesh, P(*spec))
+        if len(shape) == 2:  # dense kernel [din,dout]
+            spec = [FSDP_AXIS if fsdp > 1 and shape[0] % fsdp == 0 else None,
+                    TENSOR_AXIS if tens > 1 and shape[1] % tens == 0 else None]
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(rule, params)
+
+
+def batch_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P((DATA_AXIS, FSDP_AXIS)))
+
+
+def init_train_state(module: Module, in_shape, optimizer, seed: int = 0,
+                     mesh=None) -> TrainState:
+    """Initialize params (+opt state); if a mesh is given, place both sharded."""
+    import jax
+
+    params, _ = module.init(jax.random.PRNGKey(seed), in_shape)
+    if mesh is not None:
+        shardings = param_sharding_rules(params, mesh)
+        params = jax.device_put(params, shardings)
+    opt_state = optimizer.init(params)
+    step = np.int32(0)
+    return TrainState(params, opt_state, step)
+
+
+def compile_train_step(module: Module, optimizer):
+    """jit the train step. Sharding comes from the *inputs* (GSPMD propagation):
+    place state via init_train_state(mesh=...) and batches via batch_sharding(mesh);
+    XLA inserts the DP gradient psums / FSDP all-gathers / TP collectives."""
+    import jax
+
+    step = make_train_step(module, optimizer)
+    return jax.jit(step, donate_argnums=(0,))

@@ -1,0 +1,7 @@
+"""Compute ops: image processing, hashing, histograms — the kernel layer.
+
+Where the reference drives C++ engines (OpenCV imgproc, LightGBM histograms, VW
+hashing) through JNI/SWIG, this package provides the TPU-native kernels: jax/XLA
+(and Pallas for the hot paths) with numpy host fallbacks, plus ctypes bindings to
+the in-repo C++ runtime (native/) where host-side work is the bottleneck.
+"""

@@ -100,10 +100,14 @@ def make_mesh(spec: Optional[MeshSpec] = None, device_list: Optional[Sequence] =
     sizes = spec.resolve(len(devs))
     axis_names = tuple(sizes.keys())
     shape = tuple(sizes[a] for a in axis_names)
+    # Auto axis types: GSPMD propagation (annotate shardings, XLA inserts
+    # collectives) — jax>=0.9 defaults make_mesh to Explicit, which we don't want
+    # for the framework's implicit-sharding style.
+    auto = (jax.sharding.AxisType.Auto,) * len(axis_names)
     if device_list is not None:
         arr = np.asarray(devs).reshape(shape)
-        return jax.sharding.Mesh(arr, axis_names)
-    return jax.make_mesh(shape, axis_names, devices=devs)
+        return jax.sharding.Mesh(arr, axis_names, axis_types=auto)
+    return jax.make_mesh(shape, axis_names, devices=devs, axis_types=auto)
 
 
 def data_sharding(mesh, *batch_axes: str):

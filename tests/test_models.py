@@ -1,0 +1,330 @@
+"""Tests for the NN module system, ResNet, DNNModel, and image stages (E2E slice)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.schema import ImageSchema
+from mmlspark_tpu.models import (
+    DNNModel,
+    Dense,
+    FunctionModel,
+    Sequential,
+    build_resnet,
+    relu,
+    resnet,
+)
+from mmlspark_tpu.image import (
+    ImageFeaturizer,
+    ImageSetAugmenter,
+    ImageTransformer,
+    ResizeImageTransformer,
+    UnrollImage,
+)
+from mmlspark_tpu.ops import image as imops
+
+
+def tiny_mlp(din=4, dhid=8, dout=3, seed=0):
+    import jax
+    module = Sequential([
+        ("dense1", Dense(dhid)),
+        ("relu1", relu()),
+        ("dense2", Dense(dout)),
+    ], name="mlp")
+    params, out_shape = module.init(jax.random.PRNGKey(seed), (din,))
+    assert out_shape == (dout,)
+    return FunctionModel(module, params, (din,), layer_names=["dense2", "relu1", "dense1"])
+
+
+class TestModule:
+    def test_init_apply_shapes(self):
+        m = tiny_mlp()
+        x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+        y = np.asarray(m.apply(x))
+        assert y.shape == (5, 3)
+
+    def test_taps(self):
+        m = tiny_mlp()
+        x = np.ones((2, 4), dtype=np.float32)
+        hidden = np.asarray(m.apply(x, tap="relu1"))
+        assert hidden.shape == (2, 8)
+        assert (hidden >= 0).all()
+
+    def test_output_node_resolution(self):
+        m = tiny_mlp()
+        assert m.resolve_output(None) is None
+        assert m.resolve_output("OUTPUT_0") is None
+        assert m.resolve_output("OUTPUT_2") == "relu1"
+        assert m.resolve_output("relu1") == "relu1"
+        with pytest.raises(KeyError):
+            m.resolve_output("nope")
+
+    def test_layer_paths(self):
+        m = tiny_mlp()
+        paths = m.module.layer_paths()
+        assert "dense1" in paths and "relu1" in paths
+
+
+class TestResNet:
+    def test_tiny_resnet_forward(self):
+        # depth-18 at 32px, width 8: small enough for CPU CI
+        import jax
+        module = build_resnet(18, num_classes=10, image_size=32, width=8)
+        params, out_shape = module.init(jax.random.PRNGKey(0), (32, 32, 3))
+        assert out_shape == (10,)
+        x = np.random.default_rng(0).normal(size=(2, 32, 32, 3)).astype(np.float32)
+        y = np.asarray(module.apply(params, x))
+        assert y.shape == (2, 10)
+        assert np.isfinite(y).all()
+
+    def test_resnet_tap_avgpool(self):
+        m = resnet(18, num_classes=10, image_size=32, width=8)
+        x = np.zeros((1, 32, 32, 3), dtype=np.float32)
+        feats = np.asarray(m.apply(x, tap="avgpool"))
+        assert feats.shape == (1, 8 * 8)  # width 8 * 2^3
+
+
+class TestDNNModel:
+    def test_transform_vectors(self):
+        m = tiny_mlp()
+        rng = np.random.default_rng(1)
+        rows = [rng.normal(size=4).astype(np.float32) for _ in range(11)]
+        df = DataFrame.from_dict({"feats": rows}, num_partitions=3)
+        stage = DNNModel(inputCol="feats", outputCol="out", batchSize=4).set_model(m)
+        out = stage.transform(df)
+        col = out.column("out")
+        assert len(col) == 11
+        ref = np.asarray(m.apply(np.stack(rows)))
+        got = np.stack(list(col))
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_output_node(self):
+        m = tiny_mlp()
+        df = DataFrame.from_dict({"feats": [np.ones(4, dtype=np.float32)] * 3})
+        stage = (DNNModel(inputCol="feats", outputCol="h", batchSize=2)
+                 .set_model(m).set_output_node("relu1"))
+        col = stage.transform(df).column("h")
+        assert col[0].shape == (8,)
+
+    def test_empty_partition(self):
+        m = tiny_mlp()
+        df = DataFrame([{"feats": np.empty(0, dtype=object)}])
+        stage = DNNModel(inputCol="feats", outputCol="out").set_model(m)
+        assert stage.transform(df).count() == 0
+
+
+class TestImageOps:
+    def test_resize_identity(self):
+        img = np.arange(48, dtype=np.uint8).reshape(4, 4, 3)
+        assert np.array_equal(imops.resize(img, 4, 4), img)
+
+    def test_resize_downscale(self):
+        img = np.full((8, 8, 3), 100, dtype=np.uint8)
+        out = imops.resize(img, 4, 4)
+        assert out.shape == (4, 4, 3)
+        assert np.all(out == 100)
+
+    def test_resize_matches_jax(self):
+        import jax
+        rng = np.random.default_rng(0)
+        img = rng.normal(size=(8, 6, 3)).astype(np.float32)
+        ours = imops.resize(img, 16, 12)
+        theirs = np.asarray(jax.image.resize(img, (16, 12, 3), method="linear"))
+        np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+    def test_flip(self):
+        img = np.arange(12, dtype=np.uint8).reshape(2, 2, 3)
+        assert np.array_equal(imops.flip(img, 1), img[:, ::-1])
+        assert np.array_equal(imops.flip(img, 0), img[::-1])
+        assert np.array_equal(imops.flip(img, -1), img[::-1, ::-1])
+
+    def test_gray(self):
+        img = np.full((2, 2, 3), 128, dtype=np.uint8)
+        g = imops.color_format(img, "gray")
+        assert g.shape == (2, 2, 1)
+        assert np.all(np.abs(g.astype(int) - 128) <= 1)
+
+    def test_box_blur_constant(self):
+        img = np.full((5, 5), 7.0, dtype=np.float32)
+        out = imops.box_blur(img, 3, 3)
+        np.testing.assert_allclose(out, 7.0, atol=1e-4)
+
+    def test_gaussian_blur_preserves_mean_of_constant(self):
+        img = np.full((6, 6), 3.0, dtype=np.float32)
+        np.testing.assert_allclose(imops.gaussian_blur(img, 1.0), 3.0, atol=1e-4)
+
+    def test_threshold(self):
+        img = np.array([[1.0, 5.0], [10.0, 0.0]], dtype=np.float32)
+        out = imops.threshold(img, 4.0, 255.0, "binary")
+        assert np.array_equal(out, [[0, 255], [255, 0]])
+
+    def test_unroll_chw(self):
+        img = np.arange(12, dtype=np.uint8).reshape(2, 2, 3)
+        v = imops.unroll_chw(img)
+        assert v.shape == (12,)
+        # channel-major: first 4 entries are channel 0
+        np.testing.assert_array_equal(v[:4], [0, 3, 6, 9])
+
+    def test_ppm_roundtrip(self):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 256, size=(5, 7, 3), dtype=np.uint8)
+        data = imops.encode_ppm(img)
+        dec = imops._decode_builtin(data)
+        np.testing.assert_array_equal(dec, img)
+
+
+def image_df(n=6, h=10, w=8, seed=0, num_partitions=2):
+    rng = np.random.default_rng(seed)
+    rows = [ImageSchema.make(rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8),
+                             origin=f"img{i}") for i in range(n)]
+    return DataFrame.from_dict({"image": rows, "label": list(range(n))},
+                               num_partitions=num_partitions)
+
+
+class TestImageStages:
+    def test_image_transformer_pipeline(self):
+        df = image_df()
+        t = (ImageTransformer(inputCol="image", outputCol="out")
+             .resize(6, 6).flip(1).color_format("gray"))
+        out = t.transform(df).column("out")
+        assert out[0]["height"] == 6 and out[0]["nChannels"] == 1
+
+    def test_resize_image_transformer(self):
+        df = image_df()
+        t = ResizeImageTransformer(inputCol="image", outputCol="image",
+                                   height=4, width=4)
+        out = t.transform(df).column("image")
+        assert all(r["height"] == 4 and r["width"] == 4 for r in out)
+
+    def test_unroll(self):
+        df = image_df(h=4, w=4)
+        out = UnrollImage(inputCol="image", outputCol="unrolled").transform(df)
+        v = out.column("unrolled")[0]
+        assert v.shape == (4 * 4 * 3,)
+
+    def test_augmenter_doubles_rows(self):
+        df = image_df(n=4)
+        out = ImageSetAugmenter(inputCol="image", outputCol="image").transform(df)
+        assert out.count() == 8
+
+    def test_image_featurizer_end_to_end(self):
+        m = resnet(18, num_classes=10, image_size=16, width=8)
+        df = image_df(n=5, h=20, w=14)
+        feat = (ImageFeaturizer(inputCol="image", outputCol="features", batchSize=4)
+                .set_model(m).set_cut_output_layers(1))
+        out = feat.transform(df)
+        col = out.column("features")
+        assert len(col) == 5
+        assert col[0].shape == (64,)  # width 8 * 2^3
+        assert np.isfinite(np.stack(list(col))).all()
+
+    def test_image_featurizer_logits(self):
+        m = resnet(18, num_classes=10, image_size=16, width=8)
+        df = image_df(n=3)
+        feat = (ImageFeaturizer(inputCol="image", outputCol="logits", batchSize=4)
+                .set_model(m).set_cut_output_layers(0))
+        col = feat.transform(df).column("logits")
+        assert col[0].shape == (10,)
+
+    def test_featurizer_from_bytes(self):
+        m = resnet(18, num_classes=10, image_size=16, width=8)
+        rng = np.random.default_rng(0)
+        blobs = [imops.encode_ppm(rng.integers(0, 256, (9, 9, 3), dtype=np.uint8))
+                 for _ in range(3)]
+        df = DataFrame.from_dict({"data": blobs})
+        feat = (ImageFeaturizer(inputCol="data", outputCol="features")
+                .set_model(m))
+        col = feat.transform(df).column("features")
+        assert len(col) == 3 and col[0].shape == (64,)
+
+
+class TestReviewRegressions:
+    """Regression tests for code-review findings."""
+
+    def test_batchnorm_ema_updated_by_train_step(self):
+        import jax
+        from mmlspark_tpu.models import training as T
+        from mmlspark_tpu.models.module import BatchNorm, Dense, Sequential, relu
+
+        module = Sequential([
+            ("dense", Dense(8)),
+            ("bn", BatchNorm()),
+            ("relu", relu()),
+            ("head", Dense(3)),
+        ])
+        opt = T.make_optimizer(0.01)
+        state = T.init_train_state(module, (4,), opt, seed=0)
+        step = T.make_train_step(module, opt)
+        rng = np.random.default_rng(0)
+        batch = {"x": (rng.normal(size=(16, 4)) * 5 + 2).astype(np.float32),
+                 "y": (np.arange(16) % 3).astype(np.int32)}
+        state, metrics = jax.jit(step)(state, batch)
+        mean = np.asarray(state.params["bn"]["mean"])
+        var = np.asarray(state.params["bn"]["var"])
+        assert not np.allclose(mean, 0.0), "moving mean never updated"
+        assert not np.allclose(var, 1.0), "moving var never updated"
+
+    def test_weight_decay_skips_bn_stats(self):
+        import jax
+        from mmlspark_tpu.models import training as T
+        from mmlspark_tpu.models.module import BatchNorm, Dense, Sequential
+
+        module = Sequential([("dense", Dense(4)), ("bn", BatchNorm()), ("head", Dense(2))])
+        opt = T.make_optimizer(0.1, weight_decay=0.5)
+        state = T.init_train_state(module, (4,), opt, seed=0)
+        step = T.make_train_step(module, opt)
+        batch = {"x": np.ones((8, 4), dtype=np.float32),
+                 "y": np.zeros(8, dtype=np.int32)}
+        for _ in range(3):
+            state, _ = jax.jit(step)(state, batch)
+        # moving var must NOT be decayed toward zero by weight decay
+        assert np.asarray(state.params["bn"]["var"]).min() > 0.1
+
+    def test_dnn_model_set_model_invalidates_cache(self):
+        m1 = tiny_mlp(dout=3)
+        m2 = tiny_mlp(dout=5, seed=1)
+        df = DataFrame.from_dict({"feats": [np.ones(4, dtype=np.float32)] * 2})
+        stage = DNNModel(inputCol="feats", outputCol="out").set_model(m1)
+        assert stage.transform(df).column("out")[0].shape == (3,)
+        stage.set_model(m2)
+        assert stage.transform(df).column("out")[0].shape == (5,)
+
+    def test_dnn_model_null_rows_pass_through(self):
+        m = tiny_mlp()
+        col = np.empty(3, dtype=object)
+        col[0] = np.ones(4, dtype=np.float32)
+        col[1] = None
+        col[2] = np.ones(4, dtype=np.float32)
+        df = DataFrame([{"feats": col}])
+        out = DNNModel(inputCol="feats", outputCol="out").set_model(m).transform(df)
+        vals = out.column("out")
+        assert vals[1] is None and vals[0] is not None and vals[2] is not None
+
+    def test_featurizer_keep_na(self):
+        m = resnet(18, num_classes=10, image_size=16, width=8)
+        col = np.empty(2, dtype=object)
+        col[0] = ImageSchema.make(np.zeros((8, 8, 3), dtype=np.uint8))
+        col[1] = None
+        df = DataFrame([{"image": col}])
+        feat = (ImageFeaturizer(inputCol="image", outputCol="f", dropNa=False)
+                .set_model(m))
+        vals = feat.transform(df).column("f")
+        assert len(vals) == 2 and vals[1] is None
+
+    def test_residual_inner_taps(self):
+        m = resnet(18, num_classes=10, image_size=16, width=8)
+        paths = m.module.layer_paths()
+        inner = [p for p in paths if "body/" in p]
+        assert inner, "residual bodies should be addressable"
+        x = np.zeros((1, 16, 16, 3), dtype=np.float32)
+        act = np.asarray(m.apply(x, tap=inner[0]))
+        assert act.ndim == 4
+
+    def test_function_model_pickles(self):
+        import pickle
+        m = tiny_mlp()
+        blob = pickle.dumps(m.module)
+        m2 = pickle.loads(blob)
+        x = np.ones((2, 4), dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(m2.apply(m.params, x)),
+                                   np.asarray(m.apply(x)), atol=1e-5)
