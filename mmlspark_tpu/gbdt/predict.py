@@ -1,0 +1,140 @@
+"""Tree-ensemble prediction: vectorized host path + jitted device kernel.
+
+LGBM_BoosterPredictForMat/PredictForMatSingle parity (driven by the reference's
+scoring UDFs, lightgbm/LightGBMBooster.scala:21-148). The device kernel pads all
+trees into one SoA tensor and traverses every (row, tree) pair in parallel with a
+bounded gather loop — no per-row JNI calls, one XLA program for the whole forest.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .tree import Tree
+
+
+def predict_single_tree(tree: Tree, X: np.ndarray) -> np.ndarray:
+    """Host path: [N,F] raw floats -> [N] contributions (incl. shrinkage)."""
+    n = X.shape[0]
+    node = np.zeros(n, dtype=np.int64)
+    active = tree.feature[node] != -1
+    while active.any():
+        cur = node[active]
+        f = tree.feature[cur]
+        x = X[active, f]
+        miss = np.isnan(x)
+        go_left = np.where(miss, tree.default_left[cur], x <= tree.threshold[cur])
+        node[active] = np.where(go_left, tree.left[cur], tree.right[cur])
+        active = tree.feature[node] != -1
+    return tree.value[node] * tree.shrinkage
+
+
+def predict_ensemble(tree_groups: List[List[Tree]], X: np.ndarray,
+                     num_class: int) -> np.ndarray:
+    """[iterations][class] trees -> [N, num_class] raw score deltas."""
+    n = X.shape[0]
+    out = np.zeros((n, num_class), dtype=np.float64)
+    for group in tree_groups:
+        for k, tree in enumerate(group):
+            out[:, k] += predict_single_tree(tree, X)
+    return out
+
+
+class DeviceEnsemble:
+    """All trees padded into one SoA tensor; one jitted traversal for the forest.
+
+    Used by the model stages' transform hot path: predict cost is
+    O(depth * N * T) gathers, fully parallel on device.
+    """
+
+    def __init__(self, tree_groups: List[List[Tree]], num_class: int):
+        trees = [t for g in tree_groups for t in g]
+        self.num_class = num_class
+        self.class_of_tree = np.array(
+            [k for g in tree_groups for k in range(len(g))], dtype=np.int32)
+        self.num_trees = len(trees)
+        if not trees:
+            return
+        m = max(len(t.feature) for t in trees)
+        self.max_depth = 0
+
+        def pad(vals, fill, dtype):
+            out = np.full((self.num_trees, m), fill, dtype=dtype)
+            for i, v in enumerate(vals):
+                out[i, :len(v)] = v
+            return out
+
+        self.feature = pad([t.feature for t in trees], -1, np.int32)
+        self.threshold = pad([t.threshold for t in trees], 0.0, np.float32)
+        self.default_left = pad([t.default_left for t in trees], True, bool)
+        self.left = pad([t.left for t in trees], 0, np.int32)
+        self.right = pad([t.right for t in trees], 0, np.int32)
+        self.value = pad([np.asarray(t.value) * t.shrinkage for t in trees],
+                         0.0, np.float32)
+        for t in trees:
+            self.max_depth = max(self.max_depth, _tree_depth(t))
+        self._jitted = None
+
+    def _compile(self):
+        import jax
+        import jax.numpy as jnp
+
+        depth = max(self.max_depth, 1)
+        feature = jnp.asarray(self.feature)
+        threshold = jnp.asarray(self.threshold)
+        default_left = jnp.asarray(self.default_left)
+        left = jnp.asarray(self.left)
+        right = jnp.asarray(self.right)
+        value = jnp.asarray(self.value)
+        class_onehot = jax.nn.one_hot(
+            jnp.asarray(self.class_of_tree), self.num_class, dtype=jnp.float32)
+
+        def fwd(X):
+            n = X.shape[0]
+            t = feature.shape[0]
+            node = jnp.zeros((n, t), dtype=jnp.int32)
+
+            def body(_, node):
+                f = jnp.take_along_axis(feature[None, :, :],
+                                        node[:, :, None], axis=2)[:, :, 0]
+                thr = jnp.take_along_axis(threshold[None, :, :],
+                                          node[:, :, None], axis=2)[:, :, 0]
+                dl = jnp.take_along_axis(default_left[None, :, :],
+                                         node[:, :, None], axis=2)[:, :, 0]
+                l = jnp.take_along_axis(left[None, :, :],
+                                        node[:, :, None], axis=2)[:, :, 0]
+                r = jnp.take_along_axis(right[None, :, :],
+                                        node[:, :, None], axis=2)[:, :, 0]
+                x = jnp.take_along_axis(X, jnp.maximum(f, 0), axis=1)
+                miss = jnp.isnan(x)
+                go_left = jnp.where(miss, dl, x <= thr)
+                nxt = jnp.where(go_left, l, r)
+                return jnp.where(f == -1, node, nxt)
+
+            node = jax.lax.fori_loop(0, depth, body, node)
+            leaf_vals = jnp.take_along_axis(value[None, :, :],
+                                            node[:, :, None], axis=2)[:, :, 0]
+            return leaf_vals @ class_onehot          # [N, num_class]
+
+        return jax.jit(fwd)
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        """[N,F] float32 -> [N, num_class] summed tree outputs (device)."""
+        if self.num_trees == 0:
+            return np.zeros((X.shape[0], self.num_class), dtype=np.float64)
+        if self._jitted is None:
+            self._jitted = self._compile()
+        return np.asarray(self._jitted(np.asarray(X, dtype=np.float32)),
+                          dtype=np.float64)
+
+
+def _tree_depth(tree: Tree) -> int:
+    depth = np.zeros(len(tree.feature), dtype=np.int32)
+    order = range(len(tree.feature))
+    for i in order:  # parents precede children by construction
+        if tree.feature[i] != -1:
+            depth[tree.left[i]] = depth[i] + 1
+            depth[tree.right[i]] = depth[i] + 1
+    return int(depth.max()) + 1 if len(depth) else 1

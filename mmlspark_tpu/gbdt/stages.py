@@ -1,0 +1,386 @@
+"""LightGBM-parity pipeline stages: Classifier / Regressor / Ranker (+Models).
+
+Param surface mirrors lightgbm/LightGBMParams.scala:1-259 (camelCase names kept so
+reference users find everything); fit orchestration mirrors LightGBMBase.train
+(lightgbm/LightGBMBase.scala:18-192) including multi-batch incremental training via
+booster merge and validation-indicator early stopping. The socket-ring/rendezvous
+machinery has no equivalent here: SPMD + psum replaces it (histogram.py docstring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import (
+    ComplexParam,
+    HasFeaturesCol,
+    HasGroupCol,
+    HasInitScoreCol,
+    HasLabelCol,
+    HasValidationIndicatorCol,
+    HasWeightCol,
+    Param,
+)
+from ..core.pipeline import Estimator, Model
+from ..core.schema import ColType, Schema
+from ..parallel.batching import stack_rows
+from .booster import Booster, TrainParams, train
+
+
+class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
+                      HasValidationIndicatorCol, HasInitScoreCol):
+    """Shared param surface (LightGBMParams.scala:1-259)."""
+
+    numIterations = Param("numIterations", "Number of boosting iterations", 100,
+                          lambda v: v > 0, int)
+    learningRate = Param("learningRate", "Shrinkage rate", 0.1, lambda v: v > 0, float)
+    numLeaves = Param("numLeaves", "Max leaves per tree", 31, lambda v: v > 1, int)
+    maxBin = Param("maxBin", "Max feature bins", 255, lambda v: v > 1, int)
+    maxDepth = Param("maxDepth", "Max tree depth (-1 = unlimited)", -1, ptype=int)
+    minDataInLeaf = Param("minDataInLeaf", "Min rows per leaf", 20, lambda v: v >= 0, int)
+    minSumHessianInLeaf = Param("minSumHessianInLeaf", "Min hessian per leaf", 1e-3,
+                                ptype=float)
+    minGainToSplit = Param("minGainToSplit", "Min gain to split", 0.0, ptype=float)
+    lambdaL1 = Param("lambdaL1", "L1 regularization", 0.0, ptype=float)
+    lambdaL2 = Param("lambdaL2", "L2 regularization", 0.0, ptype=float)
+    baggingFraction = Param("baggingFraction", "Row subsample fraction", 1.0, ptype=float)
+    baggingFreq = Param("baggingFreq", "Bagging frequency (0 = off)", 0, ptype=int)
+    baggingSeed = Param("baggingSeed", "Bagging seed", 3, ptype=int)
+    featureFraction = Param("featureFraction", "Feature subsample per tree", 1.0,
+                            ptype=float)
+    boostingType = Param("boostingType", "gbdt|rf|dart|goss", "gbdt",
+                         lambda v: v in ("gbdt", "rf", "dart", "goss"), str)
+    earlyStoppingRound = Param("earlyStoppingRound",
+                               "Stop if no valid improvement for N rounds (0 = off)",
+                               0, ptype=int)
+    numBatches = Param("numBatches",
+                       "Split data into batches, train incrementally and merge "
+                       "(LightGBMBase.scala:26-39)", 0, ptype=int)
+    categoricalSlotIndexes = Param("categoricalSlotIndexes",
+                                   "Feature indexes treated as categorical", None,
+                                   ptype=(list, tuple))
+    modelString = Param("modelString", "Init model string for continued training",
+                        None, ptype=str)
+    boostFromAverage = Param("boostFromAverage", "Init score from label mean", True,
+                             ptype=bool)
+    verbosity = Param("verbosity", "Logging verbosity", -1, ptype=int)
+    seed = Param("seed", "Master random seed", 0, ptype=int)
+    objective = Param("objective", "Objective override", None, ptype=str)
+    alpha = Param("alpha", "Quantile/huber parameter", 0.9, ptype=float)
+    dropRate = Param("dropRate", "DART tree drop rate", 0.1, ptype=float)
+    maxDrop = Param("maxDrop", "DART max dropped trees", 50, ptype=int)
+    topRate = Param("topRate", "GOSS top-gradient keep rate", 0.2, ptype=float)
+    otherRate = Param("otherRate", "GOSS random keep rate", 0.1, ptype=float)
+    useBarrierExecutionMode = Param("useBarrierExecutionMode",
+                                    "Gang scheduling (inherent on TPU; parity no-op)",
+                                    False, ptype=bool)
+    numWorkers = Param("numWorkers", "Worker/shard count override (0 = auto)", 0,
+                       ptype=int)
+
+    def _train_params(self, objective: str, num_class: int = 1) -> TrainParams:
+        return TrainParams(
+            objective=self.get("objective") or objective,
+            boosting_type=self.get("boostingType"),
+            num_iterations=self.get("numIterations"),
+            learning_rate=self.get("learningRate"),
+            num_leaves=self.get("numLeaves"),
+            max_bin=self.get("maxBin"),
+            max_depth=self.get("maxDepth"),
+            min_data_in_leaf=self.get("minDataInLeaf"),
+            min_sum_hessian_in_leaf=self.get("minSumHessianInLeaf"),
+            min_gain_to_split=self.get("minGainToSplit"),
+            lambda_l1=self.get("lambdaL1"),
+            lambda_l2=self.get("lambdaL2"),
+            bagging_fraction=self.get("baggingFraction"),
+            bagging_freq=self.get("baggingFreq"),
+            bagging_seed=self.get("baggingSeed"),
+            feature_fraction=self.get("featureFraction"),
+            early_stopping_round=self.get("earlyStoppingRound"),
+            num_class=num_class,
+            alpha=self.get("alpha"),
+            drop_rate=self.get("dropRate"),
+            max_drop=self.get("maxDrop"),
+            top_rate=self.get("topRate"),
+            other_rate=self.get("otherRate"),
+            categorical_feature=tuple(self.get("categoricalSlotIndexes") or ()),
+            seed=self.get("seed"),
+        )
+
+    def _extract(self, df: DataFrame):
+        """DataFrame -> (X, y, weights, init_scores, valid_mask) numpy arrays."""
+        data = df.collect()
+        X = stack_rows(data[self.get_or_throw("featuresCol")], np.float64)
+        y = np.asarray(data[self.get_or_throw("labelCol")], dtype=np.float64)
+        w = None
+        if self.get("weightCol"):
+            w = np.asarray(data[self.get("weightCol")], dtype=np.float64)
+        init_scores = None
+        if self.get("initScoreCol"):
+            init_scores = np.asarray(data[self.get("initScoreCol")], dtype=np.float64)
+        valid_mask = None
+        if self.get("validationIndicatorCol"):
+            valid_mask = np.asarray(data[self.get("validationIndicatorCol")],
+                                    dtype=bool)
+        return X, y, w, init_scores, valid_mask
+
+    def _fit_booster(self, df: DataFrame, objective: str, num_class: int = 1,
+                     groups: Optional[np.ndarray] = None) -> Booster:
+        import logging
+
+        X, y, w, init_scores, valid_mask = self._extract(df)
+        params = self._train_params(objective, num_class)
+        valid = None
+        valid_groups = None
+        if valid_mask is not None:
+            valid = (X[valid_mask], y[valid_mask])
+            keep = ~valid_mask
+            X, y = X[keep], y[keep]
+            if w is not None:
+                w = w[keep]
+            if init_scores is not None:
+                init_scores = init_scores[keep]
+            if groups is not None:
+                valid_groups = groups[valid_mask]
+                groups = groups[keep]
+        init = None
+        if self.get("modelString"):
+            init = Booster.from_string(self.get("modelString"))
+        log = logging.getLogger("mmlspark_tpu.gbdt").info \
+            if self.get("verbosity") >= 0 else None
+
+        # worker topology: the default mesh's data axis is the worker count
+        # (ClusterUtil.getNumExecutorCores parity, LightGBMBase.scala:120-128);
+        # numWorkers=1 forces single-device training.
+        mesh = None
+        if self.get("numWorkers") != 1:
+            from ..parallel.mesh import DATA_AXIS, MeshContext
+
+            try:
+                candidate = MeshContext.get()
+                if int(candidate.shape.get(DATA_AXIS, 1)) > 1:
+                    mesh = candidate
+            except Exception:
+                mesh = None
+
+        n_batches = self.get("numBatches")
+        if n_batches and n_batches > 1:
+            booster = init
+            bounds = np.linspace(0, len(y), n_batches + 1).astype(int)
+            for b in range(n_batches):
+                sl = slice(bounds[b], bounds[b + 1])
+                booster = train(params, X[sl], y[sl],
+                                weights=w[sl] if w is not None else None,
+                                groups=groups[sl] if groups is not None else None,
+                                valid=valid, valid_groups=valid_groups,
+                                init_scores=init_scores[sl] if init_scores is not None else None,
+                                init_model=booster, log=log, mesh=mesh)
+            return booster
+        return train(params, X, y, weights=w, groups=groups, valid=valid,
+                     valid_groups=valid_groups, init_scores=init_scores,
+                     init_model=init, log=log, mesh=mesh)
+
+
+class _LightGBMModelBase(Model, HasFeaturesCol):
+    """Shared scoring: features column -> raw scores via the device forest kernel."""
+
+    model = ComplexParam("model", "Trained booster (model string)")
+
+    def __init__(self, **kwargs):
+        booster = kwargs.pop("booster", None)
+        super().__init__(**kwargs)
+        self._booster: Optional[Booster] = booster
+        self._device_ensemble = None
+        if booster is not None:
+            self.set("model", booster.to_string())
+
+    @property
+    def booster(self) -> Booster:
+        if self._booster is None:
+            self._booster = Booster.from_string(self.get_or_throw("model"))
+        return self._booster
+
+    def _ensemble(self):
+        from .predict import DeviceEnsemble
+
+        if self._device_ensemble is None:
+            b = self.booster
+            n_iter = b.best_iteration if b.best_iteration > 0 else len(b.trees)
+            self._device_ensemble = DeviceEnsemble(
+                b.trees[:n_iter], max(b.params.num_class, 1))
+        return self._device_ensemble
+
+    def _raw_scores(self, part) -> np.ndarray:
+        X = stack_rows(part[self.get_or_throw("featuresCol")], np.float32)
+        raw = self._ensemble().predict_raw(X)
+        return raw + self.booster.base_score[None, :]
+
+    # -- reference API parity --------------------------------------------
+    def save_native_model(self, path: str, overwrite: bool = True) -> None:
+        """saveNativeModel parity (LightGBMClassifier.scala)."""
+        import os
+
+        if os.path.exists(path) and not overwrite:
+            raise FileExistsError(path)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.booster.to_string())
+
+    def get_feature_importances(self, importance_type: str = "split") -> List[float]:
+        return list(self.booster.feature_importances(importance_type))
+
+    def get_model_string(self) -> str:
+        return self.get_or_throw("model")
+
+
+# ---------------------------------------------------------------------------
+# Classifier
+# ---------------------------------------------------------------------------
+
+
+class LightGBMClassifier(Estimator, _LightGBMParams):
+    """Binary/multiclass GBDT classifier (lightgbm/LightGBMClassifier.scala)."""
+
+    rawPredictionCol = Param("rawPredictionCol", "Raw scores column", "rawPrediction",
+                             ptype=str)
+    probabilityCol = Param("probabilityCol", "Probability vector column", "probability",
+                           ptype=str)
+    predictionCol = Param("predictionCol", "Predicted label column", "prediction",
+                          ptype=str)
+
+    def fit(self, df: DataFrame) -> "LightGBMClassificationModel":
+        y = df.column(self.get_or_throw("labelCol"))
+        classes = np.unique(np.asarray(y, dtype=np.float64))
+        num_class = len(classes)
+        if not np.array_equal(classes, np.arange(num_class)):
+            raise ValueError(
+                f"Labels must be 0..K-1 (got {classes[:10]}); use ValueIndexer first")
+        objective = "binary" if num_class <= 2 else "multiclass"
+        booster = self._fit_booster(df, objective,
+                                    1 if num_class <= 2 else num_class)
+        return LightGBMClassificationModel(
+            booster=booster,
+            featuresCol=self.get("featuresCol"),
+            rawPredictionCol=self.get("rawPredictionCol"),
+            probabilityCol=self.get("probabilityCol"),
+            predictionCol=self.get("predictionCol"),
+        )
+
+
+class LightGBMClassificationModel(_LightGBMModelBase):
+    rawPredictionCol = Param("rawPredictionCol", "Raw scores column", "rawPrediction",
+                             ptype=str)
+    probabilityCol = Param("probabilityCol", "Probability vector column", "probability",
+                           ptype=str)
+    predictionCol = Param("predictionCol", "Predicted label column", "prediction",
+                          ptype=str)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        def score(part):
+            raw = self._raw_scores(part)
+            if self.booster.params.objective == "binary":
+                p1 = 1 / (1 + np.exp(-raw[:, 0]))
+                proba = np.stack([1 - p1, p1], axis=1)
+                rawcol = np.stack([-raw[:, 0], raw[:, 0]], axis=1)
+            else:
+                e = np.exp(raw - raw.max(axis=1, keepdims=True))
+                proba = e / e.sum(axis=1, keepdims=True)
+                rawcol = raw
+            pred = np.argmax(proba, axis=1).astype(np.float64)
+            n = len(pred)
+            raw_obj = np.empty(n, dtype=object)
+            proba_obj = np.empty(n, dtype=object)
+            for i in range(n):
+                raw_obj[i] = rawcol[i]
+                proba_obj[i] = proba[i]
+            part[self.get("rawPredictionCol")] = raw_obj
+            part[self.get("probabilityCol")] = proba_obj
+            part[self.get("predictionCol")] = pred
+            return part
+
+        return df.map_partitions(score)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        out = schema.copy()
+        out.types[self.get("rawPredictionCol")] = ColType.VECTOR
+        out.types[self.get("probabilityCol")] = ColType.VECTOR
+        out.types[self.get("predictionCol")] = ColType.FLOAT64
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Regressor
+# ---------------------------------------------------------------------------
+
+
+class LightGBMRegressor(Estimator, _LightGBMParams):
+    """GBDT regressor: l2/l1/huber/quantile/poisson objectives
+    (lightgbm/LightGBMRegressor.scala)."""
+
+    predictionCol = Param("predictionCol", "Prediction column", "prediction", ptype=str)
+    applicationName = Param("applicationName", "regression|quantile|huber|poisson|mape",
+                            "regression", ptype=str)
+
+    def fit(self, df: DataFrame) -> "LightGBMRegressionModel":
+        objective = self.get("objective") or {
+            "regression": "regression", "quantile": "quantile",
+            "huber": "huber", "poisson": "poisson",
+        }.get(self.get("applicationName"), "regression")
+        booster = self._fit_booster(df, objective)
+        return LightGBMRegressionModel(
+            booster=booster,
+            featuresCol=self.get("featuresCol"),
+            predictionCol=self.get("predictionCol"),
+        )
+
+
+class LightGBMRegressionModel(_LightGBMModelBase):
+    predictionCol = Param("predictionCol", "Prediction column", "prediction", ptype=str)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        def score(part):
+            raw = self._raw_scores(part)[:, 0]
+            if self.booster.params.objective == "poisson":
+                raw = np.exp(raw)
+            part[self.get("predictionCol")] = raw
+            return part
+
+        return df.map_partitions(score)
+
+
+# ---------------------------------------------------------------------------
+# Ranker
+# ---------------------------------------------------------------------------
+
+
+class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
+    """LambdaRank GBDT (lightgbm/LightGBMRanker.scala; group cardinality encoding
+    TrainUtils.scala:82-132 — here groups are a plain column, no encoding dance)."""
+
+    predictionCol = Param("predictionCol", "Prediction column", "prediction", ptype=str)
+
+    def fit(self, df: DataFrame) -> "LightGBMRankerModel":
+        group_col = self.get_or_throw("groupCol")
+        raw_groups = df.column(group_col)
+        _, groups = np.unique(np.asarray([str(g) for g in raw_groups]),
+                              return_inverse=True)
+        booster = self._fit_booster(df, "lambdarank", groups=groups.astype(np.int64))
+        return LightGBMRankerModel(
+            booster=booster,
+            featuresCol=self.get("featuresCol"),
+            predictionCol=self.get("predictionCol"),
+        )
+
+
+class LightGBMRankerModel(_LightGBMModelBase):
+    predictionCol = Param("predictionCol", "Prediction column", "prediction", ptype=str)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        def score(part):
+            part[self.get("predictionCol")] = self._raw_scores(part)[:, 0]
+            return part
+
+        return df.map_partitions(score)

@@ -1,0 +1,231 @@
+"""Leaf-wise (best-first) tree growth over binned features.
+
+LightGBM's core algorithm (the reference drives it as a black box through
+LGBM_BoosterUpdateOneIter, TrainUtils.scala:170-233): grow the leaf with the
+largest split gain until ``num_leaves``, computing each split from per-leaf
+histograms, with the parent-minus-sibling subtraction trick so each level costs
+one scatter pass over the smaller child only.
+
+Host Python orchestrates; every inner computation (histogram scatter, split scan,
+row partition) is a jitted kernel from histogram.py with static shapes, so the
+whole growth loop compiles to a handful of cached XLA executables.
+
+Trees are stored as flat arrays (SoA) for vectorized prediction: no pointer
+chasing, predict is a gather loop over depth (predict_trees in booster.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import histogram as H
+
+
+@dataclasses.dataclass
+class Tree:
+    """Flat decision tree. Node 0 is the root; feature == -1 marks a leaf."""
+
+    feature: np.ndarray        # i32 [nodes], -1 for leaves
+    threshold: np.ndarray      # f64 [nodes], raw-value threshold (<= goes left)
+    threshold_bin: np.ndarray  # i32 [nodes]
+    default_left: np.ndarray   # bool [nodes], missing direction
+    left: np.ndarray           # i32 [nodes]
+    right: np.ndarray          # i32 [nodes]
+    value: np.ndarray          # f64 [nodes], leaf output (0 for internal)
+    gain: np.ndarray           # f32 [nodes], split gain (0 for leaves)
+    count: np.ndarray          # i32 [nodes], training rows through the node
+    shrinkage: float = 1.0
+
+    @property
+    def num_leaves(self) -> int:
+        return int((self.feature == -1).sum())
+
+    def to_dict(self) -> dict:
+        return {
+            "feature": self.feature.tolist(),
+            "threshold": self.threshold.tolist(),
+            "threshold_bin": self.threshold_bin.tolist(),
+            "default_left": self.default_left.tolist(),
+            "left": self.left.tolist(),
+            "right": self.right.tolist(),
+            "value": self.value.tolist(),
+            "gain": self.gain.tolist(),
+            "count": self.count.tolist(),
+            "shrinkage": self.shrinkage,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Tree":
+        return Tree(
+            feature=np.asarray(d["feature"], dtype=np.int32),
+            threshold=np.asarray(d["threshold"], dtype=np.float64),
+            threshold_bin=np.asarray(d["threshold_bin"], dtype=np.int32),
+            default_left=np.asarray(d["default_left"], dtype=bool),
+            left=np.asarray(d["left"], dtype=np.int32),
+            right=np.asarray(d["right"], dtype=np.int32),
+            value=np.asarray(d["value"], dtype=np.float64),
+            gain=np.asarray(d["gain"], dtype=np.float32),
+            count=np.asarray(d["count"], dtype=np.int32),
+            shrinkage=float(d.get("shrinkage", 1.0)),
+        )
+
+
+@dataclasses.dataclass
+class GrowerConfig:
+    num_leaves: int = 31
+    max_depth: int = -1                 # -1 = unlimited (bounded by num_leaves)
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+
+
+class _Node:
+    __slots__ = ("id", "depth", "hist", "sums", "split")
+
+    def __init__(self, id, depth, hist, sums, split):
+        self.id = id
+        self.depth = depth
+        self.hist = hist
+        self.sums = sums      # np [3]: grad, hess, count
+        self.split = split    # SplitInfo (host numpy) or None
+
+
+def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
+              config: GrowerConfig, bin_mapper, feature_mask=None,
+              node_of_row=None) -> Tuple[Tree, np.ndarray]:
+    """Grow one tree; returns (tree, leaf_node_of_row).
+
+    ``bins_dev``: [N,F] int32 (device). ``grad``/``hess``: [N] f32 (device).
+    ``row_mask``: [N] bool — bagging/goss row subset. ``feature_mask``: [F] bool.
+    ``leaf_node_of_row`` maps every (masked-in) row to its final node id, so the
+    booster can update scores with one gather instead of re-predicting.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n, num_f = bins_dev.shape
+    node_of_row = jnp.zeros(n, dtype=jnp.int32)
+
+    # growable node storage (host lists; frozen to arrays at the end)
+    feature = [-1]
+    threshold = [0.0]
+    threshold_bin = [0]
+    default_left = [True]
+    left = [-1]
+    right = [-1]
+    value = [0.0]
+    gains = [0.0]
+    counts = [0]
+
+    def eval_node(hist) -> Tuple[Optional[H.SplitInfo], np.ndarray]:
+        split = H.find_best_split(
+            hist, config.lambda_l1, config.lambda_l2,
+            config.min_sum_hessian_in_leaf, config.min_data_in_leaf,
+            feature_mask)
+        return jax.device_get(split)
+
+    root_hist = H.compute_histogram(bins_dev, grad, hess, row_mask, num_bins)
+    root_sums = np.asarray(jax.device_get(
+        H.total_sums(grad, hess, row_mask)), dtype=np.float64)
+    counts[0] = int(root_sums[2])
+    root_split = eval_node(root_hist)
+
+    heap: List[Tuple[float, int, _Node]] = []
+    tiebreak = 0
+
+    def push(node: _Node):
+        nonlocal tiebreak
+        if node.split is not None and np.isfinite(node.split.gain) \
+                and node.split.gain > config.min_gain_to_split:
+            if config.max_depth > 0 and node.depth >= config.max_depth:
+                return
+            heapq.heappush(heap, (-float(node.split.gain), tiebreak, node))
+            tiebreak += 1
+
+    push(_Node(0, 0, root_hist, root_sums, root_split))
+    n_leaves = 1
+
+    while heap and n_leaves < config.num_leaves:
+        _, _, node = heapq.heappop(heap)
+        s = node.split
+        f, t = int(s.feature), int(s.bin)
+        lid, rid = len(feature), len(feature) + 1
+
+        # record the split on the parent
+        feature[node.id] = f
+        threshold[node.id] = bin_mapper.bin_upper_value(f, t)
+        threshold_bin[node.id] = t
+        default_left[node.id] = bool(s.default_left)
+        left[node.id] = lid
+        right[node.id] = rid
+        gains[node.id] = float(s.gain)
+        value[node.id] = 0.0
+
+        lsum = np.asarray(s.left_sum, dtype=np.float64)
+        rsum = np.asarray(s.right_sum, dtype=np.float64)
+        for sums in (lsum, rsum):
+            feature.append(-1)
+            threshold.append(0.0)
+            threshold_bin.append(0)
+            default_left.append(True)
+            left.append(-1)
+            right.append(-1)
+            g_thr = np.sign(sums[0]) * max(abs(sums[0]) - config.lambda_l1, 0.0)
+            value.append(float(-g_thr / (sums[1] + config.lambda_l2)))
+            gains.append(0.0)
+            counts.append(int(sums[2]))
+
+        node_of_row = H.partition_rows(
+            bins_dev[:, f], node_of_row, node.id,
+            np.int32(t), bool(s.default_left), np.int32(lid), np.int32(rid))
+        n_leaves += 1
+
+        # histogram subtraction: scatter only the smaller child
+        small_id, big_id = (lid, rid) if lsum[2] <= rsum[2] else (rid, lid)
+        small_mask = row_mask & (node_of_row == small_id)
+        small_hist = H.compute_histogram(bins_dev, grad, hess, small_mask, num_bins)
+        big_hist = H.subtract_histogram(node.hist, small_hist)
+        small_sums = lsum if small_id == lid else rsum
+        big_sums = rsum if small_id == lid else lsum
+
+        for cid, chist, csums in ((small_id, small_hist, small_sums),
+                                  (big_id, big_hist, big_sums)):
+            if csums[2] >= 2 * config.min_data_in_leaf:
+                push(_Node(cid, node.depth + 1, chist, csums, eval_node(chist)))
+
+    tree = Tree(
+        feature=np.asarray(feature, dtype=np.int32),
+        threshold=np.asarray(threshold, dtype=np.float64),
+        threshold_bin=np.asarray(threshold_bin, dtype=np.int32),
+        default_left=np.asarray(default_left, dtype=bool),
+        left=np.asarray(left, dtype=np.int32),
+        right=np.asarray(right, dtype=np.int32),
+        value=np.asarray(value, dtype=np.float64),
+        gain=np.asarray(gains, dtype=np.float32),
+        count=np.asarray(counts, dtype=np.int32),
+    )
+    return tree, np.asarray(jax.device_get(node_of_row))
+
+
+def predict_tree_binned(tree: Tree, bins: np.ndarray) -> np.ndarray:
+    """Evaluate one tree on binned features (host reference path for tests)."""
+    n = bins.shape[0]
+    out = np.zeros(n, dtype=np.float64)
+    node = np.zeros(n, dtype=np.int64)
+    active = tree.feature[node] != -1
+    while active.any():
+        f = tree.feature[node[active]]
+        b = bins[active, f]
+        t = tree.threshold_bin[node[active]]
+        go_left = np.where(b == 0, tree.default_left[node[active]], b <= t)
+        node[active] = np.where(go_left, tree.left[node[active]],
+                                tree.right[node[active]])
+        active = tree.feature[node] != -1
+    out = tree.value[node] * tree.shrinkage
+    return out

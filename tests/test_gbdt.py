@@ -1,0 +1,427 @@
+"""GBDT engine tests: binning, histogram/split kernels, boosting, stages."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.gbdt import (
+    BinMapper,
+    Booster,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRegressor,
+    TrainParams,
+)
+from mmlspark_tpu.gbdt import booster as B
+from mmlspark_tpu.gbdt import histogram as H
+from mmlspark_tpu.gbdt.predict import DeviceEnsemble, predict_ensemble
+from mmlspark_tpu.gbdt.tree import GrowerConfig, grow_tree
+
+
+def synth_binary(n=500, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 0]
+    y = (logit + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def feature_df(X, y, extra=None, parts=2):
+    rows = [X[i] for i in range(len(X))]
+    d = {"features": rows, "label": y}
+    if extra:
+        d.update(extra)
+    return DataFrame.from_dict(d, num_partitions=parts)
+
+
+class TestBinning:
+    def test_fit_transform_shapes(self):
+        X = np.random.default_rng(0).normal(size=(100, 5))
+        m = BinMapper.fit(X, max_bin=16)
+        bins = m.transform(X)
+        assert bins.shape == X.shape
+        assert bins.min() >= 1  # no missing
+        assert bins.max() < m.max_num_bins
+
+    def test_missing_goes_to_bin0(self):
+        X = np.array([[1.0], [np.nan], [2.0]])
+        m = BinMapper.fit(X, max_bin=8)
+        bins = m.transform(X)
+        assert bins[1, 0] == 0 and bins[0, 0] >= 1
+
+    def test_monotonic(self):
+        X = np.linspace(0, 1, 50).reshape(-1, 1)
+        m = BinMapper.fit(X, max_bin=8)
+        bins = m.transform(X)[:, 0]
+        assert (np.diff(bins) >= 0).all()
+
+    def test_categorical(self):
+        X = np.array([[3.0], [7.0], [3.0], [9.0]])
+        m = BinMapper.fit(X, max_bin=8, categorical_indexes=[0])
+        bins = m.transform(X)[:, 0]
+        assert bins[0] == bins[2] and bins[0] != bins[1]
+
+    def test_json_roundtrip(self):
+        X = np.random.default_rng(0).normal(size=(50, 3))
+        m = BinMapper.fit(X, max_bin=8)
+        m2 = BinMapper.from_json(m.to_json())
+        np.testing.assert_array_equal(m.transform(X), m2.transform(X))
+
+
+class TestHistogram:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        n, f, b = 200, 4, 16
+        bins = rng.integers(0, b, size=(n, f)).astype(np.int32)
+        grad = rng.normal(size=n).astype(np.float32)
+        hess = rng.uniform(0.1, 1, size=n).astype(np.float32)
+        mask = rng.random(n) < 0.7
+        hist = np.asarray(H.compute_histogram(bins, grad, hess, mask, b))
+        for fi in range(f):
+            for bi in range(b):
+                sel = (bins[:, fi] == bi) & mask
+                np.testing.assert_allclose(hist[fi, bi, 0], grad[sel].sum(), atol=1e-3)
+                np.testing.assert_allclose(hist[fi, bi, 1], hess[sel].sum(), atol=1e-3)
+                np.testing.assert_allclose(hist[fi, bi, 2], sel.sum(), atol=1e-3)
+
+    def test_split_finds_perfect_separator(self):
+        # feature 1 perfectly separates grad sign at bin <= 4
+        n, f, b = 100, 3, 8
+        rng = np.random.default_rng(0)
+        bins = rng.integers(1, b, size=(n, f)).astype(np.int32)
+        grad = np.where(bins[:, 1] <= 4, -1.0, 1.0).astype(np.float32)
+        hess = np.ones(n, dtype=np.float32)
+        mask = np.ones(n, dtype=bool)
+        hist = H.compute_histogram(bins, grad, hess, mask, b)
+        split = H.find_best_split(hist, 0.0, 0.0, 1e-3, 1)
+        assert int(split.feature) == 1
+        assert int(split.bin) == 4
+
+    def test_subtraction_trick(self):
+        rng = np.random.default_rng(1)
+        n, f, b = 300, 5, 16
+        bins = rng.integers(0, b, size=(n, f)).astype(np.int32)
+        grad = rng.normal(size=n).astype(np.float32)
+        hess = rng.uniform(0.1, 1, size=n).astype(np.float32)
+        all_mask = np.ones(n, dtype=bool)
+        sub_mask = rng.random(n) < 0.5
+        parent = np.asarray(H.compute_histogram(bins, grad, hess, all_mask, b))
+        child = np.asarray(H.compute_histogram(bins, grad, hess, sub_mask, b))
+        sibling = np.asarray(H.subtract_histogram(parent, child))
+        direct = np.asarray(H.compute_histogram(bins, grad, hess, ~sub_mask, b))
+        np.testing.assert_allclose(sibling, direct, atol=1e-2)
+
+
+class TestTreeGrowth:
+    def test_tree_reduces_loss(self):
+        import jax.numpy as jnp
+        X, y = synth_binary(400)
+        m = BinMapper.fit(X, max_bin=32)
+        bins = m.transform(X)
+        p = np.full_like(y, y.mean())
+        grad = (p - y).astype(np.float32)
+        hess = np.maximum(p * (1 - p), 1e-6).astype(np.float32)
+        tree, leaf_of_row = grow_tree(
+            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.ones(len(y), dtype=bool), m.max_num_bins,
+            GrowerConfig(num_leaves=15, min_data_in_leaf=5), m)
+        assert tree.num_leaves > 1
+        # leaf updates move scores toward labels
+        delta = tree.value[leaf_of_row]
+        corr = np.corrcoef(delta, y - p)[0, 1]
+        assert corr > 0.5
+
+    def test_leaf_of_row_matches_predict(self):
+        import jax.numpy as jnp
+        X, y = synth_binary(200)
+        m = BinMapper.fit(X, max_bin=32)
+        bins = m.transform(X)
+        grad = (0.5 - y).astype(np.float32)
+        hess = np.full(len(y), 0.25, dtype=np.float32)
+        tree, leaf_of_row = grow_tree(
+            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.ones(len(y), dtype=bool), m.max_num_bins,
+            GrowerConfig(num_leaves=8, min_data_in_leaf=5), m)
+        from mmlspark_tpu.gbdt.tree import predict_tree_binned
+        pred_binned = predict_tree_binned(tree, bins)
+        np.testing.assert_allclose(tree.value[leaf_of_row] * tree.shrinkage,
+                                   pred_binned, atol=1e-9)
+
+    def test_raw_threshold_predict_matches_binned(self):
+        import jax.numpy as jnp
+        from mmlspark_tpu.gbdt.predict import predict_single_tree
+        from mmlspark_tpu.gbdt.tree import predict_tree_binned
+        X, y = synth_binary(300, seed=3)
+        m = BinMapper.fit(X, max_bin=64)
+        bins = m.transform(X)
+        grad = (0.5 - y).astype(np.float32)
+        hess = np.full(len(y), 0.25, dtype=np.float32)
+        tree, _ = grow_tree(
+            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.ones(len(y), dtype=bool), m.max_num_bins,
+            GrowerConfig(num_leaves=16, min_data_in_leaf=5), m)
+        np.testing.assert_allclose(predict_single_tree(tree, X),
+                                   predict_tree_binned(tree, bins), atol=1e-9)
+
+
+class TestBooster:
+    def test_binary_training_fits(self):
+        X, y = synth_binary(600)
+        params = TrainParams(objective="binary", num_iterations=30,
+                             learning_rate=0.2, num_leaves=15, min_data_in_leaf=5)
+        booster = B.train(params, X, y)
+        p = booster.predict_proba(X)[:, 1]
+        acc = np.mean((p > 0.5) == y)
+        assert acc > 0.93, acc
+
+    def test_regression_fits(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(500, 5))
+        y = 3 * X[:, 0] + np.sin(3 * X[:, 1]) + 0.1 * rng.normal(size=500)
+        params = TrainParams(objective="regression", num_iterations=50,
+                             learning_rate=0.15, num_leaves=15, min_data_in_leaf=5)
+        booster = B.train(params, X, y)
+        pred = booster.raw_predict(X)
+        r2 = 1 - np.var(pred - y) / np.var(y)
+        assert r2 > 0.9, r2
+
+    def test_multiclass_fits(self):
+        rng = np.random.default_rng(0)
+        n = 600
+        X = rng.normal(size=(n, 4))
+        y = (np.digitize(X[:, 0] + 0.3 * X[:, 1], [-0.5, 0.5])).astype(np.float64)
+        params = TrainParams(objective="multiclass", num_class=3,
+                             num_iterations=20, learning_rate=0.2,
+                             num_leaves=7, min_data_in_leaf=5)
+        booster = B.train(params, X, y)
+        pred = np.argmax(booster.predict_proba(X), axis=1)
+        assert np.mean(pred == y) > 0.9
+
+    def test_early_stopping(self):
+        X, y = synth_binary(400)
+        Xv, yv = synth_binary(200, seed=9)
+        params = TrainParams(objective="binary", num_iterations=200,
+                             learning_rate=0.3, num_leaves=31,
+                             min_data_in_leaf=2, early_stopping_round=5)
+        booster = B.train(params, X, y, valid=(Xv, yv))
+        assert booster.best_iteration > 0
+        assert len(booster.trees) < 200
+
+    def test_save_load_roundtrip(self):
+        X, y = synth_binary(300)
+        params = TrainParams(objective="binary", num_iterations=10,
+                             num_leaves=7, min_data_in_leaf=5)
+        booster = B.train(params, X, y)
+        restored = Booster.from_string(booster.to_string())
+        np.testing.assert_allclose(restored.raw_predict(X), booster.raw_predict(X),
+                                   atol=1e-12)
+
+    def test_merge(self):
+        X, y = synth_binary(300)
+        params = TrainParams(objective="binary", num_iterations=5,
+                             num_leaves=7, min_data_in_leaf=5)
+        b1 = B.train(params, X, y)
+        b2 = B.train(params, X, y, init_model=b1)
+        assert len(b2.trees) == 10
+        merged = b1.merge(b1)
+        assert len(merged.trees) == 10
+
+    @pytest.mark.parametrize("boosting", ["rf", "dart", "goss"])
+    def test_boosting_variants_run(self, boosting):
+        X, y = synth_binary(300)
+        params = TrainParams(objective="binary", boosting_type=boosting,
+                             num_iterations=8, num_leaves=7, min_data_in_leaf=5,
+                             bagging_fraction=0.8, bagging_freq=1)
+        booster = B.train(params, X, y)
+        p = booster.predict_proba(X)[:, 1]
+        assert np.mean((p > 0.5) == y) > 0.8
+
+    def test_device_ensemble_matches_host(self):
+        X, y = synth_binary(300)
+        params = TrainParams(objective="binary", num_iterations=12,
+                             num_leaves=15, min_data_in_leaf=5)
+        booster = B.train(params, X, y)
+        host = predict_ensemble(booster.trees, X, 1)
+        dev = DeviceEnsemble(booster.trees, 1).predict_raw(X)
+        np.testing.assert_allclose(dev, host, atol=1e-4)
+
+    def test_feature_importance_identifies_signal(self):
+        X, y = synth_binary(500)
+        params = TrainParams(objective="binary", num_iterations=15,
+                             num_leaves=15, min_data_in_leaf=5)
+        booster = B.train(params, X, y)
+        imp = booster.feature_importances("gain")
+        assert imp[0] == imp.max()  # feature 0 dominates the synthetic logit
+
+
+class TestStages:
+    def test_classifier_stage(self):
+        X, y = synth_binary(400)
+        df = feature_df(X, y)
+        clf = LightGBMClassifier(numIterations=20, numLeaves=15, minDataInLeaf=5,
+                                 learningRate=0.2)
+        model = clf.fit(df)
+        out = model.transform(df)
+        pred = out.column("prediction")
+        assert np.mean(pred == y) > 0.9
+        proba = out.column("probability")[0]
+        assert proba.shape == (2,) and abs(proba.sum() - 1) < 1e-6
+
+    def test_classifier_validation_early_stop(self):
+        X, y = synth_binary(500)
+        vmask = np.zeros(500, dtype=bool)
+        vmask[400:] = True
+        df = feature_df(X, y, extra={"isVal": vmask})
+        clf = LightGBMClassifier(numIterations=100, numLeaves=31, minDataInLeaf=2,
+                                 learningRate=0.3, earlyStoppingRound=5,
+                                 validationIndicatorCol="isVal")
+        model = clf.fit(df)
+        assert len(model.booster.trees) < 100
+
+    def test_regressor_stage(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(400, 5))
+        y = 2 * X[:, 0] - X[:, 3] + 0.05 * rng.normal(size=400)
+        df = feature_df(X, y)
+        model = LightGBMRegressor(numIterations=40, numLeaves=15,
+                                  minDataInLeaf=5, learningRate=0.15).fit(df)
+        pred = model.transform(df).column("prediction")
+        r2 = 1 - np.var(pred - y) / np.var(y)
+        assert r2 > 0.85, r2
+
+    def test_ranker_stage(self):
+        rng = np.random.default_rng(0)
+        n, n_groups = 300, 30
+        X = rng.normal(size=(n, 4))
+        groups = np.repeat(np.arange(n_groups), n // n_groups)
+        rel = np.clip(np.round(X[:, 0] + 0.2 * rng.normal(size=n)) + 1, 0, 3)
+        df = feature_df(X, rel, extra={"query": groups})
+        model = LightGBMRanker(numIterations=15, numLeaves=7, minDataInLeaf=3,
+                               groupCol="query").fit(df)
+        scores = model.transform(df).column("prediction")
+        # ranker should score high-relevance rows higher within groups
+        corr = np.corrcoef(scores, rel)[0, 1]
+        assert corr > 0.4, corr
+
+    def test_save_native_model(self, tmp_path):
+        X, y = synth_binary(200)
+        df = feature_df(X, y)
+        model = LightGBMClassifier(numIterations=5, numLeaves=7,
+                                   minDataInLeaf=5).fit(df)
+        p = str(tmp_path / "model.txt")
+        model.save_native_model(p)
+        restored = Booster.from_string(open(p).read())
+        np.testing.assert_allclose(restored.raw_predict(X),
+                                   model.booster.raw_predict(X))
+
+    def test_stage_save_load(self, tmp_path):
+        X, y = synth_binary(200)
+        df = feature_df(X, y)
+        model = LightGBMClassifier(numIterations=5, numLeaves=7,
+                                   minDataInLeaf=5).fit(df)
+        model.save(str(tmp_path / "m"))
+        from mmlspark_tpu.core.pipeline import PipelineStage
+        loaded = PipelineStage.load(str(tmp_path / "m"))
+        np.testing.assert_allclose(
+            np.asarray(loaded.transform(df).column("prediction"), dtype=float),
+            np.asarray(model.transform(df).column("prediction"), dtype=float))
+
+    def test_num_batches_incremental(self):
+        X, y = synth_binary(400)
+        df = feature_df(X, y)
+        model = LightGBMClassifier(numIterations=5, numLeaves=7, minDataInLeaf=5,
+                                   numBatches=2).fit(df)
+        assert len(model.booster.trees) == 10  # 5 per batch, merged
+
+
+class TestDistributed:
+    """Data-parallel GBDT over the 8-device CPU mesh (socket-ring allreduce parity)."""
+
+    def test_sharded_training_matches_single_device(self, mesh8):
+        X, y = synth_binary(400)
+        params = TrainParams(objective="binary", num_iterations=15,
+                             learning_rate=0.2, num_leaves=15, min_data_in_leaf=5)
+        b_single = B.train(params, X, y)
+        b_mesh = B.train(params, X, y, mesh=mesh8)
+        p1 = b_single.predict_proba(X)[:, 1]
+        p2 = b_mesh.predict_proba(X)[:, 1]
+        acc1 = np.mean((p1 > 0.5) == y)
+        acc2 = np.mean((p2 > 0.5) == y)
+        assert acc2 > 0.92, acc2
+        assert abs(acc1 - acc2) < 0.03
+        # histograms are psum'd exactly -> identical split structure
+        assert len(b_single.trees) == len(b_mesh.trees)
+
+    def test_sharded_training_with_padding(self, mesh8):
+        # 403 rows: not divisible by 8 -> pad path
+        X, y = synth_binary(403)
+        params = TrainParams(objective="binary", num_iterations=8,
+                             num_leaves=7, min_data_in_leaf=5)
+        booster = B.train(params, X, y, mesh=mesh8)
+        p = booster.predict_proba(X)[:, 1]
+        assert np.mean((p > 0.5) == y) > 0.88
+
+    def test_stage_uses_default_mesh(self, mesh8):
+        from mmlspark_tpu.parallel.mesh import MeshContext
+        MeshContext.set(mesh8)
+        try:
+            X, y = synth_binary(300)
+            df = feature_df(X, y)
+            model = LightGBMClassifier(numIterations=8, numLeaves=7,
+                                       minDataInLeaf=5).fit(df)
+            pred = model.transform(df).column("prediction")
+            assert np.mean(pred == y) > 0.85
+        finally:
+            MeshContext.reset()
+
+
+class TestReviewRegressions:
+    def test_categorical_feature_end_to_end(self):
+        rng = np.random.default_rng(0)
+        n = 400
+        cat = rng.integers(0, 6, size=n).astype(np.float64)
+        noise = rng.normal(size=(n, 2))
+        X = np.column_stack([cat, noise])
+        y = np.where(np.isin(cat, [1, 3, 5]), 2.0, -1.0)  # value-dependent target
+        params = TrainParams(objective="regression", num_iterations=30,
+                             learning_rate=0.3, num_leaves=15, min_data_in_leaf=5,
+                             categorical_feature=(0,))
+        booster = B.train(params, X, y)
+        mse = np.mean((booster.raw_predict(X) - y) ** 2)
+        assert mse < 0.05, mse  # was ~0.3 (predicting the mean) before the fix
+
+    def test_ranker_with_validation_indicator(self):
+        rng = np.random.default_rng(0)
+        n, n_groups = 200, 20
+        X = rng.normal(size=(n, 4))
+        groups = np.repeat(np.arange(n_groups), n // n_groups)
+        rel = np.clip(np.round(X[:, 0]) + 1, 0, 3)
+        vmask = groups >= 15
+        df = feature_df(X, rel, extra={"query": groups, "isVal": vmask})
+        model = LightGBMRanker(numIterations=10, numLeaves=7, minDataInLeaf=3,
+                               groupCol="query", earlyStoppingRound=3,
+                               validationIndicatorCol="isVal").fit(df)
+        assert model.booster.num_total_model > 0  # no IndexError crash
+
+    def test_init_score_col(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 4))
+        y = 2 * X[:, 0] + 100.0  # large offset carried by init score
+        init = np.full(300, 100.0)
+        df = feature_df(X, y, extra={"init": init})
+        model = LightGBMRegressor(numIterations=20, numLeaves=7, minDataInLeaf=5,
+                                  learningRate=0.3, initScoreCol="init").fit(df)
+        # model itself learns only the residual; add init back externally
+        pred = model.transform(df).column("prediction") + init
+        assert np.mean((pred - y) ** 2) < 1.0
+
+    def test_continued_training_smaller_max_bin(self):
+        X, y = synth_binary(300)
+        p1 = TrainParams(objective="binary", num_iterations=5, num_leaves=7,
+                         min_data_in_leaf=5, max_bin=255)
+        b1 = B.train(p1, X, y)
+        p2 = TrainParams(objective="binary", num_iterations=5, num_leaves=7,
+                         min_data_in_leaf=5, max_bin=16)  # inherits b1's mapper
+        b2 = B.train(p2, X, y, init_model=b1)
+        p = b2.predict_proba(X)[:, 1]
+        assert np.mean((p > 0.5) == y) > 0.9  # histograms not corrupted

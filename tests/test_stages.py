@@ -1,0 +1,212 @@
+"""Tests for the utility stages library (reference stages/ package parity)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.stages import (
+    Cacher,
+    ClassBalancer,
+    DropColumns,
+    DynamicMiniBatchTransformer,
+    EnsembleByKey,
+    Explode,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    Lambda,
+    MultiColumnAdapter,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    SummarizeData,
+    TextPreprocessor,
+    Timer,
+    UDFTransformer,
+    UnicodeNormalize,
+    get_value_at,
+    to_vector,
+)
+
+
+def basic_df(n=10, parts=2):
+    return DataFrame.from_dict({
+        "numbers": np.arange(n, dtype=np.float64),
+        "words": [f"w{i % 3}" for i in range(n)],
+    }, num_partitions=parts)
+
+
+def double_numbers(df):
+    return df.with_column("numbers", lambda p: p["numbers"] * 2)
+
+
+class TestBasicStages:
+    def test_lambda(self):
+        out = Lambda(double_numbers).transform(basic_df())
+        assert out.column("numbers")[1] == 2.0
+
+    def test_lambda_save_load(self, tmp_path):
+        stage = Lambda(double_numbers)
+        stage.save(str(tmp_path / "s"))
+        from mmlspark_tpu.core.pipeline import PipelineStage
+        loaded = PipelineStage.load(str(tmp_path / "s"))
+        assert loaded.transform(basic_df()).column("numbers")[1] == 2.0
+
+    def test_udf_transformer_row(self):
+        t = UDFTransformer(inputCol="numbers", outputCol="sq")
+        t.set("udf", lambda v: v * v)
+        out = t.transform(basic_df())
+        assert out.column("sq")[3] == 9.0
+
+    def test_udf_transformer_vectorized(self):
+        t = UDFTransformer(inputCol="numbers", outputCol="sq")
+        t.set("vectorizedUdf", lambda col: col ** 2)
+        assert t.transform(basic_df()).column("sq")[4] == 16.0
+
+    def test_udf_transformer_multi_input(self):
+        t = UDFTransformer(outputCol="cat")
+        t.set("inputCols", ["numbers", "words"])
+        t.set("udf", lambda a, b: f"{b}:{a}")
+        assert t.transform(basic_df()).column("cat")[0] == "w0:0.0"
+
+    def test_multi_column_adapter(self):
+        base = UDFTransformer()
+        base.set("udf", lambda v: v + 1)
+        t = MultiColumnAdapter()
+        t.set("baseStage", base)
+        t.set("inputCols", ["numbers"])
+        t.set("outputCols", ["plus1"])
+        assert t.transform(basic_df()).column("plus1")[0] == 1.0
+
+    def test_explode(self):
+        df = DataFrame.from_dict({"id": [1, 2], "vals": [[10, 20], [30]]})
+        out = Explode(inputCol="vals").transform(df)
+        assert out.count() == 3
+        assert list(out.column("id")) == [1, 1, 2]
+        assert list(out.column("vals")) == [10, 20, 30]
+
+    def test_select_drop_rename(self):
+        df = basic_df()
+        assert SelectColumns(cols=["numbers"]).transform(df).columns == ["numbers"]
+        assert DropColumns(cols=["words"]).transform(df).columns == ["numbers"]
+        out = RenameColumn(inputCol="numbers", outputCol="nums").transform(df)
+        assert "nums" in out.columns and "numbers" not in out.columns
+
+    def test_repartition(self):
+        out = Repartition(n=5).transform(basic_df(10, 2))
+        assert out.num_partitions == 5
+        assert out.count() == 10
+
+    def test_cacher_passthrough(self):
+        df = basic_df()
+        assert Cacher().transform(df).count() == df.count()
+
+    def test_stratified_repartition(self):
+        n = 40
+        df = DataFrame.from_dict({
+            "label": [i % 4 for i in range(n)],
+            "x": np.arange(n, dtype=np.float64),
+        }, num_partitions=4)
+        out = StratifiedRepartition(labelCol="label").transform(df)
+        assert out.count() == n
+        for p in out.partitions:
+            assert len(set(p["label"].tolist())) == 4  # every class in every partition
+
+    def test_class_balancer(self):
+        df = DataFrame.from_dict({"label": ["a"] * 6 + ["b"] * 2})
+        model = ClassBalancer(inputCol="label").fit(df)
+        w = model.transform(df).column("weight")
+        assert w[0] == 1.0 and w[-1] == 3.0
+
+    def test_ensemble_by_key_collapse(self):
+        df = DataFrame.from_dict({
+            "key": ["a", "a", "b"],
+            "score": [np.array([1.0, 2.0]), np.array([3.0, 4.0]), np.array([5.0, 6.0])],
+        })
+        t = EnsembleByKey(keys=["key"], cols=["score"], newCols=["avg"])
+        out = t.transform(df)
+        assert out.count() == 2
+        rows = {r["key"]: r["avg"] for r in out.rows()}
+        np.testing.assert_allclose(rows["a"], [2.0, 3.0])
+
+    def test_ensemble_by_key_broadcast(self):
+        df = DataFrame.from_dict({"key": ["a", "a", "b"], "score": [1.0, 3.0, 5.0]})
+        t = EnsembleByKey(keys=["key"], cols=["score"], newCols=["avg"],
+                          collapseGroup=False)
+        out = t.transform(df)
+        assert out.count() == 3
+        assert list(out.column("avg")) == [2.0, 2.0, 5.0]
+
+    def test_timer(self):
+        inner = UDFTransformer(inputCol="numbers", outputCol="sq")
+        inner.set("udf", lambda v: v * v)
+        timer = Timer()
+        timer.set("stage", inner)
+        model = timer.fit(basic_df())
+        assert model.transform(basic_df()).column("sq")[2] == 4.0
+
+    def test_summarize_data(self):
+        out = SummarizeData().transform(basic_df())
+        rows = {r["Feature"]: r for r in out.rows()}
+        assert rows["numbers"]["Count"] == 10.0
+        assert rows["numbers"]["Mean"] == 4.5
+        assert rows["numbers"]["Quantile_0.5"] == pytest.approx(4.5, abs=0.5)
+
+
+class TestMiniBatch:
+    def test_fixed_roundtrip(self):
+        df = basic_df(10, 2)
+        batched = FixedMiniBatchTransformer(batchSize=3).transform(df)
+        # 5 rows per partition -> batches of 3+2 per partition
+        assert batched.count() == 4
+        flat = FlattenBatch().transform(batched)
+        assert flat.count() == 10
+        assert list(flat.column("numbers")) == list(range(10))
+
+    def test_dynamic(self):
+        df = basic_df(8, 2)
+        batched = DynamicMiniBatchTransformer().transform(df)
+        assert batched.count() == 2  # one batch per partition
+        assert len(batched.column("numbers")[0]) == 4
+
+    def test_flatten_replicates_scalars(self):
+        df = DataFrame.from_dict({"batch": [[1, 2, 3]], "tag": ["t"]})
+        out = FlattenBatch().transform(df)
+        assert list(out.column("tag")) == ["t", "t", "t"]
+
+
+class TestText:
+    def test_text_preprocessor(self):
+        df = DataFrame.from_dict({"text": ["Hello World", "hello there"]})
+        t = TextPreprocessor(inputCol="text", outputCol="out", normFunc="lowerCase")
+        t.set("map", {"hello": "hi", "world": "earth"})
+        out = t.transform(df).column("out")
+        assert out[0] == "hi earth"
+        assert out[1] == "hi there"
+
+    def test_text_preprocessor_longest_match(self):
+        df = DataFrame.from_dict({"text": ["abcd"]})
+        t = TextPreprocessor(inputCol="text", outputCol="out")
+        t.set("map", {"ab": "X", "abc": "Y"})
+        assert t.transform(df).column("out")[0] == "Yd"
+
+    def test_unicode_normalize(self):
+        df = DataFrame.from_dict({"text": ["Café", "ＡＢＣ"]})
+        out = UnicodeNormalize(inputCol="text", outputCol="out",
+                               form="NFKC").transform(df)
+        assert out.column("out")[1] == "abc"
+
+
+class TestUdfs:
+    def test_get_value_at(self):
+        col = np.empty(2, dtype=object)
+        col[0] = np.array([1.0, 2.0, 3.0])
+        col[1] = np.array([4.0, 5.0, 6.0])
+        assert list(get_value_at(col, 1)) == [2.0, 5.0]
+
+    def test_to_vector(self):
+        col = np.empty(2, dtype=object)
+        col[0] = [1, 2]
+        col[1] = None
+        out = to_vector(col)
+        assert out[0].dtype == np.float64 and out[1] is None
