@@ -1,0 +1,28 @@
+"""Online linear learning, TPU-native (Vowpal Wabbit parity).
+
+The reference wraps VW's C++ core through JNI (vw/VowpalWabbitBase.scala): per-row
+JNI example construction + learn() calls, AllReduce spanning tree for distributed
+sync. Here:
+
+  - feature hashing (murmur3) + namespace sparse features   -> featurizer.py
+  - per-example adaptive SGD / FTRL as a jitted lax.scan     -> learner.py
+  - pipeline stages with VW-args parsing + training stats    -> stages.py
+  - distributed: per-shard scan + cross-shard weight average
+    via psum (replaces the --span_server spanning tree)      -> learner.py
+"""
+
+from .featurizer import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+from .learner import LearnerConfig, SparseDataset, train_linear
+from .stages import (
+    VowpalWabbitClassificationModel,
+    VowpalWabbitClassifier,
+    VowpalWabbitRegressionModel,
+    VowpalWabbitRegressor,
+)
+
+__all__ = [
+    "LearnerConfig", "SparseDataset", "VowpalWabbitClassificationModel",
+    "VowpalWabbitClassifier", "VowpalWabbitFeaturizer",
+    "VowpalWabbitInteractions", "VowpalWabbitRegressionModel",
+    "VowpalWabbitRegressor", "train_linear",
+]

@@ -1,0 +1,303 @@
+"""Jitted online linear learner: per-example adaptive SGD / FTRL over hashed features.
+
+Replaces VW's C++ learn loop (driven per-row through JNI at
+vw/VowpalWabbitBase.scala:239-258) with a ``lax.scan`` over examples inside one
+XLA program: each step gathers the example's weights, computes the loss gradient,
+and scatter-updates — the whole pass is one device launch instead of N JNI calls.
+
+Distributed (VW AllReduce spanning-tree parity, VowpalWabbitBase.scala:314-342):
+each mesh shard scans its rows independently, then weights are averaged with
+``psum`` under ``shard_map`` after every pass — exactly VW's between-pass model
+averaging, over ICI instead of driver-rooted TCP.
+
+Sparse rows are padded to a fixed nnz per row (index 0 + value 0 padding is a
+no-op because gradient contributions scale by the value).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class LearnerConfig:
+    num_bits: int = 18
+    learning_rate: float = 0.5
+    power_t: float = 0.5           # lr decay exponent (VW --power_t)
+    initial_t: float = 0.0
+    l1: float = 0.0
+    l2: float = 0.0
+    loss_function: str = "squared"  # squared | logistic | hinge | quantile
+    quantile_tau: float = 0.5
+    adaptive: bool = True           # AdaGrad per-weight scaling (VW default)
+    num_passes: int = 1
+    ftrl: bool = False
+    ftrl_alpha: float = 0.005
+    ftrl_beta: float = 0.1
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class SparseDataset:
+    """Padded sparse matrix: [N, max_nnz] indices/values (+label/weight)."""
+
+    indices: np.ndarray   # int32 [N, K]
+    values: np.ndarray    # float32 [N, K]
+    labels: np.ndarray    # float32 [N]
+    weights: np.ndarray   # float32 [N]
+
+    @staticmethod
+    def from_rows(rows, labels, weights=None, num_bits: int = 18) -> "SparseDataset":
+        mask = (1 << num_bits) - 1
+        n = len(rows)
+        nnz = [0 if r is None else len(r["indices"]) for r in rows]
+        k = max(max(nnz, default=1), 1)
+        idx = np.zeros((n, k), dtype=np.int32)
+        val = np.zeros((n, k), dtype=np.float32)
+        for i, r in enumerate(rows):
+            if r is None or len(r["indices"]) == 0:
+                continue
+            m = len(r["indices"])
+            idx[i, :m] = (np.asarray(r["indices"], dtype=np.int64) & mask)
+            val[i, :m] = r["values"]
+        return SparseDataset(
+            idx, val,
+            np.asarray(labels, dtype=np.float32),
+            np.asarray(weights if weights is not None else np.ones(n),
+                       dtype=np.float32))
+
+
+def _loss_grad(loss: str, pred, label, tau: float):
+    """dLoss/dPred for the supported VW loss functions."""
+    import jax.numpy as jnp
+
+    if loss == "squared":
+        return pred - label
+    if loss == "logistic":
+        # labels in {-1, +1} (VW convention)
+        return -label / (1.0 + jnp.exp(label * pred))
+    if loss == "hinge":
+        return jnp.where(label * pred < 1.0, -label, 0.0)
+    if loss == "quantile":
+        return jnp.where(pred > label, 1.0 - tau, -tau)
+    raise ValueError(f"Unknown loss {loss!r}")
+
+
+def make_scan_pass(config: LearnerConfig):
+    """Build the jitted single-pass scan: (state, dataset) -> (state, example_losses).
+
+    State: (w, g2, t) for adaptive SGD, or (z, n_acc) for FTRL.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    loss = config.loss_function
+    tau = config.quantile_tau
+    lr = config.learning_rate
+    power_t = config.power_t
+    l2 = config.l2
+    l1 = config.l1
+
+    if config.ftrl:
+        def step(state, ex):
+            z, n_acc = state
+            idx, val, label, wgt = ex
+            # FTRL-proximal weight reconstruction for active coords
+            zi = z[idx]
+            ni = n_acc[idx]
+            sign = jnp.sign(zi)
+            wi = jnp.where(
+                jnp.abs(zi) <= l1, 0.0,
+                -(zi - sign * l1) / ((config.ftrl_beta + jnp.sqrt(ni))
+                                     / config.ftrl_alpha + l2))
+            pred = jnp.sum(wi * val)
+            g = _loss_grad(loss, pred, label, tau) * wgt
+            gi = g * val
+            sigma = (jnp.sqrt(ni + gi * gi) - jnp.sqrt(ni)) / config.ftrl_alpha
+            z = z.at[idx].add(gi - sigma * wi)
+            n_acc = n_acc.at[idx].add(gi * gi)
+            return (z, n_acc), _example_loss(loss, pred, label, tau)
+
+        def run_pass(state, ds):
+            return jax.lax.scan(step, state,
+                                (ds["indices"], ds["values"], ds["labels"],
+                                 ds["weights"]))
+    else:
+        def step(state, ex):
+            w, g2, t = state
+            idx, val, label, wgt = ex
+            wi = w[idx]
+            pred = jnp.sum(wi * val)
+            g = _loss_grad(loss, pred, label, tau) * wgt
+            gi = g * val + l2 * wi
+            t = t + 1.0
+            eta = lr / jnp.power(t + config.initial_t, power_t)
+            if config.adaptive:
+                g2 = g2.at[idx].add(gi * gi)
+                scale = jnp.sqrt(g2[idx]) + 1e-8
+                w = w.at[idx].add(-lr * gi / scale)
+            else:
+                w = w.at[idx].add(-eta * gi)
+            return (w, g2, t), _example_loss(loss, pred, label, tau)
+
+        def run_pass(state, ds):
+            return jax.lax.scan(step, state,
+                                (ds["indices"], ds["values"], ds["labels"],
+                                 ds["weights"]))
+
+    return jax.jit(run_pass)
+
+
+def _example_loss(loss: str, pred, label, tau: float):
+    import jax.numpy as jnp
+
+    if loss == "squared":
+        return 0.5 * (pred - label) ** 2
+    if loss == "logistic":
+        return jnp.log1p(jnp.exp(-label * pred))
+    if loss == "hinge":
+        return jnp.maximum(0.0, 1.0 - label * pred)
+    if loss == "quantile":
+        d = pred - label
+        return jnp.where(d > 0, (1 - tau) * d, -tau * d)
+    raise ValueError(loss)
+
+
+@dataclasses.dataclass
+class TrainingStats:
+    """Per-worker diagnostics (VowpalWabbitBase TrainingStats parity,
+    vw/VowpalWabbitBase.scala:29-48)."""
+
+    partition_id: int
+    num_examples: int
+    total_time_ns: int
+    learn_time_ns: int
+    average_loss: float
+    weighted_example_sum: float
+
+
+def _ftrl_weights(config: LearnerConfig, z, n_acc):
+    """Reconstruct dense weights from FTRL-proximal (z, n) state."""
+    import jax.numpy as jnp
+
+    sign = jnp.sign(z)
+    return jnp.where(
+        jnp.abs(z) <= config.l1, 0.0,
+        -(z - sign * config.l1) / ((config.ftrl_beta + jnp.sqrt(n_acc))
+                                   / config.ftrl_alpha + config.l2))
+
+
+def train_linear(config: LearnerConfig, dataset: SparseDataset,
+                 initial_weights: Optional[np.ndarray] = None,
+                 mesh=None) -> Tuple[np.ndarray, List[TrainingStats]]:
+    """Run ``num_passes`` scan passes; with a mesh, shards scan independently and
+    state is psum-averaged between passes (AllReduce spanning-tree parity).
+
+    Optimizer state (AdaGrad accumulators / FTRL z,n) carries across passes.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    dim = 1 << config.num_bits
+    w0 = (jnp.asarray(initial_weights, dtype=jnp.float32)
+          if initial_weights is not None else jnp.zeros(dim, dtype=jnp.float32))
+    if config.ftrl:
+        state = (w0 * 0.0, jnp.zeros(dim, dtype=jnp.float32))  # (z, n)
+    else:
+        state = (w0, jnp.zeros(dim, dtype=jnp.float32), jnp.float32(0.0))
+
+    run_pass = make_scan_pass(config)
+    stats: List[TrainingStats] = []
+
+    n = len(dataset.labels)
+    n_shards = 1
+    if mesh is not None:
+        from ..parallel.mesh import DATA_AXIS
+
+        n_shards = int(mesh.shape.get(DATA_AXIS, 1))
+
+    if n_shards > 1:
+        from jax.sharding import PartitionSpec as P
+
+        shard_map = jax.shard_map
+
+        pad = (-n) % n_shards
+
+        def padded(a, fill=0):
+            if not pad:
+                return a
+            cfg = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, cfg, constant_values=fill)
+
+        ds = {
+            "indices": padded(dataset.indices),
+            "values": padded(dataset.values),       # value 0 => no-op example
+            "labels": padded(dataset.labels),
+            "weights": padded(dataset.weights, 0),  # weight 0 => no grad
+        }
+
+        def shard_pass(state, indices, values, labels, weights):
+            local = {"indices": indices, "values": values,
+                     "labels": labels, "weights": weights}
+            # carry starts replicated but the scan makes it shard-varying:
+            # mark it varying up front (jax vma typing for scan-in-shard_map)
+            state = jax.tree.map(
+                lambda s: jax.lax.pcast(s, (DATA_AXIS,), to="varying"), state)
+            state, losses = run_pass(state, local)
+            # between-pass model averaging over the data axis (VW sync point);
+            # pmean also restores the replicated (invariant) type for out_specs P()
+            state = jax.tree.map(
+                lambda s: jax.lax.pmean(s, axis_name=DATA_AXIS), state)
+            return state, jax.lax.psum(jnp.sum(losses), axis_name=DATA_AXIS)
+
+        state_spec = jax.tree.map(lambda _: P(), state)
+        sharded = jax.jit(shard_map(
+            shard_pass, mesh=mesh,
+            in_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=(state_spec, P())))
+
+        for _ in range(config.num_passes):
+            t0 = time.perf_counter_ns()
+            state, loss_sum = sharded(state, ds["indices"], ds["values"],
+                                      ds["labels"], ds["weights"])
+            dt = time.perf_counter_ns() - t0
+            stats.append(TrainingStats(0, n, dt, dt,
+                                       float(loss_sum) / max(n, 1),
+                                       float(dataset.weights.sum())))
+    else:
+        ds = {"indices": jnp.asarray(dataset.indices),
+              "values": jnp.asarray(dataset.values),
+              "labels": jnp.asarray(dataset.labels),
+              "weights": jnp.asarray(dataset.weights)}
+        for _ in range(config.num_passes):
+            t0 = time.perf_counter_ns()
+            state, losses = run_pass(state, ds)
+            dt = time.perf_counter_ns() - t0
+            stats.append(TrainingStats(0, n, dt, dt,
+                                       float(jnp.mean(losses)),
+                                       float(dataset.weights.sum())))
+
+    if config.ftrl:
+        w = _ftrl_weights(config, state[0], state[1])
+    else:
+        w = state[0]
+    return np.asarray(w), stats
+
+
+def predict_linear(w: np.ndarray, dataset: SparseDataset) -> np.ndarray:
+    """Batched sparse dot product (jitted)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fwd(w, idx, val):
+        return jnp.sum(w[idx] * val, axis=1)
+
+    return np.asarray(fwd(jnp.asarray(w), jnp.asarray(dataset.indices),
+                          jnp.asarray(dataset.values)))

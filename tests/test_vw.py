@@ -1,0 +1,257 @@
+"""VW-parity engine tests: hashing, featurizer, learner, stages."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.ops.hashing import MurmurWithPrefix, hash_string, murmur3_32
+from mmlspark_tpu.vw import (
+    LearnerConfig,
+    SparseDataset,
+    VowpalWabbitClassifier,
+    VowpalWabbitFeaturizer,
+    VowpalWabbitInteractions,
+    VowpalWabbitRegressor,
+    train_linear,
+)
+from mmlspark_tpu.vw.learner import predict_linear
+from mmlspark_tpu.vw.stages import parse_vw_args
+
+
+class TestMurmur:
+    def test_known_vectors(self):
+        assert murmur3_32(b"", 0) == 0
+        assert murmur3_32(b"", 1) == 0x514E28B7
+        assert murmur3_32(b"hello", 0) == 0x248BFA47
+        assert murmur3_32(b"abc", 0) == 0xB3DD93FA
+
+    def test_prefix_hashing(self):
+        m = MurmurWithPrefix("col=")
+        assert m.hash("value") == hash_string("col=value")
+
+
+class TestFeaturizer:
+    def test_numeric_and_string(self):
+        df = DataFrame.from_dict({
+            "age": [25.0, 0.0, 31.0],
+            "city": ["nyc", "sf", None],
+        })
+        out = VowpalWabbitFeaturizer(inputCols=["age", "city"]).transform(df)
+        f0 = out.column("features")[0]
+        assert len(f0["indices"]) == 2   # age + city=nyc
+        f1 = out.column("features")[1]
+        assert len(f1["indices"]) == 1   # zero numeric dropped, city=sf kept
+        f2 = out.column("features")[2]
+        assert len(f2["indices"]) == 1   # None string dropped, age kept
+
+    def test_same_string_same_index(self):
+        df = DataFrame.from_dict({"city": ["nyc", "nyc"]})
+        out = VowpalWabbitFeaturizer(inputCols=["city"]).transform(df)
+        c = out.column("features")
+        assert c[0]["indices"][0] == c[1]["indices"][0]
+
+    def test_map_and_vector(self):
+        df = DataFrame.from_dict({
+            "m": [{"a": 1.0, "b": 2.0}],
+            "v": [np.array([0.0, 3.0, 0.0, 4.0])],
+        })
+        out = VowpalWabbitFeaturizer(inputCols=["m", "v"], numBits=24).transform(df)
+        f = out.column("features")[0]
+        assert len(f["indices"]) == 4
+        assert set(np.round(f["values"]).astype(int)) == {1, 2, 3, 4}
+
+    def test_string_split(self):
+        df = DataFrame.from_dict({"text": ["hello world hello"]})
+        out = VowpalWabbitFeaturizer(inputCols=["text"], stringSplit=True,
+                                     sumCollisions=True).transform(df)
+        f = out.column("features")[0]
+        assert len(f["indices"]) == 2
+        assert sorted(f["values"]) == [1.0, 2.0]  # repeated word summed
+
+    def test_interactions(self):
+        df = DataFrame.from_dict({"a": ["x"], "b": ["y"]})
+        fa = VowpalWabbitFeaturizer(inputCols=["a"], outputCol="fa").transform(df)
+        fb = VowpalWabbitFeaturizer(inputCols=["b"], outputCol="fb").transform(fa)
+        out = VowpalWabbitInteractions(inputCols=["fa", "fb"],
+                                       outputCol="fi").transform(fb)
+        f = out.column("fi")[0]
+        assert len(f["indices"]) == 1 and f["values"][0] == 1.0
+
+
+def synth_sparse(n=400, d=50, seed=0, num_bits=12):
+    rng = np.random.default_rng(seed)
+    true_w = rng.normal(size=d)
+    rows = []
+    raws = np.zeros(n)
+    for i in range(n):
+        nnz = rng.integers(3, 10)
+        idx = rng.choice(d, size=nnz, replace=False)
+        val = rng.normal(size=nnz).astype(np.float32)
+        rows.append({"indices": idx.astype(np.int64), "values": val})
+        raws[i] = (true_w[idx] * val).sum()
+    return rows, raws
+
+
+class TestLearner:
+    def test_squared_regression_converges(self):
+        rows, raws = synth_sparse()
+        cfg = LearnerConfig(num_bits=12, learning_rate=0.5, num_passes=10)
+        ds = SparseDataset.from_rows(rows, raws, num_bits=12)
+        w, stats = train_linear(cfg, ds)
+        pred = predict_linear(w, ds)
+        r2 = 1 - np.var(pred - raws) / np.var(raws)
+        assert r2 > 0.95, r2
+        assert stats[-1].average_loss < stats[0].average_loss
+
+    def test_logistic_classification(self):
+        rows, raws = synth_sparse(600)
+        y = np.where(raws > 0, 1.0, -1.0)
+        cfg = LearnerConfig(num_bits=12, loss_function="logistic",
+                            learning_rate=0.5, num_passes=10)
+        ds = SparseDataset.from_rows(rows, y, num_bits=12)
+        w, _ = train_linear(cfg, ds)
+        pred = predict_linear(w, ds)
+        assert np.mean((pred > 0) == (y > 0)) > 0.9
+
+    def test_ftrl(self):
+        rows, raws = synth_sparse(500)
+        y = np.where(raws > 0, 1.0, -1.0)
+        cfg = LearnerConfig(num_bits=12, loss_function="logistic", ftrl=True,
+                            ftrl_alpha=0.1, num_passes=5)
+        ds = SparseDataset.from_rows(rows, y, num_bits=12)
+        w, _ = train_linear(cfg, ds)
+        pred = predict_linear(w, ds)
+        assert np.mean((pred > 0) == (y > 0)) > 0.85
+
+    def test_ftrl_l1_sparsifies(self):
+        rows, raws = synth_sparse(300)
+        cfg = LearnerConfig(num_bits=12, ftrl=True, l1=100.0, num_passes=3)
+        ds = SparseDataset.from_rows(rows, raws, num_bits=12)
+        w, _ = train_linear(cfg, ds)
+        cfg0 = LearnerConfig(num_bits=12, ftrl=True, l1=0.0, num_passes=3)
+        w0, _ = train_linear(cfg0, ds)
+        assert (w != 0).sum() < (w0 != 0).sum()
+
+    def test_distributed_matches_single(self, mesh8):
+        rows, raws = synth_sparse(400)
+        y = np.where(raws > 0, 1.0, -1.0)
+        cfg = LearnerConfig(num_bits=12, loss_function="logistic",
+                            learning_rate=0.5, num_passes=8)
+        ds = SparseDataset.from_rows(rows, y, num_bits=12)
+        w_single, _ = train_linear(cfg, ds)
+        w_mesh, _ = train_linear(cfg, ds, mesh=mesh8)
+        acc_s = np.mean((predict_linear(w_single, ds) > 0) == (y > 0))
+        acc_m = np.mean((predict_linear(w_mesh, ds) > 0) == (y > 0))
+        assert acc_m > 0.85, acc_m
+        assert abs(acc_s - acc_m) < 0.08
+
+    def test_quantile_loss(self):
+        rng = np.random.default_rng(0)
+        rows = [{"indices": np.array([0]), "values": np.array([1.0], dtype=np.float32)}
+                for _ in range(2000)]
+        y = rng.exponential(scale=2.0, size=2000)
+        cfg = LearnerConfig(num_bits=4, loss_function="quantile", quantile_tau=0.9,
+                            learning_rate=0.3, num_passes=30)
+        ds = SparseDataset.from_rows(rows, y, num_bits=4)
+        w, _ = train_linear(cfg, ds)
+        q90 = np.quantile(y, 0.9)
+        assert abs(w[0] - q90) < 0.6, (w[0], q90)
+
+
+class TestArgsParsing:
+    def test_parse(self):
+        cfg = parse_vw_args("--loss_function logistic -l 0.3 -b 22 --passes 4 "
+                            "--l1 0.01 --ftrl --ftrl_alpha 0.2")
+        assert cfg.loss_function == "logistic"
+        assert cfg.learning_rate == 0.3
+        assert cfg.num_bits == 22
+        assert cfg.num_passes == 4
+        assert cfg.l1 == 0.01
+        assert cfg.ftrl and cfg.ftrl_alpha == 0.2
+
+    def test_unknown_args_ignored(self):
+        cfg = parse_vw_args("--quiet --some_future_flag -l 0.1")
+        assert cfg.learning_rate == 0.1
+
+
+class TestStages:
+    def make_df(self, n=400, seed=0):
+        rng = np.random.default_rng(seed)
+        age = rng.uniform(20, 60, n)
+        income = rng.normal(50, 10, n)
+        city = rng.choice(["nyc", "sf", "la"], n)
+        logit = 0.1 * (age - 40) + 0.05 * (income - 50) + np.where(city == "sf", 1.5, 0)
+        y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+        return DataFrame.from_dict(
+            {"age": age, "income": income, "city": list(city), "label": y},
+            num_partitions=2)
+
+    def test_classifier_pipeline(self):
+        df = self.make_df()
+        feat = VowpalWabbitFeaturizer(inputCols=["age", "income", "city"],
+                                      outputCol="features", numBits=18)
+        fdf = feat.transform(df)
+        clf = VowpalWabbitClassifier(featuresCol="features", labelCol="label",
+                                     numPasses=10, numBits=18)
+        model = clf.fit(fdf)
+        out = model.transform(fdf)
+        acc = np.mean(out.column("prediction") == fdf.column("label"))
+        assert acc > 0.8, acc
+        proba = out.column("probability")
+        assert ((proba >= 0) & (proba <= 1)).all()
+
+    def test_regressor_dense_vectors(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 10))
+        tw = rng.normal(size=10)
+        y = X @ tw + 0.01 * rng.normal(size=300)
+        df = DataFrame.from_dict({"features": [X[i] for i in range(300)], "label": y})
+        model = VowpalWabbitRegressor(featuresCol="features", labelCol="label",
+                                      numPasses=15, numBits=10).fit(df)
+        pred = model.transform(df).column("prediction")
+        r2 = 1 - np.var(pred - y) / np.var(y)
+        assert r2 > 0.95, r2
+
+    def test_performance_statistics(self):
+        df = self.make_df(100)
+        feat = VowpalWabbitFeaturizer(inputCols=["age", "city"], outputCol="features")
+        model = VowpalWabbitClassifier(featuresCol="features", labelCol="label",
+                                       numPasses=2).fit(feat.transform(df))
+        stats = model.get_performance_statistics()
+        assert stats.count() == 2  # one row per pass
+        assert "averageLoss" in stats.columns
+
+    def test_pass_through_args(self):
+        df = self.make_df(200)
+        feat = VowpalWabbitFeaturizer(inputCols=["age", "city"], outputCol="features")
+        clf = VowpalWabbitClassifier(featuresCol="features", labelCol="label",
+                                     passThroughArgs="--passes 3 -l 0.8 --ftrl")
+        model = clf.fit(feat.transform(df))
+        assert len(model._stats) == 3
+
+    def test_initial_model_warm_start(self):
+        df = self.make_df(300)
+        feat = VowpalWabbitFeaturizer(inputCols=["age", "income", "city"],
+                                      outputCol="features")
+        fdf = feat.transform(df)
+        m1 = VowpalWabbitClassifier(featuresCol="features", labelCol="label",
+                                    numPasses=3).fit(fdf)
+        clf2 = VowpalWabbitClassifier(featuresCol="features", labelCol="label",
+                                      numPasses=1)
+        clf2.set("initialModel", m1.get("weights"))
+        m2 = clf2.fit(fdf)
+        acc = np.mean(m2.transform(fdf).column("prediction") == fdf.column("label"))
+        assert acc > 0.75
+
+    def test_model_save_load(self, tmp_path):
+        df = self.make_df(200)
+        feat = VowpalWabbitFeaturizer(inputCols=["age", "city"], outputCol="features")
+        fdf = feat.transform(df)
+        model = VowpalWabbitClassifier(featuresCol="features",
+                                       labelCol="label").fit(fdf)
+        model.save(str(tmp_path / "m"))
+        from mmlspark_tpu.core.pipeline import PipelineStage
+        loaded = PipelineStage.load(str(tmp_path / "m"))
+        np.testing.assert_allclose(
+            loaded.transform(fdf).column("rawPrediction"),
+            model.transform(fdf).column("rawPrediction"), atol=1e-6)
