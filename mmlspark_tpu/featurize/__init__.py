@@ -1,0 +1,18 @@
+"""Featurization library (reference featurize/ package, SURVEY §2.4).
+
+Auto-featurization (Featurize/AssembleFeatures), typed value indexing
+(ValueIndexer/IndexToValue), missing-data imputation (CleanMissingData), type
+coercion (DataConversion), and text featurization (TextFeaturizer, MultiNGram,
+PageSplitter).
+"""
+
+from .indexers import IndexToValue, ValueIndexer, ValueIndexerModel
+from .clean import CleanMissingData, CleanMissingDataModel, DataConversion
+from .assemble import AssembleFeatures, Featurize
+from .text import MultiNGram, PageSplitter, TextFeaturizer, TextFeaturizerModel
+
+__all__ = [
+    "AssembleFeatures", "CleanMissingData", "CleanMissingDataModel",
+    "DataConversion", "Featurize", "IndexToValue", "MultiNGram", "PageSplitter",
+    "TextFeaturizer", "TextFeaturizerModel", "ValueIndexer", "ValueIndexerModel",
+]

@@ -1,0 +1,175 @@
+"""Auto-featurization: heterogeneous columns -> one numeric feature vector.
+
+Reference: featurize/Featurize.scala:25-110 + featurize/AssembleFeatures.scala —
+per output column, build a sub-pipeline that casts numerics, indexes (or hashes
+when high-cardinality) strings, one-hot encodes categoricals, imputes missing
+values, and assembles everything into a single vector column. TrainClassifier /
+TrainRegressor lean on this for their auto-featurize step, and the reference's
+LightGBM featurize helper (LightGBMUtils.scala:44-57) is the same machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, HasInputCols, HasOutputCol, Param
+from ..core.pipeline import Estimator, Model
+from ..core.schema import ColType, Schema
+from ..ops.hashing import hash_string
+
+
+class AssembleFeatures(Estimator, HasInputCols, HasOutputCol):
+    """Fit per-column encoders; produce a single dense vector column."""
+
+    oneHotEncodeCategoricals = Param("oneHotEncodeCategoricals",
+                                     "One-hot low-cardinality strings", True,
+                                     ptype=bool)
+    numberOfFeatures = Param("numberOfFeatures",
+                             "Hash bucket count for high-cardinality strings", 262144,
+                             ptype=int)
+    allowImages = Param("allowImages", "Allow image columns (unrolled)", False,
+                        ptype=bool)
+    maxCategoricalLevels = Param("maxCategoricalLevels",
+                                 "Cardinality cutoff for one-hot vs hashing", 100,
+                                 ptype=int)
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("outputCol", "features")
+        super().__init__(**kwargs)
+
+    def fit(self, df: DataFrame) -> "AssembleFeaturesModel":
+        in_cols = list(self.get_or_throw("inputCols"))
+        data = df.collect()
+        encoders: List[Dict[str, Any]] = []
+        for c in in_cols:
+            col = data[c]
+            kind = _column_kind(col)
+            if kind == "numeric":
+                vals = _as_float(col)
+                mean = float(np.nanmean(vals)) if len(vals) else 0.0
+                encoders.append({"col": c, "kind": "numeric", "fill": mean})
+            elif kind == "vector":
+                dim = 0
+                for v in col:
+                    if v is not None:
+                        dim = len(np.asarray(v).reshape(-1))
+                        break
+                encoders.append({"col": c, "kind": "vector", "dim": dim})
+            elif kind == "string":
+                levels = sorted({str(v) for v in col if v is not None})
+                if (self.get("oneHotEncodeCategoricals")
+                        and len(levels) <= self.get("maxCategoricalLevels")):
+                    encoders.append({"col": c, "kind": "onehot", "levels": levels})
+                else:
+                    encoders.append({"col": c, "kind": "hash",
+                                     "buckets": min(self.get("numberOfFeatures"),
+                                                    1 << 18)})
+            else:
+                continue  # unsupported columns silently skipped (reference behavior)
+        return AssembleFeaturesModel(
+            inputCols=in_cols, outputCol=self.get("outputCol"), encoders=encoders)
+
+
+class AssembleFeaturesModel(Model, HasInputCols, HasOutputCol):
+    encoders = ComplexParam("encoders", "Per-column encoder specs")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        encoders = self.get_or_throw("encoders")
+        out_col = self.get_or_throw("outputCol")
+
+        def fn(p):
+            n = len(next(iter(p.values()))) if p else 0
+            pieces: List[np.ndarray] = []
+            for enc in encoders:
+                col = p[enc["col"]]
+                kind = enc["kind"]
+                if kind == "numeric":
+                    vals = _as_float(col)
+                    vals = np.where(np.isnan(vals), enc["fill"], vals)
+                    pieces.append(vals.reshape(n, 1))
+                elif kind == "vector":
+                    dim = enc["dim"]
+                    block = np.zeros((n, dim))
+                    for i, v in enumerate(col):
+                        if v is not None:
+                            block[i] = np.asarray(v, dtype=np.float64).reshape(-1)[:dim]
+                    pieces.append(block)
+                elif kind == "onehot":
+                    levels = enc["levels"]
+                    index = {v: i for i, v in enumerate(levels)}
+                    block = np.zeros((n, len(levels)))
+                    for i, v in enumerate(col):
+                        j = index.get(str(v)) if v is not None else None
+                        if j is not None:
+                            block[i, j] = 1.0
+                    pieces.append(block)
+                elif kind == "hash":
+                    # single hashed slot per string (compact; collisions sum)
+                    block = np.zeros((n, 1))
+                    for i, v in enumerate(col):
+                        if v is not None:
+                            block[i, 0] = hash_string(str(v)) % enc["buckets"]
+                    pieces.append(block)
+            full = np.concatenate(pieces, axis=1) if pieces else np.zeros((n, 0))
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                out[i] = full[i]
+            return out
+
+        return df.with_column(out_col, fn)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        out = schema.copy()
+        out.types[self.get_or_throw("outputCol")] = ColType.VECTOR
+        return out
+
+
+class Featurize(Estimator):
+    """Map of output col -> input cols, each assembled independently
+    (featurize/Featurize.scala:25-110)."""
+
+    featureColumns = Param("featureColumns", "outputCol -> [inputCols] map", None,
+                           ptype=dict)
+    oneHotEncodeCategoricals = Param("oneHotEncodeCategoricals", "One-hot strings",
+                                     True, ptype=bool)
+    numberOfFeatures = Param("numberOfFeatures", "Hash buckets", 262144, ptype=int)
+    allowImages = Param("allowImages", "Allow image columns", False, ptype=bool)
+
+    def fit(self, df: DataFrame) -> "Model":
+        from ..core.pipeline import PipelineModel
+
+        fitted = []
+        for out_col, in_cols in self.get_or_throw("featureColumns").items():
+            stage = AssembleFeatures(
+                inputCols=list(in_cols), outputCol=out_col,
+                oneHotEncodeCategoricals=self.get("oneHotEncodeCategoricals"),
+                numberOfFeatures=self.get("numberOfFeatures"),
+                allowImages=self.get("allowImages"))
+            fitted.append(stage.fit(df))
+        return PipelineModel(fitted)
+
+
+def _column_kind(col: np.ndarray) -> str:
+    if col.dtype.kind in "biufc":
+        return "numeric"
+    for v in col:
+        if v is None:
+            continue
+        if isinstance(v, str):
+            return "string"
+        if isinstance(v, (np.ndarray, list, tuple)):
+            return "vector"
+        if isinstance(v, (int, float, np.integer, np.floating, bool)):
+            return "numeric"
+        return "other"
+    return "other"
+
+
+def _as_float(col: np.ndarray) -> np.ndarray:
+    if col.dtype == object:
+        return np.array([np.nan if v is None else float(v) for v in col],
+                        dtype=np.float64)
+    return col.astype(np.float64)

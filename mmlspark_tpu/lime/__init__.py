@@ -1,0 +1,12 @@
+"""Model interpretability: LIME for tabular data and images (reference lime/).
+
+TabularLIME/TabularLIMEModel (lime/LIME.scala:164-220), ImageLIME (superpixel
+masking + sampled probes + per-row lasso, LIME.scala:43-158), SLIC superpixels
+(lime/Superpixel.scala:143+), SuperpixelTransformer.
+"""
+
+from .superpixel import Superpixel, SuperpixelTransformer, slic
+from .lime import ImageLIME, TabularLIME, TabularLIMEModel
+
+__all__ = ["ImageLIME", "Superpixel", "SuperpixelTransformer", "TabularLIME",
+           "TabularLIMEModel", "slic"]

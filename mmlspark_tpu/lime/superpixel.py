@@ -1,0 +1,107 @@
+"""SLIC-style superpixel clustering.
+
+Reference: lime/Superpixel.scala:143+ — a SLIC variant clustering pixels by
+(color, position) for ImageLIME's masking units. Implemented as vectorized
+numpy k-means in (r,g,b,lambda*x,lambda*y) space with a fixed iteration count
+(jit-friendly shape discipline; image sizes here are preprocessing-scale).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import HasInputCol, HasOutputCol, Param
+from ..core.pipeline import Transformer
+from ..core.schema import ColType, ImageSchema, Schema
+
+
+def slic(img: np.ndarray, cell_size: float = 16.0, modifier: float = 130.0,
+         iters: int = 5) -> np.ndarray:
+    """HWC image -> int32 [H,W] superpixel labels (contiguous 0..K-1).
+
+    ``cell_size``: target superpixel spacing in pixels; ``modifier``: color vs
+    space weighting (reference Superpixel defaults 16 / 130).
+    """
+    img = np.asarray(img, dtype=np.float64)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    h, w, c = img.shape
+    gy = max(1, int(round(h / cell_size)))
+    gx = max(1, int(round(w / cell_size)))
+    ys = (np.arange(gy) + 0.5) * h / gy
+    xs = (np.arange(gx) + 0.5) * w / gx
+    cy, cx = np.meshgrid(ys, xs, indexing="ij")
+    centers_pos = np.stack([cy.ravel(), cx.ravel()], axis=1)       # [K,2]
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    pos = np.stack([yy.ravel(), xx.ravel()], axis=1).astype(np.float64)  # [P,2]
+    colors = img.reshape(-1, c)                                    # [P,C]
+    k = len(centers_pos)
+    ci = np.clip(centers_pos[:, 0].astype(int), 0, h - 1)
+    cj = np.clip(centers_pos[:, 1].astype(int), 0, w - 1)
+    centers_col = img[ci, cj, :]
+    space_w = modifier / cell_size
+
+    labels = np.zeros(h * w, dtype=np.int64)
+    for _ in range(iters):
+        d_col = ((colors[:, None, :] - centers_col[None, :, :]) ** 2).sum(-1)
+        d_pos = ((pos[:, None, :] - centers_pos[None, :, :]) ** 2).sum(-1)
+        labels = np.argmin(d_col + (space_w ** 2) * d_pos, axis=1)
+        for j in range(k):
+            m = labels == j
+            if m.any():
+                centers_col[j] = colors[m].mean(axis=0)
+                centers_pos[j] = pos[m].mean(axis=0)
+    # compact labels
+    uniq, labels = np.unique(labels, return_inverse=True)
+    return labels.reshape(h, w).astype(np.int32)
+
+
+class Superpixel:
+    """Cluster container with masking helpers (Superpixel.scala parity)."""
+
+    def __init__(self, labels: np.ndarray):
+        self.labels = labels
+        self.num_clusters = int(labels.max()) + 1 if labels.size else 0
+
+    def mask_image(self, img: np.ndarray, states: np.ndarray,
+                   background: float = 0.0) -> np.ndarray:
+        """Zero out superpixels whose state is False (LIME's perturbation)."""
+        keep = np.asarray(states, dtype=bool)[self.labels]
+        out = np.array(img, copy=True)
+        out[~keep] = background
+        return out
+
+
+class SuperpixelTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Image column -> superpixel struct column (lime/SuperpixelTransformer)."""
+
+    cellSize = Param("cellSize", "Target superpixel spacing (px)", 16.0, ptype=float)
+    modifier = Param("modifier", "Color/space weighting", 130.0, ptype=float)
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("outputCol", "superpixels")
+        super().__init__(**kwargs)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get_or_throw("inputCol")
+        out_col = self.get_or_throw("outputCol")
+        cell, mod = self.get("cellSize"), self.get("modifier")
+
+        def fn(p):
+            col = p[in_col]
+            out = np.empty(len(col), dtype=object)
+            for i, row in enumerate(col):
+                if row is None:
+                    out[i] = None
+                    continue
+                img = ImageSchema.to_array(row) if ImageSchema.is_image(row) \
+                    else np.asarray(row)
+                labels = slic(img, cell, mod)
+                out[i] = {"labels": labels,
+                          "numClusters": int(labels.max()) + 1}
+            return out
+
+        return df.with_column(out_col, fn)

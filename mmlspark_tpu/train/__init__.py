@@ -1,0 +1,23 @@
+"""Training convenience layer (reference train/ package, SURVEY §2.4).
+
+TrainClassifier/TrainRegressor auto-featurize and fit any estimator;
+ComputeModelStatistics / ComputePerInstanceStatistics produce metric DataFrames.
+"""
+
+from .stages import (
+    TrainClassifier,
+    TrainRegressor,
+    TrainedClassifierModel,
+    TrainedRegressorModel,
+)
+from .metrics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    MetricsLogger,
+)
+
+__all__ = [
+    "ComputeModelStatistics", "ComputePerInstanceStatistics", "MetricsLogger",
+    "TrainClassifier", "TrainRegressor", "TrainedClassifierModel",
+    "TrainedRegressorModel",
+]

@@ -26,9 +26,11 @@ from ..core.schema import ColType, Schema
 from ..ops.hashing import hash_string
 
 
-def _sort_dedup(idx: List[int], val: List[float], mask: int
+def _sort_dedup(idx, val, mask: int, sum_collisions: bool = True
                 ) -> Dict[str, np.ndarray]:
-    if not idx:
+    """Mask, sort, and merge duplicate indices (sum, or keep-first when
+    ``sum_collisions`` is False — VW's sumCollisions semantics)."""
+    if len(idx) == 0:
         return {"indices": np.empty(0, dtype=np.int64),
                 "values": np.empty(0, dtype=np.float32)}
     arr_i = np.asarray(idx, dtype=np.int64) & mask
@@ -36,8 +38,11 @@ def _sort_dedup(idx: List[int], val: List[float], mask: int
     order = np.argsort(arr_i, kind="stable")
     arr_i, arr_v = arr_i[order], arr_v[order]
     uniq, start = np.unique(arr_i, return_index=True)
-    sums = np.add.reduceat(arr_v, start)
-    return {"indices": uniq, "values": sums.astype(np.float32)}
+    if sum_collisions:
+        merged = np.add.reduceat(arr_v, start)
+    else:
+        merged = arr_v[start]  # first occurrence wins
+    return {"indices": uniq, "values": merged.astype(np.float32)}
 
 
 class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
@@ -63,6 +68,7 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         mask = (1 << self.get("numBits")) - 1
         split = self.get("stringSplit")
         prefix = self.get("prefixStringsWithColumnName")
+        sum_coll = self.get("sumCollisions")
 
         col_hash = {c: hash_string(c, seed) for c in in_cols}
 
@@ -107,7 +113,7 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
                             val.append(float(arr[j]))
                 else:
                     raise TypeError(f"Unsupported value type {type(v)} in col {c!r}")
-            return _sort_dedup(idx, val, mask)
+            return _sort_dedup(idx, val, mask, sum_coll)
 
         def fn(p):
             n = len(next(iter(p.values()))) if p else 0
@@ -140,6 +146,7 @@ class VowpalWabbitInteractions(Transformer, HasInputCols, HasOutputCol):
         in_cols = list(self.get_or_throw("inputCols"))
         out_col = self.get_or_throw("outputCol")
         mask = (1 << self.get("numBits")) - 1
+        sum_coll = self.get("sumCollisions")
 
         def fn(p):
             n = len(next(iter(p.values()))) if p else 0
@@ -159,7 +166,7 @@ class VowpalWabbitInteractions(Transformer, HasInputCols, HasOutputCol):
                     idx = ((idx[:, None] * np.int64(67108859) + i2[None, :])
                            .reshape(-1))
                     val = (val[:, None] * v2[None, :]).reshape(-1)
-                out[i] = _sort_dedup(list(idx & mask), list(val), mask)
+                out[i] = _sort_dedup(idx, val, mask, sum_coll)
             return out
 
         return df.with_column(out_col, fn)

@@ -119,7 +119,7 @@ def make_scan_pass(config: LearnerConfig):
             sigma = (jnp.sqrt(ni + gi * gi) - jnp.sqrt(ni)) / config.ftrl_alpha
             z = z.at[idx].add(gi - sigma * wi)
             n_acc = n_acc.at[idx].add(gi * gi)
-            return (z, n_acc), _example_loss(loss, pred, label, tau)
+            return (z, n_acc), _example_loss(loss, pred, label, tau) * wgt
 
         def run_pass(state, ds):
             return jax.lax.scan(step, state,
@@ -134,14 +134,15 @@ def make_scan_pass(config: LearnerConfig):
             g = _loss_grad(loss, pred, label, tau) * wgt
             gi = g * val + l2 * wi
             t = t + 1.0
-            eta = lr / jnp.power(t + config.initial_t, power_t)
             if config.adaptive:
+                # VW adaptive: per-weight rate lr * g2^(-power_t)
                 g2 = g2.at[idx].add(gi * gi)
-                scale = jnp.sqrt(g2[idx]) + 1e-8
+                scale = jnp.power(g2[idx] + 1e-16, power_t) + 1e-8
                 w = w.at[idx].add(-lr * gi / scale)
             else:
+                eta = lr / jnp.power(t + config.initial_t, power_t)
                 w = w.at[idx].add(-eta * gi)
-            return (w, g2, t), _example_loss(loss, pred, label, tau)
+            return (w, g2, t), _example_loss(loss, pred, label, tau) * wgt
 
         def run_pass(state, ds):
             return jax.lax.scan(step, state,
@@ -157,7 +158,7 @@ def _example_loss(loss: str, pred, label, tau: float):
     if loss == "squared":
         return 0.5 * (pred - label) ** 2
     if loss == "logistic":
-        return jnp.log1p(jnp.exp(-label * pred))
+        return jnp.logaddexp(0.0, -label * pred)  # stable for large |margin|
     if loss == "hinge":
         return jnp.maximum(0.0, 1.0 - label * pred)
     if loss == "quantile":
@@ -207,7 +208,11 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
     w0 = (jnp.asarray(initial_weights, dtype=jnp.float32)
           if initial_weights is not None else jnp.zeros(dim, dtype=jnp.float32))
     if config.ftrl:
-        state = (w0 * 0.0, jnp.zeros(dim, dtype=jnp.float32))  # (z, n)
+        # warm start: choose z so the reconstructed weights equal w0 at n=0
+        # (ignores the l1 dead zone — exact for |z| > l1, the active coords)
+        z0 = -w0 * (config.ftrl_beta / config.ftrl_alpha + config.l2)
+        z0 = jnp.where(z0 != 0, z0 + jnp.sign(z0) * config.l1, 0.0)
+        state = (z0, jnp.zeros(dim, dtype=jnp.float32))  # (z, n)
     else:
         state = (w0, jnp.zeros(dim, dtype=jnp.float32), jnp.float32(0.0))
 
@@ -267,9 +272,10 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
             state, loss_sum = sharded(state, ds["indices"], ds["values"],
                                       ds["labels"], ds["weights"])
             dt = time.perf_counter_ns() - t0
+            w_sum = float(dataset.weights.sum())
             stats.append(TrainingStats(0, n, dt, dt,
-                                       float(loss_sum) / max(n, 1),
-                                       float(dataset.weights.sum())))
+                                       float(loss_sum) / max(w_sum, 1e-12),
+                                       w_sum))
     else:
         ds = {"indices": jnp.asarray(dataset.indices),
               "values": jnp.asarray(dataset.values),
@@ -279,9 +285,10 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
             t0 = time.perf_counter_ns()
             state, losses = run_pass(state, ds)
             dt = time.perf_counter_ns() - t0
+            w_sum = float(dataset.weights.sum())
             stats.append(TrainingStats(0, n, dt, dt,
-                                       float(jnp.mean(losses)),
-                                       float(dataset.weights.sum())))
+                                       float(jnp.sum(losses)) / max(w_sum, 1e-12),
+                                       w_sum))
 
     if config.ftrl:
         w = _ftrl_weights(config, state[0], state[1])

@@ -44,6 +44,8 @@ def parse_vw_args(args: str, base: Optional[LearnerConfig] = None) -> LearnerCon
         def val():
             nonlocal i
             i += 1
+            if i >= len(toks):
+                raise ValueError(f"VW arg {t!r} expects a value but none was given")
             return toks[i]
 
         if t in ("-b", "--bit_precision"):

@@ -1,0 +1,197 @@
+"""Tests: AutoML tuning/model selection + SAR recommendation/ranking."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.automl import (
+    DefaultHyperparams,
+    DiscreteHyperParam,
+    FindBestModel,
+    GridSpace,
+    HyperparamBuilder,
+    MetricEvaluator,
+    ParamSpace,
+    RangeHyperParam,
+    TuneHyperparameters,
+)
+from mmlspark_tpu.gbdt import LightGBMClassifier
+from mmlspark_tpu.recommendation import (
+    RankingAdapter,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    RecommendationIndexer,
+    SAR,
+)
+
+
+def clf_df(n=250, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(float)
+    return DataFrame.from_dict(
+        {"features": [X[i] for i in range(n)], "label": y}, num_partitions=2)
+
+
+class TestHyperparams:
+    def test_range_param(self):
+        rng = np.random.default_rng(0)
+        d = RangeHyperParam(1, 10)
+        vals = [d.sample(rng) for _ in range(50)]
+        assert all(1 <= v <= 10 and isinstance(v, int) for v in vals)
+        f = RangeHyperParam(0.1, 0.5)
+        assert all(0.1 <= f.sample(rng) <= 0.5 for _ in range(20))
+
+    def test_grid_space(self):
+        est = LightGBMClassifier()
+        builder = (HyperparamBuilder()
+                   .add_hyperparam(est, "numLeaves", DiscreteHyperParam([7, 15]))
+                   .add_hyperparam(est, "learningRate",
+                                   DiscreteHyperParam([0.1, 0.2])))
+        space = GridSpace(builder.build())
+        assert space.space_size() == 4
+        assert len(list(space.param_maps())) == 4
+
+    def test_unknown_param_rejected(self):
+        with pytest.raises(KeyError):
+            HyperparamBuilder().add_hyperparam(
+                LightGBMClassifier(), "nope", DiscreteHyperParam([1]))
+
+    def test_defaults_exist(self):
+        assert DefaultHyperparams.for_estimator(LightGBMClassifier())
+
+
+class TestTuneHyperparameters:
+    def test_cv_tuning(self):
+        df = clf_df()
+        est = LightGBMClassifier(numIterations=10, minDataInLeaf=5)
+        builder = (HyperparamBuilder()
+                   .add_hyperparam(est, "numLeaves", DiscreteHyperParam([7, 31])))
+        tuner = TuneHyperparameters(
+            models=[est], paramSpace=GridSpace(builder.build()),
+            evaluationMetric="accuracy", numFolds=2, labelCol="label")
+        model = tuner.fit(df)
+        assert model.get("bestMetric") > 0.8
+        assert model.get("bestParams")["numLeaves"] in (7, 31)
+        assert len(model.get("allMetrics")) == 2
+        out = model.transform(df)
+        assert "prediction" in out.columns
+
+    def test_parallel_tuning(self):
+        df = clf_df(150)
+        est = LightGBMClassifier(numIterations=5, minDataInLeaf=5)
+        space = ParamSpace(HyperparamBuilder().add_hyperparam(
+            est, "learningRate", RangeHyperParam(0.05, 0.3)).build(), seed=1)
+        tuner = TuneHyperparameters(
+            models=[est], paramSpace=space, evaluationMetric="AUC",
+            numFolds=2, numRuns=3, parallelism=2, labelCol="label")
+        model = tuner.fit(df)
+        assert len(model.get("allMetrics")) == 3
+
+
+class TestFindBestModel:
+    def test_selects_better_model(self):
+        df = clf_df()
+        good = LightGBMClassifier(numIterations=20, numLeaves=15,
+                                  minDataInLeaf=5).fit(df)
+        bad = LightGBMClassifier(numIterations=1, numLeaves=2,
+                                 learningRate=0.001, minDataInLeaf=100).fit(df)
+        fbm = FindBestModel(models=[bad, good], evaluationMetric="accuracy",
+                            labelCol="label")
+        best = fbm.fit(df)
+        assert best.get_or_throw("bestModel") is good
+        metrics = best.get_evaluation_results()
+        assert metrics.count() == 2
+
+
+def ratings_df(n_users=30, n_items=20, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    # two taste clusters: users like even or odd items
+    for u in range(n_users):
+        liked = [i for i in range(n_items) if i % 2 == u % 2]
+        chosen = rng.choice(liked, size=min(6, len(liked)), replace=False)
+        for i in chosen:
+            rows.append({"user": u, "item": int(i), "rating": 1.0,
+                         "time": 1_600_000_000 + int(rng.integers(0, 86400 * 60))})
+    return DataFrame.from_rows(rows)
+
+
+class TestSAR:
+    def test_fit_and_recommend(self):
+        df = ratings_df()
+        model = SAR(userCol="user", itemCol="item", ratingCol="rating",
+                    supportThreshold=1).fit(df)
+        recs = model.recommend_for_all_users(num_items=5)
+        assert recs.count() == 30
+        # user 0 likes even items -> recommendations should be mostly even
+        row0 = recs.rows()[0]
+        evens = sum(1 for i in row0["recommendations"] if i % 2 == 0)
+        assert evens >= len(row0["recommendations"]) - 1
+
+    def test_time_decay(self):
+        rows = [
+            {"user": 0, "item": 0, "rating": 1.0, "time": 0.0},
+            {"user": 0, "item": 1, "rating": 1.0, "time": 86400.0 * 365},
+            {"user": 1, "item": 0, "rating": 1.0, "time": 86400.0 * 365},
+            {"user": 1, "item": 1, "rating": 1.0, "time": 86400.0 * 365},
+        ]
+        df = DataFrame.from_rows(rows)
+        model = SAR(userCol="user", itemCol="item", ratingCol="rating",
+                    timeCol="time", supportThreshold=0,
+                    timeDecayCoeff=30).fit(df)
+        A = model.get_or_throw("userAffinity")
+        assert A[0, 0] < A[0, 1] * 1e-3  # year-old event decayed away
+
+    def test_similarity_functions(self):
+        df = ratings_df()
+        for sim in ("cooccurrence", "jaccard", "lift"):
+            model = SAR(userCol="user", itemCol="item", ratingCol="rating",
+                        similarityFunction=sim, supportThreshold=1).fit(df)
+            S = model.get_or_throw("itemSimilarity")
+            assert np.isfinite(S).all() and (S >= 0).all()
+
+    def test_transform_scores_pairs(self):
+        df = ratings_df()
+        model = SAR(userCol="user", itemCol="item", ratingCol="rating",
+                    supportThreshold=1).fit(df)
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        assert np.asarray(out.column("prediction")).mean() > 0
+
+
+class TestRanking:
+    def test_indexer(self):
+        df = DataFrame.from_dict({"u": ["alice", "bob", "alice"],
+                                  "i": ["x", "y", "y"],
+                                  "rating": [1.0, 2.0, 3.0]})
+        model = RecommendationIndexer(userInputCol="u", userOutputCol="user",
+                                      itemInputCol="i", itemOutputCol="item").fit(df)
+        out = model.transform(df)
+        assert out.column("user")[0] == out.column("user")[2]
+        assert model.recover_user(0) == "alice"
+
+    def test_ranking_evaluator(self):
+        df = DataFrame.from_rows([
+            {"recommendations": np.array([1, 2, 3]), "label": np.array([1, 9])},
+            {"recommendations": np.array([5, 6]), "label": np.array([7])},
+        ])
+        ev = RankingEvaluator(metricName="precisionAtk", k=3)
+        assert ev.evaluate(df) == pytest.approx((1 / 3 + 0) / 2)
+        ev2 = RankingEvaluator(metricName="recallAtK", k=3)
+        assert ev2.evaluate(df) == pytest.approx((0.5 + 0) / 2)
+        ev3 = RankingEvaluator(metricName="ndcgAt", k=3)
+        assert 0 < ev3.evaluate(df) < 1
+
+    def test_train_validation_split_flow(self):
+        df = ratings_df(40, 20)
+        tvs = RankingTrainValidationSplit(
+            estimator=SAR(supportThreshold=1),
+            evaluator=RankingEvaluator(metricName="ndcgAt", k=5),
+            userCol="user", itemCol="item", ratingCol="rating",
+            minRatingsPerUser=3)
+        model = tvs.fit(df)
+        # clustered tastes -> SAR should beat random ranking comfortably
+        assert model.get("validationMetric") > 0.2
+        out = model.transform(df)
+        assert "recommendations" in out.columns

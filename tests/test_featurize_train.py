@@ -1,0 +1,210 @@
+"""Tests: featurize package + train package (auto-featurization E2E)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.featurize import (
+    AssembleFeatures,
+    CleanMissingData,
+    DataConversion,
+    Featurize,
+    IndexToValue,
+    MultiNGram,
+    PageSplitter,
+    TextFeaturizer,
+    ValueIndexer,
+)
+from mmlspark_tpu.gbdt import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.train import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    TrainClassifier,
+    TrainRegressor,
+)
+
+
+def mixed_df(n=200, seed=0, parts=2):
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(20, 70, n)
+    city = rng.choice(["nyc", "sf", "la"], n)
+    income = rng.normal(60, 15, n)
+    income[rng.choice(n, 10, replace=False)] = np.nan
+    logit = 0.08 * (age - 45) + np.where(city == "sf", 2.0, 0.0) \
+        + 0.04 * np.nan_to_num(income - 60)
+    label = (logit + rng.normal(scale=0.4, size=n) > 0)
+    return DataFrame.from_dict({
+        "age": age, "city": list(city), "income": income,
+        "label": np.where(label, "yes", "no"),
+    }, num_partitions=parts)
+
+
+class TestValueIndexer:
+    def test_roundtrip(self):
+        df = DataFrame.from_dict({"cat": ["b", "a", "c", "a", None]})
+        model = ValueIndexer(inputCol="cat", outputCol="idx").fit(df)
+        out = model.transform(df)
+        idx = out.column("idx")
+        assert idx[1] == 0.0 and idx[0] == 1.0 and idx[2] == 2.0
+        assert idx[4] == 3.0  # null -> last index
+        back = IndexToValue(inputCol="idx", outputCol="orig").transform(out)
+        assert list(back.column("orig"))[:4] == ["b", "a", "c", "a"]
+
+    def test_save_load(self, tmp_path):
+        df = DataFrame.from_dict({"cat": ["x", "y"]})
+        model = ValueIndexer(inputCol="cat", outputCol="idx").fit(df)
+        model.save(str(tmp_path / "m"))
+        from mmlspark_tpu.core.pipeline import PipelineStage
+        loaded = PipelineStage.load(str(tmp_path / "m"))
+        np.testing.assert_array_equal(loaded.transform(df).column("idx"),
+                                      model.transform(df).column("idx"))
+
+
+class TestCleanMissing:
+    def test_mean_impute(self):
+        df = DataFrame.from_dict({"x": [1.0, np.nan, 3.0]})
+        model = CleanMissingData(inputCols=["x"]).fit(df)
+        out = model.transform(df).column("x")
+        assert out[1] == 2.0
+
+    def test_median_and_custom(self):
+        df = DataFrame.from_dict({"x": [1.0, np.nan, 3.0, 100.0]})
+        med = CleanMissingData(inputCols=["x"], cleaningMode="Median").fit(df)
+        assert med.transform(df).column("x")[1] == 3.0
+        cust = CleanMissingData(inputCols=["x"], cleaningMode="Custom",
+                                customValue=-1.0).fit(df)
+        assert cust.transform(df).column("x")[1] == -1.0
+
+
+class TestDataConversion:
+    def test_double_to_int(self):
+        df = DataFrame.from_dict({"x": [1.7, 2.2]})
+        out = DataConversion(cols=["x"], convertTo="integer").transform(df)
+        assert out.column("x").dtype == np.int32
+
+    def test_to_string(self):
+        df = DataFrame.from_dict({"x": [1.5]})
+        out = DataConversion(cols=["x"], convertTo="string").transform(df)
+        assert out.column("x")[0] == "1.5"
+
+    def test_to_categorical(self):
+        df = DataFrame.from_dict({"x": ["b", "a", "b"]})
+        out = DataConversion(cols=["x"], convertTo="toCategorical").transform(df)
+        assert out.column("x")[0] == 1.0
+
+
+class TestAssemble:
+    def test_mixed_columns(self):
+        df = mixed_df(50)
+        model = AssembleFeatures(inputCols=["age", "city", "income"],
+                                 outputCol="features").fit(df)
+        out = model.transform(df)
+        v = out.column("features")[0]
+        # age(1) + city onehot(3) + income(1)
+        assert v.shape == (5,)
+        assert np.isfinite(np.stack(list(out.column("features")))).all()
+
+    def test_featurize_map(self):
+        df = mixed_df(50)
+        model = Featurize(featureColumns={"feats": ["age", "city"]}).fit(df)
+        out = model.transform(df)
+        assert out.column("feats")[0].shape == (4,)
+
+
+class TestTextFeaturizer:
+    def docs(self):
+        return DataFrame.from_dict({"text": [
+            "the cat sat on the mat",
+            "the dog ate my homework",
+            "cats and dogs are pets",
+        ]})
+
+    def test_tf_idf(self):
+        model = TextFeaturizer(inputCol="text", outputCol="tf",
+                               numFeatures=1 << 12).fit(self.docs())
+        out = model.transform(self.docs())
+        f = out.column("tf")[0]
+        assert len(f["indices"]) > 0
+        assert (f["values"] >= 0).all()
+
+    def test_ngrams(self):
+        model = TextFeaturizer(inputCol="text", outputCol="tf", useNGram=True,
+                               nGramLength=2, useIDF=False,
+                               numFeatures=1 << 12).fit(self.docs())
+        f = model.transform(self.docs()).column("tf")[0]
+        assert len(f["indices"]) == 5  # 6 tokens -> 5 bigrams
+
+    def test_multi_ngram(self):
+        df = DataFrame.from_dict({"toks": [["a", "b", "c"]]})
+        out = MultiNGram(inputCol="toks", outputCol="grams",
+                         lengths=[1, 2]).transform(df)
+        assert out.column("grams")[0] == ["a", "b", "c", "a b", "b c"]
+
+    def test_page_splitter(self):
+        text = "word " * 100  # 500 chars
+        df = DataFrame.from_dict({"t": [text.strip()]})
+        out = PageSplitter(inputCol="t", outputCol="pages",
+                           maximumPageLength=120,
+                           minimumPageLength=100).transform(df)
+        pages = out.column("pages")[0]
+        assert all(len(pg) <= 120 for pg in pages)
+        assert "".join(pages) == text.strip()
+
+
+class TestTrainClassifier:
+    def test_auto_featurize_string_labels(self):
+        df = mixed_df(300)
+        tc = TrainClassifier(labelCol="label").set_model(
+            LightGBMClassifier(numIterations=15, numLeaves=15, minDataInLeaf=5))
+        model = tc.fit(df)
+        out = model.transform(df)
+        assert "scored_labels" in out.columns
+        assert "scored_probabilities" in out.columns
+        orig = out.column("scored_labels_original")
+        truth = df.column("label")
+        assert np.mean([o == t for o, t in zip(orig, truth)]) > 0.85
+
+    def test_compute_model_statistics(self):
+        df = mixed_df(300)
+        model = TrainClassifier(labelCol="label").set_model(
+            LightGBMClassifier(numIterations=15, numLeaves=15,
+                               minDataInLeaf=5)).fit(df)
+        scored = model.transform(df)
+        # label must be indexed the same way for metrics
+        idx = ValueIndexer(inputCol="label", outputCol="label").fit(df)
+        scored_idx = idx.transform(scored)
+        stats = ComputeModelStatistics(labelCol="label").transform(scored_idx)
+        row = stats.rows()[0]
+        assert row["accuracy"] > 0.85
+        assert 0 <= row["AUC"] <= 1
+        assert row["confusion_matrix"].shape == (2, 2)
+
+    def test_per_instance_statistics(self):
+        df = mixed_df(100)
+        model = TrainClassifier(labelCol="label").set_model(
+            LightGBMClassifier(numIterations=5, numLeaves=7,
+                               minDataInLeaf=5)).fit(df)
+        scored = model.transform(df)
+        idx = ValueIndexer(inputCol="label", outputCol="label").fit(df)
+        out = ComputePerInstanceStatistics(labelCol="label").transform(
+            idx.transform(scored))
+        assert "log_loss" in out.columns
+        assert (out.column("log_loss") >= 0).all()
+
+
+class TestTrainRegressor:
+    def test_regression_flow(self):
+        rng = np.random.default_rng(0)
+        n = 300
+        a = rng.normal(size=n)
+        b = rng.normal(size=n)
+        y = 3 * a - 2 * b + 0.05 * rng.normal(size=n)
+        df = DataFrame.from_dict({"a": a, "b": b, "y": y})
+        tr = TrainRegressor(labelCol="y").set_model(
+            LightGBMRegressor(numIterations=40, numLeaves=15, minDataInLeaf=5,
+                              learningRate=0.15))
+        model = tr.fit(df)
+        scored = model.transform(df)
+        stats = ComputeModelStatistics(
+            labelCol="y", evaluationMetric="regression").transform(scored)
+        assert stats.rows()[0]["R^2"] > 0.85

@@ -1,0 +1,126 @@
+"""LIME + superpixel tests."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.schema import ImageSchema
+from mmlspark_tpu.lime import ImageLIME, SuperpixelTransformer, TabularLIME, slic
+from mmlspark_tpu.ops.lasso import fit_lasso
+
+
+class TestLasso:
+    def test_recovers_sparse_coefficients(self):
+        rng = np.random.default_rng(0)
+        n, d = 300, 10
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w_true = np.zeros(d)
+        w_true[2], w_true[7] = 3.0, -2.0
+        y = X @ w_true + 1.5 + 0.01 * rng.normal(size=n)
+        w, b = fit_lasso(X, y.astype(np.float32), np.float32(0.01), iters=500)
+        w = np.asarray(w)
+        np.testing.assert_allclose(w[[2, 7]], [3.0, -2.0], atol=0.15)
+        assert np.abs(w[[0, 1, 3, 4, 5, 6, 8, 9]]).max() < 0.1
+        assert abs(float(b) - 1.5) < 0.2
+
+    def test_l1_sparsifies(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(100, 20)).astype(np.float32)
+        y = (X[:, 0] + 0.01 * rng.normal(size=100)).astype(np.float32)
+        w_strong, _ = fit_lasso(X, y, np.float32(0.5), iters=300)
+        w_none, _ = fit_lasso(X, y, np.float32(0.0), iters=300)
+        assert (np.abs(np.asarray(w_strong)) > 1e-4).sum() \
+            < (np.abs(np.asarray(w_none)) > 1e-4).sum()
+
+
+class TestSuperpixel:
+    def test_slic_segments_blocks(self):
+        img = np.zeros((32, 32, 3), dtype=np.float64)
+        img[:, 16:] = 255.0  # two halves
+        labels = slic(img, cell_size=16.0)
+        assert labels.shape == (32, 32)
+        # left and right halves should not share clusters
+        left = set(labels[:, :14].ravel().tolist())
+        right = set(labels[:, 18:].ravel().tolist())
+        assert not (left & right)
+
+    def test_superpixel_transformer(self):
+        rng = np.random.default_rng(0)
+        rows = [ImageSchema.make(rng.integers(0, 255, (24, 24, 3), dtype=np.uint8))]
+        df = DataFrame.from_dict({"image": rows})
+        out = SuperpixelTransformer(inputCol="image").transform(df)
+        sp = out.column("superpixels")[0]
+        assert sp["numClusters"] > 1
+        assert sp["labels"].shape == (24, 24)
+
+
+class _LinearProbe:
+    """Fake model stage: prediction = w . features."""
+
+    def __init__(self, w, col="features"):
+        self.w = np.asarray(w, dtype=np.float64)
+        self.col = col
+
+    def has_param(self, name):
+        return name == "featuresCol"
+
+    def get(self, name):
+        return self.col
+
+    def transform(self, df):
+        return df.with_column("prediction", lambda p: np.array(
+            [float(self.w @ np.asarray(v, dtype=np.float64).reshape(-1))
+             for v in p[self.col]]))
+
+
+class TestTabularLIME:
+    def test_recovers_linear_weights(self):
+        rng = np.random.default_rng(0)
+        n, d = 60, 4
+        X = rng.normal(size=(n, d)) * np.array([1.0, 2.0, 0.5, 1.0])
+        df = DataFrame.from_dict({"features": [X[i] for i in range(n)]})
+        w_true = np.array([2.0, -1.0, 0.0, 3.0])
+        probe = _LinearProbe(w_true)
+        lime = TabularLIME(inputCol="features", outputCol="weights",
+                           nSamples=400).set("model", probe)
+        model = lime.fit(df)
+        out = model.transform(df.limit(3))
+        for w in out.column("weights"):
+            np.testing.assert_allclose(w, w_true, atol=0.2)
+
+
+class _BrightnessProbe:
+    """Fake image model: prediction = mean pixel value of left half."""
+
+    def has_param(self, name):
+        return name == "inputCol"
+
+    def get(self, name):
+        return "image"
+
+    def transform(self, df):
+        def fn(p):
+            out = np.zeros(len(p["image"]))
+            for i, row in enumerate(p["image"]):
+                img = ImageSchema.to_array(row).astype(np.float64)
+                out[i] = img[:, : img.shape[1] // 2].mean()
+            return out
+        return df.with_column("prediction", fn)
+
+
+class TestImageLIME:
+    def test_left_half_matters(self):
+        img = np.full((24, 24, 3), 200, dtype=np.uint8)
+        df = DataFrame.from_dict({"image": [ImageSchema.make(img)]})
+        lime = ImageLIME(inputCol="image", outputCol="weights",
+                         nSamples=80, cellSize=12.0).set("model", _BrightnessProbe())
+        out = lime.transform(df)
+        w = out.column("weights")[0]
+        sp = out.column("superpixels")[0]
+        labels = sp["labels"]
+        # superpixels overlapping the left half should carry the importance
+        left_ids = set(labels[:, :10].ravel().tolist())
+        right_ids = set(labels[:, 14:].ravel().tolist()) - left_ids
+        left_imp = np.mean([w[i] for i in left_ids])
+        right_imp = np.mean([w[i] for i in right_ids]) if right_ids else 0.0
+        assert left_imp > right_imp + 1.0

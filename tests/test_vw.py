@@ -255,3 +255,50 @@ class TestStages:
         np.testing.assert_allclose(
             loaded.transform(fdf).column("rawPrediction"),
             model.transform(fdf).column("rawPrediction"), atol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_ftrl_warm_start_used(self):
+        rows, raws = synth_sparse(300)
+        cfg = LearnerConfig(num_bits=12, ftrl=True, ftrl_alpha=0.1, num_passes=3)
+        ds = SparseDataset.from_rows(rows, raws, num_bits=12)
+        w1, _ = train_linear(cfg, ds)
+        # warm-starting from w1 with zero extra passes should preserve w1
+        cfg0 = LearnerConfig(num_bits=12, ftrl=True, ftrl_alpha=0.1, num_passes=1)
+        w2, _ = train_linear(cfg0, SparseDataset.from_rows(rows[:1], raws[:1],
+                                                           num_bits=12),
+                             initial_weights=w1)
+        # one example barely moves the model; weights stay close to w1, not zero
+        assert np.abs(w2).sum() > 0.5 * np.abs(w1).sum()
+
+    def test_sum_collisions_false_keeps_first(self):
+        df = DataFrame.from_dict({"text": ["hello hello"]})
+        out = VowpalWabbitFeaturizer(inputCols=["text"], stringSplit=True,
+                                     sumCollisions=False).transform(df)
+        f = out.column("features")[0]
+        assert list(f["values"]) == [1.0]
+
+    def test_parse_args_trailing_flag_raises(self):
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="expects a value"):
+            parse_vw_args("--loss_function hinge -l")
+
+    def test_padded_distributed_loss_unbiased(self, mesh8):
+        rows, raws = synth_sparse(401)  # not divisible by 8 -> 7 pad rows
+        y = np.where(raws > 0, 1.0, -1.0)
+        # lr=0 freezes weights: every real example's loss is exactly log(2),
+        # so any deviation in the mesh average exposes pad-row contamination
+        cfg = LearnerConfig(num_bits=12, loss_function="logistic",
+                            learning_rate=0.0, adaptive=False, num_passes=1)
+        ds = SparseDataset.from_rows(rows, y, num_bits=12)
+        _, stats_mesh = train_linear(cfg, ds, mesh=mesh8)
+        assert stats_mesh[0].average_loss == pytest.approx(np.log(2), abs=1e-5)
+
+    def test_logistic_loss_no_overflow(self):
+        rows = [{"indices": np.array([0]), "values": np.array([1000.0],
+                                                              dtype=np.float32)}]
+        cfg = LearnerConfig(num_bits=4, loss_function="logistic",
+                            learning_rate=10.0, num_passes=2)
+        ds = SparseDataset.from_rows(rows * 20, np.ones(20), num_bits=4)
+        _, stats = train_linear(cfg, ds)
+        assert np.isfinite(stats[-1].average_loss)
