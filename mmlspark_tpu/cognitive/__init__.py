@@ -1,0 +1,42 @@
+"""Cognitive services as pipeline stages (reference cognitive/ package).
+
+Azure AI REST services wrapped as transformers over the HTTP stack:
+vision (OCR/analyze/tag/describe/thumbnails/recognize-text-with-polling),
+text analytics (sentiment/language/entities/NER/key phrases), face, speech,
+anomaly detection, Bing image search, Azure Search writer. Every stage uses
+value-or-column ServiceParams (cognitive/CognitiveServiceBase.scala:29-151) and
+typed response schemas (SparkBindings parity via dataclasses).
+"""
+
+from .base import CognitiveServicesBase, HasServiceParams
+from .vision import (
+    OCR,
+    AnalyzeImage,
+    DescribeImage,
+    GenerateThumbnails,
+    RecognizeDomainSpecificContent,
+    RecognizeText,
+    TagImage,
+)
+from .text import (
+    EntityDetector,
+    KeyPhraseExtractor,
+    LanguageDetector,
+    NER,
+    TextSentiment,
+)
+from .face import DetectFace, FindSimilarFace, GroupFaces, IdentifyFaces, VerifyFaces
+from .speech import SpeechToText
+from .anomaly import DetectAnomalies, DetectLastAnomaly, SimpleDetectAnomalies
+from .bing import BingImageSearch
+from .search import AddDocuments, AzureSearchWriter
+
+__all__ = [
+    "AddDocuments", "AnalyzeImage", "AzureSearchWriter", "BingImageSearch",
+    "CognitiveServicesBase", "DescribeImage", "DetectAnomalies",
+    "DetectFace", "DetectLastAnomaly", "EntityDetector", "FindSimilarFace",
+    "GenerateThumbnails", "GroupFaces", "HasServiceParams", "IdentifyFaces",
+    "KeyPhraseExtractor", "LanguageDetector", "NER", "OCR",
+    "RecognizeDomainSpecificContent", "RecognizeText", "SimpleDetectAnomalies",
+    "SpeechToText", "TagImage", "TextSentiment", "VerifyFaces",
+]

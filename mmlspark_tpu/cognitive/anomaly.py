@@ -1,0 +1,94 @@
+"""Anomaly-detector services (reference cognitive/AnamolyDetection.scala:117-160)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, ServiceParam
+from .base import CognitiveServicesBase
+
+
+class _AnomalyBase(CognitiveServicesBase):
+    series = ServiceParam("series", "Timestamped points [{timestamp,value}...]")
+    granularity = ServiceParam("granularity", "hourly/daily/...")
+    maxAnomalyRatio = ServiceParam("maxAnomalyRatio", "Max anomaly fraction")
+    sensitivity = ServiceParam("sensitivity", "Detection sensitivity")
+    customInterval = ServiceParam("customInterval", "Custom interval")
+    period = ServiceParam("period", "Seasonality period")
+    _service_param_names = ["series", "granularity", "maxAnomalyRatio",
+                            "sensitivity", "customInterval", "period"]
+
+    def _build_entity(self, vals):
+        series = vals.get("series") or []
+        clean = []
+        for pt in series:
+            if isinstance(pt, dict):
+                clean.append({"timestamp": str(pt.get("timestamp")),
+                              "value": float(pt.get("value"))})
+        body: Dict[str, Any] = {"series": clean,
+                                "granularity": str(vals.get("granularity",
+                                                            "daily"))}
+        for k in ("maxAnomalyRatio", "sensitivity", "period"):
+            if vals.get(k) is not None:
+                body[k] = vals[k]
+        if vals.get("customInterval") is not None:
+            body["customInterval"] = int(vals["customInterval"])
+        return json.dumps(body).encode("utf-8")
+
+
+class DetectAnomalies(_AnomalyBase):
+    """Batch anomaly detection over a whole series column."""
+
+
+class DetectLastAnomaly(_AnomalyBase):
+    """Detect whether the latest point is anomalous."""
+
+
+class SimpleDetectAnomalies(_AnomalyBase):
+    """Grouped convenience: rows (group, timestamp, value) -> per-row anomaly
+    flags (AnamolyDetection.scala SimpleDetectAnomalies)."""
+
+    groupbyCol = Param("groupbyCol", "Series-grouping column", None, ptype=str)
+    timestampCol = Param("timestampCol", "Timestamp column", "timestamp", ptype=str)
+    valueCol = Param("valueCol", "Value column", "value", ptype=str)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        group_col = self.get_or_throw("groupbyCol")
+        ts_col, val_col = self.get("timestampCol"), self.get("valueCol")
+        out_col = self.get_or_throw("outputCol")
+        data = df.collect()
+        groups = data[group_col]
+        n = len(groups)
+        by_group: Dict[Any, List[int]] = {}
+        for i, g in enumerate(groups):
+            by_group.setdefault(g, []).append(i)
+
+        # ONE request per group (reference SimpleDetectAnomalies behavior)
+        keys = list(by_group)
+        series_col = np.empty(len(keys), dtype=object)
+        for gi, g in enumerate(keys):
+            series_col[gi] = [{"timestamp": str(data[ts_col][i]),
+                               "value": float(data[val_col][i])}
+                              for i in by_group[g]]
+        group_df = DataFrame([{"__series__": series_col}])
+        inner = DetectAnomalies(
+            outputCol=out_col, errorCol=self.get("errorCol"),
+            url=self.get("url"), handler=self.get("handler"))
+        inner._param_map.update({k: v for k, v in self._param_map.items()
+                                 if inner.has_param(k) and k not in (
+                                     "outputCol", "errorCol", "url", "handler")})
+        inner.set_col("series", "__series__")
+        res = inner.transform(group_df).collect()[out_col]
+
+        # scatter per-row anomaly flags back by position within the group
+        flags = np.empty(n, dtype=object)
+        for gi, g in enumerate(keys):
+            arr = (res[gi] or {}).get("isAnomaly")
+            for pos, i in enumerate(by_group[g]):
+                flags[i] = (bool(arr[pos]) if arr is not None
+                            and pos < len(arr) else None)
+        return df.with_column(out_col, flags)

@@ -1,0 +1,187 @@
+"""Cognitive service base stage.
+
+Reference: cognitive/CognitiveServiceBase.scala:29-151 — a SimpleHTTPTransformer
+pipeline parameterized by ServiceParams (each holding a literal value or an
+input-column name), subscription-key header injection, URL building, and
+optional async polling on Operation-Location (RecognizeText pattern,
+cognitive/ComputerVision.scala:165-260).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, HasOutputCol, Param, ServiceParam
+from ..core.pipeline import Transformer
+from ..core.schema import ColType, Schema
+from ..io.http import HTTPRequestData, HTTPResponseData, send_with_retries
+
+
+class HasServiceParams(Transformer):
+    """Helpers to resolve ServiceParams per row."""
+
+    def _service_values(self, part, i, names: List[str]) -> Dict[str, Any]:
+        out = {}
+        for name in names:
+            v = self.get_service_value(name, part, i)
+            if v is not None:
+                out[name] = v
+        return out
+
+
+class CognitiveServicesBase(HasServiceParams, HasOutputCol):
+    """POST JSON (or binary) per row; parse the JSON response into a struct col."""
+
+    subscriptionKey = ServiceParam("subscriptionKey", "API subscription key")
+    url = Param("url", "Service endpoint URL", None, ptype=str)
+    errorCol = Param("errorCol", "Error column", "errors", ptype=str)
+    concurrency = Param("concurrency", "Concurrent requests", 1, ptype=int)
+    timeout = Param("timeout", "Request timeout (s)", 60.0, ptype=float)
+    handler = ComplexParam("handler", "Injected (HTTPRequestData)->HTTPResponseData")
+    pollingDelayMs = Param("pollingDelayMs", "Async poll interval", 300, ptype=int)
+    maxPollingRetries = Param("maxPollingRetries", "Async poll attempts", 100,
+                              ptype=int)
+
+    # subclasses set these
+    _service_param_names: List[str] = []
+    _is_async = False          # Operation-Location polling (RecognizeText)
+    _method = "POST"
+
+    def set_subscription_key(self, key: str):
+        return self.set_scalar("subscriptionKey", key)
+
+    def set_url(self, url: str):
+        return self.set("url", url)
+
+    def set_location_url(self, location: str, path: str):
+        return self.set("url",
+                        f"https://{location}.api.cognitive.microsoft.com{path}")
+
+    # -- request building (subclasses may override) ----------------------
+    def _url_params(self, vals: Dict[str, Any]) -> Dict[str, str]:
+        return {}
+
+    def _build_entity(self, vals: Dict[str, Any]) -> bytes:
+        body = {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                for k, v in vals.items() if k not in ("subscriptionKey",)}
+        return json.dumps(body).encode("utf-8")
+
+    def _content_type(self, vals: Dict[str, Any]) -> str:
+        return "application/json"
+
+    def _validate(self, vals: Dict[str, Any]) -> None:
+        """Hook: raise if required params are missing (error lands in errorCol)."""
+
+    def _build_request(self, part, i) -> Optional[HTTPRequestData]:
+        from urllib.parse import quote, urlencode
+
+        vals = self._service_values(
+            part, i, self._service_param_names + ["subscriptionKey"])
+        self._validate(vals)
+        url = self.get_or_throw("url")
+        q = self._url_params(vals)
+        if q:
+            sep = "&" if "?" in url else "?"
+            # commas stay literal (Azure comma-separated feature lists)
+            url = url + sep + urlencode(
+                q, quote_via=lambda v, safe="", enc=None, err=None:
+                quote(v, safe=","))
+        headers = {}
+        if self._method != "GET":
+            headers["Content-Type"] = self._content_type(vals)
+        key = vals.get("subscriptionKey")
+        if key:
+            headers["Ocp-Apim-Subscription-Key"] = str(key)
+        entity = self._build_entity(vals) if self._method != "GET" else None
+        return HTTPRequestData(url=url, method=self._method, headers=headers,
+                               entity=entity)
+
+    # -- async polling (ComputerVision.scala RecognizeText pattern) -------
+    def _poll(self, resp: HTTPResponseData, headers: Dict[str, str],
+              handler) -> HTTPResponseData:
+        loc = None
+        if resp.headers:
+            loc = resp.headers.get("Operation-Location") \
+                or resp.headers.get("operation-location")
+        if not loc:
+            return resp
+        delay = self.get("pollingDelayMs") / 1000.0
+        for _ in range(self.get("maxPollingRetries")):
+            time.sleep(delay)
+            poll = handler(HTTPRequestData(url=loc, method="GET",
+                                           headers=dict(headers)))
+            if poll.statusCode != 200 or poll.entity is None:
+                continue
+            obj = json.loads(poll.entity.decode("utf-8"))
+            status = str(obj.get("status", "")).lower()
+            if status in ("succeeded", "failed"):
+                return poll
+        return resp
+
+    def _parse_success(self, resp: HTTPResponseData) -> Any:
+        """Hook: map a 200 response to the output value (default: JSON body)."""
+        return json.loads(resp.entity.decode("utf-8"))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        out_col = self.get_or_throw("outputCol")
+        err_col = self.get("errorCol")
+        handler = self.get("handler") or (
+            lambda r: send_with_retries(r, timeout=self.get("timeout")))
+
+        def fn(part):
+            names = list(part)
+            n = len(part[names[0]]) if names else 0
+            out = np.empty(n, dtype=object)
+            errs = np.empty(n, dtype=object)
+            for i in range(n):
+                try:
+                    req = self._build_request(part, i)
+                except Exception as e:
+                    out[i], errs[i] = None, f"request build failed: {e}"
+                    continue
+                if req is None:
+                    out[i] = errs[i] = None
+                    continue
+                resp = handler(req)
+                if self._is_async and resp.statusCode in (200, 202):
+                    resp = self._poll(resp, req.headers or {}, handler)
+                if resp.statusCode == 200 and resp.entity is not None:
+                    try:
+                        out[i] = self._parse_success(resp)
+                        errs[i] = None
+                    except Exception as e:
+                        out[i], errs[i] = None, f"parse failed: {e}"
+                else:
+                    out[i] = None
+                    errs[i] = f"{resp.statusCode}: {resp.statusLine}"
+            part[out_col] = out
+            if err_col:
+                part[err_col] = errs
+            return part
+
+        return df.map_partitions(fn)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        out = schema.copy()
+        out.types[self.get_or_throw("outputCol")] = ColType.STRUCT
+        return out
+
+
+class DocumentsBase(CognitiveServicesBase):
+    """Text-analytics batch format: rows -> {documents: [{id, text, language}]}
+    (cognitive/TextAnalytics.scala:171-230)."""
+
+    text = ServiceParam("text", "Input text (value or column)")
+    language = ServiceParam("language", "Language hint (value or column)")
+    _service_param_names = ["text", "language"]
+
+    def _build_entity(self, vals: Dict[str, Any]) -> bytes:
+        doc = {"id": "0", "text": str(vals.get("text", ""))}
+        if vals.get("language"):
+            doc["language"] = str(vals["language"])
+        return json.dumps({"documents": [doc]}).encode("utf-8")

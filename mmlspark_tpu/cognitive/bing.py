@@ -1,0 +1,47 @@
+"""Bing image search (reference cognitive/BingImageSearch.scala)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ServiceParam
+from .base import CognitiveServicesBase
+
+
+class BingImageSearch(CognitiveServicesBase):
+    """Query -> image search results (GET with q= param)."""
+
+    q = ServiceParam("q", "Search query (value or column)")
+    count = ServiceParam("count", "Results per query")
+    offset = ServiceParam("offset", "Result offset")
+    imageType = ServiceParam("imageType", "photo/clipart/...")
+    _service_param_names = ["q", "count", "offset", "imageType"]
+    _method = "GET"
+
+    def _url_params(self, vals):
+        q = {"q": str(vals.get("q", ""))}
+        for k in ("count", "offset"):
+            if vals.get(k) is not None:
+                q[k] = str(int(vals[k]))
+        if vals.get("imageType"):
+            q["imageType"] = str(vals["imageType"])
+        return q
+
+    @staticmethod
+    def get_url_transformer(image_col: str, url_col: str):
+        """Extract contentUrl list from search results (reference helper)."""
+        from ..core.pipeline import Transformer
+        from ..stages.basic import UDFTransformer
+
+        def extract(v):
+            if v is None:
+                return None
+            return [img.get("contentUrl") for img in v.get("value", [])]
+
+        t = UDFTransformer(inputCol=image_col, outputCol=url_col)
+        t.set("udf", extract)
+        return t

@@ -1,0 +1,77 @@
+"""Face services (reference cognitive/Face.scala:18-280)."""
+
+from __future__ import annotations
+
+import json
+
+from ..core.params import ServiceParam
+from .base import CognitiveServicesBase
+from .vision import _ImageInputBase
+
+
+class DetectFace(_ImageInputBase):
+    """Face detection with attributes (Face.scala DetectFace)."""
+
+    returnFaceId = ServiceParam("returnFaceId", "Include face ids")
+    returnFaceLandmarks = ServiceParam("returnFaceLandmarks", "Include landmarks")
+    returnFaceAttributes = ServiceParam("returnFaceAttributes",
+                                        "Attribute list (age,gender,...)")
+    _service_param_names = ["imageUrl", "imageBytes", "returnFaceId",
+                            "returnFaceLandmarks", "returnFaceAttributes"]
+
+    def _url_params(self, vals):
+        q = {}
+        if vals.get("returnFaceId") is not None:
+            q["returnFaceId"] = str(bool(vals["returnFaceId"])).lower()
+        if vals.get("returnFaceLandmarks") is not None:
+            q["returnFaceLandmarks"] = str(bool(vals["returnFaceLandmarks"])).lower()
+        attrs = vals.get("returnFaceAttributes")
+        if attrs:
+            q["returnFaceAttributes"] = (",".join(attrs)
+                                         if isinstance(attrs, (list, tuple))
+                                         else str(attrs))
+        return q
+
+
+class FindSimilarFace(CognitiveServicesBase):
+    """Find similar faces from a face list (Face.scala FindSimilar)."""
+
+    faceId = ServiceParam("faceId", "Query face id")
+    faceIds = ServiceParam("faceIds", "Candidate face ids")
+    faceListId = ServiceParam("faceListId", "Face list id")
+    maxNumOfCandidatesReturned = ServiceParam("maxNumOfCandidatesReturned",
+                                              "Max candidates")
+    mode = ServiceParam("mode", "matchPerson | matchFace")
+    _service_param_names = ["faceId", "faceIds", "faceListId",
+                            "maxNumOfCandidatesReturned", "mode"]
+
+
+class GroupFaces(CognitiveServicesBase):
+    """Group face ids by similarity (Face.scala Group)."""
+
+    faceIds = ServiceParam("faceIds", "Face ids to group")
+    _service_param_names = ["faceIds"]
+
+
+class IdentifyFaces(CognitiveServicesBase):
+    """Identify faces against a person group (Face.scala Identify)."""
+
+    faceIds = ServiceParam("faceIds", "Face ids")
+    personGroupId = ServiceParam("personGroupId", "Person group")
+    maxNumOfCandidatesReturned = ServiceParam("maxNumOfCandidatesReturned",
+                                              "Max candidates")
+    confidenceThreshold = ServiceParam("confidenceThreshold", "Min confidence")
+    _service_param_names = ["faceIds", "personGroupId",
+                            "maxNumOfCandidatesReturned", "confidenceThreshold"]
+
+
+class VerifyFaces(CognitiveServicesBase):
+    """Verify two faces belong to the same person (Face.scala Verify)."""
+
+    faceId1 = ServiceParam("faceId1", "First face id")
+    faceId2 = ServiceParam("faceId2", "Second face id")
+    faceId = ServiceParam("faceId", "Face id (vs person)")
+    personGroupId = ServiceParam("personGroupId", "Person group")
+    personId = ServiceParam("personId", "Person id")
+    _service_param_names = ["faceId1", "faceId2", "faceId", "personGroupId",
+                            "personId"]

@@ -1,0 +1,32 @@
+"""Speech-to-text service (reference cognitive/SpeechToText.scala:22-100)."""
+
+from __future__ import annotations
+
+from ..core.params import ServiceParam
+from .base import CognitiveServicesBase
+
+
+class SpeechToText(CognitiveServicesBase):
+    """Audio bytes -> transcription."""
+
+    audioData = ServiceParam("audioData", "Audio bytes (value or column)")
+    language = ServiceParam("language", "Spoken language")
+    format = ServiceParam("format", "simple | detailed")
+    profanity = ServiceParam("profanity", "masked | removed | raw")
+    _service_param_names = ["audioData", "language", "format", "profanity"]
+
+    def _content_type(self, vals):
+        return "audio/wav; codec=audio/pcm; samplerate=16000"
+
+    def _build_entity(self, vals):
+        return bytes(vals.get("audioData", b""))
+
+    def _url_params(self, vals):
+        q = {}
+        if vals.get("language"):
+            q["language"] = str(vals["language"])
+        if vals.get("format"):
+            q["format"] = str(vals["format"])
+        if vals.get("profanity"):
+            q["profanity"] = str(vals["profanity"])
+        return q

@@ -1,0 +1,25 @@
+"""Text-analytics services (reference cognitive/TextAnalytics.scala:171-230)."""
+
+from .base import DocumentsBase
+
+
+class TextSentiment(DocumentsBase):
+    """Sentiment scoring per document."""
+
+
+class LanguageDetector(DocumentsBase):
+    """Language detection (no language hint input)."""
+
+    _service_param_names = ["text"]
+
+
+class EntityDetector(DocumentsBase):
+    """Linked-entity detection."""
+
+
+class NER(DocumentsBase):
+    """Named-entity recognition."""
+
+
+class KeyPhraseExtractor(DocumentsBase):
+    """Key-phrase extraction."""

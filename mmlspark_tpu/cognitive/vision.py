@@ -1,0 +1,122 @@
+"""Computer-vision services (reference cognitive/ComputerVision.scala:165-520)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+from ..core.params import Param, ServiceParam
+from .base import CognitiveServicesBase
+
+
+class _ImageInputBase(CognitiveServicesBase):
+    """Accepts an image URL (JSON body) or raw bytes (octet-stream body)."""
+
+    imageUrl = ServiceParam("imageUrl", "Image URL (value or column)")
+    imageBytes = ServiceParam("imageBytes", "Raw image bytes (value or column)")
+    _service_param_names = ["imageUrl", "imageBytes"]
+
+    def _validate(self, vals):
+        if vals.get("imageUrl") is None and vals.get("imageBytes") is None:
+            raise ValueError("one of imageUrl/imageBytes is required")
+
+    def _content_type(self, vals):
+        return ("application/octet-stream" if vals.get("imageBytes") is not None
+                else "application/json")
+
+    def _build_entity(self, vals):
+        if vals.get("imageBytes") is not None:
+            return bytes(vals["imageBytes"])
+        return json.dumps({"url": str(vals.get("imageUrl", ""))}).encode("utf-8")
+
+
+class OCR(_ImageInputBase):
+    """Printed-text OCR (ComputerVision.scala OCR)."""
+
+    detectOrientation = ServiceParam("detectOrientation", "Detect text orientation")
+    language = ServiceParam("language", "Language hint")
+    _service_param_names = ["imageUrl", "imageBytes", "detectOrientation",
+                            "language"]
+
+    def _url_params(self, vals):
+        q = {}
+        if vals.get("language"):
+            q["language"] = str(vals["language"])
+        if vals.get("detectOrientation") is not None:
+            q["detectOrientation"] = str(bool(vals["detectOrientation"])).lower()
+        return q
+
+
+class RecognizeText(_ImageInputBase):
+    """Async handwritten/printed text recognition with Operation-Location
+    polling (ComputerVision.scala:165-260)."""
+
+    mode = ServiceParam("mode", "'Printed' or 'Handwritten'")
+    _service_param_names = ["imageUrl", "imageBytes", "mode"]
+    _is_async = True
+
+    def _url_params(self, vals):
+        return {"mode": str(vals["mode"])} if vals.get("mode") else {}
+
+
+class AnalyzeImage(_ImageInputBase):
+    """Full image analysis (ComputerVision.scala AnalyzeImage)."""
+
+    visualFeatures = ServiceParam("visualFeatures", "Comma/list of features")
+    details = ServiceParam("details", "Detail domains")
+    language = ServiceParam("language", "Result language")
+    _service_param_names = ["imageUrl", "imageBytes", "visualFeatures",
+                            "details", "language"]
+
+    def _url_params(self, vals):
+        q = {}
+        for name, key in (("visualFeatures", "visualFeatures"),
+                          ("details", "details"), ("language", "language")):
+            v = vals.get(name)
+            if v is not None:
+                q[key] = ",".join(v) if isinstance(v, (list, tuple)) else str(v)
+        return q
+
+
+class TagImage(_ImageInputBase):
+    """Image tagging (ComputerVision.scala TagImage)."""
+
+
+class DescribeImage(_ImageInputBase):
+    """Caption generation (ComputerVision.scala DescribeImage)."""
+
+    maxCandidates = ServiceParam("maxCandidates", "Caption candidates")
+    _service_param_names = ["imageUrl", "imageBytes", "maxCandidates"]
+
+    def _url_params(self, vals):
+        if vals.get("maxCandidates") is not None:
+            return {"maxCandidates": str(int(vals["maxCandidates"]))}
+        return {}
+
+
+class GenerateThumbnails(_ImageInputBase):
+    """Smart-cropped thumbnails (ComputerVision.scala GenerateThumbnails).
+    Response is binary image bytes, not JSON."""
+
+    width = ServiceParam("width", "Thumbnail width")
+    height = ServiceParam("height", "Thumbnail height")
+    smartCropping = ServiceParam("smartCropping", "Enable smart cropping")
+    _service_param_names = ["imageUrl", "imageBytes", "width", "height",
+                            "smartCropping"]
+
+    def _url_params(self, vals):
+        q = {"width": str(int(vals.get("width", 64))),
+             "height": str(int(vals.get("height", 64)))}
+        if vals.get("smartCropping") is not None:
+            q["smartCropping"] = str(bool(vals["smartCropping"])).lower()
+        return q
+
+    def _parse_success(self, resp):
+        return resp.entity  # binary thumbnail bytes, not JSON
+
+
+class RecognizeDomainSpecificContent(_ImageInputBase):
+    """Domain models, e.g. celebrities/landmarks (ComputerVision.scala:470-520)."""
+
+    model = ServiceParam("model", "Domain model name")
+    _service_param_names = ["imageUrl", "imageBytes", "model"]

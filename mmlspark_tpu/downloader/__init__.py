@@ -1,0 +1,19 @@
+"""Model downloader / repository (reference downloader/ package).
+
+ModelDownloader manages repositories of pretrained models with JSON ``.meta``
+schemas, sha256 verification, and retry-with-timeout fault tolerance
+(downloader/ModelDownloader.scala:27-120, downloader/Schema.scala:24-100).
+Repos are local directories or HTTP bases (remote fetch goes through the
+retrying HTTP client). ModelSchema carries ``layerNames`` for ImageFeaturizer's
+cutOutputLayers, exactly like the reference's schema feeds setModel.
+"""
+
+from .downloader import (
+    FaultToleranceUtils,
+    ModelDownloader,
+    ModelNotFoundError,
+    ModelSchema,
+)
+
+__all__ = ["FaultToleranceUtils", "ModelDownloader", "ModelNotFoundError",
+           "ModelSchema"]

@@ -1,0 +1,213 @@
+"""Model repository with .meta schemas, hashing, and retries."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from ..models.module import FunctionModel
+
+
+class ModelNotFoundError(KeyError):
+    pass
+
+
+@dataclasses.dataclass
+class ModelSchema:
+    """Model metadata (.meta JSON) — downloader/Schema.scala:24-100 parity."""
+
+    name: str
+    uri: str                         # model payload location (dir or URL)
+    hash: Optional[str] = None       # sha256 of the payload archive
+    size: int = 0
+    inputNode: str = "ARGUMENT_0"
+    numLayers: int = 0
+    layerNames: List[str] = dataclasses.field(default_factory=list)
+    modelType: str = "image"
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=1)
+
+    @staticmethod
+    def from_json(s: str) -> "ModelSchema":
+        return ModelSchema(**json.loads(s))
+
+
+class FaultToleranceUtils:
+    """retryWithTimeout parity (downloader/ModelDownloader.scala:37-47)."""
+
+    @staticmethod
+    def retry_with_timeout(fn: Callable[[], Any], retries: int = 3,
+                           timeout_s: float = 60.0,
+                           backoff_s: float = 1.0) -> Any:
+        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import TimeoutError as FutureTimeout
+
+        last: Optional[Exception] = None
+        for attempt in range(retries):
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                future = pool.submit(fn)
+                try:
+                    return future.result(timeout=timeout_s)
+                except FutureTimeout as e:
+                    future.cancel()
+                    last = TimeoutError(f"operation exceeded {timeout_s}s")
+                except Exception as e:  # noqa: BLE001 — retry any failure
+                    last = e
+            time.sleep(backoff_s * (2 ** attempt))
+        raise last  # type: ignore[misc]
+
+
+def _sha256_dir(path: str) -> str:
+    """Stable content hash of a file or directory tree."""
+    h = hashlib.sha256()
+    if os.path.isfile(path):
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            h.update(os.path.relpath(full, path).encode())
+            with open(full, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+    return h.hexdigest()
+
+
+class ModelDownloader:
+    """Fetch models from a repo into a local cache, verified and retried.
+
+    ``repo``: local directory holding ``<name>.meta`` files (+ payload dirs),
+    or an ``http(s)://`` base URL (fetched through the retrying client —
+    unavailable in egress-less environments, error surfaces clearly).
+    """
+
+    def __init__(self, local_path: str, repo: Optional[str] = None):
+        self.local_path = local_path
+        self.repo = repo
+        os.makedirs(local_path, exist_ok=True)
+
+    # -- listing ---------------------------------------------------------
+    def get_models(self) -> Iterator[ModelSchema]:
+        """Iterate schemas in the remote/local repo (ModelDownloader.getModels)."""
+        if self.repo is None or self.repo.startswith(("http://", "https://")):
+            if self.repo is not None:
+                raise RuntimeError(
+                    "remote repo listing requires network access; use a local repo")
+            return iter(())
+        metas = [f for f in sorted(os.listdir(self.repo)) if f.endswith(".meta")]
+
+        def gen():
+            for m in metas:
+                with open(os.path.join(self.repo, m)) as f:
+                    yield ModelSchema.from_json(f.read())
+
+        return gen()
+
+    def local_models(self) -> Iterator[ModelSchema]:
+        metas = [f for f in sorted(os.listdir(self.local_path))
+                 if f.endswith(".meta")]
+        for m in metas:
+            with open(os.path.join(self.local_path, m)) as f:
+                yield ModelSchema.from_json(f.read())
+
+    # -- fetch -----------------------------------------------------------
+    def download_model(self, schema_or_name) -> ModelSchema:
+        """Copy a model into the local cache; verify sha256; idempotent
+        (ModelDownloader.downloadModel / downloadByName)."""
+        schema = (schema_or_name if isinstance(schema_or_name, ModelSchema)
+                  else self._find(schema_or_name))
+        dest = os.path.join(self.local_path, schema.name)
+        meta_dest = os.path.join(self.local_path, f"{schema.name}.meta")
+        if os.path.exists(dest) and os.path.exists(meta_dest):
+            if not schema.hash or _sha256_dir(dest) == schema.hash:
+                return self._localized(schema, dest)
+        src = schema.uri
+        if src.startswith(("http://", "https://")):
+            raise RuntimeError(
+                f"remote model fetch for {schema.name!r} requires network access")
+
+        def copy():
+            if os.path.exists(dest):
+                shutil.rmtree(dest) if os.path.isdir(dest) else os.remove(dest)
+            if os.path.isdir(src):
+                shutil.copytree(src, dest)
+            else:
+                shutil.copy(src, dest)
+            if schema.hash:
+                got = _sha256_dir(dest)
+                if got != schema.hash:
+                    raise IOError(
+                        f"hash mismatch for {schema.name}: {got} != {schema.hash}")
+            return dest
+
+        FaultToleranceUtils.retry_with_timeout(copy, retries=3)
+        local = self._localized(schema, dest)
+        with open(meta_dest, "w") as f:
+            f.write(local.to_json())
+        return local
+
+    def download_by_name(self, name: str) -> ModelSchema:
+        return self.download_model(name)
+
+    def _find(self, name: str) -> ModelSchema:
+        for s in self.get_models():
+            if s.name == name:
+                return s
+        raise ModelNotFoundError(f"No model named {name!r} in repo {self.repo!r}")
+
+    @staticmethod
+    def _localized(schema: ModelSchema, dest: str) -> ModelSchema:
+        return dataclasses.replace(schema, uri=dest)
+
+    # -- model payload handling -----------------------------------------
+    @staticmethod
+    def save_function_model(model: FunctionModel, path: str,
+                            name: Optional[str] = None) -> ModelSchema:
+        """Persist a FunctionModel as a repo payload + schema."""
+        from ..core.serialize import _save_value
+
+        os.makedirs(path, exist_ok=True)
+        manifest = _save_value(model.params, os.path.join(path, "params"))
+        import pickle
+
+        with open(os.path.join(path, "module.pkl"), "wb") as f:
+            pickle.dump(model.module, f)
+        info = {
+            "params_manifest": manifest,
+            "input_shape": list(model.input_shape),
+            "layer_names": list(model.layer_names),
+            "name": name or model.name,
+        }
+        with open(os.path.join(path, "model.json"), "w") as f:
+            json.dump(info, f)
+        return ModelSchema(
+            name=name or model.name, uri=path, hash=_sha256_dir(path),
+            inputNode="ARGUMENT_0", numLayers=len(model.layer_names),
+            layerNames=list(model.layer_names))
+
+    @staticmethod
+    def load_function_model(schema_or_path) -> FunctionModel:
+        from ..core.serialize import _load_value
+
+        path = (schema_or_path.uri if isinstance(schema_or_path, ModelSchema)
+                else schema_or_path)
+        with open(os.path.join(path, "model.json")) as f:
+            info = json.load(f)
+        import pickle
+
+        with open(os.path.join(path, "module.pkl"), "rb") as f:
+            module = pickle.load(f)
+        params = _load_value(info["params_manifest"], os.path.join(path, "params"))
+        return FunctionModel(module=module, params=params,
+                             input_shape=tuple(info["input_shape"]),
+                             layer_names=info["layer_names"],
+                             name=info["name"])

@@ -1,0 +1,32 @@
+"""IO layer: binary/image readers, HTTP client stack, writers (reference io/).
+
+Readers produce partitioned DataFrames of file bytes / decoded images
+(io/binary/BinaryFileFormat.scala, io/image/ImageUtils.scala); the HTTP stack
+turns web services into pipeline stages (io/http/*); PowerBIWriter streams
+DataFrames to the PowerBI REST API.
+"""
+
+from .binary import BinaryFileReader, read_binary_files
+from .image import read_images, to_image_column
+from .http import (
+    HTTPRequestData,
+    HTTPResponseData,
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    PartitionConsolidator,
+    SharedSingleton,
+    SharedVariable,
+    SimpleHTTPTransformer,
+    StringOutputParser,
+    send_with_retries,
+)
+from .powerbi import PowerBIWriter
+
+__all__ = [
+    "BinaryFileReader", "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
+    "JSONInputParser", "JSONOutputParser", "PartitionConsolidator",
+    "PowerBIWriter", "SharedSingleton", "SharedVariable", "SimpleHTTPTransformer",
+    "StringOutputParser", "read_binary_files", "read_images", "send_with_retries",
+    "to_image_column",
+]

@@ -1,0 +1,339 @@
+"""HTTP-on-pipeline: typed request/response schema, clients, transformer stages.
+
+Reference stack (io/http/):
+  - HTTPSchema.scala:1-342           -> HTTPRequestData / HTTPResponseData
+  - Clients.scala:1-63 + HTTPClients.scala:64-150 -> send_with_retries
+    (status-aware retry incl. 429 Retry-After sleep)
+  - HTTPTransformer.scala:79-129     -> HTTPTransformer (request col ->
+    response col, shared client per partition, bounded concurrency)
+  - SimpleHTTPTransformer.scala:1-166 + Parsers.scala:1-271 ->
+    SimpleHTTPTransformer with JSON/Custom/String parsers + error column
+  - SharedVariable.scala:1-65        -> SharedVariable / SharedSingleton
+  - PartitionConsolidator.scala:19-132 -> PartitionConsolidator
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, HasInputCol, HasOutputCol, Param
+from ..core.pipeline import Transformer
+from ..core.schema import Binding, ColType, Schema
+
+# ---------------------------------------------------------------------------
+# Schema (HTTPSchema.scala parity)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HTTPRequestData:
+    url: str
+    method: str = "GET"
+    headers: Optional[Dict[str, str]] = None
+    entity: Optional[bytes] = None
+
+    def to_row(self) -> Dict[str, Any]:
+        return Binding.to_row(self)
+
+    @staticmethod
+    def from_row(row: Dict[str, Any]) -> "HTTPRequestData":
+        return Binding.from_row(HTTPRequestData, row)
+
+
+@dataclasses.dataclass
+class HTTPResponseData:
+    statusCode: int
+    statusLine: str = ""
+    entity: Optional[bytes] = None
+    headers: Optional[Dict[str, str]] = None
+
+    def to_row(self) -> Dict[str, Any]:
+        return Binding.to_row(self)
+
+    @staticmethod
+    def from_row(row: Dict[str, Any]) -> "HTTPResponseData":
+        return Binding.from_row(HTTPResponseData, row)
+
+
+# ---------------------------------------------------------------------------
+# Client with retries (HandlingUtils.sendWithRetries parity)
+# ---------------------------------------------------------------------------
+
+
+RETRYABLE_CODES = {403, 408, 429, 500, 502, 503, 504}
+
+
+def send_request(req: HTTPRequestData, timeout: float = 60.0) -> HTTPResponseData:
+    r = urllib.request.Request(req.url, data=req.entity,
+                               headers=req.headers or {},
+                               method=req.method or "GET")
+    try:
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return HTTPResponseData(
+                statusCode=resp.status,
+                statusLine=getattr(resp, "reason", "") or "",
+                entity=resp.read(),
+                headers=dict(resp.headers.items()))
+    except urllib.error.HTTPError as e:
+        return HTTPResponseData(statusCode=e.code, statusLine=str(e.reason),
+                                entity=e.read() if e.fp else None,
+                                headers=dict(e.headers.items()) if e.headers else {})
+    except Exception as e:  # connection errors -> 0 status (retryable)
+        return HTTPResponseData(statusCode=0, statusLine=str(e))
+
+
+def send_with_retries(req: HTTPRequestData, retry_backoffs_ms=(100, 500, 1000),
+                      timeout: float = 60.0,
+                      sleep_fn: Callable[[float], None] = time.sleep
+                      ) -> HTTPResponseData:
+    """Status-aware retry: retryable codes back off; 429 honors Retry-After
+    (io/http/HTTPClients.scala:73-117)."""
+    resp = send_request(req, timeout)
+    for backoff_ms in retry_backoffs_ms:
+        if resp.statusCode == 200 or resp.statusCode not in RETRYABLE_CODES | {0}:
+            return resp
+        wait = backoff_ms / 1000.0
+        if resp.statusCode == 429 and resp.headers:
+            ra = resp.headers.get("Retry-After") or resp.headers.get("retry-after")
+            if ra:
+                try:
+                    wait = float(ra)
+                except ValueError:
+                    pass
+        sleep_fn(wait)
+        resp = send_request(req, timeout)
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# Shared per-process singletons (SharedVariable.scala parity)
+# ---------------------------------------------------------------------------
+
+
+class SharedVariable:
+    """Lazily-initialized per-process singleton (one instance per holder)."""
+
+    def __init__(self, factory: Callable[[], Any]):
+        self._factory = factory
+        self._value = None
+        self._init = False
+        self._lock = threading.Lock()
+
+    def get(self) -> Any:
+        if not self._init:
+            with self._lock:
+                if not self._init:
+                    self._value = self._factory()
+                    self._init = True
+        return self._value
+
+
+class SharedSingleton:
+    """Process-wide keyed singletons."""
+
+    _instances: Dict[str, Any] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_or_create(cls, key: str, factory: Callable[[], Any]) -> Any:
+        if key not in cls._instances:
+            with cls._lock:
+                if key not in cls._instances:
+                    cls._instances[key] = factory()
+        return cls._instances[key]
+
+
+# ---------------------------------------------------------------------------
+# HTTPTransformer (request col -> response col)
+# ---------------------------------------------------------------------------
+
+
+class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Each input row holds an HTTPRequestData (or its dict row form); output
+    rows hold HTTPResponseData dicts (HTTPTransformer.scala:79-129)."""
+
+    concurrency = Param("concurrency", "Concurrent requests per partition", 1,
+                        lambda v: v > 0, int)
+    timeout = Param("timeout", "Per-request timeout (s)", 60.0, ptype=float)
+    handler = ComplexParam("handler", "Custom (request) -> response callable")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get_or_throw("inputCol")
+        out_col = self.get_or_throw("outputCol")
+        conc = self.get("concurrency")
+        timeout = self.get("timeout")
+        handler = self.get("handler") or (
+            lambda r: send_with_retries(r, timeout=timeout))
+
+        def fn(p):
+            col = p[in_col]
+            reqs = [None if v is None else
+                    (v if isinstance(v, HTTPRequestData)
+                     else HTTPRequestData.from_row(v)) for v in col]
+            out = np.empty(len(reqs), dtype=object)
+
+            def run(i_req):
+                i, r = i_req
+                return i, (None if r is None else handler(r))
+
+            if conc > 1:
+                with ThreadPoolExecutor(max_workers=conc) as pool:
+                    for i, resp in pool.map(run, enumerate(reqs)):
+                        out[i] = resp.to_row() if resp is not None else None
+            else:
+                for i, r in enumerate(reqs):
+                    out[i] = handler(r).to_row() if r is not None else None
+            return out
+
+        return df.with_column(out_col, fn)
+
+
+# ---------------------------------------------------------------------------
+# Parsers (Parsers.scala parity)
+# ---------------------------------------------------------------------------
+
+
+class JSONInputParser:
+    """Row dict -> POST request with JSON body (JSONInputParser)."""
+
+    def __init__(self, url: str, headers: Optional[Dict[str, str]] = None,
+                 method: str = "POST"):
+        self.url = url
+        self.headers = {"Content-Type": "application/json", **(headers or {})}
+        self.method = method
+
+    def parse(self, row: Dict[str, Any]) -> HTTPRequestData:
+        clean = {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                 for k, v in row.items()}
+        return HTTPRequestData(url=self.url, method=self.method,
+                               headers=dict(self.headers),
+                               entity=json.dumps(clean).encode("utf-8"))
+
+
+class CustomInputParser:
+    def __init__(self, fn: Callable[[Dict[str, Any]], HTTPRequestData]):
+        self.fn = fn
+
+    def parse(self, row: Dict[str, Any]) -> HTTPRequestData:
+        return self.fn(row)
+
+
+class JSONOutputParser:
+    """Response body -> parsed JSON (optionally projected by a dataclass)."""
+
+    def __init__(self, binding: Optional[type] = None):
+        self.binding = binding
+
+    def parse(self, resp: Optional[HTTPResponseData]) -> Any:
+        if resp is None or resp.entity is None:
+            return None
+        obj = json.loads(resp.entity.decode("utf-8"))
+        if self.binding is not None:
+            return Binding.from_row(self.binding, obj)
+        return obj
+
+
+class StringOutputParser:
+    def parse(self, resp: Optional[HTTPResponseData]) -> Optional[str]:
+        if resp is None or resp.entity is None:
+            return None
+        return resp.entity.decode("utf-8")
+
+
+class CustomOutputParser:
+    def __init__(self, fn: Callable[[HTTPResponseData], Any]):
+        self.fn = fn
+
+    def parse(self, resp: Optional[HTTPResponseData]) -> Any:
+        return None if resp is None else self.fn(resp)
+
+
+class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
+    """input row -> request (input parser) -> HTTP -> parsed output column
+    (SimpleHTTPTransformer.scala:1-166).
+
+    ``inputCol`` may name a STRUCT column of per-row dicts, or None to use all
+    columns as the row payload. ``errorCol`` receives the response status when
+    the call failed (handleResponseErrors parity).
+    """
+
+    inputParser = ComplexParam("inputParser", "Row -> HTTPRequestData parser")
+    outputParser = ComplexParam("outputParser", "HTTPResponseData -> value parser")
+    errorCol = Param("errorCol", "Error output column", "errors", ptype=str)
+    concurrency = Param("concurrency", "Concurrent requests", 1, ptype=int)
+    timeout = Param("timeout", "Per-request timeout (s)", 60.0, ptype=float)
+    handler = ComplexParam("handler", "Custom (request) -> response callable")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get("inputCol")
+        out_col = self.get_or_throw("outputCol")
+        err_col = self.get("errorCol")
+        in_parser = self.get_or_throw("inputParser")
+        out_parser = self.get("outputParser") or JSONOutputParser()
+        handler = self.get("handler") or (
+            lambda r: send_with_retries(r, timeout=self.get("timeout")))
+        conc = self.get("concurrency")
+
+        def fn(part):
+            names = list(part)
+            n = len(part[names[0]]) if names else 0
+            out = np.empty(n, dtype=object)
+            errs = np.empty(n, dtype=object)
+
+            def payload(i):
+                if in_col and in_col in part:
+                    v = part[in_col][i]
+                    return v if isinstance(v, dict) else {"value": v}
+                return {k: part[k][i] for k in names}
+
+            def run(i):
+                req = in_parser.parse(payload(i))
+                resp = handler(req)
+                return i, resp
+
+            def consume(results):
+                for i, resp in results:
+                    if resp is not None and resp.statusCode == 200:
+                        try:
+                            out[i] = out_parser.parse(resp)
+                            errs[i] = None
+                        except Exception as e:  # malformed 200 -> errorCol
+                            out[i] = None
+                            errs[i] = f"parse failed: {e}"
+                    else:
+                        out[i] = None
+                        errs[i] = (f"{resp.statusCode}: {resp.statusLine}"
+                                   if resp is not None else "no response")
+
+            if conc > 1:
+                with ThreadPoolExecutor(max_workers=conc) as pool:
+                    consume(pool.map(run, range(n)))
+            else:
+                consume(map(run, range(n)))
+            part[out_col] = out
+            if err_col:
+                part[err_col] = errs
+            return part
+
+        return df.map_partitions(fn)
+
+
+class PartitionConsolidator(Transformer):
+    """Funnel rows from many partitions into fewer (for rate-limited resources:
+    one connection per host — io/http/PartitionConsolidator.scala:19-132)."""
+
+    targetPartitions = Param("targetPartitions", "Partitions after consolidation",
+                             1, lambda v: v > 0, int)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.coalesce(self.get("targetPartitions"))

@@ -1,0 +1,36 @@
+"""PowerBI writer (reference io/powerbi/PowerBIWriter.scala:1-114): stream
+DataFrame rows to a PowerBI push-dataset REST endpoint in JSON batches."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from .http import HTTPRequestData, send_with_retries
+
+
+class PowerBIWriter:
+    @staticmethod
+    def write(df: DataFrame, url: str, batch_size: int = 1000,
+              handler=None) -> int:
+        """POST rows as {"rows": [...]} JSON batches; returns batches sent."""
+        handler = handler or send_with_retries
+        rows = df.rows()
+        sent = 0
+        for start in range(0, len(rows), batch_size):
+            chunk = rows[start:start + batch_size]
+            clean = [{k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                      for k, v in r.items()} for r in chunk]
+            req = HTTPRequestData(
+                url=url, method="POST",
+                headers={"Content-Type": "application/json"},
+                entity=json.dumps({"rows": clean}).encode("utf-8"))
+            resp = handler(req)
+            if resp.statusCode not in (200, 202):
+                raise RuntimeError(
+                    f"PowerBI write failed: {resp.statusCode} {resp.statusLine}")
+            sent += 1
+        return sent

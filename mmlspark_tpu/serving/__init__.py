@@ -1,0 +1,14 @@
+"""Low-latency serving: any pipeline as a web service (reference Spark Serving).
+
+The reference turns a structured-streaming query into an HTTP service with
+embedded per-executor servers and driver-side routing
+(org/apache/spark/sql/execution/streaming/*, SURVEY §3.4). Here the equivalent:
+a per-host ingress server feeding a continuous micro-batching loop — queue ->
+pad/batch -> pipeline.transform (jitted stages reuse their compile cache) ->
+reply routing keyed by request id.
+"""
+
+from .server import ServingServer, serve_pipeline
+from .stages import parse_request, make_reply
+
+__all__ = ["ServingServer", "make_reply", "parse_request", "serve_pipeline"]

@@ -1,0 +1,51 @@
+"""Request/reply column sugar (reference ServingImplicits parseRequest/makeReply,
+io/IOImplicits.scala:182-213 + ServingUDFs.scala:16-50)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+
+
+def parse_request(df: DataFrame, output_col: str, parse: str = "json",
+                  value_col: str = "value") -> DataFrame:
+    """Decode the raw request-body column: json -> dict/list (dict payloads with
+    a single 'data'/'value' key unwrap to the value), text -> str, bytes -> raw."""
+
+    def fn(p):
+        col = p[value_col]
+        out = np.empty(len(col), dtype=object)
+        for i, body in enumerate(col):
+            if body is None:
+                out[i] = None
+                continue
+            raw = bytes(body)
+            if parse == "bytes":
+                out[i] = raw
+            elif parse == "text":
+                out[i] = raw.decode("utf-8", errors="replace")
+            else:
+                try:
+                    obj = json.loads(raw.decode("utf-8"))
+                except Exception:
+                    out[i] = None
+                    continue
+                if isinstance(obj, dict) and len(obj) == 1 and \
+                        next(iter(obj)) in ("data", "value"):
+                    obj = next(iter(obj.values()))
+                out[i] = np.asarray(obj, dtype=np.float64) \
+                    if isinstance(obj, list) and obj \
+                    and isinstance(obj[0], (int, float)) else obj
+        return out
+
+    return df.with_column(output_col, fn)
+
+
+def make_reply(df: DataFrame, input_col: str, reply_col: str = "reply"
+               ) -> DataFrame:
+    """Copy/coerce a column into the reply column (makeReplyUDF parity)."""
+    return df.with_column(reply_col, lambda p: p[input_col])

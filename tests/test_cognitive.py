@@ -1,0 +1,252 @@
+"""Cognitive services tests — against a fake local service (zero egress env)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.cognitive import (
+    AddDocuments,
+    AnalyzeImage,
+    AzureSearchWriter,
+    BingImageSearch,
+    DetectFace,
+    OCR,
+    RecognizeText,
+    SimpleDetectAnomalies,
+    SpeechToText,
+    TextSentiment,
+)
+from mmlspark_tpu.io.http import HTTPRequestData, HTTPResponseData
+
+
+class FakeService:
+    """Programmable in-process service handler; records requests."""
+
+    def __init__(self, responses=None):
+        self.requests = []
+        self.responses = responses or []
+        self.default = HTTPResponseData(200, "OK", b"{}", {})
+
+    def __call__(self, req: HTTPRequestData) -> HTTPResponseData:
+        self.requests.append(req)
+        if self.responses:
+            return self.responses.pop(0)
+        return self.default
+
+
+def json_resp(obj, headers=None, code=200):
+    return HTTPResponseData(code, "OK", json.dumps(obj).encode(), headers or {})
+
+
+class TestTextSentiment:
+    def test_documents_body_and_key_header(self):
+        svc = FakeService([json_resp({"documents": [{"id": "0", "score": 0.9}]}),
+                           json_resp({"documents": [{"id": "0", "score": 0.1}]})])
+        df = DataFrame.from_dict({"text": ["great product", "terrible"]})
+        stage = (TextSentiment(outputCol="sentiment", handler=svc,
+                               url="https://fake/text/analytics/v2.0/sentiment"))
+        stage.set_subscription_key("SECRET")
+        stage.set_col("text", "text")
+        stage.set_scalar("language", "en")
+        out = stage.transform(df)
+        assert out.column("sentiment")[0]["documents"][0]["score"] == 0.9
+        req = svc.requests[0]
+        assert req.headers["Ocp-Apim-Subscription-Key"] == "SECRET"
+        body = json.loads(req.entity)
+        assert body["documents"][0]["text"] == "great product"
+        assert body["documents"][0]["language"] == "en"
+
+    def test_error_column_on_failure(self):
+        svc = FakeService([HTTPResponseData(401, "Unauthorized")])
+        df = DataFrame.from_dict({"text": ["hi"]})
+        stage = TextSentiment(outputCol="s", handler=svc, url="https://fake/x")
+        stage.set_col("text", "text")
+        out = stage.transform(df)
+        assert out.column("s")[0] is None
+        assert "401" in out.column("errors")[0]
+
+
+class TestVision:
+    def test_ocr_url_params(self):
+        svc = FakeService([json_resp({"regions": []})])
+        df = DataFrame.from_dict({"url": ["http://img/x.jpg"]})
+        stage = OCR(outputCol="ocr", handler=svc, url="https://fake/vision/ocr")
+        stage.set_col("imageUrl", "url")
+        stage.set_scalar("detectOrientation", True)
+        stage.transform(df)
+        req = svc.requests[0]
+        assert "detectOrientation=true" in req.url
+        assert json.loads(req.entity)["url"] == "http://img/x.jpg"
+
+    def test_image_bytes_posts_octet_stream(self):
+        svc = FakeService([json_resp({"tags": []})])
+        df = DataFrame.from_dict({"img": [b"\x89PNGdata"]})
+        stage = AnalyzeImage(outputCol="a", handler=svc, url="https://fake/analyze")
+        stage.set_col("imageBytes", "img")
+        stage.set_scalar("visualFeatures", ["Categories", "Tags"])
+        stage.transform(df)
+        req = svc.requests[0]
+        assert req.headers["Content-Type"] == "application/octet-stream"
+        assert req.entity == b"\x89PNGdata"
+        assert "visualFeatures=Categories,Tags" in req.url
+
+    def test_recognize_text_polls_operation_location(self):
+        svc = FakeService([
+            HTTPResponseData(202, "Accepted", b"",
+                             {"Operation-Location": "https://fake/op/123"}),
+            json_resp({"status": "Running"}),
+            json_resp({"status": "Succeeded",
+                       "recognitionResult": {"lines": [{"text": "hello"}]}}),
+        ])
+        df = DataFrame.from_dict({"url": ["http://img/1.jpg"]})
+        stage = RecognizeText(outputCol="txt", handler=svc,
+                              url="https://fake/recognizeText",
+                              pollingDelayMs=1)
+        stage.set_col("imageUrl", "url")
+        out = stage.transform(df)
+        result = out.column("txt")[0]
+        assert result["recognitionResult"]["lines"][0]["text"] == "hello"
+        # first call POST, then GET polls
+        assert svc.requests[0].method == "POST"
+        assert svc.requests[1].method == "GET"
+        assert svc.requests[1].url == "https://fake/op/123"
+
+    def test_detect_face_params(self):
+        svc = FakeService([json_resp([{"faceId": "f1"}])])
+        df = DataFrame.from_dict({"url": ["http://img/face.jpg"]})
+        stage = DetectFace(outputCol="faces", handler=svc, url="https://fake/detect")
+        stage.set_col("imageUrl", "url")
+        stage.set_scalar("returnFaceAttributes", ["age", "gender"])
+        stage.transform(df)
+        assert "returnFaceAttributes=age,gender" in svc.requests[0].url
+
+
+class TestSpeech:
+    def test_audio_content_type(self):
+        svc = FakeService([json_resp({"DisplayText": "hello world"})])
+        df = DataFrame.from_dict({"audio": [b"RIFFfakewav"]})
+        stage = SpeechToText(outputCol="stt", handler=svc, url="https://fake/stt")
+        stage.set_col("audioData", "audio")
+        stage.set_scalar("language", "en-US")
+        out = stage.transform(df)
+        assert out.column("stt")[0]["DisplayText"] == "hello world"
+        req = svc.requests[0]
+        assert req.entity == b"RIFFfakewav"
+        assert "language=en-US" in req.url
+        assert "audio/wav" in req.headers["Content-Type"]
+
+
+class TestAnomaly:
+    def test_simple_detect_anomalies_groups(self):
+        def svc(req):
+            body = json.loads(req.entity)
+            n = len(body["series"])
+            return json_resp({"isAnomaly": [i == n - 1 for i in range(n)]})
+
+        rows = []
+        for g in ("a", "b"):
+            for i in range(4):
+                rows.append({"grp": g, "timestamp": f"2026-01-0{i+1}T00:00:00Z",
+                             "value": float(i if i < 3 else 100)})
+        df = DataFrame.from_rows(rows)
+        stage = SimpleDetectAnomalies(outputCol="anomaly", groupbyCol="grp",
+                                      url="https://fake/anomaly", handler=svc)
+        stage.set_scalar("granularity", "daily")
+        out = stage.transform(df)
+        flags = list(out.column("anomaly"))
+        assert flags == [False, False, False, True] * 2
+
+
+class TestBingAndSearch:
+    def test_bing_query_urlencoded(self):
+        svc = FakeService([json_resp({"value": [
+            {"contentUrl": "http://img/1.jpg"}]})])
+        df = DataFrame.from_dict({"query": ["cute cats"]})
+        stage = BingImageSearch(outputCol="results", handler=svc,
+                                url="https://fake/images/search")
+        stage.set_col("q", "query")
+        stage.set_scalar("count", 5)
+        out = stage.transform(df)
+        assert "q=cute%20cats" in svc.requests[0].url
+        assert "count=5" in svc.requests[0].url
+        urls = BingImageSearch.get_url_transformer("results", "urls") \
+            .transform(out).column("urls")[0]
+        assert urls == ["http://img/1.jpg"]
+
+    def test_azure_search_writer_batches(self):
+        svc = FakeService()
+        svc.default = json_resp({"value": []})
+        df = DataFrame.from_dict({"id": ["1", "2", "3"],
+                                  "content": ["a", "b", "c"]})
+        out = AzureSearchWriter.write(df, "KEY", "mysvc", "idx", handler=svc,
+                                      batch_size=2)
+        assert list(out.column("status")) == [200, 200, 200]
+        assert len(svc.requests) == 2  # 2 + 1 docs
+        body = json.loads(svc.requests[0].entity)
+        assert body["value"][0]["@search.action"] == "upload"
+        assert svc.requests[0].headers["api-key"] == "KEY"
+        assert "mysvc.search.windows.net/indexes/idx" in svc.requests[0].url
+
+
+class TestReviewRegressions:
+    def test_simple_detect_one_call_per_group(self):
+        calls = []
+
+        def svc(req):
+            calls.append(req)
+            body = json.loads(req.entity)
+            n = len(body["series"])
+            return json_resp({"isAnomaly": [False] * n})
+
+        rows = [{"grp": g, "timestamp": f"t{i}", "value": float(i)}
+                for g in ("a", "b") for i in range(10)]
+        df = DataFrame.from_rows(rows)
+        stage = SimpleDetectAnomalies(outputCol="anomaly", groupbyCol="grp",
+                                      url="https://fake/anomaly", handler=svc)
+        stage.set_scalar("granularity", "daily")
+        stage.transform(df)
+        assert len(calls) == 2  # one per group, not one per row
+
+    def test_url_params_escaped(self):
+        svc = FakeService([json_resp({})])
+        df = DataFrame.from_dict({"url": ["http://img/x.jpg"]})
+        stage = AnalyzeImage(outputCol="a", handler=svc, url="https://fake/an")
+        stage.set_col("imageUrl", "url")
+        stage.set_scalar("language", "pt BR&x")
+        stage.transform(df)
+        assert "pt%20BR%26x" in svc.requests[0].url
+
+    def test_missing_image_input_goes_to_error_col(self):
+        svc = FakeService()
+        df = DataFrame.from_dict({"other": [1.0]})
+        stage = OCR(outputCol="o", handler=svc, url="https://fake/ocr")
+        out = stage.transform(df)
+        assert out.column("o")[0] is None
+        assert "imageUrl/imageBytes" in out.column("errors")[0]
+        assert not svc.requests  # nothing sent
+
+    def test_search_key_from_column(self):
+        svc = FakeService()
+        svc.default = json_resp({"value": []})
+        df = DataFrame.from_dict({"id": ["1"], "content": ["a"],
+                                  "key": ["COLKEY"]})
+        stage = AddDocuments(outputCol="status", serviceName="s", indexName="i")
+        stage.set_col("subscriptionKey", "key")
+        stage.set("handler", svc)
+        stage.transform(df.drop("key").with_column("key", np.array(["COLKEY"],
+                                                                   dtype=object)))
+        assert svc.requests[0].headers["api-key"] == "COLKEY"
+
+    def test_generate_thumbnails_binary_response(self):
+        from mmlspark_tpu.cognitive import GenerateThumbnails
+        svc = FakeService([HTTPResponseData(200, "OK", b"\xff\xd8jpegbytes", {})])
+        df = DataFrame.from_dict({"url": ["http://img/x.jpg"]})
+        stage = GenerateThumbnails(outputCol="thumb", handler=svc,
+                                   url="https://fake/thumb")
+        stage.set_col("imageUrl", "url")
+        stage.set_scalar("width", 32)
+        stage.set_scalar("height", 32)
+        out = stage.transform(df)
+        assert out.column("thumb")[0] == b"\xff\xd8jpegbytes"

@@ -1,0 +1,105 @@
+"""Model downloader / repository tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.downloader import (
+    FaultToleranceUtils,
+    ModelDownloader,
+    ModelNotFoundError,
+    ModelSchema,
+)
+
+
+def make_repo(tmp_path):
+    """Build a local repo with one saved tiny model."""
+    from tests.test_models import tiny_mlp
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    model = tiny_mlp()
+    schema = ModelDownloader.save_function_model(
+        model, str(repo / "tinymlp"), name="tinymlp")
+    (repo / "tinymlp.meta").write_text(schema.to_json())
+    return repo, model
+
+
+class TestModelDownloader:
+    def test_list_and_download(self, tmp_path):
+        repo, model = make_repo(tmp_path)
+        dl = ModelDownloader(str(tmp_path / "cache"), str(repo))
+        schemas = list(dl.get_models())
+        assert [s.name for s in schemas] == ["tinymlp"]
+        local = dl.download_model("tinymlp")
+        assert os.path.isdir(local.uri)
+        loaded = ModelDownloader.load_function_model(local)
+        x = np.ones((2, 4), dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(loaded.apply(x)),
+                                   np.asarray(model.apply(x)), atol=1e-6)
+
+    def test_idempotent_download(self, tmp_path):
+        repo, _ = make_repo(tmp_path)
+        dl = ModelDownloader(str(tmp_path / "cache"), str(repo))
+        a = dl.download_model("tinymlp")
+        b = dl.download_model("tinymlp")
+        assert a.uri == b.uri
+        assert [s.name for s in dl.local_models()] == ["tinymlp"]
+
+    def test_hash_verification_fails_on_corruption(self, tmp_path):
+        repo, _ = make_repo(tmp_path)
+        meta = ModelSchema.from_json((repo / "tinymlp.meta").read_text())
+        meta.hash = "deadbeef" * 8
+        dl = ModelDownloader(str(tmp_path / "cache"))
+        with pytest.raises(IOError, match="hash mismatch"):
+            dl.download_model(meta)
+
+    def test_missing_model(self, tmp_path):
+        repo, _ = make_repo(tmp_path)
+        dl = ModelDownloader(str(tmp_path / "cache"), str(repo))
+        with pytest.raises(ModelNotFoundError):
+            dl.download_model("nonexistent")
+
+    def test_schema_feeds_image_featurizer(self, tmp_path):
+        from mmlspark_tpu.models.resnet import resnet
+        from mmlspark_tpu.image import ImageFeaturizer
+        from mmlspark_tpu.core.dataframe import DataFrame
+        from mmlspark_tpu.core.schema import ImageSchema
+
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        model = resnet(18, num_classes=10, image_size=16, width=8)
+        schema = ModelDownloader.save_function_model(
+            model, str(repo / "rn18"), name="rn18")
+        assert schema.layerNames[0] == "fc"
+
+        loaded = ModelDownloader.load_function_model(schema)
+        rng = np.random.default_rng(0)
+        df = DataFrame.from_dict({"image": [
+            ImageSchema.make(rng.integers(0, 255, (16, 16, 3), dtype=np.uint8))]})
+        feat = ImageFeaturizer(inputCol="image", outputCol="f").set_model(loaded)
+        assert feat.transform(df).column("f")[0].shape == (64,)
+
+
+class TestFaultTolerance:
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise IOError("transient")
+            return "ok"
+
+        assert FaultToleranceUtils.retry_with_timeout(
+            flaky, retries=5, backoff_s=0.001) == "ok"
+        assert len(calls) == 3
+
+    def test_raises_after_exhaustion(self):
+        def always_fails():
+            raise IOError("permanent")
+
+        with pytest.raises(IOError, match="permanent"):
+            FaultToleranceUtils.retry_with_timeout(always_fails, retries=2,
+                                                   backoff_s=0.001)

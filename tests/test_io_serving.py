@@ -1,0 +1,304 @@
+"""IO + serving tests: binary/image readers, HTTP stack, real localhost serving."""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.io import (
+    BinaryFileReader,
+    HTTPRequestData,
+    HTTPResponseData,
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    PartitionConsolidator,
+    SimpleHTTPTransformer,
+    StringOutputParser,
+    read_binary_files,
+    read_images,
+    send_with_retries,
+)
+from mmlspark_tpu.ops.image import encode_ppm
+from mmlspark_tpu.serving import ServingServer, serve_pipeline
+
+
+@pytest.fixture
+def echo_server():
+    """Real localhost HTTP server (reference test strategy: HTTPv2Suite spins
+    real servers)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        fail_first = {"count": 0}
+
+        def log_message(self, *args):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            if self.path == "/double":
+                data = json.loads(body)
+                reply = json.dumps({"result": [2 * v for v in data["values"]]})
+            elif self.path == "/flaky":
+                Handler.fail_first["count"] += 1
+                if Handler.fail_first["count"] % 2 == 1:
+                    self.send_response(503)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                reply = json.dumps({"ok": True})
+            else:
+                reply = body.decode("utf-8")
+            payload = reply.encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestBinaryReader:
+    def test_read_tree(self, tmp_path):
+        (tmp_path / "sub").mkdir()
+        (tmp_path / "a.bin").write_bytes(b"aaa")
+        (tmp_path / "sub" / "b.bin").write_bytes(b"bbbb")
+        df = read_binary_files(str(tmp_path))
+        assert df.count() == 2
+        rows = {r["path"].split("/")[-1]: r["bytes"] for r in df.rows()}
+        assert rows["a.bin"] == b"aaa" and rows["b.bin"] == b"bbbb"
+
+    def test_non_recursive_and_pattern(self, tmp_path):
+        (tmp_path / "sub").mkdir()
+        (tmp_path / "a.txt").write_bytes(b"x")
+        (tmp_path / "b.bin").write_bytes(b"y")
+        (tmp_path / "sub" / "c.txt").write_bytes(b"z")
+        df = (BinaryFileReader().option("recursive", False)
+              .option("pattern", "*.txt").load(str(tmp_path)))
+        assert df.count() == 1
+
+    def test_zip_inspection(self, tmp_path):
+        import zipfile
+        zp = tmp_path / "arch.zip"
+        with zipfile.ZipFile(zp, "w") as z:
+            z.writestr("inner1.dat", b"123")
+            z.writestr("inner2.dat", b"4567")
+        df = read_binary_files(str(tmp_path))
+        assert df.count() == 2
+        assert all("arch.zip/" in r["path"] for r in df.rows())
+
+    def test_sampling(self, tmp_path):
+        for i in range(50):
+            (tmp_path / f"f{i}.bin").write_bytes(b"x")
+        df = read_binary_files(str(tmp_path), sample_ratio=0.3, inspect_zip=False)
+        assert 3 <= df.count() <= 30
+
+
+class TestImageReader:
+    def test_read_and_decode(self, tmp_path):
+        rng = np.random.default_rng(0)
+        for i in range(3):
+            img = rng.integers(0, 255, (10, 8, 3), dtype=np.uint8)
+            (tmp_path / f"img{i}.ppm").write_bytes(encode_ppm(img))
+        (tmp_path / "broken.ppm").write_bytes(b"not an image")
+        df = read_images(str(tmp_path))
+        assert df.count() == 3  # broken dropped
+        img0 = df.column("image")[0]
+        assert img0["height"] == 10 and img0["nChannels"] == 3
+
+
+class TestHTTPClient:
+    def test_send_with_retries_429(self):
+        calls = []
+
+        def fake_send(req, timeout=60.0):
+            calls.append(1)
+            if len(calls) < 2:
+                return HTTPResponseData(429, "too many",
+                                        headers={"Retry-After": "0.01"})
+            return HTTPResponseData(200, "OK", b"done")
+
+        import mmlspark_tpu.io.http as H
+        orig = H.send_request
+        H.send_request = fake_send
+        try:
+            slept = []
+            resp = send_with_retries(HTTPRequestData("http://x"),
+                                     sleep_fn=slept.append)
+            assert resp.statusCode == 200
+            assert slept == [0.01]  # honored Retry-After
+        finally:
+            H.send_request = orig
+
+    def test_real_http_round_trip(self, echo_server):
+        df = DataFrame.from_dict({"req": [
+            HTTPRequestData(url=echo_server + "/echo", method="POST",
+                            entity=b'{"a":1}').to_row()]})
+        out = HTTPTransformer(inputCol="req", outputCol="resp").transform(df)
+        resp = HTTPResponseData.from_row(out.column("resp")[0])
+        assert resp.statusCode == 200
+        assert json.loads(resp.entity) == {"a": 1}
+
+    def test_retry_on_503(self, echo_server):
+        req = HTTPRequestData(url=echo_server + "/flaky", method="POST",
+                              entity=b"{}")
+        resp = send_with_retries(req, retry_backoffs_ms=(10, 10, 10))
+        assert resp.statusCode == 200
+
+
+class TestSimpleHTTPTransformer:
+    def test_json_round_trip(self, echo_server):
+        df = DataFrame.from_dict({"values": [[1.0, 2.0], [3.0]]})
+        t = SimpleHTTPTransformer(outputCol="out")
+        t.set("inputParser", JSONInputParser(echo_server + "/double"))
+        t.set("outputParser", JSONOutputParser())
+        out = t.transform(df)
+        results = out.column("out")
+        assert results[0]["result"] == [2.0, 4.0]
+        assert results[1]["result"] == [6.0]
+        assert out.column("errors")[0] is None
+
+    def test_error_column(self, echo_server):
+        df = DataFrame.from_dict({"values": [[1.0]]})
+        t = SimpleHTTPTransformer(outputCol="out", concurrency=1)
+        t.set("inputParser", JSONInputParser(echo_server + "/missing_path_404"))
+        # the echo server treats unknown paths as echo -> force a bad URL instead
+        t.set("inputParser", JSONInputParser("http://127.0.0.1:9/nope"))
+        t.set("handler", lambda r: HTTPResponseData(500, "boom"))
+        out = t.transform(df)
+        assert out.column("out")[0] is None
+        assert "500" in out.column("errors")[0]
+
+    def test_consolidator(self):
+        df = DataFrame.from_dict({"x": np.arange(10.0)}, num_partitions=5)
+        out = PartitionConsolidator(targetPartitions=1).transform(df)
+        assert out.num_partitions == 1 and out.count() == 10
+
+
+class TestServing:
+    def test_serve_echo_pipeline(self):
+        from mmlspark_tpu.serving.stages import parse_request
+
+        def transform(df):
+            parsed = parse_request(df, "data", parse="json")
+            return parsed.with_column(
+                "reply", lambda p: [
+                    {"sum": float(np.sum(v))} if v is not None else None
+                    for v in p["data"]])
+
+        with ServingServer(transform, port=0, max_wait_ms=2.0) as server:
+            req = urllib.request.Request(
+                server.address, data=json.dumps({"data": [1, 2, 3]}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                body = json.loads(resp.read())
+            assert body == {"sum": 6.0}
+
+    def test_serve_batches_concurrent_requests(self):
+        from mmlspark_tpu.serving.stages import parse_request
+        batch_sizes = []
+
+        def transform(df):
+            batch_sizes.append(df.count())
+            parsed = parse_request(df, "data", parse="json")
+            return parsed.with_column(
+                "reply", lambda p: [float(np.sum(v)) for v in p["data"]])
+
+        with ServingServer(transform, port=0, max_wait_ms=50.0,
+                           max_batch_size=16) as server:
+            results = []
+
+            def call(i):
+                req = urllib.request.Request(
+                    server.address, data=json.dumps({"data": [i]}).encode(),
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    results.append(float(resp.read()))
+
+            threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert sorted(results) == [float(i) for i in range(8)]
+            assert max(batch_sizes) > 1  # dynamic batching kicked in
+
+    def test_serve_fitted_model(self):
+        from mmlspark_tpu.gbdt import LightGBMRegressor
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 3))
+        y = 2 * X[:, 0] + X[:, 1]
+        df = DataFrame.from_dict({"features": [X[i] for i in range(200)],
+                                  "label": y})
+        model = LightGBMRegressor(numIterations=10, numLeaves=7,
+                                  minDataInLeaf=5).fit(df)
+        server = serve_pipeline(model, input_col="features",
+                                reply_col="reply", port=0)
+        with server:
+            x0 = X[0].tolist()
+            req = urllib.request.Request(
+                server.address, data=json.dumps({"data": x0}).encode(),
+                method="POST")
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                pred = float(resp.read())
+            expected = model.transform(df.limit(1)).column("prediction")[0]
+            assert pred == pytest.approx(expected, abs=1e-5)
+
+    def test_server_error_isolation(self):
+        def transform(df):
+            raise RuntimeError("model exploded")
+
+        with ServingServer(transform, port=0) as server:
+            req = urllib.request.Request(server.address, data=b"{}",
+                                         method="POST")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 500
+
+
+class TestReviewRegressions:
+    def test_malformed_200_goes_to_error_col(self, echo_server):
+        df = DataFrame.from_dict({"values": [[1.0]]})
+        t = SimpleHTTPTransformer(outputCol="out")
+        t.set("inputParser", JSONInputParser("http://unused/"))
+        t.set("handler",
+              lambda r: HTTPResponseData(200, "OK", b"<html>not json</html>"))
+        out = t.transform(df)
+        assert out.column("out")[0] is None
+        assert "parse failed" in out.column("errors")[0]
+
+    def test_retry_timeout_slow_success(self):
+        import time as _time
+        from mmlspark_tpu.downloader import FaultToleranceUtils
+
+        def slow():
+            _time.sleep(0.05)
+            return "done"
+
+        # generous timeout: succeeds first try, no spurious retries
+        assert FaultToleranceUtils.retry_with_timeout(
+            slow, retries=1, timeout_s=5.0) == "done"
+
+    def test_retry_timeout_enforced(self):
+        import time as _time
+        from mmlspark_tpu.downloader import FaultToleranceUtils
+
+        def too_slow():
+            _time.sleep(0.5)
+            return "late"
+
+        with pytest.raises(TimeoutError):
+            FaultToleranceUtils.retry_with_timeout(
+                too_slow, retries=1, timeout_s=0.05, backoff_s=0.001)
