@@ -1,0 +1,12 @@
+"""Codegen: API documentation + stage inventory from the stage registry.
+
+The reference reflects over the compiled jar to generate PySpark/SparklyR
+wrappers and their smoke tests (src/it/codegen, SURVEY §2.4). This framework IS
+Python, so binding generation collapses into: (a) a generated API reference
+with every stage's params/docs, (b) a machine-readable stage inventory that the
+fuzzing harness uses to enforce test coverage (FuzzingTest reflection parity).
+"""
+
+from .docs import generate_docs, stage_inventory
+
+__all__ = ["generate_docs", "stage_inventory"]

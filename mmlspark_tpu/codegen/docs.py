@@ -1,0 +1,97 @@
+"""Generate API docs + stage inventory by walking the stage registry."""
+
+from __future__ import annotations
+
+import importlib
+import os
+from typing import Any, Dict, List, Optional
+
+# importing these populates the stage registry (codegen reflects the full jar
+# in the reference; here: import the full package surface)
+_PACKAGES = [
+    "mmlspark_tpu.automl",
+    "mmlspark_tpu.cognitive",
+    "mmlspark_tpu.featurize",
+    "mmlspark_tpu.gbdt",
+    "mmlspark_tpu.image",
+    "mmlspark_tpu.io",
+    "mmlspark_tpu.lime",
+    "mmlspark_tpu.models",
+    "mmlspark_tpu.recommendation",
+    "mmlspark_tpu.stages",
+    "mmlspark_tpu.train",
+    "mmlspark_tpu.vw",
+]
+
+
+def _import_all() -> None:
+    for pkg in _PACKAGES:
+        importlib.import_module(pkg)
+
+
+def stage_inventory() -> Dict[str, type]:
+    """Every registered concrete stage, keyed by class name (dedup'd)."""
+    from ..core.pipeline import registered_stages
+
+    _import_all()
+    out: Dict[str, type] = {}
+    for name, cls in registered_stages().items():
+        if "." in name:
+            continue  # keep short names only
+        if not cls.__module__.startswith("mmlspark_tpu."):
+            continue
+        out[name] = cls
+    return dict(sorted(out.items()))
+
+
+def _stage_doc(name: str, cls: type) -> str:
+    lines = [f"### `{name}`", ""]
+    doc = (cls.__doc__ or "").strip()
+    if doc:
+        lines.append(doc)
+        lines.append("")
+    lines.append(f"*Module:* `{cls.__module__}`")
+    params = cls.params()
+    if params:
+        lines.append("")
+        lines.append("| Param | Default | Doc |")
+        lines.append("|---|---|---|")
+        for pname, p in sorted(params.items()):
+            kind = (" (complex)" if p.is_complex
+                    else " (value-or-column)" if p.is_service else "")
+            default = repr(p.default)
+            if len(default) > 40:
+                default = default[:37] + "..."
+            doc_txt = (p.doc or "").replace("|", "\\|")
+            lines.append(f"| `{pname}`{kind} | `{default}` | {doc_txt} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def generate_docs(path: str = "docs/api") -> List[str]:
+    """Write per-package markdown API docs; returns written file paths."""
+    inventory = stage_inventory()
+    by_module: Dict[str, List[str]] = {}
+    for name, cls in inventory.items():
+        pkg = cls.__module__.split(".")[1]
+        by_module.setdefault(pkg, []).append(name)
+
+    os.makedirs(path, exist_ok=True)
+    written: List[str] = []
+    index = ["# mmlspark_tpu API reference", "",
+             f"{len(inventory)} pipeline stages across "
+             f"{len(by_module)} packages.", ""]
+    for pkg, names in sorted(by_module.items()):
+        fname = os.path.join(path, f"{pkg}.md")
+        sections = [f"# mmlspark_tpu.{pkg}", ""]
+        for name in names:
+            sections.append(_stage_doc(name, inventory[name]))
+        with open(fname, "w") as f:
+            f.write("\n".join(sections))
+        written.append(fname)
+        index.append(f"- [{pkg}]({pkg}.md): " + ", ".join(
+            f"`{n}`" for n in names))
+    with open(os.path.join(path, "README.md"), "w") as f:
+        f.write("\n".join(index) + "\n")
+    written.append(os.path.join(path, "README.md"))
+    return written

@@ -1,0 +1,209 @@
+"""NativeLoader: build/discover/load the C++ runtime, with graceful fallback.
+
+Reference: core/env/NativeLoader.java:28-140 — extracts .so files from jar
+resources and System.load()s them on each executor. Here: the .so is built
+from in-repo C++ source (native/src/) on first use (g++ is in the image),
+cached under native/build/, and loaded via ctypes. Every consumer falls back
+to the numpy implementation when the library is unavailable, so the Python
+surface never hard-depends on the toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+log = logging.getLogger("mmlspark_tpu.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libmmlspark_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "src", "mmlspark_native.cpp")
+    if not os.path.exists(src):
+        return False
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", _SO_PATH, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # toolchain missing / compile error -> fallback
+        log.warning("native build failed (%s); using numpy fallbacks", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_attempted
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            if _build_attempted:
+                return None
+            _build_attempted = True
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            log.warning("native load failed (%s)", e)
+            return None
+        _declare(lib)
+        if lib.mml_version() != 1:
+            log.warning("native ABI mismatch; using numpy fallbacks")
+            return None
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+
+    lib.mml_version.restype = ctypes.c_int32
+    lib.mml_murmur3_32.restype = ctypes.c_uint32
+    lib.mml_murmur3_32.argtypes = [u8p, ctypes.c_int32, ctypes.c_uint32]
+    lib.mml_murmur3_batch.argtypes = [u8p, i64p, ctypes.c_int64,
+                                      ctypes.c_uint32, u32p]
+    lib.mml_resize_bilinear_f32.argtypes = [f32p, ctypes.c_int32, ctypes.c_int32,
+                                            ctypes.c_int32, f32p,
+                                            ctypes.c_int32, ctypes.c_int32]
+    lib.mml_resize_bilinear_u8.argtypes = [u8p, ctypes.c_int32, ctypes.c_int32,
+                                           ctypes.c_int32, u8p,
+                                           ctypes.c_int32, ctypes.c_int32]
+    lib.mml_unroll_chw_f64.argtypes = [u8p, ctypes.c_int32, ctypes.c_int32,
+                                       ctypes.c_int32, f64p, ctypes.c_int32]
+    lib.mml_histogram.argtypes = [i32p, f32p, f32p, u8p, ctypes.c_int64,
+                                  ctypes.c_int32, ctypes.c_int32, f32p]
+    lib.mml_forest_predict.argtypes = [f32p, ctypes.c_int64, ctypes.c_int32,
+                                       i32p, f32p, u8p, i32p, i32p, f32p,
+                                       ctypes.c_int32, ctypes.c_int32, i32p,
+                                       ctypes.c_int32, f64p]
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---------------------------------------------------------------------------
+# Typed wrappers (None lib -> caller should use its numpy fallback)
+# ---------------------------------------------------------------------------
+
+
+def murmur3_batch(strings: List[str], seed: int = 0) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None:
+        return None
+    encoded = [s.encode("utf-8") for s in strings]
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    buf = np.frombuffer(b"".join(encoded), dtype=np.uint8) if encoded else \
+        np.empty(0, dtype=np.uint8)
+    buf = np.ascontiguousarray(buf)
+    out = np.zeros(len(encoded), dtype=np.uint32)
+    lib.mml_murmur3_batch(_ptr(buf, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+                          len(encoded), seed & 0xFFFFFFFF,
+                          _ptr(out, ctypes.c_uint32))
+    return out.astype(np.int64)
+
+
+def resize_bilinear(img: np.ndarray, oh: int, ow: int) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None:
+        return None
+    img = np.ascontiguousarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    h, w, c = img.shape
+    if img.dtype == np.uint8:
+        dst = np.empty((oh, ow, c), dtype=np.uint8)
+        lib.mml_resize_bilinear_u8(_ptr(img, ctypes.c_uint8), h, w, c,
+                                   _ptr(dst, ctypes.c_uint8), oh, ow)
+        return dst
+    src = np.ascontiguousarray(img, dtype=np.float32)
+    dst = np.empty((oh, ow, c), dtype=np.float32)
+    lib.mml_resize_bilinear_f32(_ptr(src, ctypes.c_float), h, w, c,
+                                _ptr(dst, ctypes.c_float), oh, ow)
+    return dst
+
+
+def unroll_chw(img: np.ndarray, normalize: bool = False) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None or img.dtype != np.uint8:
+        return None
+    img = np.ascontiguousarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    h, w, c = img.shape
+    out = np.empty(c * h * w, dtype=np.float64)
+    lib.mml_unroll_chw_f64(_ptr(img, ctypes.c_uint8), h, w, c,
+                           _ptr(out, ctypes.c_double), int(normalize))
+    return out
+
+
+def histogram(bins: np.ndarray, grad: np.ndarray, hess: np.ndarray,
+              mask: np.ndarray, num_bins: int) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None:
+        return None
+    bins = np.ascontiguousarray(bins, dtype=np.int32)
+    grad = np.ascontiguousarray(grad, dtype=np.float32)
+    hess = np.ascontiguousarray(hess, dtype=np.float32)
+    mask8 = np.ascontiguousarray(mask, dtype=np.uint8)
+    n, f = bins.shape
+    out = np.zeros((f, num_bins, 3), dtype=np.float32)
+    lib.mml_histogram(_ptr(bins, ctypes.c_int32), _ptr(grad, ctypes.c_float),
+                      _ptr(hess, ctypes.c_float), _ptr(mask8, ctypes.c_uint8),
+                      n, f, num_bins, _ptr(out, ctypes.c_float))
+    return out
+
+
+def forest_predict(X: np.ndarray, feature: np.ndarray, threshold: np.ndarray,
+                   default_left: np.ndarray, left: np.ndarray,
+                   right: np.ndarray, value: np.ndarray,
+                   class_of_tree: np.ndarray, num_class: int
+                   ) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None:
+        return None
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    n, num_feat = X.shape
+    t, m = feature.shape
+    feature = np.ascontiguousarray(feature, dtype=np.int32)
+    threshold = np.ascontiguousarray(threshold, dtype=np.float32)
+    dl = np.ascontiguousarray(default_left, dtype=np.uint8)
+    left = np.ascontiguousarray(left, dtype=np.int32)
+    right = np.ascontiguousarray(right, dtype=np.int32)
+    value = np.ascontiguousarray(value, dtype=np.float32)
+    cot = np.ascontiguousarray(class_of_tree, dtype=np.int32)
+    out = np.zeros((n, num_class), dtype=np.float64)
+    lib.mml_forest_predict(
+        _ptr(X, ctypes.c_float), n, num_feat, _ptr(feature, ctypes.c_int32),
+        _ptr(threshold, ctypes.c_float), _ptr(dl, ctypes.c_uint8),
+        _ptr(left, ctypes.c_int32), _ptr(right, ctypes.c_int32),
+        _ptr(value, ctypes.c_float), t, m, _ptr(cot, ctypes.c_int32),
+        num_class, _ptr(out, ctypes.c_double))
+    return out

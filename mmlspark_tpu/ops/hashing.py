@@ -77,4 +77,11 @@ class MurmurWithPrefix:
 
 
 def hash_strings(values: Iterable[str], seed: int = 0) -> np.ndarray:
-    return np.fromiter((hash_string(v, seed) for v in values), dtype=np.int64)
+    """Batch hashing: C++ fast path when built, python fallback."""
+    vals = list(values)
+    from .. import native_loader
+
+    native = native_loader.murmur3_batch(vals, seed)
+    if native is not None:
+        return native
+    return np.fromiter((hash_string(v, seed) for v in vals), dtype=np.int64)

@@ -99,7 +99,7 @@ def encode_ppm(img: np.ndarray) -> bytes:
 
 
 def resize(img: np.ndarray, height: int, width: int, method: str = "linear") -> np.ndarray:
-    """Host-side single-image resize (numpy bilinear / nearest)."""
+    """Host-side single-image resize (C++ bilinear when built, numpy fallback)."""
     img = np.asarray(img)
     squeeze = img.ndim == 2
     if squeeze:
@@ -107,17 +107,25 @@ def resize(img: np.ndarray, height: int, width: int, method: str = "linear") -> 
     h, w, c = img.shape
     if (h, w) == (height, width):
         out = img
+    elif method != "nearest" and img.dtype in (np.uint8, np.float32):
+        from .. import native_loader
+
+        native = native_loader.resize_bilinear(img, height, width)
+        out = native if native is not None else _resize_numpy(img, height, width)
     elif method == "nearest":
         ys = np.clip((np.arange(height) + 0.5) * h / height, 0, h - 1).astype(np.int64)
         xs = np.clip((np.arange(width) + 0.5) * w / width, 0, w - 1).astype(np.int64)
         out = img[ys][:, xs]
     else:
-        out = _bilinear(img.astype(np.float32), height, width)
-        if img.dtype == np.uint8:
-            out = np.clip(np.rint(out), 0, 255).astype(np.uint8)
-        else:
-            out = out.astype(img.dtype)
+        out = _resize_numpy(img, height, width)
     return out[:, :, 0] if squeeze else out
+
+
+def _resize_numpy(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    out = _bilinear(img.astype(np.float32), height, width)
+    if img.dtype == np.uint8:
+        return np.clip(np.rint(out), 0, 255).astype(np.uint8)
+    return out.astype(img.dtype)
 
 
 def _bilinear(img: np.ndarray, height: int, width: int) -> np.ndarray:

@@ -1,0 +1,76 @@
+"""Native C++ runtime tests: build, load, and parity with the numpy/jax paths."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import native_loader as NL
+from mmlspark_tpu.ops import image as imops
+from mmlspark_tpu.ops.hashing import hash_string
+
+
+@pytest.fixture(scope="module")
+def native():
+    if not NL.available():
+        pytest.skip("native toolchain unavailable")
+    return NL
+
+
+class TestNative:
+    def test_builds_and_loads(self, native):
+        assert native.load() is not None
+
+    def test_murmur_batch_matches_python(self, native):
+        strings = ["hello", "world", "", "mmlspark_tpu", "日本語テキスト"]
+        got = native.murmur3_batch(strings, seed=42)
+        want = [hash_string(s, 42) for s in strings]
+        np.testing.assert_array_equal(got, want)
+
+    def test_resize_u8_matches_numpy(self, native):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 256, (37, 23, 3), dtype=np.uint8)
+        got = native.resize_bilinear(img, 16, 16)
+        want = imops.resize(img, 16, 16)
+        # rounding at exact .5 boundaries may differ by 1
+        assert np.abs(got.astype(int) - want.astype(int)).max() <= 1
+
+    def test_resize_f32_matches_numpy(self, native):
+        rng = np.random.default_rng(1)
+        img = rng.normal(size=(12, 18, 3)).astype(np.float32)
+        got = native.resize_bilinear(img, 24, 9)
+        want = imops.resize(img, 24, 9)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_unroll_matches_numpy(self, native):
+        rng = np.random.default_rng(2)
+        img = rng.integers(0, 256, (6, 5, 3), dtype=np.uint8)
+        got = native.unroll_chw(img)
+        want = imops.unroll_chw(img)
+        np.testing.assert_array_equal(got, want)
+
+    def test_histogram_matches_jax(self, native):
+        from mmlspark_tpu.gbdt import histogram as H
+        rng = np.random.default_rng(3)
+        n, f, b = 500, 6, 32
+        bins = rng.integers(0, b, (n, f)).astype(np.int32)
+        grad = rng.normal(size=n).astype(np.float32)
+        hess = rng.uniform(0.1, 1, n).astype(np.float32)
+        mask = rng.random(n) < 0.8
+        got = native.histogram(bins, grad, hess, mask, b)
+        want = np.asarray(H.compute_histogram(bins, grad, hess, mask, b))
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_forest_predict_matches_host(self, native):
+        from mmlspark_tpu.gbdt import TrainParams
+        from mmlspark_tpu.gbdt import booster as B
+        from mmlspark_tpu.gbdt.predict import DeviceEnsemble, predict_ensemble
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(200, 5))
+        y = (X[:, 0] > 0).astype(np.float64)
+        booster = B.train(TrainParams(objective="binary", num_iterations=8,
+                                      num_leaves=7, min_data_in_leaf=5), X, y)
+        ens = DeviceEnsemble(booster.trees, 1)
+        got = native.forest_predict(
+            X.astype(np.float32), ens.feature, ens.threshold, ens.default_left,
+            ens.left, ens.right, ens.value, ens.class_of_tree, 1)
+        want = predict_ensemble(booster.trees, X, 1)
+        np.testing.assert_allclose(got, want, atol=1e-4)
