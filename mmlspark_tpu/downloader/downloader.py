@@ -50,15 +50,21 @@ class FaultToleranceUtils:
 
         last: Optional[Exception] = None
         for attempt in range(retries):
-            with ThreadPoolExecutor(max_workers=1) as pool:
-                future = pool.submit(fn)
-                try:
-                    return future.result(timeout=timeout_s)
-                except FutureTimeout as e:
-                    future.cancel()
-                    last = TimeoutError(f"operation exceeded {timeout_s}s")
-                except Exception as e:  # noqa: BLE001 — retry any failure
-                    last = e
+            # Non-context-managed on purpose: `with` would join the worker on exit,
+            # so a hung fn() blocks the caller past the timeout. shutdown(wait=False)
+            # abandons the thread (it dies with the process); callers must make fn()
+            # idempotent vs a still-running prior attempt (e.g. write to a unique
+            # temp location and atomically rename — see download_model).
+            pool = ThreadPoolExecutor(max_workers=1)
+            future = pool.submit(fn)
+            try:
+                return future.result(timeout=timeout_s)
+            except FutureTimeout:
+                last = TimeoutError(f"operation exceeded {timeout_s}s")
+            except Exception as e:  # noqa: BLE001 — retry any failure
+                last = e
+            finally:
+                pool.shutdown(wait=False, cancel_futures=True)
             time.sleep(backoff_s * (2 ** attempt))
         raise last  # type: ignore[misc]
 
@@ -136,17 +142,27 @@ class ModelDownloader:
                 f"remote model fetch for {schema.name!r} requires network access")
 
         def copy():
-            if os.path.exists(dest):
-                shutil.rmtree(dest) if os.path.isdir(dest) else os.remove(dest)
-            if os.path.isdir(src):
-                shutil.copytree(src, dest)
-            else:
-                shutil.copy(src, dest)
-            if schema.hash:
-                got = _sha256_dir(dest)
-                if got != schema.hash:
-                    raise IOError(
-                        f"hash mismatch for {schema.name}: {got} != {schema.hash}")
+            # unique staging dir + atomic rename: a timed-out prior attempt still
+            # running in its abandoned thread can never collide with this one
+            import tempfile
+
+            stage = tempfile.mkdtemp(prefix=f".{schema.name}.", dir=self.local_path)
+            staged = os.path.join(stage, "payload")
+            try:
+                if os.path.isdir(src):
+                    shutil.copytree(src, staged)
+                else:
+                    shutil.copy(src, staged)
+                if schema.hash:
+                    got = _sha256_dir(staged)
+                    if got != schema.hash:
+                        raise IOError(
+                            f"hash mismatch for {schema.name}: {got} != {schema.hash}")
+                if os.path.exists(dest):
+                    shutil.rmtree(dest) if os.path.isdir(dest) else os.remove(dest)
+                os.rename(staged, dest)
+            finally:
+                shutil.rmtree(stage, ignore_errors=True)
             return dest
 
         FaultToleranceUtils.retry_with_timeout(copy, retries=3)
@@ -196,10 +212,49 @@ class ModelDownloader:
 
     @staticmethod
     def load_function_model(schema_or_path) -> FunctionModel:
+        """Load a model payload into a FunctionModel.
+
+        Payload formats (the reference's loader accepts any CNTK graph,
+        SerializableFunction.scala:23-42; ours accepts):
+          - a native payload dir (model.json + module.pkl + params),
+          - an ONNX file (or a dir containing exactly one ``*.onnx``),
+          - a torchvision ResNet checkpoint ``*.pth``/``*.pt`` (schema.modelType
+            "torch-resnet<depth>" carries the architecture).
+        """
         from ..core.serialize import _load_value
 
-        path = (schema_or_path.uri if isinstance(schema_or_path, ModelSchema)
-                else schema_or_path)
+        schema = schema_or_path if isinstance(schema_or_path, ModelSchema) else None
+        path = schema.uri if schema is not None else schema_or_path
+
+        onnx_path = None
+        if os.path.isfile(path) and (
+                path.endswith(".onnx")
+                or (schema is not None and schema.modelType == "onnx")):
+            onnx_path = path
+        elif os.path.isdir(path) and not os.path.exists(os.path.join(path, "model.json")):
+            cands = [f for f in os.listdir(path) if f.endswith(".onnx")]
+            if len(cands) == 1:
+                onnx_path = os.path.join(path, cands[0])
+            elif cands or (schema is not None and schema.modelType == "onnx"):
+                raise ValueError(
+                    f"ONNX payload dir {path!r} must contain exactly one *.onnx "
+                    f"file; found {sorted(cands)}")
+        if onnx_path is not None:
+            from ..onnx import import_onnx
+
+            return import_onnx(
+                onnx_path,
+                layer_names=(list(schema.layerNames) or None) if schema else None,
+                name=schema.name if schema else None)
+
+        if os.path.isfile(path) and path.endswith((".pth", ".pt")):
+            from ..models.torch_import import from_torch_resnet
+
+            depth = 50
+            if schema is not None and schema.modelType.startswith("torch-resnet"):
+                depth = int(schema.modelType[len("torch-resnet"):] or 50)
+            return from_torch_resnet(path, depth=depth)
+
         with open(os.path.join(path, "model.json")) as f:
             info = json.load(f)
         import pickle

@@ -63,7 +63,11 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         in_col = self.get_or_throw("inputCol")
         out_col = self.get_or_throw("outputCol")
         model: FunctionModel = self.get_or_throw("model")
-        h, w, c = model.input_shape
+        fmt = getattr(model, "data_format", "NHWC")
+        if fmt == "NCHW":  # imported ONNX backbones
+            c, h, w = model.input_shape
+        else:
+            h, w, c = model.input_shape
         scale = self.get("scaleFactor")
 
         # 1. normalize input rows to fixed-shape HWC float32 arrays (auto-resize,
@@ -92,7 +96,9 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                 if img.shape[2] != c:
                     img = (np.repeat(img[:, :, :1], c, axis=2) if img.shape[2] < c
                            else img[:, :, :c])
-                out[i] = img.astype(np.float32) * np.float32(scale)
+                img = img.astype(np.float32) * np.float32(scale)
+                out[i] = np.ascontiguousarray(img.transpose(2, 0, 1)) \
+                    if fmt == "NCHW" else img
             return out
 
         prepped = df.with_column("__dnn_input__", prep)

@@ -16,10 +16,12 @@ from .module import (
 )
 from .resnet import build_resnet, param_shardings, resnet, resnet18, resnet50
 from .dnn_model import DNNModel
+from .graph_module import GraphModule, GraphNode
+from .torch_import import from_torch_resnet
 
 __all__ = [
     "BatchNorm", "Conv2D", "DNNModel", "Dense", "Fn", "FunctionModel",
-    "GlobalAvgPool", "MaxPool", "Module", "Residual", "Sequential",
-    "build_resnet", "flatten", "param_shardings", "relu", "resnet",
-    "resnet18", "resnet50",
+    "GlobalAvgPool", "GraphModule", "GraphNode", "MaxPool", "Module", "Residual",
+    "Sequential", "build_resnet", "flatten", "from_torch_resnet", "param_shardings",
+    "relu", "resnet", "resnet18", "resnet50",
 ]

@@ -1,0 +1,466 @@
+"""GraphModule: executes an imported op graph (ONNX semantics) as a jit-pure Module.
+
+This is the second half of the reference's external-model story: CNTK loads a serialized
+graph and evaluates it natively with name-addressable nodes
+(CNTK/SerializableFunction.scala:23-143, cntk/CNTKModel.scala:86-138). Here the imported
+graph becomes a flat list of ops executed in topological order inside one traced
+function — XLA sees the whole graph at once and fuses it like any hand-written model.
+
+Layout note: ONNX convs/pools are NCHW. We keep NCHW *semantics* (bit-parity with the
+source model, validated against torch) and let XLA's TPU layout assignment pick the
+physical tiling — `conv_general_dilated` carries explicit dimension_numbers, so the
+compiler is free to transpose internally; there is no per-op host cost.
+
+Tap points: every node name is an addressable layer path (GraphModule.layer_paths), so
+ImageFeaturizer's cutOutputLayers and DNNModel's fetch-node addressing work on imported
+models exactly as on native ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .module import Module, Params
+
+
+@dataclasses.dataclass
+class GraphNode:
+    """One op: ONNX op_type + attrs, resolved input/output tensor names."""
+
+    name: str
+    op_type: str
+    inputs: List[str]
+    outputs: List[str]
+    attrs: Dict[str, Any]
+
+
+def _pool_dims(x_shape, kernel, strides, pads, ceil_mode=False):
+    """Output spatial dims for explicit-padded pooling (NCHW, 2 spatial dims)."""
+    out = []
+    for i in range(len(kernel)):
+        size = x_shape[2 + i] + pads[i] + pads[i + len(kernel)] - kernel[i]
+        if ceil_mode:
+            out.append(-(-size // strides[i]) + 1)
+        else:
+            out.append(size // strides[i] + 1)
+    return out
+
+
+class GraphModule(Module):
+    """A Module whose forward pass is an interpreted (but traced-once) op graph.
+
+    ``params`` for this module is a flat dict {initializer_name: array}. The importer
+    pre-populates it from the ONNX file; init() simply returns those arrays (with the
+    rng ignored), so an imported model plugs into FunctionModel/DNNModel unchanged.
+    """
+
+    is_container = True
+
+    def __init__(self, nodes: Sequence[GraphNode], initializers: Dict[str, np.ndarray],
+                 input_name: str, output_name: str,
+                 input_shape: Tuple[int, ...], name: str = "graph",
+                 compute_dtype: str = "float32"):
+        self.nodes = list(nodes)
+        self.initializers = {k: np.asarray(v) for k, v in initializers.items()}
+        self.input_name = input_name
+        self.output_name = output_name
+        self.input_shape = tuple(input_shape)  # excludes batch dim, NCHW order for images
+        self.name = name
+        self.compute_dtype = compute_dtype
+
+    # -- Module contract ----------------------------------------------------
+    def init(self, rng, in_shape):
+        import jax
+
+        if tuple(in_shape) != self.input_shape:
+            raise ValueError(
+                f"GraphModule was imported for input shape {self.input_shape}, "
+                f"got {tuple(in_shape)}")
+        params = dict(self.initializers)
+        out = jax.eval_shape(
+            lambda p, x: self.apply(p, x),
+            {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in params.items()},
+            jax.ShapeDtypeStruct((1,) + self.input_shape, np.float32))
+        return params, tuple(out.shape[1:])
+
+    def layer_paths(self, prefix: str = "") -> List[str]:
+        return [f"{prefix}{n.name}" for n in self.nodes]
+
+    def apply(self, params: Params, x, train: bool = False,
+              taps: Optional[Set[str]] = None, taps_out: Optional[Dict[str, Any]] = None,
+              stats_out: Optional[Dict[str, Any]] = None, _prefix: str = ""):
+        import jax.numpy as jnp
+
+        del train, stats_out  # imported graphs run inference-mode only
+        _ensure_ops()
+        env: Dict[str, Any] = dict(params)
+        if self.compute_dtype != "float32":
+            x = x.astype(self.compute_dtype)
+        env[self.input_name] = x
+        for node in self.nodes:
+            fn = _OPS.get(node.op_type)
+            if fn is None:
+                raise NotImplementedError(
+                    f"ONNX op {node.op_type!r} (node {node.name!r}) is not supported; "
+                    f"supported: {sorted(_OPS)}")
+            args = [env[i] if i else None for i in node.inputs]
+            res = fn(node, args, self.compute_dtype)
+            if not isinstance(res, tuple):
+                res = (res,)
+            for out_name, val in zip(node.outputs, res):
+                if out_name:
+                    env[out_name] = val
+            path = f"{_prefix}{node.name}"
+            if taps is not None and taps_out is not None and path in taps:
+                taps_out[path] = env[node.outputs[0]]
+        out = env[self.output_name]
+        return out.astype(jnp.float32) if out.dtype != jnp.int64 else out
+
+
+# ---------------------------------------------------------------------------
+# Op kernels. Each takes (node, args, compute_dtype) and returns array or tuple.
+# Semantics follow the ONNX operator spec (opset 13); correctness is pinned by
+# tests/test_onnx.py comparing against torch reference forwards.
+# ---------------------------------------------------------------------------
+
+
+def _op_conv(node, args, cdt):
+    import jax
+    import jax.numpy as jnp
+
+    x, w = args[0], args[1]
+    b = args[2] if len(args) > 2 else None
+    group = int(node.attrs.get("group", 1))
+    strides = tuple(node.attrs.get("strides", [1] * (w.ndim - 2)))
+    dilations = tuple(node.attrs.get("dilations", [1] * (w.ndim - 2)))
+    nspatial = w.ndim - 2
+    pads = node.attrs.get("pads")
+    auto_pad = node.attrs.get("auto_pad", b"NOTSET")
+    auto_pad = auto_pad.decode() if isinstance(auto_pad, bytes) else auto_pad
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+        padding = "SAME"
+    elif pads:
+        padding = [(int(pads[i]), int(pads[i + nspatial])) for i in range(nspatial)]
+    else:
+        padding = [(0, 0)] * nspatial
+    specs = {1: ("NCH", "OIH"), 2: ("NCHW", "OIHW"), 3: ("NCDHW", "OIDHW")}
+    if nspatial not in specs:
+        raise NotImplementedError(f"Conv with {nspatial} spatial dims")
+    lhs_spec, rhs_spec = specs[nspatial]
+    y = jax.lax.conv_general_dilated(
+        x.astype(cdt), jnp.asarray(w).astype(cdt),
+        window_strides=strides, padding=padding, rhs_dilation=dilations,
+        dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+        feature_group_count=group,
+        preferred_element_type=jnp.float32)
+    y = y.astype(cdt)
+    if b is not None:
+        y = y + jnp.asarray(b).astype(y.dtype).reshape((1, -1) + (1,) * nspatial)
+    return y
+
+
+def _op_bn(node, args, cdt):
+    import jax.numpy as jnp
+
+    x, scale, bias, mean, var = args[:5]
+    eps = float(node.attrs.get("epsilon", 1e-5))
+    inv = jnp.asarray(scale) / jnp.sqrt(jnp.asarray(var).astype(np.float32) + eps)
+    shift = jnp.asarray(bias) - jnp.asarray(mean) * inv
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return x * inv.reshape(shape).astype(x.dtype) + shift.reshape(shape).astype(x.dtype)
+
+
+def _op_gemm(node, args, cdt):
+    import jax.numpy as jnp
+
+    a, b = args[0], args[1]
+    c = args[2] if len(args) > 2 else None
+    alpha = float(node.attrs.get("alpha", 1.0))
+    beta = float(node.attrs.get("beta", 1.0))
+    if int(node.attrs.get("transA", 0)):
+        a = a.T
+    if int(node.attrs.get("transB", 0)):
+        b = jnp.asarray(b).T
+    y = jnp.dot(a.astype(cdt), jnp.asarray(b).astype(cdt),
+                preferred_element_type=jnp.float32).astype(jnp.float32)
+    if alpha != 1.0:
+        y = y * alpha
+    if c is not None:
+        y = y + beta * jnp.asarray(c)
+    return y.astype(cdt)
+
+
+def _window_op(node, args, cdt, reducer, init_val, is_avg=False):
+    import jax
+    import jax.numpy as jnp
+
+    x = args[0]
+    kernel = tuple(int(k) for k in node.attrs["kernel_shape"])
+    nspatial = len(kernel)
+    strides = tuple(int(s) for s in node.attrs.get("strides", [1] * nspatial))
+    pads = node.attrs.get("pads", [0] * 2 * nspatial)
+    auto_pad = node.attrs.get("auto_pad", b"NOTSET")
+    auto_pad = auto_pad.decode() if isinstance(auto_pad, bytes) else auto_pad
+    ceil_mode = int(node.attrs.get("ceil_mode", 0))
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+        padding = "SAME"
+    else:
+        padding = [(int(pads[i]), int(pads[i + nspatial])) for i in range(nspatial)]
+        if ceil_mode and padding != "SAME":
+            # grow right/bottom pad so ceil-divided windows fit
+            for i in range(nspatial):
+                size = x.shape[2 + i] + padding[i][0] + padding[i][1] - kernel[i]
+                if size % strides[i]:
+                    padding[i] = (padding[i][0],
+                                  padding[i][1] + strides[i] - size % strides[i])
+    window = (1, 1) + kernel
+    strides_full = (1, 1) + strides
+    pad_full = ([(0, 0), (0, 0)] + list(padding)) if padding != "SAME" else "SAME"
+    if is_avg:
+        ones = jnp.ones_like(x)
+        s = jax.lax.reduce_window(x.astype(np.float32), 0.0, jax.lax.add,
+                                  window, strides_full, pad_full)
+        if int(node.attrs.get("count_include_pad", 0)):
+            denom = float(np.prod(kernel))
+            return (s / denom).astype(x.dtype)
+        cnt = jax.lax.reduce_window(ones.astype(np.float32), 0.0, jax.lax.add,
+                                    window, strides_full, pad_full)
+        return (s / cnt).astype(x.dtype)
+    return jax.lax.reduce_window(x, init_val, reducer, window, strides_full, pad_full)
+
+
+def _op_maxpool(node, args, cdt):
+    import jax
+
+    return _window_op(node, args, cdt, jax.lax.max, -np.inf)
+
+
+def _op_avgpool(node, args, cdt):
+    return _window_op(node, args, cdt, None, 0.0, is_avg=True)
+
+
+def _op_global_avgpool(node, args, cdt):
+    import jax.numpy as jnp
+
+    x = args[0]
+    axes = tuple(range(2, x.ndim))
+    return jnp.mean(x.astype(np.float32), axis=axes, keepdims=True).astype(x.dtype)
+
+
+def _op_reshape(node, args, cdt):
+    import jax.numpy as jnp
+
+    x, shape = args[0], np.asarray(args[1]).tolist()
+    # ONNX: 0 means "copy dim from input"; -1 infers
+    shape = [x.shape[i] if s == 0 else int(s) for i, s in enumerate(shape)]
+    return jnp.reshape(x, shape)
+
+
+def _op_flatten(node, args, cdt):
+    import jax.numpy as jnp
+
+    x = args[0]
+    axis = int(node.attrs.get("axis", 1))
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+def _op_concat(node, args, cdt):
+    import jax.numpy as jnp
+
+    return jnp.concatenate(args, axis=int(node.attrs.get("axis", 0)))
+
+
+def _op_pad(node, args, cdt):
+    import jax.numpy as jnp
+
+    x = args[0]
+    if len(args) > 1 and args[1] is not None:
+        pads = np.asarray(args[1]).tolist()
+    else:
+        pads = node.attrs.get("pads", [0] * 2 * x.ndim)
+    value = float(np.asarray(args[2])) if len(args) > 2 and args[2] is not None \
+        else float(node.attrs.get("value", 0.0))
+    mode = node.attrs.get("mode", b"constant")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    n = x.ndim
+    widths = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+    if mode == "constant":
+        return jnp.pad(x, widths, constant_values=value)
+    return jnp.pad(x, widths, mode={"reflect": "reflect", "edge": "edge"}[mode])
+
+
+def _op_clip(node, args, cdt):
+    import jax.numpy as jnp
+
+    x = args[0]
+    lo = args[1] if len(args) > 1 and args[1] is not None else node.attrs.get("min")
+    hi = args[2] if len(args) > 2 and args[2] is not None else node.attrs.get("max")
+    if lo is not None:
+        x = jnp.maximum(x, jnp.asarray(lo).astype(x.dtype))
+    if hi is not None:
+        x = jnp.minimum(x, jnp.asarray(hi).astype(x.dtype))
+    return x
+
+
+def _op_transpose(node, args, cdt):
+    import jax.numpy as jnp
+
+    perm = node.attrs.get("perm")
+    return jnp.transpose(args[0], axes=perm)
+
+
+def _op_softmax(node, args, cdt):
+    import jax
+
+    return jax.nn.softmax(args[0].astype(np.float32),
+                          axis=int(node.attrs.get("axis", -1))).astype(args[0].dtype)
+
+
+def _op_reduce_mean(node, args, cdt):
+    import jax.numpy as jnp
+
+    axes = node.attrs.get("axes")
+    if axes is None and len(args) > 1 and args[1] is not None:
+        axes = np.asarray(args[1]).tolist()
+    keepdims = bool(node.attrs.get("keepdims", 1))
+    return jnp.mean(args[0], axis=tuple(axes) if axes else None, keepdims=keepdims)
+
+
+def _op_resize(node, args, cdt):
+    import jax
+
+    x = args[0]
+    # inputs: X, roi, scales, sizes (opset 11+). Only nearest/linear on NCHW.
+    sizes = args[3] if len(args) > 3 and args[3] is not None else None
+    scales = args[2] if len(args) > 2 and args[2] is not None else None
+    if sizes is not None:
+        out_shape = tuple(int(s) for s in np.asarray(sizes).tolist())
+    elif scales is not None:
+        sc = np.asarray(scales).tolist()
+        out_shape = tuple(int(round(d * s)) for d, s in zip(x.shape, sc))
+    else:
+        raise ValueError("Resize needs scales or sizes")
+    mode = node.attrs.get("mode", b"nearest")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    method = {"nearest": "nearest", "linear": "bilinear", "cubic": "bicubic"}[mode]
+    return jax.image.resize(x, out_shape, method=method)
+
+
+def _unary(fn):
+    return lambda node, args, cdt: fn(args[0])
+
+
+def _binary(fn):
+    return lambda node, args, cdt: fn(args[0], args[1])
+
+
+def _make_ops() -> Dict[str, Callable]:
+    import jax
+    import jax.numpy as jnp
+
+    return {
+        "Conv": _op_conv,
+        "BatchNormalization": _op_bn,
+        "Gemm": _op_gemm,
+        "MatMul": _binary(lambda a, b: jnp.matmul(
+            a, b, preferred_element_type=jnp.float32).astype(a.dtype)),
+        "MaxPool": _op_maxpool,
+        "AveragePool": _op_avgpool,
+        "GlobalAveragePool": _op_global_avgpool,
+        "Relu": _unary(lambda x: jnp.maximum(x, 0)),
+        "LeakyRelu": lambda n, a, c: jnp.where(
+            a[0] > 0, a[0], a[0] * np.float32(n.attrs.get("alpha", 0.01))),
+        "Sigmoid": _unary(jax.nn.sigmoid),
+        "HardSigmoid": lambda n, a, c: jnp.clip(
+            a[0] * np.float32(n.attrs.get("alpha", 0.2))
+            + np.float32(n.attrs.get("beta", 0.5)), 0, 1),
+        "HardSwish": _unary(jax.nn.hard_swish),
+        "Tanh": _unary(jnp.tanh),
+        "Erf": _unary(jax.lax.erf),
+        "Exp": _unary(jnp.exp),
+        "Sqrt": _unary(jnp.sqrt),
+        "Reciprocal": _unary(jnp.reciprocal),
+        "Neg": _unary(jnp.negative),
+        "Abs": _unary(jnp.abs),
+        "Softmax": _op_softmax,
+        "Add": _binary(jnp.add),
+        "Sub": _binary(jnp.subtract),
+        "Mul": _binary(jnp.multiply),
+        "Div": _binary(jnp.divide),
+        "Pow": _binary(jnp.power),
+        "Min": lambda n, a, c: jnp.minimum(a[0], a[1]),
+        "Max": lambda n, a, c: jnp.maximum(a[0], a[1]),
+        "Concat": _op_concat,
+        "Reshape": _op_reshape,
+        "Flatten": _op_flatten,
+        "Transpose": _op_transpose,
+        "Pad": _op_pad,
+        "Clip": _op_clip,
+        "Identity": _unary(lambda x: x),
+        "Dropout": lambda n, a, c: a[0],  # inference mode
+        "Cast": lambda n, a, c: a[0].astype(
+            {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+             10: np.float16, 11: np.float64}[int(n.attrs.get("to", 1))]),
+        "ReduceMean": _op_reduce_mean,
+        "Resize": _op_resize,
+        "Shape": lambda n, a, c: jnp.asarray(a[0].shape, dtype=jnp.int64),
+        "Gather": lambda n, a, c: jnp.take(
+            a[0], jnp.asarray(a[1]), axis=int(n.attrs.get("axis", 0))),
+        "Unsqueeze": lambda n, a, c: jnp.expand_dims(
+            a[0], tuple(int(x) for x in (
+                n.attrs.get("axes") or np.asarray(a[1]).tolist()))),
+        "Squeeze": lambda n, a, c: jnp.squeeze(
+            a[0], tuple(int(x) for x in (
+                n.attrs.get("axes") or np.asarray(a[1]).tolist()))),
+        "Slice": _op_slice,
+        "Split": _op_split,
+    }
+
+
+def _op_slice(node, args, cdt):
+    x = args[0]
+    if "starts" in node.attrs:  # opset 1-9 attribute form
+        starts = node.attrs["starts"]
+        ends = node.attrs["ends"]
+        axes = node.attrs.get("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    else:
+        starts = np.asarray(args[1]).tolist()
+        ends = np.asarray(args[2]).tolist()
+        axes = (np.asarray(args[3]).tolist() if len(args) > 3 and args[3] is not None
+                else list(range(len(starts))))
+        steps = (np.asarray(args[4]).tolist() if len(args) > 4 and args[4] is not None
+                 else [1] * len(starts))
+    idx: List[Any] = [slice(None)] * x.ndim
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        idx[int(a)] = slice(int(s) if s > -2**62 else None,
+                            int(e) if abs(e) < 2**62 else None, int(st))
+    return x[tuple(idx)]
+
+
+def _op_split(node, args, cdt):
+    import jax.numpy as jnp
+
+    x = args[0]
+    axis = int(node.attrs.get("axis", 0))
+    split = node.attrs.get("split")
+    if split is None and len(args) > 1 and args[1] is not None:
+        split = np.asarray(args[1]).tolist()
+    if split is None:
+        n_out = len(node.outputs)
+        return tuple(jnp.split(x, n_out, axis=axis))
+    points = np.cumsum(split)[:-1].tolist()
+    return tuple(jnp.split(x, points, axis=axis))
+
+
+# op table built lazily on first apply (jax import deferred like the rest of module.py)
+_OPS: Dict[str, Callable] = {}
+
+
+def _ensure_ops() -> None:
+    if not _OPS:
+        _OPS.update(_make_ops())

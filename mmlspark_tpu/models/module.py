@@ -153,16 +153,39 @@ def flatten() -> Fn:
     return Fn(_flatten_fn, _flat_shape)
 
 
+def _conv_out_dim(size: int, k: int, stride: int, pad) -> int:
+    """Output spatial dim for one axis; pad is 'SAME' | 'VALID' | (lo, hi)."""
+    if pad == "SAME":
+        return -(-size // stride)
+    if pad == "VALID":
+        return (size - k) // stride + 1
+    lo, hi = pad
+    return (size + lo + hi - k) // stride + 1
+
+
+def _axis_pads(padding, n_axes: int):
+    """Normalize a padding spec to per-axis entries for _conv_out_dim."""
+    if isinstance(padding, str):
+        return [padding] * n_axes
+    return list(padding)
+
+
 class Conv2D(Module):
-    """NHWC conv on the MXU: bf16 inputs/kernel, f32 accumulation (preferred_element_type)."""
+    """NHWC conv on the MXU: bf16 inputs/kernel, f32 accumulation (preferred_element_type).
+
+    ``padding``: "SAME" | "VALID" | explicit ((top,bottom),(left,right)) — the explicit
+    form gives bit-parity with frameworks that pad symmetrically where XLA's SAME would
+    split the remainder low/high differently (torch transplants, see torch_import.py).
+    """
 
     def __init__(self, features: int, kernel: Tuple[int, int] = (3, 3),
-                 strides: Tuple[int, int] = (1, 1), padding: str = "SAME",
+                 strides: Tuple[int, int] = (1, 1), padding="SAME",
                  use_bias: bool = False):
         self.features = features
         self.kernel = kernel
         self.strides = strides
-        self.padding = padding
+        self.padding = padding if isinstance(padding, str) else \
+            tuple((int(a), int(b)) for a, b in padding)
         self.use_bias = use_bias
 
     def init(self, rng, in_shape):
@@ -176,12 +199,9 @@ class Conv2D(Module):
         params = {"kernel": kernel}
         if self.use_bias:
             params["bias"] = np.zeros((self.features,), dtype=np.float32)
-        if self.padding == "SAME":
-            oh = -(-h // self.strides[0])
-            ow = -(-w // self.strides[1])
-        else:
-            oh = (h - kh) // self.strides[0] + 1
-            ow = (w - kw) // self.strides[1] + 1
+        ph, pw = _axis_pads(self.padding, 2)
+        oh = _conv_out_dim(h, kh, self.strides[0], ph)
+        ow = _conv_out_dim(w, kw, self.strides[1], pw)
         return params, (oh, ow, self.features)
 
     def apply(self, params, x, train: bool = False):
@@ -191,7 +211,7 @@ class Conv2D(Module):
             x.astype(jnp.bfloat16),
             jnp.asarray(params["kernel"]).astype(jnp.bfloat16),
             window_strides=self.strides,
-            padding=self.padding,
+            padding=self.padding if isinstance(self.padding, str) else list(self.padding),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )  # bf16 activations end-to-end: half the HBM traffic; MXU accumulates f32
         if self.use_bias:
@@ -269,28 +289,31 @@ class BatchNorm(Module):
 
 
 class MaxPool(Module):
+    """Max pooling; ``padding`` like Conv2D ("SAME"/"VALID"/explicit per-axis pairs).
+    Explicit pads fill with -inf (pure window semantics, matches torch)."""
+
     def __init__(self, window: Tuple[int, int] = (2, 2),
-                 strides: Optional[Tuple[int, int]] = None, padding: str = "SAME"):
+                 strides: Optional[Tuple[int, int]] = None, padding="SAME"):
         self.window = window
         self.strides = strides or window
-        self.padding = padding
+        self.padding = padding if isinstance(padding, str) else \
+            tuple((int(a), int(b)) for a, b in padding)
 
     def init(self, rng, in_shape):
         h, w, c = in_shape
-        if self.padding == "SAME":
-            oh = -(-h // self.strides[0])
-            ow = -(-w // self.strides[1])
-        else:
-            oh = (h - self.window[0]) // self.strides[0] + 1
-            ow = (w - self.window[1]) // self.strides[1] + 1
+        ph, pw = _axis_pads(self.padding, 2)
+        oh = _conv_out_dim(h, self.window[0], self.strides[0], ph)
+        ow = _conv_out_dim(w, self.window[1], self.strides[1], pw)
         return {}, (oh, ow, c)
 
     def apply(self, params, x, train: bool = False):
         import jax
         import jax.numpy as jnp
+        pad = self.padding if isinstance(self.padding, str) else \
+            [(0, 0)] + list(self.padding) + [(0, 0)]
         return jax.lax.reduce_window(
             x, -jnp.inf, jax.lax.max,
-            (1,) + self.window + (1,), (1,) + self.strides + (1,), self.padding)
+            (1,) + self.window + (1,), (1,) + self.strides + (1,), pad)
 
 
 class GlobalAvgPool(Module):
@@ -373,6 +396,9 @@ class FunctionModel:
     input_shape: Tuple[int, ...]
     layer_names: List[str] = dataclasses.field(default_factory=list)
     name: str = "model"
+    # image-input layout: native modules are NHWC; ONNX imports are NCHW.
+    # Consumers (ImageFeaturizer) read this to orient the pixel array.
+    data_format: str = "NHWC"
 
     def argument_names(self) -> List[str]:
         return ["ARGUMENT_0"]
@@ -401,7 +427,8 @@ class FunctionModel:
         if tap is None:
             return self.module.apply(self.params, x, train=train)
         taps_out: Dict[str, Any] = {}
-        assert isinstance(self.module, Sequential), "taps need a Sequential root"
+        assert getattr(self.module, "is_container", False), \
+            "taps need a container root (Sequential/GraphModule)"
         self.module.apply(self.params, x, train=train, taps={tap}, taps_out=taps_out)
         if tap not in taps_out:
             raise KeyError(f"Tap {tap!r} not produced; known {self.module.layer_paths()[:20]}")

@@ -34,13 +34,21 @@ from .module import (
 )
 
 
-def _bottleneck(in_ch: int, mid_ch: int, stride: int) -> Residual:
+def _pad(k: int, torch_style: bool):
+    """XLA "SAME" vs torch's symmetric k//2 pad: identical at stride 1, but at stride 2
+    SAME splits the remainder (0,1) where torch pads (1,1) — explicit pads give exact
+    transplant parity (torch_import.py)."""
+    return ((k // 2, k // 2),) * 2 if torch_style else "SAME"
+
+
+def _bottleneck(in_ch: int, mid_ch: int, stride: int,
+                torch_padding: bool = False) -> Residual:
     out_ch = mid_ch * 4
     body = Sequential([
         ("conv1", Conv2D(mid_ch, (1, 1))),
         ("bn1", BatchNorm()),
         ("relu1", relu()),
-        ("conv2", Conv2D(mid_ch, (3, 3), (stride, stride))),
+        ("conv2", Conv2D(mid_ch, (3, 3), (stride, stride), _pad(3, torch_padding))),
         ("bn2", BatchNorm()),
         ("relu2", relu()),
         ("conv3", Conv2D(out_ch, (1, 1))),
@@ -55,12 +63,13 @@ def _bottleneck(in_ch: int, mid_ch: int, stride: int) -> Residual:
     return Residual(body, shortcut)
 
 
-def _basic(in_ch: int, out_ch: int, stride: int) -> Residual:
+def _basic(in_ch: int, out_ch: int, stride: int,
+           torch_padding: bool = False) -> Residual:
     body = Sequential([
-        ("conv1", Conv2D(out_ch, (3, 3), (stride, stride))),
+        ("conv1", Conv2D(out_ch, (3, 3), (stride, stride), _pad(3, torch_padding))),
         ("bn1", BatchNorm()),
         ("relu1", relu()),
-        ("conv2", Conv2D(out_ch, (3, 3))),
+        ("conv2", Conv2D(out_ch, (3, 3), padding=_pad(3, torch_padding))),
         ("bn2", BatchNorm()),
     ])
     shortcut = None
@@ -83,15 +92,16 @@ _CONFIGS = {
 
 def build_resnet(depth: int = 50, num_classes: int = 1000,
                  image_size: int = 224, channels: int = 3,
-                 width: int = 64) -> Sequential:
+                 width: int = 64, torch_padding: bool = False) -> Sequential:
     kind, blocks = _CONFIGS[depth]
     expansion = 4 if kind == "bottleneck" else 1
     layers: List[Tuple[str, "Sequential"]] = [
         ("stem", Sequential([
-            ("conv", Conv2D(width, (7, 7), (2, 2))),
+            ("conv", Conv2D(width, (7, 7), (2, 2), _pad(7, torch_padding))),
             ("bn", BatchNorm()),
             ("relu", relu()),
-            ("pool", MaxPool((3, 3), (2, 2), "SAME")),
+            ("pool", MaxPool((3, 3), (2, 2),
+                             ((1, 1), (1, 1)) if torch_padding else "SAME")),
         ])),
     ]
     in_ch = width
@@ -101,10 +111,10 @@ def build_resnet(depth: int = 50, num_classes: int = 1000,
         for j in range(n):
             stride = 2 if (i > 0 and j == 0) else 1
             if kind == "bottleneck":
-                stage.append((str(j), _bottleneck(in_ch, ch, stride)))
+                stage.append((str(j), _bottleneck(in_ch, ch, stride, torch_padding)))
                 in_ch = ch * expansion
             else:
-                stage.append((str(j), _basic(in_ch, ch, stride)))
+                stage.append((str(j), _basic(in_ch, ch, stride, torch_padding)))
                 in_ch = ch
         layers.append((f"layer{i + 1}", Sequential(stage)))
     layers.append(("avgpool", GlobalAvgPool()))

@@ -1,0 +1,106 @@
+"""Import torchvision-style ResNet checkpoints into native NHWC FunctionModels.
+
+The reference's transfer-learning story starts from *real pretrained* backbones pulled
+by ModelDownloader (downloader/ModelDownloader.scala:27-120); this module is the direct
+path for the dominant pretrained-weight ecosystem: a torchvision `resnetXX`
+``state_dict`` (an ImageNet checkpoint .pth) becomes our native ResNet — NHWC, bf16
+MXU convs, name-addressable layers — with exact numerics (explicit torch-style padding,
+see resnet._pad).
+
+Accepts a state_dict mapping or a .pth path (torch.load on CPU; torch is an allowed
+host-side dependency — it never touches the TPU compute path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .module import FunctionModel
+from .resnet import _CONFIGS, build_resnet
+
+
+def _to_np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    return t.detach().cpu().numpy()  # torch tensor
+
+
+def _conv(sd: Dict, key: str) -> Dict[str, np.ndarray]:
+    # torch OIHW -> our HWIO
+    p = {"kernel": np.transpose(_to_np(sd[key + ".weight"]), (2, 3, 1, 0))
+         .astype(np.float32)}
+    if key + ".bias" in sd:
+        p["bias"] = _to_np(sd[key + ".bias"]).astype(np.float32)
+    return p
+
+
+def _bn(sd: Dict, key: str) -> Dict[str, np.ndarray]:
+    return {
+        "scale": _to_np(sd[key + ".weight"]).astype(np.float32),
+        "bias": _to_np(sd[key + ".bias"]).astype(np.float32),
+        "mean": _to_np(sd[key + ".running_mean"]).astype(np.float32),
+        "var": _to_np(sd[key + ".running_var"]).astype(np.float32),
+    }
+
+
+def from_torch_resnet(state_dict, depth: int = 50, num_classes: int = None,
+                      image_size: int = 224) -> FunctionModel:
+    """Map a torchvision resnet{18,34,50,101,152} state_dict onto a native FunctionModel.
+
+    num_classes defaults to the checkpoint's own head width (fc.weight rows)."""
+    if isinstance(state_dict, (str, bytes)):
+        import torch
+
+        state_dict = torch.load(state_dict, map_location="cpu", weights_only=True)
+    if hasattr(state_dict, "state_dict"):  # a whole nn.Module
+        state_dict = state_dict.state_dict()
+    sd = dict(state_dict)
+    if num_classes is None:
+        num_classes = int(_to_np(sd["fc.weight"]).shape[0])
+
+    kind, blocks = _CONFIGS[depth]
+    module = build_resnet(depth, num_classes=num_classes, image_size=image_size,
+                          torch_padding=True)
+
+    params: Dict = {
+        "stem": {"conv": _conv(sd, "conv1"), "bn": _bn(sd, "bn1")},
+    }
+    n_body_convs = 3 if kind == "bottleneck" else 2
+    for i, n in enumerate(blocks):
+        stage: Dict = {}
+        for j in range(n):
+            tk = f"layer{i + 1}.{j}"
+            body: Dict = {}
+            for c in range(1, n_body_convs + 1):
+                body[f"conv{c}"] = _conv(sd, f"{tk}.conv{c}")
+                body[f"bn{c}"] = _bn(sd, f"{tk}.bn{c}")
+            block: Dict = {"body": body}
+            if f"{tk}.downsample.0.weight" in sd:
+                block["shortcut"] = {"conv": _conv(sd, f"{tk}.downsample.0"),
+                                     "bn": _bn(sd, f"{tk}.downsample.1")}
+            stage[str(j)] = block
+        params[f"layer{i + 1}"] = stage
+
+    fc_w = _to_np(sd["fc.weight"]).astype(np.float32)  # (out, in) -> (in, out)
+    params["fc"] = {"kernel": fc_w.T.copy(), "bias": _to_np(sd["fc.bias"]).astype(np.float32)}
+
+    # shape-check the transplant against the module's own init structure
+    import jax
+
+    ref_params, out_shape = module.init(jax.random.PRNGKey(0),
+                                        (image_size, image_size, 3))
+    ref_shapes = jax.tree.map(lambda a: a.shape, ref_params)
+    got_shapes = jax.tree.map(lambda a: a.shape, params)
+    if ref_shapes != got_shapes:
+        raise ValueError(
+            "state_dict structure does not match resnet"
+            f"{depth}: expected {ref_shapes}\ngot {got_shapes}")
+    if out_shape != (num_classes,):
+        raise ValueError(f"head mismatch: {out_shape} vs num_classes={num_classes}")
+
+    layer_names = ["fc", "avgpool", "layer4", "layer3", "layer2", "layer1", "stem"]
+    return FunctionModel(module=module, params=params,
+                         input_shape=(image_size, image_size, 3),
+                         layer_names=layer_names, name=f"resnet{depth}")

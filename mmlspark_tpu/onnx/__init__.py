@@ -1,0 +1,11 @@
+"""ONNX interchange: import external pretrained checkpoints, export native models.
+
+Replaces the reference's CNTK-format model loading (CNTK/SerializableFunction.scala)
+with the open interchange format; no onnx/protobuf pip deps (see proto.py).
+"""
+
+from .importer import import_onnx
+from .export import export_onnx
+from . import proto
+
+__all__ = ["import_onnx", "export_onnx", "proto"]

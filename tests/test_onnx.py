@@ -1,0 +1,466 @@
+"""ONNX import/export + torch-checkpoint import.
+
+Validation strategy (reference: the CNTK bridge is unit-tested directly against the
+native engine, cntk/CNTKBindingSuite.scala):
+  - proto round-trip: writer bytes parse back identically,
+  - torch cross-validation: a torch CNN's weights hand-packed into ONNX by our writer,
+    imported by our reader, must reproduce torch's forward within 1e-3,
+  - native round-trip: export_onnx(resnet18) -> import_onnx reproduces the native model,
+  - from_torch_resnet: transplanted torchvision-style ResNet matches torch bit-nearly.
+"""
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu.onnx.proto as proto
+from mmlspark_tpu.onnx import export_onnx, import_onnx
+
+torch = pytest.importorskip("torch")
+
+
+def _onnx_from_torch_seq(model, in_shape, path):
+    """Hand-pack a small eval-mode torch CNN into ONNX bytes with our writer.
+
+    Supports the layer types used in the fixtures below. This deliberately exercises
+    the *reader* against torch's reference numerics without needing the onnx package.
+    """
+    import torch.nn as nn
+
+    nodes, inits = [], []
+    cur = "input"
+    n = [0]
+
+    def t(hint):
+        n[0] += 1
+        return f"{hint}_{n[0]}"
+
+    def add_init(hint, arr):
+        name = t(hint)
+        inits.append(proto.make_tensor(name, np.ascontiguousarray(arr)))
+        return name
+
+    def emit(op, ins, hint, **attrs):
+        out = t(hint)
+        nodes.append(proto.make_node(op, ins, [out], name=out, **attrs))
+        return out
+
+    for layer in model:
+        if isinstance(layer, nn.Conv2d):
+            w = layer.weight.detach().numpy()
+            ins = [cur, add_init("w", w)]
+            if layer.bias is not None:
+                ins.append(add_init("b", layer.bias.detach().numpy()))
+            p = layer.padding if isinstance(layer.padding, tuple) else (layer.padding,) * 2
+            cur = emit("Conv", ins, "conv",
+                       strides=list(layer.stride),
+                       kernel_shape=list(layer.kernel_size),
+                       pads=[p[0], p[1], p[0], p[1]],
+                       group=layer.groups)
+        elif isinstance(layer, nn.BatchNorm2d):
+            ins = [cur,
+                   add_init("s", layer.weight.detach().numpy()),
+                   add_init("bb", layer.bias.detach().numpy()),
+                   add_init("m", layer.running_mean.numpy()),
+                   add_init("v", layer.running_var.numpy())]
+            cur = emit("BatchNormalization", ins, "bn", epsilon=float(layer.eps))
+        elif isinstance(layer, nn.ReLU):
+            cur = emit("Relu", [cur], "relu")
+        elif isinstance(layer, nn.MaxPool2d):
+            k = layer.kernel_size if isinstance(layer.kernel_size, tuple) \
+                else (layer.kernel_size,) * 2
+            s = layer.stride if isinstance(layer.stride, tuple) else (layer.stride,) * 2
+            p = layer.padding if isinstance(layer.padding, tuple) else (layer.padding,) * 2
+            cur = emit("MaxPool", [cur], "maxpool", kernel_shape=list(k),
+                       strides=list(s), pads=[p[0], p[1], p[0], p[1]],
+                       ceil_mode=int(layer.ceil_mode))
+        elif isinstance(layer, nn.AvgPool2d):
+            k = layer.kernel_size if isinstance(layer.kernel_size, tuple) \
+                else (layer.kernel_size,) * 2
+            cur = emit("AveragePool", [cur], "avgpool", kernel_shape=list(k),
+                       strides=list(k),
+                       count_include_pad=int(layer.count_include_pad))
+        elif isinstance(layer, nn.AdaptiveAvgPool2d):
+            cur = emit("GlobalAveragePool", [cur], "gap")
+        elif isinstance(layer, nn.Flatten):
+            cur = emit("Flatten", [cur], "flatten", axis=1)
+        elif isinstance(layer, nn.Linear):
+            ins = [cur, add_init("fw", layer.weight.detach().numpy())]
+            if layer.bias is not None:
+                ins.append(add_init("fb", layer.bias.detach().numpy()))
+            cur = emit("Gemm", ins, "gemm", transB=1)
+        elif isinstance(layer, nn.Sigmoid):
+            cur = emit("Sigmoid", [cur], "sigmoid")
+        elif isinstance(layer, nn.Dropout):
+            cur = emit("Dropout", [cur], "dropout")
+        else:
+            raise NotImplementedError(type(layer))
+
+    blob = proto.make_model(
+        nodes, inits,
+        [proto.make_value_info("input", [None] + list(in_shape))],
+        [proto.make_value_info(cur, [None, -1])])
+    with open(path, "wb") as fh:
+        fh.write(blob)
+    return path
+
+
+class TestProtoRoundTrip:
+    def test_tensor_roundtrip(self):
+        for arr in [np.arange(12, dtype=np.float32).reshape(3, 4),
+                    np.array([1, -5, 2**40], dtype=np.int64),
+                    np.random.default_rng(0).normal(size=(2, 3, 4, 5)).astype(np.float32)]:
+            blob = proto.make_tensor("t", arr).tobytes()
+            back = proto.Tensor(blob)
+            assert back.name == "t"
+            np.testing.assert_array_equal(back.to_numpy(), arr)
+
+    def test_node_attrs_roundtrip(self):
+        blob = proto.make_node("Conv", ["x", "w"], ["y"], name="c1",
+                               strides=[2, 2], pads=[3, 3, 3, 3],
+                               epsilon=1e-5, mode="constant").tobytes()
+        node = proto.Node(blob)
+        assert node.op_type == "Conv"
+        assert node.inputs == ["x", "w"] and node.outputs == ["y"]
+        assert node.attrs["strides"] == [2, 2]
+        assert node.attrs["pads"] == [3, 3, 3, 3]
+        assert abs(node.attrs["epsilon"] - 1e-5) < 1e-12
+        assert node.attrs["mode"] == b"constant"
+
+    def test_model_roundtrip(self):
+        w = np.random.default_rng(1).normal(size=(4, 3)).astype(np.float32)
+        blob = proto.make_model(
+            [proto.make_node("Gemm", ["input", "w"], ["out"], name="g", transB=1)],
+            [proto.make_tensor("w", w)],
+            [proto.make_value_info("input", [None, 3])],
+            [proto.make_value_info("out", [None, 4])])
+        m = proto.Model(blob)
+        assert m.graph.nodes[0].op_type == "Gemm"
+        assert m.graph.inputs[0].dims == [None, 3]
+        np.testing.assert_array_equal(m.graph.initializers[0].to_numpy(), w)
+        assert m.opset == 13
+
+
+class TestTorchCrossValidation:
+    """Imported graphs must reproduce torch's reference forward pass."""
+
+    def _check(self, model, in_shape, tmp_path, atol=1e-3):
+        import torch
+
+        model.eval()
+        path = _onnx_from_torch_seq(model, in_shape, str(tmp_path / "m.onnx"))
+        fm = import_onnx(path)
+        x = np.random.default_rng(7).normal(size=(4,) + tuple(in_shape)).astype(np.float32)
+        with torch.no_grad():
+            want = model(torch.from_numpy(x)).numpy()
+        got = np.asarray(fm.apply(x))
+        np.testing.assert_allclose(got, want.reshape(got.shape), atol=atol, rtol=1e-3)
+        return fm
+
+    def test_conv_bn_relu_pool_linear(self, tmp_path):
+        import torch.nn as nn
+
+        torch.manual_seed(0)
+        model = nn.Sequential(
+            nn.Conv2d(3, 8, 3, stride=2, padding=1),
+            nn.BatchNorm2d(8), nn.ReLU(),
+            nn.MaxPool2d(3, stride=2, padding=1),
+            nn.Conv2d(8, 16, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(16, 5))
+        # make BN stats non-trivial
+        model[1].running_mean.normal_(0, 0.5)
+        model[1].running_var.uniform_(0.5, 2.0)
+        self._check(model, (3, 17, 17), tmp_path)  # odd dims: exercises pad math
+
+    def test_grouped_conv_sigmoid(self, tmp_path):
+        import torch.nn as nn
+
+        torch.manual_seed(1)
+        model = nn.Sequential(
+            nn.Conv2d(4, 8, 3, padding=1), nn.ReLU(),
+            nn.Conv2d(8, 8, 3, padding=1, groups=8),  # depthwise
+            nn.Sigmoid(),
+            nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(8, 3))
+        self._check(model, (4, 12, 12), tmp_path)
+
+    def test_avgpool_dropout(self, tmp_path):
+        import torch.nn as nn
+
+        torch.manual_seed(2)
+        model = nn.Sequential(
+            nn.Conv2d(2, 4, 5, padding=2), nn.ReLU(), nn.Dropout(0.5),
+            nn.AvgPool2d(2),
+            nn.Flatten(), nn.Linear(4 * 8 * 8, 6))
+        self._check(model, (2, 16, 16), tmp_path)
+
+    def test_taps_and_layer_names(self, tmp_path):
+        import torch.nn as nn
+
+        torch.manual_seed(3)
+        model = nn.Sequential(
+            nn.Conv2d(3, 4, 3, padding=1), nn.ReLU(),
+            nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(4, 2))
+        model.eval()
+        path = _onnx_from_torch_seq(model, (3, 8, 8), str(tmp_path / "m.onnx"))
+        fm = import_onnx(path)
+        assert fm.layer_names, "importer should auto-derive layer_names"
+        x = np.random.default_rng(0).normal(size=(2, 3, 8, 8)).astype(np.float32)
+        emb = fm.apply(x, tap=fm.resolve_output("OUTPUT_1"))
+        assert emb.shape[0] == 2 and emb.ndim >= 2
+        paths = fm.module.layer_paths()
+        assert all("_" in p for p in paths)  # node names addressable
+
+
+class TestFromTorchResnet:
+    @pytest.mark.parametrize("depth", [18, 50])
+    def test_transplant_matches_torch(self, depth):
+        """Build the torch reference ResNet locally (torchvision architecture,
+        random init) and require near-bit parity after transplant."""
+        torchvision = pytest.importorskip  # noqa: F841 — torchvision absent; build manually
+        tmodel = _torch_resnet(depth, num_classes=10)
+        tmodel.eval()
+        fm = _import_from(tmodel, depth, num_classes=10, image_size=64)
+        x = np.random.default_rng(5).normal(size=(2, 64, 64, 3)).astype(np.float32) * 0.3
+        import torch as th
+
+        with th.no_grad():
+            want = tmodel(th.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+        got = np.asarray(fm.apply(x))
+        # our convs run bf16 on the MXU; tolerance covers bf16 rounding
+        np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
+
+    def test_embedding_tap(self):
+        tmodel = _torch_resnet(18, num_classes=7)
+        tmodel.eval()
+        fm = _import_from(tmodel, 18, num_classes=7, image_size=32)
+        x = np.random.default_rng(6).normal(size=(2, 32, 32, 3)).astype(np.float32)
+        emb = fm.apply(x, tap=fm.resolve_output("avgpool"))
+        assert emb.shape == (2, 512)
+
+    def test_shape_mismatch_raises(self):
+        from mmlspark_tpu.models import from_torch_resnet
+
+        tmodel = _torch_resnet(18, num_classes=7)
+        sd = {k: v for k, v in tmodel.state_dict().items()}
+        with pytest.raises((ValueError, KeyError)):
+            from_torch_resnet(sd, depth=50, num_classes=7)
+
+
+def _torch_resnet(depth, num_classes):
+    """Minimal torchvision-compatible ResNet (same state_dict keys/shapes)."""
+    import torch.nn as nn
+
+    class BasicBlock(nn.Module):
+        expansion = 1
+
+        def __init__(self, cin, cout, stride=1, down=None):
+            super().__init__()
+            self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(cout)
+            self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(cout)
+            self.downsample = down
+            self.relu = nn.ReLU(inplace=True)
+
+        def forward(self, x):
+            idn = x if self.downsample is None else self.downsample(x)
+            out = self.relu(self.bn1(self.conv1(x)))
+            out = self.bn2(self.conv2(out))
+            return self.relu(out + idn)
+
+    class Bottleneck(nn.Module):
+        expansion = 4
+
+        def __init__(self, cin, mid, stride=1, down=None):
+            super().__init__()
+            self.conv1 = nn.Conv2d(cin, mid, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(mid)
+            self.conv2 = nn.Conv2d(mid, mid, 3, stride, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(mid)
+            self.conv3 = nn.Conv2d(mid, mid * 4, 1, bias=False)
+            self.bn3 = nn.BatchNorm2d(mid * 4)
+            self.downsample = down
+            self.relu = nn.ReLU(inplace=True)
+
+        def forward(self, x):
+            idn = x if self.downsample is None else self.downsample(x)
+            out = self.relu(self.bn1(self.conv1(x)))
+            out = self.relu(self.bn2(self.conv2(out)))
+            out = self.bn3(self.conv3(out))
+            return self.relu(out + idn)
+
+    cfg = {18: (BasicBlock, (2, 2, 2, 2)), 34: (BasicBlock, (3, 4, 6, 3)),
+           50: (Bottleneck, (3, 4, 6, 3))}
+    block, blocks = cfg[depth]
+
+    class ResNet(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.bn1 = nn.BatchNorm2d(64)
+            self.relu = nn.ReLU(inplace=True)
+            self.maxpool = nn.MaxPool2d(3, 2, 1)
+            cin = 64
+            for i, n in enumerate(blocks):
+                ch = 64 * 2 ** i
+                layers = []
+                for j in range(n):
+                    stride = 2 if (i > 0 and j == 0) else 1
+                    down = None
+                    if stride != 1 or cin != ch * block.expansion:
+                        down = nn.Sequential(
+                            nn.Conv2d(cin, ch * block.expansion, 1, stride, bias=False),
+                            nn.BatchNorm2d(ch * block.expansion))
+                    layers.append(block(cin, ch, stride, down))
+                    cin = ch * block.expansion
+                setattr(self, f"layer{i + 1}", nn.Sequential(*layers))
+            self.avgpool = nn.AdaptiveAvgPool2d(1)
+            self.fc = nn.Linear(cin, num_classes)
+
+        def forward(self, x):
+            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+            for i in range(4):
+                x = getattr(self, f"layer{i + 1}")(x)
+            x = self.avgpool(x).flatten(1)
+            return self.fc(x)
+
+    import torch as th
+
+    th.manual_seed(depth)
+    model = ResNet()
+    # non-trivial BN stats so eval-mode normalization is actually tested
+    for m in model.modules():
+        if isinstance(m, nn.BatchNorm2d):
+            m.running_mean.normal_(0, 0.2)
+            m.running_var.uniform_(0.5, 1.5)
+    return model
+
+
+def _import_from(tmodel, depth, num_classes, image_size):
+    from mmlspark_tpu.models import from_torch_resnet
+
+    return from_torch_resnet(tmodel.state_dict(), depth=depth,
+                             num_classes=num_classes, image_size=image_size)
+
+
+class TestIntegration:
+    def test_image_featurizer_on_imported_onnx(self, tmp_path):
+        """Real transfer-learning path: ONNX backbone -> ImageFeaturizer embeddings
+        (reference flow ImageFeaturizer.scala:133-178 with a downloaded model)."""
+        import torch.nn as nn
+
+        from mmlspark_tpu.core.dataframe import DataFrame
+        from mmlspark_tpu.image.featurizer import ImageFeaturizer
+
+        torch.manual_seed(9)
+        backbone = nn.Sequential(
+            nn.Conv2d(3, 6, 3, stride=2, padding=1), nn.ReLU(),
+            nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(6, 4))
+        backbone.eval()
+        path = _onnx_from_torch_seq(backbone, (3, 10, 10), str(tmp_path / "b.onnx"))
+        fm = import_onnx(path)
+        assert fm.data_format == "NCHW"
+
+        rng = np.random.default_rng(0)
+        imgs = [rng.integers(0, 255, size=(10, 10, 3)).astype(np.uint8)
+                for _ in range(6)]
+        df = DataFrame.from_dict({"image": np.array(imgs, dtype=object)},
+                                 num_partitions=2)
+        feat = (ImageFeaturizer(inputCol="image", outputCol="features")
+                .set_model(fm).set_cut_output_layers(1))
+        out = feat.transform(df).collect()
+        vecs = out["features"]
+        assert len(vecs) == 6
+        assert all(v.shape == (6,) for v in vecs)  # pooled 6-dim embedding (pre-fc)
+
+        # cut 0 = full head
+        out0 = (ImageFeaturizer(inputCol="image", outputCol="features")
+                .set_model(fm).set_cut_output_layers(0)).transform(df).collect()
+        assert all(v.shape == (4,) for v in out0["features"])
+
+    def test_downloader_onnx_payload(self, tmp_path):
+        import torch.nn as nn
+
+        from mmlspark_tpu.downloader import ModelDownloader, ModelSchema
+
+        torch.manual_seed(4)
+        model = nn.Sequential(nn.Conv2d(3, 4, 3, padding=1), nn.ReLU(),
+                              nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(4, 2))
+        model.eval()
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        payload = repo / "tiny_cnn.onnx"
+        _onnx_from_torch_seq(model, (3, 8, 8), str(payload))
+        schema = ModelSchema(name="tiny_cnn", uri=str(payload), modelType="onnx")
+        (repo / "tiny_cnn.meta").write_text(schema.to_json())
+
+        dl = ModelDownloader(str(tmp_path / "cache"), repo=str(repo))
+        local = dl.download_by_name("tiny_cnn")
+        fm = ModelDownloader.load_function_model(local)
+        x = np.random.default_rng(2).normal(size=(3, 3, 8, 8)).astype(np.float32)
+        with torch.no_grad():
+            want = model(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(np.asarray(fm.apply(x)), want, atol=1e-3, rtol=1e-3)
+
+    def test_downloader_pth_payload(self, tmp_path):
+        from mmlspark_tpu.downloader import ModelDownloader, ModelSchema
+
+        tmodel = _torch_resnet(18, num_classes=5)
+        tmodel.eval()
+        pth = tmp_path / "r18.pth"
+        torch.save(tmodel.state_dict(), str(pth))
+        schema = ModelSchema(name="r18", uri=str(pth), modelType="torch-resnet18")
+        fm = ModelDownloader.load_function_model(schema)
+        assert fm.name == "resnet18"
+        x = np.random.default_rng(3).normal(size=(1, 224, 224, 3)).astype(np.float32) * 0.1
+        import torch as th
+
+        with th.no_grad():
+            want = tmodel(th.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+        np.testing.assert_allclose(np.asarray(fm.apply(x)), want, atol=5e-2, rtol=5e-2)
+
+
+class TestNativeRoundTrip:
+    def test_export_import_resnet18(self, tmp_path):
+        from mmlspark_tpu.models.resnet import resnet
+
+        fm = resnet(18, num_classes=10, image_size=32, seed=3)
+        blob = export_onnx(fm.module, fm.params, fm.input_shape,
+                           path=str(tmp_path / "r18.onnx"), name="resnet18")
+        assert len(blob) > 1000
+        fm2 = import_onnx(str(tmp_path / "r18.onnx"), compute_dtype="bfloat16")
+        x = np.random.default_rng(0).normal(size=(2, 32, 32, 3)).astype(np.float32)
+        want = np.asarray(fm.apply(x))
+        # imported graph takes NCHW
+        got = np.asarray(fm2.apply(np.transpose(x, (0, 3, 1, 2))))
+        np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
+
+    def test_export_explicit_padding(self, tmp_path):
+        """torch-padded models (explicit pad tuples) must export their pads."""
+        import jax
+
+        from mmlspark_tpu.models.resnet import build_resnet
+
+        mod = build_resnet(18, num_classes=4, image_size=32, width=8,
+                           torch_padding=True)
+        params, _ = mod.init(jax.random.PRNGKey(1), (32, 32, 3))
+        blob = export_onnx(mod, params, (32, 32, 3))
+        fm = import_onnx(blob, compute_dtype="bfloat16")
+        x = np.random.default_rng(4).normal(size=(2, 32, 32, 3)).astype(np.float32)
+        want = np.asarray(mod.apply(params, x))
+        got = np.asarray(fm.apply(np.transpose(x, (0, 3, 1, 2))))
+        np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
+
+    def test_export_mlp(self, tmp_path):
+        import jax
+
+        from mmlspark_tpu.models.module import Dense, Sequential, flatten, relu
+
+        mod = Sequential([("d1", Dense(16)), ("act", relu()), ("d2", Dense(4))])
+        params, out_shape = mod.init(jax.random.PRNGKey(0), (8,))
+        assert out_shape == (4,)
+        blob = export_onnx(mod, params, (8,), path=str(tmp_path / "mlp.onnx"))
+        fm = import_onnx(blob)
+        x = np.random.default_rng(1).normal(size=(5, 8)).astype(np.float32)
+        want = np.asarray(mod.apply(params, x))
+        got = np.asarray(fm.apply(x))
+        np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
