@@ -116,33 +116,56 @@ def grad_hess(objective: str, scores, labels, weights=None, alpha: float = 0.9,
 
 
 def _lambdarank_grad_hess(scores, labels, group_ids, sigma: float = 1.0):
-    """Pairwise LambdaRank with |ΔNDCG| weighting, vectorized over same-group pairs.
+    """Pairwise LambdaRank with |ΔNDCG| weighting, padded per-group.
 
-    O(N * max_group) via a padded per-group formulation; groups are contiguous
-    row ranges identified by ``group_ids`` (the ranker's group column).
+    Groups are contiguous row ranges identified by ``group_ids``. Rows scatter into a
+    [num_groups, G] layout (G = max group size), pairwise terms are [num_groups, G, G]
+    — O(N * G) memory like LightGBM's per-query loop, not O(N^2) — and ranks/discounts
+    are computed *within* each group, with |ΔNDCG| normalized by the group's ideal DCG
+    (LightGBM lambdarank semantics).
     """
     import jax.numpy as jnp
 
-    n = scores.shape[0]
-    same = group_ids[:, None] == group_ids[None, :]
-    rel_diff = labels[:, None] - labels[None, :]
-    better = (rel_diff > 0) & same
-    s_diff = scores[:, None] - scores[None, :]
-    rho = 1.0 / (1.0 + jnp.exp(sigma * s_diff))          # P(i should beat j but doesn't)
+    n = int(scores.shape[0])
+    gi = np.asarray(group_ids)
+    # contiguous run segmentation (host, once per call; group layout is static)
+    change = np.nonzero(gi[1:] != gi[:-1])[0] + 1
+    starts = np.concatenate([[0], change]).astype(np.int64)
+    counts = np.diff(np.concatenate([starts, [n]])).astype(np.int64)
+    ngroups = len(starts)
+    G = int(counts.max())
+    slot = np.arange(n, dtype=np.int64) - np.repeat(starts, counts)
+    gidx = np.repeat(np.arange(ngroups, dtype=np.int64), counts)
 
-    # |ΔNDCG|: swap positions by current score rank, per group (approximate with
-    # gain difference normalized by per-group max DCG)
-    gains = (2.0 ** labels - 1.0)
-    order = jnp.argsort(-scores)
-    rank_of = jnp.zeros(n, dtype=jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    # pad into [ngroups, G]; invalid slots: score -inf (sort last), gain 0
+    s_pad = jnp.full((ngroups, G), -jnp.inf, dtype=jnp.float32).at[gidx, slot].set(scores)
+    l_pad = jnp.zeros((ngroups, G), dtype=jnp.float32).at[gidx, slot].set(labels)
+    valid = jnp.zeros((ngroups, G), dtype=bool).at[gidx, slot].set(True)
+
+    gains = jnp.where(valid, 2.0 ** l_pad - 1.0, 0.0)
+    # within-group rank by current score
+    order = jnp.argsort(-s_pad, axis=1)
+    rank_of = jnp.zeros((ngroups, G), dtype=jnp.int32)
+    rank_of = rank_of.at[jnp.arange(ngroups)[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(G, dtype=jnp.int32), (ngroups, G)))
     disc = 1.0 / jnp.log2(rank_of.astype(jnp.float32) + 2.0)
-    delta = jnp.abs((gains[:, None] - gains[None, :])
-                    * (disc[:, None] - disc[None, :]))
+    # ideal DCG per group (labels sorted descending)
+    ideal_gains = jnp.sort(gains, axis=1)[:, ::-1]
+    idcg = jnp.sum(ideal_gains / jnp.log2(jnp.arange(G, dtype=jnp.float32) + 2.0),
+                   axis=1, keepdims=True)
+    inv_idcg = jnp.where(idcg > 0, 1.0 / idcg, 0.0)[..., None]
+
+    pair_ok = valid[:, :, None] & valid[:, None, :]
+    better = (l_pad[:, :, None] > l_pad[:, None, :]) & pair_ok
+    s_diff = jnp.where(pair_ok, s_pad[:, :, None] - s_pad[:, None, :], 0.0)
+    rho = 1.0 / (1.0 + jnp.exp(sigma * s_diff))          # P(i should beat j but doesn't)
+    delta = jnp.abs((gains[:, :, None] - gains[:, None, :])
+                    * (disc[:, :, None] - disc[:, None, :])) * inv_idcg
     lam = jnp.where(better, -sigma * rho * delta, 0.0)
     h_pair = jnp.where(better, sigma * sigma * rho * (1 - rho) * delta, 0.0)
-    g = jnp.sum(lam, axis=1) - jnp.sum(lam, axis=0)
-    h = jnp.maximum(jnp.sum(h_pair, axis=1) + jnp.sum(h_pair, axis=0), 1e-16)
-    return g, h
+    g_pad = jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)
+    h_pad = jnp.maximum(jnp.sum(h_pair, axis=2) + jnp.sum(h_pair, axis=1), 1e-16)
+    return g_pad[gidx, slot], h_pad[gidx, slot]
 
 
 def init_score(objective: str, labels: np.ndarray, num_class: int = 1) -> np.ndarray:

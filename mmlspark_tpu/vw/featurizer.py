@@ -1,13 +1,19 @@
 """VowpalWabbitFeaturizer: hash heterogeneous columns into sparse features.
 
 Reference: vw/VowpalWabbitFeaturizer.scala:62-180 + vw/featurizer/*.scala (9
-type-dispatched featurizer classes). Behavior:
+type-dispatched featurizer classes). Hash scheme is reference/VW-exact so feature
+spaces interoperate:
 
-  - numeric column  -> feature index = hash(colName), value = the number
-  - string column   -> index = hash(colName + "=" + value) (categorical), value 1
-  - string-array    -> one categorical feature per element
-  - map column      -> index = hash(colName + "." + key), value = map value
-  - vector column   -> indices = hash(colName) + position (dense passthrough)
+  - namespaceHash = murmur(outputCol, seed)  (VowpalWabbitFeaturizer.scala:115)
+  - numeric/bool  -> index = murmur(prefixName, namespaceHash), value = the number
+    (zero values filtered; NumericFeaturizer/BooleanFeaturizer)
+  - string        -> index = murmur(prefixName + value, namespaceHash), value 1
+    (StringFeaturizer; prefixName = colName when prefixStringsWithColumnName else "")
+  - string-array  -> one such feature per element (StringArrayFeaturizer)
+  - map           -> index = murmur(prefixName + key, namespaceHash), value = map
+    value (MapFeaturizer); string-valued maps hash key+value with value 1
+    (MapStringFeaturizer)
+  - vector        -> raw positional indices + values passthrough (VectorFeaturizer)
 
 Output row = {"indices": int64[], "values": float32[]} struct (sorted, deduped by
 summing — VW semantics for repeated indices), masked into ``numBits`` space.
@@ -70,47 +76,53 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         prefix = self.get("prefixStringsWithColumnName")
         sum_coll = self.get("sumCollisions")
 
-        col_hash = {c: hash_string(c, seed) for c in in_cols}
+        # namespaceHash seeds every per-feature hash (reference :115).
+        # NOTE: the reference passes prefixName to ALL featurizers (numeric/bool/map
+        # included, VowpalWabbitFeaturizer.scala:65-78), so with
+        # prefixStringsWithColumnName=False numeric columns share one index — odd,
+        # but reference-exact; leave the flag on (default) for distinct indices.
+        ns_hash = hash_string(out_col, seed)
+        prefix_of = {c: (c if prefix else "") for c in in_cols}
+        col_hash = {c: hash_string(prefix_of[c], ns_hash) for c in in_cols}
 
         def featurize_row(p, i) -> Dict[str, np.ndarray]:
             idx: List[int] = []
             val: List[float] = []
             for c in in_cols:
                 v = p[c][i]
+                pn = prefix_of[c]
                 if v is None:
                     continue
-                if isinstance(v, (int, float, np.integer, np.floating)) \
-                        and not isinstance(v, bool):
-                    if v != 0:
-                        idx.append(col_hash[c])
-                        val.append(float(v))
-                elif isinstance(v, bool):
-                    if v:
+                if isinstance(v, (bool, np.bool_)):
+                    if v:  # BooleanFeaturizer: fires only when true
                         idx.append(col_hash[c])
                         val.append(1.0)
+                elif isinstance(v, (int, float, np.integer, np.floating)):
+                    if v != 0:  # NumericFeaturizer filters zeros
+                        idx.append(col_hash[c])
+                        val.append(float(v))
                 elif isinstance(v, str):
                     tokens = v.split() if split else [v]
                     for t in tokens:
-                        key = f"{c}={t}" if prefix else t
-                        idx.append(hash_string(key, seed))
+                        idx.append(hash_string(pn + t, ns_hash))
                         val.append(1.0)
                 elif isinstance(v, dict):
                     for k, mv in v.items():
-                        idx.append(hash_string(f"{c}.{k}", seed))
-                        val.append(float(mv))
+                        if isinstance(mv, str):  # MapStringFeaturizer: key+value
+                            idx.append(hash_string(pn + str(k) + mv, ns_hash))
+                            val.append(1.0)
+                        elif mv != 0:  # MapFeaturizer: key, zero-filtered
+                            idx.append(hash_string(pn + str(k), ns_hash))
+                            val.append(float(mv))
                 elif isinstance(v, (list, tuple, np.ndarray)):
                     arr = np.asarray(v)
                     if arr.dtype.kind in "OUS":
-                        for t in arr:
-                            key = f"{c}={t}" if prefix else str(t)
-                            idx.append(hash_string(key, seed))
+                        for t in arr:  # StringArrayFeaturizer
+                            idx.append(hash_string(pn + str(t), ns_hash))
                             val.append(1.0)
-                    else:  # dense vector passthrough: base hash + position
-                        base = col_hash[c]
-                        nz = np.nonzero(arr)[0]
-                        for j in nz:
-                            idx.append(base + int(j))
-                            val.append(float(arr[j]))
+                    else:  # VectorFeaturizer: raw positional indices, values as-is
+                        idx.extend(range(arr.size))
+                        val.extend(float(x) for x in arr.ravel())
                 else:
                     raise TypeError(f"Unsupported value type {type(v)} in col {c!r}")
             return _sort_dedup(idx, val, mask, sum_coll)
@@ -157,16 +169,18 @@ class VowpalWabbitInteractions(Transformer, HasInputCols, HasOutputCol):
                     out[i] = {"indices": np.empty(0, dtype=np.int64),
                               "values": np.empty(0, dtype=np.float32)}
                     continue
-                idx = feats[0]["indices"].astype(np.int64)
-                val = feats[0]["values"].astype(np.float64)
-                for f in feats[1:]:
-                    # VW's interaction hash: i1 * magic + i2 (FNV-style combine)
-                    i2 = f["indices"].astype(np.int64)
-                    v2 = f["values"].astype(np.float64)
-                    idx = ((idx[:, None] * np.int64(67108859) + i2[None, :])
-                           .reshape(-1))
-                    val = (val[:, None] * v2[None, :]).reshape(-1)
-                out[i] = _sort_dedup(idx, val, mask, sum_coll)
+                # FNV-1 combine, 32-bit wraparound (VowpalWabbitInteractions.scala:43-57):
+                # start idx=0, per column idx = (idx * 16777619) ^ idx_col
+                fnv = np.uint32(16777619)
+                idx = np.zeros(1, dtype=np.uint32)
+                val = np.ones(1, dtype=np.float64)
+                with np.errstate(over="ignore"):
+                    for f in feats:
+                        i2 = f["indices"].astype(np.uint32)
+                        v2 = f["values"].astype(np.float64)
+                        idx = ((idx[:, None] * fnv) ^ i2[None, :]).reshape(-1)
+                        val = (val[:, None] * v2[None, :]).reshape(-1)
+                out[i] = _sort_dedup(idx.astype(np.int64), val, mask, sum_coll)
             return out
 
         return df.with_column(out_col, fn)

@@ -10,8 +10,9 @@ each mesh shard scans its rows independently, then weights are averaged with
 ``psum`` under ``shard_map`` after every pass — exactly VW's between-pass model
 averaging, over ICI instead of driver-rooted TCP.
 
-Sparse rows are padded to a fixed nnz per row (index 0 + value 0 padding is a
-no-op because gradient contributions scale by the value).
+Sparse rows are padded to a fixed nnz per row; padded slots (index 0, value 0) are
+inert because both the gradient and the l2 decay are gated on value != 0, and padded
+rows (example weight 0) don't advance the learning-rate clock.
 """
 
 from __future__ import annotations
@@ -132,8 +133,12 @@ def make_scan_pass(config: LearnerConfig):
             wi = w[idx]
             pred = jnp.sum(wi * val)
             g = _loss_grad(loss, pred, label, tau) * wgt
-            gi = g * val + l2 * wi
-            t = t + 1.0
+            # gate the l2 decay on active slots: padded nnz slots are (index 0,
+            # value 0) and must not decay weight bucket 0 / pollute its AdaGrad
+            # accumulator
+            gi = g * val + l2 * wi * (val != 0)
+            # padded rows (example weight 0) must not advance the lr-decay clock
+            t = t + (wgt > 0)
             if config.adaptive:
                 # VW adaptive: per-weight rate lr * g2^(-power_t)
                 g2 = g2.at[idx].add(gi * gi)

@@ -57,8 +57,55 @@ class TestFeaturizer:
         })
         out = VowpalWabbitFeaturizer(inputCols=["m", "v"], numBits=24).transform(df)
         f = out.column("features")[0]
-        assert len(f["indices"]) == 4
-        assert set(np.round(f["values"]).astype(int)) == {1, 2, 3, 4}
+        # VectorFeaturizer passthrough: raw positional indices 0..3 incl. zeros
+        # (reference VectorFeaturizer.scala dense branch), + 2 hashed map features
+        assert len(f["indices"]) == 6
+        assert {0, 1, 2, 3}.issubset(set(f["indices"].tolist()))
+        assert set(np.round(f["values"]).astype(int)) == {0, 1, 2, 3, 4}
+
+    def test_reference_hash_scheme(self):
+        """Indices follow the reference exactly: namespaceHash = murmur(outputCol,
+        seed); string idx = murmur(colName + value, namespaceHash)
+        (VowpalWabbitFeaturizer.scala:115, StringFeaturizer.scala)."""
+        from mmlspark_tpu.ops.hashing import hash_string
+
+        df = DataFrame.from_dict({"city": ["nyc"], "age": [3.0]})
+        out = VowpalWabbitFeaturizer(inputCols=["city", "age"], outputCol="features",
+                                     numBits=30).transform(df)
+        f = out.column("features")[0]
+        ns = hash_string("features", 0)
+        mask = (1 << 30) - 1
+        want = {hash_string("citynyc", ns) & mask, hash_string("age", ns) & mask}
+        assert set(f["indices"].tolist()) == want
+
+        # namespace (outputCol) changes the whole feature space
+        out2 = VowpalWabbitFeaturizer(inputCols=["city", "age"], outputCol="other",
+                                      numBits=30).transform(df)
+        assert set(out2.column("other")[0]["indices"].tolist()) != want
+
+        # prefixStringsWithColumnName=False drops the column prefix only
+        out3 = VowpalWabbitFeaturizer(inputCols=["city"], outputCol="features",
+                                      prefixStringsWithColumnName=False,
+                                      numBits=30).transform(df)
+        assert out3.column("features")[0]["indices"][0] == \
+            (hash_string("nyc", ns) & mask)
+
+    def test_interactions_fnv1_combine(self):
+        """Interaction index = (i1 * 16777619) ^ i2 in 32-bit, masked
+        (VowpalWabbitInteractions.scala:43-57)."""
+        from mmlspark_tpu.ops.hashing import hash_string
+
+        df = DataFrame.from_dict({"a": ["x"], "b": ["y"]})
+        fa = VowpalWabbitFeaturizer(inputCols=["a"], outputCol="fa").transform(df)
+        fb = VowpalWabbitFeaturizer(inputCols=["b"], outputCol="fb").transform(fa)
+        out = VowpalWabbitInteractions(inputCols=["fa", "fb"], outputCol="fx",
+                                       numBits=30).transform(fb)
+        i1 = int(fb.collect()["fa"][0]["indices"][0])
+        i2 = int(fb.collect()["fb"][0]["indices"][0])
+        want = ((np.uint32(i1) * np.uint32(16777619)) ^ np.uint32(i2)) & np.uint32(
+            (1 << 30) - 1)
+        got = out.column("fx")[0]["indices"]
+        assert got.tolist() == [int(want)]
 
     def test_string_split(self):
         df = DataFrame.from_dict({"text": ["hello world hello"]})
