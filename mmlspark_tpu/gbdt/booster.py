@@ -12,6 +12,7 @@ Grad/hess computation and score updates are jitted; tree growth is tree.py.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -74,7 +75,7 @@ def _sigmoid(x):
 
 
 def grad_hess(objective: str, scores, labels, weights=None, alpha: float = 0.9,
-              groups=None):
+              groups=None, group_segments=None):
     """Returns (grad, hess) arrays, shape [N] (or [N,K] multiclass)."""
     import jax
     import jax.numpy as jnp
@@ -106,7 +107,8 @@ def grad_hess(objective: str, scores, labels, weights=None, alpha: float = 0.9,
         g = jnp.exp(scores) - labels
         h = jnp.exp(scores)
     elif objective == "lambdarank":
-        return _lambdarank_grad_hess(scores, labels, groups)
+        return _lambdarank_grad_hess(scores, labels, groups,
+                                     segments=group_segments)
     else:
         raise ValueError(f"Unknown objective {objective!r}")
     if weights is not None:
@@ -115,39 +117,79 @@ def grad_hess(objective: str, scores, labels, weights=None, alpha: float = 0.9,
     return g, h
 
 
-def _lambdarank_grad_hess(scores, labels, group_ids, sigma: float = 1.0):
-    """Pairwise LambdaRank with |ΔNDCG| weighting, padded per-group.
+class GroupSegments:
+    """Host-side segmentation of contiguous ``group_ids`` runs, bucketed by
+    padded (power-of-two) group size. Computed once per dataset and reused
+    every boosting iteration (the group layout never changes)."""
 
-    Groups are contiguous row ranges identified by ``group_ids``. Rows scatter into a
-    [num_groups, G] layout (G = max group size), pairwise terms are [num_groups, G, G]
-    — O(N * G) memory like LightGBM's per-query loop, not O(N^2) — and ranks/discounts
-    are computed *within* each group, with |ΔNDCG| normalized by the group's ideal DCG
-    (LightGBM lambdarank semantics).
+    __slots__ = ("n", "buckets")
+
+    def __init__(self, n, buckets):
+        self.n = n
+        # buckets: list of (Gb, rows, loc_g, loc_slot, m) — see segment_groups
+        self.buckets = buckets
+
+
+def segment_groups(group_ids) -> GroupSegments:
+    """Segment rows into contiguous groups and bucket groups by size class.
+
+    Raises if a group id appears in two non-adjacent runs — that silently
+    breaks pairwise ranking, so it must be an error (sort by group first).
     """
-    import jax.numpy as jnp
-
-    n = int(scores.shape[0])
     gi = np.asarray(group_ids)
-    # contiguous run segmentation (host, once per call; group layout is static)
+    n = len(gi)
     change = np.nonzero(gi[1:] != gi[:-1])[0] + 1
     starts = np.concatenate([[0], change]).astype(np.int64)
     counts = np.diff(np.concatenate([starts, [n]])).astype(np.int64)
-    ngroups = len(starts)
-    G = int(counts.max())
-    slot = np.arange(n, dtype=np.int64) - np.repeat(starts, counts)
-    gidx = np.repeat(np.arange(ngroups, dtype=np.int64), counts)
+    run_ids = gi[starts]
+    if len(np.unique(run_ids)) != len(run_ids):
+        raise ValueError(
+            "lambdarank requires rows grouped contiguously by group id; a "
+            "group id reappears after a different group — sort the dataset "
+            "by the group column first")
 
-    # pad into [ngroups, G]; invalid slots: score -inf (sort last), gain 0
-    s_pad = jnp.full((ngroups, G), -jnp.inf, dtype=jnp.float32).at[gidx, slot].set(scores)
-    l_pad = jnp.zeros((ngroups, G), dtype=jnp.float32).at[gidx, slot].set(labels)
-    valid = jnp.zeros((ngroups, G), dtype=bool).at[gidx, slot].set(True)
+    by_size: Dict[int, list] = {}
+    for g in range(len(starts)):
+        c = int(counts[g])
+        gb = 1 if c <= 1 else 1 << int(np.ceil(np.log2(c)))
+        by_size.setdefault(gb, []).append(g)
 
+    import jax.numpy as jnp
+
+    buckets = []
+    for gb, glist in sorted(by_size.items()):
+        bcounts = counts[glist]
+        rows = np.concatenate(
+            [np.arange(starts[g], starts[g] + counts[g]) for g in glist])
+        loc_g = np.repeat(np.arange(len(glist), dtype=np.int64), bcounts)
+        loc_slot = np.concatenate(
+            [np.arange(c, dtype=np.int64) for c in bcounts])
+        # store as device arrays: the layout is static across boosting, so the
+        # H2D upload of the index arrays happens once, not per iteration
+        buckets.append((gb, jnp.asarray(rows, dtype=jnp.int32),
+                        jnp.asarray(loc_g, dtype=jnp.int32),
+                        jnp.asarray(loc_slot, dtype=jnp.int32), len(glist)))
+    return GroupSegments(n, buckets)
+
+
+# Bound on pairwise-tensor elements materialized at once (f32 [chunk, Gb, Gb];
+# 2**24 elements = 64 MB per tensor, ~6 live tensors => a few hundred MB peak).
+_LAMBDARANK_PAIR_BUDGET = 1 << 24
+
+
+@functools.partial(
+    __import__("jax").jit, static_argnames=("sigma",))
+def _lambdarank_bucket(s_pad, l_pad, valid, sigma: float = 1.0):
+    """Pairwise LambdaRank lambdas for one [m, G] padded bucket of groups."""
+    import jax.numpy as jnp
+
+    m, G = s_pad.shape
     gains = jnp.where(valid, 2.0 ** l_pad - 1.0, 0.0)
-    # within-group rank by current score
+    # within-group rank by current score (invalid slots sort last: score -inf)
     order = jnp.argsort(-s_pad, axis=1)
-    rank_of = jnp.zeros((ngroups, G), dtype=jnp.int32)
-    rank_of = rank_of.at[jnp.arange(ngroups)[:, None], order].set(
-        jnp.broadcast_to(jnp.arange(G, dtype=jnp.int32), (ngroups, G)))
+    rank_of = jnp.zeros((m, G), dtype=jnp.int32)
+    rank_of = rank_of.at[jnp.arange(m)[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(G, dtype=jnp.int32), (m, G)))
     disc = 1.0 / jnp.log2(rank_of.astype(jnp.float32) + 2.0)
     # ideal DCG per group (labels sorted descending)
     ideal_gains = jnp.sort(gains, axis=1)[:, ::-1]
@@ -158,14 +200,61 @@ def _lambdarank_grad_hess(scores, labels, group_ids, sigma: float = 1.0):
     pair_ok = valid[:, :, None] & valid[:, None, :]
     better = (l_pad[:, :, None] > l_pad[:, None, :]) & pair_ok
     s_diff = jnp.where(pair_ok, s_pad[:, :, None] - s_pad[:, None, :], 0.0)
-    rho = 1.0 / (1.0 + jnp.exp(sigma * s_diff))          # P(i should beat j but doesn't)
+    rho = 1.0 / (1.0 + jnp.exp(sigma * s_diff))      # P(i beats j but doesn't)
     delta = jnp.abs((gains[:, :, None] - gains[:, None, :])
                     * (disc[:, :, None] - disc[:, None, :])) * inv_idcg
     lam = jnp.where(better, -sigma * rho * delta, 0.0)
     h_pair = jnp.where(better, sigma * sigma * rho * (1 - rho) * delta, 0.0)
     g_pad = jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)
-    h_pad = jnp.maximum(jnp.sum(h_pair, axis=2) + jnp.sum(h_pair, axis=1), 1e-16)
-    return g_pad[gidx, slot], h_pad[gidx, slot]
+    h_pad = jnp.sum(h_pair, axis=2) + jnp.sum(h_pair, axis=1)
+    return g_pad, h_pad
+
+
+def _lambdarank_grad_hess(scores, labels, group_ids, sigma: float = 1.0,
+                          segments: Optional[GroupSegments] = None):
+    """Pairwise LambdaRank with |ΔNDCG| weighting (LightGBM semantics).
+
+    Groups (contiguous ``group_ids`` runs) are bucketed by power-of-two padded
+    size, so a few singleton-heavy queries never inflate the padding of the
+    rest; within a bucket the [m, G, G] pairwise tensors are materialized at
+    most ``_LAMBDARANK_PAIR_BUDGET`` elements at a time (lax.map over group
+    chunks), bounding memory at O(chunk * G^2) regardless of dataset size.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    seg = segments if segments is not None else segment_groups(group_ids)
+    n = seg.n
+    g_out = jnp.zeros(n, dtype=jnp.float32)
+    h_out = jnp.full(n, 1e-16, dtype=jnp.float32)
+
+    for gb, rows, loc_g, loc_slot, m in seg.buckets:
+        if gb <= 1:
+            continue  # singleton groups: no pairs, keep (0, 1e-16)
+        chunk = max(1, min(m, _LAMBDARANK_PAIR_BUDGET // (gb * gb)))
+        m_pad = (m + chunk - 1) // chunk * chunk
+        s_pad = jnp.full((m_pad, gb), -jnp.inf, dtype=jnp.float32)
+        l_pad = jnp.zeros((m_pad, gb), dtype=jnp.float32)
+        valid = jnp.zeros((m_pad, gb), dtype=bool)
+        s_pad = s_pad.at[loc_g, loc_slot].set(scores[rows])
+        l_pad = l_pad.at[loc_g, loc_slot].set(labels[rows])
+        valid = valid.at[loc_g, loc_slot].set(True)
+
+        nchunks = m_pad // chunk
+        if nchunks == 1:
+            g_pad, h_pad = _lambdarank_bucket(s_pad, l_pad, valid, sigma)
+        else:
+            g_pad, h_pad = jax.lax.map(
+                lambda t: _lambdarank_bucket(t[0], t[1], t[2] > 0, sigma),
+                (s_pad.reshape(nchunks, chunk, gb),
+                 l_pad.reshape(nchunks, chunk, gb),
+                 valid.reshape(nchunks, chunk, gb).astype(jnp.int8)))
+            g_pad = g_pad.reshape(m_pad, gb)
+            h_pad = h_pad.reshape(m_pad, gb)
+        g_out = g_out.at[rows].set(g_pad[loc_g, loc_slot])
+        h_out = h_out.at[rows].set(
+            jnp.maximum(h_pad[loc_g, loc_slot], 1e-16))
+    return g_out, h_out
 
 
 def init_score(objective: str, labels: np.ndarray, num_class: int = 1) -> np.ndarray:
@@ -434,6 +523,9 @@ def train(params: TrainParams,
     labels = put(jnp.asarray(y, dtype=jnp.float32))
     w_dev = put(jnp.asarray(weights, dtype=jnp.float32)) if weights is not None else None
     g_dev = put(jnp.asarray(groups, dtype=jnp.int32)) if groups is not None else None
+    # lambdarank group layout is static across boosting: segment once here
+    group_seg = (segment_groups(groups)
+                 if groups is not None and objective == "lambdarank" else None)
 
     if init_scores is not None:
         # per-row init score (initScoreCol): boosting starts from it, but it is
@@ -497,7 +589,8 @@ def train(params: TrainParams,
 
         score_dev = put(jnp.asarray(scores[:, 0] if k == 1 else scores,
                                     dtype=jnp.float32))
-        g, h = grad_hess(objective, score_dev, labels, w_dev, params.alpha, g_dev)
+        g, h = grad_hess(objective, score_dev, labels, w_dev, params.alpha,
+                         g_dev, group_segments=group_seg)
 
         # ----- bagging / goss row selection
         row_mask = bag_mask

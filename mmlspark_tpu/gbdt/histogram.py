@@ -6,8 +6,10 @@ binned histogram construction and best-split search. Here both are jitted XLA
 kernels over static [N,F] / [F,B] shapes:
 
   - ``compute_histogram``: masked scatter-add of (grad, hess, count) into
-    [F, B, 3]. On TPU XLA lowers this to a sort-major scatter; a Pallas
-    VMEM-accumulator kernel is provided in pallas_hist.py for the hot path.
+    [F, B, 3]. On TPU the hot path dispatches to the Pallas one-hot-matmul
+    kernel in pallas_hist.py (the scatter reformulated as an MXU contraction
+    with a VMEM-resident accumulator); elsewhere it falls back to the XLA
+    ``at[].add`` scatter below.
   - ``find_best_split``: vectorized gain scan over all (feature, bin) candidates
     with L1/L2 regularization, min-data / min-hessian constraints, and learned
     missing-value default direction — one argmax on device, no per-feature host
@@ -36,10 +38,26 @@ class SplitInfo(NamedTuple):
     right_sum: np.ndarray     # [3]
 
 
+def compute_histogram(bins, grad, hess, row_mask, num_bins: int):
+    """[N,F] int bins + per-row grad/hess + row mask -> [F, num_bins, 3] sums.
+
+    On TPU, dispatches to the Pallas MXU kernel (pallas_hist.py): per-shard
+    kernel + psum under shard_map when rows are sharded over a mesh axis,
+    plain kernel on single-device inputs. Falls back to the XLA scatter for
+    CPU/GPU, traced inputs, and shardings the kernel doesn't handle.
+    """
+    from . import pallas_hist
+
+    out = pallas_hist.dispatch(bins, grad, hess, row_mask, num_bins)
+    if out is not None:
+        return out
+    return compute_histogram_xla(bins, grad, hess, row_mask, num_bins)
+
+
 @functools.partial(
     __import__("jax").jit, static_argnames=("num_bins",))
-def compute_histogram(bins, grad, hess, row_mask, num_bins: int):
-    """[N,F] int bins + per-row grad/hess + row mask -> [F, num_bins, 3] sums."""
+def compute_histogram_xla(bins, grad, hess, row_mask, num_bins: int):
+    """XLA ``at[].add`` scatter lowering (CPU/GPU fallback + parity reference)."""
     import jax.numpy as jnp
 
     n, f = bins.shape

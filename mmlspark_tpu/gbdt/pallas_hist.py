@@ -1,0 +1,202 @@
+"""Pallas TPU kernel for binned histogram accumulation.
+
+The GBDT hot loop (reference: LGBM_BoosterUpdateOneIter's histogram build,
+lightgbm/TrainUtils.scala:170-233) is a scatter-add of per-row (grad, hess,
+count) triples into [F, B] bins. XLA lowers ``hist.at[idx].add(vals)`` to a
+serialized sort-major scatter on TPU — correct but far off the roofline.
+
+This kernel reformulates the scatter as a **one-hot contraction on the MXU**:
+
+    hist[f, b, c] = sum_n (bins[n, f] == b) * vals[n, c]
+                  = (onehot(bins[:, f]).T @ vals).T        # [3, B] per feature
+
+The one-hot matrix is materialized only inside VMEM, one [CHUNK, B_pad] tile
+at a time, and immediately contracted — it never exists in HBM, so HBM traffic
+is exactly the input reads (bins, vals) plus one [3, F*B_pad] accumulator.
+The grid is 1-D over row chunks with the accumulator block resident in VMEM
+across the whole grid (standard Pallas reduction pattern); the feature dim is
+never block-sliced (Mosaic wants minor dims 128-divisible or full-array) —
+instead, inputs wider than FMAX features are split into separate pallas_call
+slabs on the host, bounding the accumulator at [3, FMAX*B_pad].
+
+Bin counts are padded to a multiple of 128 (the TPU lane width) so every
+slice write is tile-aligned; features are padded to the feature-tile size.
+Padded rows/features contribute zero because ``vals`` is pre-masked.
+
+Dispatch: ``histogram.compute_histogram`` routes here when the default backend
+is TPU (env ``MMLSPARK_TPU_NO_PALLAS=1`` forces the XLA path). On CPU the
+kernel runs in interpreter mode for tests only.
+
+Measured on TPU v5e (1 chip, tunneled), N=100k rows, F=32, B=256, f32, via
+tools/bench_hist.py: XLA scatter 125-138 ms/hist vs Pallas MXU 8.1-9.9
+ms/hist — 12.9-17.1x across 4 runs (the recorded run in BENCH_hist.json:
+125.0 ms vs 9.7 ms, 12.9x; the tunnel adds run-to-run variance). At N=1M
+the XLA scatter path fails to compile (temp-buffer OOM: its sort-based
+lowering materializes s32[N*F] keys); the Pallas path runs fine.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Row-chunk size: bounds the one-hot VMEM tile ([CHUNK, B_pad] f32 = 256 KB at
+# B_pad=128). FMAX bounds features handled per pallas_call — wider inputs are
+# processed in host-side slabs so the [3, F*B_pad] accumulator stays in VMEM.
+CHUNK = 512
+FMAX = 64
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _hist_kernel(bins_ref, vals_ref, out_ref, *, nf: int, b_pad: int):
+    """One row-chunk grid cell.
+
+    bins_ref: [CHUNK, nf] i32, vals_ref: [CHUNK, 3] f32 (pre-masked),
+    out_ref:  [3, nf*B_pad] f32 accumulator, VMEM-resident across the grid.
+    (Mosaic requires block minor dims 128-divisible or full-array, so the
+    feature dim is never block-sliced — the grid runs over row chunks only.)
+    """
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    vals = vals_ref[...]                                     # [CHUNK, 3]
+    for f in range(nf):                                      # static unroll
+        col = bins_ref[:, f : f + 1]                         # [CHUNK, 1]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (col.shape[0], b_pad), 1)
+        onehot = (col == iota).astype(jnp.float32)           # [CHUNK, B_pad]
+        acc = jax.lax.dot_general(                           # [3, B_pad] on MXU
+            vals, onehot,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            # HIGHEST = full-f32 MXU passes: gradient sums feed split gains,
+            # and the default bf16 rounding of vals costs ~1e-3 relative.
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+        out_ref[:, f * b_pad : (f + 1) * b_pad] += acc
+
+
+def _hist_slab(bins_slab, vals, b_pad: int, interpret: bool):
+    """[N_pad, Fs] bins + [N_pad, 3] masked vals -> [3, Fs*b_pad] sums."""
+    n_pad, fs = bins_slab.shape
+    n_chunks = n_pad // CHUNK
+    return pl.pallas_call(
+        functools.partial(_hist_kernel, nf=fs, b_pad=b_pad),
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((CHUNK, fs), lambda j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((CHUNK, 3), lambda j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((3, fs * b_pad), lambda j: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((3, fs * b_pad), jnp.float32),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 3 * n_pad * fs * b_pad,
+            bytes_accessed=bins_slab.size * 4 + vals.size * 4
+            + 3 * fs * b_pad * 4,
+            transcendentals=0,
+        ),
+    )(bins_slab, vals)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
+def compute_histogram_mxu(bins, grad, hess, row_mask, num_bins: int,
+                          interpret: bool = False):
+    """[N,F] int bins + per-row grad/hess + row mask -> [F, num_bins, 3] sums.
+
+    Drop-in replacement for histogram.compute_histogram's XLA scatter path.
+    """
+    n, f = bins.shape
+    b_pad = max(128, _round_up(num_bins, 128))
+    n_pad = _round_up(max(n, 1), CHUNK)
+
+    m = row_mask.astype(jnp.float32)
+    vals = jnp.stack([grad * m, hess * m, m], axis=-1).astype(jnp.float32)
+    vals = jnp.pad(vals, ((0, n_pad - n), (0, 0)))
+    bins_p = jnp.pad(bins.astype(jnp.int32), ((0, n_pad - n), (0, 0)))
+
+    slabs = []
+    for f0 in range(0, f, FMAX):
+        fs = min(FMAX, f - f0)
+        out = _hist_slab(bins_p[:, f0 : f0 + fs], vals, b_pad, interpret)
+        slabs.append(out.reshape(3, fs, b_pad))
+    hist = jnp.concatenate(slabs, axis=1)        # [3, F, b_pad]
+    return hist.transpose(1, 2, 0)[:, :num_bins, :]
+
+
+def compute_histogram_sharded(bins, grad, hess, row_mask, num_bins: int,
+                              interpret: bool = False):
+    """Row-sharded variant: per-shard Pallas histogram + psum over the row
+    axes — the multi-chip data-parallel path (LightGBM's socket-ring
+    allreduce as one XLA collective). ``bins`` must be a concrete jax.Array
+    with a NamedSharding whose spec shards dim 0."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    sh = bins.sharding
+    mesh = sh.mesh
+    row_axes = sh.spec[0]
+    specs = (sh.spec, P(row_axes), P(row_axes), P(row_axes))
+
+    # check_vma=False: pallas_call can't declare varying-mesh-axes metadata
+    @functools.partial(shard_map, mesh=mesh, in_specs=specs, out_specs=P(),
+                       check_vma=False)
+    def _go(b, g, h, m):
+        local = compute_histogram_mxu(b, g, h, m, num_bins,
+                                      interpret=interpret)
+        return jax.lax.psum(local, row_axes)
+
+    return _go(bins, grad, hess, row_mask)
+
+
+def _row_sharded_spec(x):
+    """Return True if x is a concrete array with a NamedSharding that splits
+    dim 0 over >1 device (the GBDT data-parallel layout)."""
+    from jax.sharding import NamedSharding
+
+    if not isinstance(x, jax.Array) or isinstance(x, jax.core.Tracer):
+        return False
+    sh = getattr(x, "sharding", None)
+    if not isinstance(sh, NamedSharding) or len(sh.device_set) <= 1:
+        return False
+    spec = sh.spec
+    return len(spec) > 0 and spec[0] is not None
+
+
+def dispatch(bins, grad, hess, row_mask, num_bins: int):
+    """Backend/sharding-aware histogram dispatch used by
+    histogram.compute_histogram. Returns None when the caller should use the
+    XLA scatter path (non-TPU backend, traced values, or exotic shardings
+    GSPMD already partitions correctly)."""
+    if not use_pallas():
+        return None
+    if isinstance(bins, jax.core.Tracer):
+        return None  # inside someone else's jit: let GSPMD lower the scatter
+    if _row_sharded_spec(bins):
+        return compute_histogram_sharded(bins, grad, hess, row_mask, num_bins)
+    if isinstance(bins, jax.Array) and len(bins.sharding.device_set) > 1:
+        return None  # replicated/oddly-sharded multi-device input: XLA path
+    return compute_histogram_mxu(bins, grad, hess, row_mask, num_bins)
+
+
+def use_pallas() -> bool:
+    """True when the Pallas path should be dispatched (TPU backend, not
+    disabled via MMLSPARK_TPU_NO_PALLAS)."""
+    if os.environ.get("MMLSPARK_TPU_NO_PALLAS", "") not in ("", "0"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False

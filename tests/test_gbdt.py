@@ -425,3 +425,47 @@ class TestReviewRegressions:
         b2 = B.train(p2, X, y, init_model=b1)
         p = b2.predict_proba(X)[:, 1]
         assert np.mean((p > 0.5) == y) > 0.9  # histograms not corrupted
+
+
+class TestLambdaRankInternals:
+    def _gh(self, scores, labels, groups):
+        import jax.numpy as jnp
+        return B._lambdarank_grad_hess(
+            jnp.asarray(scores, dtype=jnp.float32),
+            jnp.asarray(labels, dtype=jnp.float32), groups)
+
+    def test_noncontiguous_groups_raise(self):
+        with pytest.raises(ValueError, match="contiguous"):
+            B.segment_groups(np.array([0, 1, 0, 1]))
+
+    def test_skewed_group_sizes_bucketed(self):
+        """Many singletons + one large group: buckets keep padding local, and
+        singleton rows get zero gradient (no pairs)."""
+        rng = np.random.default_rng(1)
+        sizes = [1] * 50 + [64]
+        groups = np.repeat(np.arange(len(sizes)), sizes)
+        n = len(groups)
+        scores = rng.normal(size=n)
+        labels = rng.integers(0, 3, size=n).astype(np.float64)
+        g, h = self._gh(scores, labels, groups)
+        g, h = np.asarray(g), np.asarray(h)
+        assert g.shape == (n,) and h.shape == (n,)
+        np.testing.assert_array_equal(g[:50], 0.0)   # singletons: no pairs
+        assert np.abs(g[50:]).sum() > 0              # big group: real lambdas
+        seg = B.segment_groups(groups)
+        assert sorted(gb for gb, *_ in seg.buckets) == [1, 64]
+
+    def test_chunked_matches_unchunked(self, monkeypatch):
+        """Shrinking the pair budget (forcing lax.map chunking) must not
+        change the lambdas."""
+        rng = np.random.default_rng(2)
+        n_groups, gsize = 12, 8
+        groups = np.repeat(np.arange(n_groups), gsize)
+        n = len(groups)
+        scores = rng.normal(size=n)
+        labels = rng.integers(0, 4, size=n).astype(np.float64)
+        g1, h1 = self._gh(scores, labels, groups)
+        monkeypatch.setattr(B, "_LAMBDARANK_PAIR_BUDGET", 2 * gsize * gsize)
+        g2, h2 = self._gh(scores, labels, groups)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), atol=1e-6)

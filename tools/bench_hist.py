@@ -1,0 +1,64 @@
+"""Benchmark the GBDT histogram kernels: Pallas MXU vs XLA scatter, on the
+live backend. Prints one JSON line per variant.
+
+Usage: python tools/bench_hist.py [N] [F] [B]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.gbdt import histogram as H
+from mmlspark_tpu.gbdt import pallas_hist
+
+
+def bench(fn, grad, iters=20):
+    """fn(grad) -> hist. Each iteration's grad depends on the previous output
+    so executions cannot overlap or be elided."""
+    out = fn(grad)  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(grad + out[0, 0, 0] * 0.0)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    f = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    b = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, b, size=(n, f)).astype(np.int32))
+    grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    hess = jnp.asarray(rng.uniform(0.1, 1.0, size=n).astype(np.float32))
+    mask = jnp.asarray(rng.uniform(size=n) < 0.8)
+
+    backend = jax.default_backend()
+    t_xla = bench(lambda g: H.compute_histogram_xla(bins, g, hess, mask, b),
+                  grad)
+    res = {"backend": backend, "n": n, "f": f, "b": b,
+           "xla_ms": round(t_xla * 1e3, 3),
+           "xla_rows_per_s": round(n / t_xla)}
+
+    if backend == "tpu":
+        x1 = np.asarray(H.compute_histogram_xla(bins, grad, hess, mask, b))
+        x2 = np.asarray(pallas_hist.compute_histogram_mxu(
+            bins, grad, hess, mask, b))
+        np.testing.assert_allclose(x1, x2, rtol=1e-4, atol=1e-2)
+        t_pal = bench(lambda g: pallas_hist.compute_histogram_mxu(
+            bins, g, hess, mask, b), grad)
+        res.update({"pallas_ms": round(t_pal * 1e3, 3),
+                    "pallas_rows_per_s": round(n / t_pal),
+                    "speedup": round(t_xla / t_pal, 2)})
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
