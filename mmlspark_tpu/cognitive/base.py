@@ -25,6 +25,8 @@ from ..io.http import HTTPRequestData, HTTPResponseData, send_with_retries
 class HasServiceParams(Transformer):
     """Helpers to resolve ServiceParams per row."""
 
+    _abstract = True
+
     def _service_values(self, part, i, names: List[str]) -> Dict[str, Any]:
         out = {}
         for name in names:
@@ -36,6 +38,8 @@ class HasServiceParams(Transformer):
 
 class CognitiveServicesBase(HasServiceParams, HasOutputCol):
     """POST JSON (or binary) per row; parse the JSON response into a struct col."""
+
+    _abstract = True
 
     subscriptionKey = ServiceParam("subscriptionKey", "API subscription key")
     url = Param("url", "Service endpoint URL", None, ptype=str)
@@ -175,6 +179,8 @@ class CognitiveServicesBase(HasServiceParams, HasOutputCol):
 class DocumentsBase(CognitiveServicesBase):
     """Text-analytics batch format: rows -> {documents: [{id, text, language}]}
     (cognitive/TextAnalytics.scala:171-230)."""
+
+    _abstract = True
 
     text = ServiceParam("text", "Input text (value or column)")
     language = ServiceParam("language", "Language hint (value or column)")

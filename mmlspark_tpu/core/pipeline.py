@@ -43,7 +43,11 @@ class PipelineStage(Params):
 
     def __init_subclass__(cls, **kwargs):
         super().__init_subclass__(**kwargs)
-        if not cls.__name__.startswith("_"):
+        # `_abstract = True` in the class's own dict marks intermediate bases
+        # that are not user-constructible stages (kept out of the registry so
+        # codegen and fuzzing enforcement see only concrete stages)
+        if not cls.__name__.startswith("_") and \
+                not cls.__dict__.get("_abstract", False):
             _STAGE_REGISTRY[cls.__name__] = cls
             _STAGE_REGISTRY[f"{cls.__module__}.{cls.__name__}"] = cls
 
@@ -71,6 +75,8 @@ class PipelineStage(Params):
 class Transformer(PipelineStage):
     """A DataFrame -> DataFrame stage."""
 
+    _abstract = True
+
     def transform(self, df: DataFrame) -> DataFrame:
         raise NotImplementedError
 
@@ -81,12 +87,16 @@ class Transformer(PipelineStage):
 class Estimator(PipelineStage):
     """A stage fitted on a DataFrame, producing a Model."""
 
+    _abstract = True
+
     def fit(self, df: DataFrame) -> "Model":
         raise NotImplementedError
 
 
 class Model(Transformer):
     """A fitted Transformer (may reference its parent estimator's params)."""
+
+    _abstract = True
 
 
 class Evaluator(Params):

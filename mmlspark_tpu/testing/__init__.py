@@ -1,0 +1,10 @@
+"""Test-framework utilities shipped with the library (reference parity:
+com/microsoft/ml/spark/core/test — TestBase fixtures, DataFrameEquality,
+the Fuzzing framework and its reflection-based coverage enforcement)."""
+
+from .fuzzing import (  # noqa: F401
+    TestObject,
+    discover_all_stages,
+    experiment_fuzz,
+    serialization_fuzz,
+)

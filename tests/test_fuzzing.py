@@ -1,0 +1,791 @@
+"""Fuzzing-by-reflection: every registered stage must declare a TestObject.
+
+Reference parity: core/test/fuzzing/Fuzzing.scala:16-205 (auto-derived
+experiment + serialization fuzz tests per stage) and FuzzingTest.scala (jar
+reflection asserting no stage lacks a fuzzing suite). Here:
+
+  - ``FIXTURES`` maps stage-class name -> zero-arg factory returning a
+    TestObject; ``covers`` lists model classes exercised via an estimator.
+  - ``WAIVED`` lists stages intentionally excluded, each with a reason.
+  - ``test_every_stage_is_covered`` fails listing any concrete registered
+    stage that is neither fixtured, covered, nor waived.
+  - every fixture gets ExperimentFuzzing (run twice, outputs equal) and
+    SerializationFuzzing (stage + fitted model save/load, outputs equal).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.testing.fuzzing import (
+    TestObject,
+    discover_all_stages,
+    experiment_fuzz,
+    serialization_fuzz,
+)
+
+# --------------------------------------------------------------------------
+# shared tiny datasets
+# --------------------------------------------------------------------------
+
+
+def clf_df(n=80, seed=0, parts=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.2, size=n) > 0).astype(float)
+    return DataFrame.from_dict(
+        {"features": [X[i] for i in range(n)], "label": y}, num_partitions=parts)
+
+
+def reg_df(n=80, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    y = 2 * X[:, 0] - X[:, 1] + 0.05 * rng.normal(size=n)
+    return DataFrame.from_dict(
+        {"features": [X[i] for i in range(n)], "label": y}, num_partitions=2)
+
+
+def mixed_df(n=60, seed=2):
+    rng = np.random.default_rng(seed)
+    return DataFrame.from_dict({
+        "age": rng.uniform(20, 70, n),
+        "city": rng.choice(["york", "kent", "bath"], n).tolist(),
+        "income": rng.normal(50, 10, n),
+        "label": rng.integers(0, 2, n).astype(float),
+    }, num_partitions=2)
+
+
+def text_df():
+    return DataFrame.from_dict({
+        "text": ["the quick brown fox", "jumps over the lazy dog",
+                 "pack my box with five dozen jugs", "hello world"]})
+
+
+def image_df(n=4, h=12, w=10, seed=0):
+    from mmlspark_tpu.core.schema import ImageSchema
+    rng = np.random.default_rng(seed)
+    rows = [ImageSchema.make(
+        rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8), origin=f"i{i}")
+        for i in range(n)]
+    return DataFrame.from_dict({"image": rows}, num_partitions=2)
+
+
+def ratings_df(n_users=16, n_items=12, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for u in range(n_users):
+        liked = [i for i in range(n_items) if i % 2 == u % 2]
+        for i in rng.choice(liked, size=min(5, len(liked)), replace=False):
+            rows.append({"user": u, "item": int(i), "rating": 1.0,
+                         "time": 1_600_000_000 + int(rng.integers(0, 86400))})
+    return DataFrame.from_rows(rows)
+
+
+def scored_clf_df(n=80):
+    """TrainClassifier output + indexed label (ComputeModelStatistics input)."""
+    from mmlspark_tpu.featurize import ValueIndexer
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    from mmlspark_tpu.train import TrainClassifier
+    df = mixed_df(n)
+    model = TrainClassifier(labelCol="label").set_model(
+        LightGBMClassifier(numIterations=5, numLeaves=7, minDataInLeaf=5)).fit(df)
+    scored = model.transform(df)
+    return ValueIndexer(inputCol="label", outputCol="label").fit(df).transform(scored)
+
+
+# module-level (picklable) functions for Lambda / UDFTransformer
+def _double_df(df):
+    return df.with_column("numbers", lambda p: p["numbers"] * 2.0)
+
+
+def _square(v):
+    return float(v) ** 2
+
+
+# --------------------------------------------------------------------------
+# fixture registry
+# --------------------------------------------------------------------------
+
+FIXTURES = {}
+COVERS = {}
+
+
+def fixture(name, covers=()):
+    def deco(fn):
+        FIXTURES[name] = fn
+        COVERS[name] = tuple(covers)
+        return fn
+    return deco
+
+
+WAIVED = {
+    # Requires a live HTTP endpoint per row; the reference runs these suites
+    # against real Azure services. Serialization is still fuzzed via the
+    # serialize-level fixtures of sibling cognitive stages below.
+}
+
+
+# ---- stages/ ----
+
+
+@fixture("Cacher")
+def _cacher():
+    return TestObject(__import__("mmlspark_tpu.stages", fromlist=["Cacher"]).Cacher(),
+                      transform_df=mixed_df())
+
+
+@fixture("ClassBalancer", covers=("ClassBalancerModel",))
+def _class_balancer():
+    from mmlspark_tpu.stages import ClassBalancer
+    df = DataFrame.from_dict({"label": ["a"] * 6 + ["b"] * 2})
+    return TestObject(ClassBalancer(inputCol="label"), fit_df=df, transform_df=df)
+
+
+@fixture("DropColumns")
+def _drop_columns():
+    from mmlspark_tpu.stages import DropColumns
+    return TestObject(DropColumns(cols=["city"]), transform_df=mixed_df())
+
+
+@fixture("SelectColumns")
+def _select_columns():
+    from mmlspark_tpu.stages import SelectColumns
+    return TestObject(SelectColumns(cols=["age", "label"]), transform_df=mixed_df())
+
+
+@fixture("RenameColumn")
+def _rename_column():
+    from mmlspark_tpu.stages import RenameColumn
+    return TestObject(RenameColumn(inputCol="age", outputCol="years"),
+                      transform_df=mixed_df())
+
+
+@fixture("EnsembleByKey")
+def _ensemble_by_key():
+    from mmlspark_tpu.stages import EnsembleByKey
+    df = DataFrame.from_dict({"key": ["a", "a", "b"], "score": [1.0, 3.0, 5.0]})
+    return TestObject(EnsembleByKey(keys=["key"], cols=["score"], newCols=["avg"]),
+                      transform_df=df)
+
+
+@fixture("Explode")
+def _explode():
+    from mmlspark_tpu.stages import Explode
+    df = DataFrame.from_dict({"id": [1, 2], "vals": [[10, 20], [30]]})
+    return TestObject(Explode(inputCol="vals"), transform_df=df)
+
+
+@fixture("Lambda")
+def _lambda():
+    from mmlspark_tpu.stages import Lambda
+    df = DataFrame.from_dict({"numbers": [1.0, 2.0, 3.0]})
+    return TestObject(Lambda(_double_df), transform_df=df)
+
+
+@fixture("UDFTransformer")
+def _udf_transformer():
+    from mmlspark_tpu.stages import UDFTransformer
+    df = DataFrame.from_dict({"numbers": [1.0, 2.0, 3.0]})
+    return TestObject(UDFTransformer(inputCol="numbers", outputCol="sq")
+                      .set("udf", _square), transform_df=df)
+
+
+@fixture("MultiColumnAdapter")
+def _multi_column_adapter():
+    from mmlspark_tpu.stages import MultiColumnAdapter, UDFTransformer
+    base = UDFTransformer().set("udf", _square)
+    df = DataFrame.from_dict({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+    return TestObject(
+        MultiColumnAdapter(inputCols=["a", "b"], outputCols=["a2", "b2"])
+        .set("baseStage", base), transform_df=df)
+
+
+@fixture("PartitionCoalesce")
+def _partition_coalesce():
+    from mmlspark_tpu.stages import PartitionCoalesce
+    return TestObject(PartitionCoalesce(n=1), transform_df=mixed_df())
+
+
+@fixture("Repartition")
+def _repartition():
+    from mmlspark_tpu.stages import Repartition
+    return TestObject(Repartition(n=3), transform_df=mixed_df())
+
+
+@fixture("StratifiedRepartition")
+def _stratified_repartition():
+    from mmlspark_tpu.stages import StratifiedRepartition
+    return TestObject(StratifiedRepartition(labelCol="label"),
+                      transform_df=mixed_df())
+
+
+@fixture("SummarizeData")
+def _summarize_data():
+    from mmlspark_tpu.stages import SummarizeData
+    return TestObject(SummarizeData(), transform_df=mixed_df())
+
+
+@fixture("Timer", covers=("TimerModel",))
+def _timer():
+    from mmlspark_tpu.stages import Timer, UDFTransformer
+    inner = UDFTransformer(inputCol="age", outputCol="age2").set("udf", _square)
+    df = mixed_df()
+    return TestObject(Timer().set("stage", inner), fit_df=df, transform_df=df)
+
+
+@fixture("FixedMiniBatchTransformer")
+def _fixed_minibatch():
+    from mmlspark_tpu.stages import FixedMiniBatchTransformer
+    return TestObject(FixedMiniBatchTransformer(batchSize=3),
+                      transform_df=mixed_df())
+
+
+@fixture("DynamicMiniBatchTransformer")
+def _dynamic_minibatch():
+    from mmlspark_tpu.stages import DynamicMiniBatchTransformer
+    return TestObject(DynamicMiniBatchTransformer(), transform_df=mixed_df())
+
+
+@fixture("TimeIntervalMiniBatchTransformer")
+def _time_interval_minibatch():
+    from mmlspark_tpu.stages import TimeIntervalMiniBatchTransformer
+    return TestObject(TimeIntervalMiniBatchTransformer(millisToWait=5),
+                      transform_df=mixed_df())
+
+
+@fixture("FlattenBatch")
+def _flatten_batch():
+    from mmlspark_tpu.stages import FixedMiniBatchTransformer, FlattenBatch
+    batched = FixedMiniBatchTransformer(batchSize=3).transform(mixed_df())
+    return TestObject(FlattenBatch(), transform_df=batched)
+
+
+@fixture("TextPreprocessor")
+def _text_preprocessor():
+    from mmlspark_tpu.stages import TextPreprocessor
+    return TestObject(
+        TextPreprocessor(inputCol="text", outputCol="out", normFunc="lowerCase"),
+        transform_df=text_df())
+
+
+@fixture("UnicodeNormalize")
+def _unicode_normalize():
+    from mmlspark_tpu.stages import UnicodeNormalize
+    df = DataFrame.from_dict({"text": ["Café", "ＡＢＣ"]})
+    return TestObject(UnicodeNormalize(inputCol="text", outputCol="out"),
+                      transform_df=df)
+
+
+# ---- featurize/ ----
+
+
+@fixture("ValueIndexer", covers=("ValueIndexerModel",))
+def _value_indexer():
+    from mmlspark_tpu.featurize import ValueIndexer
+    df = mixed_df()
+    return TestObject(ValueIndexer(inputCol="city", outputCol="idx"),
+                      fit_df=df, transform_df=df)
+
+
+@fixture("IndexToValue")
+def _index_to_value():
+    from mmlspark_tpu.featurize import ValueIndexer, IndexToValue
+    df = mixed_df()
+    indexed = ValueIndexer(inputCol="city", outputCol="idx").fit(df).transform(df)
+    return TestObject(IndexToValue(inputCol="idx", outputCol="orig"),
+                      transform_df=indexed)
+
+
+@fixture("CleanMissingData", covers=("CleanMissingDataModel",))
+def _clean_missing():
+    from mmlspark_tpu.featurize import CleanMissingData
+    df = DataFrame.from_dict({"x": [1.0, np.nan, 3.0, np.nan, 5.0]})
+    return TestObject(CleanMissingData(inputCols=["x"]), fit_df=df, transform_df=df)
+
+
+@fixture("DataConversion")
+def _data_conversion():
+    from mmlspark_tpu.featurize import DataConversion
+    df = DataFrame.from_dict({"x": [1.2, 2.8, 3.1]})
+    return TestObject(DataConversion(cols=["x"], convertTo="integer"),
+                      transform_df=df)
+
+
+@fixture("AssembleFeatures", covers=("AssembleFeaturesModel",))
+def _assemble_features():
+    from mmlspark_tpu.featurize import AssembleFeatures
+    df = mixed_df()
+    return TestObject(
+        AssembleFeatures(inputCols=["age", "city", "income"],
+                         outputCol="features"),
+        fit_df=df, transform_df=df)
+
+
+@fixture("Featurize", covers=("PipelineModel",))
+def _featurize():
+    from mmlspark_tpu.featurize import Featurize
+    df = mixed_df()
+    return TestObject(Featurize(featureColumns={"feats": ["age", "city"]}),
+                      fit_df=df, transform_df=df)
+
+
+@fixture("TextFeaturizer", covers=("TextFeaturizerModel",))
+def _text_featurizer():
+    from mmlspark_tpu.featurize import TextFeaturizer
+    df = text_df()
+    return TestObject(TextFeaturizer(inputCol="text", outputCol="tf"),
+                      fit_df=df, transform_df=df)
+
+
+@fixture("MultiNGram")
+def _multi_ngram():
+    from mmlspark_tpu.featurize import MultiNGram
+    df = DataFrame.from_dict({"toks": [["a", "b", "c", "d"], ["x", "y"]]})
+    return TestObject(MultiNGram(inputCol="toks", outputCol="grams",
+                                 lengths=[2, 3]), transform_df=df)
+
+
+@fixture("PageSplitter")
+def _page_splitter():
+    from mmlspark_tpu.featurize import PageSplitter
+    df = DataFrame.from_dict({"t": ["word " * 40]})
+    return TestObject(PageSplitter(inputCol="t", outputCol="pages",
+                                   maximumPageLength=50), transform_df=df)
+
+
+# ---- gbdt/ ----
+
+
+@fixture("LightGBMClassifier", covers=("LightGBMClassificationModel",))
+def _lgbm_classifier():
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    df = clf_df()
+    return TestObject(
+        LightGBMClassifier(numIterations=5, numLeaves=7, minDataInLeaf=5),
+        fit_df=df, transform_df=df)
+
+
+@fixture("LightGBMRegressor", covers=("LightGBMRegressionModel",))
+def _lgbm_regressor():
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+    df = reg_df()
+    return TestObject(
+        LightGBMRegressor(numIterations=5, numLeaves=7, minDataInLeaf=5),
+        fit_df=df, transform_df=df)
+
+
+@fixture("LightGBMRanker", covers=("LightGBMRankerModel",))
+def _lgbm_ranker():
+    from mmlspark_tpu.gbdt import LightGBMRanker
+    rng = np.random.default_rng(0)
+    n, gsize = 60, 6
+    X = rng.normal(size=(n, 3))
+    rel = np.clip(np.round(X[:, 0]) + 1, 0, 3)
+    df = DataFrame.from_dict({
+        "features": [X[i] for i in range(n)], "label": rel,
+        "query": np.repeat(np.arange(n // gsize), gsize)})
+    return TestObject(
+        LightGBMRanker(numIterations=4, numLeaves=7, minDataInLeaf=3,
+                       groupCol="query"),
+        fit_df=df, transform_df=df)
+
+
+# ---- vw/ ----
+
+
+@fixture("VowpalWabbitFeaturizer")
+def _vw_featurizer():
+    from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+    return TestObject(
+        VowpalWabbitFeaturizer(inputCols=["age", "city"], outputCol="features"),
+        transform_df=mixed_df())
+
+
+@fixture("VowpalWabbitInteractions")
+def _vw_interactions():
+    from mmlspark_tpu.vw import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+    df = mixed_df()
+    fa = VowpalWabbitFeaturizer(inputCols=["age"], outputCol="fa").transform(df)
+    fb = VowpalWabbitFeaturizer(inputCols=["city"], outputCol="fb").transform(fa)
+    return TestObject(
+        VowpalWabbitInteractions(inputCols=["fa", "fb"], outputCol="fx"),
+        transform_df=fb)
+
+
+def _vw_features_df():
+    from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+    df = clf_df()
+    feat = VowpalWabbitFeaturizer(inputCols=["features"], outputCol="vwfeat")
+    return feat.transform(df)
+
+
+@fixture("VowpalWabbitClassifier", covers=("VowpalWabbitClassificationModel",))
+def _vw_classifier():
+    from mmlspark_tpu.vw import VowpalWabbitClassifier
+    df = _vw_features_df()
+    return TestObject(
+        VowpalWabbitClassifier(featuresCol="vwfeat", labelCol="label",
+                               numPasses=2, numBits=12),
+        fit_df=df, transform_df=df)
+
+
+@fixture("VowpalWabbitRegressor", covers=("VowpalWabbitRegressionModel",))
+def _vw_regressor():
+    from mmlspark_tpu.vw import VowpalWabbitRegressor, VowpalWabbitFeaturizer
+    df = reg_df()
+    fdf = VowpalWabbitFeaturizer(inputCols=["features"],
+                                 outputCol="vwfeat").transform(df)
+    return TestObject(
+        VowpalWabbitRegressor(featuresCol="vwfeat", labelCol="label",
+                              numPasses=2, numBits=12),
+        fit_df=fdf, transform_df=fdf)
+
+
+# ---- image/ ----
+
+
+@fixture("ImageTransformer")
+def _image_transformer():
+    from mmlspark_tpu.image import ImageTransformer
+    return TestObject(
+        ImageTransformer(inputCol="image", outputCol="out").resize(6, 6).flip(1),
+        transform_df=image_df())
+
+
+@fixture("ResizeImageTransformer")
+def _resize_image():
+    from mmlspark_tpu.image import ResizeImageTransformer
+    return TestObject(
+        ResizeImageTransformer(inputCol="image", outputCol="image",
+                               height=6, width=6),
+        transform_df=image_df())
+
+
+@fixture("UnrollImage")
+def _unroll_image():
+    from mmlspark_tpu.image import UnrollImage
+    return TestObject(UnrollImage(inputCol="image", outputCol="unrolled"),
+                      transform_df=image_df(h=6, w=6))
+
+
+@fixture("UnrollBinaryImage")
+def _unroll_binary_image():
+    from mmlspark_tpu.image import UnrollBinaryImage
+    from mmlspark_tpu.ops import image as imops
+    rng = np.random.default_rng(0)
+    blobs = [imops.encode_ppm(rng.integers(0, 256, (8, 8, 3), dtype=np.uint8))
+             for _ in range(3)]
+    df = DataFrame.from_dict({"data": blobs})
+    return TestObject(
+        UnrollBinaryImage(inputCol="data", outputCol="unrolled",
+                          height=6, width=6),
+        transform_df=df)
+
+
+@fixture("ImageSetAugmenter")
+def _image_set_augmenter():
+    from mmlspark_tpu.image import ImageSetAugmenter
+    return TestObject(ImageSetAugmenter(inputCol="image", outputCol="image"),
+                      transform_df=image_df())
+
+
+def _tiny_resnet():
+    from mmlspark_tpu.models import resnet
+    return resnet(18, num_classes=4, image_size=16, width=8)
+
+
+@fixture("ImageFeaturizer")
+def _image_featurizer():
+    from mmlspark_tpu.image import ImageFeaturizer
+    return TestObject(
+        ImageFeaturizer(inputCol="image", outputCol="features", batchSize=4)
+        .set_model(_tiny_resnet()).set_cut_output_layers(1),
+        transform_df=image_df())
+
+
+# ---- models/ ----
+
+
+@fixture("DNNModel")
+def _dnn_model():
+    from mmlspark_tpu.models import DNNModel, Dense, FunctionModel, Sequential, relu
+    import jax
+    module = Sequential([("d1", Dense(6)), ("r", relu()), ("d2", Dense(2))],
+                        name="mlp")
+    params, _ = module.init(jax.random.PRNGKey(0), (4,))
+    fm = FunctionModel(module, params, (4,), layer_names=["d2", "r", "d1"])
+    rng = np.random.default_rng(0)
+    df = DataFrame.from_dict(
+        {"feats": [rng.normal(size=4) for _ in range(6)]}, num_partitions=2)
+    return TestObject(
+        DNNModel(inputCol="feats", outputCol="out", batchSize=3).set_model(fm),
+        transform_df=df)
+
+
+# ---- train/ ----
+
+
+@fixture("TrainClassifier", covers=("TrainedClassifierModel",))
+def _train_classifier():
+    from mmlspark_tpu.train import TrainClassifier
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    df = mixed_df()
+    return TestObject(
+        TrainClassifier(labelCol="label").set_model(
+            LightGBMClassifier(numIterations=4, numLeaves=7, minDataInLeaf=5)),
+        fit_df=df, transform_df=df)
+
+
+@fixture("TrainRegressor", covers=("TrainedRegressorModel",))
+def _train_regressor():
+    from mmlspark_tpu.train import TrainRegressor
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+    rng = np.random.default_rng(0)
+    df = DataFrame.from_dict({"a": rng.normal(size=60),
+                              "b": rng.normal(size=60),
+                              "y": rng.normal(size=60)})
+    return TestObject(
+        TrainRegressor(labelCol="y").set_model(
+            LightGBMRegressor(numIterations=4, numLeaves=7, minDataInLeaf=5)),
+        fit_df=df, transform_df=df)
+
+
+@fixture("ComputeModelStatistics")
+def _compute_model_statistics():
+    from mmlspark_tpu.train import ComputeModelStatistics
+    return TestObject(ComputeModelStatistics(labelCol="label"),
+                      transform_df=scored_clf_df())
+
+
+@fixture("ComputePerInstanceStatistics")
+def _compute_per_instance():
+    from mmlspark_tpu.train import ComputePerInstanceStatistics
+    return TestObject(ComputePerInstanceStatistics(labelCol="label"),
+                      transform_df=scored_clf_df())
+
+
+# ---- automl/ ----
+
+
+@fixture("TuneHyperparameters", covers=("TuneHyperparametersModel",))
+def _tune_hyperparameters():
+    from mmlspark_tpu.automl import (DiscreteHyperParam, GridSpace,
+                                     HyperparamBuilder, TuneHyperparameters)
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    df = clf_df()
+    est = LightGBMClassifier(numIterations=3, minDataInLeaf=5)
+    builder = HyperparamBuilder().add_hyperparam(
+        est, "numLeaves", DiscreteHyperParam([7, 15]))
+    return TestObject(
+        TuneHyperparameters(models=[est], paramSpace=GridSpace(builder.build()),
+                            evaluationMetric="accuracy", numFolds=2,
+                            labelCol="label"),
+        fit_df=df, transform_df=df)
+
+
+@fixture("FindBestModel", covers=("BestModel",))
+def _find_best_model():
+    from mmlspark_tpu.automl import FindBestModel
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    df = clf_df()
+    m1 = LightGBMClassifier(numIterations=4, numLeaves=7, minDataInLeaf=5).fit(df)
+    m2 = LightGBMClassifier(numIterations=1, numLeaves=2, minDataInLeaf=20).fit(df)
+    return TestObject(
+        FindBestModel(models=[m1, m2], evaluationMetric="accuracy",
+                      labelCol="label"),
+        fit_df=df, transform_df=df)
+
+
+# ---- lime/ ----
+
+
+@fixture("TabularLIME", covers=("TabularLIMEModel",))
+def _tabular_lime():
+    from mmlspark_tpu.lime import TabularLIME
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+    df = reg_df(40)
+    probe = LightGBMRegressor(numIterations=3, numLeaves=5,
+                              minDataInLeaf=3).fit(df)
+    return TestObject(
+        TabularLIME(inputCol="features", outputCol="weights", nSamples=60)
+        .set("model", probe),
+        fit_df=df, transform_df=df.limit(2))
+
+
+@fixture("ImageLIME")
+def _image_lime():
+    from mmlspark_tpu.lime import ImageLIME
+    from mmlspark_tpu.image import ImageFeaturizer
+    probe = (ImageFeaturizer(inputCol="image", outputCol="prediction",
+                             batchSize=4)
+             .set_model(_tiny_resnet()).set_cut_output_layers(0))
+    return TestObject(
+        ImageLIME(inputCol="image", outputCol="weights", nSamples=20,
+                  cellSize=8.0).set("model", _SumProbe()),
+        transform_df=image_df(n=1, h=16, w=16))
+
+
+class _SumProbe:
+    """Picklable image probe: prediction = mean pixel (module-level class)."""
+
+    def has_param(self, name):
+        return name == "inputCol"
+
+    def get(self, name):
+        return "image"
+
+    def transform(self, df):
+        from mmlspark_tpu.core.schema import ImageSchema
+
+        def fn(p):
+            return np.array([ImageSchema.to_array(r).astype(np.float64).mean()
+                             for r in p["image"]])
+        return df.with_column("prediction", fn)
+
+
+@fixture("SuperpixelTransformer")
+def _superpixel_transformer():
+    from mmlspark_tpu.lime import SuperpixelTransformer
+    return TestObject(SuperpixelTransformer(inputCol="image", cellSize=8.0),
+                      transform_df=image_df(n=2, h=16, w=16))
+
+
+# ---- recommendation/ ----
+
+
+@fixture("SAR", covers=("SARModel",))
+def _sar():
+    from mmlspark_tpu.recommendation import SAR
+    df = ratings_df()
+    return TestObject(SAR(supportThreshold=1), fit_df=df, transform_df=df)
+
+
+@fixture("RecommendationIndexer", covers=("RecommendationIndexerModel",))
+def _recommendation_indexer():
+    from mmlspark_tpu.recommendation import RecommendationIndexer
+    df = DataFrame.from_dict({"u": ["alice", "bob", "alice"],
+                              "i": ["x", "y", "y"],
+                              "rating": [1.0, 2.0, 3.0]})
+    return TestObject(
+        RecommendationIndexer(userInputCol="u", userOutputCol="user",
+                              itemInputCol="i", itemOutputCol="item"),
+        fit_df=df, transform_df=df)
+
+
+@fixture("RankingAdapter", covers=("RankingAdapterModel",))
+def _ranking_adapter():
+    from mmlspark_tpu.recommendation import RankingAdapter, SAR
+    df = ratings_df()
+    return TestObject(
+        RankingAdapter(k=3).set("recommender", SAR(supportThreshold=1)),
+        fit_df=df, transform_df=df)
+
+
+@fixture("RankingTrainValidationSplit",
+         covers=("RankingTrainValidationSplitModel",))
+def _ranking_tvs():
+    from mmlspark_tpu.recommendation import (RankingEvaluator,
+                                             RankingTrainValidationSplit, SAR)
+    df = ratings_df()
+    return TestObject(
+        RankingTrainValidationSplit(
+            estimator=SAR(supportThreshold=1),
+            evaluator=RankingEvaluator(metricName="ndcgAt", k=3),
+            userCol="user", itemCol="item", ratingCol="rating",
+            minRatingsPerUser=2),
+        fit_df=df, transform_df=df)
+
+
+# ---- io/ ----
+
+
+@fixture("PartitionConsolidator")
+def _partition_consolidator():
+    from mmlspark_tpu.io import PartitionConsolidator
+    return TestObject(PartitionConsolidator(targetPartitions=1),
+                      transform_df=mixed_df())
+
+
+@fixture("HTTPTransformer")
+def _http_transformer():
+    from mmlspark_tpu.io import HTTPTransformer
+    return TestObject(HTTPTransformer(inputCol="req", outputCol="resp"),
+                      level="serialize")
+
+
+@fixture("SimpleHTTPTransformer")
+def _simple_http_transformer():
+    from mmlspark_tpu.io import SimpleHTTPTransformer
+    return TestObject(SimpleHTTPTransformer(outputCol="out", concurrency=2),
+                      level="serialize")
+
+
+# ---- cognitive/ (serialize-level: transforms need live service endpoints;
+# the functional behavior is tested against fake servers in test_cognitive.py)
+
+
+def _cog(cls_name, module, **params):
+    import importlib
+    cls = getattr(importlib.import_module(f"mmlspark_tpu.cognitive.{module}"),
+                  cls_name)
+    stage = cls(outputCol="out", url="https://fake.example/api", **params)
+    stage.set_subscription_key("key123")
+    return TestObject(stage, level="serialize")
+
+
+_COGNITIVE = {
+    "anomaly": ["DetectAnomalies", "DetectLastAnomaly", "SimpleDetectAnomalies"],
+    "bing": ["BingImageSearch"],
+    "face": ["DetectFace", "FindSimilarFace", "GroupFaces", "IdentifyFaces",
+             "VerifyFaces"],
+    "search": ["AddDocuments"],
+    "speech": ["SpeechToText"],
+    "text": ["EntityDetector", "KeyPhraseExtractor", "LanguageDetector", "NER",
+             "TextSentiment"],
+    "vision": ["AnalyzeImage", "DescribeImage", "GenerateThumbnails", "OCR",
+               "RecognizeDomainSpecificContent", "RecognizeText", "TagImage"],
+}
+
+for _mod, _names in _COGNITIVE.items():
+    for _n in _names:
+        FIXTURES[_n] = (lambda n=_n, m=_mod: _cog(n, m))
+        COVERS[_n] = ()
+
+
+# --------------------------------------------------------------------------
+# the tests
+# --------------------------------------------------------------------------
+
+def test_every_stage_is_covered():
+    """FuzzingTest.scala parity: reflect over the registry; fail listing any
+    concrete stage with no fixture, no covering estimator, and no waiver."""
+    names = {c.__name__ for c in discover_all_stages()}
+    covered = set(FIXTURES) | {c for cs in COVERS.values() for c in cs} \
+        | set(WAIVED)
+    missing = sorted(names - covered)
+    assert not missing, (
+        f"{len(missing)} registered stages lack fuzzing fixtures "
+        f"(add to FIXTURES or WAIVED with a reason): {missing}")
+
+
+def test_fixtures_name_real_stages():
+    from mmlspark_tpu.core.pipeline import registered_stages
+    discover_all_stages()  # import everything first
+    names = {c.__name__ for c in registered_stages().values()}
+    bogus = sorted((set(FIXTURES) | {c for cs in COVERS.values() for c in cs})
+                   - names)
+    assert not bogus, f"fixtures reference unregistered stages: {bogus}"
+
+
+@pytest.mark.parametrize("name", sorted(FIXTURES))
+def test_experiment_fuzzing(name):
+    obj = FIXTURES[name]()
+    obj.covers = tuple(COVERS.get(name, ())) or obj.covers
+    experiment_fuzz(obj)
+
+
+@pytest.mark.parametrize("name", sorted(FIXTURES))
+def test_serialization_fuzzing(name, tmp_path):
+    obj = FIXTURES[name]()
+    obj.covers = tuple(COVERS.get(name, ())) or obj.covers
+    serialization_fuzz(obj, str(tmp_path))
