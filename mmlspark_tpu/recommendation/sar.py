@@ -5,8 +5,10 @@ item-item co-occurrence similarity (jaccard / lift / cooccurrence) via sparse
 matrix multiply, SARModel.recommendForAllUsers (SARModel.scala:23-169).
 
 TPU design: the co-occurrence C = B^T B and the scoring A @ S are dense
-bf16-matmuls on the MXU (item and user counts in recommender benchmarks fit
-comfortably; a blocked path handles larger catalogs).
+f32 MXU matmuls (Precision.HIGHEST — similarity cells and recommendation
+scores are gated against the reference's committed TLC fixtures at tight
+tolerances, see tests/test_benchmarks.py; catalogs at recommender-benchmark
+scale make the extra MXU passes immaterial).
 """
 
 from __future__ import annotations
@@ -69,7 +71,10 @@ class SAR(Estimator):
 
         @jax.jit
         def cooccur(b):
-            return jnp.dot(b.T.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+            # full-f32 MXU passes: co-occurrence counts feed exact-parity
+            # similarity gates (tests/test_benchmarks.py vs the reference's
+            # TLC fixtures); 0/1 inputs make the f32 accumulation exact
+            return jnp.dot(b.T, b, precision=jax.lax.Precision.HIGHEST,
                            preferred_element_type=jnp.float32)
 
         C = np.asarray(cooccur(binary))
@@ -109,7 +114,10 @@ class SARModel(Model):
 
         @jax.jit
         def score(a, s):
-            return jnp.dot(a.astype(jnp.bfloat16), s.astype(jnp.bfloat16),
+            # HIGHEST: recommendation scores are compared at 1e-3 absolute
+            # against the reference's committed predictions; bf16 rounding
+            # of the affinities costs more than that
+            return jnp.dot(a, s, precision=jax.lax.Precision.HIGHEST,
                            preferred_element_type=jnp.float32)
 
         scores = np.asarray(score(A, S))

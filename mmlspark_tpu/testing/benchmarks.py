@@ -1,0 +1,83 @@
+"""Benchmark accuracy-regression gates (core/test/benchmarks/Benchmarks.scala
+parity): metric values are recorded to CSV and compared against a committed
+file; drift beyond the per-metric precision fails the suite.
+
+CSV format matches the reference exactly (``name,value,precision,
+higherIsBetter``; Benchmark.toCSVEntry), and the comparison rule matches
+compareBenchmark (Benchmarks.scala:71-86): a higher-is-better metric may
+exceed the committed value freely but not fall more than ``precision`` below
+it; a lower-is-better metric the reverse.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import os
+from typing import Dict, List
+
+
+@dataclasses.dataclass
+class Benchmark:
+    name: str
+    value: float
+    precision: float
+    higher_is_better: bool = True
+
+    def to_csv_entry(self) -> str:
+        hib = "true" if self.higher_is_better else "false"
+        return f"{self.name},{self.value},{self.precision},{hib}"
+
+
+class Benchmarks:
+    """Accumulate benchmarks during a suite; verify against a committed CSV."""
+
+    def __init__(self):
+        self._benchmarks: List[Benchmark] = []
+
+    def add_benchmark(self, name: str, value: float, precision: float = 1e-3,
+                      higher_is_better: bool = True) -> None:
+        assert name not in [b.name for b in self._benchmarks], \
+            f"Benchmark {name} already exists"
+        self._benchmarks.append(Benchmark(name, float(value), float(precision),
+                                          higher_is_better))
+
+    def write_csv(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write("name,value,precision,higherIsBetter\n")
+            for b in self._benchmarks:
+                f.write(b.to_csv_entry() + "\n")
+
+    @staticmethod
+    def read_csv(path: str) -> Dict[str, Benchmark]:
+        out: Dict[str, Benchmark] = {}
+        with open(path) as f:
+            for row in csv.DictReader(f):
+                out[row["name"]] = Benchmark(
+                    row["name"], float(row["value"]), float(row["precision"]),
+                    row["higherIsBetter"].strip().lower() == "true")
+        return out
+
+    def verify(self, committed_csv: str, new_csv: str = None) -> None:
+        """compareBenchmark parity: fail on missing/extra names or drift
+        beyond precision in the bad direction."""
+        if new_csv:
+            self.write_csv(new_csv)
+        old = self.read_csv(committed_csv)
+        new = {b.name: b for b in self._benchmarks}
+        assert set(new) == set(old), (
+            f"benchmark sets differ: new-only={sorted(set(new) - set(old))}, "
+            f"missing={sorted(set(old) - set(new))}")
+        failures = []
+        for name, bn in new.items():
+            bo = old[name]
+            assert bn.higher_is_better == bo.higher_is_better, name
+            diff = bn.value - bo.value
+            ok = (diff + bn.precision > 0) if bn.higher_is_better \
+                else (-diff + bn.precision > 0)
+            if not ok:
+                failures.append(
+                    f"{name}: new {bn.value} vs committed {bo.value} "
+                    f"(precision {bn.precision})")
+        assert not failures, "benchmark regressions:\n" + "\n".join(failures)

@@ -1,0 +1,235 @@
+"""Accuracy-parity regression gates (Benchmarks.scala + SARSpec TLC parity).
+
+Two layers, mirroring the reference's committed-metric strategy:
+
+1. **SAR vs the reference's own committed fixtures** — the strongest
+   cross-implementation gate: tests/resources/{demoUsage,sim_*,userpred_*}
+   are the exact files the reference tests against
+   (src/test/resources/..., SARSpec.scala:62-108). Our SAR must reproduce
+   every similarity-matrix cell and the top-10 user predictions.
+   (user_aff.csv.gz ships with the reference but is never asserted there —
+   SARSpec passes it to test_affinity_matrices which ignores it — so it is
+   not asserted here either.)
+
+2. **GBDT benchmark CSV gates** — the reference trains
+   LightGBMClassifier(numLeaves=5, numIterations=10) per boosting variant on
+   committed datasets and fails CI on metric drift
+   (VerifyLightGBMClassifier.scala:395-455, benchmarks_*.csv). Its datasets
+   are build-time downloads we cannot fetch, so the same protocol runs on
+   sklearn's bundled real datasets (breast_cancer, wine, diabetes) with our
+   committed CSV (tests/resources/benchmarks_VerifyLightGBM.csv) as the
+   drift gate, plus a parity floor against sklearn's
+   HistGradientBoosting* (a mature histogram-GBDT) on the same data.
+"""
+
+import csv
+import gzip
+import os
+from datetime import datetime, timezone
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.recommendation import RecommendationIndexer, SAR
+from mmlspark_tpu.testing.benchmarks import Benchmarks
+
+RES = os.path.join(os.path.dirname(__file__), "resources")
+
+
+# --------------------------------------------------------------------------
+# SAR vs reference TLC fixtures
+# --------------------------------------------------------------------------
+
+
+def _parse_ts(s: str) -> float:
+    return datetime.strptime(s, "%Y/%m/%dT%H:%M:%S").replace(
+        tzinfo=timezone.utc).timestamp()
+
+
+@pytest.fixture(scope="module")
+def tlc_data():
+    with gzip.open(os.path.join(RES, "demoUsage.csv.gz"), "rt") as f:
+        rows = [r for r in csv.DictReader(f)
+                if r["userId"] and r["productId"] and r["timestamp"]]
+    df = DataFrame.from_dict({
+        "userId": [r["userId"] for r in rows],
+        "productId": [r["productId"] for r in rows],
+        "ts": [_parse_ts(r["timestamp"]) for r in rows]})
+    indexer = RecommendationIndexer(
+        userInputCol="userId", userOutputCol="user",
+        itemInputCol="productId", itemOutputCol="item").fit(df)
+    tdf = indexer.transform(df)
+    item_index = {k: int(v) for k, v in indexer.get("itemMap").items()}
+    user_index = {k: int(v) for k, v in indexer.get("userMap").items()}
+    return rows, tdf, indexer, item_index, user_index
+
+
+def _fit_sar(tdf, threshold, similarity):
+    return SAR(userCol="user", itemCol="item", ratingCol="rating",
+               timeCol="ts", supportThreshold=threshold,
+               similarityFunction=similarity,
+               startTime=_parse_ts("2015/06/09T19:39:37")).fit(tdf)
+
+
+_SIM_CASES = [
+    (1, "cooccurrence", "sim_count1.csv.gz"),
+    (1, "lift", "sim_lift1.csv.gz"),
+    (1, "jaccard", "sim_jac1.csv.gz"),
+    (3, "cooccurrence", "sim_count3.csv.gz"),
+    (3, "lift", "sim_lift3.csv.gz"),
+    (3, "jaccard", "sim_jac3.csv.gz"),
+]
+
+
+@pytest.mark.parametrize("threshold,similarity,fixture", _SIM_CASES)
+def test_sar_similarity_matches_reference(tlc_data, threshold, similarity,
+                                          fixture):
+    """Every similarity cell must equal the reference's committed value
+    (SarTLCSpec.test_affinity_matrices exact-equality protocol)."""
+    rows, tdf, indexer, item_index, _ = tlc_data
+    model = _fit_sar(tdf, threshold, similarity)
+    S = np.asarray(model.get("itemSimilarity"))
+    with gzip.open(os.path.join(RES, fixture), "rt") as f:
+        fx = list(csv.reader(f))
+    header = fx[0][1:]
+    checked = 0
+    for line in fx[1:]:
+        i = item_index[line[0]]
+        want = np.array([float(v) for v in line[1:]])
+        got = S[i, [item_index[j] for j in header]]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"{fixture} row {line[0]}")
+        checked += len(header)
+    assert checked >= 10000  # 101x101 matrix
+
+
+_USERPRED_CASES = [
+    ("cooccurrence", "userpred_count3_userid_only.csv.gz"),
+    ("lift", "userpred_lift3_userid_only.csv.gz"),
+    ("jaccard", "userpred_jac3_userid_only.csv.gz"),
+]
+
+
+@pytest.mark.parametrize("similarity,fixture", _USERPRED_CASES)
+def test_sar_userpred_matches_reference(tlc_data, similarity, fixture):
+    """Top-10 unseen-item recommendations for the reference's probe user
+    match the committed items and scores (SARSpec userpred protocol)."""
+    rows, tdf, indexer, item_index, user_index = tlc_data
+    model = _fit_sar(tdf, 3, similarity)
+    uid = user_index["0003000098E85347"]
+    recs_df = model.recommend_for_all_users(num_items=40, remove_seen=True)
+    urow = {c: recs_df.column(c)[uid] for c in recs_df.columns}
+    inv_item = {v: k for k, v in item_index.items()}
+    got_items = [inv_item[i] for i in urow["recommendations"][:10]]
+    got_scores = np.asarray(urow["ratings"][:10], dtype=np.float64)
+
+    with gzip.open(os.path.join(RES, fixture), "rt") as f:
+        ans = list(csv.DictReader(f))[0]
+    want_items = [ans[f"rec{i}"] for i in range(1, 11)]
+    want_scores = np.array([float(ans[f"score{i}"]) for i in range(1, 11)])
+
+    np.testing.assert_allclose(got_scores, want_scores, atol=1e-3,
+                               err_msg=fixture)
+    # item order may legitimately swap between equal scores; require the sets
+    # to match and ordering to agree wherever scores are distinct
+    assert set(got_items) == set(want_items), fixture
+    for k in range(10):
+        if all(abs(want_scores[k] - want_scores[j]) > 1e-6
+               for j in range(10) if j != k):
+            assert got_items[k] == want_items[k], f"{fixture} rank {k}"
+
+
+# --------------------------------------------------------------------------
+# GBDT benchmark CSV gates (VerifyLightGBMClassifier/Regressor protocol)
+# --------------------------------------------------------------------------
+
+
+def _feature_df(X, y, parts=2):
+    return DataFrame.from_dict(
+        {"features": [X[i] for i in range(len(X))], "label": y},
+        num_partitions=parts)
+
+
+def _auc(probs, y):
+    from sklearn.metrics import roc_auc_score
+    return float(roc_auc_score(y, probs))
+
+
+_BOOSTING_TYPES = ("gbdt", "rf", "dart", "goss")
+
+
+def _base_params(boosting):
+    p = dict(numLeaves=5, numIterations=10, boostingType=boosting,
+             minDataInLeaf=20, seed=42)
+    if boosting == "rf":
+        p.update(baggingFraction=0.9, baggingFreq=1)
+    return p
+
+
+@pytest.fixture(scope="module")
+def gbdt_benchmarks():
+    """Train all dataset x boosting-type combos once; the committed-CSV gate
+    runs in test_gbdt_benchmarks_vs_committed."""
+    from sklearn.datasets import load_breast_cancer, load_diabetes, load_wine
+    from mmlspark_tpu.gbdt import LightGBMClassifier, LightGBMRegressor
+
+    bench = Benchmarks()
+
+    # binary: breast_cancer (569 rows, 30 features), AUC on train
+    data = load_breast_cancer()
+    df = _feature_df(data.data, data.target.astype(np.float64))
+    for bt in _BOOSTING_TYPES:
+        model = LightGBMClassifier(**_base_params(bt)).fit(df)
+        probs = np.stack(list(model.transform(df).column("probability")))
+        bench.add_benchmark(f"LightGBMClassifier_breast_cancer_{bt}",
+                            _auc(probs[:, 1], data.target), 0.01)
+
+    # multiclass: wine (178 rows, 3 classes), accuracy on train
+    data = load_wine()
+    df = _feature_df(data.data, data.target.astype(np.float64))
+    for bt in _BOOSTING_TYPES:
+        # multiclass objective is auto-detected from the label cardinality
+        model = LightGBMClassifier(**_base_params(bt)).fit(df)
+        pred = np.asarray(model.transform(df).column("prediction"))
+        bench.add_benchmark(f"LightGBMClassifier_wine_{bt}",
+                            float((pred == data.target).mean()), 0.03)
+
+    # regression: diabetes (442 rows), R^2 on train
+    data = load_diabetes()
+    df = _feature_df(data.data, data.target.astype(np.float64))
+    for bt in _BOOSTING_TYPES:
+        model = LightGBMRegressor(**_base_params(bt)).fit(df)
+        pred = np.asarray(model.transform(df).column("prediction"))
+        ss_res = float(((pred - data.target) ** 2).sum())
+        ss_tot = float(((data.target - data.target.mean()) ** 2).sum())
+        bench.add_benchmark(f"LightGBMRegressor_diabetes_{bt}",
+                            1.0 - ss_res / ss_tot, 0.03)
+    return bench
+
+
+def test_gbdt_benchmarks_vs_committed(gbdt_benchmarks, tmp_path):
+    """Benchmarks.scala verifyBenchmarks parity: committed CSV is the gate."""
+    gbdt_benchmarks.verify(
+        os.path.join(RES, "benchmarks_VerifyLightGBM.csv"),
+        new_csv=str(tmp_path / "new_benchmarks.csv"))
+
+
+def test_gbdt_parity_vs_sklearn_hist_gbdt():
+    """Cross-library floor: our gbdt must be within 0.02 AUC of sklearn's
+    HistGradientBoostingClassifier trained with comparable capacity."""
+    from sklearn.datasets import load_breast_cancer
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    data = load_breast_cancer()
+    skl = HistGradientBoostingClassifier(
+        max_iter=10, max_leaf_nodes=5, learning_rate=0.1,
+        min_samples_leaf=20, early_stopping=False).fit(data.data, data.target)
+    skl_auc = _auc(skl.predict_proba(data.data)[:, 1], data.target)
+
+    df = _feature_df(data.data, data.target.astype(np.float64))
+    ours = LightGBMClassifier(**_base_params("gbdt")).fit(df)
+    probs = np.stack(list(ours.transform(df).column("probability")))
+    our_auc = _auc(probs[:, 1], data.target)
+    assert our_auc >= skl_auc - 0.02, (our_auc, skl_auc)
