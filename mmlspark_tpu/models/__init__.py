@@ -12,6 +12,8 @@ from .module import (
     Residual,
     Sequential,
     flatten,
+    matmul_dtype,
+    matmul_precision,
     relu,
 )
 from .resnet import build_resnet, param_shardings, resnet, resnet18, resnet50

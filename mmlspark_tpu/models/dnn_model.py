@@ -45,8 +45,12 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
     model = ComplexParam("model", "The FunctionModel to evaluate")
     outputNode = Param("outputNode", "Named layer to fetch (None = final output)", None, ptype=str)
     batchSize = Param("batchSize", "Rows per evaluation minibatch", 64, lambda v: v > 0, int)
-    useMesh = Param("useMesh", "Shard eval batches over the default mesh data axis", False,
-                    ptype=bool)
+    useMesh = Param("useMesh",
+                    "Shard eval batches over the active mesh data axis; "
+                    "None (default) = auto: on whenever a >1-device mesh has "
+                    "been explicitly set via MeshContext.set, off otherwise. "
+                    "True additionally builds a default mesh if none is set; "
+                    "False forces single-device eval.", None, ptype=bool)
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
@@ -104,7 +108,9 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
 
         params_dev = jax.device_put(model.params)  # resident once (broadcast parity)
 
-        mesh = MeshContext.get() if self.get("useMesh") else None
+        use = self.get("useMesh")
+        mesh = MeshContext.get() if use is True else \
+            (MeshContext.current() if use is None else None)
         sharding = None
         if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
             sharding = data_sharding(mesh)

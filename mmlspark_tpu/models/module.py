@@ -29,6 +29,91 @@ import numpy as np
 Params = Dict[str, Any]
 
 
+import contextvars as _contextvars
+
+_MATMUL_DTYPE: "_contextvars.ContextVar[str]" = _contextvars.ContextVar(
+    "mmlspark_tpu_matmul_dtype", default="bfloat16")
+
+
+def matmul_dtype() -> str:
+    """Activation/weight dtype for Conv2D/Dense MXU ops: "bfloat16" (default —
+    half the HBM traffic; accumulation is always f32), "float32" (exact —
+    used by sharded-equals-single-device equivalence tests and accuracy-parity
+    gates, where bf16 rounding noise would mask real sharding bugs), or
+    "float64" (numerical experiments; requires jax_enable_x64)."""
+    return _MATMUL_DTYPE.get()
+
+
+class _ContextVarScope:
+    """Context manager setting a ContextVar for the scope (thread/task-local,
+    so concurrent jit traces can't leak each other's setting)."""
+
+    _var: "_contextvars.ContextVar"
+
+    def __init__(self, value):
+        self._value = value
+
+    def __enter__(self):
+        self._token = self._var.set(self._value)
+        return self
+
+    def __exit__(self, *exc):
+        self._var.reset(self._token)
+        return False
+
+
+class matmul_precision(_ContextVarScope):
+    """Context manager selecting the matmul dtype, read at TRACE time.
+
+    CAUTION: jit retraces read the dtype current at the retrace — a function
+    first traced inside ``matmul_precision("float32")`` that later retraces
+    (new input shapes) OUTSIDE the context compiles those shapes in the
+    then-current default. Keep every call that may trace inside the context
+    (or bake the precision in with a trace-time wrapper the way
+    compile_train_step does for activation sharding)."""
+
+    _var = _MATMUL_DTYPE
+
+    def __init__(self, dtype: str):
+        assert dtype in ("bfloat16", "float32", "float64"), dtype
+        if dtype == "float64":
+            import jax
+            assert jax.config.jax_enable_x64, \
+                "matmul_precision('float64') requires jax_enable_x64 " \
+                "(otherwise astype(float64) silently yields float32)"
+        super().__init__(dtype)
+
+
+_ACTIVATION_SHARDING = _contextvars.ContextVar(
+    "mmlspark_tpu_activation_sharding", default=None)
+
+
+class activation_sharding(_ContextVarScope):
+    """Trace-time context: constrain every inter-layer activation to the given
+    sharding (normally batch_sharding(mesh)).
+
+    Why this exists: the XLA SPMD partitioners (both Shardy and legacy GSPMD)
+    mis-propagate the BACKWARD of conv when a broadcast-multiply sits between
+    two channel-sharded convs at small spatial sizes — gradients come back
+    wrong by ~1e-1 in f64 (repro: tests/test_models.py
+    test_train_step_dp_fsdp_tp_matches_single_device, which fails without
+    this). Anchoring each activation to the batch sharding removes the bad
+    propagation choice; with the anchors, sharded == single-device to 1e-7.
+    compile_train_step(mesh=...) enables it automatically, inside the traced
+    function so retraces re-enter it.
+    """
+
+    _var = _ACTIVATION_SHARDING
+
+
+def _constrain_activation(x):
+    s = _ACTIVATION_SHARDING.get()
+    if s is None:
+        return x
+    import jax
+    return jax.lax.with_sharding_constraint(x, s)
+
+
 def _rng_split(rng, n):
     import jax
     return jax.random.split(rng, n)
@@ -93,6 +178,7 @@ class Sequential(Module):
                 x = layer.apply(p, x, train=train, stats_out=stats_out, _path=path)
             else:
                 x = layer.apply(p, x, train=train)
+            x = _constrain_activation(x)
             if taps is not None and taps_out is not None and path in taps:
                 taps_out[path] = x
         return x
@@ -207,13 +293,17 @@ class Conv2D(Module):
     def apply(self, params, x, train: bool = False):
         import jax
         import jax.numpy as jnp
+        dt = getattr(jnp, matmul_dtype())
+        # no preferred_element_type: the conv transpose rule requires the
+        # cotangent dtype to match the inputs, so an f32-accumulate bf16 conv
+        # is not differentiable; the TPU MXU accumulates f32 internally anyway
         y = jax.lax.conv_general_dilated(
-            x.astype(jnp.bfloat16),
-            jnp.asarray(params["kernel"]).astype(jnp.bfloat16),
+            x.astype(dt),
+            jnp.asarray(params["kernel"]).astype(dt),
             window_strides=self.strides,
             padding=self.padding if isinstance(self.padding, str) else list(self.padding),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )  # bf16 activations end-to-end: half the HBM traffic; MXU accumulates f32
+        )  # bf16 activations end-to-end: half the HBM traffic
         if self.use_bias:
             y = y + params["bias"].astype(y.dtype)
         return y
@@ -237,10 +327,11 @@ class Dense(Module):
 
     def apply(self, params, x, train: bool = False):
         import jax.numpy as jnp
-        y = jnp.dot(x.astype(jnp.bfloat16),
-                    jnp.asarray(params["kernel"]).astype(jnp.bfloat16),
-                    preferred_element_type=jnp.float32)
-        y = y.astype(jnp.float32)
+        dt = getattr(jnp, matmul_dtype())
+        y = jnp.dot(x.astype(dt), jnp.asarray(params["kernel"]).astype(dt),
+                    preferred_element_type=jnp.float64 if dt == jnp.float64
+                    else jnp.float32)
+        y = y.astype(dt if dt == jnp.float64 else jnp.float32)
         if self.use_bias:
             y = y + params["bias"]
         return y
@@ -364,7 +455,7 @@ class Residual(Module):
             s = self.shortcut.apply(params["shortcut"], x, train=train, taps=taps,
                                     taps_out=taps_out, stats_out=stats_out,
                                     _prefix=_prefix + "shortcut/")
-        return jnp.maximum(y + s, 0)
+        return _constrain_activation(jnp.maximum(y + s, 0))
 
     def layer_paths(self, prefix: str = "") -> List[str]:
         out = self.body.layer_paths(prefix + "body/")

@@ -184,11 +184,29 @@ def init_train_state(module: Module, in_shape, optimizer, seed: int = 0,
     return TrainState(params, opt_state, step)
 
 
-def compile_train_step(module: Module, optimizer):
+def compile_train_step(module: Module, optimizer, mesh=None):
     """jit the train step. Sharding comes from the *inputs* (GSPMD propagation):
     place state via init_train_state(mesh=...) and batches via batch_sharding(mesh);
-    XLA inserts the DP gradient psums / FSDP all-gathers / TP collectives."""
+    XLA inserts the DP gradient psums / FSDP all-gathers / TP collectives.
+
+    Pass ``mesh`` when training over a multi-device mesh: activations are then
+    anchored to the batch sharding via module.activation_sharding — without
+    the anchors the XLA SPMD partitioners (Shardy and GSPMD alike) produce
+    WRONG conv gradients for channel-sharded kernels at small spatial sizes
+    (see activation_sharding's docstring; the equivalence test in
+    tests/test_models.py fails by ~1e-1 without this)."""
     import jax
 
+    from .module import activation_sharding
+
     step = make_train_step(module, optimizer)
-    return jax.jit(step, donate_argnums=(0,))
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,))
+
+    constraint = batch_sharding(mesh)
+
+    def step_anchored(state, batch):
+        with activation_sharding(constraint):  # trace-time context
+            return step(state, batch)
+
+    return jax.jit(step_anchored, donate_argnums=(0,))

@@ -145,6 +145,15 @@ class MeshContext:
         return cls._default
 
     @classmethod
+    def current(cls):
+        """The explicitly-set mesh, or None — never lazily builds one.
+        Auto-mode consumers (DNNModel useMesh=None) use this so that 'no mesh
+        configured' stays single-device instead of silently constructing a
+        global-device mesh (which would span non-addressable devices in a
+        multi-host deployment)."""
+        return cls._default
+
+    @classmethod
     def set(cls, mesh) -> None:
         cls._default = mesh
 
