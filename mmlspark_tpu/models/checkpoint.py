@@ -1,0 +1,63 @@
+"""Train-state checkpointing: params + optimizer state + step.
+
+Reference scope (SURVEY §5 checkpoint/resume): the reference persists models,
+not training step state — its continued-training hooks are model-level (VW
+initialModel bytes, LightGBM BoosterMerge). A TPU training loop additionally
+needs step-level resume: params, optimizer state, and the step counter
+restored onto the right device shardings. Orbax (the standard JAX checkpoint
+library) handles the array serialization; restore takes a reference state so
+sharded trees come back with their original NamedShardings.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from .training import TrainState
+
+
+def save_train_state(state: TrainState, path: str) -> None:
+    """Write params + opt_state + step under ``path`` (overwrites)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckpt = ocp.PyTreeCheckpointer()
+    tree = {"params": state.params, "opt_state": state.opt_state,
+            "step": np.asarray(state.step)}
+    # block: callers treat save as durable once it returns
+    ckpt.save(path, tree, force=True)
+
+
+def load_train_state(path: str, like: Optional[TrainState] = None) -> TrainState:
+    """Restore a TrainState.
+
+    ``like``: a reference state (e.g. fresh init_train_state(...)) providing
+    the tree structure and target shardings — required to restore optax state
+    (whose pytree types aren't stored) and to place arrays back on a mesh.
+    Without it, arrays come back host-resident with plain structure.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckpt = ocp.PyTreeCheckpointer()
+    if like is None:
+        tree = ckpt.restore(path)
+        return TrainState(tree["params"], tree["opt_state"],
+                          np.asarray(tree["step"]))
+
+    ref = {"params": like.params, "opt_state": like.opt_state,
+           "step": np.asarray(like.step)}
+    restore_args = jax.tree.map(
+        lambda leaf: ocp.ArrayRestoreArgs(sharding=leaf.sharding)
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding")
+        else ocp.RestoreArgs(),
+        ref)
+    tree = ckpt.restore(
+        path, args=ocp.args.PyTreeRestore(
+            item=ref, restore_args=restore_args))
+    return TrainState(tree["params"], tree["opt_state"],
+                      np.asarray(tree["step"]))

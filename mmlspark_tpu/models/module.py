@@ -257,7 +257,9 @@ def _axis_pads(padding, n_axes: int):
 
 
 class Conv2D(Module):
-    """NHWC conv on the MXU: bf16 inputs/kernel, f32 accumulation (preferred_element_type).
+    """NHWC conv on the MXU: inputs/kernel in matmul_dtype() (bf16 default;
+    the MXU accumulates f32 internally — preferred_element_type can't be used
+    here, see the comment in apply()).
 
     ``padding``: "SAME" | "VALID" | explicit ((top,bottom),(left,right)) — the explicit
     form gives bit-parity with frameworks that pad symmetrically where XLA's SAME would

@@ -137,26 +137,30 @@ class MeshContext:
     """
 
     _default = None
+    _explicit = False
 
     @classmethod
     def get(cls):
         if cls._default is None:
-            cls._default = make_mesh()
+            cls._default = make_mesh()  # lazy: does NOT count as explicit
         return cls._default
 
     @classmethod
     def current(cls):
-        """The explicitly-set mesh, or None — never lazily builds one.
-        Auto-mode consumers (DNNModel useMesh=None) use this so that 'no mesh
-        configured' stays single-device instead of silently constructing a
+        """The explicitly-set mesh (via set()), or None. A mesh that get()
+        built lazily does not count. Auto-mode consumers (DNNModel
+        useMesh=None) use this so that 'no mesh configured' stays
+        single-device instead of silently adopting a lazily-constructed
         global-device mesh (which would span non-addressable devices in a
         multi-host deployment)."""
-        return cls._default
+        return cls._default if cls._explicit else None
 
     @classmethod
     def set(cls, mesh) -> None:
         cls._default = mesh
+        cls._explicit = True
 
     @classmethod
     def reset(cls) -> None:
         cls._default = None
+        cls._explicit = False
