@@ -8,7 +8,9 @@ pad/batch -> pipeline.transform (jitted stages reuse their compile cache) ->
 reply routing keyed by request id.
 """
 
-from .server import ServingServer, serve_pipeline
+from .server import ServingServer, reply_to, serve_pipeline
+from .routing import RoutingFront, register_worker
 from .stages import parse_request, make_reply
 
-__all__ = ["ServingServer", "make_reply", "parse_request", "serve_pipeline"]
+__all__ = ["RoutingFront", "ServingServer", "make_reply", "parse_request",
+           "register_worker", "reply_to", "serve_pipeline"]

@@ -1,0 +1,229 @@
+"""RoutingFront — the driver-side routing service for multi-worker serving.
+
+Reference: HTTPSourceV2.scala:113-173 — the driver runs an HttpServer; every
+WorkerServer POSTs its ServiceInfo{name, host, port} to register, and public
+traffic is spread across registered workers. Worker loss is handled by retrying
+on another worker and evicting the dead one (Spark task retry gave the
+reference this for free; here it's explicit).
+
+TPU-native deployment note: one RoutingFront per serving cluster (typically on
+the coordinator host), one ServingServer per TPU host; the pipeline inside
+each worker uses that host's chips. Cross-worker replies ride the internal
+endpoint (server.reply_to), so a worker group that shards a batch can answer
+requests that entered elsewhere.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.error import HTTPError, URLError
+from urllib.parse import urlsplit
+from urllib.request import Request, urlopen
+
+
+class RoutingFront:
+    """HTTP front: register workers, round-robin public requests, evict dead.
+
+    Endpoints:
+      POST /_mmlspark/register   {"address": "http://host:port/api"} -> 200
+      GET  /_mmlspark/workers    -> {"workers": [...]}
+      anything else              -> forwarded to a worker (retry across
+                                    workers; a worker failing ``max_failures``
+                                    consecutive times is evicted)
+    """
+
+    REGISTER_PATH = "/_mmlspark/register"
+    WORKERS_PATH = "/_mmlspark/workers"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 forward_timeout_s: float = 70.0, max_failures: int = 3,
+                 token: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.forward_timeout_s = forward_timeout_s
+        self.max_failures = max_failures
+        self.token = token  # when set, /register requires X-MMLSpark-Token
+        self._workers: List[str] = []
+        self._failures: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -- worker management ------------------------------------------------
+    def register(self, address: str) -> None:
+        with self._lock:
+            if address not in self._workers:
+                self._workers.append(address)
+            self._failures[address] = 0
+
+    def deregister(self, address: str) -> None:
+        with self._lock:
+            if address in self._workers:
+                self._workers.remove(address)
+            self._failures.pop(address, None)
+
+    @property
+    def workers(self) -> List[str]:
+        with self._lock:
+            return list(self._workers)
+
+    def _pick_order(self) -> List[str]:
+        with self._lock:
+            ws = list(self._workers)
+        if not ws:
+            return []
+        start = next(self._rr) % len(ws)
+        return ws[start:] + ws[:start]
+
+    def _note_failure(self, address: str) -> None:
+        with self._lock:
+            n = self._failures.get(address, 0) + 1
+            self._failures[address] = n
+            if n >= self.max_failures and address in self._workers:
+                self._workers.remove(address)
+
+    def _note_success(self, address: str) -> None:
+        with self._lock:
+            self._failures[address] = 0
+
+    # -- HTTP ---------------------------------------------------------------
+    def _make_handler(self):
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _read_body(self) -> bytes:
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                return self.rfile.read(length) if length else b""
+
+            def _respond(self, status: int, body: bytes,
+                         ctype: str = "application/json"):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle(self):
+                incoming = urlsplit(self.path)
+                path = incoming.path.rstrip("/")
+                body = self._read_body()
+                if path == RoutingFront.REGISTER_PATH:
+                    from .server import TOKEN_HEADER
+                    if front.token is not None and \
+                            self.headers.get(TOKEN_HEADER) != front.token:
+                        self._respond(403, b'{"error": "bad cluster token"}')
+                        return
+                    try:
+                        front.register(json.loads(body.decode())["address"])
+                        self._respond(200, b"{}")
+                    except Exception as e:  # noqa: BLE001
+                        self._respond(400, json.dumps(
+                            {"error": str(e)}).encode())
+                    return
+                if path == RoutingFront.WORKERS_PATH:
+                    self._respond(200, json.dumps(
+                        {"workers": front.workers}).encode())
+                    return
+                # forward to a worker, retrying across the ring; a request is
+                # only REPLAYED on another worker when the failure shows it
+                # never reached the first one (connect refused/reset) or the
+                # method is idempotent — a read timeout on a POST may mean the
+                # worker is mid-compute, so replaying would double-process it
+                order = front._pick_order()
+                if not order:
+                    self._respond(503, b'{"error": "no workers registered"}')
+                    return
+                idempotent = self.command in ("GET", "HEAD")
+                for addr in order:
+                    parts = urlsplit(addr)
+                    # "/" routes to the worker's registered api path; any
+                    # other path+query forwards verbatim (proxy semantics) so
+                    # the worker's own 404 behavior is preserved
+                    wpath = parts.path if path in ("", "/") else incoming.path
+                    query = f"?{incoming.query}" if incoming.query else ""
+                    url = f"{parts.scheme}://{parts.netloc}{wpath or '/'}{query}"
+                    req = Request(url, data=body if body else None,
+                                  method=self.command,
+                                  headers={k: v for k, v in
+                                           self.headers.items()
+                                           if k.lower() not in
+                                           ("host", "content-length")})
+                    try:
+                        with urlopen(req,
+                                     timeout=front.forward_timeout_s) as resp:
+                            front._note_success(addr)
+                            self._respond(
+                                resp.status, resp.read(),
+                                resp.headers.get("Content-Type",
+                                                 "application/json"))
+                            return
+                    except HTTPError as e:
+                        # worker answered (e.g. 500 from the pipeline):
+                        # authoritative, do not retry elsewhere
+                        front._note_success(addr)
+                        self._respond(e.code, e.read() or b"",
+                                      e.headers.get("Content-Type",
+                                                    "text/plain"))
+                        return
+                    except (URLError, OSError) as e:
+                        front._note_failure(addr)
+                        reason = getattr(e, "reason", e)
+                        timed_out = isinstance(reason, TimeoutError) or \
+                            "timed out" in str(reason).lower()
+                        if timed_out and not idempotent:
+                            self._respond(504, json.dumps(
+                                {"error": f"worker {addr} timed out; not "
+                                          f"replayed (non-idempotent)"}
+                            ).encode())
+                            return
+                        continue
+                self._respond(502, b'{"error": "all workers failed"}')
+
+            do_POST = _handle
+            do_GET = _handle
+
+        return Handler
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "RoutingFront":
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                             name="routing-front")
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}/"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def register_worker(front_address: str, worker_address: str,
+                    timeout: float = 10.0, token: Optional[str] = None) -> None:
+    """Worker-side registration call (ServiceInfo POST parity)."""
+    from .server import _post_json
+
+    parts = urlsplit(front_address)
+    url = f"{parts.scheme}://{parts.netloc}{RoutingFront.REGISTER_PATH}"
+    _post_json(url, {"address": worker_address}, timeout=timeout, token=token)

@@ -5,10 +5,12 @@ Reference mapping (SURVEY §3.4, HTTPSourceV2.scala):
   - request id + epoch bookkeeping    -> per-request reply slots (Event + holder)
   - micro-batch/continuous trigger    -> drain loop: wait <= max_wait_ms for up
     to max_batch_size requests, one pipeline.transform per drained batch
-  - ServingUDFs.sendReplyUDF          -> reply slot fulfillment by request id
-  - driver routing / multi-worker     -> ServingServer instances are per-host;
-    a front proxy (or DNS) spreads load, replies always come from the host that
-    accepted the request (no cross-machine replyTo hop needed)
+  - ServingUDFs.sendReplyUDF          -> reply slot fulfillment by request id;
+    a peer process can answer via the internal reply endpoint + ``reply_to``
+    (the cross-machine replyTo hop, HTTPSourceV2.scala:516-545)
+  - driver routing / multi-worker     -> RoutingFront (routing.py): workers
+    register, the front load-balances public traffic and retries/evicts dead
+    workers (driver routing service, HTTPSourceV2.scala:113-173)
 
 The batching loop keeps the pipeline's jitted stages warm: after the first
 batch, steady-state latency is queue wait + one compiled forward.
@@ -17,6 +19,7 @@ batch, steady-state latency is queue wait + one compiled forward.
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 import queue as queue_mod
@@ -26,6 +29,25 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.dataframe import DataFrame
+
+#: header carrying the shared cluster secret for internal endpoints
+TOKEN_HEADER = "X-MMLSpark-Token"
+
+
+def _post_json(url: str, payload: dict, timeout: float = 10.0,
+               token: Optional[str] = None) -> None:
+    """POST a JSON payload; any 2xx is success, errors raise (HTTPError for
+    >=400 via urlopen, RuntimeError for odd non-2xx successes)."""
+    from urllib.request import Request, urlopen
+
+    headers = {"Content-Type": "application/json"}
+    if token is not None:
+        headers[TOKEN_HEADER] = token
+    req = Request(url, data=json.dumps(payload).encode("utf-8"),
+                  method="POST", headers=headers)
+    with urlopen(req, timeout=timeout) as resp:
+        if not 200 <= resp.status < 300:
+            raise RuntimeError(f"POST {url} failed: {resp.status}")
 
 
 class _ReplySlot:
@@ -46,25 +68,43 @@ class ServingServer:
       - ``value``:   raw request body bytes
       - ``headers``: per-row dict of request headers
     and must return a DataFrame containing ``id`` and a reply column
-    (default "reply") holding str/bytes/dict per row.
+    (default "reply") holding str/bytes/dict per row. Returning an EMPTY
+    DataFrame means "answered elsewhere": rows stay pending for the
+    cross-worker replyTo hop. A non-empty output without the reply column is
+    a configuration error and fails the batch with 500s.
+
+    ``token``: optional shared cluster secret. When set, the internal reply
+    endpoint requires the ``X-MMLSpark-Token`` header — set the same token on
+    every worker and the RoutingFront. The public API is the intended open
+    surface; the internal endpoints are cluster-internal (the reference's
+    equivalents sit inside the Spark cluster's network boundary,
+    HTTPSourceV2.scala:516-545).
     """
+
+    # internal reply endpoint (cross-machine replyTo, HTTPSourceV2.scala:516-545)
+    INTERNAL_REPLY_PATH = "/_mmlspark/reply"
 
     def __init__(self, transform: Callable[[DataFrame], DataFrame],
                  host: str = "127.0.0.1", port: int = 8898,
                  api_path: str = "/", reply_col: str = "reply",
                  max_batch_size: int = 64, max_wait_ms: float = 5.0,
+                 slot_timeout_s: float = 60.0, token: Optional[str] = None,
                  name: str = "serving"):
         self.transform = transform
         self.host = host
         self.port = port
+        self.slot_timeout_s = slot_timeout_s
         self.api_path = api_path.rstrip("/") or "/"
         self.reply_col = reply_col
         self.max_batch_size = max_batch_size
         self.max_wait_ms = max_wait_ms
         self.name = name
+        self.token = token
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         self._slots: Dict[int, _ReplySlot] = {}
-        self._next_id = 0
+        # random start: ids are routing handles that ride to peer workers, so
+        # don't make them guessable from zero (defense alongside `token`)
+        self._next_id = random.SystemRandom().randrange(1 << 48)
         self._id_lock = threading.Lock()
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -83,18 +123,38 @@ class ServingServer:
 
             def _handle(self):
                 path = self.path.rstrip("/") or "/"
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(length) if length else b""
+                if path == ServingServer.INTERNAL_REPLY_PATH:
+                    # peer worker answering a request that entered here
+                    # (sendReplyUDF -> replyTo hop, ServingUDFs.scala:36-48)
+                    if server.token is not None and \
+                            self.headers.get(TOKEN_HEADER) != server.token:
+                        self.send_error(403, "bad or missing cluster token")
+                        return
+                    try:
+                        msg = json.loads(body.decode("utf-8"))
+                        import base64
+                        server._fulfill(
+                            int(msg["id"]), int(msg.get("status", 200)),
+                            base64.b64decode(msg["body_b64"]),
+                            content_type=msg.get("content_type"))
+                        self.send_response(200)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                    except Exception as e:  # noqa: BLE001
+                        self.send_error(400, str(e))
+                    return
                 if path != server.api_path:
                     self.send_error(404)
                     return
-                length = int(self.headers.get("Content-Length", 0) or 0)
-                body = self.rfile.read(length) if length else b""
                 slot = _ReplySlot()
                 with server._id_lock:
                     rid = server._next_id
                     server._next_id += 1
                     server._slots[rid] = slot
                 server._queue.put((rid, body, dict(self.headers.items())))
-                ok = slot.event.wait(timeout=60.0)
+                ok = slot.event.wait(timeout=server.slot_timeout_s)
                 with server._id_lock:
                     server._slots.pop(rid, None)
                 if not ok:
@@ -142,28 +202,52 @@ class ServingServer:
             for i, (_, body, hdrs) in enumerate(batch):
                 bodies[i] = body
                 headers[i] = hdrs
-            df = DataFrame([{"id": ids, "value": bodies, "headers": headers}])
+            origin = np.empty(len(batch), dtype=object)
+            origin[:] = self.address
+            df = DataFrame([{"id": ids, "value": bodies, "headers": headers,
+                             "origin": origin}])
             try:
                 out = self.transform(df)
                 data = out.collect()
-                out_ids = data["id"]
-                replies = data[self.reply_col]
+                has_rows = any(len(v) for v in data.values())
+                if "id" in data and self.reply_col in data:
+                    out_ids, replies = data["id"], data[self.reply_col]
+                elif not has_rows:
+                    # empty output => nothing answered locally (handoff)
+                    out_ids, replies = (), ()
+                else:
+                    # rows but no id/reply column: a misconfigured transform,
+                    # not a handoff — fail fast instead of letting every
+                    # client hang to the slot timeout
+                    raise KeyError(
+                        f"transform output has rows but no 'id' + "
+                        f"'{self.reply_col}' columns (got {list(data)})")
                 for rid, reply in zip(out_ids, replies):
-                    self._fulfill(int(rid), 200, reply)
-                answered = {int(r) for r in out_ids}
-                for rid in ids:
-                    if int(rid) not in answered:
+                    if reply is None:
                         self._fulfill(int(rid), 204, b"")
+                    else:
+                        self._fulfill(int(rid), 200, reply)
+                # rows ABSENT from the output stay pending: another worker may
+                # answer them via the internal replyTo endpoint; otherwise the
+                # slot times out with 504 (HTTPSourceV2 leaves unanswered
+                # requests to the epoch timeout the same way)
             except Exception as e:  # failed batch -> 500s, keep serving
                 for rid in ids:
                     self._fulfill(int(rid), 500, json.dumps(
                         {"error": str(e)}).encode("utf-8"))
 
-    def _fulfill(self, rid: int, status: int, reply: Any):
-        slot = self._slots.get(rid)
+    def _fulfill(self, rid: int, status: int, reply: Any,
+                 content_type: Optional[str] = None):
+        # pop-to-claim: the batcher thread and peer replyTo handler threads can
+        # race on the same rid; exactly one wins the slot, so the waiting
+        # client never sees a torn status/body pair
+        with self._id_lock:
+            slot = self._slots.pop(rid, None)
         if slot is None:
             return
-        if isinstance(reply, (dict, list)):
+        if content_type is not None and isinstance(reply, (bytes, bytearray)):
+            body, ctype = bytes(reply), content_type
+        elif isinstance(reply, (dict, list)):
             body = json.dumps(reply, default=_json_default).encode("utf-8")
             ctype = "application/json"
         elif isinstance(reply, (bytes, bytearray)):
@@ -179,7 +263,8 @@ class ServingServer:
         slot.body = body
         slot.content_type = ctype
         slot.event.set()
-        self.requests_served += 1
+        with self._id_lock:
+            self.requests_served += 1
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingServer":
@@ -210,6 +295,34 @@ class ServingServer:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+def reply_to(origin_address: str, rid: int, reply: Any, status: int = 200,
+             timeout: float = 10.0, token: Optional[str] = None) -> None:
+    """Answer a request pending on another worker (sendReplyUDF/replyTo parity,
+    ServingUDFs.scala:36-48): POST the reply to ``origin``'s internal handler,
+    which responds on the cached exchange.
+
+    ``origin_address``: the ``origin`` column value the request carried
+    (http://host:port/api); the internal endpoint lives on the same server.
+    ``token``: the cluster secret, when the origin server was started with one.
+    """
+    import base64
+    from urllib.parse import urlsplit
+
+    if isinstance(reply, (bytes, bytearray)):
+        body, ctype = bytes(reply), "application/octet-stream"
+    elif isinstance(reply, str):
+        body, ctype = reply.encode("utf-8"), "text/plain"
+    else:
+        body = json.dumps(reply, default=_json_default).encode("utf-8")
+        ctype = "application/json"
+    parts = urlsplit(origin_address)
+    url = f"{parts.scheme}://{parts.netloc}{ServingServer.INTERNAL_REPLY_PATH}"
+    _post_json(url, {"id": int(rid), "status": int(status),
+                     "content_type": ctype,
+                     "body_b64": base64.b64encode(body).decode("ascii")},
+               timeout=timeout, token=token)
 
 
 def _json_default(o):

@@ -2,6 +2,8 @@
 
 import json
 import threading
+import time
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -302,3 +304,204 @@ class TestReviewRegressions:
         with pytest.raises(TimeoutError):
             FaultToleranceUtils.retry_with_timeout(
                 too_slow, retries=1, timeout_s=0.05, backoff_s=0.001)
+
+
+class TestDistributedServing:
+    """Multi-worker serving: routing front + cross-worker replyTo
+    (HTTPSourceV2 driver routing service + sendReplyUDF parity)."""
+
+    @staticmethod
+    def _echo_worker(tag):
+        from mmlspark_tpu.serving.stages import parse_request
+
+        def transform(df):
+            parsed = parse_request(df, "data", parse="json")
+            return parsed.with_column(
+                "reply", lambda p: [{"worker": tag, "sum": float(np.sum(v))}
+                                    for v in p["data"]])
+        return transform
+
+    def _post(self, url, obj, timeout=15):
+        req = urllib.request.Request(
+            url, data=json.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_front_spreads_load_and_all_answered(self):
+        from mmlspark_tpu.serving import (RoutingFront, ServingServer,
+                                          register_worker)
+        with ServingServer(self._echo_worker("a"), port=0,
+                           max_wait_ms=2.0) as wa, \
+                ServingServer(self._echo_worker("b"), port=0,
+                              max_wait_ms=2.0) as wb, \
+                RoutingFront(port=0) as front:
+            register_worker(front.address, wa.address)
+            register_worker(front.address, wb.address)
+            seen = set()
+            for i in range(8):
+                status, body = self._post(front.address, {"data": [i, 1]})
+                assert status == 200
+                assert body["sum"] == i + 1
+                seen.add(body["worker"])
+            assert seen == {"a", "b"}  # round-robin reached both
+
+    def test_front_evicts_dead_worker_and_retries(self):
+        from mmlspark_tpu.serving import (RoutingFront, ServingServer,
+                                          register_worker)
+        with ServingServer(self._echo_worker("live"), port=0,
+                           max_wait_ms=2.0) as live, \
+                RoutingFront(port=0, max_failures=2) as front:
+            register_worker(front.address, live.address)
+            # register a dead address too
+            register_worker(front.address, "http://127.0.0.1:9/")
+            for i in range(6):
+                status, body = self._post(front.address, {"data": [i]})
+                assert status == 200 and body["worker"] == "live"
+            assert front.workers == [live.address]  # dead one evicted
+
+    def test_cross_worker_reply_to(self):
+        """A request enters worker A; worker B answers it via the internal
+        reply endpoint (the cross-machine replyTo hop)."""
+        from mmlspark_tpu.serving import ServingServer, reply_to
+        handed_off = []
+
+        def transform_a(df):
+            # hand the batch off instead of answering locally
+            data = df.collect()
+            for rid, body, origin in zip(data["id"], data["value"],
+                                         data["origin"]):
+                handed_off.append((int(rid), bytes(body), origin))
+            return df.limit(0)  # answer no rows locally -> stay pending
+
+        with ServingServer(transform_a, port=0, max_wait_ms=2.0,
+                           slot_timeout_s=20.0) as wa:
+            result = {}
+
+            def client():
+                status, body = self._post(wa.address, {"data": [5, 6]})
+                result["status"], result["body"] = status, body
+
+            t = threading.Thread(target=client)
+            t.start()
+            deadline = time.time() + 10
+            while not handed_off and time.time() < deadline:
+                time.sleep(0.01)
+            assert handed_off, "request never reached the transform"
+            rid, body, origin = handed_off[0]
+            # "worker B": answer from outside A's loop via the origin address
+            payload = json.loads(body.decode())
+            reply_to(origin, rid, {"answered_by": "b",
+                                   "sum": float(sum(payload["data"]))})
+            t.join(timeout=10)
+            assert result["status"] == 200
+            assert result["body"] == {"answered_by": "b", "sum": 11.0}
+
+    def test_slot_timeout_configurable(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        def never_answers(df):
+            return df.select([])
+
+        with ServingServer(never_answers, port=0, max_wait_ms=1.0,
+                           slot_timeout_s=0.3) as server:
+            t0 = time.time()
+            req = urllib.request.Request(
+                server.address, data=b"{}", method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                assert False, "expected 504"
+            except urllib.error.HTTPError as e:
+                assert e.code == 504
+            assert time.time() - t0 < 5.0
+
+    def test_missing_reply_col_fails_fast_not_hang(self):
+        """A transform that outputs rows without the reply column is a config
+        error: clients get an immediate 500, not a slot-timeout hang."""
+        from mmlspark_tpu.serving import ServingServer
+
+        def misconfigured(df):
+            return df.with_column("wrong_col", lambda p: p["value"])
+
+        with ServingServer(misconfigured, port=0, max_wait_ms=1.0,
+                           slot_timeout_s=30.0) as server:
+            t0 = time.time()
+            req = urllib.request.Request(
+                server.address, data=b"{}", method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                assert False, "expected 500"
+            except urllib.error.HTTPError as e:
+                assert e.code == 500
+                assert b"reply" in e.read()
+            assert time.time() - t0 < 5.0  # did NOT wait out the 30s slot
+
+    def test_internal_endpoints_require_token(self):
+        """With a cluster token set, unauthenticated replyTo and register are
+        rejected; authenticated ones work."""
+        from mmlspark_tpu.serving import (RoutingFront, ServingServer,
+                                          register_worker, reply_to)
+
+        with ServingServer(self._echo_worker("a"), port=0, max_wait_ms=2.0,
+                           token="s3cret") as wa, \
+                RoutingFront(port=0, token="s3cret") as front:
+            # unauthenticated register -> 403
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                register_worker(front.address, wa.address)
+            assert ei.value.code == 403
+            # unauthenticated replyTo -> 403
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                reply_to(wa.address, 12345, {"x": 1})
+            assert ei.value.code == 403
+            # authenticated register + serve work end-to-end
+            register_worker(front.address, wa.address, token="s3cret")
+            status, body = self._post(front.address, {"data": [2, 3]})
+            assert status == 200 and body["sum"] == 5.0
+
+    def test_front_does_not_replay_timed_out_post(self):
+        """A POST that times out on a worker must NOT be replayed on another
+        worker (double-processing hazard) — client gets 504."""
+        from mmlspark_tpu.serving import RoutingFront, ServingServer, \
+            register_worker
+        processed = []
+
+        def slow(df):
+            data = df.collect()
+            processed.extend(int(r) for r in data["id"])
+            time.sleep(1.5)  # longer than the front's forward timeout
+            return df.with_column("reply", lambda p: [b"late"] * len(p["id"]))
+
+        with ServingServer(slow, port=0, max_wait_ms=1.0) as ws, \
+                ServingServer(self._echo_worker("fast"), port=0,
+                              max_wait_ms=1.0) as wf, \
+                RoutingFront(port=0, forward_timeout_s=0.4) as front:
+            register_worker(front.address, ws.address)  # round-robin hits slow first
+            register_worker(front.address, wf.address)
+            req = urllib.request.Request(
+                front.address, data=json.dumps({"data": [1]}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                assert False, "expected 504"
+            except urllib.error.HTTPError as e:
+                assert e.code == 504
+                assert b"not replayed" in e.read()
+            time.sleep(2.0)  # let the slow worker finish
+            assert len(processed) == 1  # exactly one worker saw the request
+
+    def test_front_forwards_path_and_query(self):
+        """Non-root paths forward verbatim: the worker's own 404 comes back."""
+        from mmlspark_tpu.serving import RoutingFront, ServingServer, \
+            register_worker
+        with ServingServer(self._echo_worker("a"), port=0,
+                           max_wait_ms=2.0) as wa, \
+                RoutingFront(port=0) as front:
+            register_worker(front.address, wa.address)
+            req = urllib.request.Request(
+                front.address.rstrip("/") + "/nonexistent?q=1",
+                data=b"{}", method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404  # the WORKER's 404, not a model reply

@@ -1,0 +1,88 @@
+"""Serving latency benchmark: p50/p95/p99 end-to-end HTTP round-trip.
+
+Two endpoints, mirroring the reference's latency story
+(docs/mmlspark-serving.md: "sub-millisecond" continuous serving):
+  - echo: parse JSON -> sum -> reply (pipeline overhead floor)
+  - featurize: ResNet-18 image featurization (the model endpoint)
+
+Prints one JSON line with latencies in milliseconds.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+
+def _measure(url: str, payload: bytes, n: int, warmup: int = 20):
+    lat = []
+    for i in range(n + warmup):
+        req = urllib.request.Request(
+            url, data=payload, method="POST",
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+        dt = time.perf_counter() - t0
+        if i >= warmup:
+            lat.append(dt * 1e3)
+    a = np.asarray(lat)
+    return {"p50_ms": round(float(np.percentile(a, 50)), 3),
+            "p95_ms": round(float(np.percentile(a, 95)), 3),
+            "p99_ms": round(float(np.percentile(a, 99)), 3),
+            "mean_ms": round(float(a.mean()), 3), "n": n}
+
+
+def main():
+    import jax
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models import DNNModel
+    from mmlspark_tpu.models.resnet import resnet
+    from mmlspark_tpu.serving import ServingServer
+    from mmlspark_tpu.serving.stages import parse_request
+
+    platform = jax.devices()[0].platform
+    n = 200 if platform != "cpu" else 50
+
+    # --- echo endpoint (pipeline-overhead floor)
+    def echo(df):
+        parsed = parse_request(df, "data", parse="json")
+        return parsed.with_column(
+            "reply", lambda p: [float(np.sum(v)) for v in p["data"]])
+
+    # max_wait_ms=0: single-stream latency mode (batch waits only add
+    # latency when requests arrive sequentially)
+    with ServingServer(echo, port=0, max_wait_ms=0.0) as server:
+        echo_stats = _measure(server.address,
+                              json.dumps({"data": [1, 2, 3]}).encode(), n)
+
+    # --- model endpoint: ResNet-18 featurize of a 64x64 image
+    model = resnet(18, num_classes=16, image_size=64, width=16)
+    dnn = DNNModel(inputCol="img", outputCol="feat", batchSize=8,
+                   useMesh=False).set_model(model)
+    dnn.set_output_node_index(1)
+
+    def featurize(df):
+        def decode(p):
+            out = np.empty(len(p["value"]), dtype=object)
+            for i, b in enumerate(p["value"]):
+                arr = np.frombuffer(b, dtype=np.uint8).astype(np.float32)
+                out[i] = arr.reshape(64, 64, 3) / 255.0
+            return out
+        with_img = df.with_column("img", decode)
+        out = dnn.transform(with_img)
+        return out.with_column("reply", lambda p: p["feat"])
+
+    img = np.random.default_rng(0).integers(
+        0, 256, size=(64, 64, 3), dtype=np.uint8).tobytes()
+    with ServingServer(featurize, port=0, max_wait_ms=0.0) as server:
+        model_stats = _measure(server.address, img, n)
+
+    print(json.dumps({"backend": platform,
+                      "echo": echo_stats, "resnet18_featurize": model_stats}))
+
+
+if __name__ == "__main__":
+    main()
