@@ -1,40 +1,73 @@
 """Benchmark: ResNet-50 image featurization throughput (the north-star path).
 
 Measures the flagship DNNModel/ImageFeaturizer inference path on whatever
-accelerator is available (one real TPU chip under the driver): jitted bf16
-ResNet-50 forward to the pooled-feature tap, including host->device transfer
-of each uint8 batch (the realistic pipeline boundary; decode is benchmarked
-separately and excluded, as the reference excludes JVM-side image IO from its
-claims, docs/mmlspark-serving.md).
+accelerator is available (one real TPU chip under the driver). Two numbers:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} —
+  - **steady_state**: jitted bf16 ResNet-50 forward to the pooled-feature tap
+    with inputs already device-resident (two recycled batches) — the kernel
+    ceiling, what the chip sustains when the input pipeline keeps up. This is
+    the headline `value`.
+  - **e2e**: each iteration ships a fresh uint8 batch host->device inside the
+    timed region (`jax.device_put` per step, dispatch pipelined) — the
+    realistic pipeline boundary. Decode/resize are benchmarked separately
+    (tools/), as the reference excludes JVM-side image IO from its claims
+    (docs/mmlspark-serving.md). The measured `h2d_gbps` is printed with it:
+    under the driver's tunnelled single chip the host link runs ~25 MB/s, so
+    e2e there is link-bound and reflects the tunnel, not the framework (a
+    colocated TPU host moves uint8 pixels at PCIe rates, >10 GB/s).
+
+Also prints `mfu`: achieved FLOP/s over the chip's peak bf16 FLOP/s, with
+the FLOP count taken from XLA's own cost analysis of the compiled
+executable (not a hand-count).
+
+Batch size 2048 is the measured optimum on TPU v5e (sweep: 256->2769 img/s,
+1024->10761, 2048->11471, 4096->10866; per-dispatch overhead dominates small
+batches).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} —
 baseline = 2000 images/sec/chip (BASELINE.md north star).
 """
 
 from __future__ import annotations
 
 import json
-import sys
 import time
 
 import numpy as np
 
 BASELINE_IMAGES_PER_SEC = 2000.0
 
+# peak dense bf16 FLOP/s per chip, for the MFU estimate (best-effort table;
+# unknown platforms report mfu=None rather than a made-up denominator)
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e
+}
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in sorted(_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.startswith(prefix):
+            return peak
+    return None
+
 
 def main() -> None:
     import jax
-
-    from mmlspark_tpu.models.resnet import resnet
-
     import jax.numpy as jnp
 
     from mmlspark_tpu.models.module import FunctionModel
+    from mmlspark_tpu.models.resnet import resnet
 
-    platform = jax.devices()[0].platform
-    batch = 256 if platform != "cpu" else 16
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    batch = 2048 if on_accel else 16
     size = 224
-    warmup, iters = 3, 30 if platform != "cpu" else 3
+    warmup = 3
+    iters = 12 if on_accel else 3
 
     model = resnet(50, num_classes=1000, image_size=size)
 
@@ -48,10 +81,23 @@ def main() -> None:
 
     params = jax.device_put(model.params)
     rng = np.random.default_rng(0)
-    # steady-state throughput: inputs device-resident (input pipeline overlapped),
-    # dispatch pipelined, completion forced by fetching every scalar witness
+
+    # ---- steady-state: device-resident inputs, recycled ------------------
     batches = [jax.device_put(rng.integers(0, 256, size=(batch, size, size, 3),
                                            dtype=np.uint8)) for _ in range(2)]
+    # AOT-compile once and call the compiled executable directly: the jitted
+    # wrapper would not reuse this compilation, and a second multi-10s
+    # ResNet-50/2048 compile is real startup cost
+    compiled = featurize.lower(params, batches[0]).compile()
+    featurize = lambda p, x: compiled(p, x)  # noqa: E731
+    flops_per_call = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops_per_call = float(ca.get("flops")) if ca.get("flops") else None
+    except Exception:
+        pass
 
     for i in range(warmup):
         float(featurize(params, batches[i % 2]))
@@ -61,13 +107,40 @@ def main() -> None:
     for o in outs:
         assert np.isfinite(float(o))
     dt = time.perf_counter() - t0
+    steady_ips = batch * iters / dt
 
-    ips = batch * iters / dt
+    # ---- e2e: fresh uint8 batch host->device every step ------------------
+    host_batches = [rng.integers(0, 256, size=(batch, size, size, 3),
+                                 dtype=np.uint8) for _ in range(3)]
+    float(featurize(params, jax.device_put(host_batches[0])))  # warm path
+    e2e_iters = max(iters // 2, 2)
+    t0 = time.perf_counter()
+    outs = [featurize(params, jax.device_put(host_batches[i % 3]))
+            for i in range(e2e_iters)]
+    for o in outs:
+        assert np.isfinite(float(o))
+    e2e_dt = time.perf_counter() - t0
+    e2e_ips = batch * e2e_iters / e2e_dt
+
+    # raw host->device bandwidth, so the e2e number is interpretable
+    t0 = time.perf_counter()
+    jax.device_put(host_batches[1]).block_until_ready()
+    h2d_gbps = host_batches[1].nbytes / (time.perf_counter() - t0) / 1e9
+
+    peak = _peak_flops(dev)
+    mfu = (round(steady_ips / batch * flops_per_call / peak, 3)
+           if (flops_per_call and peak) else None)
+
     print(json.dumps({
         "metric": "resnet50_featurize_images_per_sec_per_chip",
-        "value": round(ips, 1),
+        "value": round(steady_ips, 1),
         "unit": "images/sec",
-        "vs_baseline": round(ips / BASELINE_IMAGES_PER_SEC, 3),
+        "vs_baseline": round(steady_ips / BASELINE_IMAGES_PER_SEC, 3),
+        "e2e_images_per_sec": round(e2e_ips, 1),
+        "h2d_gbps": round(h2d_gbps, 3),
+        "batch": batch,
+        "mfu": mfu,
+        "device": getattr(dev, "device_kind", dev.platform),
     }))
 
 
