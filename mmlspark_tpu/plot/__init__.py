@@ -1,0 +1,5 @@
+"""Evaluation plotting (reference src/main/python/mmlspark/plot)."""
+
+from .plot import confusionMatrix, roc, roc_curve_points
+
+__all__ = ["confusionMatrix", "roc", "roc_curve_points"]

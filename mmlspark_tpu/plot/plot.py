@@ -1,0 +1,114 @@
+"""Plotting helpers for model evaluation.
+
+Reference parity: src/main/python/mmlspark/plot/plot.py (confusionMatrix +
+roc over a Spark/pandas DataFrame, sklearn + matplotlib). Here the metric
+math is the framework's own (train/metrics.py — no sklearn dependency) and
+the input is the columnar DataFrame, pandas, or raw arrays. matplotlib is
+imported lazily so the core library carries no hard dependency on it; pass
+``ax`` to compose into an existing figure.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..train.metrics import auc_score
+from ..train.metrics import confusion_matrix as _confusion_counts
+
+
+def _columns(df: Any, *names: str):
+    """Pull named columns out of a DataFrame / pandas / dict-of-arrays."""
+    if isinstance(df, DataFrame):
+        data = df.select(*names).collect()
+        return tuple(np.asarray(data[n]) for n in names)
+    if hasattr(df, "to_numpy") and hasattr(df, "columns"):  # pandas
+        return tuple(df[n].to_numpy() for n in names)
+    return tuple(np.asarray(df[n]) for n in names)
+
+
+def roc_curve_points(labels: np.ndarray, scores: np.ndarray):
+    """(fpr, tpr, thresholds) by descending-score sweep — the standard
+    construction, implemented directly (no sklearn)."""
+    labels = np.asarray(labels, dtype=np.float64) > 0.5
+    scores = np.asarray(scores, dtype=np.float64)
+    if labels.size == 0:
+        raise ValueError("roc_curve_points: empty input")
+    order = np.argsort(-scores)
+    labels, scores = labels[order], scores[order]
+    # collapse ties: step only where the threshold actually changes
+    distinct = np.r_[np.where(np.diff(scores))[0], labels.size - 1]
+    tps = np.cumsum(labels)[distinct]
+    fps = (distinct + 1) - tps
+    n_pos = max(int(labels.sum()), 1)
+    n_neg = max(int((~labels).sum()), 1)
+    tpr = np.r_[0.0, tps / n_pos]
+    fpr = np.r_[0.0, fps / n_neg]
+    thresholds = np.r_[np.inf, scores[distinct]]
+    return fpr, tpr, thresholds
+
+
+def confusionMatrix(df: Any, y_col: str, y_hat_col: str,
+                    labels: Sequence[Any], ax: Optional[Any] = None):
+    """Render a row-normalized confusion-matrix heatmap with count annotations
+    and an accuracy banner (reference plot.py confusionMatrix parity).
+
+    ``labels`` maps class index -> display name (ticks), as in the reference.
+    Returns the matplotlib Axes.
+    """
+    import matplotlib.pyplot as plt
+
+    y, y_hat = _columns(df, y_col, y_hat_col)
+    y = np.asarray(y).astype(np.int64)
+    y_hat = np.asarray(y_hat).astype(np.int64)
+    accuracy = float(np.mean(y == y_hat))
+    k = len(labels)
+    for name, arr in (("y", y), ("y_hat", y_hat)):
+        if arr.size and (arr.min() < 0 or arr.max() >= k):
+            raise ValueError(
+                f"{name} values must be class indices in [0, {k}) matching "
+                f"`labels`; got range [{arr.min()}, {arr.max()}]")
+    cm = _confusion_counts(y, y_hat, k)
+    row_sums = cm.sum(axis=1, keepdims=True)
+    cmn = cm / np.maximum(row_sums, 1)
+
+    if ax is None:
+        ax = plt.gca()
+    ax.text(-0.3, -0.55, f"Accuracy = {round(accuracy * 100, 1)}%",
+            fontsize=14)
+    ticks = np.arange(k)
+    ax.set_xticks(ticks, labels=[str(v) for v in labels], rotation=0)
+    ax.set_yticks(ticks, labels=[str(v) for v in labels], rotation=90)
+    im = ax.imshow(cmn, interpolation="nearest", cmap="Blues", vmin=0, vmax=1)
+    thresh = 0.1
+    for i, j in itertools.product(range(k), range(k)):
+        ax.text(j, i, int(cm[i, j]), horizontalalignment="center",
+                fontsize=14, color="white" if cmn[i, j] > thresh else "black")
+    ax.figure.colorbar(im, ax=ax)
+    ax.set_xlabel("Predicted Label", fontsize=14)
+    ax.set_ylabel("True Label", fontsize=14)
+    return ax
+
+
+def roc(df: Any, y_col: str, y_hat_col: str, thresh: float = 0.5,
+        ax: Optional[Any] = None):
+    """Plot the ROC curve of score column ``y_hat_col`` against binarized
+    label column ``y_col`` (reference plot.py roc parity; label values are
+    binarized at ``thresh`` the same way). Returns the Axes, with the AUC in
+    the title (an addition — the reference leaves the plot unannotated).
+    """
+    import matplotlib.pyplot as plt
+
+    y, scores = _columns(df, y_col, y_hat_col)
+    labels = (np.asarray(y, dtype=np.float64) > thresh).astype(np.float64)
+    fpr, tpr, _ = roc_curve_points(labels, np.asarray(scores, np.float64))
+    if ax is None:
+        ax = plt.gca()
+    ax.plot(fpr, tpr)
+    ax.set_xlabel("False Positive Rate", fontsize=16)
+    ax.set_ylabel("True Positive Rate", fontsize=16)
+    ax.set_title(f"AUC = {auc_score(labels, np.asarray(scores, np.float64)):.3f}")
+    return ax

@@ -2,6 +2,15 @@
 com/microsoft/ml/spark/core/test — TestBase fixtures, DataFrameEquality,
 the Fuzzing framework and its reflection-based coverage enforcement)."""
 
+from .datagen import (  # noqa: F401
+    ColumnOptions,
+    GenConstraints,
+    MissingOptions,
+    RandomGenConstraints,
+    generate_dataset,
+    generate_like,
+    options_from_schema,
+)
 from .fuzzing import (  # noqa: F401
     TestObject,
     discover_all_stages,

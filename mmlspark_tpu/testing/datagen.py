@@ -1,0 +1,232 @@
+"""Synthetic dataset generation for fuzz/property tests.
+
+Re-designs the reference's datagen framework
+(core/test/datagen/GenerateDataset.scala:15-112, DatasetOptions.scala:28-52,
+DatasetConstraints.scala:11-62, GenerateRow.scala:29-53) for the columnar
+substrate: instead of per-row RDD generators, whole columns are drawn
+vectorized from a seeded ``numpy.random.Generator``, and missing values are
+injected column-wise. The option space matches the reference — per-column
+(column-kind x data-kind) choices sampled from a constrained set, optional
+missing-value injection with a target rate — plus vector columns, which the
+reference left as a TODO (DatasetOptions.scala:12 "TODO: add Categorical,
+DenseVector, SparseVector").
+
+Used by tests/test_fuzzing.py to drive featurize stages over randomly-shaped
+inputs, the way VerifyGenerateDataset + the featurize fuzz suites use it in
+the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import string
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+
+#: data kinds the generator can draw (reference DataOptions.scala:17-20;
+#: date/timestamp are drawn as numpy datetime64 -> object columns)
+DATA_KINDS = ("string", "int", "double", "boolean", "date", "timestamp",
+              "byte", "short")
+
+#: column kinds (reference ColumnOptions — Scalar only; vector is our
+#: extension for the VectorAssembler/featurize paths)
+COLUMN_KINDS = ("scalar", "vector")
+
+
+@dataclasses.dataclass(frozen=True)
+class MissingOptions:
+    """Missing-value injection (DatasetMissingValuesGenOptions parity).
+
+    ``percent_missing``: fraction of cells nulled per eligible column.
+    ``data_kinds``: kinds eligible for injection (empty = none).
+    """
+
+    percent_missing: float = 0.0
+    data_kinds: Tuple[str, ...] = ()
+
+    @property
+    def has_missing(self) -> bool:
+        return self.percent_missing > 0 and bool(self.data_kinds)
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnOptions:
+    """Constrains one column's generation (DatasetOptions parity): the actual
+    (column kind, data kind) pair is sampled per column from these sets."""
+
+    data_kinds: Tuple[str, ...] = DATA_KINDS
+    column_kinds: Tuple[str, ...] = ("scalar",)
+    missing: MissingOptions = MissingOptions()
+
+    def __post_init__(self):
+        bad = set(self.data_kinds) - set(DATA_KINDS)
+        if bad:
+            raise ValueError(f"unknown data kinds: {sorted(bad)}")
+        bad = set(self.column_kinds) - set(COLUMN_KINDS)
+        if bad:
+            raise ValueError(f"unknown column kinds: {sorted(bad)}")
+
+
+@dataclasses.dataclass(frozen=True)
+class GenConstraints:
+    """Dataset-level constraints (BasicDatasetGenConstraints parity)."""
+
+    num_rows: int
+    num_cols: int
+    slots_per_col: Tuple[int, ...] = ()   # vector widths, cycled per column
+    randomize_column_names: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomGenConstraints:
+    """Ranges resolved to concrete constraints with the run's rng
+    (RandomDatasetGenConstraints parity)."""
+
+    min_rows: int = 1
+    max_rows: int = 100
+    min_cols: int = 1
+    max_cols: int = 10
+    min_slots: int = 1
+    max_slots: int = 8
+
+    def resolve(self, rng: np.random.Generator) -> GenConstraints:
+        cols = int(rng.integers(self.min_cols, self.max_cols + 1))
+        return GenConstraints(
+            num_rows=int(rng.integers(self.min_rows, self.max_rows + 1)),
+            num_cols=cols,
+            slots_per_col=tuple(int(rng.integers(self.min_slots,
+                                                 self.max_slots + 1))
+                                for _ in range(cols)))
+
+
+_ALPHABET = np.array(list(string.ascii_letters + string.digits))
+
+
+def _random_name(rng: np.random.Generator) -> str:
+    n = int(rng.integers(4, 12))
+    return "col_" + "".join(rng.choice(_ALPHABET, size=n))
+
+
+def _draw_scalar(kind: str, n: int, rng: np.random.Generator) -> np.ndarray:
+    if kind == "string":
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            ln = int(rng.integers(0, 16))
+            out[i] = "".join(rng.choice(_ALPHABET, size=ln))
+        return out
+    if kind == "int":
+        return rng.integers(np.iinfo(np.int32).min, np.iinfo(np.int32).max,
+                            size=n, dtype=np.int64).astype(np.int32)
+    if kind == "double":
+        return rng.standard_normal(n) * 1e3
+    if kind == "boolean":
+        return rng.integers(0, 2, size=n).astype(bool)
+    if kind == "byte":
+        return rng.integers(-128, 128, size=n, dtype=np.int64).astype(np.int32)
+    if kind == "short":
+        return rng.integers(-32768, 32768, size=n,
+                            dtype=np.int64).astype(np.int32)
+    if kind in ("date", "timestamp"):
+        # epoch range ~1970..2100; dates floor to days
+        secs = rng.integers(0, 4_102_444_800, size=n)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            ts = np.datetime64(int(secs[i]), "s")
+            out[i] = ts.astype("datetime64[D]") if kind == "date" else ts
+        return out
+    raise ValueError(f"unknown data kind {kind!r}")
+
+
+def _inject_missing(col: np.ndarray, kind: str, frac: float,
+                    rng: np.random.Generator) -> np.ndarray:
+    mask = rng.random(len(col)) < frac
+    if not mask.any():
+        return col
+    if kind == "double" and col.dtype != object:
+        out = col.astype(np.float64)
+        out[mask] = np.nan
+        return out
+    out = col.astype(object)
+    out[mask] = None
+    return out
+
+
+def generate_dataset(constraints, seed: int,
+                     per_column: Optional[Dict[int, ColumnOptions]] = None,
+                     default: Optional[ColumnOptions] = None,
+                     num_partitions: int = 1) -> DataFrame:
+    """Generate a random DataFrame (GenerateDataset.generateDatasetFromOptions
+    parity). ``per_column`` maps 0-based column index -> ColumnOptions;
+    unmapped columns use ``default`` (reference default: all kinds, 50%
+    missing eligible everywhere — we default to no missing unless asked).
+    """
+    rng = np.random.default_rng(seed)
+    if isinstance(constraints, RandomGenConstraints):
+        constraints = constraints.resolve(rng)
+    per_column = per_column or {}
+    default = default or ColumnOptions()
+
+    data: Dict[str, np.ndarray] = {}
+    for ci in range(constraints.num_cols):
+        opts = per_column.get(ci, default)
+        kind = str(rng.choice(opts.data_kinds))
+        ckind = str(rng.choice(opts.column_kinds))
+        name = (_random_name(rng) if constraints.randomize_column_names
+                else f"col_{ci}")
+        while name in data:  # random names must stay unique
+            name = _random_name(rng)
+        n = constraints.num_rows
+        if ckind == "vector":
+            slots = (constraints.slots_per_col[ci % len(constraints.slots_per_col)]
+                     if constraints.slots_per_col else 4)
+            col = np.empty(n, dtype=object)
+            for i in range(n):
+                col[i] = rng.standard_normal(slots)
+        else:
+            col = _draw_scalar(kind, n, rng)
+        if opts.missing.has_missing and kind in opts.missing.data_kinds \
+                and ckind == "scalar":
+            col = _inject_missing(col, kind, opts.missing.percent_missing, rng)
+        data[name] = col
+    return DataFrame.from_dict(data, num_partitions=num_partitions)
+
+
+def options_from_schema(df: DataFrame) -> Dict[int, ColumnOptions]:
+    """Derive per-column options matching an existing DataFrame's schema
+    (GenerateDataset.getOptionsFromSchema parity), so ``generate_like`` can
+    draw fresh data in the same shape."""
+    from ..core.schema import ColType
+
+    mapping = {
+        ColType.STRING: "string", ColType.INT32: "int", ColType.INT64: "int",
+        ColType.FLOAT32: "double", ColType.FLOAT64: "double",
+        ColType.BOOL: "boolean",
+    }
+    out: Dict[int, ColumnOptions] = {}
+    for i, name in enumerate(df.columns):
+        ctype = df.schema[name]
+        if ctype in (ColType.VECTOR, ColType.TENSOR):
+            out[i] = ColumnOptions(column_kinds=("vector",))
+        else:
+            out[i] = ColumnOptions(
+                data_kinds=(mapping.get(ctype, "string"),))
+    return out
+
+
+def generate_like(df: DataFrame, num_rows: int, seed: int,
+                  num_partitions: int = 1) -> DataFrame:
+    """Fresh random data with ``df``'s column names and kinds — the
+    schema-driven entry the reference's fuzz suites use."""
+    opts = options_from_schema(df)
+    gen = generate_dataset(
+        GenConstraints(num_rows=num_rows, num_cols=len(df.columns),
+                       randomize_column_names=False),
+        seed=seed, per_column=opts, num_partitions=num_partitions)
+    # rebuild with the target names positionally (renaming in place could
+    # collide when df's own names overlap the col_i placeholders)
+    data = {new: gen.column(old)
+            for old, new in zip(gen.columns, df.columns)}
+    return DataFrame.from_dict(data, num_partitions=num_partitions)

@@ -1,0 +1,87 @@
+"""Plot utilities (reference plot.py parity), rendered headless."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.plot import confusionMatrix, roc, roc_curve_points
+from mmlspark_tpu.train.metrics import auc_score
+
+
+@pytest.fixture(autouse=True)
+def _close_figs():
+    yield
+    plt.close("all")
+
+
+class TestRocCurvePoints:
+    def test_perfect_classifier(self):
+        labels = np.array([0, 0, 1, 1])
+        scores = np.array([0.1, 0.2, 0.8, 0.9])
+        fpr, tpr, thr = roc_curve_points(labels, scores)
+        # reaches (0,1) before any false positive
+        assert any(t == 1.0 and f == 0.0 for f, t in zip(fpr, tpr))
+        assert fpr[0] == 0.0 and tpr[0] == 0.0
+        assert fpr[-1] == 1.0 and tpr[-1] == 1.0
+
+    def test_monotone_and_matches_auc(self):
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 2, 200).astype(float)
+        scores = labels * 0.3 + rng.random(200) * 0.7
+        fpr, tpr, _ = roc_curve_points(labels, scores)
+        assert np.all(np.diff(fpr) >= 0) and np.all(np.diff(tpr) >= 0)
+        # trapezoid over the curve == rank-based AUC
+        assert np.trapezoid(tpr, fpr) == pytest.approx(
+            auc_score(labels, scores), abs=1e-9)
+
+    def test_tied_scores_collapse(self):
+        labels = np.array([0, 1, 0, 1])
+        scores = np.array([0.5, 0.5, 0.5, 0.5])
+        fpr, tpr, _ = roc_curve_points(labels, scores)
+        # single diagonal step: (0,0) -> (1,1)
+        assert len(fpr) == 2
+        assert np.trapezoid(tpr, fpr) == pytest.approx(0.5)
+
+
+class TestPlots:
+    def _df(self):
+        rng = np.random.default_rng(1)
+        y = rng.integers(0, 3, 60)
+        y_hat = np.where(rng.random(60) < 0.8, y, (y + 1) % 3)
+        return DataFrame.from_dict({"label": y, "pred": y_hat}), y, y_hat
+
+    def test_confusion_matrix_renders(self):
+        df, y, y_hat = self._df()
+        ax = confusionMatrix(df, "label", "pred", labels=["a", "b", "c"])
+        assert ax.get_xlabel() == "Predicted Label"
+        # k*k count annotations + accuracy banner
+        assert len(ax.texts) == 9 + 1
+        acc_text = ax.texts[0].get_text()
+        assert f"{round(float(np.mean(y == y_hat)) * 100, 1)}" in acc_text
+
+    def test_roc_renders_on_dataframe_and_arrays(self):
+        rng = np.random.default_rng(2)
+        labels = rng.integers(0, 2, 100).astype(float)
+        scores = labels * 0.4 + rng.random(100) * 0.6
+        df = DataFrame.from_dict({"y": labels, "score": scores})
+        ax = roc(df, "y", "score")
+        assert len(ax.lines) == 1
+        assert "AUC" in ax.get_title()
+        plt.close("all")
+        # dict-of-arrays input path
+        ax2 = roc({"y": labels, "score": scores}, "y", "score")
+        x, t = ax2.lines[0].get_data()
+        assert np.trapezoid(t, x) == pytest.approx(auc_score(labels, scores),
+                                                   abs=1e-9)
+
+    def test_pandas_input(self):
+        import pandas as pd
+
+        pdf = pd.DataFrame({"label": [0, 1, 0, 1], "pred": [0, 1, 1, 1]})
+        ax = confusionMatrix(pdf, "label", "pred", labels=[0, 1])
+        assert len(ax.texts) == 4 + 1
