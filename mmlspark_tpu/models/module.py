@@ -203,9 +203,14 @@ class Fn(Module):
     def init(self, rng, in_shape):
         if self.out_shape_fn is not None:
             return {}, self.out_shape_fn(in_shape)
-        # probe with a zero array (host, cheap)
-        probe = np.zeros((1,) + tuple(in_shape), dtype=np.float32)
-        out = np.asarray(self.fn(probe))
+        # abstract shape probe: traces fn without running it on any backend,
+        # so ops that only work under jit (or would be wrong on host numpy)
+        # still probe correctly, and value-dependent shapes fail loudly at
+        # init instead of silently committing to the zero-input's shape
+        import jax
+
+        spec = jax.ShapeDtypeStruct((1,) + tuple(in_shape), np.float32)
+        out = jax.eval_shape(self.fn, spec)
         return {}, tuple(out.shape[1:])
 
     def apply(self, params, x, train: bool = False):

@@ -1,5 +1,9 @@
-from .batching import Batch, Minibatcher, concat_outputs, next_bucket, pad_batch, stack_rows
+from .batching import (
+    Batch, Minibatcher, concat_outputs, densify_sparse, is_sparse_row,
+    next_bucket, pad_batch, sparse_width, stack_rows,
+)
 from .mesh import (
     DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, SEQ_AXIS, TENSOR_AXIS,
-    MeshContext, MeshSpec, data_sharding, make_mesh, num_data_shards, replicated_sharding,
+    MeshContext, MeshSpec, data_sharding, initialize_distributed, make_mesh,
+    num_data_shards, process_shard, replicated_sharding,
 )

@@ -43,15 +43,71 @@ def local_device_count() -> int:
     return jax.local_device_count()
 
 
+_dist_initialized = False
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> None:
+                           process_id: Optional[int] = None) -> bool:
     """Multi-host bootstrap (replaces driver-socket rendezvous,
-    LightGBMUtils.scala:105-173). No-op when single-process."""
+    LightGBMUtils.scala:105-173). Called automatically by ``make_mesh`` before
+    device discovery; explicit earlier calls are fine and idempotent.
+
+    Arguments default from the environment — ``MMLSPARK_COORDINATOR``,
+    ``MMLSPARK_NUM_PROCESSES``, ``MMLSPARK_PROCESS_ID`` — so a pod launch
+    (one process per host, same program) needs no code changes: set the env
+    on each host and every ``make_mesh()`` sees the global device set.
+    No-op when single-process. Returns True iff jax.distributed was
+    initialized by this call.
+    """
+    global _dist_initialized
+    if _dist_initialized:
+        return False
+    coordinator_address = coordinator_address or \
+        os.environ.get("MMLSPARK_COORDINATOR")
+    if num_processes is None and "MMLSPARK_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["MMLSPARK_NUM_PROCESSES"])
+    if process_id is None and "MMLSPARK_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["MMLSPARK_PROCESS_ID"])
     if num_processes in (None, 1):
-        return
+        # single-process no-op does NOT latch: a later explicit call (or one
+        # made after the env appears) must still be able to initialize
+        return False
     import jax
+
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        # the user bootstrapped jax.distributed themselves (standard JAX
+        # multi-host practice) — respect it, don't double-initialize
+        _dist_initialized = True
+        return False
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    _dist_initialized = True  # latch only after a successful init
+    log.info("jax.distributed initialized: process %s of %s via %s",
+             process_id, num_processes, coordinator_address)
+    return True
+
+
+def process_shard(df, process_id: Optional[int] = None,
+                  num_processes: Optional[int] = None):
+    """Per-process input sharding: each host feeds its own slice of a
+    DataFrame's partitions into the mesh (the SPMD input-pipeline story —
+    the reference's equivalent is Spark assigning partitions to executors).
+    Round-robin by partition index; identity when single-process."""
+    import jax
+
+    if process_id is None or num_processes is None:
+        # env-var launches must shard correctly even before make_mesh runs
+        initialize_distributed()
+    pid = jax.process_index() if process_id is None else process_id
+    n = jax.process_count() if num_processes is None else num_processes
+    if n <= 1:
+        return df
+    from ..core.dataframe import DataFrame
+
+    mine = [p for i, p in enumerate(df.partitions) if i % n == pid]
+    if not mine:
+        return df.limit(0)
+    return DataFrame(mine, schema=df.schema)
 
 
 @dataclasses.dataclass
@@ -95,6 +151,8 @@ def make_mesh(spec: Optional[MeshSpec] = None, device_list: Optional[Sequence] =
     """
     import jax
 
+    if device_list is None:
+        initialize_distributed()  # env-driven multi-host bootstrap (no-op local)
     spec = spec or MeshSpec()
     devs = list(device_list) if device_list is not None else jax.devices()
     sizes = spec.resolve(len(devs))

@@ -299,3 +299,63 @@ class TestMesh:
         f = jax.shard_map(total, mesh=mesh8, in_specs=P("data"), out_specs=P())
         x = jnp.arange(8.0)
         np.testing.assert_allclose(np.asarray(f(x)), 28.0)
+
+
+class TestDistributedBootstrap:
+    """initialize_distributed + per-process input sharding (multi-host story;
+    single-process paths here, the driver's dryrun covers the mesh step)."""
+
+    def test_single_process_noop(self):
+        from mmlspark_tpu.parallel import mesh as mesh_mod
+        old = mesh_mod._dist_initialized
+        mesh_mod._dist_initialized = False
+        try:
+            assert mesh_mod.initialize_distributed() is False
+            # second call short-circuits without re-reading env
+            assert mesh_mod.initialize_distributed() is False
+        finally:
+            mesh_mod._dist_initialized = old
+
+    def test_env_driven_multiprocess_args(self, monkeypatch):
+        """Env vars parse into a jax.distributed.initialize call (stubbed)."""
+        import jax
+
+        from mmlspark_tpu.parallel import mesh as mesh_mod
+
+        calls = []
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda addr, n, pid: calls.append((addr, n, pid)))
+        monkeypatch.setenv("MMLSPARK_COORDINATOR", "10.0.0.1:1234")
+        monkeypatch.setenv("MMLSPARK_NUM_PROCESSES", "4")
+        monkeypatch.setenv("MMLSPARK_PROCESS_ID", "2")
+        old = mesh_mod._dist_initialized
+        mesh_mod._dist_initialized = False
+        try:
+            assert mesh_mod.initialize_distributed() is True
+            assert calls == [("10.0.0.1:1234", 4, 2)]
+            # idempotent: no second init
+            assert mesh_mod.initialize_distributed() is False
+            assert len(calls) == 1
+        finally:
+            mesh_mod._dist_initialized = old
+
+    def test_process_shard_round_robin(self):
+        from mmlspark_tpu.parallel import process_shard
+
+        df = DataFrame.from_dict({"x": np.arange(12.0)}, num_partitions=6)
+        shards = [process_shard(df, process_id=p, num_processes=3)
+                  for p in range(3)]
+        assert [s.num_partitions for s in shards] == [2, 2, 2]
+        all_rows = np.sort(np.concatenate([s.column("x") for s in shards]))
+        np.testing.assert_array_equal(all_rows, np.arange(12.0))
+        # identity when single-process
+        assert process_shard(df, process_id=0, num_processes=1) is df
+
+    def test_process_shard_more_processes_than_partitions(self):
+        from mmlspark_tpu.parallel import process_shard
+
+        df = DataFrame.from_dict({"x": np.arange(4.0)}, num_partitions=2)
+        empty = process_shard(df, process_id=3, num_processes=4)
+        assert len(empty) == 0
+        assert empty.columns == df.columns

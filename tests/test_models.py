@@ -64,6 +64,28 @@ class TestModule:
         paths = m.module.layer_paths()
         assert "dense1" in paths and "relu1" in paths
 
+    def test_fn_shape_probe_is_abstract(self):
+        """Fn without out_shape_fn probes via jax.eval_shape: no concrete
+        execution, so jax-only ops work and nothing runs on host numpy."""
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.models.module import Fn
+
+        ran = []
+
+        def jax_only(x):
+            ran.append(True)
+            # top_k has no numpy equivalent under np-array dispatch
+            vals, _ = jax.lax.top_k(x, 3)
+            return jnp.swapaxes(vals, -1, -2) if vals.ndim > 2 else vals
+
+        params, out_shape = Fn(jax_only).init(jax.random.key(0), (10,))
+        assert out_shape == (3,)
+        assert params == {}
+        # traced (abstractly) exactly once, never executed concretely
+        assert len(ran) == 1
+
 
 class TestResNet:
     def test_tiny_resnet_forward(self):
