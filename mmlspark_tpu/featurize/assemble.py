@@ -58,6 +58,11 @@ class AssembleFeatures(Estimator, HasInputCols, HasOutputCol):
                         dim = len(np.asarray(v).reshape(-1))
                         break
                 encoders.append({"col": c, "kind": "vector", "dim": dim})
+            elif kind == "sparse":
+                from ..parallel.batching import sparse_width
+
+                encoders.append({"col": c, "kind": "sparse",
+                                 "dim": sparse_width(col)})
             elif kind == "string":
                 levels = sorted({str(v) for v in col if v is not None})
                 if (self.get("oneHotEncodeCategoricals")
@@ -97,6 +102,10 @@ class AssembleFeaturesModel(Model, HasInputCols, HasOutputCol):
                         if v is not None:
                             block[i] = np.asarray(v, dtype=np.float64).reshape(-1)[:dim]
                     pieces.append(block)
+                elif kind == "sparse":
+                    from ..parallel.batching import densify_sparse
+
+                    pieces.append(densify_sparse(col, enc["dim"]))
                 elif kind == "onehot":
                     levels = enc["levels"]
                     index = {v: i for i, v in enumerate(levels)}
@@ -160,6 +169,10 @@ def _column_kind(col: np.ndarray) -> str:
             continue
         if isinstance(v, str):
             return "string"
+        from ..parallel.batching import is_sparse_row
+
+        if is_sparse_row(v):
+            return "sparse"  # TextFeaturizer/VW sparse-row struct
         if isinstance(v, (np.ndarray, list, tuple)):
             return "vector"
         if isinstance(v, (int, float, np.integer, np.floating, bool)):

@@ -116,11 +116,12 @@ class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
             out = np.empty(len(col), dtype=object)
             for i, text in enumerate(col):
                 sparse = hash_tf(self._tokens(text), nf)
+                values = sparse["values"]
                 if idf is not None:
-                    sparse = {"indices": sparse["indices"],
-                              "values": (sparse["values"]
-                                         * idf[sparse["indices"]]).astype(np.float32)}
-                out[i] = sparse
+                    values = (values * idf[sparse["indices"]]).astype(np.float32)
+                # "size" makes the row densifiable downstream (stack_rows)
+                out[i] = {"size": nf, "indices": sparse["indices"],
+                          "values": values}
             return out
 
         return df.with_column(out_col, fn)

@@ -257,8 +257,10 @@ def _lambdarank_grad_hess(scores, labels, group_ids, sigma: float = 1.0,
     return g_out, h_out
 
 
-def init_score(objective: str, labels: np.ndarray, num_class: int = 1) -> np.ndarray:
-    """Base score before the first tree (BoostFromAverage parity)."""
+def init_score(objective: str, labels: np.ndarray, num_class: int = 1,
+               alpha: float = 0.9) -> np.ndarray:
+    """Base score before the first tree (BoostFromAverage parity).
+    ``alpha`` is the quantile level for the quantile objective."""
     if objective == "binary":
         p = np.clip(labels.mean(), 1e-12, 1 - 1e-12)
         return np.full(1, np.log(p / (1 - p)), dtype=np.float64)
@@ -274,7 +276,7 @@ def init_score(objective: str, labels: np.ndarray, num_class: int = 1) -> np.nda
     if objective in ("regression_l1", "l1", "mae"):
         return np.full(1, np.median(labels), dtype=np.float64)
     if objective == "quantile":
-        return np.full(1, np.quantile(labels, 0.9), dtype=np.float64)
+        return np.full(1, np.quantile(labels, alpha), dtype=np.float64)
     if objective == "poisson":
         return np.full(1, np.log(max(labels.mean(), 1e-12)), dtype=np.float64)
     return np.zeros(1, dtype=np.float64)
@@ -537,7 +539,8 @@ def train(params: TrainParams,
             init_arr = np.concatenate([init_arr, np.zeros((pad_rows, init_arr.shape[1]))])
         scores = np.broadcast_to(init_arr, (n, k)).copy()
     else:
-        base = init_score(objective, np.asarray(y[:n_real], dtype=np.float64), k)
+        base = init_score(objective, np.asarray(y[:n_real], dtype=np.float64),
+                          k, alpha=params.alpha)
         scores = np.tile(base, (n, 1)).astype(np.float64)
     booster = Booster(params, mapper, base_score=base)
     if init_model is not None:

@@ -48,14 +48,62 @@ def pad_batch(arr: np.ndarray, target: int, pad_value: float = 0.0) -> np.ndarra
     return np.pad(arr, pad_width, constant_values=pad_value)
 
 
+def is_sparse_row(v) -> bool:
+    """True for the framework's sparse-row struct ``{"indices", "values"
+    [, "size"]}`` (shared by TextFeaturizer and the VW featurizer)."""
+    return isinstance(v, dict) and "indices" in v and "values" in v
+
+
+def sparse_width(col) -> int:
+    """The dense width of a sparse-row column: the declared ``size`` when the
+    producer carries one (both in-repo producers do — widths then do NOT
+    depend on which rows a partition happens to hold), else max index + 1."""
+    width = 0
+    for v in col:
+        if v is None:
+            continue
+        s = int(v.get("size", 0))
+        if not s:
+            idx = np.asarray(v["indices"])
+            s = int(idx.max()) + 1 if idx.size else 0
+        width = max(width, s)
+    return width
+
+
+def densify_sparse(col, width: int, dtype=np.float64) -> np.ndarray:
+    """Sparse-row column -> dense [N, width]. Indices >= width are dropped
+    (VW masking semantics; also what a narrower fit-time width means)."""
+    out = np.zeros((len(col), width), dtype=dtype)
+    for i, v in enumerate(col):
+        if v is None:
+            continue
+        idx = np.asarray(v["indices"], dtype=np.int64)
+        keep = idx < width
+        out[i, idx[keep]] = np.asarray(v["values"], dtype=dtype)[keep]
+    return out
+
+
 def stack_rows(col: np.ndarray, dtype=np.float32) -> np.ndarray:
     """Stack a column of per-row arrays/scalars into one dense [N, ...] array.
 
-    Ragged rows are an error here — resize/pad upstream (images are resized before
-    unroll in the reference too, image/ImageFeaturizer.scala:141-165).
+    Sparse rows densify here (via ``sparse_width``/``densify_sparse``), so
+    every dense consumer (GBDT, DNN, LIME) accepts sparse feature columns the
+    way Spark ML estimators accept SparseVector. Ragged dense rows are an
+    error — resize/pad upstream (images are resized before unroll in the
+    reference too, image/ImageFeaturizer.scala:141-165).
     """
     if col.dtype != object:
         return np.ascontiguousarray(col, dtype=dtype)
+    probe = next((v for v in col if v is not None), None)
+    if is_sparse_row(probe):
+        width = sparse_width(col)
+        if width > (1 << 22):
+            raise ValueError(
+                f"sparse column width {width} is too large to densify — "
+                f"use a smaller feature space (e.g. VowpalWabbitFeaturizer"
+                f"(numBits<=22), TextFeaturizer(numFeatures<=4194304)) or a "
+                f"sparse-native consumer (the VW stages)")
+        return densify_sparse(col, width, dtype=dtype)
     rows = [np.asarray(v, dtype=dtype) for v in col]
     shapes = {r.shape for r in rows}
     if len(shapes) > 1:

@@ -36,8 +36,9 @@ def _sort_dedup(idx, val, mask: int, sum_collisions: bool = True
                 ) -> Dict[str, np.ndarray]:
     """Mask, sort, and merge duplicate indices (sum, or keep-first when
     ``sum_collisions`` is False — VW's sumCollisions semantics)."""
+    size = mask + 1  # declared width: densification must not depend on rows
     if len(idx) == 0:
-        return {"indices": np.empty(0, dtype=np.int64),
+        return {"size": size, "indices": np.empty(0, dtype=np.int64),
                 "values": np.empty(0, dtype=np.float32)}
     arr_i = np.asarray(idx, dtype=np.int64) & mask
     arr_v = np.asarray(val, dtype=np.float32)
@@ -48,7 +49,7 @@ def _sort_dedup(idx, val, mask: int, sum_collisions: bool = True
         merged = np.add.reduceat(arr_v, start)
     else:
         merged = arr_v[start]  # first occurrence wins
-    return {"indices": uniq, "values": merged.astype(np.float32)}
+    return {"size": size, "indices": uniq, "values": merged.astype(np.float32)}
 
 
 class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
@@ -166,7 +167,8 @@ class VowpalWabbitInteractions(Transformer, HasInputCols, HasOutputCol):
             for i in range(n):
                 feats = [p[c][i] for c in in_cols]
                 if any(f is None for f in feats):
-                    out[i] = {"indices": np.empty(0, dtype=np.int64),
+                    out[i] = {"size": mask + 1,
+                              "indices": np.empty(0, dtype=np.int64),
                               "values": np.empty(0, dtype=np.float32)}
                     continue
                 # FNV-1 combine, 32-bit wraparound (VowpalWabbitInteractions.scala:43-57):

@@ -126,6 +126,65 @@ class TestTextFeaturizer:
         f = out.column("tf")[0]
         assert len(f["indices"]) > 0
         assert (f["values"] >= 0).all()
+        assert f["size"] == 1 << 12  # densifiable downstream
+
+    def test_sparse_output_feeds_dense_estimators(self):
+        """TextFeaturizer sparse rows flow into GBDT and auto-featurize
+        (stack_rows/AssembleFeatures densify them — SparseVector parity)."""
+        from mmlspark_tpu.gbdt import LightGBMClassifier
+        from mmlspark_tpu.parallel import stack_rows
+
+        rng = np.random.default_rng(0)
+        texts, labels = [], []
+        for i in range(80):
+            word = "good" if i % 2 else "bad"
+            texts.append(f"the {word} movie was {word}")
+            labels.append(float(i % 2))
+        df = DataFrame.from_dict({"text": np.array(texts, object),
+                                  "y": np.array(labels)})
+        tf = TextFeaturizer(inputCol="text", outputCol="features",
+                            numFeatures=256).fit(df).transform(df)
+        # 1) direct densify
+        dense = stack_rows(tf.column("features"), np.float64)
+        assert dense.shape == (80, 256)
+        # 2) GBDT consumes the sparse column directly
+        model = LightGBMClassifier(labelCol="y", featuresCol="features",
+                                   numIterations=10, numLeaves=7,
+                                   minDataInLeaf=5).fit(tf)
+        pred = model.transform(tf).column("prediction")
+        assert float(np.mean(pred == labels)) > 0.9
+        # 3) auto-featurize (TrainClassifier path) assembles sparse + others
+        assembled = Featurize(featureColumns={"all": ["features"]}) \
+            .fit(tf).transform(tf)
+        v = assembled.column("all")[0]
+        assert np.asarray(v).shape == (256,)
+
+    def test_sparse_width_is_declared_not_data_dependent(self):
+        """Densified width comes from the producer's declared size, so a
+        partition/test-set whose max index is smaller still gets the same
+        width as fit time (was: max-index inference -> shape mismatch)."""
+        from mmlspark_tpu.parallel import sparse_width, stack_rows
+        from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+
+        df = DataFrame.from_dict(
+            {"word": np.array(["alpha", "beta", "gamma", "delta"], object)})
+        out = VowpalWabbitFeaturizer(inputCols=["word"], outputCol="f",
+                                     numBits=10).transform(df)
+        col = out.column("f")
+        assert sparse_width(col) == 1024
+        # any single-row slice densifies to the SAME width
+        assert stack_rows(col[:1], np.float64).shape == (1, 1024)
+        assert stack_rows(col[2:], np.float64).shape == (2, 1024)
+
+    def test_huge_sparse_width_errors_clearly(self):
+        from mmlspark_tpu.parallel import stack_rows
+
+        row = {"size": 1 << 30, "indices": np.array([5]),
+               "values": np.array([1.0], dtype=np.float32)}
+        col = np.empty(1, dtype=object)
+        col[0] = row
+        with pytest.raises(ValueError, match="too large to densify"):
+            stack_rows(col, np.float64)
 
     def test_ngrams(self):
         model = TextFeaturizer(inputCol="text", outputCol="tf", useNGram=True,

@@ -376,6 +376,30 @@ class TestDistributed:
 
 
 class TestReviewRegressions:
+    def test_quantile_init_score_uses_alpha(self):
+        """init_score for the quantile objective must start at the CONFIGURED
+        quantile — it was hardcoded to 0.9, so low-alpha fits started at the
+        90th percentile and barely converged."""
+        rng = np.random.default_rng(0)
+        y = rng.standard_normal(500)
+        lo = B.init_score("quantile", y, alpha=0.2)[0]
+        hi = B.init_score("quantile", y, alpha=0.8)[0]
+        assert lo == pytest.approx(np.quantile(y, 0.2))
+        assert hi == pytest.approx(np.quantile(y, 0.8))
+        # end-to-end: empirical coverage brackets the requested quantiles
+        X = rng.normal(size=(300, 4))
+        y = X @ rng.normal(size=4) + rng.standard_t(df=3, size=300)
+        cov = {}
+        for alpha in (0.2, 0.8):
+            params = TrainParams(objective="quantile", alpha=alpha,
+                                 num_iterations=30, learning_rate=0.1,
+                                 num_leaves=15, min_data_in_leaf=10)
+            booster = B.train(params, X, y)
+            cov[alpha] = float(np.mean(y < booster.raw_predict(X)))
+        assert 0.05 < cov[0.2] < 0.45, cov
+        assert 0.55 < cov[0.8] < 0.95, cov
+        assert cov[0.2] < cov[0.8]
+
     def test_categorical_feature_end_to_end(self):
         rng = np.random.default_rng(0)
         n = 400
