@@ -1,0 +1,75 @@
+"""Shared synthetic datasets shaped like the reference notebooks' data."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+
+
+def adult_census(n: int = 400, seed: int = 0) -> DataFrame:
+    """Adult-census-shaped table: mixed numeric/categorical, string label
+    (the `income` column of the notebook)."""
+    rng = np.random.default_rng(seed)
+    age = rng.integers(17, 80, n).astype(np.float64)
+    hours = rng.integers(10, 70, n).astype(np.float64)
+    education = rng.choice(["HS-grad", "Bachelors", "Masters", "Doctorate"], n)
+    occupation = rng.choice(["Tech", "Sales", "Service", "Exec"], n)
+    score = (0.04 * (age - 40) + 0.05 * (hours - 40)
+             + (education == "Masters") * 0.8 + (education == "Doctorate") * 1.5
+             + (occupation == "Exec") * 0.7 + rng.normal(0, 0.8, n))
+    label = np.where(score > 0.3, ">50K", "<=50K")
+    return DataFrame.from_dict({
+        "age": age, "hours_per_week": hours,
+        "education": education.astype(object),
+        "occupation": occupation.astype(object),
+        "income": label.astype(object)}, num_partitions=4)
+
+
+def flight_delays(n: int = 400, seed: int = 1) -> DataFrame:
+    """Flight-delays-shaped table with injected missing values."""
+    rng = np.random.default_rng(seed)
+    distance = rng.uniform(100, 3000, n)
+    dep_hour = rng.integers(0, 24, n).astype(np.float64)
+    carrier = rng.choice(["AA", "DL", "UA", "WN"], n)
+    delay = (0.01 * distance + (dep_hour > 17) * 12
+             + (carrier == "UA") * 5 + rng.normal(0, 6, n))
+    # missing values, as the DataCleaning notebook expects
+    distance[rng.random(n) < 0.1] = np.nan
+    dep_hour[rng.random(n) < 0.1] = np.nan
+    return DataFrame.from_dict({
+        "distance": distance, "dep_hour": dep_hour,
+        "carrier": carrier.astype(object), "delay": delay},
+        num_partitions=4)
+
+
+def drug_activity(n: int = 300, d: int = 8, seed: int = 2):
+    """Drug-discovery-shaped regression: dense feature vectors, heavy-tailed
+    target (what quantile objectives are for)."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = X @ w + rng.standard_t(df=3, size=n) * 2.0
+    df = DataFrame.from_dict({"features": [X[i] for i in range(n)],
+                              "activity": y}, num_partitions=4)
+    return df, X, y
+
+
+def tiny_images(n: int = 6, h: int = 24, w: int = 18, seed: int = 3,
+                with_labels: bool = False) -> DataFrame:
+    """Image-schema rows (the OpenCV/DeepLearning notebooks' input)."""
+    from mmlspark_tpu.core.schema import ImageSchema
+
+    rng = np.random.default_rng(seed)
+    rows, labels = [], []
+    for i in range(n):
+        label = i % 2
+        img = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+        if label:  # class 1 = bright left half, so transfer learning can win
+            img[:, : w // 2] = np.minimum(img[:, : w // 2] + 120, 255)
+        rows.append(ImageSchema.make(img, origin=f"img_{i}"))
+        labels.append(label)
+    data = {"image": rows}
+    if with_labels:
+        data["label"] = np.array(labels, dtype=np.int64)
+    return DataFrame.from_dict(data, num_partitions=2)

@@ -1,0 +1,38 @@
+"""Classification - Adult Census with Vowpal Wabbit.
+
+The VW journey: hash-featurize mixed columns into a sparse space, train the
+online linear learner with multiple passes, inspect training statistics.
+"""
+
+import numpy as np
+
+from _data import adult_census
+from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer
+
+
+def main():
+    df = adult_census(500)
+    # string label -> {0,1}
+    df = df.with_column("label", lambda p: (
+        np.array([v == ">50K" for v in p["income"]])).astype(np.float64))
+    train, test = df.random_split([0.75, 0.25], seed=7)
+
+    featurized = VowpalWabbitFeaturizer(
+        inputCols=["age", "hours_per_week", "education", "occupation"],
+        outputCol="features")
+    clf = VowpalWabbitClassifier(labelCol="label", featuresCol="features",
+                                 numPasses=5, learningRate=0.5)
+    model = clf.fit(featurized.transform(train))
+    scored = model.transform(featurized.transform(test))
+
+    acc = float(np.mean(scored.column("prediction") ==
+                        scored.column("label")))
+    stats = model.get_performance_statistics()
+    print(f"accuracy={acc:.3f} stats_rows={stats.count()}")
+    assert acc > 0.65, acc
+    assert stats.count() >= 1
+    print(f"EXAMPLE OK accuracy={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""HttpOnSpark - Working with Arbitrary Web APIs.
+
+Column of requests -> SimpleHTTPTransformer -> column of parsed responses,
+with retries and bounded concurrency, against a local web API.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.io import (JSONInputParser, JSONOutputParser,
+                             SimpleHTTPTransformer)
+
+
+def start_api():
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n))
+            body = json.dumps({"doubled": payload["x"] * 2}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}/"
+
+
+def main():
+    httpd, url = start_api()
+    try:
+        df = DataFrame.from_dict({"x": np.arange(20.0)}, num_partitions=4)
+        t = SimpleHTTPTransformer(outputCol="out", concurrency=4)
+        t.set("inputParser", JSONInputParser(url))
+        t.set("outputParser", JSONOutputParser())
+        out = t.transform(df)
+        doubled = [r["doubled"] for r in out.column("out")]
+        assert doubled == [2.0 * i for i in range(20)]
+        print(f"EXAMPLE OK responses={len(doubled)}")
+    finally:
+        httpd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""HyperParameterTuning - Fighting Breast Cancer.
+
+Grid search with k-fold CV over multiple estimators via
+TuneHyperparameters; pick and apply the best model.
+"""
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.automl import (DiscreteHyperParam, GridSpace,
+                                 HyperparamBuilder, TuneHyperparameters)
+from mmlspark_tpu.gbdt import LightGBMClassifier
+
+
+def breast_cancer(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6))
+    logit = X @ np.array([1.5, -2.0, 0.8, 0.0, 1.0, -0.5]) + rng.normal(0, 0.7, n)
+    y = (logit > 0).astype(np.float64)
+    return DataFrame.from_dict({"features": [X[i] for i in range(n)],
+                                "label": y}, num_partitions=3)
+
+
+def main():
+    df = breast_cancer()
+    est = LightGBMClassifier(numIterations=15, minDataInLeaf=5)
+    builder = (HyperparamBuilder()
+               .add_hyperparam(est, "numLeaves", DiscreteHyperParam([7, 31]))
+               .add_hyperparam(est, "learningRate",
+                               DiscreteHyperParam([0.1, 0.3])))
+    tuner = TuneHyperparameters(models=[est],
+                                paramSpace=GridSpace(builder.build()),
+                                evaluationMetric="accuracy", numFolds=3,
+                                labelCol="label")
+    best = tuner.fit(df)
+    print(f"best params={best.get('bestParams')} "
+          f"metric={best.get('bestMetric'):.3f} "
+          f"grid size={len(best.get('allMetrics'))}")
+    assert best.get("bestMetric") > 0.8
+    assert len(best.get("allMetrics")) == 4
+    out = best.transform(df)
+    assert "prediction" in out.columns
+    print(f"EXAMPLE OK best={best.get('bestMetric'):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""LightGBM - Quantile Regression for Drug Discovery.
+
+Quantile-objective GBDT over dense feature vectors: predict the 20th and
+80th percentile of activity; the empirical coverage of the band should
+bracket the requested quantiles.
+"""
+
+import numpy as np
+
+from _data import drug_activity
+from mmlspark_tpu.gbdt import LightGBMRegressor
+
+
+def main():
+    df, X, y = drug_activity(250)
+
+    def fit_quantile(alpha):
+        return LightGBMRegressor(
+            objective="quantile", alpha=alpha, labelCol="activity",
+            featuresCol="features", numIterations=40, numLeaves=15,
+            minDataInLeaf=10, learningRate=0.1).fit(df)
+
+    lo = fit_quantile(0.2).transform(df).column("prediction")
+    hi = fit_quantile(0.8).transform(df).column("prediction")
+    below_lo = float(np.mean(y < lo))
+    below_hi = float(np.mean(y < hi))
+    print(f"P(y<q20)={below_lo:.2f} P(y<q80)={below_hi:.2f}")
+    assert 0.05 < below_lo < 0.4, below_lo
+    assert 0.6 < below_hi < 0.95, below_hi
+    assert float(np.mean(hi - lo)) > 0
+    print(f"EXAMPLE OK band=({below_lo:.2f},{below_hi:.2f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""OpenCV - Pipeline Image Transformations.
+
+Composable image-op pipeline: resize, crop, flip, grayscale, blur,
+threshold, then unroll to a flat vector for downstream ML.
+"""
+
+import numpy as np
+
+from _data import tiny_images
+from mmlspark_tpu.image import ImageTransformer, UnrollImage
+
+
+def main():
+    df = tiny_images(n=6, h=32, w=24)
+    t = (ImageTransformer(inputCol="image", outputCol="out")
+         .resize(16, 16)
+         .crop(2, 2, 12, 12)
+         .flip(1)
+         .color_format("gray")
+         .blur(3, 3)
+         .threshold(90, 255))
+    out = t.transform(df)
+    first = out.column("out")[0]
+    print(f"transformed: {first['height']}x{first['width']}"
+          f" channels={first['nChannels']}")
+    assert first["height"] == 12 and first["width"] == 12
+    assert first["nChannels"] == 1
+
+    unrolled = UnrollImage(inputCol="out", outputCol="vec").transform(out)
+    vec = unrolled.column("vec")[0]
+    assert vec.shape == (12 * 12,)
+    # threshold makes it binary
+    assert set(np.unique(vec)).issubset({0.0, 255.0})
+    print(f"EXAMPLE OK vec_dim={vec.shape[0]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""SparkServing - Deploying a Classifier.
+
+Train a model, deploy it behind the continuous-serving ingress, query it
+over HTTP, then scale out: two workers behind a RoutingFront.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.gbdt import LightGBMClassifier
+from mmlspark_tpu.serving import RoutingFront, register_worker, serve_pipeline
+
+
+def main():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 4))
+    y = (X @ np.array([1.0, -1.0, 0.5, 0.0]) > 0).astype(np.float64)
+    df = DataFrame.from_dict({"features": [X[i] for i in range(300)],
+                              "label": y})
+    model = LightGBMClassifier(numIterations=20, numLeaves=15,
+                               minDataInLeaf=5).fit(df)
+
+    def query(url, vec):
+        req = urllib.request.Request(
+            url, data=json.dumps({"data": vec}).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=20) as resp:
+            return float(resp.read())
+
+    # single worker
+    with serve_pipeline(model, input_col="features",
+                        reply_col="prediction", port=0) as server:
+        preds = [query(server.address, X[i].tolist()) for i in range(10)]
+    expected = model.transform(df.limit(10)).column("prediction")
+    assert np.allclose(preds, expected[:10]), (preds, expected[:10])
+    print(f"single-worker: 10 predictions match batch scoring")
+
+    # scaled out: two workers + routing front
+    with serve_pipeline(model, input_col="features",
+                        reply_col="prediction", port=0) as w1, \
+            serve_pipeline(model, input_col="features",
+                           reply_col="prediction", port=0) as w2, \
+            RoutingFront(port=0) as front:
+        register_worker(front.address, w1.address)
+        register_worker(front.address, w2.address)
+        preds = [query(front.address, X[i].tolist()) for i in range(10)]
+        served = w1.requests_served + w2.requests_served
+    assert np.allclose(preds, expected[:10])
+    assert served >= 10
+    print(f"routed: both workers served (total={served})")
+    print("EXAMPLE OK served=%d" % served)
+
+
+if __name__ == "__main__":
+    main()

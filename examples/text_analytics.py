@@ -1,0 +1,52 @@
+"""TextAnalytics - Amazon Book Reviews.
+
+Text classification: TextFeaturizer (tokenize, n-grams, hashing TF-IDF)
+into TrainClassifier.
+"""
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.featurize import TextFeaturizer
+from mmlspark_tpu.gbdt import LightGBMClassifier
+from mmlspark_tpu.train import TrainClassifier
+
+GOOD = ["great", "excellent", "wonderful", "loved", "amazing", "best"]
+BAD = ["terrible", "awful", "boring", "hated", "worst", "dull"]
+FILLER = ["the", "book", "story", "plot", "characters", "chapter", "read"]
+
+
+def reviews(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    texts, labels = [], []
+    for _ in range(n):
+        label = int(rng.integers(0, 2))
+        vocab = GOOD if label else BAD
+        words = list(rng.choice(FILLER, 6)) + list(rng.choice(vocab, 3))
+        rng.shuffle(words)
+        texts.append(" ".join(words))
+        labels.append(float(label))
+    return DataFrame.from_dict({"text": np.array(texts, dtype=object),
+                                "rating": np.array(labels)},
+                               num_partitions=3)
+
+
+def main():
+    df = reviews()
+    train, test = df.random_split([0.75, 0.25], seed=5)
+
+    feats = TextFeaturizer(inputCol="text", outputCol="features",
+                           numFeatures=2048).fit(train)
+    model = TrainClassifier(labelCol="rating").set_model(
+        LightGBMClassifier(numIterations=25, numLeaves=15,
+                           minDataInLeaf=5)).fit(feats.transform(train))
+    scored = model.transform(feats.transform(test))
+    acc = float(np.mean(scored.column("scored_labels_original") ==
+                        scored.column("rating")))
+    print(f"test accuracy={acc:.3f} on {test.count()} reviews")
+    assert acc > 0.8, acc
+    print(f"EXAMPLE OK accuracy={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""E2E tier: run every example journey as a subprocess and assert success
+(the reference's nbtest layer, DatabricksUtilities.scala — here the journeys
+are plain scripts so the tier needs no cluster)."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES_DIR = Path(__file__).resolve().parent.parent / "examples"
+SCRIPTS = sorted(p.name for p in EXAMPLES_DIR.glob("*.py")
+                 if not p.name.startswith("_"))
+
+
+def test_every_example_is_covered():
+    """Reflection guard, FuzzingTest-style: a new example script is
+    automatically picked up (parametrization is generated from the dir)."""
+    assert len(SCRIPTS) >= 10
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(EXAMPLES_DIR.parent), env.get("PYTHONPATH", "")])
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES_DIR / script)],
+        capture_output=True, text=True, timeout=600,
+        cwd=str(EXAMPLES_DIR), env=env)
+    assert proc.returncode == 0, \
+        f"{script} failed:\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "EXAMPLE OK" in proc.stdout, proc.stdout
