@@ -1,0 +1,14 @@
+"""`python -m mmlspark_tpu` — the reflected CLI binding surface
+(codegen/cli.py; reference WrapperGenerator's second-language wrappers)."""
+
+import sys
+
+from .codegen.cli import main
+
+# guard: reflection (pkgutil.walk_packages in the fuzzing tier) imports this
+# module too, and must not trigger an argparse exit
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `... list | head`
+        sys.exit(0)
