@@ -1,0 +1,60 @@
+"""DeepLearning - BiLSTM Medical Entity Extraction.
+
+Sequence tagging with the native BiLSTM family: synthetic "clinical notes"
+where drug-like tokens must be tagged, trained with a jitted optax loop on
+the module tree, evaluated per token.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from mmlspark_tpu.models import bilstm_tagger
+
+VOCAB = 40
+DRUG_TOKENS = set(range(30, 40))  # ids 30..39 are "drug mentions"
+SEQ = 16
+
+
+def make_batch(rng, n):
+    toks = rng.integers(0, VOCAB, size=(n, SEQ))
+    tags = np.isin(toks, list(DRUG_TOKENS)).astype(np.int32)
+    return jnp.asarray(toks), jnp.asarray(tags)
+
+
+def main():
+    model = bilstm_tagger(seq_len=SEQ, vocab_size=VOCAB, embed_dim=16,
+                          hidden=24, num_tags=2)
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(model.params)
+    params = model.params
+
+    @jax.jit
+    def step(params, opt_state, toks, tags):
+        def loss_fn(p):
+            logits = model.module.apply(p, toks)  # [B, T, 2]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tags).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(0)
+    for i in range(80):
+        toks, tags = make_batch(rng, 64)
+        params, opt_state, loss = step(params, opt_state, toks, tags)
+        if i % 20 == 0:
+            print(f"step {i} loss={float(loss):.4f}")
+
+    toks, tags = make_batch(rng, 200)
+    pred = np.argmax(np.asarray(model.module.apply(params, toks)), axis=-1)
+    acc = float(np.mean(pred == np.asarray(tags)))
+    print(f"token tagging accuracy={acc:.3f}")
+    assert acc > 0.95, acc
+    print(f"EXAMPLE OK accuracy={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -16,14 +16,29 @@ from .module import (
     matmul_precision,
     relu,
 )
+from .attention import (
+    BiLSTM,
+    Embed,
+    LSTM,
+    LayerNorm,
+    MultiHeadAttention,
+    bilstm_tagger,
+    dense_attention,
+    ring_attention,
+    transformer_block,
+    transformer_encoder,
+)
 from .resnet import build_resnet, param_shardings, resnet, resnet18, resnet50
 from .dnn_model import DNNModel
 from .graph_module import GraphModule, GraphNode
 from .torch_import import from_torch_resnet
 
 __all__ = [
-    "BatchNorm", "Conv2D", "DNNModel", "Dense", "Fn", "FunctionModel",
-    "GlobalAvgPool", "GraphModule", "GraphNode", "MaxPool", "Module", "Residual",
-    "Sequential", "build_resnet", "flatten", "from_torch_resnet", "param_shardings",
-    "relu", "resnet", "resnet18", "resnet50",
+    "BatchNorm", "BiLSTM", "Conv2D", "DNNModel", "Dense", "Embed", "Fn",
+    "FunctionModel", "GlobalAvgPool", "GraphModule", "GraphNode", "LSTM",
+    "LayerNorm", "MaxPool", "Module", "MultiHeadAttention", "Residual",
+    "Sequential", "bilstm_tagger", "build_resnet", "dense_attention",
+    "flatten", "from_torch_resnet", "param_shardings", "relu", "resnet",
+    "resnet18", "resnet50", "ring_attention", "transformer_block",
+    "transformer_encoder",
 ]

@@ -323,14 +323,14 @@ class Dense(Module):
 
     def init(self, rng, in_shape):
         import jax
-        (d,) = in_shape
+        d = in_shape[-1]  # acts on the last dim; leading dims (e.g. time) pass through
         wkey, _ = _rng_split(rng, 2)
         w = jax.random.normal(wkey, (d, self.features), dtype=np.float32)
         w = w * np.float32(1.0 / math.sqrt(d))
         params = {"kernel": w}
         if self.use_bias:
             params["bias"] = np.zeros((self.features,), dtype=np.float32)
-        return params, (self.features,)
+        return params, tuple(in_shape[:-1]) + (self.features,)
 
     def apply(self, params, x, train: bool = False):
         import jax.numpy as jnp
@@ -428,13 +428,17 @@ class GlobalAvgPool(Module):
 # ---------------------------------------------------------------------------
 
 class Residual(Module):
-    """y = relu(body(x) + shortcut(x)); shortcut projects when shapes change."""
+    """y = act(body(x) + shortcut(x)); shortcut projects when shapes change.
+    ``activation``: "relu" (ResNet convention) or None (pre-norm transformer
+    blocks, where the residual stream stays linear)."""
 
     is_container = True
 
-    def __init__(self, body: Sequential, shortcut: Optional[Sequential] = None):
+    def __init__(self, body: Sequential, shortcut: Optional[Sequential] = None,
+                 activation: Optional[str] = "relu"):
         self.body = body
         self.shortcut = shortcut
+        self.activation = activation
 
     def init(self, rng, in_shape):
         k1, k2 = _rng_split(rng, 2)
@@ -462,7 +466,10 @@ class Residual(Module):
             s = self.shortcut.apply(params["shortcut"], x, train=train, taps=taps,
                                     taps_out=taps_out, stats_out=stats_out,
                                     _prefix=_prefix + "shortcut/")
-        return _constrain_activation(jnp.maximum(y + s, 0))
+        out = y + s
+        if getattr(self, "activation", "relu") == "relu":
+            out = jnp.maximum(out, 0)
+        return _constrain_activation(out)
 
     def layer_paths(self, prefix: str = "") -> List[str]:
         out = self.body.layer_paths(prefix + "body/")
