@@ -3,4 +3,5 @@ from .params import Param, Params, ComplexParam, ServiceParam
 from .pipeline import (
     Estimator, Evaluator, Model, Pipeline, PipelineModel, PipelineStage, Transformer,
 )
+from .profiling import annotate, device_memory_stats, profile_transform, trace
 from .schema import ColType, ImageSchema, Schema

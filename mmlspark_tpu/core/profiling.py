@@ -1,0 +1,81 @@
+"""Profiling: JAX/XLA profiler integration on top of the StopWatch layer.
+
+The reference's tracing story is wall-clock instrumentation (StopWatch.scala,
+Timer.scala) because Spark owns the deeper profile. On TPU the deeper profile
+is the XLA one — per-op device timelines, HBM traffic, MXU utilization — so
+this module wires ``jax.profiler`` into the framework idioms:
+
+  - ``trace(log_dir)``: context manager capturing a TensorBoard/Perfetto
+    trace of everything inside it (device + host).
+  - ``annotate(name)``: named span inside a trace, so stage boundaries are
+    visible between XLA ops (wraps ``jax.profiler.TraceAnnotation``).
+  - ``profile_transform(stage, df, log_dir)``: one-call stage profile —
+    runs ``stage.transform(df)`` under a trace with a named span per call.
+  - ``device_memory_stats()``: per-device live/peak HBM bytes, the quick
+    "am I about to OOM" check (jax.local_devices()[i].memory_stats()).
+
+Traces open in TensorBoard (`tensorboard --logdir <dir>`) or Perfetto; on
+TPU they include the hardware trace, on CPU the host timeline only.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Iterator, List, Optional
+
+from .utils import StopWatch, log
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_trace: bool = False) -> Iterator[None]:
+    """Capture a JAX profiler trace of the enclosed block into ``log_dir``."""
+    import jax
+
+    with jax.profiler.trace(log_dir,
+                            create_perfetto_trace=create_perfetto_trace):
+        yield
+    log.info("profiler trace written to %s", log_dir)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span (shows up between XLA ops in the trace timeline)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def profile_transform(stage, df, log_dir: str, iterations: int = 1,
+                      create_perfetto_trace: bool = False) -> Dict[str, Any]:
+    """Profile ``stage.transform(df)``: wall clock via StopWatch + a full
+    XLA trace in ``log_dir``. Returns {"elapsed_s", "per_call_s", "log_dir"}.
+    """
+    if iterations < 1:
+        raise ValueError(f"iterations must be >= 1, got {iterations}")
+    watch = StopWatch()
+    name = type(stage).__name__
+    with trace(log_dir, create_perfetto_trace=create_perfetto_trace):
+        for i in range(iterations):
+            with annotate(f"{name}.transform[{i}]"), watch.measure():
+                stage.transform(df)
+    return {"elapsed_s": watch.elapsed_s,
+            "per_call_s": watch.elapsed_s / iterations,
+            "log_dir": log_dir}
+
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Per-device memory stats (bytes_in_use / peak_bytes_in_use / limit when
+    the backend reports them; CPU backends may report nothing)."""
+    import jax
+
+    out: List[Dict[str, Any]] = []
+    for d in jax.local_devices():
+        stats: Optional[Dict[str, Any]] = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # backend without memory stats
+            stats = None
+        out.append({"device": str(d), "platform": d.platform,
+                    "stats": stats or {}})
+    return out

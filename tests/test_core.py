@@ -359,3 +359,37 @@ class TestDistributedBootstrap:
         empty = process_shard(df, process_id=3, num_processes=4)
         assert len(empty) == 0
         assert empty.columns == df.columns
+
+
+class TestProfiling:
+    """JAX profiler integration (SURVEY §5 tracing; the TPU-deep profile the
+    reference leaves to Spark's instrumentation)."""
+
+    def test_profile_transform_writes_trace(self, tmp_path):
+        from mmlspark_tpu.core.profiling import profile_transform
+        from mmlspark_tpu.stages import SelectColumns
+
+        df = DataFrame.from_dict({"a": np.arange(10.0), "b": np.arange(10.0)})
+        stage = SelectColumns(cols=["a"])
+        res = profile_transform(stage, df, str(tmp_path / "trace"),
+                                iterations=3)
+        assert res["elapsed_s"] > 0
+        assert res["per_call_s"] <= res["elapsed_s"]
+        # a trace artifact tree was produced
+        produced = list((tmp_path / "trace").rglob("*"))
+        assert produced, "no trace files written"
+
+    def test_annotate_and_memory_stats(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.core.profiling import (annotate,
+                                                 device_memory_stats, trace)
+
+        with trace(str(tmp_path / "t")):
+            with annotate("matmul-span"):
+                x = jnp.ones((64, 64))
+                float(jnp.sum(x @ x))
+        stats = device_memory_stats()
+        assert len(stats) == 8  # the virtual CPU mesh
+        assert all("platform" in s for s in stats)
