@@ -28,6 +28,7 @@ from .attention import (
     transformer_block,
     transformer_encoder,
 )
+from .moe import MoE, expert_shardings
 from .resnet import build_resnet, param_shardings, resnet, resnet18, resnet50
 from .dnn_model import DNNModel
 from .graph_module import GraphModule, GraphNode
@@ -36,9 +37,9 @@ from .torch_import import from_torch_resnet
 __all__ = [
     "BatchNorm", "BiLSTM", "Conv2D", "DNNModel", "Dense", "Embed", "Fn",
     "FunctionModel", "GlobalAvgPool", "GraphModule", "GraphNode", "LSTM",
-    "LayerNorm", "MaxPool", "Module", "MultiHeadAttention", "Residual",
+    "LayerNorm", "MaxPool", "MoE", "Module", "MultiHeadAttention", "Residual",
     "Sequential", "bilstm_tagger", "build_resnet", "dense_attention",
-    "flatten", "from_torch_resnet", "param_shardings", "relu", "resnet",
-    "resnet18", "resnet50", "ring_attention", "transformer_block",
-    "transformer_encoder",
+    "expert_shardings", "flatten", "from_torch_resnet", "param_shardings",
+    "relu", "resnet", "resnet18", "resnet50", "ring_attention",
+    "transformer_block", "transformer_encoder",
 ]

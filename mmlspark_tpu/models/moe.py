@@ -1,0 +1,109 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``expert`` axis.
+
+No reference counterpart (MMLSpark predates MoE); this is the expert-
+parallel leg of the framework's parallelism story (dp/fsdp/tp/sp/ep/pp).
+Design follows the standard switch-transformer dispatch expressed as dense
+einsums so GSPMD shards it (scaling-book style — annotate, let XLA insert
+the all_to_alls):
+
+  - router: tokens [B, T, D] -> logits [B, T, E], top-1 expert per token;
+  - dispatch: one-hot [B, T, E, C] capacity mask (first C tokens per expert
+    keep their slot, overflow drops — switch semantics), contracted against
+    tokens to form per-expert buffers [E, B, C, D];
+  - expert FFN: per-expert weights W1 [E, D, H], W2 [E, H, D] applied with a
+    batched einsum (leading E dim shards over ``expert`` — with the buffers
+    sharded the same way, XLA inserts the dispatch/return all_to_all);
+  - combine: the same mask scatters expert outputs back to token positions,
+    scaled by the router probability.
+
+``expert_shardings(mesh)`` gives the NamedShardings to place params/buffers;
+the equality test (sharded == single-device) runs on an 8-device mesh.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from .module import Module, _rng_split, matmul_dtype
+
+
+class MoE(Module):
+    """Top-1 (switch) MoE FFN on [T, D] rows (batch dim added at apply)."""
+
+    def __init__(self, num_experts: int, hidden: Optional[int] = None,
+                 capacity_factor: float = 1.5):
+        self.num_experts = num_experts
+        self.hidden = hidden
+        self.capacity_factor = capacity_factor
+
+    def init(self, rng, in_shape):
+        import jax
+
+        t, d = in_shape
+        h = self.hidden or 4 * d
+        kr, k1, k2 = _rng_split(rng, 3)
+        e = self.num_experts
+        return {
+            "router": jax.random.normal(kr, (d, e), dtype=np.float32)
+            * np.float32(1.0 / math.sqrt(d)),
+            "w1": jax.random.normal(k1, (e, d, h), dtype=np.float32)
+            * np.float32(1.0 / math.sqrt(d)),
+            "w2": jax.random.normal(k2, (e, h, d), dtype=np.float32)
+            * np.float32(1.0 / math.sqrt(h)),
+        }, (t, d)
+
+    def _capacity(self, tokens: int) -> int:
+        return max(1, int(math.ceil(
+            tokens * self.capacity_factor / self.num_experts)))
+
+    def apply(self, params, x, train: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        B, T, D = x.shape
+        E = self.num_experts
+        C = self._capacity(T)
+        dt = getattr(jnp, matmul_dtype())
+
+        logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32),
+                            jnp.asarray(params["router"]))
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                  # [B, T]
+        gate = jnp.max(probs, axis=-1)                       # [B, T]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [B, T, E]
+        # position of each token within its expert's buffer; >=C overflows drop
+        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0      # [B, T, E]
+        keep = (pos >= 0) & (pos < C)
+        dispatch = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                                  dtype=jnp.float32) * keep[..., None]
+        # [B, T, E, C] x [B, T, D] -> expert buffers [E, B, C, D]
+        buf = jnp.einsum("btec,btd->ebcd", dispatch, x.astype(jnp.float32))
+        w1 = jnp.asarray(params["w1"]).astype(dt)
+        w2 = jnp.asarray(params["w2"]).astype(dt)
+        hmid = jax.nn.relu(jnp.einsum("ebcd,edh->ebch", buf.astype(dt), w1,
+                                      preferred_element_type=jnp.float32))
+        out_buf = jnp.einsum("ebch,ehd->ebcd", hmid.astype(dt), w2,
+                             preferred_element_type=jnp.float32)
+        # combine back to token positions, gate-scaled
+        combined = jnp.einsum("btec,ebcd->btd", dispatch,
+                              out_buf.astype(jnp.float32))
+        return combined * gate[..., None]
+
+
+def expert_shardings(mesh, params):
+    """Shardings pytree mirroring ``params``: expert-indexed leaves (w1/w2)
+    shard their leading E dim over the 'expert' axis; the router replicates.
+    Pass straight to ``jax.device_put(params, expert_shardings(mesh, params))``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def place(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("w1", "w2"):
+            return NamedSharding(mesh, P("expert"))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(place, params)

@@ -3,7 +3,8 @@ from .batching import (
     next_bucket, pad_batch, sparse_width, stack_rows,
 )
 from .mesh import (
-    DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, SEQ_AXIS, TENSOR_AXIS,
+    DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS,
     MeshContext, MeshSpec, data_sharding, initialize_distributed, make_mesh,
     num_data_shards, process_shard, replicated_sharding,
 )
+from .pipeline_parallel import pipeline_apply, stack_stage_params

@@ -31,6 +31,7 @@ FSDP_AXIS = "fsdp"
 TENSOR_AXIS = "tensor"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
 
 
 def devices(backend: Optional[str] = None) -> List:
@@ -119,6 +120,7 @@ class MeshSpec:
     tensor: int = 1
     seq: int = 1
     expert: int = 1
+    pipe: int = 1
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
         sizes = dataclasses.asdict(self)

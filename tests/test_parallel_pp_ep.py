@@ -1,0 +1,140 @@
+"""Pipeline parallelism (pipe axis) and expert parallelism (expert axis):
+parallel execution == single-device execution on a real 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mmlspark_tpu.models.moe import MoE, expert_shardings
+from mmlspark_tpu.models.module import matmul_precision
+from mmlspark_tpu.parallel import MeshSpec, make_mesh
+from mmlspark_tpu.parallel.pipeline_parallel import (pipeline_apply,
+                                                     stack_stage_params)
+
+
+@pytest.fixture(scope="module")
+def pipe_mesh():
+    return make_mesh(MeshSpec(data=1, pipe=8))
+
+
+@pytest.fixture(scope="module")
+def expert_mesh():
+    return make_mesh(MeshSpec(data=1, expert=8))
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _stages(S, D, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"w": jnp.asarray(rng.normal(size=(D, D)).astype(np.float32) /
+                              np.sqrt(D)),
+             "b": jnp.asarray(rng.normal(size=(D,)).astype(np.float32) * .1)}
+            for _ in range(S)]
+
+
+class TestPipelineParallel:
+    S, M, B, D = 8, 16, 4, 8
+
+    def _sequential(self, stages, xs):
+        out = []
+        for m in range(xs.shape[0]):
+            h = xs[m]
+            for p in stages:
+                h = _stage_fn(p, h)
+            out.append(h)
+        return np.stack(out)
+
+    def test_pipeline_matches_sequential(self, pipe_mesh):
+        stages = _stages(self.S, self.D)
+        stacked = stack_stage_params(stages)
+        rng = np.random.default_rng(1)
+        xs = jnp.asarray(rng.normal(
+            size=(self.M, self.B, self.D)).astype(np.float32))
+
+        f = jax.jit(jax.shard_map(
+            lambda p, x: pipeline_apply(_stage_fn, p, x, "pipe", self.S),
+            mesh=pipe_mesh, in_specs=(P("pipe"), P()), out_specs=P()))
+        got = np.asarray(f(stacked, xs))
+        want = self._sequential(stages, np.asarray(xs))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_gradients_flow_through_pipeline(self, pipe_mesh):
+        stages = _stages(self.S, self.D, seed=2)
+        stacked = stack_stage_params(stages)
+        rng = np.random.default_rng(3)
+        xs = jnp.asarray(rng.normal(
+            size=(self.M, self.B, self.D)).astype(np.float32))
+
+        def loss(p, x):
+            y = pipeline_apply(_stage_fn, p, x, "pipe", self.S)
+            return jax.lax.psum(jnp.sum(y * y), "pipe") / 8.0
+
+        f = jax.jit(jax.shard_map(
+            jax.grad(loss), mesh=pipe_mesh,
+            in_specs=(P("pipe"), P()), out_specs=P("pipe")))
+        grads = f(stacked, xs)
+        for leaf in jax.tree.leaves(grads):
+            arr = np.asarray(leaf)
+            assert np.isfinite(arr).all()
+        # the per-stage weight grads must be nonzero for every stage
+        gw = np.asarray(grads["w"])
+        assert gw.shape[0] == self.S
+        assert all(np.abs(gw[s]).max() > 0 for s in range(self.S))
+
+    def test_fewer_microbatches_than_stages(self, pipe_mesh):
+        stages = _stages(self.S, self.D, seed=4)
+        stacked = stack_stage_params(stages)
+        xs = jnp.asarray(np.random.default_rng(5).normal(
+            size=(3, 2, self.D)).astype(np.float32))  # M=3 < S=8
+        f = jax.jit(jax.shard_map(
+            lambda p, x: pipeline_apply(_stage_fn, p, x, "pipe", self.S),
+            mesh=pipe_mesh, in_specs=(P("pipe"), P()), out_specs=P()))
+        got = np.asarray(f(stacked, xs))
+        want = self._sequential(stages, np.asarray(xs))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestExpertParallel:
+    def test_moe_forward_shapes_and_routing(self):
+        with matmul_precision("float32"):
+            moe = MoE(num_experts=4, capacity_factor=2.0)
+            params, out_shape = moe.init(jax.random.key(0), (16, 8))
+            assert out_shape == (16, 8)
+            x = jnp.asarray(np.random.default_rng(0).normal(
+                size=(2, 16, 8)).astype(np.float32))
+            y = moe.apply(params, x)
+            assert y.shape == (2, 16, 8)
+            assert np.isfinite(np.asarray(y)).all()
+            assert np.abs(np.asarray(y)).max() > 0
+
+    def test_expert_sharded_matches_single_device(self, expert_mesh):
+        """Params sharded over 8 experts on 8 devices == replicated result
+        (GSPMD inserts the dispatch/return collectives)."""
+        with matmul_precision("float32"):
+            moe = MoE(num_experts=8, capacity_factor=2.0)
+            params, _ = moe.init(jax.random.key(1), (32, 16))
+            x = jnp.asarray(np.random.default_rng(1).normal(
+                size=(2, 32, 16)).astype(np.float32))
+            want = np.asarray(jax.jit(moe.apply)(params, x))
+
+            shardings = expert_shardings(expert_mesh, params)
+            placed = jax.device_put(params, shardings)
+            x_repl = jax.device_put(
+                x, NamedSharding(expert_mesh, P()))
+            got = np.asarray(jax.jit(moe.apply)(placed, x_repl))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_capacity_drops_overflow(self):
+        """With capacity_factor ~0, (nearly) all tokens drop -> output ~0."""
+        moe = MoE(num_experts=2, capacity_factor=1e-9)
+        params, _ = moe.init(jax.random.key(2), (8, 4))
+        x = jnp.asarray(np.random.default_rng(2).normal(
+            size=(1, 8, 4)).astype(np.float32))
+        y = np.asarray(moe.apply(params, x))
+        # capacity 1 per expert (min), so at most 2 token rows are nonzero
+        nonzero_rows = int((np.abs(y[0]).sum(-1) > 1e-9).sum())
+        assert nonzero_rows <= 2
