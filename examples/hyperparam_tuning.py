@@ -30,7 +30,7 @@ def main():
                                DiscreteHyperParam([0.1, 0.3])))
     tuner = TuneHyperparameters(models=[est],
                                 paramSpace=GridSpace(builder.build()),
-                                evaluationMetric="accuracy", numFolds=3,
+                                evaluationMetric="accuracy", numFolds=2,
                                 labelCol="label")
     best = tuner.fit(df)
     print(f"best params={best.get('bestParams')} "

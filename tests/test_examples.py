@@ -29,7 +29,7 @@ def test_example_runs(script):
         [str(EXAMPLES_DIR.parent), env.get("PYTHONPATH", "")])
     proc = subprocess.run(
         [sys.executable, str(EXAMPLES_DIR / script)],
-        capture_output=True, text=True, timeout=600,
+        capture_output=True, text=True, timeout=900,
         cwd=str(EXAMPLES_DIR), env=env)
     assert proc.returncode == 0, \
         f"{script} failed:\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
