@@ -1,0 +1,115 @@
+"""SSH reverse port forwarding for serving workers behind a gateway.
+
+Reference: io/http/PortForwarding.scala:1-86 — workers open a JSch SSH
+session to a gateway host and reverse-forward a remote port to their local
+ServingServer, retrying across a remote port range until a free one binds.
+Here the tunnel rides the system ``ssh`` client (OpenSSH is the fleet-
+standard transport; no JVM, no bundled SSH implementation): ``ssh -N -R
+remote:...:local`` runs as a supervised subprocess, with the same retry-
+across-ports behavior and identity-file support.
+
+Typical use: a RoutingFront on a public gateway, ServingServers on TPU
+hosts inside a private network — each worker forwards
+``gateway:port -> localhost:server.port`` then registers
+``http://gateway:port/`` with the front.
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+import time
+from typing import List, Optional, Tuple
+
+
+def build_ssh_command(username: str, ssh_host: str, ssh_port: int,
+                      bind_address: str, remote_port: int, local_host: str,
+                      local_port: int,
+                      key_file: Optional[str] = None,
+                      extra_opts: Optional[List[str]] = None) -> List[str]:
+    """The argv for one reverse-forward attempt (unit-testable seam;
+    forwardPortToRemote's JSch setRemoteForwarding equivalent)."""
+    cmd = ["ssh", "-N",
+           "-o", "StrictHostKeyChecking=no",
+           "-o", "ExitOnForwardFailure=yes",
+           "-o", "ServerAliveInterval=30",
+           "-p", str(ssh_port)]
+    if key_file:
+        cmd += ["-i", key_file]
+    cmd += ["-R", f"{bind_address}:{remote_port}:{local_host}:{local_port}",
+            f"{username}@{ssh_host}"]
+    if extra_opts:
+        cmd += list(extra_opts)
+    return cmd
+
+
+class PortForwarder:
+    """Supervised reverse SSH tunnel (forwardPortToRemote parity).
+
+    ``start()`` tries remote ports ``remote_port_start..+max_retries`` until
+    one binds (ExitOnForwardFailure makes a taken port exit immediately, the
+    JSch retry-loop behavior); the winning port is ``.remote_port``.
+    """
+
+    def __init__(self, username: str, ssh_host: str, ssh_port: int = 22,
+                 bind_address: str = "0.0.0.0", remote_port_start: int = 8898,
+                 local_host: str = "127.0.0.1", local_port: int = 8898,
+                 key_file: Optional[str] = None, max_retries: int = 10,
+                 settle_s: float = 1.0):
+        self.username = username
+        self.ssh_host = ssh_host
+        self.ssh_port = ssh_port
+        self.bind_address = bind_address
+        self.remote_port_start = remote_port_start
+        self.local_host = local_host
+        self.local_port = local_port
+        self.key_file = key_file
+        self.max_retries = max_retries
+        self.settle_s = settle_s
+        self.remote_port: Optional[int] = None
+        self._proc: Optional[subprocess.Popen] = None
+
+    def _spawn(self, remote_port: int) -> subprocess.Popen:
+        cmd = build_ssh_command(self.username, self.ssh_host, self.ssh_port,
+                                self.bind_address, remote_port,
+                                self.local_host, self.local_port,
+                                key_file=self.key_file)
+        return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    def start(self) -> Tuple[subprocess.Popen, int]:
+        last_err: Optional[str] = None
+        for attempt in range(self.max_retries + 1):
+            port = self.remote_port_start + attempt
+            proc = self._spawn(port)
+            time.sleep(self.settle_s)
+            if proc.poll() is None:  # still running => forward bound
+                self._proc, self.remote_port = proc, port
+                return proc, port
+            last_err = f"ssh exited rc={proc.returncode} for port {port}"
+        raise RuntimeError(
+            f"could not establish reverse forward after "
+            f"{self.max_retries + 1} attempts: {last_err} "
+            f"(cmd: {shlex.join(build_ssh_command(self.username, self.ssh_host, self.ssh_port, self.bind_address, self.remote_port_start, self.local_host, self.local_port))})")
+
+    @property
+    def remote_address(self) -> str:
+        if self.remote_port is None:
+            raise RuntimeError("forwarder not started")
+        return f"http://{self.ssh_host}:{self.remote_port}/"
+
+    def stop(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        self._proc = None
+
+    def __enter__(self) -> "PortForwarder":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

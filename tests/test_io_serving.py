@@ -505,3 +505,62 @@ class TestDistributedServing:
                 assert False, "expected 404"
             except urllib.error.HTTPError as e:
                 assert e.code == 404  # the WORKER's 404, not a model reply
+
+
+class TestPortForwarding:
+    """SSH reverse-forward parity (PortForwarding.scala) — the ssh transport
+    itself is the system client; these tests pin the argv contract and the
+    retry-across-ports supervision using a stub executable."""
+
+    def test_ssh_command_contract(self):
+        from mmlspark_tpu.serving import build_ssh_command
+
+        cmd = build_ssh_command("worker", "gateway.example", 2222,
+                                "0.0.0.0", 8900, "127.0.0.1", 8898,
+                                key_file="/keys/id_ed25519")
+        assert cmd[0] == "ssh" and "-N" in cmd
+        assert "ExitOnForwardFailure=yes" in cmd  # taken port must fail fast
+        assert "-R" in cmd
+        assert cmd[cmd.index("-R") + 1] == "0.0.0.0:8900:127.0.0.1:8898"
+        assert cmd[cmd.index("-p") + 1] == "2222"
+        assert cmd[cmd.index("-i") + 1] == "/keys/id_ed25519"
+        assert cmd[-1] == "worker@gateway.example"
+
+    def test_retries_across_ports_until_one_binds(self, tmp_path, monkeypatch):
+        """First two 'ports' fail (ssh exits), third stays up -> picked."""
+        import subprocess
+
+        from mmlspark_tpu.serving import PortForwarder
+
+        calls = []
+
+        def fake_spawn(self, remote_port):
+            calls.append(remote_port)
+            if len(calls) < 3:
+                return subprocess.Popen(["false"])  # exits immediately
+            return subprocess.Popen(["sleep", "30"])  # tunnel "holds"
+
+        monkeypatch.setattr(PortForwarder, "_spawn", fake_spawn)
+        fwd = PortForwarder("u", "gw", remote_port_start=9000,
+                            local_port=1234, settle_s=0.2, max_retries=5)
+        try:
+            proc, port = fwd.start()
+            assert port == 9002
+            assert calls == [9000, 9001, 9002]
+            assert fwd.remote_address == "http://gw:9002/"
+            assert proc.poll() is None
+        finally:
+            fwd.stop()
+        assert fwd._proc is None
+
+    def test_all_ports_taken_raises(self, monkeypatch):
+        import subprocess
+
+        from mmlspark_tpu.serving import PortForwarder
+
+        monkeypatch.setattr(
+            PortForwarder, "_spawn",
+            lambda self, port: subprocess.Popen(["false"]))
+        fwd = PortForwarder("u", "gw", settle_s=0.05, max_retries=2)
+        with pytest.raises(RuntimeError, match="could not establish"):
+            fwd.start()
