@@ -11,8 +11,9 @@ reply routing keyed by request id.
 from .server import ServingServer, reply_to, serve_pipeline
 from .routing import RoutingFront, register_worker
 from .port_forwarding import PortForwarder, build_ssh_command
+from .journal import RequestJournal
 from .stages import parse_request, make_reply
 
-__all__ = ["PortForwarder", "RoutingFront", "ServingServer",
+__all__ = ["PortForwarder", "RequestJournal", "RoutingFront", "ServingServer",
            "build_ssh_command", "make_reply", "parse_request",
            "register_worker", "reply_to", "serve_pipeline"]

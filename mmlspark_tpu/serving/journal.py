@@ -1,0 +1,123 @@
+"""Write-ahead request journal: epoch/commit semantics for the serving loop.
+
+Reference: HTTPSourceV2's epoch machinery (HTTPSourceV2.scala:575-640 —
+per-epoch request queues, history kept until the epoch commits, recovered
+partitions replayed to retried tasks). The TPU-native serving loop has no
+Spark task retry, so the equivalent durability contract is a write-ahead
+journal: every drained batch is an *epoch*; its requests are journaled
+BEFORE the transform runs, and the epoch commits once every request in it
+has been answered (or abandoned by its client). After a crash, ``recover``
+returns the uncommitted requests so a supervisor can re-submit them to a
+fresh server — at-least-once processing for side-effecting pipelines.
+
+Format: JSONL, one op per line:
+    {"op": "entry", "epoch": E, "id": rid, "body_b64": ..., "headers": {...}}
+    {"op": "commit", "epoch": E}
+``compact`` rewrites the file dropping committed epochs.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class RequestJournal:
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+
+    # -- write side (server) ----------------------------------------------
+    @staticmethod
+    def _entry(epoch: int, rid: int, body: bytes,
+               headers: Optional[Dict[str, str]]) -> str:
+        return json.dumps({
+            "op": "entry", "epoch": int(epoch), "id": int(rid),
+            "body_b64": base64.b64encode(bytes(body)).decode("ascii"),
+            "headers": dict(headers or {})})
+
+    def append(self, epoch: int, rid: int, body: bytes,
+               headers: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._fh.write(self._entry(epoch, rid, body, headers) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def append_many(self, epoch: int, entries) -> None:
+        """Journal a whole epoch with ONE flush+fsync (the hot batch path:
+        durability is per-epoch, so per-request fsyncs buy nothing).
+        ``entries``: iterable of (rid, body, headers)."""
+        lines = [self._entry(epoch, rid, body, headers)
+                 for rid, body, headers in entries]
+        with self._lock:
+            self._fh.write("\n".join(lines) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def commit(self, epoch: int) -> None:
+        with self._lock:
+            self._fh.write(json.dumps({"op": "commit",
+                                       "epoch": int(epoch)}) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
+
+    # -- read side (recovery) ---------------------------------------------
+    @staticmethod
+    def _pending_by_epoch(path: str
+                          ) -> Dict[int, List[Tuple[int, bytes, Dict[str, str]]]]:
+        if not os.path.exists(path):
+            return {}
+        entries: Dict[int, List[Tuple[int, bytes, Dict[str, str]]]] = {}
+        committed = set()
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # torn final line from a crash mid-append — exactly the
+                    # case recovery exists for; skip it (that request never
+                    # reached the transform)
+                    continue
+                if rec["op"] == "commit":
+                    committed.add(rec["epoch"])
+                else:
+                    entries.setdefault(rec["epoch"], []).append(
+                        (rec["id"], base64.b64decode(rec["body_b64"]),
+                         rec.get("headers", {})))
+        return {e: v for e, v in entries.items() if e not in committed}
+
+    @staticmethod
+    def recover(path: str) -> List[Tuple[int, bytes, Dict[str, str]]]:
+        """(rid, body, headers) of every request in an UNcommitted epoch —
+        what a supervisor re-submits after a crash."""
+        pending = RequestJournal._pending_by_epoch(path)
+        out: List[Tuple[int, bytes, Dict[str, str]]] = []
+        for epoch in sorted(pending):
+            out.extend(pending[epoch])
+        return out
+
+    def compact(self) -> None:
+        """Rewrite the journal keeping only uncommitted epochs, preserving
+        their epoch numbers (a late commit of a live epoch must still match)."""
+        with self._lock:
+            self._fh.close()
+            pending = self._pending_by_epoch(self.path)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                for epoch in sorted(pending):
+                    for rid, body, headers in pending[epoch]:
+                        fh.write(self._entry(epoch, rid, body, headers) + "\n")
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "a", encoding="utf-8")

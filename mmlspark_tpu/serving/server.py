@@ -89,6 +89,7 @@ class ServingServer:
                  api_path: str = "/", reply_col: str = "reply",
                  max_batch_size: int = 64, max_wait_ms: float = 5.0,
                  slot_timeout_s: float = 60.0, token: Optional[str] = None,
+                 journal_path: Optional[str] = None,
                  name: str = "serving"):
         self.transform = transform
         self.host = host
@@ -100,6 +101,16 @@ class ServingServer:
         self.max_wait_ms = max_wait_ms
         self.name = name
         self.token = token
+        # write-ahead journal => epoch/commit semantics (journal.py): each
+        # drained batch is an epoch, committed once every request is answered
+        self._journal = None
+        self._epoch = 0
+        self._epoch_rids: Dict[int, set] = {}
+        self._journal_lock = threading.Lock()  # serializes epoch bookkeeping
+        if journal_path:
+            from .journal import RequestJournal
+
+            self._journal = RequestJournal(journal_path)
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         self._slots: Dict[int, _ReplySlot] = {}
         # random start: ids are routing handles that ride to peer workers, so
@@ -139,6 +150,7 @@ class ServingServer:
                             int(msg["id"]), int(msg.get("status", 200)),
                             base64.b64decode(msg["body_b64"]),
                             content_type=msg.get("content_type"))
+                        server._maybe_commit_epochs()
                         self.send_response(200)
                         self.send_header("Content-Length", "0")
                         self.end_headers()
@@ -204,6 +216,12 @@ class ServingServer:
                 headers[i] = hdrs
             origin = np.empty(len(batch), dtype=object)
             origin[:] = self.address
+            if self._journal is not None:
+                with self._journal_lock:
+                    self._epoch += 1
+                    epoch = self._epoch
+                    self._epoch_rids[epoch] = {int(r) for r in ids}
+                self._journal.append_many(epoch, batch)
             df = DataFrame([{"id": ids, "value": bodies, "headers": headers,
                              "origin": origin}])
             try:
@@ -235,6 +253,22 @@ class ServingServer:
                 for rid in ids:
                     self._fulfill(int(rid), 500, json.dumps(
                         {"error": str(e)}).encode("utf-8"))
+            self._maybe_commit_epochs()
+
+    def _maybe_commit_epochs(self) -> None:
+        """Commit every epoch whose requests are all answered or abandoned
+        (their slots are gone) — HTTPSourceV2 commit() parity. Called from
+        the batcher thread and peer-reply handler threads; _journal_lock
+        serializes the check-commit-delete so an epoch commits exactly once."""
+        if self._journal is None or self._stop.is_set():
+            return
+        with self._id_lock:
+            live = set(self._slots)
+        with self._journal_lock:
+            for epoch in sorted(self._epoch_rids):
+                if not (self._epoch_rids[epoch] & live):
+                    self._journal.commit(epoch)
+                    del self._epoch_rids[epoch]
 
     def _fulfill(self, rid: int, status: int, reply: Any,
                  content_type: Optional[str] = None):
@@ -285,6 +319,13 @@ class ServingServer:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # join the batcher before closing the journal: an in-flight batch
+        # must finish its append/commit on an open file
+        for t in self._threads:
+            if t.name.endswith("-batcher"):
+                t.join(timeout=5)
+        if self._journal is not None:
+            self._journal.close()
 
     @property
     def address(self) -> str:
