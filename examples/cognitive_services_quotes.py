@@ -1,0 +1,94 @@
+"""CognitiveServices - Celebrity Quote Analysis (against a local service).
+
+The cognitive journey: ServiceParam stages (value-or-column params,
+subscription key header, typed request/response) calling a REAL HTTP
+endpoint — here a local stand-in for the Text Analytics API, so the journey
+runs hermetically. Point ``url`` at an actual Azure endpoint and the same
+pipeline runs unchanged.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.cognitive import KeyPhraseExtractor, TextSentiment
+
+QUOTES = [
+    "The best way to predict the future is to invent it",
+    "I have not failed I have just found ten thousand ways that will not work",
+    "Innovation distinguishes between a leader and a follower",
+    "It always seems impossible until it is done",
+]
+POSITIVE = {"best", "invent", "innovation", "leader", "done"}
+
+
+def start_text_analytics():
+    """Local Text Analytics stand-in: /sentiment scores by positive words,
+    /keyPhrases returns long words; checks the subscription-key header."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            if self.headers.get("Ocp-Apim-Subscription-Key") != "LOCAL-KEY":
+                self.send_error(401)
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            docs = json.loads(self.rfile.read(n))["documents"]
+            out = []
+            for d in docs:
+                words = set(d["text"].lower().split())
+                if self.path.endswith("/sentiment"):
+                    score = len(words & POSITIVE) / 3.0
+                    out.append({"id": d["id"], "score": min(score, 1.0)})
+                else:  # /keyPhrases
+                    out.append({"id": d["id"],
+                                "keyPhrases": [w for w in d["text"].split()
+                                               if len(w) > 7]})
+            body = json.dumps({"documents": out}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def main():
+    httpd, base = start_text_analytics()
+    try:
+        df = DataFrame.from_dict({"quote": np.array(QUOTES, dtype=object)})
+
+        sentiment = TextSentiment(outputCol="sentiment",
+                                  url=base + "/text/analytics/v2.0/sentiment")
+        sentiment.set_subscription_key("LOCAL-KEY")
+        sentiment.set_col("text", "quote")
+        sentiment.set_scalar("language", "en")
+
+        phrases = KeyPhraseExtractor(
+            outputCol="phrases", url=base + "/text/analytics/v2.0/keyPhrases")
+        phrases.set_subscription_key("LOCAL-KEY")
+        phrases.set_col("text", "quote")
+
+        out = phrases.transform(sentiment.transform(df))
+        scores = [r["documents"][0]["score"] for r in out.column("sentiment")]
+        kp = [r["documents"][0]["keyPhrases"] for r in out.column("phrases")]
+        for q, s, k in zip(QUOTES, scores, kp):
+            print(f"score={s:.2f} phrases={k[:2]} :: {q[:40]}...")
+        assert all(0.0 <= s <= 1.0 for s in scores)
+        assert scores[0] > 0  # "best...invent" hits positive words
+        assert any("Innovation" in p for p in kp[2])
+        print(f"EXAMPLE OK quotes={len(QUOTES)}")
+    finally:
+        httpd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
