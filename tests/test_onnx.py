@@ -464,3 +464,80 @@ class TestNativeRoundTrip:
         want = np.asarray(mod.apply(params, x))
         got = np.asarray(fm.apply(x))
         np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+
+class TestTransformerGraphImport:
+    """Transformer-family ONNX graphs import and match torch numerics —
+    attention is MatMul/Transpose/Mul/Softmax/Add, all runtime ops of
+    GraphModule, so sequence models ride the same import path as CNNs."""
+
+    def _attention_onnx(self, Wq, Wk, Wv, Wo, scale, path):
+        nodes, inits = [], []
+
+        def init(name, arr):
+            inits.append(proto.make_tensor(name,
+                                           np.ascontiguousarray(arr)))
+            return name
+
+        init("Wq", Wq), init("Wk", Wk), init("Wv", Wv), init("Wo", Wo)
+        init("scale", np.asarray(scale, dtype=np.float32))
+        for proj, w in (("q", "Wq"), ("k", "Wk"), ("v", "Wv")):
+            nodes.append(proto.make_node("MatMul", ["input", w], [proj],
+                                         name=f"proj_{proj}"))
+        nodes.append(proto.make_node("Transpose", ["k"], ["kT"],
+                                     name="kT", perm=[0, 2, 1]))
+        nodes.append(proto.make_node("MatMul", ["q", "kT"], ["s_raw"],
+                                     name="scores"))
+        nodes.append(proto.make_node("Mul", ["s_raw", "scale"], ["s"],
+                                     name="scale_scores"))
+        nodes.append(proto.make_node("Softmax", ["s"], ["p"],
+                                     name="attn_softmax", axis=-1))
+        nodes.append(proto.make_node("MatMul", ["p", "v"], ["ctx"],
+                                     name="context"))
+        nodes.append(proto.make_node("MatMul", ["ctx", "Wo"], ["out"],
+                                     name="out_proj"))
+        blob = proto.make_model(
+            nodes, inits,
+            [proto.make_value_info("input", [None, 6, 8])],
+            [proto.make_value_info("out", [None, 6, 8])])
+        with open(path, "wb") as fh:
+            fh.write(blob)
+        return path
+
+    def test_self_attention_matches_torch(self, tmp_path):
+        import torch
+
+        rng = np.random.default_rng(0)
+        D = 8
+        Wq, Wk, Wv, Wo = ((rng.normal(size=(D, D)) / np.sqrt(D))
+                          .astype(np.float32) for _ in range(4))
+        scale = 1.0 / np.sqrt(D)
+        path = self._attention_onnx(Wq, Wk, Wv, Wo, scale,
+                                    str(tmp_path / "attn.onnx"))
+        fm = import_onnx(path, compute_dtype="float32")
+
+        x = rng.normal(size=(3, 6, D)).astype(np.float32)
+        with torch.no_grad():
+            tx = torch.from_numpy(x)
+            q, k, v = tx @ torch.from_numpy(Wq), tx @ torch.from_numpy(Wk), \
+                tx @ torch.from_numpy(Wv)
+            p = torch.softmax(q @ k.transpose(1, 2) * scale, dim=-1)
+            want = ((p @ v) @ torch.from_numpy(Wo)).numpy()
+        got = np.asarray(fm.apply(x))
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+    def test_attention_tap_addressing(self, tmp_path):
+        """Named nodes in the imported graph are tappable (OUTPUT_i /
+        layer addressing works for sequence graphs too)."""
+        rng = np.random.default_rng(1)
+        D = 8
+        ws = [(rng.normal(size=(D, D)) / np.sqrt(D)).astype(np.float32)
+              for _ in range(4)]
+        path = self._attention_onnx(*ws, 1.0 / np.sqrt(D),
+                                    str(tmp_path / "attn2.onnx"))
+        fm = import_onnx(path, compute_dtype="float32",
+                         layer_names=["out_proj", "attn_softmax"])
+        x = rng.normal(size=(2, 6, D)).astype(np.float32)
+        p = np.asarray(fm.apply(x, tap="attn_softmax"))
+        assert p.shape == (2, 6, 6)
+        np.testing.assert_allclose(p.sum(-1), 1.0, atol=1e-5)  # rows sum to 1
