@@ -85,6 +85,44 @@ class TestPipelineParallel:
         assert gw.shape[0] == self.S
         assert all(np.abs(gw[s]).max() > 0 for s in range(self.S))
 
+    def test_transformer_blocks_through_pipeline(self, pipe_mesh):
+        """The pp schedule composes with the real model family: 8 transformer
+        blocks, one per stage, == sequential application."""
+        import jax.random as jr
+
+        from mmlspark_tpu.models import transformer_block
+        from mmlspark_tpu.models.module import matmul_precision
+
+        D, H = 16, 2
+        blocks = [transformer_block(D, H) for _ in range(self.S)]
+        with matmul_precision("float32"):
+            per_stage = []
+            for i, b in enumerate(blocks):
+                p, out_shape = b.init(jr.key(i), (4, D))
+                assert out_shape == (4, D)
+                per_stage.append(p)
+            stacked = stack_stage_params(per_stage)
+            rng = np.random.default_rng(9)
+            xs = jnp.asarray(rng.normal(size=(4, 2, 4, D)).astype(np.float32))
+
+            block0 = blocks[0]  # all blocks share one apply (same topology)
+
+            def stage_fn(p, x):
+                return block0.apply(p, x)
+
+            f = jax.jit(jax.shard_map(
+                lambda p, x: pipeline_apply(stage_fn, p, x, "pipe", self.S),
+                mesh=pipe_mesh, in_specs=(P("pipe"), P()), out_specs=P()))
+            got = np.asarray(f(stacked, xs))
+
+            want = []
+            for m in range(xs.shape[0]):
+                h = xs[m]
+                for b, p in zip(blocks, per_stage):
+                    h = b.apply(p, h)
+                want.append(np.asarray(h))
+        np.testing.assert_allclose(got, np.stack(want), atol=1e-4)
+
     def test_fewer_microbatches_than_stages(self, pipe_mesh):
         stages = _stages(self.S, self.D, seed=4)
         stacked = stack_stage_params(stages)
