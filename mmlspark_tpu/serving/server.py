@@ -379,7 +379,8 @@ def _json_default(o):
 def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    parse: str = "json", host: str = "127.0.0.1", port: int = 0,
                    api_path: str = "/", max_batch_size: int = 64,
-                   max_wait_ms: float = 5.0) -> ServingServer:
+                   max_wait_ms: float = 5.0, token: Optional[str] = None,
+                   journal_path: Optional[str] = None) -> ServingServer:
     """Serve a fitted Transformer: request body -> ``input_col`` -> stage ->
     ``reply_col`` (IOImplicits fluent sugar parity, io/IOImplicits.scala:182-213).
 
@@ -400,4 +401,5 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
 
     return ServingServer(transform, host=host, port=port, api_path=api_path,
                          reply_col=reply_col, max_batch_size=max_batch_size,
-                         max_wait_ms=max_wait_ms)
+                         max_wait_ms=max_wait_ms, token=token,
+                         journal_path=journal_path)
