@@ -21,9 +21,22 @@ import numpy as np
 
 log = logging.getLogger("mmlspark_tpu.native")
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_PKG_DIR)
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libmmlspark_native.so")
+
+
+def _so_path() -> str:
+    """Repo build dir when the repo layout is present (dev checkout); else a
+    user cache dir (pip-installed: site-packages may be read-only)."""
+    if os.path.isdir(_NATIVE_DIR):
+        return os.path.join(_NATIVE_DIR, "build", "libmmlspark_native.so")
+    cache = os.environ.get("XDG_CACHE_HOME",
+                           os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(cache, "mmlspark_tpu", "libmmlspark_native.so")
+
+
+_SO_PATH = _so_path()
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -31,8 +44,12 @@ _build_attempted = False
 
 
 def _build() -> bool:
-    src = os.path.join(_NATIVE_DIR, "src", "mmlspark_native.cpp")
-    if not os.path.exists(src):
+    # dev checkout first; the wheel ships the same source as package data
+    # (native_src/ — a sync test keeps the two identical)
+    candidates = [os.path.join(_NATIVE_DIR, "src", "mmlspark_native.cpp"),
+                  os.path.join(_PKG_DIR, "native_src", "mmlspark_native.cpp")]
+    src = next((c for c in candidates if os.path.exists(c)), None)
+    if src is None:
         return False
     os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
     cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", _SO_PATH, src]

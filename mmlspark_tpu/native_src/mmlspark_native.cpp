@@ -1,0 +1,192 @@
+// mmlspark_tpu native runtime: host-side hot paths in C++.
+//
+// The reference ships its hot host code as native libraries (OpenCV imgproc for
+// image preprocessing, LightGBM's C++ histogram core, VW's murmur hashing)
+// loaded through NativeLoader (core/env/NativeLoader.java:28-140). The TPU
+// rebuild keeps device compute in XLA/Pallas; THIS library covers the host
+// side: image decode-adjacent preprocessing (resize/blur/unroll feeding the
+// chip), batched feature hashing, and the binned-histogram CPU reference used
+// for verification and non-accelerator fallback.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <algorithm>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// MurmurHash3 x86_32 (VW-compatible; validated against standard vectors)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+uint32_t mml_murmur3_32(const uint8_t* data, int32_t len, uint32_t seed) {
+    const uint32_t c1 = 0xcc9e2d51u, c2 = 0x1b873593u;
+    uint32_t h = seed;
+    const int32_t nblocks = len / 4;
+    for (int32_t i = 0; i < nblocks; i++) {
+        uint32_t k;
+        std::memcpy(&k, data + i * 4, 4);
+        k *= c1; k = rotl32(k, 15); k *= c2;
+        h ^= k; h = rotl32(h, 13); h = h * 5u + 0xe6546b64u;
+    }
+    const uint8_t* tail = data + nblocks * 4;
+    uint32_t k1 = 0;
+    switch (len & 3) {
+        case 3: k1 ^= (uint32_t)tail[2] << 16; [[fallthrough]];
+        case 2: k1 ^= (uint32_t)tail[1] << 8;  [[fallthrough]];
+        case 1: k1 ^= tail[0];
+                k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2; h ^= k1;
+    }
+    h ^= (uint32_t)len;
+    h ^= h >> 16; h *= 0x85ebca6bu; h ^= h >> 13; h *= 0xc2b2ae35u; h ^= h >> 16;
+    return h;
+}
+
+// Batch hashing: concatenated utf-8 buffer + offsets -> hashes.
+void mml_murmur3_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                       uint32_t seed, uint32_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        const int64_t start = offsets[i], end = offsets[i + 1];
+        out[i] = mml_murmur3_32(buf + start, (int32_t)(end - start), seed);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Image preprocessing (OpenCV-imgproc replacement for the host pipeline)
+// ---------------------------------------------------------------------------
+
+// Half-pixel-center bilinear resize, HWC float32 (matches ops/image._bilinear).
+void mml_resize_bilinear_f32(const float* src, int32_t h, int32_t w, int32_t c,
+                             float* dst, int32_t oh, int32_t ow) {
+    for (int32_t oy = 0; oy < oh; oy++) {
+        const double fy = ((double)oy + 0.5) * h / oh - 0.5;
+        int32_t y0 = (int32_t)std::floor(fy);
+        double wy = fy - y0;
+        if (y0 < 0) { y0 = 0; wy = 0.0; }
+        if (y0 > h - 1) { y0 = h - 1; wy = 0.0; }
+        const int32_t y1 = std::min(y0 + 1, h - 1);
+        if (wy < 0) wy = 0; if (wy > 1) wy = 1;
+        for (int32_t ox = 0; ox < ow; ox++) {
+            const double fx = ((double)ox + 0.5) * w / ow - 0.5;
+            int32_t x0 = (int32_t)std::floor(fx);
+            double wx = fx - x0;
+            if (x0 < 0) { x0 = 0; wx = 0.0; }
+            if (x0 > w - 1) { x0 = w - 1; wx = 0.0; }
+            const int32_t x1 = std::min(x0 + 1, w - 1);
+            if (wx < 0) wx = 0; if (wx > 1) wx = 1;
+            for (int32_t ch = 0; ch < c; ch++) {
+                const double tl = src[(y0 * w + x0) * c + ch];
+                const double tr = src[(y0 * w + x1) * c + ch];
+                const double bl = src[(y1 * w + x0) * c + ch];
+                const double br = src[(y1 * w + x1) * c + ch];
+                const double top = tl * (1 - wx) + tr * wx;
+                const double bot = bl * (1 - wx) + br * wx;
+                dst[(oy * ow + ox) * c + ch] = (float)(top * (1 - wy) + bot * wy);
+            }
+        }
+    }
+}
+
+void mml_resize_bilinear_u8(const uint8_t* src, int32_t h, int32_t w, int32_t c,
+                            uint8_t* dst, int32_t oh, int32_t ow) {
+    // u8 path: compute in float, round-clamp (matches numpy path)
+    for (int32_t oy = 0; oy < oh; oy++) {
+        const double fy = ((double)oy + 0.5) * h / oh - 0.5;
+        int32_t y0 = (int32_t)std::floor(fy);
+        double wy = fy - y0;
+        if (y0 < 0) { y0 = 0; wy = 0.0; }
+        if (y0 > h - 1) { y0 = h - 1; wy = 0.0; }
+        const int32_t y1 = std::min(y0 + 1, h - 1);
+        if (wy < 0) wy = 0; if (wy > 1) wy = 1;
+        for (int32_t ox = 0; ox < ow; ox++) {
+            const double fx = ((double)ox + 0.5) * w / ow - 0.5;
+            int32_t x0 = (int32_t)std::floor(fx);
+            double wx = fx - x0;
+            if (x0 < 0) { x0 = 0; wx = 0.0; }
+            if (x0 > w - 1) { x0 = w - 1; wx = 0.0; }
+            const int32_t x1 = std::min(x0 + 1, w - 1);
+            if (wx < 0) wx = 0; if (wx > 1) wx = 1;
+            for (int32_t ch = 0; ch < c; ch++) {
+                const double tl = src[(y0 * w + x0) * c + ch];
+                const double tr = src[(y0 * w + x1) * c + ch];
+                const double bl = src[(y1 * w + x0) * c + ch];
+                const double br = src[(y1 * w + x1) * c + ch];
+                const double top = tl * (1 - wx) + tr * wx;
+                const double bot = bl * (1 - wx) + br * wx;
+                double v = std::nearbyint(top * (1 - wy) + bot * wy);
+                if (v < 0) v = 0; if (v > 255) v = 255;
+                dst[(oy * ow + ox) * c + ch] = (uint8_t)v;
+            }
+        }
+    }
+}
+
+// HWC uint8 -> flat CHW float64 (UnrollImage hot path).
+void mml_unroll_chw_f64(const uint8_t* src, int32_t h, int32_t w, int32_t c,
+                        double* out, int32_t normalize) {
+    const double scale = normalize ? (1.0 / 255.0) : 1.0;
+    for (int32_t ch = 0; ch < c; ch++)
+        for (int32_t y = 0; y < h; y++)
+            for (int32_t x = 0; x < w; x++)
+                out[(ch * h + y) * w + x] = src[(y * w + x) * c + ch] * scale;
+}
+
+// ---------------------------------------------------------------------------
+// Binned histogram accumulation (LightGBM core CPU reference)
+// ---------------------------------------------------------------------------
+
+// bins [n,f] int32, grad/hess [n] f32, mask [n] u8 -> hist [f, num_bins, 3]
+void mml_histogram(const int32_t* bins, const float* grad, const float* hess,
+                   const uint8_t* mask, int64_t n, int32_t f, int32_t num_bins,
+                   float* hist) {
+    std::memset(hist, 0, sizeof(float) * (size_t)f * num_bins * 3);
+    for (int64_t i = 0; i < n; i++) {
+        if (!mask[i]) continue;
+        const float g = grad[i], hs = hess[i];
+        const int32_t* row = bins + i * f;
+        for (int32_t j = 0; j < f; j++) {
+            float* cell = hist + ((size_t)j * num_bins + row[j]) * 3;
+            cell[0] += g;
+            cell[1] += hs;
+            cell[2] += 1.0f;
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Tree-ensemble prediction (LGBM_BoosterPredictForMat CPU reference)
+// ---------------------------------------------------------------------------
+
+// SoA forest: feature/left/right [t,m] i32, threshold [t,m] f32,
+// default_left [t,m] u8, value [t,m] f32 (pre-scaled by shrinkage).
+void mml_forest_predict(const float* X, int64_t n, int32_t num_feat,
+                        const int32_t* feature, const float* threshold,
+                        const uint8_t* default_left, const int32_t* left,
+                        const int32_t* right, const float* value,
+                        int32_t t, int32_t m, const int32_t* class_of_tree,
+                        int32_t num_class, double* out) {
+    for (int64_t i = 0; i < n; i++) {
+        const float* x = X + i * num_feat;
+        for (int32_t ti = 0; ti < t; ti++) {
+            const int32_t base = ti * m;
+            int32_t node = 0;
+            while (feature[base + node] >= 0) {
+                const float v = x[feature[base + node]];
+                bool go_left = std::isnan(v) ? (bool)default_left[base + node]
+                                             : (v <= threshold[base + node]);
+                node = go_left ? left[base + node] : right[base + node];
+            }
+            out[i * num_class + class_of_tree[ti]] += value[base + node];
+        }
+    }
+}
+
+int32_t mml_version() { return 1; }
+
+}  // extern "C"

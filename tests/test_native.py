@@ -1,5 +1,7 @@
 """Native C++ runtime tests: build, load, and parity with the numpy/jax paths."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -74,3 +76,19 @@ class TestNative:
             ens.left, ens.right, ens.value, ens.class_of_tree, 1)
         want = predict_ensemble(booster.trees, X, 1)
         np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_packaged_native_source_in_sync():
+    """The wheel ships mmlspark_tpu/native_src/ as package data; it must stay
+    byte-identical to the canonical native/src/ tree."""
+    import mmlspark_tpu
+
+    pkg = os.path.join(os.path.dirname(mmlspark_tpu.__file__),
+                       "native_src", "mmlspark_native.cpp")
+    repo = os.path.join(os.path.dirname(os.path.dirname(mmlspark_tpu.__file__)),
+                        "native", "src", "mmlspark_native.cpp")
+    if not os.path.exists(repo):
+        pytest.skip("installed layout: only the packaged copy exists")
+    with open(pkg, "rb") as a, open(repo, "rb") as b:
+        assert a.read() == b.read(), \
+            "native_src/ drifted from native/src/ — re-copy the source"
