@@ -1,11 +1,13 @@
 """Long-context inference with ring attention (sequence parallelism).
 
-A 4096-token document is too long for one device's O(T^2) attention memory;
-shard it over the mesh's ``seq`` axis: each device holds 512 tokens, KV
-blocks rotate around the ring (one ICI hop per step), and the streaming
-softmax keeps per-device memory at O(T_local^2) — 64x smaller score blocks
-here. The same MultiHeadAttention module runs dense on one chip and
-ring-parallel under shard_map; this journey proves the outputs agree.
+A long document overruns one device's O(T^2) attention memory; shard it
+over the mesh's ``seq`` axis: each device holds T/n tokens, KV blocks
+rotate around the ring (one ICI hop per step), and the streaming softmax
+keeps per-device memory at O(T_local^2) — 64x smaller score blocks on an
+8-device mesh. The same MultiHeadAttention module runs dense on one chip
+and ring-parallel under shard_map; this journey proves the outputs agree
+(sized to stay light on the CI's virtual CPU mesh; on real chips the same
+code runs tens of thousands of tokens).
 """
 
 import jax
@@ -17,7 +19,7 @@ from mmlspark_tpu.models import dense_attention, ring_attention
 from mmlspark_tpu.models.module import matmul_precision
 from mmlspark_tpu.parallel import MeshSpec, make_mesh
 
-SEQ = 4096
+SEQ = 2048
 HEADS, HEAD_DIM = 4, 32
 
 
