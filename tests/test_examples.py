@@ -27,8 +27,16 @@ def test_example_runs(script):
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = os.pathsep.join(
         [str(EXAMPLES_DIR.parent), env.get("PYTHONPATH", "")])
+    # the env var alone is not enough: the image's sitecustomize registers
+    # the TPU plugin in every interpreter, so pin the platform the way
+    # conftest.py does — post-import config.update — then run the script
+    runner = (
+        "import sys, runpy, jax; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        "runpy.run_path(sys.argv[1], run_name='__main__')"
+    )
     proc = subprocess.run(
-        [sys.executable, str(EXAMPLES_DIR / script)],
+        [sys.executable, "-c", runner, str(EXAMPLES_DIR / script)],
         capture_output=True, text=True, timeout=900,
         cwd=str(EXAMPLES_DIR), env=env)
     assert proc.returncode == 0, \
