@@ -1,0 +1,59 @@
+"""TextAnalytics - Amazon Book Reviews with Word2Vec.
+
+The embedding-based variant of the text journey: train Word2Vec on the
+corpus, embed each review as the average of its word vectors, classify on
+the embeddings. Closes the last text notebook (the plain TF-IDF variant is
+text_analytics.py).
+"""
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.featurize import Word2Vec
+from mmlspark_tpu.gbdt import LightGBMClassifier
+from mmlspark_tpu.train import TrainClassifier
+
+GOOD = ["great", "excellent", "wonderful", "loved", "amazing", "best"]
+BAD = ["terrible", "awful", "boring", "hated", "worst", "dull"]
+FILLER = ["the", "book", "story", "plot", "characters", "chapter", "read"]
+
+
+def reviews(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    texts, labels = [], []
+    for _ in range(n):
+        label = int(rng.integers(0, 2))
+        vocab = GOOD if label else BAD
+        words = list(rng.choice(FILLER, 5)) + list(rng.choice(vocab, 3))
+        rng.shuffle(words)
+        texts.append(" ".join(words))
+        labels.append(float(label))
+    return DataFrame.from_dict({"text": np.array(texts, dtype=object),
+                                "rating": np.array(labels)},
+                               num_partitions=3)
+
+
+def main():
+    df = reviews()
+    train, test = df.random_split([0.75, 0.25], seed=3)
+
+    w2v = Word2Vec(inputCol="text", outputCol="embedding", vectorSize=16,
+                   minCount=3, numIterations=8, windowSize=3,
+                   batchSize=512, stepSize=0.2, seed=0).fit(train)
+    print(f"vocab={len(w2v.get('vocab'))} words; "
+          f"synonyms of 'great': {w2v.find_synonyms('great', 3)}")
+
+    model = TrainClassifier(labelCol="rating").set_model(
+        LightGBMClassifier(numIterations=25, numLeaves=15,
+                           minDataInLeaf=5)).fit(
+        w2v.transform(train).select("embedding", "rating"))
+    scored = model.transform(w2v.transform(test).select("embedding", "rating"))
+    acc = float(np.mean(scored.column("scored_labels_original") ==
+                        scored.column("rating")))
+    print(f"test accuracy={acc:.3f} on {test.count()} reviews")
+    assert acc > 0.8, acc
+    print(f"EXAMPLE OK accuracy={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

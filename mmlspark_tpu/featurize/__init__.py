@@ -10,9 +10,11 @@ from .indexers import IndexToValue, ValueIndexer, ValueIndexerModel
 from .clean import CleanMissingData, CleanMissingDataModel, DataConversion
 from .assemble import AssembleFeatures, Featurize
 from .text import MultiNGram, PageSplitter, TextFeaturizer, TextFeaturizerModel
+from .word2vec import Word2Vec, Word2VecModel
 
 __all__ = [
     "AssembleFeatures", "CleanMissingData", "CleanMissingDataModel",
     "DataConversion", "Featurize", "IndexToValue", "MultiNGram", "PageSplitter",
     "TextFeaturizer", "TextFeaturizerModel", "ValueIndexer", "ValueIndexerModel",
+    "Word2Vec", "Word2VecModel",
 ]

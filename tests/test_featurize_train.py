@@ -267,3 +267,56 @@ class TestTrainRegressor:
         stats = ComputeModelStatistics(
             labelCol="y", evaluationMetric="regression").transform(scored)
         assert stats.rows()[0]["R^2"] > 0.85
+
+
+class TestWord2Vec:
+    def _docs(self):
+        rng = np.random.default_rng(0)
+        animals = ["cat", "dog", "horse", "cow"]
+        foods = ["pizza", "pasta", "salad", "soup"]
+        docs = []
+        for _ in range(120):
+            group = animals if rng.random() < 0.5 else foods
+            words = list(rng.choice(group, 4)) + ["the", "a"]
+            rng.shuffle(words)
+            docs.append(" ".join(words))
+        return DataFrame.from_dict({"text": np.array(docs, object)})
+
+    def test_embeddings_capture_cooccurrence(self):
+        from mmlspark_tpu.featurize import Word2Vec
+
+        df = self._docs()
+        model = Word2Vec(inputCol="text", outputCol="vec", vectorSize=16,
+                         minCount=2, numIterations=30, windowSize=3,
+                         batchSize=256, stepSize=0.3, seed=1).fit(df)
+        # words that co-occur (same topic) are closer than cross-topic pairs
+        syn = dict(model.find_synonyms("cat", num=len(model.get("vocab"))))
+        assert syn["dog"] > syn["pizza"]
+        assert syn["horse"] > syn["pasta"]
+
+    def test_transform_averages_and_zero_for_oov(self):
+        from mmlspark_tpu.featurize import Word2Vec
+
+        df = self._docs()
+        model = Word2Vec(inputCol="text", outputCol="vec", vectorSize=8,
+                         minCount=2, numIterations=1).fit(df)
+        out = model.transform(DataFrame.from_dict(
+            {"text": np.array(["cat dog", "zzz qqq"], object)}))
+        v = out.column("vec")
+        assert v[0].shape == (8,) and np.abs(v[0]).max() > 0
+        np.testing.assert_array_equal(v[1], np.zeros(8))
+
+    def test_token_list_input(self):
+        from mmlspark_tpu.featurize import Word2Vec
+
+        df = DataFrame.from_dict({"toks": [["a", "b", "a"], ["b", "a", "b"]] * 6})
+        model = Word2Vec(inputCol="toks", outputCol="v", vectorSize=4,
+                         minCount=1, numIterations=1, batchSize=8).fit(df)
+        assert model.transform(df).column("v")[0].shape == (4,)
+
+    def test_empty_vocab_raises(self):
+        from mmlspark_tpu.featurize import Word2Vec
+
+        df = DataFrame.from_dict({"text": np.array(["x y", "z w"], object)})
+        with pytest.raises(ValueError, match="vocab"):
+            Word2Vec(inputCol="text", outputCol="v", minCount=5).fit(df)

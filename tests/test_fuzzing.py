@@ -337,6 +337,18 @@ def _text_featurizer():
                       fit_df=df, transform_df=df)
 
 
+@fixture("Word2Vec", covers=("Word2VecModel",))
+def _word2vec():
+    from mmlspark_tpu.featurize import Word2Vec
+    df = DataFrame.from_dict({"text": [
+        "the cat sat on the mat", "the dog sat on the rug",
+        "a cat and a dog sat", "the mat and the rug"] * 3})
+    return TestObject(Word2Vec(inputCol="text", outputCol="vec",
+                               vectorSize=8, minCount=1, numIterations=1,
+                               batchSize=32),
+                      fit_df=df, transform_df=df)
+
+
 @fixture("MultiNGram")
 def _multi_ngram():
     from mmlspark_tpu.featurize import MultiNGram
