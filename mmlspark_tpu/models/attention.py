@@ -229,8 +229,13 @@ def _gelu(x):
 
 def transformer_block(dim: int, num_heads: int, mlp_ratio: int = 4,
                       causal: bool = False, ring_axis: Optional[str] = None,
-                      ring_axis_size: Optional[int] = None) -> Sequential:
-    """Pre-norm transformer block as a named Sequential (taps work)."""
+                      ring_axis_size: Optional[int] = None,
+                      moe_experts: Optional[int] = None,
+                      moe_capacity_factor: float = 1.5) -> Sequential:
+    """Pre-norm transformer block as a named Sequential (taps work).
+    ``moe_experts``: replace the dense FFN with a switch-MoE of that many
+    experts (shard their weights over the ``expert`` axis via
+    ``moe.expert_shardings`` for expert parallelism)."""
     from .module import Dense, Residual
 
     attn = Sequential([
@@ -239,12 +244,21 @@ def transformer_block(dim: int, num_heads: int, mlp_ratio: int = 4,
                                     ring_axis=ring_axis,
                                     ring_axis_size=ring_axis_size)),
     ])
-    mlp = Sequential([
-        ("ln", LayerNorm()),
-        ("fc1", Dense(dim * mlp_ratio)),
-        ("gelu", Fn(_gelu, lambda s: s)),
-        ("fc2", Dense(dim)),
-    ])
+    if moe_experts:
+        from .moe import MoE
+
+        mlp = Sequential([
+            ("ln", LayerNorm()),
+            ("moe", MoE(moe_experts, hidden=dim * mlp_ratio,
+                        capacity_factor=moe_capacity_factor)),
+        ])
+    else:
+        mlp = Sequential([
+            ("ln", LayerNorm()),
+            ("fc1", Dense(dim * mlp_ratio)),
+            ("gelu", Fn(_gelu, lambda s: s)),
+            ("fc2", Dense(dim)),
+        ])
     return Sequential([
         ("attn", Residual(attn, activation=None)),
         ("mlp", Residual(mlp, activation=None)),

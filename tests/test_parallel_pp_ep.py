@@ -166,6 +166,31 @@ class TestExpertParallel:
             got = np.asarray(jax.jit(moe.apply)(placed, x_repl))
         np.testing.assert_allclose(got, want, atol=1e-5)
 
+    def test_moe_transformer_block_expert_sharded(self, expert_mesh):
+        """A transformer block with a switch-MoE FFN: forward works, and the
+        whole block's params expert-shard (nested w1/w2 leaves) with the
+        sharded result equal to single-device."""
+        import jax.random as jr
+
+        from mmlspark_tpu.models import transformer_block
+        from mmlspark_tpu.models.moe import expert_shardings
+
+        with matmul_precision("float32"):
+            block = transformer_block(16, 2, moe_experts=8,
+                                      moe_capacity_factor=2.0)
+            params, out_shape = block.init(jr.key(0), (12, 16))
+            assert out_shape == (12, 16)
+            x = jnp.asarray(np.random.default_rng(4).normal(
+                size=(2, 12, 16)).astype(np.float32))
+            want = np.asarray(jax.jit(block.apply)(params, x))
+            assert np.isfinite(want).all()
+
+            placed = jax.device_put(params, expert_shardings(expert_mesh,
+                                                             params))
+            x_repl = jax.device_put(x, NamedSharding(expert_mesh, P()))
+            got = np.asarray(jax.jit(block.apply)(placed, x_repl))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
     def test_capacity_drops_overflow(self):
         """With capacity_factor ~0, (nearly) all tokens drop -> output ~0."""
         moe = MoE(num_experts=2, capacity_factor=1e-9)
