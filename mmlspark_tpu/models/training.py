@@ -26,13 +26,17 @@ from ..parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
 
 
 def cross_entropy_loss(logits, labels, mask=None):
-    """Mean softmax cross-entropy; labels are int class ids. Padded rows masked out."""
+    """Mean softmax cross-entropy; labels are int class ids over the leading
+    dims. Handles [B, K] logits with [B] labels AND per-token [B, T, K] with
+    [B, T] (sequence taggers/LMs) — classes are always the last axis. Padded
+    rows/tokens masked out via ``mask`` of the labels' shape."""
     import jax
     import jax.numpy as jnp
 
     logits = logits.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0] - lse
+    ll = jnp.take_along_axis(
+        logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0] - lse
     if mask is not None:
         m = mask.astype(jnp.float32)
         return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
