@@ -191,6 +191,21 @@ def dispatch(bins, grad, hess, row_mask, num_bins: int):
     return compute_histogram_mxu(bins, grad, hess, row_mask, num_bins)
 
 
+def use_mxu_single_device(bins) -> bool:
+    """Should a jitted caller lower its histogram through the single-device
+    MXU kernel? (The fused split step's routing — kept here, next to
+    dispatch(), so the backend predicates cannot drift apart.) Row-sharded
+    inputs must NOT take this path OR the in-jit XLA scatter: they need
+    dispatch()'s per-shard kernel + psum."""
+    if not use_pallas():
+        return False
+    if isinstance(bins, jax.core.Tracer):
+        return False
+    if isinstance(bins, jax.Array) and len(bins.sharding.device_set) > 1:
+        return False
+    return True
+
+
 def use_pallas() -> bool:
     """True when the Pallas path should be dispatched (TPU backend, not
     disabled via MMLSPARK_TPU_NO_PALLAS)."""

@@ -109,8 +109,19 @@ def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
     import jax
     import jax.numpy as jnp
 
+    from . import pallas_hist
+
     n, num_f = bins_dev.shape
-    node_of_row = jnp.zeros(n, dtype=jnp.int32)
+    if node_of_row is None:
+        node_of_row = jnp.zeros(n, dtype=jnp.int32)
+
+    # routing for the per-split histogram, decided ONCE (invariant over the
+    # loop): row-sharded inputs keep the multi-call path whose
+    # compute_histogram dispatch runs the per-shard Pallas kernel + psum
+    # (the in-jit XLA scatter both loses ~13x and can OOM at large N);
+    # everything else takes the fused one-dispatch step.
+    row_sharded = bool(pallas_hist._row_sharded_spec(bins_dev))
+    use_mxu = pallas_hist.use_mxu_single_device(bins_dev)
 
     # growable node storage (host lists; frozen to arrays at the end)
     feature = [-1]
@@ -181,23 +192,51 @@ def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
             gains.append(0.0)
             counts.append(int(sums[2]))
 
-        node_of_row = H.partition_rows(
-            bins_dev[:, f], node_of_row, node.id,
-            np.int32(t), bool(s.default_left), np.int32(lid), np.int32(rid))
         n_leaves += 1
-
-        # histogram subtraction: scatter only the smaller child
         small_id, big_id = (lid, rid) if lsum[2] <= rsum[2] else (rid, lid)
-        small_mask = row_mask & (node_of_row == small_id)
-        small_hist = H.compute_histogram(bins_dev, grad, hess, small_mask, num_bins)
-        big_hist = H.subtract_histogram(node.hist, small_hist)
         small_sums = lsum if small_id == lid else rsum
         big_sums = rsum if small_id == lid else lsum
 
-        for cid, chist, csums in ((small_id, small_hist, small_sums),
-                                  (big_id, big_hist, big_sums)):
+        if row_sharded:
+            # multi-call path: compute_histogram dispatches to the per-shard
+            # Pallas kernel + psum (the fused jit's in-graph scatter would
+            # lose ~13x and can OOM at large N — pallas_hist.py:30-35)
+            node_of_row = H.partition_rows(
+                bins_dev[:, f], node_of_row, node.id,
+                np.int32(t), bool(s.default_left), np.int32(lid),
+                np.int32(rid))
+            small_mask = row_mask & (node_of_row == small_id)
+            small_hist = H.compute_histogram(bins_dev, grad, hess,
+                                             small_mask, num_bins)
+            big_hist = H.subtract_histogram(node.hist, small_hist)
+            split_small = eval_node(small_hist)
+            split_big = eval_node(big_hist)
+        else:
+            # fused split iteration: route rows + scatter the smaller
+            # child's histogram + sibling subtraction + both children's
+            # split evals in ONE device dispatch (H.fused_split_step — the
+            # loop used to be dispatch-bound at 4-5 round trips per split)
+            node_of_row, small_hist, big_hist, split_small, split_big = \
+                H.fused_split_step(
+                    bins_dev, grad, hess, row_mask, node_of_row, node.hist,
+                    np.int32(f), np.int32(t), bool(s.default_left),
+                    np.int32(node.id), np.int32(lid), np.int32(rid),
+                    np.int32(small_id),
+                    config.lambda_l1, config.lambda_l2,
+                    config.min_sum_hessian_in_leaf,
+                    feature_mask if feature_mask is not None
+                    else np.zeros(0, dtype=bool),
+                    num_bins=num_bins,
+                    min_data_in_leaf=config.min_data_in_leaf,
+                    use_mxu=use_mxu,
+                    has_feature_mask=feature_mask is not None)
+            split_small, split_big = jax.device_get((split_small, split_big))
+
+        for cid, chist, csplit, csums in (
+                (small_id, small_hist, split_small, small_sums),
+                (big_id, big_hist, split_big, big_sums)):
             if csums[2] >= 2 * config.min_data_in_leaf:
-                push(_Node(cid, node.depth + 1, chist, csums, eval_node(chist)))
+                push(_Node(cid, node.depth + 1, chist, csums, csplit))
 
     tree = Tree(
         feature=np.asarray(feature, dtype=np.int32),
