@@ -493,3 +493,89 @@ class TestLambdaRankInternals:
         g2, h2 = self._gh(scores, labels, groups)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-6)
         np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), atol=1e-6)
+
+
+class TestFusedSplitStep:
+    """The one-dispatch split iteration must be semantically identical to the
+    multi-call sequence it replaced (partition -> histogram -> subtraction ->
+    two split evals)."""
+
+    def test_fused_equals_multicall(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.gbdt import histogram as H
+
+        rng = np.random.default_rng(0)
+        n, f, num_bins = 500, 6, 16
+        bins = jnp.asarray(rng.integers(0, num_bins, size=(n, f)),
+                           dtype=jnp.int32)
+        grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        hess = jnp.asarray(np.ones(n, dtype=np.float32))
+        row_mask = jnp.asarray(rng.random(n) < 0.9)
+        node_of_row = jnp.zeros(n, dtype=jnp.int32)
+
+        parent_hist = H.compute_histogram(bins, grad, hess, row_mask, num_bins)
+        s = jax.device_get(H.find_best_split(parent_hist, 0.0, 1.0, 1e-3, 5))
+        fsel, t, dleft = int(s.feature), int(s.bin), bool(s.default_left)
+        lid, rid = 1, 2
+        small_id = lid if float(s.left_sum[2]) <= float(s.right_sum[2]) else rid
+
+        # multi-call reference
+        nor_ref = H.partition_rows(bins[:, fsel], node_of_row, np.int32(0),
+                                   np.int32(t), dleft, np.int32(lid),
+                                   np.int32(rid))
+        small_mask = row_mask & (nor_ref == small_id)
+        small_ref = H.compute_histogram(bins, grad, hess, small_mask, num_bins)
+        big_ref = H.subtract_histogram(parent_hist, small_ref)
+        ss_ref = jax.device_get(H.find_best_split(small_ref, 0.0, 1.0, 1e-3, 5))
+        sb_ref = jax.device_get(H.find_best_split(big_ref, 0.0, 1.0, 1e-3, 5))
+
+        # fused
+        nor, small, big, ss, sb = H.fused_split_step(
+            bins, grad, hess, row_mask, node_of_row, parent_hist,
+            np.int32(fsel), np.int32(t), dleft, np.int32(0),
+            np.int32(lid), np.int32(rid), np.int32(small_id),
+            0.0, 1.0, 1e-3, np.zeros(0, dtype=bool),
+            num_bins=num_bins, min_data_in_leaf=5, use_mxu=False,
+            has_feature_mask=False)
+        ss, sb = jax.device_get((ss, sb))
+
+        np.testing.assert_array_equal(np.asarray(nor), np.asarray(nor_ref))
+        np.testing.assert_allclose(np.asarray(small), np.asarray(small_ref),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(big), np.asarray(big_ref),
+                                   atol=1e-5)
+        for got, want in ((ss, ss_ref), (sb, sb_ref)):
+            assert int(got.feature) == int(want.feature)
+            assert int(got.bin) == int(want.bin)
+            np.testing.assert_allclose(float(got.gain), float(want.gain),
+                                       rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(got.left_sum),
+                                       np.asarray(want.left_sum), atol=1e-4)
+
+    def test_feature_mask_respected_in_fused_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.gbdt import histogram as H
+
+        rng = np.random.default_rng(1)
+        n, f, num_bins = 300, 4, 8
+        bins = jnp.asarray(rng.integers(0, num_bins, size=(n, f)),
+                           dtype=jnp.int32)
+        grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        hess = jnp.asarray(np.ones(n, dtype=np.float32))
+        row_mask = jnp.ones(n, dtype=bool)
+        nor = jnp.zeros(n, dtype=jnp.int32)
+        parent = H.compute_histogram(bins, grad, hess, row_mask, num_bins)
+        mask = np.array([True, False, False, False])
+        _, _, _, ss, sb = H.fused_split_step(
+            bins, grad, hess, row_mask, nor, parent,
+            np.int32(0), np.int32(3), True, np.int32(0),
+            np.int32(1), np.int32(2), np.int32(1),
+            0.0, 1.0, 1e-3, mask,
+            num_bins=num_bins, min_data_in_leaf=2, use_mxu=False,
+            has_feature_mask=True)
+        ss, sb = jax.device_get((ss, sb))
+        assert int(ss.feature) == 0 and int(sb.feature) == 0  # only unmasked
