@@ -575,6 +575,29 @@ def train(params: TrainParams,
     lr = 1.0 if is_rf else params.learning_rate
     bag_mask = np.ones(n, dtype=bool)  # persists across iters (bagging_freq reuse)
 
+    # single-device accelerator fast path: keep the running scores ON DEVICE
+    # (Kahan-compensated f32 — see _add_leaf_values) and update them from the
+    # fused grower's device-resident row routing — no per-iter [N] score
+    # upload or row fetch. Dart is excluded (it rewrites scores on host when
+    # dropping/re-adding trees), the sharded path is excluded (its grower
+    # already returns host rows through the per-shard kernels), and CPU keeps
+    # the exact-f64 host accumulation (in-process dispatch is cheap there).
+    fast_scores = (shard_put is None and not is_dart
+                   and jax.default_backend() != "cpu")
+    max_nodes = 2 * params.num_leaves - 1
+    score_dev = comp_dev = None
+    if fast_scores:
+        score_dev = jax.device_put(jnp.asarray(
+            scores[:, 0] if k == 1 else scores, dtype=jnp.float32))
+        comp_dev = jnp.zeros_like(score_dev)
+
+    def _host_scores():
+        if not fast_scores:
+            return scores
+        s, c = jax.device_get((score_dev, comp_dev))
+        return (np.asarray(s, dtype=np.float64)
+                + np.asarray(c, dtype=np.float64)).reshape(n, -1)
+
     for it in range(params.num_iterations):
         # ----- dart: drop a subset of existing trees from the current scores
         dropped: List[int] = []
@@ -590,8 +613,9 @@ def train(params: TrainParams,
                 for kk in range(k):
                     scores[:, kk] -= _tree_contrib(booster.trees[di][kk], X)
 
-        score_dev = put(jnp.asarray(scores[:, 0] if k == 1 else scores,
-                                    dtype=jnp.float32))
+        if not fast_scores:
+            score_dev = put(jnp.asarray(scores[:, 0] if k == 1 else scores,
+                                        dtype=jnp.float32))
         g, h = grad_hess(objective, score_dev, labels, w_dev, params.alpha,
                          g_dev, group_segments=group_seg)
 
@@ -637,13 +661,24 @@ def train(params: TrainParams,
             gk = g if g.ndim == 1 else g[:, kk]
             hk = h if h.ndim == 1 else h[:, kk]
             tree, leaf_of_row = grow_tree(bins_dev, gk, hk, mask_dev, num_bins,
-                                          config, mapper, feature_mask)
+                                          config, mapper, feature_mask,
+                                          device_rows=fast_scores)
             shrink = lr
             if is_dart and dropped:
                 shrink = lr / (len(dropped) + lr)  # dart normalization
             tree.shrinkage = shrink
             group.append(tree)
-            scores[:, kk] += tree.value[leaf_of_row] * shrink
+            if fast_scores:
+                # rows may be host numpy if the grower fell back to the
+                # per-split path (memory budget) — device scores either way
+                vals = np.zeros(max(max_nodes, len(tree.value)),
+                                dtype=np.float32)
+                vals[: len(tree.value)] = tree.value * shrink
+                score_dev, comp_dev = _add_leaf_values(
+                    score_dev, comp_dev, jnp.asarray(vals),
+                    jnp.asarray(leaf_of_row), kk if k > 1 else None)
+            else:
+                scores[:, kk] += tree.value[leaf_of_row] * shrink
         if is_dart and dropped:
             # scale dropped trees and add them back
             factor = len(dropped) / (len(dropped) + lr)
@@ -672,7 +707,8 @@ def train(params: TrainParams,
                     log(f"early stopping at iteration {it + 1}, best {best_iter}")
                 break
         elif log and (it + 1) % 10 == 0:
-            train_scores = scores[:, 0] if k == 1 else scores
+            host_sc = _host_scores()
+            train_scores = host_sc[:, 0] if k == 1 else host_sc
             m = eval_metric(metric, train_scores, np.asarray(y, dtype=np.float64),
                             groups)
             log(f"[{it + 1}] train {metric}={m:.6f}")
@@ -689,3 +725,25 @@ def _tree_contrib(tree: Tree, X: np.ndarray) -> np.ndarray:
     from .predict import predict_single_tree
 
     return predict_single_tree(tree, X)
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("kk",))
+def _add_leaf_values(score, comp, values, rows, kk=None):
+    """On-device score update: score += values[rows] (column kk if multiclass).
+
+    Kahan-compensated: ``comp`` carries the rounding residual of every prior
+    add, so small per-tree updates against a large running score are not lost
+    to f32 (the accumulated sum keeps ~2x24-bit effective mantissa, standing
+    in for the f64 host accumulation of the non-fast path). ``values`` is
+    padded to the static max-node count so every tree of a run hits the same
+    compiled executable."""
+    upd = values[rows]
+    if kk is not None:
+        s_col, c_col = score[:, kk], comp[:, kk]
+        y = upd + c_col
+        t = s_col + y
+        return (score.at[:, kk].set(t),
+                comp.at[:, kk].set(y - (t - s_col)))
+    y = upd + comp
+    t = score + y
+    return t, y - (t - score)

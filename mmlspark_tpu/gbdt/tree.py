@@ -6,9 +6,22 @@ largest split gain until ``num_leaves``, computing each split from per-leaf
 histograms, with the parent-minus-sibling subtraction trick so each level costs
 one scatter pass over the smaller child only.
 
-Host Python orchestrates; every inner computation (histogram scatter, split scan,
-row partition) is a jitted kernel from histogram.py with static shapes, so the
-whole growth loop compiles to a handful of cached XLA executables.
+Two growth paths, identical semantics:
+
+- **Device-fused (default)**: the ENTIRE tree grows inside one jitted
+  ``lax.while_loop`` — the best-first heap is an argmax over per-leaf candidate
+  gains, node state lives in flat device arrays, and each iteration routes rows
+  + scatters the small child's histogram (Pallas MXU kernel on TPU) + derives
+  the sibling by subtraction + evaluates both children's splits. One dispatch
+  and one host fetch per TREE; the old per-split orchestration cost ~31
+  blocking round trips per tree and was dispatch-bound end-to-end
+  (BENCH_gbdt_train.json).
+- **Host-orchestrated**: one fused dispatch per split (histogram.py kernels
+  with static shapes). Kept for row-sharded (multi-chip) inputs — whose
+  histogram needs the per-shard Pallas kernel + psum under shard_map — and as
+  the fallback when the per-node histogram buffer would exceed the memory
+  budget (MMLSPARK_TPU_FUSED_TREE_BYTES, or MMLSPARK_TPU_NO_FUSED_TREE=1 to
+  force it off).
 
 Trees are stored as flat arrays (SoA) for vectorized prediction: no pointer
 chasing, predict is a gather loop over depth (predict_trees in booster.py).
@@ -17,12 +30,39 @@ chasing, predict is a gather loop over depth (predict_trees in booster.py).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import heapq
+import os
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from . import histogram as H
+
+# Per-node histogram buffer cap for the device-fused grower: [2L-1, F, B, 3] f32.
+# Above this, fall back to per-split host orchestration (whose live set is the
+# heap frontier only).
+_FUSED_TREE_DEFAULT_BUDGET = 2 << 30
+
+
+def _fused_tree_enabled(max_nodes: int, num_f: int, num_bins: int) -> bool:
+    if os.environ.get("MMLSPARK_TPU_NO_FUSED_TREE", "") not in ("", "0"):
+        return False
+    budget = int(os.environ.get("MMLSPARK_TPU_FUSED_TREE_BYTES",
+                                _FUSED_TREE_DEFAULT_BUDGET))
+    if max_nodes * num_f * num_bins * 3 * 4 > budget:
+        return False
+    if os.environ.get("MMLSPARK_TPU_FUSED_TREE", "") not in ("", "0"):
+        return True  # forced on (tests exercise the fused path on CPU)
+    # default: accelerators only — the fused win is removing per-split
+    # dispatch round trips, which in-process CPU dispatch barely pays
+    # (measured: TPU 200s -> 27s, CPU 8.3s -> 11.9s on the training bench)
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
 
 
 @dataclasses.dataclass
@@ -96,9 +136,214 @@ class _Node:
         self.split = split    # SplitInfo (host numpy) or None
 
 
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("num_bins", "max_nodes", "min_data_in_leaf", "max_depth",
+                     "use_mxu", "has_feature_mask"))
+def _grow_tree_device(bins, grad, hess, row_mask, node_of_row,
+                      lambda_l1, lambda_l2, min_sum_hessian, min_gain_to_split,
+                      feature_mask, *, num_bins: int, max_nodes: int,
+                      min_data_in_leaf: int, max_depth: int,
+                      use_mxu: bool, has_feature_mask: bool):
+    """Grow one whole tree inside a single jitted ``lax.while_loop``.
+
+    The best-first heap becomes an argmax over ``cand_gain`` (−inf marks
+    non-splittable/already-split nodes); ties resolve to the lowest node id.
+    NOTE: the host path's heapq breaks exact-gain ties by push order, which
+    is small-child-first — NOT always the lower node id — so two candidates
+    with bit-identical gains can pop in a different order there. Gains are
+    f32 sums of distinct data, so real datasets hit this with probability ~0;
+    everywhere else node ids are assigned in split order exactly as the host
+    path does, and both paths produce identical trees.
+
+    Returns flat node arrays sized ``max_nodes`` (= 2*num_leaves−1), the
+    per-node (grad, hess, count) sums for host-side f64 leaf values, the final
+    row→node routing, and ``n_nodes``. One dispatch, one fetch, per tree.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if use_mxu:
+        from .pallas_hist import compute_histogram_mxu as hist_fn
+    else:
+        hist_fn = H.compute_histogram_xla
+
+    fm = feature_mask if has_feature_mask else None
+    neg_inf = jnp.float32(-jnp.inf)
+    M = max_nodes
+    num_leaves_target = (max_nodes + 1) // 2
+
+    def best(hist):
+        return H.find_best_split(hist, lambda_l1, lambda_l2, min_sum_hessian,
+                                 min_data_in_leaf, fm)
+
+    root_hist = hist_fn(bins, grad, hess, row_mask, num_bins)
+    root_sums = H.total_sums(grad, hess, row_mask)
+    s0 = best(root_hist)
+    # host parity: the root is pushed without the 2*min_data_in_leaf check
+    # (find_best_split already enforces per-side constraints), and the
+    # max_depth guard can never block depth 0
+    root_ok = jnp.isfinite(s0.gain) & (s0.gain > min_gain_to_split)
+
+    f32 = jnp.float32
+    state = dict(
+        node_of_row=node_of_row,
+        feature=jnp.full(M, -1, jnp.int32),
+        threshold_bin=jnp.zeros(M, jnp.int32),
+        default_left=jnp.ones(M, bool),
+        left=jnp.full(M, -1, jnp.int32),
+        right=jnp.full(M, -1, jnp.int32),
+        gain=jnp.zeros(M, f32),
+        sums=jnp.zeros((M, 3), f32).at[0].set(root_sums),
+        depth=jnp.zeros(M, jnp.int32),
+        hists=jnp.zeros((M,) + root_hist.shape, f32).at[0].set(root_hist),
+        cand_gain=jnp.full(M, -jnp.inf, f32).at[0].set(
+            jnp.where(root_ok, s0.gain, neg_inf)),
+        cand_feature=jnp.zeros(M, jnp.int32).at[0].set(s0.feature),
+        cand_bin=jnp.zeros(M, jnp.int32).at[0].set(s0.bin),
+        cand_dleft=jnp.zeros(M, bool).at[0].set(s0.default_left),
+        cand_lsum=jnp.zeros((M, 3), f32).at[0].set(s0.left_sum),
+        cand_rsum=jnp.zeros((M, 3), f32).at[0].set(s0.right_sum),
+        n_nodes=jnp.int32(1),
+        n_leaves=jnp.int32(1),
+    )
+
+    def cond(st):
+        return (st["n_leaves"] < num_leaves_target) \
+            & (jnp.max(st["cand_gain"]) > neg_inf)
+
+    def body(st):
+        leaf = jnp.argmax(st["cand_gain"]).astype(jnp.int32)
+        f = st["cand_feature"][leaf]
+        t = st["cand_bin"][leaf]
+        dl = st["cand_dleft"][leaf]
+        lsum = st["cand_lsum"][leaf]
+        rsum = st["cand_rsum"][leaf]
+        lid = st["n_nodes"]
+        rid = lid + 1
+        dchild = st["depth"][leaf] + 1
+
+        node_of_row = H.partition_rows(
+            jnp.take(bins, f, axis=1), st["node_of_row"], leaf, t, dl, lid, rid)
+
+        small_is_left = lsum[2] <= rsum[2]
+        small_id = jnp.where(small_is_left, lid, rid)
+        big_id = jnp.where(small_is_left, rid, lid)
+        small_mask = row_mask & (node_of_row == small_id)
+        # note: a "gather the small child's <=N/2 rows first" variant was
+        # measured SLOWER on TPU — nonzero-compaction + row gather cost more
+        # than the halved MXU histogram saved — so the kernel scans all rows
+        # with the mask zeroing non-members
+        small_hist = hist_fn(bins, grad, hess, small_mask, num_bins)
+        big_hist = H.subtract_histogram(st["hists"][leaf], small_hist)
+        s_small = best(small_hist)
+        s_big = best(big_hist)
+
+        cg = st["cand_gain"].at[leaf].set(neg_inf)
+        cf, cb, cd = st["cand_feature"], st["cand_bin"], st["cand_dleft"]
+        cl, cr = st["cand_lsum"], st["cand_rsum"]
+
+        def push(arrs, nid, s, csum):
+            cg, cf, cb, cd, cl, cr = arrs
+            ok = jnp.isfinite(s.gain) & (s.gain > min_gain_to_split)
+            ok &= csum[2] >= 2 * min_data_in_leaf
+            if max_depth > 0:
+                ok &= dchild < max_depth
+            return (cg.at[nid].set(jnp.where(ok, s.gain, neg_inf)),
+                    cf.at[nid].set(s.feature), cb.at[nid].set(s.bin),
+                    cd.at[nid].set(s.default_left),
+                    cl.at[nid].set(s.left_sum), cr.at[nid].set(s.right_sum))
+
+        small_sums = jnp.where(small_is_left, lsum, rsum)
+        big_sums = jnp.where(small_is_left, rsum, lsum)
+        arrs = push((cg, cf, cb, cd, cl, cr), small_id, s_small, small_sums)
+        cg, cf, cb, cd, cl, cr = push(arrs, big_id, s_big, big_sums)
+
+        return dict(
+            node_of_row=node_of_row,
+            feature=st["feature"].at[leaf].set(f),
+            threshold_bin=st["threshold_bin"].at[leaf].set(t),
+            default_left=st["default_left"].at[leaf].set(dl),
+            left=st["left"].at[leaf].set(lid),
+            right=st["right"].at[leaf].set(rid),
+            gain=st["gain"].at[leaf].set(st["cand_gain"][leaf]),
+            sums=st["sums"].at[lid].set(lsum).at[rid].set(rsum),
+            depth=st["depth"].at[lid].set(dchild).at[rid].set(dchild),
+            hists=st["hists"].at[small_id].set(small_hist)
+                             .at[big_id].set(big_hist),
+            cand_gain=cg, cand_feature=cf, cand_bin=cb, cand_dleft=cd,
+            cand_lsum=cl, cand_rsum=cr,
+            n_nodes=lid + 2, n_leaves=st["n_leaves"] + 1,
+        )
+
+    out = jax.lax.while_loop(cond, body, state)
+    return {k: out[k] for k in (
+        "node_of_row", "feature", "threshold_bin", "default_left", "left",
+        "right", "gain", "sums", "n_nodes")}
+
+
+def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
+                     config: GrowerConfig, bin_mapper, feature_mask,
+                     node_of_row, device_rows: bool = False
+                     ) -> Tuple[Tree, np.ndarray]:
+    """Host wrapper for the one-dispatch-per-tree device grower.
+
+    ``device_rows``: return the row→leaf routing as the device array instead
+    of fetching it (the booster's on-device score update wants it resident).
+    """
+    import jax
+
+    from . import pallas_hist
+
+    dev_out = _grow_tree_device(
+        bins_dev, grad, hess, row_mask, node_of_row,
+        np.float32(config.lambda_l1), np.float32(config.lambda_l2),
+        np.float32(config.min_sum_hessian_in_leaf),
+        np.float32(config.min_gain_to_split),
+        feature_mask if feature_mask is not None else np.zeros(0, dtype=bool),
+        num_bins=num_bins, max_nodes=2 * config.num_leaves - 1,
+        min_data_in_leaf=config.min_data_in_leaf, max_depth=config.max_depth,
+        use_mxu=pallas_hist.use_mxu_single_device(bins_dev),
+        has_feature_mask=feature_mask is not None)
+    rows_dev = dev_out.pop("node_of_row")
+    out = jax.device_get(dev_out)
+
+    nn = int(out["n_nodes"])
+    feature = out["feature"][:nn].astype(np.int32)
+    tbin = out["threshold_bin"][:nn].astype(np.int32)
+    sums = out["sums"][:nn].astype(np.float64)
+    # leaf values on host in f64, the same formula + precision lineage as the
+    # per-split path (which fetches f32 SplitInfo sums and computes in f64)
+    g_thr = np.sign(sums[:, 0]) * np.maximum(
+        np.abs(sums[:, 0]) - config.lambda_l1, 0.0)
+    value = np.where(feature < 0,
+                     -g_thr / (sums[:, 1] + config.lambda_l2), 0.0)
+    # host-path parity: values are assigned at child creation only, so an
+    # unsplit root keeps 0.0 (it is never anyone's child)
+    value[0] = 0.0 if nn == 1 else value[0]
+    threshold = np.array(
+        [bin_mapper.bin_upper_value(int(f), int(t)) if f >= 0 else 0.0
+         for f, t in zip(feature, tbin)], dtype=np.float64)
+    tree = Tree(
+        feature=feature,
+        threshold=threshold,
+        threshold_bin=tbin,
+        default_left=out["default_left"][:nn].astype(bool),
+        left=out["left"][:nn].astype(np.int32),
+        right=out["right"][:nn].astype(np.int32),
+        value=value,
+        gain=out["gain"][:nn].astype(np.float32),
+        count=sums[:, 2].astype(np.int32),
+    )
+    if device_rows:
+        return tree, rows_dev
+    return tree, np.asarray(jax.device_get(rows_dev))
+
+
 def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
               config: GrowerConfig, bin_mapper, feature_mask=None,
-              node_of_row=None) -> Tuple[Tree, np.ndarray]:
+              node_of_row=None, device_rows: bool = False
+              ) -> Tuple[Tree, np.ndarray]:
     """Grow one tree; returns (tree, leaf_node_of_row).
 
     ``bins_dev``: [N,F] int32 (device). ``grad``/``hess``: [N] f32 (device).
@@ -115,13 +360,19 @@ def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
     if node_of_row is None:
         node_of_row = jnp.zeros(n, dtype=jnp.int32)
 
-    # routing for the per-split histogram, decided ONCE (invariant over the
-    # loop): row-sharded inputs keep the multi-call path whose
-    # compute_histogram dispatch runs the per-shard Pallas kernel + psum
-    # (the in-jit XLA scatter both loses ~13x and can OOM at large N);
-    # everything else takes the fused one-dispatch step.
+    # routing, decided ONCE (invariant over the loop): row-sharded inputs keep
+    # the multi-call path whose compute_histogram dispatch runs the per-shard
+    # Pallas kernel + psum (the in-jit XLA scatter both loses ~13x and can OOM
+    # at large N); everything else grows the WHOLE tree in one device dispatch
+    # (unless the per-node histogram buffer would blow the memory budget).
     row_sharded = bool(pallas_hist._row_sharded_spec(bins_dev))
     use_mxu = pallas_hist.use_mxu_single_device(bins_dev)
+
+    if not row_sharded and _fused_tree_enabled(
+            2 * config.num_leaves - 1, num_f, num_bins):
+        return _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins,
+                                config, bin_mapper, feature_mask, node_of_row,
+                                device_rows=device_rows)
 
     # growable node storage (host lists; frozen to arrays at the end)
     feature = [-1]

@@ -164,6 +164,177 @@ class TestTreeGrowth:
                                    predict_tree_binned(tree, bins), atol=1e-9)
 
 
+class TestFusedTreeGrower:
+    """The one-dispatch-per-tree device grower must produce the SAME tree as
+    the host-orchestrated per-split path (same kernels, same pop order)."""
+
+    def _grow_both(self, monkeypatch, config, seed=0, with_mask=False,
+                   with_feature_mask=False, with_missing=False):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(seed)
+        X, y = synth_binary(500, seed=seed)
+        if with_missing:
+            X[rng.random(X.shape) < 0.1] = np.nan
+        m = BinMapper.fit(X, max_bin=32)
+        bins = jnp.asarray(m.transform(X))
+        p = np.full_like(y, y.mean())
+        grad = jnp.asarray((p - y).astype(np.float32))
+        hess = jnp.asarray(np.maximum(p * (1 - p), 1e-6).astype(np.float32))
+        mask = jnp.asarray(rng.random(len(y)) < 0.8) if with_mask \
+            else jnp.ones(len(y), dtype=bool)
+        fmask = None
+        if with_feature_mask:
+            fm = np.ones(X.shape[1], dtype=bool)
+            fm[rng.choice(X.shape[1], size=2, replace=False)] = False
+            fmask = jnp.asarray(fm)
+
+        monkeypatch.delenv("MMLSPARK_TPU_NO_FUSED_TREE", raising=False)
+        monkeypatch.setenv("MMLSPARK_TPU_FUSED_TREE", "1")
+        fused, fused_rows = grow_tree(bins, grad, hess, mask, m.max_num_bins,
+                                      config, m, fmask)
+        monkeypatch.setenv("MMLSPARK_TPU_NO_FUSED_TREE", "1")
+        host, host_rows = grow_tree(bins, grad, hess, mask, m.max_num_bins,
+                                    config, m, fmask)
+        return fused, fused_rows, host, host_rows
+
+    def _assert_trees_equal(self, fused, fused_rows, host, host_rows):
+        np.testing.assert_array_equal(fused.feature, host.feature)
+        np.testing.assert_array_equal(fused.threshold_bin, host.threshold_bin)
+        np.testing.assert_array_equal(fused.default_left, host.default_left)
+        np.testing.assert_array_equal(fused.left, host.left)
+        np.testing.assert_array_equal(fused.right, host.right)
+        np.testing.assert_array_equal(fused.count, host.count)
+        np.testing.assert_allclose(fused.threshold, host.threshold)
+        np.testing.assert_allclose(fused.value, host.value, rtol=1e-5,
+                                   atol=1e-7)
+        np.testing.assert_allclose(fused.gain, host.gain, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_array_equal(fused_rows, host_rows)
+
+    def test_matches_host_default_config(self, monkeypatch):
+        out = self._grow_both(
+            monkeypatch, GrowerConfig(num_leaves=15, min_data_in_leaf=5))
+        self._assert_trees_equal(*out)
+
+    def test_matches_host_regularized_masked(self, monkeypatch):
+        out = self._grow_both(
+            monkeypatch,
+            GrowerConfig(num_leaves=31, min_data_in_leaf=3, lambda_l1=0.5,
+                         lambda_l2=1.0, min_gain_to_split=0.01),
+            seed=1, with_mask=True, with_feature_mask=True)
+        self._assert_trees_equal(*out)
+
+    def test_matches_host_max_depth_missing(self, monkeypatch):
+        out = self._grow_both(
+            monkeypatch,
+            GrowerConfig(num_leaves=31, max_depth=3, min_data_in_leaf=5),
+            seed=2, with_missing=True)
+        fused = out[0]
+        self._assert_trees_equal(*out)
+        # max_depth actually bound the tree
+        assert fused.num_leaves <= 8
+
+    def test_unsplittable_root_value_zero(self, monkeypatch):
+        import jax.numpy as jnp
+
+        monkeypatch.delenv("MMLSPARK_TPU_NO_FUSED_TREE", raising=False)
+        monkeypatch.setenv("MMLSPARK_TPU_FUSED_TREE", "1")
+        # 4 rows with min_data_in_leaf=20: no split can satisfy constraints
+        bins = jnp.asarray(np.array([[1], [2], [3], [4]], dtype=np.int32))
+        grad = jnp.asarray(np.array([1, -1, 1, -1], dtype=np.float32))
+        hess = jnp.ones(4, dtype=jnp.float32)
+        m = BinMapper.fit(np.array([[1.0], [2.0], [3.0], [4.0]]), max_bin=8)
+        tree, rows = grow_tree(bins, grad, hess, jnp.ones(4, dtype=bool), 8,
+                               GrowerConfig(num_leaves=7, min_data_in_leaf=20),
+                               m)
+        assert tree.num_leaves == 1
+        assert tree.value[0] == 0.0
+        np.testing.assert_array_equal(rows, np.zeros(4))
+
+    def test_train_end_to_end_matches(self, monkeypatch):
+        X, y = synth_binary(400, seed=4)
+        params = TrainParams(objective="binary", num_iterations=10,
+                             num_leaves=15, min_data_in_leaf=5)
+        monkeypatch.delenv("MMLSPARK_TPU_NO_FUSED_TREE", raising=False)
+        monkeypatch.setenv("MMLSPARK_TPU_FUSED_TREE", "1")
+        b_fused = B.train(params, X, y)
+        monkeypatch.setenv("MMLSPARK_TPU_NO_FUSED_TREE", "1")
+        b_host = B.train(params, X, y)
+        np.testing.assert_allclose(b_fused.raw_predict(X),
+                                   b_host.raw_predict(X), rtol=1e-4, atol=1e-5)
+
+    def test_memory_budget_falls_back(self, monkeypatch):
+        from mmlspark_tpu.gbdt.tree import _fused_tree_enabled
+
+        monkeypatch.setenv("MMLSPARK_TPU_FUSED_TREE", "1")
+        monkeypatch.setenv("MMLSPARK_TPU_FUSED_TREE_BYTES", "1000")
+        assert not _fused_tree_enabled(63, 28, 256)  # budget wins over force-on
+        monkeypatch.delenv("MMLSPARK_TPU_FUSED_TREE_BYTES")
+        assert _fused_tree_enabled(63, 28, 256)
+
+
+class TestDeviceScores:
+    """The accelerator fast path keeps running scores on device in
+    Kahan-compensated f32 — small updates must not vanish against a large
+    base the way naive f32 accumulation loses them."""
+
+    def test_kahan_preserves_small_updates(self):
+        import jax.numpy as jnp
+
+        score = jnp.full(4, 1.0e6, dtype=jnp.float32)
+        comp = jnp.zeros(4, dtype=jnp.float32)
+        naive = score
+        vals = jnp.asarray(np.full(3, 0.01, dtype=np.float32))
+        rows = jnp.zeros(4, dtype=jnp.int32)
+        for _ in range(1000):
+            score, comp = B._add_leaf_values(score, comp, vals, rows)
+            naive = naive + vals[rows]
+        want = 1.0e6 + 1000 * 0.01
+        got = np.float64(score[0]) + np.float64(comp[0])
+        assert abs(got - want) < 1e-3, got
+        # the naive f32 sum demonstrably loses the updates (f32 eps@1e6 ~ 0.06)
+        assert abs(float(naive[0]) - want) > 1.0
+
+    def test_kahan_multiclass_column(self):
+        import jax.numpy as jnp
+
+        score = jnp.zeros((5, 3), dtype=jnp.float32)
+        comp = jnp.zeros((5, 3), dtype=jnp.float32)
+        vals = jnp.asarray(np.array([0.5, -0.25], dtype=np.float32))
+        rows = jnp.asarray(np.array([0, 1, 1, 0, 1], dtype=np.int32))
+        score, comp = B._add_leaf_values(score, comp, vals, rows, 2)
+        got = np.asarray(score)
+        np.testing.assert_allclose(got[:, 2], [0.5, -0.25, -0.25, 0.5, -0.25])
+        assert np.all(got[:, :2] == 0)
+
+    def test_fast_scores_train_matches_host(self, monkeypatch):
+        """Force the fast path on CPU: predictions must match the f64 host
+        accumulation within f32 tolerance."""
+        X, y = synth_binary(400, seed=5)
+        params = TrainParams(objective="binary", num_iterations=12,
+                             num_leaves=15, min_data_in_leaf=5)
+        b_host = B.train(params, X, y)
+        monkeypatch.setattr("jax.default_backend", lambda: "tpu")
+        monkeypatch.setenv("MMLSPARK_TPU_NO_PALLAS", "1")  # XLA hist on CPU
+        b_fast = B.train(params, X, y)
+        np.testing.assert_allclose(b_fast.raw_predict(X),
+                                   b_host.raw_predict(X), rtol=1e-4, atol=1e-5)
+
+    def test_fast_scores_with_validation(self, monkeypatch):
+        """Early stopping reads valid-set metrics (host predict) — must work
+        identically with device-resident train scores."""
+        X, y = synth_binary(400, seed=6)
+        params = TrainParams(objective="binary", num_iterations=30,
+                             num_leaves=7, min_data_in_leaf=5,
+                             early_stopping_round=3)
+        b_host = B.train(params, X[:300], y[:300], valid=(X[300:], y[300:]))
+        monkeypatch.setattr("jax.default_backend", lambda: "tpu")
+        monkeypatch.setenv("MMLSPARK_TPU_NO_PALLAS", "1")  # XLA hist on CPU
+        b_fast = B.train(params, X[:300], y[:300], valid=(X[300:], y[300:]))
+        assert b_fast.best_iteration == b_host.best_iteration
+
+
 class TestBooster:
     def test_binary_training_fits(self):
         X, y = synth_binary(600)

@@ -5,16 +5,18 @@ The reference's LightGBM headline is training speed (docs/lightgbm.md:
 boosting run (numLeaves=31, 50 iterations, 255 bins) on Higgs-shaped data,
 with sklearn's HistGradientBoosting timed on the same data for scale.
 
-Honest reading of the recorded artifact (BENCH_gbdt_train.json): end-to-end
-training wall clock is DISPATCH-bound, not compute-bound — leaf-wise growth
-issues several small jitted calls per tree node, so per-call overhead
-dominates at these scales (through the driver's tunnelled chip each call
-pays ~90ms RTT; even on local CPU the per-node XLA dispatch loses to
-sklearn's in-process C loop at 20k rows). The FLOP-heavy inner op is fast
-(the Pallas histogram beats the XLA lowering 12.9x, BENCH_hist.json); the
-known optimization frontier is level-wise batched growth — fuse every
-node of a depth level into one call — which removes the per-node dispatch
-without touching the math.
+Performance history (BENCH_gbdt_train.json): the first implementation issued
+4-5 device calls per SPLIT and was dispatch-bound (~349s for this config
+through the tunnelled chip); fusing each split into one dispatch got 200s;
+growing the WHOLE tree inside one jitted lax.while_loop (tree.py
+_grow_tree_device: device-side argmax heap + Pallas MXU histograms; a
+small-child N/2 row-gather variant measured slower and was dropped) plus
+keeping the running scores device-resident
+(booster.py _add_leaf_values) removes the per-split round trips entirely —
+one dispatch and one small fetch per tree. Remaining wall clock is histogram
+compute plus one tunnel round trip per tree; a colocated TPU host skips the
+~90ms RTT. sklearn's in-process HistGradientBoosting is timed on the same
+data for scale (it pays no device boundary at all).
 """
 
 import json
