@@ -117,13 +117,18 @@ class GraphModule(Module):
             if taps is not None and taps_out is not None and path in taps:
                 taps_out[path] = env[node.outputs[0]]
         out = env[self.output_name]
-        return out.astype(jnp.float32) if out.dtype != jnp.int64 else out
+        if jnp.issubdtype(out.dtype, jnp.integer) or out.dtype == jnp.bool_:
+            return out
+        return out.astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
 # Op kernels. Each takes (node, args, compute_dtype) and returns array or tuple.
-# Semantics follow the ONNX operator spec (opset 13); correctness is pinned by
-# tests/test_onnx.py comparing against torch reference forwards.
+# Semantics follow the ONNX operator spec (opset 13 baseline; LayerNormalization
+# per opset 17, Gelu per opset 20); coverage spans CNN, transformer
+# (LayerNorm/Gelu/reduces/compares), decoder/segmentation (ConvTranspose,
+# InstanceNorm, Resize), and recurrent (LSTM/GRU via lax.scan) families.
+# Correctness is pinned by tests/test_onnx.py against torch reference forwards.
 # ---------------------------------------------------------------------------
 
 
@@ -320,16 +325,6 @@ def _op_softmax(node, args, cdt):
                           axis=int(node.attrs.get("axis", -1))).astype(args[0].dtype)
 
 
-def _op_reduce_mean(node, args, cdt):
-    import jax.numpy as jnp
-
-    axes = node.attrs.get("axes")
-    if axes is None and len(args) > 1 and args[1] is not None:
-        axes = np.asarray(args[1]).tolist()
-    keepdims = bool(node.attrs.get("keepdims", 1))
-    return jnp.mean(args[0], axis=tuple(axes) if axes else None, keepdims=keepdims)
-
-
 def _op_resize(node, args, cdt):
     import jax
 
@@ -405,7 +400,61 @@ def _make_ops() -> Dict[str, Callable]:
         "Cast": lambda n, a, c: a[0].astype(
             {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
              10: np.float16, 11: np.float64}[int(n.attrs.get("to", 1))]),
-        "ReduceMean": _op_reduce_mean,
+        "ReduceMean": _reduce(lambda x, axis, keepdims: jnp.mean(
+            x, axis=axis, keepdims=keepdims)),
+        "ReduceSum": _reduce(lambda x, axis, keepdims: jnp.sum(
+            x, axis=axis, keepdims=keepdims)),
+        "ReduceMax": _reduce(lambda x, axis, keepdims: jnp.max(
+            x, axis=axis, keepdims=keepdims)),
+        "ReduceMin": _reduce(lambda x, axis, keepdims: jnp.min(
+            x, axis=axis, keepdims=keepdims)),
+        "ReduceProd": _reduce(lambda x, axis, keepdims: jnp.prod(
+            x, axis=axis, keepdims=keepdims)),
+        "ArgMax": _argminmax(jnp.argmax),
+        "ArgMin": _argminmax(jnp.argmin),
+        "LayerNormalization": _op_layernorm,
+        "InstanceNormalization": _op_instancenorm,
+        "ConvTranspose": _op_conv_transpose,
+        "GlobalMaxPool": lambda n, a, c: jnp.max(
+            a[0], axis=tuple(range(2, a[0].ndim)), keepdims=True),
+        "Gelu": lambda n, a, c: (
+            jax.nn.gelu(a[0].astype(np.float32),
+                        approximate=(n.attrs.get("approximate", b"none")
+                                     in (b"tanh", "tanh"))).astype(a[0].dtype)),
+        "Softplus": _unary(lambda x: jax.nn.softplus(
+            x.astype(np.float32)).astype(x.dtype)),
+        "Elu": lambda n, a, c: jnp.where(
+            a[0] > 0, a[0],
+            np.float32(n.attrs.get("alpha", 1.0))
+            * (jnp.exp(jnp.minimum(a[0], 0.0)) - 1)),
+        "Selu": lambda n, a, c: (
+            np.float32(n.attrs.get("gamma", 1.0507009873554805))
+            * jnp.where(a[0] > 0, a[0],
+                        np.float32(n.attrs.get("alpha", 1.6732632423543772))
+                        * (jnp.exp(jnp.minimum(a[0], 0.0)) - 1))),
+        "PRelu": _binary(lambda x, s: jnp.where(x > 0, x, x * s)),
+        "Expand": _op_expand,
+        "Tile": _op_tile,
+        "Where": lambda n, a, c: jnp.where(a[0], a[1], a[2]),
+        "Equal": _binary(jnp.equal),
+        "Greater": _binary(jnp.greater),
+        "GreaterOrEqual": _binary(jnp.greater_equal),
+        "Less": _binary(jnp.less),
+        "LessOrEqual": _binary(jnp.less_equal),
+        "Not": _unary(jnp.logical_not),
+        "And": _binary(jnp.logical_and),
+        "Or": _binary(jnp.logical_or),
+        "Log": _unary(jnp.log),
+        "Sin": _unary(jnp.sin),
+        "Cos": _unary(jnp.cos),
+        "Floor": _unary(jnp.floor),
+        "Ceil": _unary(jnp.ceil),
+        "Round": _unary(jnp.round),
+        "Sign": _unary(jnp.sign),
+        "Mean": lambda n, a, c: sum(a) / len(a),
+        "Sum": lambda n, a, c: sum(a),
+        "LSTM": _op_lstm,
+        "GRU": _op_gru,
         "Resize": _op_resize,
         "Shape": lambda n, a, c: jnp.asarray(a[0].shape, dtype=jnp.int64),
         "Gather": lambda n, a, c: jnp.take(
@@ -419,6 +468,251 @@ def _make_ops() -> Dict[str, Callable]:
         "Slice": _op_slice,
         "Split": _op_split,
     }
+
+
+def _op_layernorm(node, args, cdt):
+    import jax.numpy as jnp
+
+    x, scale = args[0], jnp.asarray(args[1])
+    b = jnp.asarray(args[2]) if len(args) > 2 and args[2] is not None else None
+    axis = int(node.attrs.get("axis", -1))
+    eps = float(node.attrs.get("epsilon", 1e-5))
+    axes = tuple(range(axis % x.ndim, x.ndim))
+    xf = x.astype(np.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    inv = 1.0 / jnp.sqrt(var + eps)
+    y = ((xf - mean) * inv * scale.astype(np.float32))
+    if b is not None:
+        y = y + b.astype(np.float32)
+    # spec outputs: Y, Mean, InvStdDev (later two rarely consumed)
+    return y.astype(x.dtype), mean, inv
+
+
+def _op_instancenorm(node, args, cdt):
+    import jax.numpy as jnp
+
+    x, scale, b = args[0], jnp.asarray(args[1]), jnp.asarray(args[2])
+    eps = float(node.attrs.get("epsilon", 1e-5))
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(np.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    y = (xf - mean) / jnp.sqrt(var + eps) * scale.reshape(shape) \
+        + b.reshape(shape)
+    return y.astype(x.dtype)
+
+
+def _op_conv_transpose(node, args, cdt):
+    import jax
+    import jax.numpy as jnp
+
+    x, w = args[0], jnp.asarray(args[1])
+    b = args[2] if len(args) > 2 else None
+    group = int(node.attrs.get("group", 1))
+    nspatial = w.ndim - 2
+    auto_pad = node.attrs.get("auto_pad", b"NOTSET")
+    auto_pad = auto_pad.decode() if isinstance(auto_pad, bytes) else auto_pad
+    if auto_pad not in ("NOTSET", ""):
+        raise NotImplementedError(f"ConvTranspose auto_pad={auto_pad!r}")
+    if node.attrs.get("output_shape"):
+        raise NotImplementedError("ConvTranspose output_shape attribute")
+    strides = [int(s) for s in node.attrs.get("strides", [1] * nspatial)]
+    dilations = [int(d) for d in node.attrs.get("dilations", [1] * nspatial)]
+    out_pad = [int(p) for p in node.attrs.get("output_padding", [0] * nspatial)]
+    pads = node.attrs.get("pads", [0] * 2 * nspatial)
+    kernel = [int(k) for k in w.shape[2:]]
+
+    # ONNX W layout: [C_in, C_out/group, k...]. Express the transposed conv as
+    # a dilated-input forward conv: flip the kernel spatially, swap in/out
+    # channel axes (per group), dilate the input by the stride, and pad so
+    # out = (i-1)*s - pb - pe + ((k-1)*d + 1) + output_padding.
+    wg = w.reshape((group, w.shape[0] // group) + tuple(w.shape[1:]))
+    wg = jnp.flip(wg, axis=tuple(range(3, 3 + nspatial)))
+    wg = jnp.swapaxes(wg, 1, 2)  # [g, C_out/g, C_in/g, k...]
+    w_fwd = wg.reshape((w.shape[1] * group, w.shape[0] // group) + tuple(kernel))
+
+    padding = []
+    for i in range(nspatial):
+        eff_k = (kernel[i] - 1) * dilations[i]
+        padding.append((eff_k - int(pads[i]),
+                        eff_k - int(pads[i + nspatial]) + out_pad[i]))
+    specs = {1: ("NCH", "OIH"), 2: ("NCHW", "OIHW"), 3: ("NCDHW", "OIDHW")}
+    lhs_spec, rhs_spec = specs[nspatial]
+    y = jax.lax.conv_general_dilated(
+        x.astype(cdt), w_fwd.astype(cdt),
+        window_strides=(1,) * nspatial, padding=padding,
+        lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
+        dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+        feature_group_count=group,
+        preferred_element_type=jnp.float32)
+    y = y.astype(cdt)
+    if b is not None:
+        y = y + jnp.asarray(b).astype(y.dtype).reshape(
+            (1, -1) + (1,) * nspatial)
+    return y
+
+
+def _reduce(fn, arg_default=None):
+    def op(node, args, cdt):
+        import jax.numpy as jnp
+
+        axes = node.attrs.get("axes")
+        if axes is None and len(args) > 1 and args[1] is not None:
+            axes = np.asarray(args[1]).tolist()
+        keepdims = bool(node.attrs.get("keepdims", 1))
+        if not axes and int(node.attrs.get("noop_with_empty_axes", 0)):
+            return args[0]  # spec: empty/absent axes + noop flag = identity
+        return fn(args[0], axis=tuple(int(a) for a in axes) if axes else None,
+                  keepdims=keepdims)
+    return op
+
+
+def _argminmax(fn):
+    def op(node, args, cdt):
+        import jax.numpy as jnp
+
+        axis = int(node.attrs.get("axis", 0))
+        keepdims = bool(node.attrs.get("keepdims", 1))
+        x = args[0]
+        if int(node.attrs.get("select_last_index", 0)):
+            # spec: ties pick the LAST index — flip, argmax, re-index
+            out = x.shape[axis] - 1 - fn(jnp.flip(x, axis), axis=axis)
+        else:
+            out = fn(x, axis=axis)
+        if keepdims:
+            out = jnp.expand_dims(out, axis)
+        # spec says int64; int32 under JAX's default x64-off (same values)
+        return out.astype(jnp.int32)
+    return op
+
+
+def _op_expand(node, args, cdt):
+    import jax.numpy as jnp
+
+    x = args[0]
+    shape = [int(s) for s in np.asarray(args[1]).tolist()]
+    # ONNX Expand: bidirectional broadcast; dim 1 (or missing) broadcasts
+    want = list(jnp.broadcast_shapes(tuple(x.shape), tuple(shape)))
+    return jnp.broadcast_to(x, want)
+
+
+def _op_tile(node, args, cdt):
+    import jax.numpy as jnp
+
+    reps = [int(r) for r in np.asarray(args[1]).tolist()]
+    return jnp.tile(args[0], reps)
+
+
+def _lstm_gates(x_t, h, c, w, r, wb, rb):
+    """One ONNX LSTM step; gate order iofc, activations sigmoid/tanh/tanh."""
+    import jax
+    import jax.numpy as jnp
+
+    H = h.shape[-1]
+    z = x_t @ w.T + h @ r.T + wb + rb            # [B, 4H]
+    i = jax.nn.sigmoid(z[:, :H])
+    o = jax.nn.sigmoid(z[:, H:2 * H])
+    f = jax.nn.sigmoid(z[:, 2 * H:3 * H])
+    g = jnp.tanh(z[:, 3 * H:])
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _op_lstm(node, args, cdt):
+    """ONNX LSTM (layout 0: X [T,B,I]) via lax.scan; supports forward /
+    reverse / bidirectional, default activations, optional B/initial_h/c.
+    sequence_lens is ignored (all sequences full length)."""
+    import jax
+    import jax.numpy as jnp
+
+    x, w, r = args[0], jnp.asarray(args[1]), jnp.asarray(args[2])
+    hidden = int(node.attrs["hidden_size"])
+    direction = node.attrs.get("direction", b"forward")
+    direction = direction.decode() if isinstance(direction, bytes) else direction
+    if int(node.attrs.get("layout", 0)) != 0:
+        raise NotImplementedError("LSTM layout=1")
+    if len(args) > 7 and args[7] is not None:
+        raise NotImplementedError("LSTM peephole weights (input P)")
+    T, B, _ = x.shape
+    D = w.shape[0]
+    bias = jnp.asarray(args[3]) if len(args) > 3 and args[3] is not None \
+        else jnp.zeros((D, 8 * hidden), dtype=jnp.float32)
+    h0 = jnp.asarray(args[5]) if len(args) > 5 and args[5] is not None \
+        else jnp.zeros((D, B, hidden), dtype=jnp.float32)
+    c0 = jnp.asarray(args[6]) if len(args) > 6 and args[6] is not None \
+        else jnp.zeros((D, B, hidden), dtype=jnp.float32)
+
+    xf = x.astype(np.float32)
+    dirs = {"forward": [False], "reverse": [True],
+            "bidirectional": [False, True]}[direction]
+    ys, hs, cs = [], [], []
+    for d, rev in enumerate(dirs):
+        seq = jnp.flip(xf, 0) if rev else xf
+        wb, rb = bias[d, :4 * hidden], bias[d, 4 * hidden:]
+
+        def step(carry, x_t, _w=w[d], _r=r[d], _wb=wb, _rb=rb):
+            h, c = carry
+            h2, c2 = _lstm_gates(x_t, h, c, _w, _r, _wb, _rb)
+            return (h2, c2), h2
+
+        (h_fin, c_fin), y = jax.lax.scan(step, (h0[d], c0[d]), seq)
+        ys.append(jnp.flip(y, 0) if rev else y)
+        hs.append(h_fin)
+        cs.append(c_fin)
+    Y = jnp.stack(ys, axis=1)                     # [T, D, B, H]
+    return Y.astype(x.dtype), jnp.stack(hs, 0), jnp.stack(cs, 0)
+
+
+def _op_gru(node, args, cdt):
+    """ONNX GRU (layout 0), gate order zrh; honors linear_before_reset."""
+    import jax
+    import jax.numpy as jnp
+
+    x, w, r = args[0], jnp.asarray(args[1]), jnp.asarray(args[2])
+    hidden = int(node.attrs["hidden_size"])
+    direction = node.attrs.get("direction", b"forward")
+    direction = direction.decode() if isinstance(direction, bytes) else direction
+    lbr = int(node.attrs.get("linear_before_reset", 0))
+    if int(node.attrs.get("layout", 0)) != 0:
+        raise NotImplementedError("GRU layout=1")
+    T, B, _ = x.shape
+    D = w.shape[0]
+    bias = jnp.asarray(args[3]) if len(args) > 3 and args[3] is not None \
+        else jnp.zeros((D, 6 * hidden), dtype=jnp.float32)
+    h0 = jnp.asarray(args[5]) if len(args) > 5 and args[5] is not None \
+        else jnp.zeros((D, B, hidden), dtype=jnp.float32)
+
+    xf = x.astype(np.float32)
+    dirs = {"forward": [False], "reverse": [True],
+            "bidirectional": [False, True]}[direction]
+    ys, hs = [], []
+    H = hidden
+    for d, rev in enumerate(dirs):
+        seq = jnp.flip(xf, 0) if rev else xf
+        wb, rb = bias[d, :3 * H], bias[d, 3 * H:]
+
+        def step(carry, x_t, _w=w[d], _r=r[d], _wb=wb, _rb=rb):
+            h = carry
+            xz = x_t @ _w.T + _wb                 # [B, 3H]
+            hz = h @ _r.T                         # [B, 3H] (no rb yet)
+            z = jax.nn.sigmoid(xz[:, :H] + hz[:, :H] + _rb[:H])
+            rt = jax.nn.sigmoid(xz[:, H:2 * H] + hz[:, H:2 * H] + _rb[H:2 * H])
+            if lbr:
+                ht = jnp.tanh(xz[:, 2 * H:] + rt * (hz[:, 2 * H:] + _rb[2 * H:]))
+            else:
+                ht = jnp.tanh(xz[:, 2 * H:] + (rt * h) @ _r[2 * H:].T
+                              + _rb[2 * H:])
+            h2 = (1 - z) * ht + z * h
+            return h2, h2
+
+        h_fin, y = jax.lax.scan(step, h0[d], seq)
+        ys.append(jnp.flip(y, 0) if rev else y)
+        hs.append(h_fin)
+    Y = jnp.stack(ys, axis=1)
+    return Y.astype(x.dtype), jnp.stack(hs, 0)
 
 
 def _op_slice(node, args, cdt):

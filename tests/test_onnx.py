@@ -92,6 +92,43 @@ def _onnx_from_torch_seq(model, in_shape, path):
             cur = emit("Sigmoid", [cur], "sigmoid")
         elif isinstance(layer, nn.Dropout):
             cur = emit("Dropout", [cur], "dropout")
+        elif isinstance(layer, nn.ConvTranspose2d):
+            w = layer.weight.detach().numpy()  # [C_in, C_out/g, kH, kW]
+            ins = [cur, add_init("wt", w)]
+            if layer.bias is not None:
+                ins.append(add_init("bt", layer.bias.detach().numpy()))
+            p = layer.padding if isinstance(layer.padding, tuple) \
+                else (layer.padding,) * 2
+            op = layer.output_padding if isinstance(layer.output_padding, tuple) \
+                else (layer.output_padding,) * 2
+            cur = emit("ConvTranspose", ins, "convt",
+                       strides=list(layer.stride),
+                       kernel_shape=list(layer.kernel_size),
+                       pads=[p[0], p[1], p[0], p[1]],
+                       output_padding=list(op), group=layer.groups)
+        elif isinstance(layer, nn.InstanceNorm2d):
+            ins = [cur,
+                   add_init("is", layer.weight.detach().numpy()
+                            if layer.affine else
+                            np.ones(layer.num_features, np.float32)),
+                   add_init("ib", layer.bias.detach().numpy()
+                            if layer.affine else
+                            np.zeros(layer.num_features, np.float32))]
+            cur = emit("InstanceNormalization", ins, "inorm",
+                       epsilon=float(layer.eps))
+        elif isinstance(layer, nn.LayerNorm):
+            ins = [cur, add_init("lns", layer.weight.detach().numpy()),
+                   add_init("lnb", layer.bias.detach().numpy())]
+            cur = emit("LayerNormalization", ins, "lnorm",
+                       axis=-len(layer.normalized_shape),
+                       epsilon=float(layer.eps))
+        elif isinstance(layer, nn.GELU):
+            cur = emit("Gelu", [cur], "gelu",
+                       approximate=layer.approximate)
+        elif isinstance(layer, nn.ELU):
+            cur = emit("Elu", [cur], "elu", alpha=float(layer.alpha))
+        elif isinstance(layer, nn.Softplus):
+            cur = emit("Softplus", [cur], "softplus")
         else:
             raise NotImplementedError(type(layer))
 
@@ -525,6 +562,165 @@ class TestTransformerGraphImport:
             want = ((p @ v) @ torch.from_numpy(Wo)).numpy()
         got = np.asarray(fm.apply(x))
         np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+    def test_layernorm_gelu_mlp(self, tmp_path):
+        import torch.nn as nn
+
+        torch.manual_seed(5)
+        model = nn.Sequential(
+            nn.Flatten(), nn.Linear(12, 16), nn.LayerNorm(16), nn.GELU(),
+            nn.Linear(16, 8), nn.LayerNorm(8), nn.ELU(), nn.Linear(8, 3))
+        model.eval()
+        path = _onnx_from_torch_seq(model, (12,), str(tmp_path / "ln.onnx"))
+        fm = import_onnx(path)
+        x = np.random.default_rng(0).normal(size=(5, 12)).astype(np.float32)
+        with torch.no_grad():
+            want = model(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(np.asarray(fm.apply(x)), want,
+                                   atol=1e-4, rtol=1e-3)
+
+    def test_conv_transpose_instance_norm(self, tmp_path):
+        import torch.nn as nn
+
+        torch.manual_seed(6)
+        model = nn.Sequential(
+            nn.Conv2d(3, 8, 3, stride=2, padding=1),
+            nn.InstanceNorm2d(8, affine=True), nn.ReLU(),
+            nn.ConvTranspose2d(8, 4, 3, stride=2, padding=1,
+                               output_padding=1),
+            nn.Softplus(),
+            nn.ConvTranspose2d(4, 4, 4, stride=2, padding=1, groups=2))
+        model.eval()
+        with torch.no_grad():  # non-trivial affine stats
+            model[1].weight.normal_(1.0, 0.2)
+            model[1].bias.normal_(0, 0.2)
+        path = _onnx_from_torch_seq(model, (3, 13, 13),
+                                    str(tmp_path / "ct.onnx"))
+        fm = import_onnx(path)
+        x = np.random.default_rng(1).normal(size=(2, 3, 13, 13)) \
+            .astype(np.float32)
+        with torch.no_grad():
+            want = model(torch.from_numpy(x)).numpy()
+        got = np.asarray(fm.apply(x))
+        np.testing.assert_allclose(got.reshape(want.shape), want,
+                                   atol=1e-4, rtol=1e-3)
+
+    def test_data_ops_roundtrip(self, tmp_path):
+        """Reduce/Arg/Expand/Where/compare ops vs numpy reference."""
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(3, 4, 5)).astype(np.float32)
+        nodes = [
+            proto.make_node("ReduceSum", ["input", "axes1"], ["rsum"],
+                            name="rsum", keepdims=1),
+            proto.make_node("ReduceMax", ["input"], ["rmax"], name="rmax",
+                            axes=[2], keepdims=0),
+            proto.make_node("ArgMax", ["rmax"], ["amax"], name="amax",
+                            axis=1, keepdims=0),
+            proto.make_node("GreaterOrEqual", ["input", "rsum"], ["ge"],
+                            name="ge"),
+            proto.make_node("Where", ["ge", "input", "zero"], ["w"],
+                            name="w"),
+            proto.make_node("Expand", ["w", "eshape"], ["out"], name="out"),
+        ]
+        inits = [proto.make_tensor("axes1", np.asarray([1], dtype=np.int64)),
+                 proto.make_tensor("zero", np.asarray(0.0, dtype=np.float32)),
+                 proto.make_tensor("eshape",
+                                   np.asarray([2, 3, 4, 5], dtype=np.int64))]
+        blob = proto.make_model(
+            nodes, inits, [proto.make_value_info("input", [None, 4, 5])],
+            [proto.make_value_info("out", [2, 3, 4, 5])])
+        p = tmp_path / "ops.onnx"
+        p.write_bytes(blob)
+        fm = import_onnx(str(p), input_shape=(4, 5))
+        got = np.asarray(fm.apply(x))
+        rsum = x.sum(axis=1, keepdims=True)
+        want = np.broadcast_to(np.where(x >= rsum, x, 0.0), (2, 3, 4, 5))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # and the intermediate int outputs are tappable
+        amax = np.asarray(fm.apply(x, tap="amax"))
+        np.testing.assert_array_equal(amax, x.max(axis=2).argmax(axis=1))
+
+    def _pack_rnn(self, op, torch_rnn, in_dim, hidden, path, extra_attrs=None):
+        """Hand-pack a torch LSTM/GRU into the corresponding ONNX node.
+
+        torch gate orders: LSTM (i,f,g,o) -> ONNX (i,o,f,c);
+        GRU (r,z,n) -> ONNX (z,r,h) with linear_before_reset=1.
+        """
+        ngates = 4 if op == "LSTM" else 3
+
+        def reorder(m):
+            gates = np.split(m, ngates, axis=0)
+            if op == "LSTM":
+                i, f, g, o = gates
+                return np.concatenate([i, o, f, g], axis=0)
+            r, z, nn_ = gates
+            return np.concatenate([z, r, nn_], axis=0)
+
+        dirs = 2 if torch_rnn.bidirectional else 1
+        W, R, B = [], [], []
+        for d in range(dirs):
+            sfx = f"_l0{'_reverse' if d else ''}"
+            W.append(reorder(getattr(torch_rnn, "weight_ih" + sfx)
+                             .detach().numpy()))
+            R.append(reorder(getattr(torch_rnn, "weight_hh" + sfx)
+                             .detach().numpy()))
+            B.append(np.concatenate(
+                [reorder(getattr(torch_rnn, "bias_ih" + sfx).detach().numpy()),
+                 reorder(getattr(torch_rnn, "bias_hh" + sfx)
+                         .detach().numpy())]))
+        attrs = dict(hidden_size=hidden,
+                     direction="bidirectional" if dirs == 2 else "forward")
+        if extra_attrs:
+            attrs.update(extra_attrs)
+        nodes = [proto.make_node(op, ["input", "W", "R", "B"], ["Y"],
+                                 name="rnn", **attrs)]
+        inits = [proto.make_tensor("W", np.stack(W).astype(np.float32)),
+                 proto.make_tensor("R", np.stack(R).astype(np.float32)),
+                 proto.make_tensor("B", np.stack(B).astype(np.float32))]
+        blob = proto.make_model(
+            nodes, inits, [proto.make_value_info("input", [None, 2, in_dim])],
+            [proto.make_value_info("Y", [None, dirs, 2, hidden])])
+        path.write_bytes(blob)
+        return str(path)
+
+    def test_bilstm_matches_torch(self, tmp_path):
+        """A torch BiLSTM imported through the ONNX LSTM op — the BiLSTM
+        entity-extraction notebook's import path (reference runs it through
+        CNTKModel, DeepLearning - BiLSTM notebook)."""
+        import torch.nn as nn
+
+        torch.manual_seed(7)
+        T, B, I, H = 6, 2, 5, 7
+        rnn = nn.LSTM(I, H, bidirectional=True)
+        rnn.eval()
+        path = self._pack_rnn("LSTM", rnn, I, H, tmp_path / "bilstm.onnx")
+        # torch input [T, B, I] == ONNX layout 0; per-example shape (B, I)
+        fm = import_onnx(path, input_shape=(B, I))
+        x = np.random.default_rng(3).normal(size=(T, B, I)).astype(np.float32)
+        with torch.no_grad():
+            want, _ = rnn(torch.from_numpy(x))   # [T, B, 2H]
+        got = np.asarray(fm.apply(x))            # [T, 2, B, H]
+        np.testing.assert_allclose(got[:, 0], want[:, :, :H].numpy(),
+                                   atol=1e-4, rtol=1e-3)
+        np.testing.assert_allclose(got[:, 1], want[:, :, H:].numpy(),
+                                   atol=1e-4, rtol=1e-3)
+
+    def test_gru_matches_torch(self, tmp_path):
+        import torch.nn as nn
+
+        torch.manual_seed(8)
+        T, B, I, H = 5, 3, 4, 6
+        rnn = nn.GRU(I, H)
+        rnn.eval()
+        path = self._pack_rnn("GRU", rnn, I, H, tmp_path / "gru.onnx",
+                              extra_attrs={"linear_before_reset": 1})
+        fm = import_onnx(path, input_shape=(B, I))
+        x = np.random.default_rng(4).normal(size=(T, B, I)).astype(np.float32)
+        with torch.no_grad():
+            want, _ = rnn(torch.from_numpy(x))   # [T, B, H]
+        got = np.asarray(fm.apply(x))            # [T, 1, B, H]
+        np.testing.assert_allclose(got[:, 0], want.numpy(),
+                                   atol=1e-4, rtol=1e-3)
 
     def test_attention_tap_addressing(self, tmp_path):
         """Named nodes in the imported graph are tappable (OUTPUT_i /
