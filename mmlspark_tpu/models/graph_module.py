@@ -554,7 +554,7 @@ def _op_conv_transpose(node, args, cdt):
     return y
 
 
-def _reduce(fn, arg_default=None):
+def _reduce(fn):
     def op(node, args, cdt):
         import jax.numpy as jnp
 

@@ -102,6 +102,53 @@ class TestDenseAttentionOffsets:
             np.testing.assert_allclose(full, want[:, 4:], atol=1e-5)
 
 
+class TestFlashDispatch:
+    """Gate logic for the Pallas flash-attention route (the kernel itself
+    only runs on TPU; equivalence there is proven by the TPU-gated test
+    below plus BENCH_seq.json)."""
+
+    def test_gates_keep_cpu_and_f32_on_xla_path(self):
+        from mmlspark_tpu.models.attention import _flash_dispatch
+
+        q, k, v = _qkv(B=1, T=128, H=2, D=64)
+        # f32 inputs: stay exact
+        assert _flash_dispatch(q, k, v, False, 0, 0) is None
+        qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))
+        # bf16 but CPU backend: no pallas kernel
+        if jax.default_backend() != "tpu":
+            assert _flash_dispatch(qb, kb, vb, False, 0, 0) is None
+
+    def test_gates_reject_unsupported_shapes(self, monkeypatch):
+        from mmlspark_tpu.models import attention as A
+
+        # pretend TPU + drop the length threshold so only shape gates decide
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setenv("MMLSPARK_TPU_FLASH_MIN_T", "64")
+        q, k, v = (a.astype(jnp.bfloat16) for a in _qkv(B=1, T=96, H=2, D=64))
+        assert A._flash_dispatch(q, k, v, False, 0, 0) is None  # T%128
+        q, k, v = (a.astype(jnp.bfloat16) for a in _qkv(B=1, T=128, H=2, D=48))
+        assert A._flash_dispatch(q, k, v, False, 0, 0) is None  # head dim
+        q, k, v = (a.astype(jnp.bfloat16) for a in _qkv(B=1, T=128, H=2, D=64))
+        assert A._flash_dispatch(q, k, v, False, 4, 0) is None  # shard offset
+        monkeypatch.setenv("MMLSPARK_TPU_NO_FLASH", "1")
+        assert A._flash_dispatch(q, k, v, False, 0, 0) is None  # kill switch
+
+    @pytest.mark.skipif(jax.default_backend() != "tpu",
+                        reason="flash kernel is TPU-only")
+    def test_flash_matches_xla_on_tpu(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_FLASH_MIN_T", "128")
+        q, k, v = (a.astype(jnp.bfloat16)
+                   for a in _qkv(B=2, T=256, H=4, D=64, seed=3))
+        for causal in (False, True):
+            got = np.asarray(dense_attention(q, k, v, causal=causal),
+                             dtype=np.float32)
+            monkeypatch.setenv("MMLSPARK_TPU_NO_FLASH", "1")
+            want = np.asarray(dense_attention(q, k, v, causal=causal),
+                              dtype=np.float32)
+            monkeypatch.delenv("MMLSPARK_TPU_NO_FLASH")
+            assert np.abs(got - want).max() < 0.05  # bf16-scale agreement
+
+
 class TestMultiHeadAttention:
     def test_module_dense_path(self):
         mha = MultiHeadAttention(num_heads=2)

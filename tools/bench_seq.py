@@ -1,11 +1,15 @@
 """Sequence-family benchmark: transformer encoder + BiLSTM throughput.
 
 Steady-state tokens/sec on the available chip (device-resident inputs, AOT-
-compiled executables, scalar witnesses force completion). Prints one JSON
-line; BENCH_seq.json records the artifact.
+compiled executables, scalar witnesses force completion). Also A/Bs the
+attention kernel (Pallas flash vs the XLA lowering) at long sequence lengths
+with the repeat loop ON DEVICE — per-call dispatch through a tunnelled chip
+costs ~100ms RTT, which a host-side loop would measure instead of the kernel.
+Prints one JSON line; BENCH_seq.json records the artifact.
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -58,6 +62,42 @@ def main():
     bi_tps = _bench(lambda p, x: bi_c(p, x), (jax.device_put(bi.params), toks),
                     B * T)
 
+    # flash-vs-XLA attention A/B (TPU only; flash dispatches on bf16 inputs)
+    flash_ab = {}
+    if on_accel:
+        from mmlspark_tpu.models.attention import dense_attention
+
+        def attn_ms(flash: bool, T: int, B=4, H=8, D=64, inner=10):
+            os.environ.pop("MMLSPARK_TPU_NO_FLASH", None)
+            if not flash:
+                os.environ["MMLSPARK_TPU_NO_FLASH"] = "1"
+            q, k, v = (jnp.asarray(
+                rng.normal(size=(B, T, H, D)).astype(np.float32))
+                .astype(jnp.bfloat16) for _ in range(3))
+
+            @jax.jit
+            def f(q, k, v):
+                def body(i, acc):
+                    # dtype-preserving dependency on acc: keeps q bf16 (the
+                    # flash gate requires it) while defeating loop hoisting
+                    o = dense_attention(q + acc.astype(q.dtype) * 0, k, v,
+                                        causal=True)
+                    return acc + o.astype(jnp.float32).sum()
+
+                return jax.lax.fori_loop(0, inner, body, jnp.float32(0))
+
+            float(f(q, k, v))  # compile + warm
+            t0 = time.perf_counter()
+            float(f(q, k, v))
+            return (time.perf_counter() - t0) / inner * 1e3
+
+        for t_ab in (2048, 8192):
+            fl, xla = attn_ms(True, t_ab), attn_ms(False, t_ab)
+            flash_ab[f"T{t_ab}"] = {
+                "flash_ms": round(fl, 2), "xla_ms": round(xla, 2),
+                "speedup": round(xla / fl, 2)}
+        os.environ.pop("MMLSPARK_TPU_NO_FLASH", None)
+
     print(json.dumps({
         "backend": dev.platform,
         "transformer_tokens_per_sec": round(tf_tps, 1),
@@ -65,6 +105,7 @@ def main():
                                "heads": 8},
         "bilstm_tokens_per_sec": round(bi_tps, 1),
         "bilstm_config": {"batch": B, "seq": T, "embed": 128, "hidden": 256},
+        "attention_flash_vs_xla": flash_ab or None,
     }))
 
 
