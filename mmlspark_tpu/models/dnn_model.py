@@ -36,14 +36,25 @@ from .module import FunctionModel
 class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
     """Evaluate a FunctionModel over an input column of arrays/images.
 
-    Mirrors CNTKModel's public surface: setModel, setInputCol/setOutputCol (the
-    1-input/1-output case of feedDict/fetchDict — CNTKModel.scala:204-260),
+    Mirrors CNTKModel's public surface: setModel, setInputCol/setOutputCol,
+    setFeedDict/setFetchDict (multi-input / multi-output column<->node maps,
+    all outputs fetched in ONE forward — CNTKModel.scala:204-260),
     setOutputNode/setOutputNodeIndex (SerializableFunction node addressing),
     setMiniBatchSize.
     """
 
     model = ComplexParam("model", "The FunctionModel to evaluate")
     outputNode = Param("outputNode", "Named layer to fetch (None = final output)", None, ptype=str)
+    feedDict = Param("feedDict",
+                     "Map of model argument names (ARGUMENT_i or graph input "
+                     "names; keys) to input column names (values) — the "
+                     "multi-input form of inputCol "
+                     "(cntk/CNTKModel.scala:204-214)", None, ptype=dict)
+    fetchDict = Param("fetchDict",
+                      "Map of output column names (keys) to fetch nodes "
+                      "(OUTPUT_i or layer paths; values) — the multi-output "
+                      "form of outputCol, all fetched in ONE forward pass "
+                      "(cntk/CNTKModel.scala:215-223)", None, ptype=dict)
     batchSize = Param("batchSize", "Rows per evaluation minibatch", 64, lambda v: v > 0, int)
     useMesh = Param("useMesh",
                     "Shard eval batches over the active mesh data axis; "
@@ -73,38 +84,80 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
     def set_mini_batch_size(self, n: int) -> "DNNModel":
         return self.set("batchSize", n)
 
+    def set_feed_dict(self, *args) -> "DNNModel":
+        """set_feed_dict({arg: col, ...}) or set_feed_dict(arg, col)."""
+        d = {args[0]: args[1]} if len(args) == 2 else dict(args[0])
+        return self.set("feedDict", d)
+
+    def set_fetch_dict(self, *args) -> "DNNModel":
+        """set_fetch_dict({col: node, ...}) or set_fetch_dict(col, node)."""
+        d = {args[0]: args[1]} if len(args) == 2 else dict(args[0])
+        return self.set("fetchDict", d)
+
+    # -- I/O maps ----------------------------------------------------------
+    def _io_maps(self, model):
+        """Resolve (input_name -> column, out_column -> tap) maps from either
+        the dict params or the single-column params."""
+        feed = self.get("feedDict")
+        if feed:
+            in_map = {model.resolve_input(k): v for k, v in feed.items()}
+        else:
+            in_map = {model.resolve_input("ARGUMENT_0"):
+                      self.get_or_throw("inputCol")}
+        fetch = self.get("fetchDict")
+        if fetch:
+            out_map = {c: model.resolve_output(n) for c, n in fetch.items()}
+        else:
+            out_map = {self.get_or_throw("outputCol"):
+                       model.resolve_output(self.get("outputNode"))}
+        return in_map, out_map
+
     # -- compiled forward -------------------------------------------------
-    def _compiled(self, tap: Optional[str]):
-        """jit-compiled (params, x) -> activations for one fetch node."""
+    def _compiled(self, taps: Tuple[Optional[str], ...], multi_in: bool):
+        """jit-compiled (params, x) -> tuple of activations, one per tap
+        (all fetched in ONE forward). ``x`` is an array, or a dict of arrays
+        for multi-input models."""
         import jax
 
         model = self.get_model()
-        key = ("fwd", id(model), tap)
+        key = ("fwd", id(model), taps, multi_in)
         if key not in self._jit_cache:
 
             def fwd(params, x):
                 live = FunctionModel(model.module, params, model.input_shape,
                                      model.layer_names, model.name)
-                return live.apply(x, tap=tap)
+                acts = live.apply_taps(x, list(taps))
+                return tuple(acts[t] for t in taps)
 
             self._jit_cache[key] = jax.jit(fwd)
         return self._jit_cache[key]
 
     def transform_schema(self, schema: Schema) -> Schema:
-        schema.require(self.get_or_throw("inputCol"))
+        model = self.get_model()
+        in_map, out_map = self._io_maps(model)
+        for col in in_map.values():
+            schema.require(col)
         out = schema.copy()
-        out.types[self.get_or_throw("outputCol")] = ColType.VECTOR
+        for col in out_map:
+            out.types[col] = ColType.VECTOR
         return out
 
     def transform(self, df: DataFrame) -> DataFrame:
         import jax
 
-        in_col = self.get_or_throw("inputCol")
-        out_col = self.get_or_throw("outputCol")
         model = self.get_model()
-        tap = model.resolve_output(self.get("outputNode"))
-        fwd = self._compiled(tap)
-        batcher = Minibatcher(self.get("batchSize"), bucket=True, dtype=np.float32)
+        in_map, out_map = self._io_maps(model)      # input name -> col, col -> tap
+        in_cols = list(in_map.values())
+        out_cols = list(out_map)
+        taps = tuple(out_map[c] for c in out_cols)
+        # dict-feed unless the map is exactly {primary input: col} — a single
+        # entry naming a SECONDARY input must go through the dict path so
+        # GraphModule validates the incomplete feed instead of silently
+        # binding the column to the primary input
+        multi_in = list(in_map) != model.argument_names()[:1]
+        fwd = self._compiled(taps, multi_in)
+        batcher = Minibatcher(self.get("batchSize"), bucket=True,
+                              dtype=np.float32, preserve_int=True)
 
         params_dev = jax.device_put(model.params)  # resident once (broadcast parity)
 
@@ -117,31 +170,46 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
             params_dev = jax.device_put(params_dev, replicated_sharding(mesh))
 
         def eval_partition(part):
-            n = len(part[in_col])
-            col = np.empty(n, dtype=object)
+            n = len(part[in_cols[0]])
+            cols = {c: np.empty(n, dtype=object) for c in out_cols}
             if n == 0:
-                part[out_col] = col
+                for c in out_cols:
+                    part[c] = cols[c]
                 return part
             # null inputs produce null outputs (CNTKModel emits null rows for
-            # undecodable inputs rather than failing the partition)
-            in_vals = part[in_col]
-            valid_idx = np.array([i for i in range(n) if in_vals[i] is not None],
-                                 dtype=np.int64)
+            # undecodable inputs rather than failing the partition); a row is
+            # valid only if EVERY fed column is non-null
+            valid_idx = np.array(
+                [i for i in range(n)
+                 if all(part[c][i] is not None for c in in_cols)],
+                dtype=np.int64)
             if len(valid_idx) == 0:
-                part[out_col] = col
+                for c in out_cols:
+                    part[c] = cols[c]
                 return part
-            sub = {in_col: in_vals[valid_idx]}
+            sub = {c: part[c][valid_idx] for c in in_cols}
             outs = []
-            for batch in batcher.batches(sub, [in_col]):
-                x = batch.arrays[in_col]
-                if sharding is not None and x.shape[0] % mesh.shape[DATA_AXIS] == 0:
-                    x = jax.device_put(x, sharding)
-                y = np.asarray(fwd(params_dev, x), dtype=np.float32)
-                outs.append(y[: batch.num_valid])
-            full = concat_outputs(outs)
-            for j, i in enumerate(valid_idx):
-                col[i] = full[j]
-            part[out_col] = col
+            for batch in batcher.batches(sub, in_cols):
+                if multi_in:
+                    x = {name: batch.arrays[col] for name, col in in_map.items()}
+                    if sharding is not None \
+                            and batch.size % mesh.shape[DATA_AXIS] == 0:
+                        x = {k: jax.device_put(v, sharding)
+                             for k, v in x.items()}
+                else:
+                    x = batch.arrays[in_cols[0]]
+                    if sharding is not None \
+                            and x.shape[0] % mesh.shape[DATA_AXIS] == 0:
+                        x = jax.device_put(x, sharding)
+                ys = fwd(params_dev, x)
+                outs.append(tuple(np.asarray(y, dtype=np.float32)[: batch.num_valid]
+                                  for y in ys))
+            for ci, c in enumerate(out_cols):
+                full = concat_outputs([o[ci] for o in outs])
+                for j, i in enumerate(valid_idx):
+                    cols[c][i] = full[j]
+            for c in out_cols:
+                part[c] = cols[c]
             return part
 
         return df.map_partitions(eval_partition)

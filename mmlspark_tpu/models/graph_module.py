@@ -62,14 +62,28 @@ class GraphModule(Module):
     def __init__(self, nodes: Sequence[GraphNode], initializers: Dict[str, np.ndarray],
                  input_name: str, output_name: str,
                  input_shape: Tuple[int, ...], name: str = "graph",
-                 compute_dtype: str = "float32"):
+                 compute_dtype: str = "float32",
+                 extra_input_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+                 extra_input_dtypes: Optional[Dict[str, Any]] = None,
+                 input_dtype: Any = np.float32):
         self.nodes = list(nodes)
         self.initializers = {k: np.asarray(v) for k, v in initializers.items()}
         self.input_name = input_name
         self.output_name = output_name
         self.input_shape = tuple(input_shape)  # excludes batch dim, NCHW order for images
+        # secondary graph inputs (multi-input models; feedDict parity):
+        # {tensor_name: per-example shape}, ordered — ARGUMENT_1.. addressing
+        self.extra_input_shapes = {
+            k: tuple(v) for k, v in (extra_input_shapes or {}).items()}
+        self.extra_input_dtypes = {
+            k: np.dtype(v) for k, v in (extra_input_dtypes or {}).items()}
+        self.input_dtype = np.dtype(input_dtype)
         self.name = name
         self.compute_dtype = compute_dtype
+
+    @property
+    def input_names(self) -> List[str]:
+        return [self.input_name] + list(self.extra_input_shapes)
 
     # -- Module contract ----------------------------------------------------
     def init(self, rng, in_shape):
@@ -80,10 +94,23 @@ class GraphModule(Module):
                 f"GraphModule was imported for input shape {self.input_shape}, "
                 f"got {tuple(in_shape)}")
         params = dict(self.initializers)
+        primary_dt = np.dtype(np.int32) if self.input_dtype == np.int64 \
+            else self.input_dtype
+        x: Any = jax.ShapeDtypeStruct((1,) + self.input_shape, primary_dt)
+        if self.extra_input_shapes:
+            # multi-input probe: dynamic (None) secondary dims probed as 1
+            x = {self.input_name: x}
+            for name, shape in self.extra_input_shapes.items():
+                dt = self.extra_input_dtypes.get(name, np.dtype(np.float32))
+                # x64-off JAX: probe int64-declared inputs as int32
+                if dt == np.int64:
+                    dt = np.dtype(np.int32)
+                x[name] = jax.ShapeDtypeStruct(
+                    (1,) + tuple(1 if d is None else d for d in shape), dt)
         out = jax.eval_shape(
             lambda p, x: self.apply(p, x),
             {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in params.items()},
-            jax.ShapeDtypeStruct((1,) + self.input_shape, np.float32))
+            x)
         return params, tuple(out.shape[1:])
 
     def layer_paths(self, prefix: str = "") -> List[str]:
@@ -97,9 +124,21 @@ class GraphModule(Module):
         del train, stats_out  # imported graphs run inference-mode only
         _ensure_ops()
         env: Dict[str, Any] = dict(params)
-        if self.compute_dtype != "float32":
-            x = x.astype(self.compute_dtype)
-        env[self.input_name] = x
+        if isinstance(x, dict):
+            missing = [n for n in self.input_names if n not in x]
+            if missing:
+                raise KeyError(f"graph inputs {missing} not fed "
+                               f"(have {sorted(x)})")
+            for name, arr in x.items():
+                if self.compute_dtype != "float32" and not jnp.issubdtype(
+                        jnp.asarray(arr).dtype, jnp.integer):
+                    arr = arr.astype(self.compute_dtype)
+                env[name] = arr
+        else:
+            if self.compute_dtype != "float32" and not jnp.issubdtype(
+                    jnp.asarray(x).dtype, jnp.integer):
+                x = x.astype(self.compute_dtype)
+            env[self.input_name] = x
         for node in self.nodes:
             fn = _OPS.get(node.op_type)
             if fn is None:

@@ -506,7 +506,26 @@ class FunctionModel:
     data_format: str = "NHWC"
 
     def argument_names(self) -> List[str]:
-        return ["ARGUMENT_0"]
+        """Graph input names (multi-input GraphModules list all of them)."""
+        names = getattr(self.module, "input_names", None)
+        return list(names) if names else ["ARGUMENT_0"]
+
+    def resolve_input(self, node: str) -> str:
+        """Resolve an input spec (``ARGUMENT_i`` or a raw graph input name)
+        to the module's input tensor name. (Reference:
+        SerializableFunction.scala:61-63 ARGUMENT_i addressing.)"""
+        names = self.argument_names()
+        if node.startswith("ARGUMENT_"):
+            suffix = node[len("ARGUMENT_"):]
+            if not suffix.isdigit() or int(suffix) >= len(names):
+                raise KeyError(
+                    f"{node!r}: model has {len(names)} argument(s) ({names}); "
+                    f"valid indices are 0..{len(names) - 1}")
+            return names[int(suffix)]
+        if node in names:
+            return node
+        raise KeyError(f"Unknown input node {node!r}; known: {names} "
+                       f"or ARGUMENT_i")
 
     def output_names(self) -> List[str]:
         return ["OUTPUT_0"] + list(self.layer_names)
@@ -538,3 +557,26 @@ class FunctionModel:
         if tap not in taps_out:
             raise KeyError(f"Tap {tap!r} not produced; known {self.module.layer_paths()[:20]}")
         return taps_out[tap]
+
+    def apply_taps(self, x, taps, train: bool = False):
+        """ONE forward pass fetching several nodes (fetchDict parity —
+        cntk/CNTKModel.scala:204-223 evaluates all fetch variables in a
+        single native eval). ``taps`` is a list of tap paths where ``None``
+        means the final output; returns {tap: activation}."""
+        real = {t for t in taps if t is not None}
+        taps_out: Dict[str, Any] = {}
+        if real:
+            assert getattr(self.module, "is_container", False), \
+                "taps need a container root (Sequential/GraphModule)"
+            out = self.module.apply(self.params, x, train=train, taps=real,
+                                    taps_out=taps_out)
+        else:
+            out = self.module.apply(self.params, x, train=train)
+        missing = real - set(taps_out)
+        if missing:
+            raise KeyError(f"Taps {sorted(missing)} not produced; known "
+                           f"{self.module.layer_paths()[:20]}")
+        result = dict(taps_out)
+        if None in list(taps):
+            result[None] = out
+        return result

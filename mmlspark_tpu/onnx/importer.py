@@ -119,13 +119,23 @@ def import_onnx(path_or_bytes, input_shape: Optional[Sequence[int]] = None,
 
     consts: Dict[str, np.ndarray] = {t.name: t.to_numpy() for t in g.initializers}
     init_names = set(consts)
-    graph_input = None
-    for vi in g.inputs:
-        if vi.name not in init_names:  # old exporters list initializers as inputs too
-            graph_input = vi
-            break
-    if graph_input is None:
+    # old exporters list initializers as inputs too; real inputs are the rest.
+    # The first is the primary (ARGUMENT_0); any others become secondary
+    # inputs fed by dict (DNNModel feedDict parity for multi-input models).
+    real_inputs = [vi for vi in g.inputs if vi.name not in init_names]
+    if not real_inputs:
         raise ValueError("ONNX graph has no non-initializer input")
+    graph_input = real_inputs[0]
+    extra_input_shapes: Dict[str, tuple] = {}
+    extra_input_dtypes: Dict[str, np.dtype] = {}
+    for vi in real_inputs[1:]:
+        # shapes/dtypes are introspection metadata (init()'s shape probe);
+        # dynamic dims stay None — actual shapes arrive with the fed arrays
+        tail = (vi.dims or [])[1:]
+        extra_input_shapes[vi.name] = tuple(
+            int(d) if d is not None else None for d in tail)
+        extra_input_dtypes[vi.name] = np.dtype(
+            proto._DT_TO_NP.get(vi.elem_type, np.float32))
     if input_shape is None:
         dims = graph_input.dims or []
         if len(dims) < 1:
@@ -189,7 +199,9 @@ def import_onnx(path_or_bytes, input_shape: Optional[Sequence[int]] = None,
     module = GraphModule(
         graph_nodes, params, input_name=graph_input.name, output_name=output_name,
         input_shape=input_shape, name=name or (g.name or "onnx_model"),
-        compute_dtype=compute_dtype)
+        compute_dtype=compute_dtype, extra_input_shapes=extra_input_shapes,
+        extra_input_dtypes=extra_input_dtypes,
+        input_dtype=proto._DT_TO_NP.get(graph_input.elem_type, np.float32))
 
     if layer_names is None:
         # taps from the head backwards: last nodes producing "cut-worthy" outputs

@@ -132,17 +132,33 @@ class Minibatcher:
     """
 
     def __init__(self, batch_size: int = 32, bucket: bool = True,
-                 dtype=np.float32, pad_value: float = 0.0):
+                 dtype=np.float32, pad_value: float = 0.0,
+                 preserve_int: bool = False):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         self.batch_size = batch_size
         self.bucket = bucket
         self.dtype = dtype
         self.pad_value = pad_value
+        # preserve_int: integer columns keep their dtype instead of casting to
+        # ``dtype`` — token-id inputs must reach embedding Gathers as ints
+        self.preserve_int = preserve_int
+
+    def _col_dtype(self, col):
+        if not self.preserve_int:
+            return self.dtype
+        if getattr(col, "dtype", None) is not None and col.dtype != object:
+            return None if np.issubdtype(col.dtype, np.integer) else self.dtype
+        probe = next((v for v in col if v is not None), None)
+        if probe is not None and np.issubdtype(np.asarray(probe).dtype,
+                                               np.integer):
+            return None
+        return self.dtype
 
     def batches(self, part: Partition, cols: Sequence[str]) -> Iterator[Batch]:
         n = len(next(iter(part.values()))) if part else 0
-        dense = {c: stack_rows(part[c], self.dtype) for c in cols}
+        dense = {c: stack_rows(part[c], self._col_dtype(part[c]))
+                 for c in cols}
         for start in range(0, n, self.batch_size):
             stop = min(start + self.batch_size, n)
             m = stop - start

@@ -134,6 +134,137 @@ class TestDNNModel:
         stage = DNNModel(inputCol="feats", outputCol="out").set_model(m)
         assert stage.transform(df).count() == 0
 
+    def test_fetch_dict_multi_output_one_forward(self):
+        """fetchDict: several output columns, each a different node, all from
+        ONE forward (CNTKModel.scala:215-223)."""
+        m = tiny_mlp()
+        rng = np.random.default_rng(2)
+        rows = [rng.normal(size=4).astype(np.float32) for _ in range(7)]
+        df = DataFrame.from_dict({"feats": rows}, num_partitions=2)
+        stage = (DNNModel(inputCol="feats", batchSize=4).set_model(m)
+                 .set_fetch_dict({"logits": "OUTPUT_0", "hidden": "relu1"}))
+        out = stage.transform(df)
+        logits = np.stack(list(out.column("logits")))
+        hidden = np.stack(list(out.column("hidden")))
+        np.testing.assert_allclose(logits, np.asarray(m.apply(np.stack(rows))),
+                                   atol=1e-4)
+        np.testing.assert_allclose(
+            hidden, np.asarray(m.apply(np.stack(rows), tap="relu1")),
+            atol=1e-4)
+
+    def test_feed_dict_multi_input_graph(self, tmp_path):
+        """feedDict: a two-input ONNX graph fed from two columns
+        (CNTKModel.scala:204-214)."""
+        import mmlspark_tpu.onnx.proto as proto
+        from mmlspark_tpu.onnx import import_onnx
+
+        rng = np.random.default_rng(3)
+        W = rng.normal(size=(4, 3)).astype(np.float32)
+        nodes = [
+            proto.make_node("MatMul", ["a", "W"], ["aw"], name="proj"),
+            proto.make_node("Add", ["aw", "b"], ["out"], name="sum"),
+        ]
+        inits = [proto.make_tensor("W", W)]
+        blob = proto.make_model(
+            nodes, inits,
+            [proto.make_value_info("a", [None, 4]),
+             proto.make_value_info("b", [None, 3])],
+            [proto.make_value_info("out", [None, 3])])
+        p = tmp_path / "two_in.onnx"
+        p.write_bytes(blob)
+        fm = import_onnx(str(p))
+        assert fm.argument_names() == ["a", "b"]
+        assert fm.resolve_input("ARGUMENT_1") == "b"
+
+        a_rows = [rng.normal(size=4).astype(np.float32) for _ in range(6)]
+        b_rows = [rng.normal(size=3).astype(np.float32) for _ in range(6)]
+        df = DataFrame.from_dict({"ca": a_rows, "cb": b_rows},
+                                 num_partitions=2)
+        stage = (DNNModel(outputCol="out", batchSize=4).set_model(fm)
+                 .set_feed_dict({"ARGUMENT_0": "ca", "ARGUMENT_1": "cb"}))
+        got = np.stack(list(stage.transform(df).column("out")))
+        want = np.stack(a_rows) @ W + np.stack(b_rows)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_feed_dict_null_row_any_column(self):
+        """A row is null if ANY fed column is null."""
+        m = tiny_mlp()
+        rows = [np.ones(4, dtype=np.float32), None, np.ones(4, dtype=np.float32)]
+        df = DataFrame.from_dict({"feats": np.array(rows, dtype=object)})
+        stage = DNNModel(inputCol="feats", outputCol="out",
+                         batchSize=2).set_model(m)
+        col = stage.transform(df).column("out")
+        assert col[1] is None and col[0] is not None
+
+    def test_resolve_input_errors(self):
+        m = tiny_mlp()
+        assert m.resolve_input("ARGUMENT_0")  # single-arg models: index 0 ok
+        with pytest.raises(KeyError):
+            m.resolve_input("ARGUMENT_3")
+        with pytest.raises(KeyError):
+            m.resolve_input("ARGUMENT_-1")   # negative must not wrap around
+        with pytest.raises(KeyError):
+            m.resolve_input("ARGUMENT_x")
+        with pytest.raises(KeyError):
+            m.resolve_input("nonexistent_input")
+
+    def _two_input_token_model(self, tmp_path):
+        """Embedding-style graph: int token ids Gather + float bias add."""
+        import mmlspark_tpu.onnx.proto as proto
+        from mmlspark_tpu.onnx import import_onnx
+
+        rng = np.random.default_rng(5)
+        table = rng.normal(size=(16, 3)).astype(np.float32)
+        nodes = [
+            proto.make_node("Gather", ["table", "ids"], ["emb"], name="embed",
+                            axis=0),
+            proto.make_node("ReduceMean", ["emb"], ["pooled"], name="pool",
+                            axes=[1], keepdims=0),
+            proto.make_node("Add", ["pooled", "bias"], ["out"], name="sum"),
+        ]
+        inits = [proto.make_tensor("table", table)]
+        blob = proto.make_model(
+            nodes, inits,
+            [proto.make_value_info("ids", [None, 5],
+                                   elem_type=proto.DT_INT32),
+             proto.make_value_info("bias", [None, 3])],
+            [proto.make_value_info("out", [None, 3])])
+        p = tmp_path / "tok.onnx"
+        p.write_bytes(blob)
+        return import_onnx(str(p), input_shape=(5,)), table
+
+    def test_feed_dict_integer_tokens_preserved(self, tmp_path):
+        """Token-id columns must reach embedding Gathers as INTEGERS — the
+        batcher preserves int dtypes instead of casting to f32."""
+        fm, table = self._two_input_token_model(tmp_path)
+        rng = np.random.default_rng(6)
+        ids = [rng.integers(0, 16, size=5).astype(np.int32) for _ in range(5)]
+        bias = [rng.normal(size=3).astype(np.float32) for _ in range(5)]
+        df = DataFrame.from_dict({"ids": ids, "bias": bias})
+        stage = (DNNModel(outputCol="out", batchSize=3).set_model(fm)
+                 .set_feed_dict({"ids": "ids", "bias": "bias"}))
+        got = np.stack(list(stage.transform(df).column("out")))
+        want = table[np.stack(ids)].mean(axis=1) + np.stack(bias)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_single_entry_feed_dict_secondary_input_validates(self, tmp_path):
+        """A single-entry feedDict naming a SECONDARY input must fail with
+        the missing-inputs validation, not silently bind to the primary."""
+        fm, _ = self._two_input_token_model(tmp_path)
+        df = DataFrame.from_dict(
+            {"bias": [np.zeros(3, dtype=np.float32)] * 2})
+        stage = (DNNModel(outputCol="out", batchSize=2).set_model(fm)
+                 .set_feed_dict("ARGUMENT_1", "bias"))
+        with pytest.raises(KeyError, match="not fed"):
+            stage.transform(df).column("out")
+
+    def test_multi_input_graph_init_probe(self, tmp_path):
+        fm, _ = self._two_input_token_model(tmp_path)
+        import jax
+
+        params, out_shape = fm.module.init(jax.random.key(0), (5,))
+        assert out_shape == (3,)
+
 
 class TestImageOps:
     def test_resize_identity(self):
