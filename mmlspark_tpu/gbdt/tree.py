@@ -16,12 +16,13 @@ Two growth paths, identical semantics:
   and one host fetch per TREE; the old per-split orchestration cost ~31
   blocking round trips per tree and was dispatch-bound end-to-end
   (BENCH_gbdt_train.json).
+  Row-sharded (multi-chip) inputs take the same fused path per shard under
+  ``shard_map`` with psum'd histograms — replicated split decisions, sharded
+  row routing (LightGBM's socket-ring allreduce as one collective stream).
 - **Host-orchestrated**: one fused dispatch per split (histogram.py kernels
-  with static shapes). Kept for row-sharded (multi-chip) inputs — whose
-  histogram needs the per-shard Pallas kernel + psum under shard_map — and as
-  the fallback when the per-node histogram buffer would exceed the memory
-  budget (MMLSPARK_TPU_FUSED_TREE_BYTES, or MMLSPARK_TPU_NO_FUSED_TREE=1 to
-  force it off).
+  with static shapes). The fallback when the per-node histogram buffer would
+  exceed the memory budget (MMLSPARK_TPU_FUSED_TREE_BYTES), on CPU (cheap
+  in-process dispatch), or when MMLSPARK_TPU_NO_FUSED_TREE=1 forces it.
 
 Trees are stored as flat arrays (SoA) for vectorized prediction: no pointer
 chasing, predict is a gather loop over depth (predict_trees in booster.py).
@@ -33,7 +34,7 @@ import dataclasses
 import functools
 import heapq
 import os
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -136,15 +137,13 @@ class _Node:
         self.split = split    # SplitInfo (host numpy) or None
 
 
-@functools.partial(
-    __import__("jax").jit,
-    static_argnames=("num_bins", "max_nodes", "min_data_in_leaf", "max_depth",
-                     "use_mxu", "has_feature_mask"))
-def _grow_tree_device(bins, grad, hess, row_mask, node_of_row,
-                      lambda_l1, lambda_l2, min_sum_hessian, min_gain_to_split,
-                      feature_mask, *, num_bins: int, max_nodes: int,
-                      min_data_in_leaf: int, max_depth: int,
-                      use_mxu: bool, has_feature_mask: bool):
+def _grow_tree_device_body(bins, grad, hess, row_mask, node_of_row,
+                           lambda_l1, lambda_l2, min_sum_hessian,
+                           min_gain_to_split, feature_mask, *, num_bins: int,
+                           max_nodes: int, min_data_in_leaf: int,
+                           max_depth: int, use_mxu: bool,
+                           has_feature_mask: bool, psum_axis=None,
+                           interpret: bool = False):
     """Grow one whole tree inside a single jitted ``lax.while_loop``.
 
     The best-first heap becomes an argmax over ``cand_gain`` (−inf marks
@@ -159,14 +158,28 @@ def _grow_tree_device(bins, grad, hess, row_mask, node_of_row,
     Returns flat node arrays sized ``max_nodes`` (= 2*num_leaves−1), the
     per-node (grad, hess, count) sums for host-side f64 leaf values, the final
     row→node routing, and ``n_nodes``. One dispatch, one fetch, per tree.
+
+    ``psum_axis``: when set, this body is running per-shard under shard_map
+    with rows split over that mesh axis — every histogram/total is psum'd so
+    all shards make identical (replicated) split decisions while the row
+    routing stays sharded. This is LightGBM's socket-ring data-parallel mode
+    as one collective (TrainUtils.scala:383-418).
     """
     import jax
     import jax.numpy as jnp
 
     if use_mxu:
-        from .pallas_hist import compute_histogram_mxu as hist_fn
+        from .pallas_hist import compute_histogram_mxu
+
+        def base_hist(b, g, h, m, nb):
+            return compute_histogram_mxu(b, g, h, m, nb, interpret=interpret)
     else:
-        hist_fn = H.compute_histogram_xla
+        base_hist = H.compute_histogram_xla
+    if psum_axis is None:
+        hist_fn = base_hist
+    else:
+        def hist_fn(b, g, h, m, nb):
+            return jax.lax.psum(base_hist(b, g, h, m, nb), psum_axis)
 
     fm = feature_mask if has_feature_mask else None
     neg_inf = jnp.float32(-jnp.inf)
@@ -179,6 +192,8 @@ def _grow_tree_device(bins, grad, hess, row_mask, node_of_row,
 
     root_hist = hist_fn(bins, grad, hess, row_mask, num_bins)
     root_sums = H.total_sums(grad, hess, row_mask)
+    if psum_axis is not None:
+        root_sums = jax.lax.psum(root_sums, psum_axis)
     s0 = best(root_hist)
     # host parity: the root is pushed without the 2*min_data_in_leaf check
     # (find_best_split already enforces per-side constraints), and the
@@ -282,29 +297,115 @@ def _grow_tree_device(bins, grad, hess, row_mask, node_of_row,
         "right", "gain", "sums", "n_nodes")}
 
 
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("num_bins", "max_nodes", "min_data_in_leaf", "max_depth",
+                     "use_mxu", "has_feature_mask"))
+def _grow_tree_device(bins, grad, hess, row_mask, node_of_row,
+                      lambda_l1, lambda_l2, min_sum_hessian, min_gain_to_split,
+                      feature_mask, *, num_bins: int, max_nodes: int,
+                      min_data_in_leaf: int, max_depth: int,
+                      use_mxu: bool, has_feature_mask: bool):
+    return _grow_tree_device_body(
+        bins, grad, hess, row_mask, node_of_row, lambda_l1, lambda_l2,
+        min_sum_hessian, min_gain_to_split, feature_mask, num_bins=num_bins,
+        max_nodes=max_nodes, min_data_in_leaf=min_data_in_leaf,
+        max_depth=max_depth, use_mxu=use_mxu,
+        has_feature_mask=has_feature_mask)
+
+
+_SHARDED_GROW_CACHE: Dict[Tuple, Any] = {}
+
+
+def _grow_tree_device_sharded(bins, grad, hess, row_mask, node_of_row,
+                              lambda_l1, lambda_l2, min_sum_hessian,
+                              min_gain_to_split, feature_mask, *,
+                              num_bins: int, max_nodes: int,
+                              min_data_in_leaf: int, max_depth: int,
+                              has_feature_mask: bool):
+    """Row-sharded whole-tree growth: the while_loop runs per shard under
+    shard_map with psum'd histograms/totals, so every shard takes identical
+    split decisions (replicated tree arrays) while ``node_of_row`` stays
+    sharded. One dispatch + one collective stream per tree instead of
+    one host round trip per split."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from . import pallas_hist
+
+    sh = bins.sharding
+    mesh, row_axes = sh.mesh, sh.spec[0]
+    # MMLSPARK_TPU_PALLAS_INTERPRET=1: run the MXU kernel in interpreter mode
+    # (CPU tests of the psum'd-Pallas branch production TPU meshes take)
+    interpret = os.environ.get("MMLSPARK_TPU_PALLAS_INTERPRET",
+                               "") not in ("", "0")
+    use_mxu = pallas_hist.use_pallas() or interpret
+    key = (mesh, row_axes, num_bins, max_nodes, min_data_in_leaf, max_depth,
+           has_feature_mask, use_mxu, interpret)
+    if key not in _SHARDED_GROW_CACHE:
+        if len(_SHARDED_GROW_CACHE) >= 16:  # bound compiled-program memory
+            _SHARDED_GROW_CACHE.pop(next(iter(_SHARDED_GROW_CACHE)))
+        row_spec = P(row_axes)
+        rep = P()
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(sh.spec, row_spec, row_spec, row_spec, row_spec,
+                      rep, rep, rep, rep, rep),
+            out_specs={"node_of_row": row_spec, "feature": rep,
+                       "threshold_bin": rep, "default_left": rep, "left": rep,
+                       "right": rep, "gain": rep, "sums": rep, "n_nodes": rep},
+            check_vma=False)  # pallas_call can't declare varying-mesh-axes
+        def go(b, g, h, m, rows, l1, l2, msh, mgs, fm):
+            return _grow_tree_device_body(
+                b, g, h, m, rows, l1, l2, msh, mgs, fm, num_bins=num_bins,
+                max_nodes=max_nodes, min_data_in_leaf=min_data_in_leaf,
+                max_depth=max_depth, use_mxu=use_mxu,
+                has_feature_mask=has_feature_mask, psum_axis=row_axes,
+                interpret=interpret)
+
+        _SHARDED_GROW_CACHE[key] = jax.jit(go)
+    return _SHARDED_GROW_CACHE[key](
+        bins, grad, hess, row_mask, node_of_row,
+        np.float32(lambda_l1), np.float32(lambda_l2),
+        np.float32(min_sum_hessian), np.float32(min_gain_to_split),
+        feature_mask)
+
+
 def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
                      config: GrowerConfig, bin_mapper, feature_mask,
-                     node_of_row, device_rows: bool = False
-                     ) -> Tuple[Tree, np.ndarray]:
+                     node_of_row, device_rows: bool = False,
+                     row_sharded: bool = False) -> Tuple[Tree, np.ndarray]:
     """Host wrapper for the one-dispatch-per-tree device grower.
 
     ``device_rows``: return the row→leaf routing as the device array instead
     of fetching it (the booster's on-device score update wants it resident).
+    ``row_sharded``: rows are split over a mesh axis — use the shard_map
+    variant with psum'd histograms.
     """
     import jax
 
     from . import pallas_hist
 
-    dev_out = _grow_tree_device(
-        bins_dev, grad, hess, row_mask, node_of_row,
-        np.float32(config.lambda_l1), np.float32(config.lambda_l2),
-        np.float32(config.min_sum_hessian_in_leaf),
-        np.float32(config.min_gain_to_split),
-        feature_mask if feature_mask is not None else np.zeros(0, dtype=bool),
+    fm = feature_mask if feature_mask is not None else np.zeros(0, dtype=bool)
+    common = dict(
         num_bins=num_bins, max_nodes=2 * config.num_leaves - 1,
         min_data_in_leaf=config.min_data_in_leaf, max_depth=config.max_depth,
-        use_mxu=pallas_hist.use_mxu_single_device(bins_dev),
         has_feature_mask=feature_mask is not None)
+    if row_sharded:
+        dev_out = _grow_tree_device_sharded(
+            bins_dev, grad, hess, row_mask, node_of_row,
+            config.lambda_l1, config.lambda_l2,
+            config.min_sum_hessian_in_leaf, config.min_gain_to_split,
+            fm, **common)
+    else:
+        dev_out = _grow_tree_device(
+            bins_dev, grad, hess, row_mask, node_of_row,
+            np.float32(config.lambda_l1), np.float32(config.lambda_l2),
+            np.float32(config.min_sum_hessian_in_leaf),
+            np.float32(config.min_gain_to_split), fm,
+            use_mxu=pallas_hist.use_mxu_single_device(bins_dev), **common)
     rows_dev = dev_out.pop("node_of_row")
     out = jax.device_get(dev_out)
 
@@ -360,19 +461,21 @@ def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
     if node_of_row is None:
         node_of_row = jnp.zeros(n, dtype=jnp.int32)
 
-    # routing, decided ONCE (invariant over the loop): row-sharded inputs keep
-    # the multi-call path whose compute_histogram dispatch runs the per-shard
-    # Pallas kernel + psum (the in-jit XLA scatter both loses ~13x and can OOM
-    # at large N); everything else grows the WHOLE tree in one device dispatch
-    # (unless the per-node histogram buffer would blow the memory budget).
+    # routing, decided ONCE (invariant over the loop): the default on
+    # accelerators grows the WHOLE tree in one device dispatch — per-shard
+    # under shard_map with psum'd histograms when rows are sharded over a
+    # mesh axis, plain when single-device. Fallback (memory budget exceeded
+    # or MMLSPARK_TPU_NO_FUSED_TREE=1): host-orchestrated per-split calls,
+    # whose compute_histogram dispatch runs the per-shard Pallas kernel +
+    # psum for sharded inputs.
     row_sharded = bool(pallas_hist._row_sharded_spec(bins_dev))
     use_mxu = pallas_hist.use_mxu_single_device(bins_dev)
 
-    if not row_sharded and _fused_tree_enabled(
-            2 * config.num_leaves - 1, num_f, num_bins):
+    if _fused_tree_enabled(2 * config.num_leaves - 1, num_f, num_bins):
         return _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins,
                                 config, bin_mapper, feature_mask, node_of_row,
-                                device_rows=device_rows)
+                                device_rows=device_rows,
+                                row_sharded=row_sharded)
 
     # growable node storage (host lists; frozen to arrays at the end)
     feature = [-1]
