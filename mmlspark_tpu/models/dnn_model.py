@@ -189,6 +189,17 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
                 return part
             sub = {c: part[c][valid_idx] for c in in_cols}
             outs = []
+            # pipelined dispatch: keep up to 2 batches in flight so the next
+            # batch's H2D + compute overlaps the previous fetch (jax dispatch
+            # is async; only the np.asarray readback blocks). The per-row JNI
+            # loop this replaces was fully serial (CNTKModel.scala:129-136).
+            in_flight: list = []
+
+            def drain_one():
+                ys, num_valid = in_flight.pop(0)
+                outs.append(tuple(
+                    np.asarray(y, dtype=np.float32)[:num_valid] for y in ys))
+
             for batch in batcher.batches(sub, in_cols):
                 if multi_in:
                     x = {name: batch.arrays[col] for name, col in in_map.items()}
@@ -201,9 +212,11 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
                     if sharding is not None \
                             and x.shape[0] % mesh.shape[DATA_AXIS] == 0:
                         x = jax.device_put(x, sharding)
-                ys = fwd(params_dev, x)
-                outs.append(tuple(np.asarray(y, dtype=np.float32)[: batch.num_valid]
-                                  for y in ys))
+                in_flight.append((fwd(params_dev, x), batch.num_valid))
+                if len(in_flight) >= 2:
+                    drain_one()
+            while in_flight:
+                drain_one()
             for ci, c in enumerate(out_cols):
                 full = concat_outputs([o[ci] for o in outs])
                 for j, i in enumerate(valid_idx):
