@@ -19,6 +19,7 @@ Design choices vs Spark:
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -314,9 +315,37 @@ class DataFrame:
             parts.append(merged)
         return DataFrame(parts, self._schema.copy())
 
-    def map_partitions(self, fn: Callable[[Partition], Partition]) -> "DataFrame":
-        """THE distribution primitive (reference: df.mapPartitions everywhere, SURVEY §1)."""
-        return self._carry_meta(DataFrame([fn(dict(p)) for p in self._partitions]))
+    def map_partitions(self, fn: Callable[[Partition], Partition],
+                       retries: Optional[int] = None) -> "DataFrame":
+        """THE distribution primitive (reference: df.mapPartitions everywhere, SURVEY §1).
+
+        ``retries``: re-run ``fn`` on a fresh copy of a partition that raised —
+        the recovery story Spark's task retry gave the reference for free
+        (default spark.task.maxFailures=4 attempts; here default 0, or the
+        MMLSPARK_TPU_TASK_RETRIES env). Each attempt receives a fresh dict, so
+        column REBINDING never leaks between attempts (in-place ndarray writes
+        would — treat partition arrays as immutable, as stage code here does);
+        the last failure re-raises with the partition index attached.
+        """
+        if retries is None:
+            retries = int(os.environ.get("MMLSPARK_TPU_TASK_RETRIES", "0"))
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        out = []
+        for pi, p in enumerate(self._partitions):
+            for attempt in range(retries + 1):
+                try:
+                    out.append(fn(dict(p)))
+                    break
+                except Exception as e:
+                    if attempt >= retries:
+                        # the ORIGINAL exception propagates (type, attrs,
+                        # errno, args all intact); the partition context
+                        # rides along as a note
+                        e.add_note(f"[map_partitions] partition {pi} failed "
+                                   f"after {attempt + 1} attempt(s)")
+                        raise
+        return self._carry_meta(DataFrame(out))
 
     def partition_by_key(self, key: str, n: Optional[int] = None) -> "DataFrame":
         """Hash-partition rows by a key column (shuffle)."""

@@ -133,6 +133,53 @@ class TestDataFrame:
         df2 = df.map_partitions(lambda p: {"n": p["numbers"] + 1})
         np.testing.assert_array_equal(df2.column("n"), np.arange(1, 7, dtype=np.float64))
 
+    def test_map_partitions_retries_flaky_task(self):
+        """Spark task-retry parity: a transiently failing partition fn is
+        re-run on a fresh copy of the partition."""
+        df = DataFrame.from_dict({"x": np.arange(8.0)}, num_partitions=2)
+        fails = {"left": 2}
+
+        def flaky(p):
+            p["x"] = p["x"] + 1  # mutation must not leak into the retry
+            if fails["left"] > 0:
+                fails["left"] -= 1
+                raise OSError("transient")
+            return p
+
+        out = df.map_partitions(flaky, retries=2)
+        np.testing.assert_array_equal(out.column("x"), np.arange(1.0, 9.0))
+
+    def test_map_partitions_retry_exhaustion_keeps_original(self):
+        df = DataFrame.from_dict({"x": np.arange(4.0)}, num_partitions=1)
+
+        def always(p):
+            raise OSError(2, "No such file")
+
+        with pytest.raises(OSError) as ei:
+            df.map_partitions(always, retries=2)
+        # ORIGINAL exception object: attributes intact, context as a note
+        assert ei.value.errno == 2
+        assert any("partition 0 failed after 3" in n
+                   for n in getattr(ei.value, "__notes__", []))
+
+    def test_map_partitions_negative_retries_raises(self):
+        df = DataFrame.from_dict({"x": np.arange(4.0)}, num_partitions=1)
+        with pytest.raises(ValueError, match="retries"):
+            df.map_partitions(lambda p: p, retries=-1)
+
+    def test_map_partitions_retries_env_default(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_TASK_RETRIES", "1")
+        df = DataFrame.from_dict({"x": np.arange(4.0)}, num_partitions=1)
+        fails = {"left": 1}
+
+        def flaky(p):
+            if fails["left"] > 0:
+                fails["left"] -= 1
+                raise OSError("transient")
+            return p
+
+        assert df.map_partitions(flaky).count() == 4
+
     def test_random_split(self):
         df = DataFrame.from_dict({"x": np.arange(1000.0)}, num_partitions=3)
         a, b = df.random_split([0.8, 0.2], seed=1)
