@@ -1,0 +1,100 @@
+"""REAL multi-process distributed tests: two OS processes, jax.distributed
+rendezvous, gloo collectives over the inter-process (DCN-stand-in) link.
+
+This is the multi-host story the reference implements with a driver-socket
+rendezvous + native comm rings (LightGBMUtils.scala:105-173, VW spanning
+tree): here `make_mesh` bootstraps `jax.distributed` from MMLSPARK_* env
+vars and XLA collectives span the processes. The 8-device virtual-CPU mesh
+used everywhere else in the suite exercises multi-DEVICE semantics in one
+process; this file proves the multi-PROCESS layer (coordinator rendezvous,
+cross-process collectives, per-process input sharding) actually works.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = r"""
+import os, sys
+pid = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["MMLSPARK_COORDINATOR"] = f"localhost:{port}"
+os.environ["MMLSPARK_NUM_PROCESSES"] = "2"
+os.environ["MMLSPARK_PROCESS_ID"] = str(pid)
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh, process_shard
+
+# 1. mesh construction bootstraps jax.distributed from the env
+mesh = make_mesh(MeshSpec(data=8))
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert jax.local_device_count() == 4
+
+# 2. cross-process collective: global sum of a row-sharded array
+x_global = np.arange(8.0, dtype=np.float32)
+sharding = NamedSharding(mesh, P("data"))
+off = jax.process_index() * 4
+arrs = [jax.device_put(x_global[off + i:off + i + 1], d)
+        for i, d in enumerate(mesh.local_devices)]
+x = jax.make_array_from_single_device_arrays((8,), sharding, arrs)
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+got = float(np.asarray(total.addressable_data(0)))
+assert got == 28.0, got
+
+# 3. per-process input sharding: round-robin partitions
+df = DataFrame.from_dict({"v": np.arange(12.0)}, num_partitions=4)
+mine = process_shard(df)
+assert mine.num_partitions == 2, mine.num_partitions
+local_sum = float(np.sum(mine.column("v")))
+
+# 4. the local sums from (3) recombine across processes (allgather)
+from jax.experimental import multihost_utils
+all_sums = multihost_utils.process_allgather(np.float32(local_sum))
+assert float(np.sum(all_sums)) == 66.0, all_sums  # sum(0..11)
+
+print(f"WORKER {pid} OK", flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_mesh_collectives_and_input_sharding(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER.replace("{repo!r}", repr(str(REPO))))
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("MMLSPARK_", "XLA_", "JAX_"))}
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"WORKER {pid} OK" in out
