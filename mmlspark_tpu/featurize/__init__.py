@@ -8,13 +8,14 @@ PageSplitter).
 
 from .indexers import IndexToValue, ValueIndexer, ValueIndexerModel
 from .clean import CleanMissingData, CleanMissingDataModel, DataConversion
-from .assemble import AssembleFeatures, Featurize
+from .assemble import AssembleFeatures, FastVectorAssembler, Featurize
 from .text import MultiNGram, PageSplitter, TextFeaturizer, TextFeaturizerModel
 from .word2vec import Word2Vec, Word2VecModel
 
 __all__ = [
     "AssembleFeatures", "CleanMissingData", "CleanMissingDataModel",
-    "DataConversion", "Featurize", "IndexToValue", "MultiNGram", "PageSplitter",
+    "DataConversion", "FastVectorAssembler", "Featurize", "IndexToValue",
+    "MultiNGram", "PageSplitter",
     "TextFeaturizer", "TextFeaturizerModel", "ValueIndexer", "ValueIndexerModel",
     "Word2Vec", "Word2VecModel",
 ]

@@ -16,9 +16,51 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, HasInputCols, HasOutputCol, Param
-from ..core.pipeline import Estimator, Model
+from ..core.pipeline import Estimator, Model, Transformer
 from ..core.schema import ColType, Schema
 from ..ops.hashing import hash_string
+
+
+class FastVectorAssembler(Transformer, HasInputCols, HasOutputCol):
+    """Concatenate numeric/vector columns into one dense vector per row,
+    skipping per-slot metadata bookkeeping — the reference's metadata-light
+    VectorAssembler replacement (org/apache/spark/ml/feature/
+    FastVectorAssembler.scala:1-151). Null scalars become NaN; null vectors
+    raise (their width is unknowable row-locally, same as the reference)."""
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("outputCol", "features")
+        super().__init__(**kwargs)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_cols = list(self.get_or_throw("inputCols"))
+        out_col = self.get_or_throw("outputCol")
+
+        def fn(p):
+            n = len(next(iter(p.values()))) if p else 0
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                parts = []
+                for c in in_cols:
+                    v = p[c][i]
+                    if v is None:
+                        parts.append(np.array([np.nan]))
+                    elif isinstance(v, (np.ndarray, list, tuple)):
+                        arr = np.asarray(v, dtype=np.float64).ravel()
+                        parts.append(arr)
+                    else:
+                        parts.append(np.array([float(v)], dtype=np.float64))
+                out[i] = np.concatenate(parts)
+            return out
+
+        return df.with_column(out_col, fn)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        for c in self.get_or_throw("inputCols"):
+            schema.require(c)
+        out = schema.copy()
+        out.types[self.get_or_throw("outputCol")] = ColType.VECTOR
+        return out
 
 
 class AssembleFeatures(Estimator, HasInputCols, HasOutputCol):

@@ -110,6 +110,23 @@ class TestAssemble:
         out = model.transform(df)
         assert out.column("feats")[0].shape == (4,)
 
+    def test_fast_vector_assembler(self):
+        from mmlspark_tpu.featurize import FastVectorAssembler
+
+        df = DataFrame.from_dict({
+            "a": np.array([1.0, None, 3.0], dtype=object),
+            "v": [np.array([4.0, 5.0]), np.array([6.0, 7.0]),
+                  np.array([8.0, 9.0])],
+            "b": np.array([10.0, 11.0, 12.0]),
+        })
+        out = FastVectorAssembler(inputCols=["a", "v", "b"],
+                                  outputCol="f").transform(df)
+        vecs = list(out.column("f"))
+        np.testing.assert_allclose(vecs[0], [1.0, 4.0, 5.0, 10.0])
+        assert np.isnan(vecs[1][0])  # null scalar -> NaN slot
+        np.testing.assert_allclose(vecs[1][1:], [6.0, 7.0, 11.0])
+        np.testing.assert_allclose(vecs[2], [3.0, 8.0, 9.0, 12.0])
+
 
 class TestTextFeaturizer:
     def docs(self):

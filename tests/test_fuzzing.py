@@ -311,6 +311,19 @@ def _data_conversion():
                       transform_df=df)
 
 
+@fixture("FastVectorAssembler")
+def _fast_vector_assembler():
+    from mmlspark_tpu.featurize import FastVectorAssembler
+    rng = np.random.default_rng(4)
+    df = DataFrame.from_dict({
+        "a": rng.normal(size=20),
+        "v": [rng.normal(size=3) for _ in range(20)],
+    }, num_partitions=2)
+    return TestObject(
+        FastVectorAssembler(inputCols=["a", "v"], outputCol="features"),
+        transform_df=df)
+
+
 @fixture("AssembleFeatures", covers=("AssembleFeaturesModel",))
 def _assemble_features():
     from mmlspark_tpu.featurize import AssembleFeatures
