@@ -54,6 +54,11 @@ class TrainParams:
     top_rate: float = 0.2                  # goss
     other_rate: float = 0.1                # goss
     categorical_feature: Tuple[int, ...] = ()
+    # tree_learner parity (LightGBMParams.scala:13-18). Both values run the
+    # exact psum'd-histogram algorithm: voting_parallel is LightGBM's lossy
+    # bandwidth optimization for slow networks; exact histograms over ICI
+    # strictly dominate (same or better splits at no extra cost here).
+    parallelism: str = "data_parallel"
     metric: str = ""                       # default chosen by objective
     verbosity: int = -1
     seed: int = 0

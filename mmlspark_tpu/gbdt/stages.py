@@ -78,6 +78,15 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
     useBarrierExecutionMode = Param("useBarrierExecutionMode",
                                     "Gang scheduling (inherent on TPU; parity no-op)",
                                     False, ptype=bool)
+    parallelism = Param("parallelism",
+                        "Tree-learner parallelism (LightGBMParams.scala:13-18): "
+                        "data_parallel or voting_parallel. Both run the EXACT "
+                        "psum'd-histogram algorithm here — voting_parallel is "
+                        "LightGBM's bandwidth approximation for slow networks, "
+                        "and exact histograms over ICI collectives strictly "
+                        "dominate it (same or better splits, no extra cost)",
+                        "data_parallel",
+                        lambda v: v in ("data_parallel", "voting_parallel"), str)
     numWorkers = Param("numWorkers", "Worker/shard count override (0 = auto)", 0,
                        ptype=int)
 
@@ -107,6 +116,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             top_rate=self.get("topRate"),
             other_rate=self.get("otherRate"),
             categorical_feature=tuple(self.get("categoricalSlotIndexes") or ()),
+            parallelism=self.get("parallelism"),
             seed=self.get("seed"),
         )
 

@@ -617,6 +617,24 @@ class TestDistributed:
         p = booster.predict_proba(X)[:, 1]
         assert np.mean((p > 0.5) == y) > 0.88
 
+    def test_parallelism_param_parity(self, mesh8):
+        """tree_learner parity: voting_parallel is accepted and runs the
+        exact data-parallel algorithm (which strictly dominates the voting
+        approximation); invalid values are rejected."""
+        X, y = synth_binary(300)
+        df = feature_df(X, y)
+        model = LightGBMClassifier(numIterations=6, numLeaves=7,
+                                   minDataInLeaf=5,
+                                   parallelism="voting_parallel").fit(df)
+        pred = model.transform(df).column("prediction")
+        assert np.mean(pred == y) > 0.85
+        assert model.booster.params.parallelism == "voting_parallel"
+        # model-string round trip keeps it; old strings default cleanly
+        b2 = Booster.from_string(model.booster.to_string())
+        assert b2.params.parallelism == "voting_parallel"
+        with pytest.raises(Exception):
+            LightGBMClassifier(parallelism="tree_parallel")
+
     def test_stage_uses_default_mesh(self, mesh8):
         from mmlspark_tpu.parallel.mesh import MeshContext
         MeshContext.set(mesh8)
