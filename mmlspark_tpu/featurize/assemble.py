@@ -170,11 +170,38 @@ class AssembleFeaturesModel(Model, HasInputCols, HasOutputCol):
                 out[i] = full[i]
             return out
 
-        return df.with_column(out_col, fn)
+        res = df.with_column(out_col, fn)
+        names = self.slot_names()
+        if names is not None:
+            res.schema.meta(out_col)["slot_names"] = names
+        return res
+
+    def slot_names(self) -> Optional[List[str]]:
+        """Per-slot names of the assembled vector (the reference keeps these
+        in Spark ML column metadata; consumers like categoricalSlotNames
+        resolve against them). None when a block has no stable naming or the
+        vector is too wide to enumerate."""
+        names: List[str] = []
+        for enc in self.get_or_throw("encoders"):
+            c, kind = enc["col"], enc["kind"]
+            if kind == "numeric":
+                names.append(c)
+            elif kind == "onehot":
+                names.extend(f"{c}_{lv}" for lv in enc["levels"])
+            elif kind == "hash":
+                names.append(c)
+            elif kind in ("vector", "sparse"):
+                if enc["dim"] > 10_000:
+                    return None
+                names.extend(f"{c}_{i}" for i in range(enc["dim"]))
+        return names
 
     def transform_schema(self, schema: Schema) -> Schema:
         out = schema.copy()
         out.types[self.get_or_throw("outputCol")] = ColType.VECTOR
+        names = self.slot_names()
+        if names is not None:
+            out.meta(self.get_or_throw("outputCol"))["slot_names"] = names
         return out
 
 

@@ -47,9 +47,14 @@ class BinMapper:
     @staticmethod
     def fit(X: np.ndarray, max_bin: int = 255,
             categorical_indexes: Sequence[int] = (),
-            sample_cnt: int = 200_000, seed: int = 0) -> "BinMapper":
+            sample_cnt: int = 200_000, seed: int = 0,
+            max_bin_by_feature: Sequence[int] = ()) -> "BinMapper":
         """Compute quantile edges from (a sample of) the data
-        (LightGBM bin_construct_sample_cnt semantics)."""
+        (LightGBM bin_construct_sample_cnt semantics).
+
+        ``max_bin_by_feature``: per-feature bin counts overriding ``max_bin``
+        outright — in either direction, like LightGBM's max_bin_by_feature
+        (empty = uniform ``max_bin``)."""
         n, num_f = X.shape
         rng = np.random.default_rng(seed)
         if n > sample_cnt:
@@ -58,15 +63,24 @@ class BinMapper:
         else:
             sample = X
         cat = set(categorical_indexes)
+        caps = list(max_bin_by_feature) if max_bin_by_feature else []
+        if caps and len(caps) != num_f:
+            raise ValueError(
+                f"max_bin_by_feature has {len(caps)} entries for {num_f} "
+                f"features")
         edges: List[np.ndarray] = []
         categorical: List[bool] = []
         categories: Dict[int, np.ndarray] = {}
         for f in range(num_f):
+            fmax = int(caps[f]) if caps else max_bin
+            if not 2 <= fmax <= 65535:
+                raise ValueError(
+                    f"max_bin_by_feature[{f}]={fmax} must be in [2, 65535]")
             col = sample[:, f]
             col = col[~np.isnan(col)]
             if f in cat:
                 vals = np.unique(col.astype(np.int64)) if col.size else np.array([0])
-                categories[f] = vals[: max_bin - 1]
+                categories[f] = vals[: fmax - 1]
                 edges.append(np.empty(0))
                 categorical.append(True)
                 continue
@@ -75,11 +89,11 @@ class BinMapper:
             if len(uniq) <= 1:
                 edges.append(np.empty(0))
                 continue
-            if len(uniq) <= max_bin - 1:
+            if len(uniq) <= fmax - 1:
                 # one bin per distinct value: edges at midpoints
                 e = (uniq[:-1] + uniq[1:]) / 2.0
             else:
-                qs = np.linspace(0, 1, max_bin)[1:-1]
+                qs = np.linspace(0, 1, fmax)[1:-1]
                 e = np.unique(np.quantile(col, qs))
             edges.append(e.astype(np.float64))
         return BinMapper(edges, categorical, categories, max_bin)

@@ -59,6 +59,13 @@ class TrainParams:
     # bandwidth optimization for slow networks; exact histograms over ICI
     # strictly dominate (same or better splits at no extra cost here).
     parallelism: str = "data_parallel"
+    max_delta_step: float = 0.0            # clamp |leaf value| (0 = off)
+    pos_bagging_fraction: float = 1.0      # binary class-aware bagging
+    neg_bagging_fraction: float = 1.0
+    max_bin_by_feature: Tuple[int, ...] = ()
+    # log the TRAIN metric every iteration (isProvideTrainingMetric,
+    # TrainUtils.scala:194-230) — also when a validation set is present
+    train_metric: bool = False
     metric: str = ""                       # default chosen by objective
     verbosity: int = -1
     seed: int = 0
@@ -441,6 +448,7 @@ class Booster:
         assert d.get("format") == MODEL_FORMAT, f"bad model format {d.get('format')}"
         p = d["params"]
         p["categorical_feature"] = tuple(p.get("categorical_feature", ()))
+        p["max_bin_by_feature"] = tuple(p.get("max_bin_by_feature", ()))
         params = TrainParams(**p)
         return Booster(
             params,
@@ -518,7 +526,8 @@ def train(params: TrainParams,
         mapper = init_model.bin_mapper
     else:
         mapper = BinMapper.fit(X[:n_real], params.max_bin,
-                               params.categorical_feature, seed=params.seed)
+                               params.categorical_feature, seed=params.seed,
+                               max_bin_by_feature=params.max_bin_by_feature)
     bins = mapper.transform(X)
     # the mapper (possibly inherited from init_model with a different max_bin)
     # is the sole authority on bin count — mixing in params.max_bin would corrupt
@@ -572,7 +581,8 @@ def train(params: TrainParams,
         min_data_in_leaf=params.min_data_in_leaf,
         min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
         min_gain_to_split=params.min_gain_to_split,
-        lambda_l1=params.lambda_l1, lambda_l2=params.lambda_l2)
+        lambda_l1=params.lambda_l1, lambda_l2=params.lambda_l2,
+        max_delta_step=params.max_delta_step)
 
     is_rf = params.boosting_type == "rf"
     is_dart = params.boosting_type == "dart"
@@ -644,11 +654,23 @@ def train(params: TrainParams,
             amp_dev = jnp.asarray(amp)
             g, h = g * (amp_dev if g.ndim == 1 else amp_dev[:, None]), \
                    h * (amp_dev if h.ndim == 1 else amp_dev[:, None])
-        elif (params.bagging_fraction < 1.0
+        elif ((params.bagging_fraction < 1.0
+               or params.pos_bagging_fraction < 1.0
+               or params.neg_bagging_fraction < 1.0)
               and (is_rf or params.bagging_freq > 0)
               and it % max(params.bagging_freq, 1) == 0):
             # resample every bagging_freq iterations, reuse the subset in between
-            bag_mask = rng.random(n) < params.bagging_fraction
+            if (params.pos_bagging_fraction < 1.0
+                    or params.neg_bagging_fraction < 1.0):
+                # class-aware bagging (binary): per-class keep fractions
+                # (LightGBM pos/neg_bagging_fraction; overrides the uniform
+                # fraction like LightGBM does)
+                pos = np.asarray(y) > 0.5
+                frac = np.where(pos, params.pos_bagging_fraction,
+                                params.neg_bagging_fraction)
+                bag_mask = rng.random(n) < frac
+            else:
+                bag_mask = rng.random(n) < params.bagging_fraction
             row_mask = bag_mask
 
         # ----- feature subsampling
@@ -694,6 +716,13 @@ def train(params: TrainParams,
         booster.trees.append(group)
 
         # ----- eval + early stopping
+        if params.train_metric and log:
+            host_sc = _host_scores()
+            tm = eval_metric(metric, host_sc[:n_real, 0] if k == 1
+                             else host_sc[:n_real],
+                             np.asarray(y[:n_real], dtype=np.float64),
+                             groups[:n_real] if groups is not None else None)
+            log(f"[{it + 1}] train {metric}={tm:.6f}")
         if val_X is not None:
             val_scores = booster.raw_predict(val_X, num_iteration=len(booster.trees))
             m = eval_metric(metric, val_scores, np.asarray(val_y, dtype=np.float64),
@@ -711,7 +740,7 @@ def train(params: TrainParams,
                 if log:
                     log(f"early stopping at iteration {it + 1}, best {best_iter}")
                 break
-        elif log and (it + 1) % 10 == 0:
+        elif log and not params.train_metric and (it + 1) % 10 == 0:
             host_sc = _host_scores()
             train_scores = host_sc[:, 0] if k == 1 else host_sc
             m = eval_metric(metric, train_scores, np.asarray(y, dtype=np.float64),

@@ -89,6 +89,39 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
                         lambda v: v in ("data_parallel", "voting_parallel"), str)
     numWorkers = Param("numWorkers", "Worker/shard count override (0 = auto)", 0,
                        ptype=int)
+    metric = Param("metric", "Eval metric override (auc, binary_logloss, l1, "
+                   "l2, rmse, ndcg, ...; empty = objective default)", "",
+                   ptype=str)
+    isProvideTrainingMetric = Param(
+        "isProvideTrainingMetric",
+        "Log the training metric during fit (TrainUtils.scala:194-230)",
+        False, ptype=bool)
+    maxDeltaStep = Param("maxDeltaStep",
+                         "Clamp on |leaf output| (0 = off; LightGBM "
+                         "max_delta_step, e.g. for poisson stability)",
+                         0.0, ptype=float)
+    posBaggingFraction = Param("posBaggingFraction",
+                               "Positive-class bagging fraction (binary)",
+                               1.0, ptype=float)
+    negBaggingFraction = Param("negBaggingFraction",
+                               "Negative-class bagging fraction (binary)",
+                               1.0, ptype=float)
+    maxBinByFeature = Param("maxBinByFeature",
+                            "Per-feature bin caps overriding maxBin", None,
+                            ptype=(list, tuple))
+    categoricalSlotNames = Param(
+        "categoricalSlotNames",
+        "Feature slot NAMES treated as categorical, resolved against the "
+        "features column's slot_names metadata (AssembleFeatures records it)",
+        None, ptype=(list, tuple))
+    defaultListenPort = Param("defaultListenPort",
+                              "Socket-era rendezvous port (reference "
+                              "LightGBMConstants.DefaultLocalListenPort; "
+                              "accepted for API parity — collectives need no "
+                              "sockets here)", 12400, ptype=int)
+    timeout = Param("timeout",
+                    "Socket-era network timeout seconds (parity no-op)",
+                    120.0, ptype=float)
 
     def _train_params(self, objective: str, num_class: int = 1) -> TrainParams:
         return TrainParams(
@@ -117,6 +150,12 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             other_rate=self.get("otherRate"),
             categorical_feature=tuple(self.get("categoricalSlotIndexes") or ()),
             parallelism=self.get("parallelism"),
+            metric=self.get("metric") or "",
+            max_delta_step=self.get("maxDeltaStep"),
+            pos_bagging_fraction=self.get("posBaggingFraction"),
+            neg_bagging_fraction=self.get("negBaggingFraction"),
+            max_bin_by_feature=tuple(self.get("maxBinByFeature") or ()),
+            train_metric=self.get("isProvideTrainingMetric"),
             seed=self.get("seed"),
         )
 
@@ -143,6 +182,24 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
 
         X, y, w, init_scores, valid_mask = self._extract(df)
         params = self._train_params(objective, num_class)
+        names = self.get("categoricalSlotNames")
+        if names:
+            slot_names = df.schema.metadata.get(
+                self.get_or_throw("featuresCol"), {}).get("slot_names")
+            if not slot_names:
+                raise ValueError(
+                    "categoricalSlotNames requires slot_names metadata on "
+                    "the features column (AssembleFeatures records it); use "
+                    "categoricalSlotIndexes otherwise")
+            lut = {nm: i for i, nm in enumerate(slot_names)}
+            missing = [nm for nm in names if nm not in lut]
+            if missing:
+                raise KeyError(f"categoricalSlotNames not found in "
+                               f"slot_names metadata: {missing}")
+            params = dataclasses.replace(
+                params, categorical_feature=tuple(sorted(
+                    set(params.categorical_feature)
+                    | {lut[nm] for nm in names})))
         valid = None
         valid_groups = None
         if valid_mask is not None:
@@ -160,7 +217,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
         if self.get("modelString"):
             init = Booster.from_string(self.get("modelString"))
         log = logging.getLogger("mmlspark_tpu.gbdt").info \
-            if self.get("verbosity") >= 0 else None
+            if (self.get("verbosity") >= 0
+                or self.get("isProvideTrainingMetric")) else None
 
         # worker topology: the default mesh's data axis is the worker count
         # (ClusterUtil.getNumExecutorCores parity, LightGBMBase.scala:120-128);

@@ -124,6 +124,7 @@ class GrowerConfig:
     min_gain_to_split: float = 0.0
     lambda_l1: float = 0.0
     lambda_l2: float = 0.0
+    max_delta_step: float = 0.0         # clamp |leaf value| (0 = off)
 
 
 class _Node:
@@ -419,6 +420,8 @@ def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
         np.abs(sums[:, 0]) - config.lambda_l1, 0.0)
     value = np.where(feature < 0,
                      -g_thr / (sums[:, 1] + config.lambda_l2), 0.0)
+    if config.max_delta_step > 0:
+        value = np.clip(value, -config.max_delta_step, config.max_delta_step)
     # host-path parity: values are assigned at child creation only, so an
     # unsplit root keeps 0.0 (it is never anyone's child)
     value[0] = 0.0 if nn == 1 else value[0]
@@ -542,7 +545,11 @@ def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
             left.append(-1)
             right.append(-1)
             g_thr = np.sign(sums[0]) * max(abs(sums[0]) - config.lambda_l1, 0.0)
-            value.append(float(-g_thr / (sums[1] + config.lambda_l2)))
+            v = float(-g_thr / (sums[1] + config.lambda_l2))
+            if config.max_delta_step > 0:
+                v = float(np.clip(v, -config.max_delta_step,
+                                  config.max_delta_step))
+            value.append(v)
             gains.append(0.0)
             counts.append(int(sums[2]))
 

@@ -635,6 +635,84 @@ class TestDistributed:
         with pytest.raises(Exception):
             LightGBMClassifier(parallelism="tree_parallel")
 
+    def test_max_bin_by_feature(self):
+        X = np.random.default_rng(0).normal(size=(3000, 3))
+        m = BinMapper.fit(X, max_bin=64, max_bin_by_feature=[8, 128, 16])
+        assert m.num_bins(0) <= 9    # 8 value bins + missing
+        assert m.num_bins(2) <= 17
+        assert m.num_bins(1) > 65    # overrides max_bin UPWARD too
+
+    def test_max_delta_step_clamps_leaves(self, monkeypatch):
+        X, y = synth_binary(300)
+        y = y * 100.0  # large targets -> large unclamped leaf values
+        for env in ("1", "0"):  # host-orchestrated and fused paths
+            monkeypatch.setenv("MMLSPARK_TPU_NO_FUSED_TREE", env)
+            if env == "0":
+                monkeypatch.setenv("MMLSPARK_TPU_FUSED_TREE", "1")
+            b = B.train(TrainParams(objective="regression", num_iterations=3,
+                                    num_leaves=7, min_data_in_leaf=5,
+                                    max_delta_step=0.1), X, y)
+            for grp in b.trees:
+                for t in grp:
+                    leaves = t.value[t.feature == -1]
+                    assert np.all(np.abs(leaves) <= 0.1 + 1e-9)
+
+    def test_class_aware_bagging(self):
+        X, y = synth_binary(400)
+        params = TrainParams(objective="binary", num_iterations=8,
+                             num_leaves=7, min_data_in_leaf=5,
+                             bagging_freq=1, pos_bagging_fraction=0.9,
+                             neg_bagging_fraction=0.3)
+        b = B.train(params, X, y)
+        p = b.predict_proba(X)[:, 1]
+        assert np.mean((p > 0.5) == y) > 0.85
+
+    def test_metric_param_early_stopping(self):
+        X, y = synth_binary(400)
+        params = TrainParams(objective="binary", num_iterations=40,
+                             num_leaves=7, min_data_in_leaf=5, metric="auc",
+                             early_stopping_round=5)
+        b = B.train(params, X[:300], y[:300], valid=(X[300:], y[300:]))
+        assert b.best_iteration > 0  # auc is higher-better; stopping worked
+
+    def test_is_provide_training_metric_logs_with_validation(self, caplog):
+        """The training metric must be logged even when a validation split
+        exists (it used to be unreachable in the early-stopping setup)."""
+        import logging
+
+        X, y = synth_binary(300)
+        df = feature_df(X, y, extra={"isVal": np.arange(300) >= 240})
+        with caplog.at_level(logging.INFO, logger="mmlspark_tpu.gbdt"):
+            LightGBMClassifier(numIterations=5, numLeaves=7, minDataInLeaf=5,
+                               validationIndicatorCol="isVal",
+                               isProvideTrainingMetric=True).fit(df)
+        msgs = [r.message for r in caplog.records]
+        assert any("train binary_logloss" in m for m in msgs), msgs
+        assert any("valid binary_logloss" in m for m in msgs), msgs
+
+    def test_categorical_slot_names_via_metadata(self):
+        from mmlspark_tpu.featurize import AssembleFeatures
+
+        rng = np.random.default_rng(3)
+        n = 300
+        cat = rng.integers(0, 4, n).astype(float)
+        num = rng.normal(size=n)
+        y = ((cat >= 2).astype(float) + 0.1 * num > 0.5).astype(float)
+        df = DataFrame.from_dict({"cat": cat, "num": num, "label": y},
+                                 num_partitions=2)
+        feats = AssembleFeatures(inputCols=["cat", "num"],
+                                 outputCol="features").fit(df).transform(df)
+        assert feats.schema.metadata["features"]["slot_names"] == \
+            ["cat", "num"]
+        model = LightGBMClassifier(numIterations=8, numLeaves=7,
+                                   minDataInLeaf=5,
+                                   categoricalSlotNames=["cat"]).fit(feats)
+        assert 0 in model.booster.params.categorical_feature
+        assert np.mean(model.transform(feats).column("prediction") == y) > 0.9
+        with pytest.raises(KeyError, match="nope"):
+            LightGBMClassifier(numIterations=2,
+                               categoricalSlotNames=["nope"]).fit(feats)
+
     def test_stage_uses_default_mesh(self, mesh8):
         from mmlspark_tpu.parallel.mesh import MeshContext
         MeshContext.set(mesh8)
