@@ -58,6 +58,11 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
     seed = Param("seed", "Murmur seed", 0, ptype=int)
     stringSplit = Param("stringSplit", "Tokenize strings on whitespace into words",
                         False, ptype=bool)
+    stringSplitInputCols = Param(
+        "stringSplitInputCols",
+        "Columns whose strings are whitespace-tokenized (the reference's "
+        "param name, VowpalWabbitFeaturizer.scala; stringSplit=True applies "
+        "to every column)", None, ptype=(list, tuple))
     sumCollisions = Param("sumCollisions", "Sum values on index collision (else keep)",
                           True, ptype=bool)
     prefixStringsWithColumnName = Param("prefixStringsWithColumnName",
@@ -73,7 +78,8 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         out_col = self.get_or_throw("outputCol")
         seed = self.get("seed")
         mask = (1 << self.get("numBits")) - 1
-        split = self.get("stringSplit")
+        split_all = self.get("stringSplit")
+        split_cols = set(self.get("stringSplitInputCols") or ())
         prefix = self.get("prefixStringsWithColumnName")
         sum_coll = self.get("sumCollisions")
 
@@ -121,6 +127,7 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
                             idx.append(col_hash[c])
                             val.append(float(v))
                     elif isinstance(v, str):
+                        split = split_all or c in split_cols
                         for t in (v.split() if split else [v]):
                             add_hashed(i, pn + t)
                     elif isinstance(v, dict):

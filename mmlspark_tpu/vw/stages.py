@@ -103,6 +103,11 @@ class _VowpalWabbitBase(HasFeaturesCol, HasLabelCol, HasWeightCol):
     numWorkers = Param("numWorkers", "Worker/shard override (0=auto, 1=single)", 0,
                        ptype=int)
     initialModel = ComplexParam("initialModel", "Warm-start weights")
+    additionalFeatures = Param(
+        "additionalFeatures",
+        "Extra sparse-feature columns merged with featuresCol per row "
+        "(vw/VowpalWabbitBase.scala additionalFeatures — e.g. the output of "
+        "VowpalWabbitInteractions)", None, ptype=(list, tuple))
 
     def _config(self, loss: str) -> LearnerConfig:
         cfg = LearnerConfig(loss_function=loss, num_bits=self.get("numBits"),
@@ -122,6 +127,9 @@ class _VowpalWabbitBase(HasFeaturesCol, HasLabelCol, HasWeightCol):
         data = df.collect()
         rows = data[self.get_or_throw("featuresCol")]
         rows = [_to_sparse(r) for r in rows]
+        for extra_col in (self.get("additionalFeatures") or ()):
+            extra = [_to_sparse(r) for r in data[extra_col]]
+            rows = [_merge_sparse(a, b) for a, b in zip(rows, extra)]
         labels = np.asarray(data[self.get_or_throw("labelCol")], dtype=np.float64)
         if label_transform is not None:
             labels = label_transform(labels)
@@ -155,10 +163,34 @@ def _to_sparse(r) -> Optional[Dict[str, np.ndarray]]:
     return {"indices": nz.astype(np.int64), "values": arr[nz].astype(np.float32)}
 
 
+def _merge_sparse(a, b):
+    """Union two sparse rows (values summed on index collision — VW merges
+    namespaces into one example the same way)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    idx = np.concatenate([np.asarray(a["indices"], dtype=np.int64),
+                          np.asarray(b["indices"], dtype=np.int64)])
+    val = np.concatenate([np.asarray(a["values"], dtype=np.float32),
+                          np.asarray(b["values"], dtype=np.float32)])
+    uniq, inv = np.unique(idx, return_inverse=True)
+    merged = np.zeros(len(uniq), dtype=np.float32)
+    np.add.at(merged, inv, val)
+    out = {"indices": uniq, "values": merged}
+    size = max(int(a.get("size", 0)), int(b.get("size", 0)))
+    if size:
+        out["size"] = size
+    return out
+
+
 class _VowpalWabbitModelBase(Model, HasFeaturesCol):
     weights = ComplexParam("weights", "Learned weight vector")
     numBits = Param("numBits", "Feature space bits", 18, ptype=int)
     testArgs = Param("testArgs", "Extra args used at test time (parity)", "", ptype=str)
+    additionalFeatures = Param("additionalFeatures",
+                               "Extra sparse columns merged at scoring, same "
+                               "as at training", None, ptype=(list, tuple))
 
     def __init__(self, **kwargs):
         self._stats: List[TrainingStats] = kwargs.pop("stats", [])
@@ -166,6 +198,9 @@ class _VowpalWabbitModelBase(Model, HasFeaturesCol):
 
     def _raw(self, part) -> np.ndarray:
         rows = [_to_sparse(r) for r in part[self.get_or_throw("featuresCol")]]
+        for extra_col in (self.get("additionalFeatures") or ()):
+            extra = [_to_sparse(r) for r in part[extra_col]]
+            rows = [_merge_sparse(a, b) for a, b in zip(rows, extra)]
         ds = SparseDataset.from_rows(rows, np.zeros(len(rows)),
                                      num_bits=self.get("numBits"))
         return predict_linear(self.get_or_throw("weights"), ds)
@@ -209,6 +244,7 @@ class VowpalWabbitClassifier(Estimator, _VowpalWabbitBase):
         return VowpalWabbitClassificationModel(
             weights=w, numBits=cfg.num_bits, stats=stats,
             featuresCol=self.get("featuresCol"),
+            additionalFeatures=self.get("additionalFeatures"),
             rawPredictionCol=self.get("rawPredictionCol"),
             probabilityCol=self.get("probabilityCol"),
             predictionCol=self.get("predictionCol"))
@@ -247,6 +283,7 @@ class VowpalWabbitRegressor(Estimator, _VowpalWabbitBase):
         return VowpalWabbitRegressionModel(
             weights=w, numBits=cfg.num_bits, stats=stats,
             featuresCol=self.get("featuresCol"),
+            additionalFeatures=self.get("additionalFeatures"),
             predictionCol=self.get("predictionCol"))
 
 

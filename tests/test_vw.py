@@ -349,3 +349,42 @@ class TestReviewRegressions:
         ds = SparseDataset.from_rows(rows * 20, np.ones(20), num_bits=4)
         _, stats = train_linear(cfg, ds)
         assert np.isfinite(stats[-1].average_loss)
+
+
+class TestParamParityAdditions:
+    def test_additional_features_merge(self):
+        """additionalFeatures columns merge into the training examples
+        (vw/VowpalWabbitBase.scala additionalFeatures)."""
+        from mmlspark_tpu.vw import VowpalWabbitClassifier
+
+        rng = np.random.default_rng(0)
+        n = 200
+        # base features are noise; the SIGNAL lives in the additional column
+        base = [{"indices": np.array([1]), "values":
+                 np.array([rng.normal()], dtype=np.float32)} for _ in range(n)]
+        y = rng.integers(0, 2, n).astype(np.float64)
+        extra = [{"indices": np.array([7]),
+                  "values": np.array([1.0 if y[i] else -1.0],
+                                     dtype=np.float32)} for i in range(n)]
+        df = DataFrame.from_dict({"features": np.array(base, dtype=object),
+                                  "extra": np.array(extra, dtype=object),
+                                  "label": y})
+        plain = VowpalWabbitClassifier(numPasses=5).fit(df)
+        acc_plain = np.mean(plain.transform(df).column("prediction") == y)
+        boosted = VowpalWabbitClassifier(
+            numPasses=5, additionalFeatures=["extra"]).fit(df)
+        acc_boosted = np.mean(boosted.transform(df).column("prediction") == y)
+        assert acc_boosted > 0.95 > acc_plain + 0.3
+
+    def test_string_split_input_cols(self):
+        from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+
+        df = DataFrame.from_dict({
+            "a": np.array(["x y", "x y"], dtype=object),
+            "b": np.array(["x y", "x y"], dtype=object)})
+        out = VowpalWabbitFeaturizer(
+            inputCols=["a", "b"], outputCol="f", numBits=18,
+            stringSplitInputCols=["a"]).transform(df)
+        row = out.column("f")[0]
+        # col a tokenizes into 2 features; col b stays 1 whole-string feature
+        assert len(row["indices"]) == 3
