@@ -341,9 +341,12 @@ class DataFrame:
                     if attempt >= retries:
                         # the ORIGINAL exception propagates (type, attrs,
                         # errno, args all intact); the partition context
-                        # rides along as a note
-                        e.add_note(f"[map_partitions] partition {pi} failed "
-                                   f"after {attempt + 1} attempt(s)")
+                        # rides along as a note (add_note is 3.11+; on 3.10
+                        # we drop the note rather than mask the exception)
+                        note = (f"[map_partitions] partition {pi} failed "
+                                f"after {attempt + 1} attempt(s)")
+                        if hasattr(e, "add_note"):
+                            e.add_note(note)
                         raise
         return self._carry_meta(DataFrame(out))
 

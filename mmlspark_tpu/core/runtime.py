@@ -1,0 +1,47 @@
+"""Process-level JAX runtime knobs shared by the hot entry points.
+
+The reference ships AOT-compiled native engines (LightGBM/VW/CNTK pay their
+compile cost at build time); the XLA equivalent is the persistent compilation
+cache — first-ever run of a program shape pays the compile, every later
+process reuses it. Enabled lazily from the training/serving entry points so
+importing the package never touches jax config.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("mmlspark_tpu.runtime")
+
+_cache_enabled = False
+
+
+def ensure_compile_cache() -> None:
+    """Enable JAX's persistent compilation cache (idempotent).
+
+    Opt out with MMLSPARK_TPU_COMPILE_CACHE=0; override the directory with
+    MMLSPARK_TPU_COMPILE_CACHE_DIR (default ~/.cache/mmlspark_tpu/xla).
+    """
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    if os.environ.get("MMLSPARK_TPU_COMPILE_CACHE", "1") in ("0", "false"):
+        return
+    path = os.environ.get("MMLSPARK_TPU_COMPILE_CACHE_DIR") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "mmlspark_tpu", "xla")
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            # CPU AOT cache entries warn (and can SIGILL) across machine
+            # feature sets, and CPU compiles are cheap — accelerators only
+            return
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # jax too old / read-only fs: non-fatal
+        log.debug("compilation cache unavailable: %s", e)

@@ -35,15 +35,35 @@ class FastVectorAssembler(Transformer, HasInputCols, HasOutputCol):
     def transform(self, df: DataFrame) -> DataFrame:
         in_cols = list(self.get_or_throw("inputCols"))
         out_col = self.get_or_throw("outputCol")
+        # Vector-typed columns have row-locally-unknowable width when null,
+        # so nulls there must raise (FastVectorAssembler.scala:143-144).
+        vector_typed = {
+            c for c in in_cols
+            if df.schema.types.get(c) in (ColType.VECTOR, ColType.TENSOR)
+        }
 
         def fn(p):
             n = len(next(iter(p.values()))) if p else 0
+            # Columns not schema-marked VECTOR can still carry arrays (OBJECT
+            # dtype); detect from the partition's first non-null value.
+            holds_vectors = set(vector_typed)
+            for c in in_cols:
+                if c not in holds_vectors:
+                    for v in p[c]:
+                        if v is not None:
+                            if isinstance(v, (np.ndarray, list, tuple)):
+                                holds_vectors.add(c)
+                            break
             out = np.empty(n, dtype=object)
             for i in range(n):
                 parts = []
                 for c in in_cols:
                     v = p[c][i]
                     if v is None:
+                        if c in holds_vectors:
+                            raise ValueError(
+                                f"Values to assemble cannot be null: column "
+                                f"'{c}' holds a null vector")
                         parts.append(np.array([np.nan]))
                     elif isinstance(v, (np.ndarray, list, tuple)):
                         arr = np.asarray(v, dtype=np.float64).ravel()

@@ -74,8 +74,8 @@ class BinMapper:
         for f in range(num_f):
             fmax = int(caps[f]) if caps else max_bin
             if not 2 <= fmax <= 65535:
-                raise ValueError(
-                    f"max_bin_by_feature[{f}]={fmax} must be in [2, 65535]")
+                what = f"max_bin_by_feature[{f}]" if caps else "max_bin"
+                raise ValueError(f"{what}={fmax} must be in [2, 65535]")
             col = sample[:, f]
             col = col[~np.isnan(col)]
             if f in cat:

@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -468,6 +469,228 @@ class Booster:
 
 
 # ---------------------------------------------------------------------------
+# Whole-run fused training (one dispatch for ALL boosting iterations)
+# ---------------------------------------------------------------------------
+
+# Precomputed bagging-mask budget for the scan path: [iters, N] bool uploaded
+# once. Above this, fall back to the per-tree loop.
+_SCAN_MASK_BUDGET = 1 << 28
+
+
+def _now() -> float:
+    import time
+
+    return time.perf_counter()
+
+
+def _scan_train_ok(params: TrainParams, objective: str, valid, log,
+                   shard_put) -> bool:
+    """Can this run take the whole-training-in-one-dispatch lax.scan path?
+
+    The scan path removes EVERY per-iteration host round trip (the per-tree
+    fused grower still paid one dispatch + one fetch per tree — ~4 tunnel
+    RTTs/iteration end to end). Exclusions: dart (host-side tree
+    drop/re-add), goss (grad-dependent host sampling), lambdarank (grouped
+    grad), validation/early-stopping + per-iteration logging (host eval),
+    and sharded inputs (the per-tree shard_map grower handles those).
+    """
+    import jax
+
+    if os.environ.get("MMLSPARK_TPU_NO_SCAN_TRAIN", "") not in ("", "0"):
+        return False
+    if params.boosting_type in ("dart", "goss"):
+        return False
+    if objective == "lambdarank":
+        return False
+    if valid is not None or log is not None or params.train_metric:
+        return False
+    if shard_put is not None:
+        return False
+    max_nodes = 2 * params.num_leaves - 1
+    if max_nodes < 3:
+        return False  # num_leaves=1: nothing to grow
+    forced = os.environ.get("MMLSPARK_TPU_SCAN_TRAIN", "") not in ("", "0")
+    if not forced and jax.default_backend() == "cpu":
+        # CPU in-process dispatch is cheap; the host loop keeps exact-f64
+        # score accumulation there
+        return False
+    return True
+
+
+def _scan_precompute_masks(params: TrainParams, rng, n: int, num_f: int,
+                           y: np.ndarray, is_rf: bool):
+    """Replicate the host loop's per-iteration RNG draws (bagging mask, then
+    feature mask — same order, same generator) for all iterations up front.
+    Returns (row_masks [iters,N]|None, feat_masks [iters,F]|None, ok)."""
+    iters = params.num_iterations
+    bag_cond = ((params.bagging_fraction < 1.0
+                 or params.pos_bagging_fraction < 1.0
+                 or params.neg_bagging_fraction < 1.0)
+                and (is_rf or params.bagging_freq > 0))
+    use_feat = params.feature_fraction < 1.0
+    if bag_cond and iters * n > _SCAN_MASK_BUDGET:
+        return None, None, False
+    row_masks = np.empty((iters, n), dtype=bool) if bag_cond else None
+    feat_masks = np.empty((iters, num_f), dtype=bool) if use_feat else None
+    bag = np.ones(n, dtype=bool)
+    for it in range(iters):
+        if bag_cond and it % max(params.bagging_freq, 1) == 0:
+            if (params.pos_bagging_fraction < 1.0
+                    or params.neg_bagging_fraction < 1.0):
+                pos = y > 0.5
+                frac = np.where(pos, params.pos_bagging_fraction,
+                                params.neg_bagging_fraction)
+                bag = rng.random(n) < frac
+            else:
+                bag = rng.random(n) < params.bagging_fraction
+        if bag_cond:
+            row_masks[it] = bag
+        if use_feat:
+            m = np.zeros(num_f, dtype=bool)
+            n_feat = max(1, int(num_f * params.feature_fraction))
+            m[rng.choice(num_f, size=n_feat, replace=False)] = True
+            feat_masks[it] = m
+    return row_masks, feat_masks, True
+
+
+def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
+                mapper: BinMapper, bins_dev, labels, w_dev,
+                scores: np.ndarray, n: int, num_f: int, num_bins: int,
+                k: int, lr: float, row_masks, feat_masks) -> None:
+    """Run ALL boosting iterations in ONE jitted lax.scan dispatch.
+
+    Each scan step: grad/hess from the running scores, whole-tree growth via
+    the fused while_loop grower (Pallas MXU histograms on TPU), on-device f32
+    leaf values feeding a Kahan-compensated score update. The stacked tree
+    arrays come back in a single fetch; leaf values of the SAVED trees are
+    recomputed on host in f64 from the fetched (grad, hess, count) sums —
+    the same precision lineage as the per-tree path. The running f32 score
+    update uses device-f32 leaf values, so late-tree splits can differ from
+    the per-tree path by float rounding (predictions agree to ~1e-5; the
+    per-tree path remains available via MMLSPARK_TPU_NO_SCAN_TRAIN=1).
+
+    Replaces ~4 tunnel round trips per boosting iteration with one dispatch
+    + one fetch for the whole run (the reference's LGBM_BoosterUpdateOneIter
+    loop is likewise in-process once entered, TrainUtils.scala:170-233).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from . import pallas_hist
+    from .tree import _grow_tree_device_body
+
+    iters = params.num_iterations
+    M = 2 * params.num_leaves - 1
+    use_mxu = pallas_hist.use_pallas()
+    objective = params.objective
+    alpha = params.alpha
+
+    l1 = np.float32(config.lambda_l1)
+    l2 = np.float32(config.lambda_l2)
+    msh = np.float32(config.min_sum_hessian_in_leaf)
+    mgs = np.float32(config.min_gain_to_split)
+    has_fm = feat_masks is not None
+    fm_dummy = jnp.zeros(0, dtype=bool)
+    ones_mask = jnp.ones(n, dtype=bool)
+    shrink = np.float32(lr)
+
+    def body(carry, xs):
+        score, comp = carry
+        row_mask = xs["rm"] if row_masks is not None else ones_mask
+        fmask = xs["fm"] if has_fm else fm_dummy
+        g, h = grad_hess(objective, score, labels, w_dev, alpha)
+        outs = []
+        for kk in range(k):
+            gk = g if g.ndim == 1 else g[:, kk]
+            hk = h if h.ndim == 1 else h[:, kk]
+            out = _grow_tree_device_body(
+                bins_dev, gk, hk, row_mask, jnp.zeros(n, jnp.int32),
+                l1, l2, msh, mgs, fmask,
+                num_bins=num_bins, max_nodes=M,
+                min_data_in_leaf=config.min_data_in_leaf,
+                max_depth=config.max_depth, use_mxu=use_mxu,
+                has_feature_mask=has_fm)
+            rows = out.pop("node_of_row")
+            sums, feat = out["sums"], out["feature"]
+            g_thr = jnp.sign(sums[:, 0]) * jnp.maximum(
+                jnp.abs(sums[:, 0]) - l1, 0.0)
+            val = jnp.where(feat < 0, -g_thr / (sums[:, 1] + l2), 0.0)
+            if config.max_delta_step > 0:
+                val = jnp.clip(val, -config.max_delta_step,
+                               config.max_delta_step)
+            # host-path parity: an unsplit root keeps value 0
+            val = val.at[0].set(jnp.where(out["n_nodes"] > 1, val[0], 0.0))
+            upd = (val * shrink)[rows]
+            if k == 1:
+                y_ = upd + comp
+                t_ = score + y_
+                score, comp = t_, y_ - (t_ - score)
+            else:
+                s_col, c_col = score[:, kk], comp[:, kk]
+                y_ = upd + c_col
+                t_ = s_col + y_
+                score = score.at[:, kk].set(t_)
+                comp = comp.at[:, kk].set(y_ - (t_ - s_col))
+            outs.append(out)
+        stacked = jax.tree.map(lambda *a: jnp.stack(a), *outs)  # [k, ...]
+        return (score, comp), stacked
+
+    score0 = jnp.asarray(scores[:, 0] if k == 1 else scores, dtype=jnp.float32)
+    comp0 = jnp.zeros_like(score0)
+    xs = None
+    if row_masks is not None or has_fm:
+        xs = {}
+        if row_masks is not None:
+            xs["rm"] = jnp.asarray(row_masks)
+        if has_fm:
+            xs["fm"] = jnp.asarray(feat_masks)
+    timing = os.environ.get("MMLSPARK_TPU_GBDT_TIMING", "") not in ("", "0")
+    t0 = _now() if timing else 0.0
+    _, ys = jax.lax.scan(body, (score0, comp0), xs, length=iters)
+    if timing:
+        print(f"[gbdt-scan] trace+dispatch {_now() - t0:.3f}s", flush=True)
+        t0 = _now()
+    host = jax.device_get(ys)
+    if timing:
+        print(f"[gbdt-scan] device exec+fetch {_now() - t0:.3f}s", flush=True)
+        t0 = _now()
+
+    for it in range(iters):
+        group: List[Tree] = []
+        for kk in range(k):
+            nn = int(host["n_nodes"][it, kk])
+            feature = host["feature"][it, kk][:nn].astype(np.int32)
+            tbin = host["threshold_bin"][it, kk][:nn].astype(np.int32)
+            sums = host["sums"][it, kk][:nn].astype(np.float64)
+            g_thr = np.sign(sums[:, 0]) * np.maximum(
+                np.abs(sums[:, 0]) - config.lambda_l1, 0.0)
+            value = np.where(feature < 0,
+                             -g_thr / (sums[:, 1] + config.lambda_l2), 0.0)
+            if config.max_delta_step > 0:
+                value = np.clip(value, -config.max_delta_step,
+                                config.max_delta_step)
+            value[0] = 0.0 if nn == 1 else value[0]
+            threshold = np.array(
+                [mapper.bin_upper_value(int(f), int(t)) if f >= 0 else 0.0
+                 for f, t in zip(feature, tbin)], dtype=np.float64)
+            group.append(Tree(
+                feature=feature,
+                threshold=threshold,
+                threshold_bin=tbin,
+                default_left=host["default_left"][it, kk][:nn].astype(bool),
+                left=host["left"][it, kk][:nn].astype(np.int32),
+                right=host["right"][it, kk][:nn].astype(np.int32),
+                value=value,
+                gain=host["gain"][it, kk][:nn].astype(np.float32),
+                count=sums[:, 2].astype(np.int32),
+                shrinkage=lr,
+            ))
+        booster.trees.append(group)
+    if timing:
+        print(f"[gbdt-scan] host tree build {_now() - t0:.3f}s", flush=True)
+
+
+# ---------------------------------------------------------------------------
 # Training loop
 # ---------------------------------------------------------------------------
 
@@ -589,6 +812,25 @@ def train(params: TrainParams,
     is_goss = params.boosting_type == "goss"
     lr = 1.0 if is_rf else params.learning_rate
     bag_mask = np.ones(n, dtype=bool)  # persists across iters (bagging_freq reuse)
+
+    # whole-run fused path: every boosting iteration inside ONE lax.scan
+    # dispatch — no per-tree host round trips at all
+    if _scan_train_ok(params, objective, valid, log, shard_put):
+        row_masks, feat_masks, ok = _scan_precompute_masks(
+            params, rng, n, num_f, np.asarray(y), is_rf)
+        if ok:
+            from ..core.runtime import ensure_compile_cache
+
+            ensure_compile_cache()
+            _train_scan(params, config, booster, mapper, bins_dev, labels,
+                        w_dev, scores, n, num_f, num_bins, k, lr,
+                        row_masks, feat_masks)
+            if is_rf and booster.trees:
+                inv = 1.0 / len(booster.trees)
+                for gtrees in booster.trees:
+                    for t in gtrees:
+                        t.shrinkage = inv
+            return booster
 
     # single-device accelerator fast path: keep the running scores ON DEVICE
     # (Kahan-compensated f32 — see _add_leaf_values) and update them from the

@@ -29,6 +29,7 @@ from ..core.pipeline import Estimator, Model
 from ..core.schema import ColType, Schema
 from ..parallel.batching import stack_rows
 from .booster import Booster, TrainParams, train
+from .lgbm_format import parse_model_string
 
 
 class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
@@ -215,7 +216,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
                 groups = groups[keep]
         init = None
         if self.get("modelString"):
-            init = Booster.from_string(self.get("modelString"))
+            init = parse_model_string(self.get("modelString"))
         log = logging.getLogger("mmlspark_tpu.gbdt").info \
             if (self.get("verbosity") >= 0
                 or self.get("isProvideTrainingMetric")) else None
@@ -288,14 +289,32 @@ class _LightGBMModelBase(Model, HasFeaturesCol):
 
     # -- reference API parity --------------------------------------------
     def save_native_model(self, path: str, overwrite: bool = True) -> None:
-        """saveNativeModel parity (LightGBMClassifier.scala)."""
+        """saveNativeModel parity (LightGBMClassifier.scala, emitting the
+        actual LightGBM v3 text model via LightGBMBooster.scala:96-148 —
+        the written file loads in any LightGBM runtime)."""
         import os
+
+        from .lgbm_format import to_lightgbm_string
 
         if os.path.exists(path) and not overwrite:
             raise FileExistsError(path)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:
-            f.write(self.booster.to_string())
+            f.write(to_lightgbm_string(self.booster))
+
+    @classmethod
+    def load_native_model_from_string(cls, text: str, **kwargs):
+        """Build a scoring model from a LightGBM v3 text model string
+        (loadNativeModelFromString parity, LightGBMClassifier.scala)."""
+        from .lgbm_format import from_lightgbm_string
+
+        return cls(booster=from_lightgbm_string(text), **kwargs)
+
+    @classmethod
+    def load_native_model_from_file(cls, path: str, **kwargs):
+        """loadNativeModelFromFile parity (LightGBMClassifier.scala)."""
+        with open(path) as f:
+            return cls.load_native_model_from_string(f.read(), **kwargs)
 
     def get_feature_importances(self, importance_type: str = "split") -> List[float]:
         return list(self.booster.feature_importances(importance_type))

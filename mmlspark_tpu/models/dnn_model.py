@@ -133,12 +133,22 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
         return self._jit_cache[key]
 
     def transform_schema(self, schema: Schema) -> Schema:
-        model = self.get_model()
-        in_map, out_map = self._io_maps(model)
-        for col in in_map.values():
+        if self.get("model") is None:
+            # schema-only validation before the model is set: fall back to
+            # the column params (node-name resolution needs a live model)
+            feed = self.get("feedDict")
+            in_cols = list(feed.values()) if feed \
+                else [self.get_or_throw("inputCol")]
+            fetch = self.get("fetchDict")
+            out_cols = list(fetch) if fetch else [self.get_or_throw("outputCol")]
+        else:
+            model = self.get_model()
+            in_map, out_map = self._io_maps(model)
+            in_cols, out_cols = list(in_map.values()), list(out_map)
+        for col in in_cols:
             schema.require(col)
         out = schema.copy()
-        for col in out_map:
+        for col in out_cols:
             out.types[col] = ColType.VECTOR
         return out
 

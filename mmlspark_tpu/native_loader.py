@@ -45,7 +45,8 @@ _build_attempted = False
 
 def _build() -> bool:
     # dev checkout first; the wheel ships the same source as package data
-    # (native_src/ — a sync test keeps the two identical)
+    # (native_src/ is a symlink to native/src/ in the repo; wheel builds
+    # materialize it as a real file)
     candidates = [os.path.join(_NATIVE_DIR, "src", "mmlspark_native.cpp"),
                   os.path.join(_PKG_DIR, "native_src", "mmlspark_native.cpp")]
     src = next((c for c in candidates if os.path.exists(c)), None)

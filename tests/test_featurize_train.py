@@ -127,6 +127,22 @@ class TestAssemble:
         np.testing.assert_allclose(vecs[1][1:], [6.0, 7.0, 11.0])
         np.testing.assert_allclose(vecs[2], [3.0, 8.0, 9.0, 12.0])
 
+    def test_fast_vector_assembler_null_vector_raises(self):
+        # a null VECTOR value has row-locally-unknowable width: must raise
+        # (FastVectorAssembler.scala:143-144), never emit a misaligned [NaN]
+        from mmlspark_tpu.featurize import FastVectorAssembler
+
+        df = DataFrame.from_dict({
+            "v": np.array([np.array([4.0, 5.0]), None,
+                           np.array([8.0, 9.0])], dtype=object),
+            "b": np.array([10.0, 11.0, 12.0]),
+        })
+        import pytest
+
+        with pytest.raises(ValueError, match="cannot be null"):
+            FastVectorAssembler(inputCols=["v", "b"],
+                                outputCol="f").transform(df).collect()
+
 
 class TestTextFeaturizer:
     def docs(self):

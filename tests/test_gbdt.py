@@ -264,6 +264,97 @@ class TestFusedTreeGrower:
         np.testing.assert_allclose(b_fused.raw_predict(X),
                                    b_host.raw_predict(X), rtol=1e-4, atol=1e-5)
 
+    def test_gather_tiers_match_full_scan(self, monkeypatch):
+        """Tiered small-child row compaction must grow the same tree as the
+        full-row-scan histogram (summation association differs by ulps at
+        most; structure and predictions must agree)."""
+        import jax.numpy as jnp
+
+        X, y = synth_binary(9000, seed=13)
+        m = BinMapper.fit(X, max_bin=64)
+        bins = jnp.asarray(m.transform(X))
+        p = np.full_like(y, y.mean())
+        grad = jnp.asarray((p - y).astype(np.float32))
+        hess = jnp.asarray(np.maximum(p * (1 - p), 1e-6).astype(np.float32))
+        mask = jnp.ones(len(y), dtype=bool)
+        config = GrowerConfig(num_leaves=15, min_data_in_leaf=5)
+        monkeypatch.delenv("MMLSPARK_TPU_NO_FUSED_TREE", raising=False)
+        monkeypatch.setenv("MMLSPARK_TPU_FUSED_TREE", "1")
+        monkeypatch.delenv("MMLSPARK_TPU_NO_GATHER_HIST", raising=False)
+        gat, rows_g = grow_tree(bins, grad, hess, mask, m.max_num_bins,
+                                config, m)
+        monkeypatch.setenv("MMLSPARK_TPU_NO_GATHER_HIST", "1")
+        full, rows_f = grow_tree(bins, grad, hess, mask, m.max_num_bins,
+                                 config, m)
+        np.testing.assert_array_equal(gat.feature, full.feature)
+        np.testing.assert_array_equal(gat.threshold_bin, full.threshold_bin)
+        np.testing.assert_array_equal(gat.left, full.left)
+        np.testing.assert_array_equal(gat.count, full.count)
+        np.testing.assert_allclose(gat.value, full.value, rtol=1e-4, atol=1e-7)
+        np.testing.assert_array_equal(rows_g, rows_f)
+
+    def test_scan_train_matches_host_path(self, monkeypatch):
+        """The whole-run lax.scan path (all iterations in one dispatch) must
+        agree with the host per-tree loop to float-rounding tolerance: the
+        saved trees recompute leaf values in f64 from the same sums; only the
+        running f32 score stream can differ by ulps."""
+        X, y = synth_binary(400, seed=4)
+        params = TrainParams(objective="binary", num_iterations=10,
+                             num_leaves=15, min_data_in_leaf=5)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SCAN_TRAIN", raising=False)
+        b_scan = B.train(params, X, y)
+        monkeypatch.delenv("MMLSPARK_TPU_SCAN_TRAIN", raising=False)
+        monkeypatch.setenv("MMLSPARK_TPU_NO_SCAN_TRAIN", "1")
+        b_host = B.train(params, X, y)
+        assert len(b_scan.trees) == len(b_host.trees)
+        np.testing.assert_allclose(b_scan.raw_predict(X),
+                                   b_host.raw_predict(X), rtol=1e-3, atol=1e-4)
+        # accuracy must be indistinguishable
+        acc_scan = np.mean((b_scan.raw_predict(X) > 0) == y)
+        acc_host = np.mean((b_host.raw_predict(X) > 0) == y)
+        assert abs(acc_scan - acc_host) < 0.01
+
+    def test_scan_train_bagging_feature_fraction(self, monkeypatch):
+        """Scan path with precomputed bagging + feature masks: the masks
+        replicate the host loop's RNG draws exactly, so trees match the
+        host path's structure on the first iterations."""
+        X, y = synth_binary(600, seed=11)
+        params = TrainParams(objective="binary", num_iterations=6,
+                             num_leaves=7, min_data_in_leaf=5,
+                             bagging_fraction=0.7, bagging_freq=2,
+                             feature_fraction=0.8, seed=5)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SCAN_TRAIN", raising=False)
+        b_scan = B.train(params, X, y)
+        monkeypatch.delenv("MMLSPARK_TPU_SCAN_TRAIN", raising=False)
+        monkeypatch.setenv("MMLSPARK_TPU_NO_SCAN_TRAIN", "1")
+        b_host = B.train(params, X, y)
+        # same RNG stream -> same masks -> first tree structurally identical
+        np.testing.assert_array_equal(b_scan.trees[0][0].feature,
+                                      b_host.trees[0][0].feature)
+        np.testing.assert_array_equal(b_scan.trees[0][0].threshold_bin,
+                                      b_host.trees[0][0].threshold_bin)
+        np.testing.assert_allclose(b_scan.raw_predict(X),
+                                   b_host.raw_predict(X), rtol=1e-3, atol=1e-4)
+
+    def test_scan_train_multiclass(self, monkeypatch):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(300, 6))
+        y = (X[:, 0] + X[:, 1] > 0.5).astype(np.float64) \
+            + (X[:, 2] > 0.3).astype(np.float64)
+        params = TrainParams(objective="multiclass", num_class=3,
+                             num_iterations=5, num_leaves=7,
+                             min_data_in_leaf=5)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SCAN_TRAIN", raising=False)
+        b_scan = B.train(params, X, y)
+        monkeypatch.delenv("MMLSPARK_TPU_SCAN_TRAIN", raising=False)
+        monkeypatch.setenv("MMLSPARK_TPU_NO_SCAN_TRAIN", "1")
+        b_host = B.train(params, X, y)
+        np.testing.assert_allclose(b_scan.raw_predict(X),
+                                   b_host.raw_predict(X), rtol=1e-3, atol=1e-4)
+
     def test_sharded_fused_matches_single_device(self, mesh8, monkeypatch):
         """Whole-tree growth under shard_map (psum'd histograms) must produce
         the SAME tree as single-device fused growth."""
@@ -566,9 +657,14 @@ class TestStages:
                                    minDataInLeaf=5).fit(df)
         p = str(tmp_path / "model.txt")
         model.save_native_model(p)
-        restored = Booster.from_string(open(p).read())
+        # saveNativeModel emits the real LightGBM v3 text format
+        # (LightGBMBooster.scala:96-148), not the internal JSON
+        from mmlspark_tpu.gbdt.lgbm_format import from_lightgbm_string
+
+        restored = from_lightgbm_string(open(p).read())
         np.testing.assert_allclose(restored.raw_predict(X),
-                                   model.booster.raw_predict(X))
+                                   model.booster.raw_predict(X),
+                                   rtol=1e-9, atol=1e-9)
 
     def test_stage_save_load(self, tmp_path):
         X, y = synth_binary(200)

@@ -79,8 +79,9 @@ class TestNative:
 
 
 def test_packaged_native_source_in_sync():
-    """The wheel ships mmlspark_tpu/native_src/ as package data; it must stay
-    byte-identical to the canonical native/src/ tree."""
+    """The wheel ships mmlspark_tpu/native_src/ as package data; in the repo
+    it is a symlink to the canonical native/src/ tree (single source of
+    truth), materialized as a real file at wheel-build time."""
     import mmlspark_tpu
 
     pkg = os.path.join(os.path.dirname(mmlspark_tpu.__file__),
