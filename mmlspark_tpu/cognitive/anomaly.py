@@ -9,6 +9,7 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import Param, ServiceParam
+from . import schemas as S
 from .base import CognitiveServicesBase
 
 
@@ -23,7 +24,9 @@ class _AnomalyBase(CognitiveServicesBase):
                             "sensitivity", "customInterval", "period"]
 
     def _build_entity(self, vals):
-        series = vals.get("series") or []
+        series = vals.get("series")
+        if series is None:
+            series = []
         clean = []
         for pt in series:
             if isinstance(pt, dict):
@@ -41,11 +44,17 @@ class _AnomalyBase(CognitiveServicesBase):
 
 
 class DetectAnomalies(_AnomalyBase):
-    """Batch anomaly detection over a whole series column."""
+    """Batch anomaly detection over a whole series column
+    (AnomalyDetectorSchemas.scala ADEntireResponse)."""
+
+    responseBinding = S.ADEntireResponse
 
 
 class DetectLastAnomaly(_AnomalyBase):
-    """Detect whether the latest point is anomalous."""
+    """Detect whether the latest point is anomalous
+    (AnomalyDetectorSchemas.scala ADLastResponse)."""
+
+    responseBinding = S.ADLastResponse
 
 
 class SimpleDetectAnomalies(_AnomalyBase):

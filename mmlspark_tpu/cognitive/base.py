@@ -127,9 +127,26 @@ class CognitiveServicesBase(HasServiceParams, HasOutputCol):
                 return poll
         return resp
 
+    #: per-service typed response schema (a TypedStruct subclass or a
+    #: typing.List[...] of one) — SparkBindings parity: responses are parsed
+    #: into schema-checked structs, not raw JSON (cognitive/*Schemas.scala
+    #: via core/schema/SparkBindings.scala:13-47). None = raw JSON.
+    responseBinding = None
+
+    typedOutput = Param("typedOutput",
+                        "Parse responses into the typed schema (raw JSON "
+                        "structs when False)", True, ptype=bool)
+
     def _parse_success(self, resp: HTTPResponseData) -> Any:
-        """Hook: map a 200 response to the output value (default: JSON body)."""
-        return json.loads(resp.entity.decode("utf-8"))
+        """Map a 200 response to the output value: the service's typed
+        response struct when a binding is declared (schema-checked; mismatch
+        lands in errorCol), else the raw JSON."""
+        obj = json.loads(resp.entity.decode("utf-8"))
+        if self.responseBinding is not None and self.get("typedOutput"):
+            from .schemas import _bind_value
+
+            return _bind_value(self.responseBinding, obj, "$")
+        return obj
 
     def transform(self, df: DataFrame) -> DataFrame:
         out_col = self.get_or_throw("outputCol")
@@ -172,7 +189,15 @@ class CognitiveServicesBase(HasServiceParams, HasOutputCol):
 
     def transform_schema(self, schema: Schema) -> Schema:
         out = schema.copy()
-        out.types[self.get_or_throw("outputCol")] = ColType.STRUCT
+        out_col = self.get_or_throw("outputCol")
+        out.types[out_col] = ColType.STRUCT
+        if self.responseBinding is not None and self.get("typedOutput"):
+            from .schemas import _type_schema
+
+            # downstream consumers bind columns to fields against this
+            # (SparkBindings .schema parity)
+            out.meta(out_col)["response_schema"] = _type_schema(
+                self.responseBinding)
         return out
 
 

@@ -4,13 +4,20 @@ from __future__ import annotations
 
 import json
 
+from typing import List
+
 from ..core.params import ServiceParam
+
+from . import schemas as S
 from .base import CognitiveServicesBase
 from .vision import _ImageInputBase
 
 
 class DetectFace(_ImageInputBase):
-    """Face detection with attributes (Face.scala DetectFace)."""
+    """Face detection with attributes (Face.scala DetectFace).
+    The response is a bare JSON array of faces (FaceSchemas.scala Face)."""
+
+    responseBinding = List[S.DetectedFace]
 
     returnFaceId = ServiceParam("returnFaceId", "Include face ids")
     returnFaceLandmarks = ServiceParam("returnFaceLandmarks", "Include landmarks")
@@ -35,6 +42,8 @@ class DetectFace(_ImageInputBase):
 
 class FindSimilarFace(CognitiveServicesBase):
     """Find similar faces from a face list (Face.scala FindSimilar)."""
+
+    responseBinding = List[S.FoundFace]
 
     faceId = ServiceParam("faceId", "Query face id")
     faceIds = ServiceParam("faceIds", "Candidate face ids")

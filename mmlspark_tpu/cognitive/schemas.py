@@ -1,0 +1,508 @@
+"""Typed response schemas for the cognitive services.
+
+Reference parity: the reference ships per-service response case classes bound
+to Spark rows via SparkBindings (cognitive/TextAnalyticsSchemas.scala,
+ComputerVisionSchemas.scala, FaceSchemas.scala, AnomalyDetectorSchemas.scala,
+SpeechSchemas.scala, all built on core/schema/SparkBindings.scala:13-47) so
+downstream stages can bind columns to fields with schema checking. Here the
+equivalent is a dataclass binding layer: every service declares a response
+dataclass; JSON responses are parsed INTO it with per-field type validation
+(wrong shapes raise BindingError with a JSON-path), and the bound structs
+support both attribute and item access so column consumers can navigate
+``resp.documents[0].score`` or ``resp["documents"][0]["score"]``.
+
+``struct_schema(cls)`` emits a JSON-able schema description that transform
+stages attach to the output column's metadata — the SparkBindings .schema
+equivalent downstream checks can validate against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, List, Optional
+
+
+class BindingError(TypeError):
+    """A JSON response does not match the declared schema."""
+
+
+@dataclasses.dataclass
+class TypedStruct:
+    """Base for bound response structs: attribute + item access, dict-ish."""
+
+    def __getitem__(self, key):
+        try:
+            return getattr(self, key)
+        except AttributeError:
+            raise KeyError(key)
+
+    def get(self, key, default=None):
+        return getattr(self, key, default)
+
+    def keys(self):
+        return [f.name for f in dataclasses.fields(self)]
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def bind(cls, obj, path: str = "$"):
+    """Parse ``obj`` (decoded JSON) into dataclass ``cls``, validating every
+    field's type recursively. Unknown JSON fields are ignored (APIs add
+    fields); missing non-Optional fields raise."""
+    if not (isinstance(cls, type) and issubclass(cls, TypedStruct)):
+        raise TypeError(f"{cls} is not a TypedStruct")
+    if not isinstance(obj, dict):
+        raise BindingError(
+            f"{path}: expected object for {cls.__name__}, got "
+            f"{type(obj).__name__}")
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for fld in dataclasses.fields(cls):
+        kwargs[fld.name] = _bind_value(hints[fld.name], obj.get(fld.name),
+                                       f"{path}.{fld.name}")
+    return cls(**kwargs)
+
+
+def _bind_value(t, v, path):
+    origin = typing.get_origin(t)
+    if origin is typing.Union:  # Optional[T] (the only union used here)
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        if v is None:
+            return None
+        return _bind_value(args[0], v, path)
+    if v is None:
+        raise BindingError(f"{path}: missing required field")
+    if origin is list:
+        (elt,) = typing.get_args(t)
+        if not isinstance(v, (list, tuple)):
+            raise BindingError(f"{path}: expected array, got "
+                               f"{type(v).__name__}")
+        return [_bind_value(elt, x, f"{path}[{i}]") for i, x in enumerate(v)]
+    if isinstance(t, type) and issubclass(t, TypedStruct):
+        return bind(t, v, path)
+    if t is float:
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise BindingError(f"{path}: expected number, got "
+                               f"{type(v).__name__}")
+        return float(v)
+    if t is int:
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise BindingError(f"{path}: expected integer, got "
+                               f"{type(v).__name__}")
+        return int(v)
+    if t is bool:
+        if not isinstance(v, bool):
+            raise BindingError(f"{path}: expected boolean, got "
+                               f"{type(v).__name__}")
+        return v
+    if t is str:
+        if not isinstance(v, str):
+            raise BindingError(f"{path}: expected string, got "
+                               f"{type(v).__name__}")
+        return str(v)
+    if t is Any:
+        return v
+    raise BindingError(f"{path}: unsupported schema type {t!r}")
+
+
+def struct_schema(cls) -> dict:
+    """JSON-able schema description of a TypedStruct (SparkBindings.schema
+    equivalent, attached to output-column metadata)."""
+    hints = typing.get_type_hints(cls)
+    return {"struct": cls.__name__,
+            "fields": {f.name: _type_schema(hints[f.name])
+                       for f in dataclasses.fields(cls)}}
+
+
+def _type_schema(t):
+    origin = typing.get_origin(t)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        return {"optional": _type_schema(args[0])}
+    if origin is list:
+        (elt,) = typing.get_args(t)
+        return {"array": _type_schema(elt)}
+    if isinstance(t, type) and issubclass(t, TypedStruct):
+        return struct_schema(t)
+    if t is Any:
+        return "any"
+    return t.__name__
+
+
+# ---------------------------------------------------------------------------
+# Text analytics (TextAnalyticsSchemas.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TAError(TypedStruct):
+    id: str
+    message: str
+
+
+@dataclasses.dataclass
+class SentimentScore(TypedStruct):
+    id: str
+    score: float
+
+
+@dataclasses.dataclass
+class SentimentResponse(TypedStruct):
+    documents: List[SentimentScore]
+    errors: Optional[List[TAError]] = None
+
+
+@dataclasses.dataclass
+class DetectedLanguage(TypedStruct):
+    name: str
+    iso6391Name: str
+    score: float
+
+
+@dataclasses.dataclass
+class DetectLanguageScore(TypedStruct):
+    id: str
+    detectedLanguages: List[DetectedLanguage]
+
+
+@dataclasses.dataclass
+class DetectLanguageResponse(TypedStruct):
+    documents: List[DetectLanguageScore]
+    errors: Optional[List[TAError]] = None
+
+
+@dataclasses.dataclass
+class Match(TypedStruct):
+    text: str
+    offset: int
+    length: int
+
+
+@dataclasses.dataclass
+class Entity(TypedStruct):
+    name: str
+    matches: List[Match]
+    wikipediaLanguage: Optional[str] = None
+    wikipediaId: Optional[str] = None
+    wikipediaUrl: Optional[str] = None
+    bingId: Optional[str] = None
+
+
+@dataclasses.dataclass
+class DetectEntitiesScore(TypedStruct):
+    id: str
+    entities: List[Entity]
+
+
+@dataclasses.dataclass
+class DetectEntitiesResponse(TypedStruct):
+    documents: List[DetectEntitiesScore]
+    errors: Optional[List[TAError]] = None
+
+
+@dataclasses.dataclass
+class NERMatch(TypedStruct):
+    text: str
+    offset: int
+    length: int
+    entityTypeScore: Optional[float] = None
+
+
+@dataclasses.dataclass
+class NEREntity(TypedStruct):
+    name: str
+    matches: List[NERMatch]
+    type: Optional[str] = None
+    subtype: Optional[str] = None
+    wikipediaLanguage: Optional[str] = None
+    wikipediaId: Optional[str] = None
+    wikipediaUrl: Optional[str] = None
+    bingId: Optional[str] = None
+
+
+@dataclasses.dataclass
+class NERDoc(TypedStruct):
+    id: str
+    entities: List[NEREntity]
+
+
+@dataclasses.dataclass
+class NERResponse(TypedStruct):
+    documents: List[NERDoc]
+    errors: Optional[List[TAError]] = None
+
+
+@dataclasses.dataclass
+class KeyPhraseScore(TypedStruct):
+    id: str
+    keyPhrases: List[str]
+
+
+@dataclasses.dataclass
+class KeyPhraseResponse(TypedStruct):
+    documents: List[KeyPhraseScore]
+    errors: Optional[List[TAError]] = None
+
+
+# ---------------------------------------------------------------------------
+# Computer vision (ComputerVisionSchemas.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class OCRWord(TypedStruct):
+    boundingBox: str
+    text: str
+
+
+@dataclasses.dataclass
+class OCRLine(TypedStruct):
+    boundingBox: str
+    words: List[OCRWord]
+
+
+@dataclasses.dataclass
+class OCRRegion(TypedStruct):
+    boundingBox: str
+    lines: List[OCRLine]
+
+
+@dataclasses.dataclass
+class OCRResponse(TypedStruct):
+    language: str
+    regions: List[OCRRegion]
+    textAngle: Optional[float] = None
+    orientation: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ImageTag(TypedStruct):
+    name: str
+    confidence: float
+    hint: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ImageCaption(TypedStruct):
+    text: str
+    confidence: float
+
+
+@dataclasses.dataclass
+class ImageDescription(TypedStruct):
+    tags: List[str]
+    captions: List[ImageCaption]
+
+
+@dataclasses.dataclass
+class ImageMetadata(TypedStruct):
+    width: Optional[int] = None
+    height: Optional[int] = None
+    format: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ImageCategory(TypedStruct):
+    name: str
+    score: float
+
+
+@dataclasses.dataclass
+class FaceRectangle(TypedStruct):
+    left: int
+    top: int
+    width: int
+    height: int
+
+
+@dataclasses.dataclass
+class AIFace(TypedStruct):
+    faceRectangle: FaceRectangle
+    age: Optional[int] = None
+    gender: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ColorInfo(TypedStruct):
+    dominantColorForeground: Optional[str] = None
+    dominantColorBackground: Optional[str] = None
+    dominantColors: Optional[List[str]] = None
+    accentColor: Optional[str] = None
+    isBWImg: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class AIResponse(TypedStruct):
+    """AnalyzeImage response (features present only when requested)."""
+
+    requestId: Optional[str] = None
+    metadata: Optional[ImageMetadata] = None
+    categories: Optional[List[ImageCategory]] = None
+    tags: Optional[List[ImageTag]] = None
+    description: Optional[ImageDescription] = None
+    faces: Optional[List[AIFace]] = None
+    color: Optional[ColorInfo] = None
+    imageType: Optional[Any] = None
+    adult: Optional[Any] = None
+
+
+@dataclasses.dataclass
+class TagImagesResponse(TypedStruct):
+    tags: List[ImageTag]
+    requestId: Optional[str] = None
+    metadata: Optional[ImageMetadata] = None
+
+
+@dataclasses.dataclass
+class DescribeImageResponse(TypedStruct):
+    description: ImageDescription
+    requestId: Optional[str] = None
+    metadata: Optional[ImageMetadata] = None
+
+
+@dataclasses.dataclass
+class RTWord(TypedStruct):
+    boundingBox: List[int]
+    text: str
+
+
+@dataclasses.dataclass
+class RTLine(TypedStruct):
+    boundingBox: List[int]
+    text: str
+    words: List[RTWord]
+
+
+@dataclasses.dataclass
+class RTResult(TypedStruct):
+    lines: List[RTLine]
+
+
+@dataclasses.dataclass
+class RTResponse(TypedStruct):
+    """RecognizeText async result (status + recognitionResult)."""
+
+    status: str
+    recognitionResult: Optional[RTResult] = None
+
+
+@dataclasses.dataclass
+class DSIRCelebrity(TypedStruct):
+    name: str
+    confidence: float
+    faceRectangle: Optional[FaceRectangle] = None
+
+
+@dataclasses.dataclass
+class DSIRLandmark(TypedStruct):
+    name: str
+    confidence: float
+
+
+@dataclasses.dataclass
+class DSIRResult(TypedStruct):
+    celebrities: Optional[List[DSIRCelebrity]] = None
+    landmarks: Optional[List[DSIRLandmark]] = None
+
+
+@dataclasses.dataclass
+class DSIRResponse(TypedStruct):
+    """RecognizeDomainSpecificContent response."""
+
+    result: DSIRResult
+    requestId: Optional[str] = None
+    metadata: Optional[ImageMetadata] = None
+
+
+# ---------------------------------------------------------------------------
+# Face (FaceSchemas.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Emotion(TypedStruct):
+    anger: Optional[float] = None
+    contempt: Optional[float] = None
+    disgust: Optional[float] = None
+    fear: Optional[float] = None
+    happiness: Optional[float] = None
+    neutral: Optional[float] = None
+    sadness: Optional[float] = None
+    surprise: Optional[float] = None
+
+
+@dataclasses.dataclass
+class FaceAttributes(TypedStruct):
+    age: Optional[float] = None
+    gender: Optional[str] = None
+    smile: Optional[float] = None
+    glasses: Optional[str] = None
+    emotion: Optional[Emotion] = None
+
+
+@dataclasses.dataclass
+class DetectedFace(TypedStruct):
+    faceId: Optional[str] = None
+    faceRectangle: Optional[FaceRectangle] = None
+    faceAttributes: Optional[FaceAttributes] = None
+    faceLandmarks: Optional[Any] = None
+
+
+@dataclasses.dataclass
+class FoundFace(TypedStruct):
+    persistedFaceId: Optional[str] = None
+    faceId: Optional[str] = None
+    confidence: Optional[float] = None
+
+
+# ---------------------------------------------------------------------------
+# Anomaly detection (AnomalyDetectorSchemas.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ADEntireResponse(TypedStruct):
+    isAnomaly: List[bool]
+    isPositiveAnomaly: List[bool]
+    isNegativeAnomaly: List[bool]
+    period: int
+    expectedValues: List[float]
+    upperMargins: List[float]
+    lowerMargins: List[float]
+
+
+@dataclasses.dataclass
+class ADLastResponse(TypedStruct):
+    isAnomaly: bool
+    isPositiveAnomaly: bool
+    isNegativeAnomaly: bool
+    period: int
+    expectedValue: float
+    upperMargin: float
+    lowerMargin: float
+    suggestedWindow: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Speech (SpeechSchemas.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SpeechNBest(TypedStruct):
+    Confidence: Optional[float] = None
+    Lexical: Optional[str] = None
+    ITN: Optional[str] = None
+    MaskedITN: Optional[str] = None
+    Display: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SpeechResponse(TypedStruct):
+    RecognitionStatus: str
+    Offset: Optional[int] = None
+    Duration: Optional[int] = None
+    DisplayText: Optional[str] = None
+    NBest: Optional[List[SpeechNBest]] = None

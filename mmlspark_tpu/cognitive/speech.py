@@ -3,11 +3,14 @@
 from __future__ import annotations
 
 from ..core.params import ServiceParam
+from . import schemas as S
 from .base import CognitiveServicesBase
 
 
 class SpeechToText(CognitiveServicesBase):
-    """Audio bytes -> transcription."""
+    """Audio bytes -> transcription (SpeechSchemas.scala parity)."""
+
+    responseBinding = S.SpeechResponse
 
     audioData = ServiceParam("audioData", "Audio bytes (value or column)")
     language = ServiceParam("language", "Spoken language")

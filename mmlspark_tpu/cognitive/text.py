@@ -1,25 +1,38 @@
-"""Text-analytics services (reference cognitive/TextAnalytics.scala:171-230)."""
+"""Text-analytics services (reference cognitive/TextAnalytics.scala:171-230).
 
+Responses parse into the typed schemas of schemas.py
+(TextAnalyticsSchemas.scala parity)."""
+
+from . import schemas as S
 from .base import DocumentsBase
 
 
 class TextSentiment(DocumentsBase):
     """Sentiment scoring per document."""
 
+    responseBinding = S.SentimentResponse
+
 
 class LanguageDetector(DocumentsBase):
     """Language detection (no language hint input)."""
 
     _service_param_names = ["text"]
+    responseBinding = S.DetectLanguageResponse
 
 
 class EntityDetector(DocumentsBase):
     """Linked-entity detection."""
 
+    responseBinding = S.DetectEntitiesResponse
+
 
 class NER(DocumentsBase):
     """Named-entity recognition."""
 
+    responseBinding = S.NERResponse
+
 
 class KeyPhraseExtractor(DocumentsBase):
     """Key-phrase extraction."""
+
+    responseBinding = S.KeyPhraseResponse

@@ -1,4 +1,7 @@
-"""Computer-vision services (reference cognitive/ComputerVision.scala:165-520)."""
+"""Computer-vision services (reference cognitive/ComputerVision.scala:165-520).
+
+Responses parse into the typed schemas of schemas.py
+(ComputerVisionSchemas.scala parity)."""
 
 from __future__ import annotations
 
@@ -6,6 +9,7 @@ import json
 from typing import Any, Dict
 
 from ..core.params import Param, ServiceParam
+from . import schemas as S
 from .base import CognitiveServicesBase
 
 
@@ -33,6 +37,8 @@ class _ImageInputBase(CognitiveServicesBase):
 class OCR(_ImageInputBase):
     """Printed-text OCR (ComputerVision.scala OCR)."""
 
+    responseBinding = S.OCRResponse
+
     detectOrientation = ServiceParam("detectOrientation", "Detect text orientation")
     language = ServiceParam("language", "Language hint")
     _service_param_names = ["imageUrl", "imageBytes", "detectOrientation",
@@ -54,6 +60,7 @@ class RecognizeText(_ImageInputBase):
     mode = ServiceParam("mode", "'Printed' or 'Handwritten'")
     _service_param_names = ["imageUrl", "imageBytes", "mode"]
     _is_async = True
+    responseBinding = S.RTResponse
 
     def _url_params(self, vals):
         return {"mode": str(vals["mode"])} if vals.get("mode") else {}
@@ -61,6 +68,8 @@ class RecognizeText(_ImageInputBase):
 
 class AnalyzeImage(_ImageInputBase):
     """Full image analysis (ComputerVision.scala AnalyzeImage)."""
+
+    responseBinding = S.AIResponse
 
     visualFeatures = ServiceParam("visualFeatures", "Comma/list of features")
     details = ServiceParam("details", "Detail domains")
@@ -81,9 +90,13 @@ class AnalyzeImage(_ImageInputBase):
 class TagImage(_ImageInputBase):
     """Image tagging (ComputerVision.scala TagImage)."""
 
+    responseBinding = S.TagImagesResponse
+
 
 class DescribeImage(_ImageInputBase):
     """Caption generation (ComputerVision.scala DescribeImage)."""
+
+    responseBinding = S.DescribeImageResponse
 
     maxCandidates = ServiceParam("maxCandidates", "Caption candidates")
     _service_param_names = ["imageUrl", "imageBytes", "maxCandidates"]
@@ -117,6 +130,8 @@ class GenerateThumbnails(_ImageInputBase):
 
 class RecognizeDomainSpecificContent(_ImageInputBase):
     """Domain models, e.g. celebrities/landmarks (ComputerVision.scala:470-520)."""
+
+    responseBinding = S.DSIRResponse
 
     model = ServiceParam("model", "Domain model name")
     _service_param_names = ["imageUrl", "imageBytes", "model"]

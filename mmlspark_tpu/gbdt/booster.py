@@ -483,6 +483,16 @@ def _now() -> float:
     return time.perf_counter()
 
 
+@functools.partial(__import__("jax").jit, donate_argnums=(0,))
+def _widen_bins(b):
+    """uint8 bins -> device-resident int32 (donated: the u8 copy is freed).
+    Keeps every downstream kernel on the int32 layout it was built for while
+    the host->device transfer ships 1/4 the bytes."""
+    import jax.numpy as jnp
+
+    return b.astype(jnp.int32)
+
+
 def _scan_train_ok(params: TrainParams, objective: str, valid, log,
                    shard_put) -> bool:
     """Can this run take the whole-training-in-one-dispatch lax.scan path?
@@ -556,7 +566,8 @@ def _scan_precompute_masks(params: TrainParams, rng, n: int, num_f: int,
 def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
                 mapper: BinMapper, bins_dev, labels, w_dev,
                 scores: np.ndarray, n: int, num_f: int, num_bins: int,
-                k: int, lr: float, row_masks, feat_masks) -> None:
+                k: int, lr: float, row_masks, feat_masks,
+                pad_mask: Optional[np.ndarray] = None) -> None:
     """Run ALL boosting iterations in ONE jitted lax.scan dispatch.
 
     Each scan step: grad/hess from the running scores, whole-tree growth via
@@ -591,7 +602,12 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
     mgs = np.float32(config.min_gain_to_split)
     has_fm = feat_masks is not None
     fm_dummy = jnp.zeros(0, dtype=bool)
-    ones_mask = jnp.ones(n, dtype=bool)
+    if pad_mask is not None and not pad_mask.all():
+        if row_masks is not None:
+            row_masks = row_masks & pad_mask[None, :]
+        ones_mask = jnp.asarray(pad_mask)
+    else:
+        ones_mask = jnp.ones(n, dtype=bool)
     shrink = np.float32(lr)
 
     def body(carry, xs):
@@ -646,13 +662,37 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
             xs["fm"] = jnp.asarray(feat_masks)
     timing = os.environ.get("MMLSPARK_TPU_GBDT_TIMING", "") not in ("", "0")
     t0 = _now() if timing else 0.0
-    _, ys = jax.lax.scan(body, (score0, comp0), xs, length=iters)
+
+    # Chunk the scan so one dispatch stays under the device-runtime bound
+    # (~40-60s of continuous execution crashed/restarted the worker on the
+    # tunnelled chip at 2M+ rows x 50 iters): bound row*iteration work per
+    # dispatch; the (score, comp) carry stays device-resident across chunks,
+    # so the host cost is one small fetch per chunk.
+    budget = int(os.environ.get("MMLSPARK_TPU_SCAN_CHUNK_ROWS", str(2 * 10**7)))
+    ipc = max(1, min(iters, budget // max(n, 1)))
+    n_chunks = -(-iters // ipc)
+
+    carry = (score0, comp0)
+    host_chunks = []
+    done = 0
+    while done < iters:
+        # EVERY chunk runs the same static length (one compiled program): a
+        # short final chunk overgrows up to ipc-1 surplus trees (same xs rows
+        # repeated) that are simply dropped below — one tree of wasted
+        # compute beats a second multi-second XLA compile
+        xs_c = None
+        if xs is not None:
+            idx = np.minimum(np.arange(done, done + ipc), iters - 1)
+            xs_c = {k: v[idx] for k, v in xs.items()}
+        carry, ys = jax.lax.scan(body, carry, xs_c, length=ipc)
+        host_chunks.append(jax.device_get(ys))
+        done += ipc
+    host = jax.tree.map(lambda *c: np.concatenate(c, axis=0), *host_chunks) \
+        if len(host_chunks) > 1 else host_chunks[0]
+    host = jax.tree.map(lambda a: a[:iters], host)
     if timing:
-        print(f"[gbdt-scan] trace+dispatch {_now() - t0:.3f}s", flush=True)
-        t0 = _now()
-    host = jax.device_get(ys)
-    if timing:
-        print(f"[gbdt-scan] device exec+fetch {_now() - t0:.3f}s", flush=True)
+        print(f"[gbdt-scan] exec+fetch ({n_chunks} chunk(s) of <= {ipc}) "
+              f"{_now() - t0:.3f}s", flush=True)
         t0 = _now()
 
     for it in range(iters):
@@ -717,29 +757,44 @@ def train(params: TrainParams,
     import jax
     import jax.numpy as jnp
 
-    shard_put = None
+    from .pallas_hist import CHUNK
+
+    # Pad rows so every device array is a CHUNK multiple (the histogram
+    # kernel would otherwise jnp.pad inside jit — a whole-array copy that
+    # OOMed the 10M-row bench) and, when sharded, a per-shard CHUNK
+    # multiple. Padded rows: NaN features (bin 0), zero label/weight,
+    # excluded from training via pad_mask (empty-partition IgnoreStatus
+    # parity, TrainUtils.scala:332-341).
+    shard_put = bins_put = None
+    n_shards = 1
     if mesh is not None:
         from ..parallel.mesh import DATA_AXIS, data_sharding
 
         n_shards = int(mesh.shape.get(DATA_AXIS, 1))
-        if n_shards > 1:
-            pad = (-len(y)) % n_shards
-            if pad:
-                X = np.concatenate([X, np.full((pad, X.shape[1]), np.nan)])
-                y = np.concatenate([y, np.zeros(pad)])
-                if weights is not None:
-                    weights = np.concatenate([weights, np.zeros(pad)])
-                if groups is not None:
-                    groups = np.concatenate([groups, np.full(pad, -1)])
-            sharding = data_sharding(mesh)
-            shard_put = lambda a: jax.device_put(a, sharding)
-            pad_mask = np.ones(len(y), dtype=bool)
-            if pad:
-                pad_mask[-pad:] = False
+    row_mult = CHUNK * max(n_shards, 1)
+    pad = (-len(y)) % row_mult
+    if pad:
+        X = np.concatenate([X, np.full((pad, X.shape[1]), np.nan)])
+        y = np.concatenate([y, np.zeros(pad)])
+        if weights is not None:
+            weights = np.concatenate([weights, np.zeros(pad)])
+        if groups is not None:
+            groups = np.concatenate([groups, np.full(pad, -1)])
+    if n_shards > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.mesh import DATA_AXIS, data_sharding
+
+        sharding = data_sharding(mesh)
+        shard_put = lambda a: jax.device_put(a, sharding)
+        # feature-major bins shard the ROW dim, which is dim 1
+        bins_sharding = NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
+        bins_put = lambda a: jax.device_put(a, bins_sharding)
+    pad_mask = np.ones(len(y), dtype=bool)
+    if pad:
+        pad_mask[-pad:] = False
 
     n, num_f = X.shape
-    if shard_put is None:
-        pad_mask = np.ones(n, dtype=bool)
     n_real = int(pad_mask.sum())
     k = max(params.num_class, 1)
     objective = params.objective
@@ -757,7 +812,17 @@ def train(params: TrainParams,
     # the flat scatter indices in compute_histogram
     num_bins = mapper.max_num_bins
     put = shard_put or jax.device_put
-    bins_dev = put(jnp.asarray(bins, dtype=jnp.int32))
+    put_bins = bins_put or jax.device_put
+    # feature-major [F, N] device layout (column store, like LightGBM's own
+    # Dataset): minor dim rows -> no XLA lane padding (an [N, 28] int32
+    # array tiles 28 -> 128 lanes, a 4.6x HBM blowup at 10M rows)
+    bins_fm = np.ascontiguousarray(bins.T)
+    if num_bins <= 256:
+        # ship bins as uint8 (4x less H2D — at 10M rows that's 280 MB vs
+        # 1.1 GB through the host link) and widen once on device
+        bins_dev = _widen_bins(put_bins(jnp.asarray(bins_fm.astype(np.uint8))))
+    else:
+        bins_dev = put_bins(jnp.asarray(bins_fm, dtype=jnp.int32))
 
     labels = put(jnp.asarray(y, dtype=jnp.float32))
     w_dev = put(jnp.asarray(weights, dtype=jnp.float32)) if weights is not None else None
@@ -824,7 +889,7 @@ def train(params: TrainParams,
             ensure_compile_cache()
             _train_scan(params, config, booster, mapper, bins_dev, labels,
                         w_dev, scores, n, num_f, num_bins, k, lr,
-                        row_masks, feat_masks)
+                        row_masks, feat_masks, pad_mask=pad_mask)
             if is_rf and booster.trees:
                 inv = 1.0 / len(booster.trees)
                 for gtrees in booster.trees:
@@ -882,8 +947,10 @@ def train(params: TrainParams,
             g_abs = np.abs(np.asarray(jax.device_get(g)))
             if g_abs.ndim == 2:
                 g_abs = g_abs.sum(axis=1)
-            top_n = int(n * params.top_rate)
-            other_n = int(n * params.other_rate)
+            # pad rows sit at the end; goss ranks/samples REAL rows only
+            g_abs = g_abs[:n_real]
+            top_n = int(n_real * params.top_rate)
+            other_n = int(n_real * params.other_rate)
             order = np.argsort(-g_abs)
             row_mask = np.zeros(n, dtype=bool)
             row_mask[order[:top_n]] = True
@@ -983,10 +1050,11 @@ def train(params: TrainParams,
                     log(f"early stopping at iteration {it + 1}, best {best_iter}")
                 break
         elif log and not params.train_metric and (it + 1) % 10 == 0:
-            host_sc = _host_scores()
+            host_sc = _host_scores()[:n_real]
             train_scores = host_sc[:, 0] if k == 1 else host_sc
-            m = eval_metric(metric, train_scores, np.asarray(y, dtype=np.float64),
-                            groups)
+            m = eval_metric(metric, train_scores,
+                            np.asarray(y[:n_real], dtype=np.float64),
+                            groups[:n_real] if groups is not None else None)
             log(f"[{it + 1}] train {metric}={m:.6f}")
 
     if is_rf and booster.trees:

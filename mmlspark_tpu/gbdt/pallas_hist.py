@@ -5,19 +5,23 @@ lightgbm/TrainUtils.scala:170-233) is a scatter-add of per-row (grad, hess,
 count) triples into [F, B] bins. XLA lowers ``hist.at[idx].add(vals)`` to a
 serialized sort-major scatter on TPU — correct but far off the roofline.
 
-This kernel reformulates the scatter as a **one-hot contraction on the MXU**:
+This kernel reformulates the scatter as a **one-hot contraction on the MXU**
+over FEATURE-MAJOR inputs (bins [F, N], vals [3, N] — minor dim rows, so the
+HBM arrays carry no lane padding; an [N, 28] int32 layout tiles 28 -> 128
+lanes, a 4.6x HBM blowup that OOMed the 10M-row bench):
 
-    hist[f, b, c] = sum_n (bins[n, f] == b) * vals[n, c]
-                  = (onehot(bins[:, f]).T @ vals).T        # [3, B] per feature
+    hist[f, b, c] = sum_n (bins[f, n] == b) * vals[c, n]
+                  = vals @ onehot_t(bins[f, :]).T          # [3, B] per feature
 
-The one-hot matrix is materialized only inside VMEM, one [CHUNK, B_pad] tile
-at a time, and immediately contracted — it never exists in HBM, so HBM traffic
-is exactly the input reads (bins, vals) plus one [3, F*B_pad] accumulator.
-The grid is 1-D over row chunks with the accumulator block resident in VMEM
-across the whole grid (standard Pallas reduction pattern); the feature dim is
-never block-sliced (Mosaic wants minor dims 128-divisible or full-array) —
-instead, inputs wider than FMAX features are split into separate pallas_call
-slabs on the host, bounding the accumulator at [3, FMAX*B_pad].
+The transposed one-hot ([B_pad, CHUNK]: the feature row broadcast over
+sublanes against a dim-0 iota) is materialized only inside VMEM, one chunk
+at a time, and immediately contracted — it never exists in HBM, so HBM
+traffic is exactly the input reads (bins, vals) plus one [3, F*B_pad]
+accumulator. The grid is 1-D over row chunks with the accumulator block
+resident in VMEM across the whole grid (standard Pallas reduction pattern);
+the feature dim is never block-sliced — inputs wider than FMAX features are
+split into separate pallas_call slabs on the host, bounding the accumulator
+at [3, FMAX*B_pad].
 
 Bin counts are padded to a multiple of 128 (the TPU lane width) so every
 slice write is tile-aligned; features are padded to the feature-tile size.
@@ -57,12 +61,17 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _hist_kernel(bins_ref, vals_ref, out_ref, *, nf: int, b_pad: int):
-    """One row-chunk grid cell.
+    """One row-chunk grid cell, feature-major layout.
 
-    bins_ref: [CHUNK, nf] i32, vals_ref: [CHUNK, 3] f32 (pre-masked),
+    bins_ref: [nf, CHUNK] int (feature-major: minor dim = rows, so the HBM
+    array carries no lane padding — an [N, F] layout tiles F up to 128 lanes,
+    a 4.6x HBM blowup at F=28 that OOMed the 10M-row bench),
+    vals_ref: [3, CHUNK] f32 (pre-masked channels x rows),
     out_ref:  [3, nf*B_pad] f32 accumulator, VMEM-resident across the grid.
-    (Mosaic requires block minor dims 128-divisible or full-array, so the
-    feature dim is never block-sliced — the grid runs over row chunks only.)
+
+    The one-hot is built TRANSPOSED ([B_pad, CHUNK]: sublane broadcast of the
+    feature row against a dim-0 iota) and contracted over rows on the MXU —
+    no in-kernel transposes or minor-dim reshapes (Mosaic rejects those).
     """
     j = pl.program_id(0)
 
@@ -70,14 +79,16 @@ def _hist_kernel(bins_ref, vals_ref, out_ref, *, nf: int, b_pad: int):
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    vals = vals_ref[...]                                     # [CHUNK, 3]
+    vals = vals_ref[...]                                     # [3, CHUNK]
+    chunk = vals.shape[1]
+    iota0 = jax.lax.broadcasted_iota(jnp.int32, (b_pad, chunk), 0)
     for f in range(nf):                                      # static unroll
-        col = bins_ref[:, f : f + 1]                         # [CHUNK, 1]
-        iota = jax.lax.broadcasted_iota(jnp.int32, (col.shape[0], b_pad), 1)
-        onehot = (col == iota).astype(jnp.float32)           # [CHUNK, B_pad]
+        col = bins_ref[f : f + 1, :].astype(jnp.int32)       # [1, CHUNK]
+        onehot_t = (jnp.broadcast_to(col, (b_pad, chunk))
+                    == iota0).astype(jnp.float32)            # [B_pad, CHUNK]
         acc = jax.lax.dot_general(                           # [3, B_pad] on MXU
-            vals, onehot,
-            dimension_numbers=(((0,), (0,)), ((), ())),
+            vals, onehot_t,
+            dimension_numbers=(((1,), (1,)), ((), ())),
             # HIGHEST = full-f32 MXU passes: gradient sums feed split gains,
             # and the default bf16 rounding of vals costs ~1e-3 relative.
             precision=jax.lax.Precision.HIGHEST,
@@ -86,16 +97,16 @@ def _hist_kernel(bins_ref, vals_ref, out_ref, *, nf: int, b_pad: int):
 
 
 def _hist_slab(bins_slab, vals, b_pad: int, interpret: bool):
-    """[N_pad, Fs] bins + [N_pad, 3] masked vals -> [3, Fs*b_pad] sums."""
-    n_pad, fs = bins_slab.shape
+    """[Fs, N_pad] bins + [3, N_pad] masked vals -> [3, Fs*b_pad] sums."""
+    fs, n_pad = bins_slab.shape
     n_chunks = n_pad // CHUNK
     return pl.pallas_call(
         functools.partial(_hist_kernel, nf=fs, b_pad=b_pad),
         grid=(n_chunks,),
         in_specs=[
-            pl.BlockSpec((CHUNK, fs), lambda j: (j, 0),
+            pl.BlockSpec((fs, CHUNK), lambda j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((CHUNK, 3), lambda j: (j, 0),
+            pl.BlockSpec((3, CHUNK), lambda j: (0, j),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((3, fs * b_pad), lambda j: (0, 0),
@@ -112,42 +123,48 @@ def _hist_slab(bins_slab, vals, b_pad: int, interpret: bool):
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
-def compute_histogram_mxu(bins, grad, hess, row_mask, num_bins: int,
+def compute_histogram_mxu(bins_fm, grad, hess, row_mask, num_bins: int,
                           interpret: bool = False):
-    """[N,F] int bins + per-row grad/hess + row mask -> [F, num_bins, 3] sums.
+    """[F,N] feature-major int bins + per-row grad/hess + row mask ->
+    [F, num_bins, 3] sums.
 
     Drop-in replacement for histogram.compute_histogram's XLA scatter path.
+    Rows are padded to a CHUNK multiple here; callers that keep N a CHUNK
+    multiple (booster.train pads once on host) make the pad a no-op.
     """
-    n, f = bins.shape
+    f, n = bins_fm.shape
     b_pad = max(128, _round_up(num_bins, 128))
     n_pad = _round_up(max(n, 1), CHUNK)
 
     m = row_mask.astype(jnp.float32)
-    vals = jnp.stack([grad * m, hess * m, m], axis=-1).astype(jnp.float32)
-    vals = jnp.pad(vals, ((0, n_pad - n), (0, 0)))
-    bins_p = jnp.pad(bins.astype(jnp.int32), ((0, n_pad - n), (0, 0)))
+    # channel-major [3, N]: minor dim rows -> no lane padding (an [N, 3]
+    # layout pads 3 -> 128 lanes, a 42x HBM blowup at large N)
+    vals = jnp.stack([grad * m, hess * m, m], axis=0).astype(jnp.float32)
+    vals = jnp.pad(vals, ((0, 0), (0, n_pad - n)))
+    bins_p = jnp.pad(bins_fm, ((0, 0), (0, n_pad - n)))
 
     slabs = []
     for f0 in range(0, f, FMAX):
         fs = min(FMAX, f - f0)
-        out = _hist_slab(bins_p[:, f0 : f0 + fs], vals, b_pad, interpret)
+        out = _hist_slab(bins_p[f0 : f0 + fs, :], vals, b_pad, interpret)
         slabs.append(out.reshape(3, fs, b_pad))
     hist = jnp.concatenate(slabs, axis=1)        # [3, F, b_pad]
     return hist.transpose(1, 2, 0)[:, :num_bins, :]
 
 
-def compute_histogram_sharded(bins, grad, hess, row_mask, num_bins: int,
+def compute_histogram_sharded(bins_fm, grad, hess, row_mask, num_bins: int,
                               interpret: bool = False):
     """Row-sharded variant: per-shard Pallas histogram + psum over the row
     axes — the multi-chip data-parallel path (LightGBM's socket-ring
-    allreduce as one XLA collective). ``bins`` must be a concrete jax.Array
-    with a NamedSharding whose spec shards dim 0."""
+    allreduce as one XLA collective). ``bins_fm`` is feature-major [F, N]
+    and must be a concrete jax.Array with a NamedSharding whose spec shards
+    dim 1 (the row dim)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from jax import shard_map
 
-    sh = bins.sharding
+    sh = bins_fm.sharding
     mesh = sh.mesh
-    row_axes = sh.spec[0]
+    row_axes = sh.spec[1]
     specs = (sh.spec, P(row_axes), P(row_axes), P(row_axes))
 
     # check_vma=False: pallas_call can't declare varying-mesh-axes metadata
@@ -158,12 +175,13 @@ def compute_histogram_sharded(bins, grad, hess, row_mask, num_bins: int,
                                       interpret=interpret)
         return jax.lax.psum(local, row_axes)
 
-    return _go(bins, grad, hess, row_mask)
+    return _go(bins_fm, grad, hess, row_mask)
 
 
 def _row_sharded_spec(x):
-    """Return True if x is a concrete array with a NamedSharding that splits
-    dim 0 over >1 device (the GBDT data-parallel layout)."""
+    """Return True if x is a concrete feature-major [F, N] array with a
+    NamedSharding that splits dim 1 (rows) over >1 device (the GBDT
+    data-parallel layout)."""
     from jax.sharding import NamedSharding
 
     if not isinstance(x, jax.Array) or isinstance(x, jax.core.Tracer):
@@ -172,7 +190,7 @@ def _row_sharded_spec(x):
     if not isinstance(sh, NamedSharding) or len(sh.device_set) <= 1:
         return False
     spec = sh.spec
-    return len(spec) > 0 and spec[0] is not None
+    return len(spec) > 1 and spec[1] is not None
 
 
 def dispatch(bins, grad, hess, row_mask, num_bins: int):

@@ -160,9 +160,10 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             seed=self.get("seed"),
         )
 
-    def _extract(self, df: DataFrame):
+    def _extract(self, df: DataFrame, data=None):
         """DataFrame -> (X, y, weights, init_scores, valid_mask) numpy arrays."""
-        data = df.collect()
+        if data is None:
+            data = df.collect()
         X = stack_rows(data[self.get_or_throw("featuresCol")], np.float64)
         y = np.asarray(data[self.get_or_throw("labelCol")], dtype=np.float64)
         w = None
@@ -177,11 +178,50 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
                                     dtype=bool)
         return X, y, w, init_scores, valid_mask
 
+    def _fit_booster_sparse(self, data, objective: str,
+                            num_class: int) -> Booster:
+        """CSR training for sparse-row features (TextFeaturizer / VW
+        featurizer output) — never densifies, so 2^18-wide hashTF spaces
+        train in O(nnz) memory (generateSparseDataset parity,
+        lightgbm/TrainUtils.scala:23-66)."""
+        from .sparse import SparseDataset, train_sparse
+
+        y = np.asarray(data[self.get_or_throw("labelCol")], dtype=np.float64)
+        w = None
+        if self.get("weightCol"):
+            w = np.asarray(data[self.get("weightCol")], dtype=np.float64)
+        for unsupported in ("validationIndicatorCol", "initScoreCol",
+                            "categoricalSlotNames", "categoricalSlotIndexes"):
+            if self.get(unsupported):
+                raise ValueError(
+                    f"{unsupported} is not supported with sparse features "
+                    f"yet — densify explicitly (FastVectorAssembler) for "
+                    f"that configuration")
+        params = self._train_params(objective, num_class)
+        if (params.bagging_fraction < 1.0 or params.feature_fraction < 1.0
+                or params.pos_bagging_fraction < 1.0
+                or params.neg_bagging_fraction < 1.0):
+            raise ValueError(
+                "bagging/feature subsampling is not supported with sparse "
+                "features yet — densify explicitly for that configuration")
+        ds = SparseDataset.from_rows(
+            data[self.get_or_throw("featuresCol")],
+            max_bin=min(params.max_bin, 255))
+        return train_sparse(params, ds, y, weights=w)
+
     def _fit_booster(self, df: DataFrame, objective: str, num_class: int = 1,
                      groups: Optional[np.ndarray] = None) -> Booster:
         import logging
 
-        X, y, w, init_scores, valid_mask = self._extract(df)
+        from ..parallel.batching import is_sparse_row
+
+        data = df.collect()  # ONE materialization for sniff + either path
+        fcol = data[self.get_or_throw("featuresCol")]
+        first = next((v for v in fcol if v is not None), None)
+        if is_sparse_row(first) and groups is None:
+            return self._fit_booster_sparse(data, objective, num_class)
+
+        X, y, w, init_scores, valid_mask = self._extract(df, data)
         params = self._train_params(objective, num_class)
         names = self.get("categoricalSlotNames")
         if names:
@@ -283,7 +323,23 @@ class _LightGBMModelBase(Model, HasFeaturesCol):
         return self._device_ensemble
 
     def _raw_scores(self, part) -> np.ndarray:
-        X = stack_rows(part[self.get_or_throw("featuresCol")], np.float32)
+        from ..parallel.batching import is_sparse_row
+
+        col = part[self.get_or_throw("featuresCol")]
+        first = next((v for v in col if v is not None), None)
+        if is_sparse_row(first):
+            # CSR predict: no densification (PredictForCSRSingle parity,
+            # lightgbm/LightGBMBooster.scala:21-148)
+            from .sparse import predict_csr, rows_to_csr
+
+            b = self.booster
+            n_iter = b.best_iteration if b.best_iteration > 0 \
+                else len(b.trees)
+            indptr, indices, values, _ = rows_to_csr(col, filter_zeros=False)
+            raw = predict_csr(b.trees[:n_iter], indptr, indices, values,
+                              max(b.params.num_class, 1))
+            return raw + b.base_score[None, :]
+        X = stack_rows(col, np.float32)
         raw = self._ensemble().predict_raw(X)
         return raw + self.booster.base_score[None, :]
 

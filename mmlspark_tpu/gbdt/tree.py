@@ -138,7 +138,7 @@ class _Node:
         self.split = split    # SplitInfo (host numpy) or None
 
 
-def _grow_tree_device_body(bins, grad, hess, row_mask, node_of_row,
+def _grow_tree_device_body(bins_fm, grad, hess, row_mask, node_of_row,
                            lambda_l1, lambda_l2, min_sum_hessian,
                            min_gain_to_split, feature_mask, *, num_bins: int,
                            max_nodes: int, min_data_in_leaf: int,
@@ -195,12 +195,17 @@ def _grow_tree_device_body(bins, grad, hess, row_mask, node_of_row,
     gather_caps: Tuple[int, ...] = ()
     if psum_axis is None and os.environ.get(
             "MMLSPARK_TPU_NO_GATHER_HIST", "") in ("", "0"):
-        n_rows = int(bins.shape[0])
+        n_rows = int(bins_fm.shape[1])
         caps = []
-        c = (n_rows // 2 + 511) // 512 * 512
-        while c >= 4096 and len(caps) < 6:
+        # Tiers start at n/8: the row compaction is an axis-1 gather on the
+        # [F, N] column store, measured ~19 ms per N/2 rows at N=1M — a
+        # gathered histogram only beats the masked full scan when the child
+        # is well under a quarter of the rows. /2 steps bound tier waste at
+        # 2x; at most 5 tiers (each branch compiles its own Pallas kernel).
+        c = (n_rows // 8 + 511) // 512 * 512
+        while c >= max(4096, n_rows // 128) and len(caps) < 5:
             caps.append(c)
-            c = (c // 4 + 511) // 512 * 512
+            c = (c // 2 + 511) // 512 * 512
         if caps:
             gather_caps = tuple(caps)
 
@@ -208,19 +213,19 @@ def _grow_tree_device_body(bins, grad, hess, row_mask, node_of_row,
         """Histogram of the masked rows, streaming only a tier-sized
         compacted buffer when the tiers are enabled."""
         if not gather_caps:
-            return hist_fn(bins, grad, hess, small_mask, num_bins)
+            return hist_fn(bins_fm, grad, hess, small_mask, num_bins)
 
         def make_branch(cap):
             def br(_):
                 idx = jnp.nonzero(small_mask, size=cap, fill_value=0)[0]
                 valid = jnp.arange(cap, dtype=jnp.int32) < small_cnt
-                return base_hist(jnp.take(bins, idx, axis=0),
+                return base_hist(jnp.take(bins_fm, idx, axis=1),
                                  jnp.take(grad, idx), jnp.take(hess, idx),
                                  valid, num_bins)
             return br
 
         def full(_):
-            return hist_fn(bins, grad, hess, small_mask, num_bins)
+            return hist_fn(bins_fm, grad, hess, small_mask, num_bins)
 
         # caps are descending; choose the smallest tier that fits (small
         # children are always <= N/2, so tier 0 is a guaranteed fallback)
@@ -240,7 +245,7 @@ def _grow_tree_device_body(bins, grad, hess, row_mask, node_of_row,
         return H.find_best_split(hist, lambda_l1, lambda_l2, min_sum_hessian,
                                  min_data_in_leaf, fm)
 
-    root_hist = hist_fn(bins, grad, hess, row_mask, num_bins)
+    root_hist = hist_fn(bins_fm, grad, hess, row_mask, num_bins)
     root_sums = H.total_sums(grad, hess, row_mask)
     if psum_axis is not None:
         root_sums = jax.lax.psum(root_sums, psum_axis)
@@ -289,7 +294,7 @@ def _grow_tree_device_body(bins, grad, hess, row_mask, node_of_row,
         dchild = st["depth"][leaf] + 1
 
         node_of_row = H.partition_rows(
-            jnp.take(bins, f, axis=1), st["node_of_row"], leaf, t, dl, lid, rid)
+            jnp.take(bins_fm, f, axis=0), st["node_of_row"], leaf, t, dl, lid, rid)
 
         small_is_left = lsum[2] <= rsum[2]
         small_id = jnp.where(small_is_left, lid, rid)
@@ -299,8 +304,11 @@ def _grow_tree_device_body(bins, grad, hess, row_mask, node_of_row,
         small_cnt = jnp.sum(small_mask, dtype=jnp.int32)
         small_hist = small_child_hist(small_mask, small_cnt)
         big_hist = H.subtract_histogram(st["hists"][leaf], small_hist)
-        s_small = best(small_hist)
-        s_big = best(big_hist)
+        s_pair = H.find_best_split_pair(
+            jnp.stack([small_hist, big_hist]), lambda_l1, lambda_l2,
+            min_sum_hessian, min_data_in_leaf, fm)
+        s_small = jax.tree.map(lambda x: x[0], s_pair)
+        s_big = jax.tree.map(lambda x: x[1], s_pair)
 
         cg = st["cand_gain"].at[leaf].set(neg_inf)
         cf, cb, cd = st["cand_feature"], st["cand_bin"], st["cand_dleft"]
@@ -383,7 +391,7 @@ def _grow_tree_device_sharded(bins, grad, hess, row_mask, node_of_row,
     from . import pallas_hist
 
     sh = bins.sharding
-    mesh, row_axes = sh.mesh, sh.spec[0]
+    mesh, row_axes = sh.mesh, sh.spec[1]  # bins_fm [F, N]: rows on dim 1
     # MMLSPARK_TPU_PALLAS_INTERPRET=1: run the MXU kernel in interpreter mode
     # (CPU tests of the psum'd-Pallas branch production TPU meshes take)
     interpret = os.environ.get("MMLSPARK_TPU_PALLAS_INTERPRET",
@@ -491,13 +499,15 @@ def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
     return tree, np.asarray(jax.device_get(rows_dev))
 
 
-def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
+def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
               config: GrowerConfig, bin_mapper, feature_mask=None,
               node_of_row=None, device_rows: bool = False
               ) -> Tuple[Tree, np.ndarray]:
     """Grow one tree; returns (tree, leaf_node_of_row).
 
-    ``bins_dev``: [N,F] int32 (device). ``grad``/``hess``: [N] f32 (device).
+    ``bins_fm``: [F,N] int (device, FEATURE-MAJOR — the canonical column-store
+    layout: minor dim rows avoids XLA lane padding; LightGBM stores features
+    column-wise the same way). ``grad``/``hess``: [N] f32 (device).
     ``row_mask``: [N] bool — bagging/goss row subset. ``feature_mask``: [F] bool.
     ``leaf_node_of_row`` maps every (masked-in) row to its final node id, so the
     booster can update scores with one gather instead of re-predicting.
@@ -507,7 +517,7 @@ def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
 
     from . import pallas_hist
 
-    n, num_f = bins_dev.shape
+    num_f, n = bins_fm.shape
     if node_of_row is None:
         node_of_row = jnp.zeros(n, dtype=jnp.int32)
 
@@ -518,11 +528,11 @@ def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
     # or MMLSPARK_TPU_NO_FUSED_TREE=1): host-orchestrated per-split calls,
     # whose compute_histogram dispatch runs the per-shard Pallas kernel +
     # psum for sharded inputs.
-    row_sharded = bool(pallas_hist._row_sharded_spec(bins_dev))
-    use_mxu = pallas_hist.use_mxu_single_device(bins_dev)
+    row_sharded = bool(pallas_hist._row_sharded_spec(bins_fm))
+    use_mxu = pallas_hist.use_mxu_single_device(bins_fm)
 
     if _fused_tree_enabled(2 * config.num_leaves - 1, num_f, num_bins):
-        return _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins,
+        return _grow_tree_fused(bins_fm, grad, hess, row_mask, num_bins,
                                 config, bin_mapper, feature_mask, node_of_row,
                                 device_rows=device_rows,
                                 row_sharded=row_sharded)
@@ -545,7 +555,7 @@ def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
             feature_mask)
         return jax.device_get(split)
 
-    root_hist = H.compute_histogram(bins_dev, grad, hess, row_mask, num_bins)
+    root_hist = H.compute_histogram(bins_fm, grad, hess, row_mask, num_bins)
     root_sums = np.asarray(jax.device_get(
         H.total_sums(grad, hess, row_mask)), dtype=np.float64)
     counts[0] = int(root_sums[2])
@@ -610,11 +620,11 @@ def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
             # Pallas kernel + psum (the fused jit's in-graph scatter would
             # lose ~13x and can OOM at large N — pallas_hist.py:30-35)
             node_of_row = H.partition_rows(
-                bins_dev[:, f], node_of_row, node.id,
+                bins_fm[f], node_of_row, node.id,
                 np.int32(t), bool(s.default_left), np.int32(lid),
                 np.int32(rid))
             small_mask = row_mask & (node_of_row == small_id)
-            small_hist = H.compute_histogram(bins_dev, grad, hess,
+            small_hist = H.compute_histogram(bins_fm, grad, hess,
                                              small_mask, num_bins)
             big_hist = H.subtract_histogram(node.hist, small_hist)
             split_small = eval_node(small_hist)
@@ -626,7 +636,7 @@ def grow_tree(bins_dev, grad, hess, row_mask, num_bins: int,
             # loop used to be dispatch-bound at 4-5 round trips per split)
             node_of_row, small_hist, big_hist, split_small, split_big = \
                 H.fused_split_step(
-                    bins_dev, grad, hess, row_mask, node_of_row, node.hist,
+                    bins_fm, grad, hess, row_mask, node_of_row, node.hist,
                     np.int32(f), np.int32(t), bool(s.default_left),
                     np.int32(node.id), np.int32(lid), np.int32(rid),
                     np.int32(small_id),

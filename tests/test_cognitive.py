@@ -40,6 +40,15 @@ def json_resp(obj, headers=None, code=200):
     return HTTPResponseData(code, "OK", json.dumps(obj).encode(), headers or {})
 
 
+def _ad_entire(flags):
+    """Schema-complete ADEntireResponse body (AnomalyDetectorSchemas.scala)."""
+    n = len(flags)
+    return {"isAnomaly": flags, "isPositiveAnomaly": flags,
+            "isNegativeAnomaly": [False] * n, "period": 0,
+            "expectedValues": [0.0] * n, "upperMargins": [1.0] * n,
+            "lowerMargins": [1.0] * n}
+
+
 class TestTextSentiment:
     def test_documents_body_and_key_header(self):
         svc = FakeService([json_resp({"documents": [{"id": "0", "score": 0.9}]}),
@@ -70,7 +79,7 @@ class TestTextSentiment:
 
 class TestVision:
     def test_ocr_url_params(self):
-        svc = FakeService([json_resp({"regions": []})])
+        svc = FakeService([json_resp({"language": "en", "regions": []})])
         df = DataFrame.from_dict({"url": ["http://img/x.jpg"]})
         stage = OCR(outputCol="ocr", handler=svc, url="https://fake/vision/ocr")
         stage.set_col("imageUrl", "url")
@@ -98,7 +107,10 @@ class TestVision:
                              {"Operation-Location": "https://fake/op/123"}),
             json_resp({"status": "Running"}),
             json_resp({"status": "Succeeded",
-                       "recognitionResult": {"lines": [{"text": "hello"}]}}),
+                       "recognitionResult": {"lines": [
+                           {"boundingBox": [0, 0, 9, 9], "text": "hello",
+                            "words": [{"boundingBox": [0, 0, 9, 9],
+                                       "text": "hello"}]}]}}),
         ])
         df = DataFrame.from_dict({"url": ["http://img/1.jpg"]})
         stage = RecognizeText(outputCol="txt", handler=svc,
@@ -125,7 +137,8 @@ class TestVision:
 
 class TestSpeech:
     def test_audio_content_type(self):
-        svc = FakeService([json_resp({"DisplayText": "hello world"})])
+        svc = FakeService([json_resp({"RecognitionStatus": "Success",
+                                      "DisplayText": "hello world"})])
         df = DataFrame.from_dict({"audio": [b"RIFFfakewav"]})
         stage = SpeechToText(outputCol="stt", handler=svc, url="https://fake/stt")
         stage.set_col("audioData", "audio")
@@ -143,7 +156,7 @@ class TestAnomaly:
         def svc(req):
             body = json.loads(req.entity)
             n = len(body["series"])
-            return json_resp({"isAnomaly": [i == n - 1 for i in range(n)]})
+            return json_resp(_ad_entire([i == n - 1 for i in range(n)]))
 
         rows = []
         for g in ("a", "b"):
@@ -198,7 +211,7 @@ class TestReviewRegressions:
             calls.append(req)
             body = json.loads(req.entity)
             n = len(body["series"])
-            return json_resp({"isAnomaly": [False] * n})
+            return json_resp(_ad_entire([False] * n))
 
         rows = [{"grp": g, "timestamp": f"t{i}", "value": float(i)}
                 for g in ("a", "b") for i in range(10)]
@@ -250,3 +263,121 @@ class TestReviewRegressions:
         stage.set_scalar("height", 32)
         out = stage.transform(df)
         assert out.column("thumb")[0] == b"\xff\xd8jpegbytes"
+
+
+class TestTypedSchemas:
+    """Typed response bindings (cognitive/*Schemas.scala parity via
+    schemas.py): responses land as schema-checked structs, not raw JSON."""
+
+    def test_sentiment_typed_access(self):
+        from mmlspark_tpu.cognitive.schemas import SentimentResponse
+
+        svc = FakeService([json_resp(
+            {"documents": [{"id": "0", "score": 0.93}],
+             "errors": [{"id": "1", "message": "too long"}]})])
+        df = DataFrame.from_dict({"text": ["great"]})
+        stage = TextSentiment(outputCol="s", handler=svc, url="https://fake/ta")
+        stage.set_col("text", "text")
+        resp = stage.transform(df).column("s")[0]
+        assert isinstance(resp, SentimentResponse)
+        assert resp.documents[0].score == pytest.approx(0.93)
+        assert resp.documents[0].id == "0"
+        assert resp.errors[0].message == "too long"
+        # item access still works for dict-style consumers
+        assert resp["documents"][0]["score"] == pytest.approx(0.93)
+
+    def test_ocr_typed_regions(self):
+        from mmlspark_tpu.cognitive.schemas import OCRResponse
+
+        svc = FakeService([json_resp(
+            {"language": "en", "textAngle": 0.5, "orientation": "Up",
+             "regions": [{"boundingBox": "1,2,3,4", "lines": [
+                 {"boundingBox": "1,2,3,4", "words": [
+                     {"boundingBox": "1,2,3,4", "text": "hi"}]}]}]})])
+        df = DataFrame.from_dict({"url": ["http://img/x.jpg"]})
+        stage = OCR(outputCol="o", handler=svc, url="https://fake/ocr")
+        stage.set_col("imageUrl", "url")
+        resp = stage.transform(df).column("o")[0]
+        assert isinstance(resp, OCRResponse)
+        assert resp.regions[0].lines[0].words[0].text == "hi"
+        assert resp.textAngle == pytest.approx(0.5)
+
+    def test_detect_face_typed_rectangles(self):
+        svc = FakeService([json_resp([
+            {"faceId": "f1",
+             "faceRectangle": {"left": 10, "top": 20, "width": 30,
+                               "height": 40},
+             "faceAttributes": {"age": 31.5, "gender": "female",
+                                "emotion": {"happiness": 0.9}}}])])
+        df = DataFrame.from_dict({"url": ["http://img/f.jpg"]})
+        stage = DetectFace(outputCol="faces", handler=svc,
+                           url="https://fake/detect")
+        stage.set_col("imageUrl", "url")
+        faces = stage.transform(df).column("faces")[0]
+        assert faces[0].faceRectangle.left == 10
+        assert faces[0].faceAttributes.age == pytest.approx(31.5)
+        assert faces[0].faceAttributes.emotion.happiness == pytest.approx(0.9)
+
+    def test_anomaly_typed_response(self):
+        from mmlspark_tpu.cognitive import DetectAnomalies
+        from mmlspark_tpu.cognitive.schemas import ADEntireResponse
+
+        svc = FakeService([json_resp(_ad_entire([False, True]))])
+        df = DataFrame.from_dict({"series": [
+            [{"timestamp": "t0", "value": 1.0},
+             {"timestamp": "t1", "value": 99.0}]]}, num_partitions=1)
+        stage = DetectAnomalies(outputCol="a", handler=svc,
+                                url="https://fake/anomaly")
+        stage.set_col("series", "series")
+        stage.set_scalar("granularity", "daily")
+        resp = stage.transform(df).column("a")[0]
+        assert isinstance(resp, ADEntireResponse)
+        assert resp.isAnomaly == [False, True]
+        assert resp.upperMargins == [1.0, 1.0]
+
+    def test_schema_mismatch_lands_in_error_col(self):
+        # score must be a number: a string response fails the binding and the
+        # row gets an error instead of a silently-untyped struct
+        svc = FakeService([json_resp(
+            {"documents": [{"id": "0", "score": "very positive"}]})])
+        df = DataFrame.from_dict({"text": ["x"]})
+        stage = TextSentiment(outputCol="s", handler=svc, url="https://fake/ta")
+        stage.set_col("text", "text")
+        out = stage.transform(df)
+        assert out.column("s")[0] is None
+        err = out.column("errors")[0]
+        assert "score" in err and "number" in err
+
+    def test_typed_output_opt_out(self):
+        svc = FakeService([json_resp({"documents": [{"id": "0",
+                                                     "score": 0.5}]})])
+        df = DataFrame.from_dict({"text": ["x"]})
+        stage = TextSentiment(outputCol="s", handler=svc, url="https://fake/ta",
+                              typedOutput=False)
+        stage.set_col("text", "text")
+        resp = stage.transform(df).column("s")[0]
+        assert isinstance(resp, dict)  # raw JSON struct
+
+    def test_transform_schema_carries_response_schema(self):
+        from mmlspark_tpu.core.schema import Schema, ColType
+
+        stage = TextSentiment(outputCol="s", url="https://fake/ta")
+        stage.set_col("text", "text")
+        out = stage.transform_schema(Schema({"text": ColType.STRING}))
+        meta = out.meta("s")["response_schema"]
+        assert meta["struct"] == "SentimentResponse"
+        assert meta["fields"]["documents"]["array"]["fields"]["score"] == "float"
+
+    def test_speech_typed(self):
+        from mmlspark_tpu.cognitive.schemas import SpeechResponse
+
+        svc = FakeService([json_resp({"RecognitionStatus": "Success",
+                                      "DisplayText": "hi",
+                                      "NBest": [{"Confidence": 0.87,
+                                                 "Display": "hi"}]})])
+        df = DataFrame.from_dict({"audio": [b"RIFF"]})
+        stage = SpeechToText(outputCol="t", handler=svc, url="https://fake/stt")
+        stage.set_col("audioData", "audio")
+        resp = stage.transform(df).column("t")[0]
+        assert isinstance(resp, SpeechResponse)
+        assert resp.NBest[0].Confidence == pytest.approx(0.87)

@@ -34,6 +34,14 @@ def feature_df(X, y, extra=None, parts=2):
     return DataFrame.from_dict(d, num_partitions=parts)
 
 
+def fm(bins_nf):
+    """Row-major [N, F] host bins -> the feature-major [F, N] device layout
+    the histogram kernels take (column store, no XLA lane padding)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.ascontiguousarray(np.asarray(bins_nf).T))
+
+
 class TestBinning:
     def test_fit_transform_shapes(self):
         X = np.random.default_rng(0).normal(size=(100, 5))
@@ -76,7 +84,7 @@ class TestHistogram:
         grad = rng.normal(size=n).astype(np.float32)
         hess = rng.uniform(0.1, 1, size=n).astype(np.float32)
         mask = rng.random(n) < 0.7
-        hist = np.asarray(H.compute_histogram(bins, grad, hess, mask, b))
+        hist = np.asarray(H.compute_histogram(fm(bins), grad, hess, mask, b))
         for fi in range(f):
             for bi in range(b):
                 sel = (bins[:, fi] == bi) & mask
@@ -92,7 +100,7 @@ class TestHistogram:
         grad = np.where(bins[:, 1] <= 4, -1.0, 1.0).astype(np.float32)
         hess = np.ones(n, dtype=np.float32)
         mask = np.ones(n, dtype=bool)
-        hist = H.compute_histogram(bins, grad, hess, mask, b)
+        hist = H.compute_histogram(fm(bins), grad, hess, mask, b)
         split = H.find_best_split(hist, 0.0, 0.0, 1e-3, 1)
         assert int(split.feature) == 1
         assert int(split.bin) == 4
@@ -105,10 +113,10 @@ class TestHistogram:
         hess = rng.uniform(0.1, 1, size=n).astype(np.float32)
         all_mask = np.ones(n, dtype=bool)
         sub_mask = rng.random(n) < 0.5
-        parent = np.asarray(H.compute_histogram(bins, grad, hess, all_mask, b))
-        child = np.asarray(H.compute_histogram(bins, grad, hess, sub_mask, b))
+        parent = np.asarray(H.compute_histogram(fm(bins), grad, hess, all_mask, b))
+        child = np.asarray(H.compute_histogram(fm(bins), grad, hess, sub_mask, b))
         sibling = np.asarray(H.subtract_histogram(parent, child))
-        direct = np.asarray(H.compute_histogram(bins, grad, hess, ~sub_mask, b))
+        direct = np.asarray(H.compute_histogram(fm(bins), grad, hess, ~sub_mask, b))
         np.testing.assert_allclose(sibling, direct, atol=1e-2)
 
 
@@ -122,7 +130,7 @@ class TestTreeGrowth:
         grad = (p - y).astype(np.float32)
         hess = np.maximum(p * (1 - p), 1e-6).astype(np.float32)
         tree, leaf_of_row = grow_tree(
-            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            fm(bins), jnp.asarray(grad), jnp.asarray(hess),
             jnp.ones(len(y), dtype=bool), m.max_num_bins,
             GrowerConfig(num_leaves=15, min_data_in_leaf=5), m)
         assert tree.num_leaves > 1
@@ -139,7 +147,7 @@ class TestTreeGrowth:
         grad = (0.5 - y).astype(np.float32)
         hess = np.full(len(y), 0.25, dtype=np.float32)
         tree, leaf_of_row = grow_tree(
-            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            fm(bins), jnp.asarray(grad), jnp.asarray(hess),
             jnp.ones(len(y), dtype=bool), m.max_num_bins,
             GrowerConfig(num_leaves=8, min_data_in_leaf=5), m)
         from mmlspark_tpu.gbdt.tree import predict_tree_binned
@@ -157,7 +165,7 @@ class TestTreeGrowth:
         grad = (0.5 - y).astype(np.float32)
         hess = np.full(len(y), 0.25, dtype=np.float32)
         tree, _ = grow_tree(
-            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            fm(bins), jnp.asarray(grad), jnp.asarray(hess),
             jnp.ones(len(y), dtype=bool), m.max_num_bins,
             GrowerConfig(num_leaves=16, min_data_in_leaf=5), m)
         np.testing.assert_allclose(predict_single_tree(tree, X),
@@ -177,7 +185,7 @@ class TestFusedTreeGrower:
         if with_missing:
             X[rng.random(X.shape) < 0.1] = np.nan
         m = BinMapper.fit(X, max_bin=32)
-        bins = jnp.asarray(m.transform(X))
+        bins = fm(m.transform(X))
         p = np.full_like(y, y.mean())
         grad = jnp.asarray((p - y).astype(np.float32))
         hess = jnp.asarray(np.maximum(p * (1 - p), 1e-6).astype(np.float32))
@@ -185,9 +193,9 @@ class TestFusedTreeGrower:
             else jnp.ones(len(y), dtype=bool)
         fmask = None
         if with_feature_mask:
-            fm = np.ones(X.shape[1], dtype=bool)
-            fm[rng.choice(X.shape[1], size=2, replace=False)] = False
-            fmask = jnp.asarray(fm)
+            fmask_np = np.ones(X.shape[1], dtype=bool)
+            fmask_np[rng.choice(X.shape[1], size=2, replace=False)] = False
+            fmask = jnp.asarray(fmask_np)
 
         monkeypatch.delenv("MMLSPARK_TPU_NO_FUSED_TREE", raising=False)
         monkeypatch.setenv("MMLSPARK_TPU_FUSED_TREE", "1")
@@ -241,7 +249,7 @@ class TestFusedTreeGrower:
         monkeypatch.delenv("MMLSPARK_TPU_NO_FUSED_TREE", raising=False)
         monkeypatch.setenv("MMLSPARK_TPU_FUSED_TREE", "1")
         # 4 rows with min_data_in_leaf=20: no split can satisfy constraints
-        bins = jnp.asarray(np.array([[1], [2], [3], [4]], dtype=np.int32))
+        bins = jnp.asarray(np.array([[1, 2, 3, 4]], dtype=np.int32))  # [F=1, N=4]
         grad = jnp.asarray(np.array([1, -1, 1, -1], dtype=np.float32))
         hess = jnp.ones(4, dtype=jnp.float32)
         m = BinMapper.fit(np.array([[1.0], [2.0], [3.0], [4.0]]), max_bin=8)
@@ -272,7 +280,7 @@ class TestFusedTreeGrower:
 
         X, y = synth_binary(9000, seed=13)
         m = BinMapper.fit(X, max_bin=64)
-        bins = jnp.asarray(m.transform(X))
+        bins = fm(m.transform(X))
         p = np.full_like(y, y.mean())
         grad = jnp.asarray((p - y).astype(np.float32))
         hess = jnp.asarray(np.maximum(p * (1 - p), 1e-6).astype(np.float32))
@@ -338,6 +346,23 @@ class TestFusedTreeGrower:
         np.testing.assert_allclose(b_scan.raw_predict(X),
                                    b_host.raw_predict(X), rtol=1e-3, atol=1e-4)
 
+    def test_scan_train_chunked_dispatch(self, monkeypatch):
+        """Forcing tiny per-dispatch budgets must produce the same model:
+        chunks share one compiled program, surplus overgrown trees are
+        dropped, and the score carry stays consistent across chunks."""
+        X, y = synth_binary(400, seed=6)
+        params = TrainParams(objective="binary", num_iterations=7,
+                             num_leaves=7, min_data_in_leaf=5)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SCAN_TRAIN", raising=False)
+        b_one = B.train(params, X, y)
+        # 3 chunks of 3 (last one overgrows 2 surplus trees)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_CHUNK_ROWS", str(3 * 512))
+        b_chunked = B.train(params, X, y)
+        assert len(b_chunked.trees) == 7
+        np.testing.assert_allclose(b_chunked.raw_predict(X),
+                                   b_one.raw_predict(X), rtol=1e-5, atol=1e-6)
+
     def test_scan_train_multiclass(self, monkeypatch):
         rng = np.random.default_rng(3)
         X = rng.normal(size=(300, 6))
@@ -375,13 +400,19 @@ class TestFusedTreeGrower:
         config = GrowerConfig(num_leaves=15, min_data_in_leaf=5)
 
         single, rows_single = grow_tree(
-            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            fm(bins), jnp.asarray(grad), jnp.asarray(hess),
             jnp.asarray(mask), m.max_num_bins, config, m)
 
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mmlspark_tpu.parallel.mesh import DATA_AXIS
+
         shard = data_sharding(mesh8)
+        bins_sh = NamedSharding(mesh8, P(None, DATA_AXIS))
         put = lambda a: jax.device_put(jnp.asarray(a), shard)  # noqa: E731
         sharded, rows_sharded = grow_tree(
-            put(bins.astype(np.int32)), put(grad), put(hess), put(mask),
+            jax.device_put(fm(bins.astype(np.int32)), bins_sh),
+            put(grad), put(hess), put(mask),
             m.max_num_bins, config, m)
 
         np.testing.assert_array_equal(sharded.feature, single.feature)
@@ -411,9 +442,14 @@ class TestFusedTreeGrower:
         grad = (0.5 - y).astype(np.float32)
         hess = np.full(len(y), 0.25, dtype=np.float32)
         config = GrowerConfig(num_leaves=7, min_data_in_leaf=5)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mmlspark_tpu.parallel.mesh import DATA_AXIS
+
         shard = data_sharding(mesh8)
+        bins_sh = NamedSharding(mesh8, P(None, DATA_AXIS))
         put = lambda a: jax.device_put(jnp.asarray(a), shard)  # noqa: E731
-        args = (put(bins), put(grad), put(hess),
+        args = (jax.device_put(fm(bins), bins_sh), put(grad), put(hess),
                 put(np.ones(len(y), dtype=bool)), m.max_num_bins, config, m)
 
         xla_tree, xla_rows = grow_tree(*args)
@@ -956,8 +992,7 @@ class TestFusedSplitStep:
 
         rng = np.random.default_rng(0)
         n, f, num_bins = 500, 6, 16
-        bins = jnp.asarray(rng.integers(0, num_bins, size=(n, f)),
-                           dtype=jnp.int32)
+        bins = fm(rng.integers(0, num_bins, size=(n, f)))
         grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
         hess = jnp.asarray(np.ones(n, dtype=np.float32))
         row_mask = jnp.asarray(rng.random(n) < 0.9)
@@ -970,7 +1005,7 @@ class TestFusedSplitStep:
         small_id = lid if float(s.left_sum[2]) <= float(s.right_sum[2]) else rid
 
         # multi-call reference
-        nor_ref = H.partition_rows(bins[:, fsel], node_of_row, np.int32(0),
+        nor_ref = H.partition_rows(bins[fsel], node_of_row, np.int32(0),
                                    np.int32(t), dleft, np.int32(lid),
                                    np.int32(rid))
         small_mask = row_mask & (nor_ref == small_id)
@@ -1010,8 +1045,7 @@ class TestFusedSplitStep:
 
         rng = np.random.default_rng(1)
         n, f, num_bins = 300, 4, 8
-        bins = jnp.asarray(rng.integers(0, num_bins, size=(n, f)),
-                           dtype=jnp.int32)
+        bins = fm(rng.integers(0, num_bins, size=(n, f)))
         grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
         hess = jnp.asarray(np.ones(n, dtype=np.float32))
         row_mask = jnp.ones(n, dtype=bool)

@@ -258,6 +258,60 @@ class TestServing:
             expected = model.transform(df.limit(1)).column("prediction")[0]
             assert pred == pytest.approx(expected, abs=1e-5)
 
+    def test_latency_stats_decomposition(self):
+        """The serving loop records per-request queue/compute/overhead; the
+        /_mmlspark/stats endpoint exposes them (verdict item: decompose the
+        model-endpoint latency into framework vs compute shares)."""
+        from mmlspark_tpu.serving import ServingServer
+        from mmlspark_tpu.serving.stages import parse_request
+
+        def echo(df):
+            parsed = parse_request(df, "data", parse="json")
+            return parsed.with_column(
+                "reply", lambda p: [float(np.sum(v)) for v in p["data"]])
+
+        with ServingServer(echo, port=0, max_wait_ms=0.0) as server:
+            server.warmup(json.dumps({"data": [1, 2]}).encode())
+            payload = json.dumps({"data": [1, 2, 3]}).encode()
+            for _ in range(12):
+                req = urllib.request.Request(server.address, data=payload,
+                                             method="POST")
+                with urllib.request.urlopen(req, timeout=15) as resp:
+                    resp.read()
+            # warmup batches bypass HTTP: they must not pollute the stats
+            s = server.stats.summary()
+            assert s["n"] == 12
+            for key in ("queue_ms", "compute_ms", "overhead_ms", "total_ms"):
+                assert s[key]["p50"] >= 0.0
+            # components must account for the total (within rounding)
+            assert s["total_ms"]["mean"] == pytest.approx(
+                s["queue_ms"]["mean"] + s["compute_ms"]["mean"]
+                + s["overhead_ms"]["mean"], abs=0.01)
+            # the stats endpoint serves the same summary
+            with urllib.request.urlopen(
+                    f"http://{server.host}:{server.port}/_mmlspark/stats",
+                    timeout=15) as resp:
+                remote = json.loads(resp.read())
+            assert remote["n"] >= 12
+
+    def test_warmup_precompiles_without_serving_replies(self):
+        """warmup() pushes synthetic batches through the transform (compiling
+        batch sizes 1 and max) without leaking replies or ids."""
+        from mmlspark_tpu.serving import ServingServer
+
+        seen_sizes = []
+
+        def transform(df):
+            data = df.collect()
+            seen_sizes.append(len(data["id"]))
+            return df.with_column("reply", lambda p: [b"ok"] * len(p["id"]))
+
+        server = ServingServer(transform, port=0, max_batch_size=16)
+        server.warmup(b"x")
+        assert seen_sizes == [1, 16]
+        assert server.requests_served == 0
+        assert server.stats.summary()["n"] == 0
+
     def test_server_error_isolation(self):
         def transform(df):
             raise RuntimeError("model exploded")

@@ -1,4 +1,9 @@
-"""Pallas histogram kernel vs the XLA scatter path (interpret mode on CPU)."""
+"""Pallas histogram kernel vs the XLA scatter path (interpret mode on CPU).
+
+Device bins are FEATURE-MAJOR [F, N] (column store: minor dim rows, no XLA
+lane padding); tests construct row-major [N, F] for readability and
+transpose at the device boundary.
+"""
 
 import numpy as np
 import pytest
@@ -8,6 +13,11 @@ import jax.numpy as jnp
 
 from mmlspark_tpu.gbdt import histogram as H
 from mmlspark_tpu.gbdt import pallas_hist
+
+
+def fm(bins_nf) -> jnp.ndarray:
+    """Row-major [N, F] host bins -> feature-major device layout."""
+    return jnp.asarray(np.ascontiguousarray(np.asarray(bins_nf).T))
 
 
 def _ref_hist(bins, grad, hess, mask, num_bins):
@@ -30,10 +40,10 @@ def test_pallas_matches_xla_and_numpy(n, f, b):
     mask = rng.uniform(size=n) < 0.7
 
     xla = np.asarray(H.compute_histogram_xla(
-        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        fm(bins), jnp.asarray(grad), jnp.asarray(hess),
         jnp.asarray(mask), b))
     pal = np.asarray(pallas_hist.compute_histogram_mxu(
-        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        fm(bins), jnp.asarray(grad), jnp.asarray(hess),
         jnp.asarray(mask), b,
         interpret=jax.default_backend() != "tpu"))
     ref = _ref_hist(bins, grad, hess, mask, b)
@@ -43,8 +53,28 @@ def test_pallas_matches_xla_and_numpy(n, f, b):
     np.testing.assert_allclose(pal, ref, rtol=1e-5, atol=1e-3)
 
 
+def test_uint8_bins_match_int32():
+    """uint8 feature-major bins (the 4x-smaller upload dtype) must produce
+    identical histograms after the on-device widen."""
+    from mmlspark_tpu.gbdt.booster import _widen_bins
+
+    rng = np.random.default_rng(5)
+    bins = rng.integers(0, 250, size=(300, 4)).astype(np.int32)
+    grad = rng.normal(size=300).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=300).astype(np.float32)
+    mask = jnp.ones(300, dtype=bool)
+    wide = np.asarray(pallas_hist.compute_histogram_mxu(
+        fm(bins), jnp.asarray(grad), jnp.asarray(hess), mask, 250,
+        interpret=jax.default_backend() != "tpu"))
+    narrow = np.asarray(pallas_hist.compute_histogram_mxu(
+        _widen_bins(fm(bins).astype(jnp.uint8)), jnp.asarray(grad),
+        jnp.asarray(hess), mask, 250,
+        interpret=jax.default_backend() != "tpu"))
+    np.testing.assert_array_equal(wide, narrow)
+
+
 def test_all_rows_masked_out():
-    bins = jnp.zeros((64, 2), dtype=jnp.int32)
+    bins = jnp.zeros((2, 64), dtype=jnp.int32)  # [F, N]
     z = jnp.zeros(64, dtype=jnp.float32)
     pal = np.asarray(pallas_hist.compute_histogram_mxu(
         bins, z, z, jnp.zeros(64, dtype=bool), 4,
@@ -60,7 +90,9 @@ def test_dispatch_respects_env(monkeypatch):
 
 def test_sharded_matches_xla(mesh8):
     """Per-shard Pallas + psum under shard_map == unsharded XLA scatter."""
-    from mmlspark_tpu.parallel.mesh import data_sharding
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mmlspark_tpu.parallel.mesh import DATA_AXIS, data_sharding
 
     rng = np.random.default_rng(3)
     n, f, b = 512, 6, 16
@@ -70,7 +102,8 @@ def test_sharded_matches_xla(mesh8):
     mask = rng.uniform(size=n) < 0.6
 
     sh = data_sharding(mesh8)
-    bins_d = jax.device_put(jnp.asarray(bins), sh)
+    bins_sh = NamedSharding(mesh8, P(None, DATA_AXIS))  # [F, N]: rows on dim 1
+    bins_d = jax.device_put(fm(bins), bins_sh)
     grad_d = jax.device_put(jnp.asarray(grad), sh)
     hess_d = jax.device_put(jnp.asarray(hess), sh)
     mask_d = jax.device_put(jnp.asarray(mask), sh)
@@ -79,6 +112,6 @@ def test_sharded_matches_xla(mesh8):
     got = np.asarray(pallas_hist.compute_histogram_sharded(
         bins_d, grad_d, hess_d, mask_d, b, interpret=True))
     want = np.asarray(H.compute_histogram_xla(
-        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        fm(bins), jnp.asarray(grad), jnp.asarray(hess),
         jnp.asarray(mask), b))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)

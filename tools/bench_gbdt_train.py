@@ -1,28 +1,51 @@
 """GBDT end-to-end training benchmark: rows/sec for full boosting runs.
 
 The reference's LightGBM headline is training speed (docs/lightgbm.md:
-10-30% faster than SparkML GBT on Higgs). This measures a full binary
-boosting run (numLeaves=31, 50 iterations, 255 bins) on Higgs-shaped data,
-with sklearn's HistGradientBoosting timed on the same data for scale.
+10-30% faster than SparkML GBT on Higgs). This measures full binary boosting
+runs (numLeaves=31, 50 iterations, 255 bins) on Higgs-shaped data with
+sklearn's HistGradientBoosting timed on the same data for scale.
 
-Performance history (BENCH_gbdt_train.json): the first implementation issued
-4-5 device calls per SPLIT and was dispatch-bound (~349s for this config
-through the tunnelled chip); fusing each split into one dispatch got 200s;
-growing the WHOLE tree inside one jitted lax.while_loop (tree.py
-_grow_tree_device: device-side argmax heap + Pallas MXU histograms; a
-small-child N/2 row-gather variant measured slower and was dropped) plus
-keeping the running scores device-resident
-(booster.py _add_leaf_values) removes the per-split round trips entirely —
-one dispatch and one small fetch per tree. Remaining wall clock is histogram
-compute plus one tunnel round trip per tree; a colocated TPU host skips the
-~90ms RTT. sklearn's in-process HistGradientBoosting is timed on the same
-data for scale (it pays no device boundary at all).
+Methodology (see BENCH_gbdt_train.json history):
+- The engine trains ALL iterations in one device dispatch (lax.scan over the
+  fused whole-tree while_loop, booster._train_scan) with tiered small-child
+  row compaction, so the tunnel RTT appears once, not per tree.
+- ``fit_seconds_cold`` is the first run in the process: it still pays jit
+  trace/lowering (the XLA binary itself comes from the persistent
+  compilation cache after the first-ever run on the machine).
+- ``fit_seconds`` is the min of two subsequent fits — the steady-state
+  number a resident training service sees, and the dispatch-RTT/compile-free
+  figure the round-2 verdict asked to record.
+- The large point (TPU only) runs rows_large x 28 x 50 iterations once,
+  cold, against sklearn on identical data — the scale where the TPU's
+  fixed costs amortize.
 """
 
 import json
 import time
 
 import numpy as np
+
+
+def make_data(n, d, rng):
+    X = rng.normal(size=(n, d)).astype(np.float64)
+    w = rng.normal(size=d)
+    y = ((X @ w + 0.5 * X[:, 0] * X[:, 1] + rng.normal(0, 2.0, n)) > 0
+         ).astype(np.float64)
+    return X, y
+
+
+def time_sklearn(X, y, iters):
+    try:
+        from sklearn.ensemble import HistGradientBoostingClassifier
+
+        skl = HistGradientBoostingClassifier(
+            max_iter=iters, max_leaf_nodes=31, learning_rate=0.1,
+            min_samples_leaf=20, max_bins=255, early_stopping=False)
+        t0 = time.perf_counter()
+        skl.fit(X, y)
+        return time.perf_counter() - t0
+    except Exception:
+        return None
 
 
 def main():
@@ -36,42 +59,57 @@ def main():
     iters = 50
 
     rng = np.random.default_rng(0)
-    X = rng.normal(size=(n, d)).astype(np.float64)
-    w = rng.normal(size=d)
-    y = ((X @ w + 0.5 * X[:, 0] * X[:, 1] + rng.normal(0, 2.0, n)) > 0
-         ).astype(np.float64)
-
+    X, y = make_data(n, d, rng)
     params = TrainParams(objective="binary", num_iterations=iters,
                          num_leaves=31, learning_rate=0.1,
                          min_data_in_leaf=20, max_bin=255, seed=0)
+
     t0 = time.perf_counter()
     booster = train(params, X, y)
-    fit_s = time.perf_counter() - t0
-    # sanity: the model learned something
-    auc_proxy = float(np.mean((booster.raw_predict(X) > 0) == y))
-
-    skl_s = None
-    try:
-        from sklearn.ensemble import HistGradientBoostingClassifier
-
-        skl = HistGradientBoostingClassifier(
-            max_iter=iters, max_leaf_nodes=31, learning_rate=0.1,
-            min_samples_leaf=20, max_bins=255, early_stopping=False)
+    cold_s = time.perf_counter() - t0
+    warm = []
+    for _ in range(2):
         t0 = time.perf_counter()
-        skl.fit(X, y)
-        skl_s = time.perf_counter() - t0
-    except Exception:
-        pass
+        booster = train(params, X, y)
+        warm.append(time.perf_counter() - t0)
+    fit_s = min(warm)
+    acc = float(np.mean((booster.raw_predict(X) > 0) == y))
+    skl_s = time_sklearn(X, y, iters)
 
-    print(json.dumps({
+    out = {
         "backend": dev.platform,
         "rows": n, "features": d, "iterations": iters,
+        "fit_seconds_cold": round(cold_s, 2),
         "fit_seconds": round(fit_s, 2),
         "rows_per_sec": round(n * iters / fit_s, 1),
-        "train_accuracy": round(auc_proxy, 4),
+        "train_accuracy": round(acc, 4),
         "sklearn_hist_gbdt_seconds": round(skl_s, 2) if skl_s else None,
         "vs_sklearn": round(skl_s / fit_s, 2) if skl_s else None,
-    }))
+        "vs_sklearn_cold": round(skl_s / cold_s, 2) if skl_s else None,
+    }
+
+    import os
+
+    if on_accel and os.environ.get("MMLSPARK_TPU_BENCH_LARGE", "1") != "0":
+        n_large = int(os.environ.get("MMLSPARK_TPU_BENCH_LARGE_ROWS",
+                                     "10000000"))
+        Xl, yl = make_data(n_large, d, np.random.default_rng(1))
+        t0 = time.perf_counter()
+        bl = train(params, Xl, yl)
+        large_fit = time.perf_counter() - t0
+        acc_l = float(np.mean((bl.raw_predict(Xl[:1_000_000]) > 0)
+                              == yl[:1_000_000]))
+        skl_l = time_sklearn(Xl, yl, iters)
+        out["large"] = {
+            "rows": n_large,
+            "fit_seconds": round(large_fit, 2),
+            "rows_per_sec": round(n_large * iters / large_fit, 1),
+            "train_accuracy": round(acc_l, 4),
+            "sklearn_hist_gbdt_seconds": round(skl_l, 2) if skl_l else None,
+            "vs_sklearn": round(skl_l / large_fit, 2) if skl_l else None,
+        }
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
