@@ -484,14 +484,34 @@ def train_sparse(params, ds: SparseDataset, y: np.ndarray,
 def predict_csr(tree_groups: List[List[Tree]], indptr, indices, values,
                 num_class: int) -> np.ndarray:
     """[CSR rows] -> [N, num_class] raw score deltas (PredictForCSRSingle
-    parity, LightGBMBooster.scala:21-148 — vectorized over rows)."""
+    parity, LightGBMBooster.scala:21-148 — fully vectorized over rows).
+
+    Value lookup rides ONE global searchsorted per depth step over the
+    composite (row, feature) key — CSR rows are sorted, so
+    ``row * (F+1) + feature`` is globally ascending."""
     indptr = np.asarray(indptr, dtype=np.int64)
     indices = np.asarray(indices, dtype=np.int64)
     values = np.asarray(values, dtype=np.float64)
     n = len(indptr) - 1
     out = np.zeros((n, num_class), dtype=np.float64)
-    starts, ends = indptr[:-1], indptr[1:]
+    width = int(indices.max()) + 2 if len(indices) else 1
+    row_of = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+    key = row_of * width + indices                    # globally ascending
 
+    def lookup(rows: np.ndarray, feats: np.ndarray) -> np.ndarray:
+        res = np.zeros(len(feats), dtype=np.float64)
+        if not len(key):
+            return res
+        inr = feats < width  # features beyond the data's width are absent
+        q = rows[inr] * width + feats[inr]
+        pos = np.searchsorted(key, q)
+        ok = (pos < len(key)) & (key[np.minimum(pos, len(key) - 1)] == q)
+        sub = np.zeros(len(q), dtype=np.float64)
+        sub[ok] = values[pos[ok]]
+        res[inr] = sub
+        return res
+
+    all_rows = np.arange(n, dtype=np.int64)
     for group in tree_groups:
         for kcls, tree in enumerate(group):
             node = np.zeros(n, dtype=np.int64)
@@ -499,24 +519,10 @@ def predict_csr(tree_groups: List[List[Tree]], indptr, indices, values,
             while active.any():
                 cur = node[active]
                 f = tree.feature[cur].astype(np.int64)
-                x = lookup_subset(indices, values, starts[active],
-                                  ends[active], f)
+                x = lookup(all_rows[active], f)
                 go_left = x <= tree.threshold[cur]
                 node[active] = np.where(go_left, tree.left[cur],
                                         tree.right[cur])
                 active = tree.feature[node] != -1
             out[:, kcls] += tree.value[node] * tree.shrinkage
     return out
-
-
-def lookup_subset(indices, values, starts, ends, feats) -> np.ndarray:
-    """Vectorized CSR value lookup for (row subset, per-row feature)."""
-    m = len(starts)
-    res = np.zeros(m, dtype=np.float64)
-    for i in range(m):
-        s, e = starts[i], ends[i]
-        seg = indices[s:e]
-        p = np.searchsorted(seg, feats[i])
-        if p < len(seg) and seg[p] == feats[i]:
-            res[i] = values[s + p]
-    return res

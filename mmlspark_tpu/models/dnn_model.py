@@ -28,7 +28,7 @@ from ..core.params import ComplexParam, HasBatchSize, HasInputCol, HasOutputCol,
 from ..core.dataframe import DataFrame
 from ..core.pipeline import Model
 from ..core.schema import ColType, Schema
-from ..parallel.batching import Minibatcher, concat_outputs
+from ..parallel.batching import DevicePrefetcher, Minibatcher, concat_outputs
 from ..parallel.mesh import DATA_AXIS, MeshContext, data_sharding, replicated_sharding
 from .module import FunctionModel
 
@@ -210,23 +210,46 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
                 outs.append(tuple(
                     np.asarray(y, dtype=np.float32)[:num_valid] for y in ys))
 
-            for batch in batcher.batches(sub, in_cols):
+            def to_device(batch):
+                """Stack/pad + H2D for one batch — runs on the prefetch
+                thread so the NEXT batch's transfer overlaps this one's
+                compute (DynamicBufferedBatcher parity,
+                stages/Batchers.scala:12-160)."""
                 if multi_in:
-                    x = {name: batch.arrays[col] for name, col in in_map.items()}
-                    if sharding is not None \
-                            and batch.size % mesh.shape[DATA_AXIS] == 0:
-                        x = {k: jax.device_put(v, sharding)
-                             for k, v in x.items()}
+                    x = {name: batch.arrays[col]
+                         for name, col in in_map.items()}
+                    if sharding is not None:
+                        # mesh-indivisible batches stay UNCOMMITTED host
+                        # arrays (committing to one device conflicts with
+                        # the mesh-replicated params inside jit)
+                        if batch.size % mesh.shape[DATA_AXIS] == 0:
+                            x = {k: jax.device_put(v, sharding)
+                                 for k, v in x.items()}
+                    else:
+                        x = {k: jax.device_put(v) for k, v in x.items()}
                 else:
                     x = batch.arrays[in_cols[0]]
-                    if sharding is not None \
-                            and x.shape[0] % mesh.shape[DATA_AXIS] == 0:
-                        x = jax.device_put(x, sharding)
-                in_flight.append((fwd(params_dev, x), batch.num_valid))
-                if len(in_flight) >= 2:
+                    if sharding is not None:
+                        if x.shape[0] % mesh.shape[DATA_AXIS] == 0:
+                            x = jax.device_put(x, sharding)
+                    else:
+                        x = jax.device_put(x)
+                return x, batch.num_valid
+
+            prefetch = DevicePrefetcher(batcher.batches(sub, in_cols),
+                                        put=to_device, depth=2)
+            try:
+                for x, num_valid in prefetch:
+                    in_flight.append((fwd(params_dev, x), num_valid))
+                    if len(in_flight) >= 2:
+                        drain_one()
+                while in_flight:
                     drain_one()
-            while in_flight:
-                drain_one()
+            finally:
+                # a failed forward/readback must not strand the producer
+                # thread blocked on the bounded queue (it pins device
+                # buffers for the process lifetime)
+                prefetch.close()
             for ci, c in enumerate(out_cols):
                 full = concat_outputs([o[ci] for o in outs])
                 for j, i in enumerate(valid_idx):

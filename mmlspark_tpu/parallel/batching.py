@@ -25,6 +25,82 @@ import numpy as np
 Partition = Dict[str, np.ndarray]
 
 
+class DevicePrefetcher:
+    """Background-thread device prefetch: pull items from an iterator, ship
+    them to the device (``put``), and hand over device-resident results
+    through a bounded queue — the producer's decode/stack/H2D cost overlaps
+    the consumer's compute.
+
+    Reference analogue: the background-thread DynamicBufferedBatcher
+    (stages/Batchers.scala:12-160) that keeps Spark partitions fed while the
+    consumer works. ``depth`` bounds in-flight batches (double buffering by
+    default) so memory stays bounded.
+
+    Iterate it like the original iterator; producer exceptions re-raise at
+    the consumer.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator, put: Optional[Callable] = None,
+                 depth: int = 2):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._err: List[BaseException] = []
+        self._stop = threading.Event()
+
+        def offer(item) -> bool:
+            """Bounded put that gives up when the consumer closed — an
+            abandoned iteration must not strand this thread (and its
+            device-resident buffers) on a full queue forever."""
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in it:
+                    if self._stop.is_set():
+                        return
+                    if not offer(put(item) if put is not None else item):
+                        return
+            except BaseException as e:  # noqa: BLE001 - re-raised at consumer
+                self._err.append(e)
+            finally:
+                offer(self._DONE)
+
+        self._thread = threading.Thread(target=produce, daemon=True,
+                                        name="device-prefetch")
+        self._thread.start()
+
+    def close(self) -> None:
+        """Release the producer thread and any queued buffers (idempotent)."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._DONE:
+                    if self._err:
+                        raise self._err[0]
+                    return
+                yield item
+        finally:
+            self.close()
+
+
 def next_bucket(n: int, buckets: Optional[Sequence[int]] = None, multiple: int = 8) -> int:
     """Smallest allowed static size >= n. Default: next power of two >= max(n, multiple)."""
     if n <= 0:

@@ -205,6 +205,19 @@ class _VowpalWabbitModelBase(Model, HasFeaturesCol):
                                      num_bits=self.get("numBits"))
         return predict_linear(self.get_or_throw("weights"), ds)
 
+    def get_readable_model(self, max_entries: int = 1 << 20) -> str:
+        """The vw ``--readable_model`` text dump: one ``index:weight`` line
+        per nonzero weight in the hashed feature space. The binary VW blob
+        (getModel, vw/VowpalWabbitBaseModel.scala:1-98) is a version-pinned
+        non-goal — see docs/vw.md; this text form cross-checks individual
+        weights against a vw run (the hashing is bit-exact murmur)."""
+        w = np.asarray(self.get_or_throw("weights"), dtype=np.float64)
+        lines = [f"bits:{self.get('numBits')}"]
+        nz = np.nonzero(w)[0]
+        for i in nz[:max_entries]:
+            lines.append(f"{int(i)}:{w[i]:.6f}")
+        return "\n".join(lines) + "\n"
+
     def get_performance_statistics(self) -> DataFrame:
         """Training diagnostics DataFrame (VowpalWabbitBase.scala:344-368)."""
         if not self._stats:

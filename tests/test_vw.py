@@ -388,3 +388,23 @@ class TestParamParityAdditions:
         row = out.column("f")[0]
         # col a tokenizes into 2 features; col b stays 1 whole-string feature
         assert len(row["indices"]) == 3
+
+
+def test_readable_model_dump():
+    """--readable_model parity: index:weight lines over the hashed space
+    (binary VW blob interchange is a documented non-goal, docs/vw.md)."""
+    from mmlspark_tpu.vw import VowpalWabbitClassifier
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = DataFrame.from_dict(
+        {"features": [X[i] for i in range(len(X))], "label": y})
+    model = VowpalWabbitClassifier(numPasses=2, labelCol="label").fit(df)
+    text = model.get_readable_model()
+    lines = text.strip().splitlines()
+    assert lines[0] == "bits:18"
+    assert len(lines) > 1
+    idx, wval = lines[1].split(":")
+    w = np.asarray(model.get("weights"))
+    assert abs(w[int(idx)] - float(wval)) < 1e-5
