@@ -500,15 +500,19 @@ def _scan_train_ok(params: TrainParams, objective: str, valid, log,
     The scan path removes EVERY per-iteration host round trip (the per-tree
     fused grower still paid one dispatch + one fetch per tree — ~4 tunnel
     RTTs/iteration end to end). Exclusions: dart (host-side tree
-    drop/re-add), goss (grad-dependent host sampling), lambdarank (grouped
-    grad), validation/early-stopping + per-iteration logging (host eval),
-    and sharded inputs (the per-tree shard_map grower handles those).
+    drop/re-add), lambdarank (grouped grad), validation/early-stopping +
+    per-iteration logging (host eval), and sharded inputs (the per-tree
+    shard_map grower handles those). GOSS runs in-scan with on-device
+    gradient-threshold selection + row compaction (see _train_scan) — the
+    sampling is the point of GOSS (LightGBM's speed feature,
+    GossStrategy in the reference's underlying engine), so the compacted
+    histogram stream is where the row-reduction actually buys time.
     """
     import jax
 
     if os.environ.get("MMLSPARK_TPU_NO_SCAN_TRAIN", "") not in ("", "0"):
         return False
-    if params.boosting_type in ("dart", "goss"):
+    if params.boosting_type == "dart":
         return False
     if objective == "lambdarank":
         return False
@@ -536,7 +540,10 @@ def _scan_precompute_masks(params: TrainParams, rng, n: int, num_f: int,
     bag_cond = ((params.bagging_fraction < 1.0
                  or params.pos_bagging_fraction < 1.0
                  or params.neg_bagging_fraction < 1.0)
-                and (is_rf or params.bagging_freq > 0))
+                and (is_rf or params.bagging_freq > 0)
+                # goss overrides bagging (host-path / LightGBM semantics:
+                # the goss selection IS the row mask)
+                and params.boosting_type != "goss")
     use_feat = params.feature_fraction < 1.0
     if bag_cond and iters * n > _SCAN_MASK_BUDGET:
         return None, None, False
@@ -610,23 +617,107 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
         ones_mask = jnp.ones(n, dtype=bool)
     shrink = np.float32(lr)
 
+    # ----- in-scan GOSS: the histogram kernel streams ~2 MXU cycles per
+    # row*feature regardless of masking, so a masked goss subset saves
+    # nothing — the win comes from COMPACTING the tree's rows to the
+    # selected ~(top_rate+other_rate) fraction at the root, shrinking every
+    # histogram/partition pass of the whole tree. Selection is on device:
+    # the top_n |grad| threshold comes from a 20-step count bisection
+    # (scatter-free — TPUs have no scatter hardware), "other" rows are a
+    # Bernoulli draw (rate other_n/remaining) amplified by (1-a)/b exactly
+    # like the host path, and the subset is gathered into a static-capacity
+    # buffer (overflow on gradient ties truncates in row order — LightGBM
+    # breaks ties by sort order, equally arbitrary). Full-row score routing
+    # is recovered by replaying the grown tree's splits over all N rows.
+    is_goss = params.boosting_type == "goss"
+    if is_goss:
+        n_real = int(pad_mask.sum()) if pad_mask is not None else n
+        top_n = int(n_real * params.top_rate)
+        other_n = int(n_real * params.other_rate)
+        sel_budget = max(top_n + other_n, 1)
+        goss_cap = min(n, -(-(sel_budget + max(256, sel_budget // 16)) // 512)
+                       * 512)
+        goss_amp = np.float32((1.0 - params.top_rate)
+                              / max(params.other_rate, 1e-12))
+        goss_keys = jax.random.split(
+            jax.random.PRNGKey(params.seed or params.bagging_seed), iters)
+
+    from . import histogram as H
+
+    def _route_full(tree_out):
+        """Route ALL n rows through the grown tree (children have larger ids
+        than parents, so one in-order replay of the split records is a full
+        traversal)."""
+        feat = tree_out["feature"]
+        tb = tree_out["threshold_bin"]
+        dl_ = tree_out["default_left"]
+        li = tree_out["left"]
+        ri = tree_out["right"]
+
+        def rb(j, nor):
+            f = feat[j]
+            binrow = jax.lax.dynamic_index_in_dim(
+                bins_dev, jnp.maximum(f, 0), axis=0, keepdims=False)
+            new = H.partition_rows(binrow, nor, j, tb[j], dl_[j], li[j],
+                                   ri[j])
+            return jnp.where(f >= 0, new, nor)
+
+        return jax.lax.fori_loop(0, tree_out["n_nodes"], rb,
+                                 jnp.zeros(n, jnp.int32))
+
     def body(carry, xs):
         score, comp = carry
         row_mask = xs["rm"] if row_masks is not None else ones_mask
         fmask = xs["fm"] if has_fm else fm_dummy
         g, h = grad_hess(objective, score, labels, w_dev, alpha)
+        if is_goss:
+            g_sel = jnp.abs(g) if g.ndim == 1 else jnp.sum(jnp.abs(g), axis=1)
+            g_sel = jnp.where(ones_mask, g_sel, 0.0)
+            gmax = jnp.max(g_sel).astype(jnp.float32)
+
+            def _bis(_, lohi):
+                lo, hi = lohi
+                mid = 0.5 * (lo + hi)
+                above = jnp.sum((g_sel >= mid) & ones_mask, dtype=jnp.int32)
+                take = above >= top_n
+                return (jnp.where(take, mid, lo), jnp.where(take, hi, mid))
+
+            lo, _ = jax.lax.fori_loop(
+                0, 20, _bis,
+                (jnp.float32(0.0), gmax * jnp.float32(1.000001) + 1e-30))
+            is_top = ones_mask & (g_sel >= lo)
+            count_top = jnp.sum(is_top, dtype=jnp.int32)
+            p_other = other_n / jnp.maximum(
+                (jnp.int32(n_real) - count_top).astype(jnp.float32), 1.0)
+            u = jax.random.uniform(xs["gk"], (n,))
+            sel = is_top | (ones_mask & ~is_top & (u < p_other))
+            amp = jnp.where(is_top, jnp.float32(1.0), goss_amp)
+            idx = jnp.nonzero(sel, size=goss_cap, fill_value=0)[0]
+            sel_cnt = jnp.minimum(jnp.sum(sel, dtype=jnp.int32), goss_cap)
+            mask_it = jnp.arange(goss_cap, dtype=jnp.int32) < sel_cnt
+            bins_it = jnp.take(bins_dev, idx, axis=1)
+            amp_c = jnp.take(amp, idx)
+            nor0 = jnp.zeros(goss_cap, jnp.int32)
+        else:
+            bins_it, mask_it = bins_dev, row_mask
+            nor0 = jnp.zeros(n, jnp.int32)
         outs = []
         for kk in range(k):
             gk = g if g.ndim == 1 else g[:, kk]
             hk = h if h.ndim == 1 else h[:, kk]
+            if is_goss:
+                gk = jnp.take(gk, idx) * amp_c
+                hk = jnp.take(hk, idx) * amp_c
             out = _grow_tree_device_body(
-                bins_dev, gk, hk, row_mask, jnp.zeros(n, jnp.int32),
+                bins_it, gk, hk, mask_it, nor0,
                 l1, l2, msh, mgs, fmask,
                 num_bins=num_bins, max_nodes=M,
                 min_data_in_leaf=config.min_data_in_leaf,
                 max_depth=config.max_depth, use_mxu=use_mxu,
                 has_feature_mask=has_fm)
             rows = out.pop("node_of_row")
+            if is_goss:
+                rows = _route_full(out)
             sums, feat = out["sums"], out["feature"]
             g_thr = jnp.sign(sums[:, 0]) * jnp.maximum(
                 jnp.abs(sums[:, 0]) - l1, 0.0)
@@ -654,12 +745,14 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
     score0 = jnp.asarray(scores[:, 0] if k == 1 else scores, dtype=jnp.float32)
     comp0 = jnp.zeros_like(score0)
     xs = None
-    if row_masks is not None or has_fm:
+    if row_masks is not None or has_fm or is_goss:
         xs = {}
         if row_masks is not None:
             xs["rm"] = jnp.asarray(row_masks)
         if has_fm:
             xs["fm"] = jnp.asarray(feat_masks)
+        if is_goss:
+            xs["gk"] = goss_keys
     timing = os.environ.get("MMLSPARK_TPU_GBDT_TIMING", "") not in ("", "0")
     t0 = _now() if timing else 0.0
 

@@ -261,16 +261,22 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             if (self.get("verbosity") >= 0
                 or self.get("isProvideTrainingMetric")) else None
 
-        # worker topology: the default mesh's data axis is the worker count
-        # (ClusterUtil.getNumExecutorCores parity, LightGBMBase.scala:120-128);
-        # numWorkers=1 forces single-device training.
+        # worker topology: an EXPLICITLY configured mesh's data axis is the
+        # worker count (ClusterUtil.getNumExecutorCores parity,
+        # LightGBMBase.scala:120-128); numWorkers=1 forces single-device
+        # training. Like DNNModel's auto mode, 'no mesh configured' stays
+        # single-device (MeshContext.current, not get): silently adopting a
+        # lazily-built all-device mesh row-shards tiny fits onto the
+        # per-split collective path — orders of magnitude slower than one
+        # device — and would span non-addressable devices multi-host.
         mesh = None
         if self.get("numWorkers") != 1:
             from ..parallel.mesh import DATA_AXIS, MeshContext
 
             try:
-                candidate = MeshContext.get()
-                if int(candidate.shape.get(DATA_AXIS, 1)) > 1:
+                candidate = MeshContext.current()
+                if candidate is not None \
+                        and int(candidate.shape.get(DATA_AXIS, 1)) > 1:
                     mesh = candidate
             except Exception:
                 mesh = None

@@ -144,8 +144,11 @@ class _VowpalWabbitBase(HasFeaturesCol, HasLabelCol, HasWeightCol):
         from ..parallel.mesh import DATA_AXIS, MeshContext
 
         try:
-            mesh = MeshContext.get()
-            if int(mesh.shape.get(DATA_AXIS, 1)) > 1:
+            # explicit meshes only (MeshContext.current): auto-adopting the
+            # lazily-built all-device mesh drags small fits through the
+            # distributed path (see LightGBM stage note)
+            mesh = MeshContext.current()
+            if mesh is not None and int(mesh.shape.get(DATA_AXIS, 1)) > 1:
                 return mesh
         except Exception:
             pass

@@ -1,5 +1,7 @@
 """GBDT engine tests: binning, histogram/split kernels, boosting, stages."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -379,6 +381,65 @@ class TestFusedTreeGrower:
         b_host = B.train(params, X, y)
         np.testing.assert_allclose(b_scan.raw_predict(X),
                                    b_host.raw_predict(X), rtol=1e-3, atol=1e-4)
+
+    def test_scan_train_goss_matches_host_accuracy(self, monkeypatch):
+        """In-scan GOSS (on-device bisection threshold + compacted growth +
+        full-row split replay) is a different sampler from the host loop's
+        argsort/rng.choice, so trees differ — but it must land at the same
+        accuracy, and the full-gbdt accuracy must be within GOSS's expected
+        loss."""
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(4000, 10))
+        logit = X[:, 0] * 2 + X[:, 1] - X[:, 2] * 0.5 \
+            + 0.3 * rng.normal(size=4000)
+        y = (logit > 0).astype(np.float64)
+        params = TrainParams(objective="binary", num_iterations=15,
+                             num_leaves=15, min_data_in_leaf=5,
+                             boosting_type="goss", top_rate=0.2,
+                             other_rate=0.1, seed=7)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SCAN_TRAIN", raising=False)
+        b_scan = B.train(params, X, y)
+        assert len(b_scan.trees) == 15
+        monkeypatch.delenv("MMLSPARK_TPU_SCAN_TRAIN", raising=False)
+        monkeypatch.setenv("MMLSPARK_TPU_NO_SCAN_TRAIN", "1")
+        b_host = B.train(params, X, y)
+        acc_scan = np.mean((b_scan.raw_predict(X) > 0) == y)
+        acc_host = np.mean((b_host.raw_predict(X) > 0) == y)
+        assert abs(acc_scan - acc_host) < 0.02, (acc_scan, acc_host)
+
+    def test_scan_train_goss_deterministic(self, monkeypatch):
+        """Same seed -> bit-identical model (the in-scan sampler draws from
+        a params.seed-keyed counter PRNG, not host RNG state)."""
+        X, y = synth_binary(2000, seed=9)
+        params = TrainParams(objective="binary", num_iterations=6,
+                             num_leaves=7, min_data_in_leaf=5,
+                             boosting_type="goss", seed=11)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SCAN_TRAIN", raising=False)
+        b1 = B.train(params, X, y)
+        b2 = B.train(params, X, y)
+        np.testing.assert_array_equal(b1.raw_predict(X), b2.raw_predict(X))
+        # a different seed must change the sampled subsets (and the model)
+        b3 = B.train(dataclasses.replace(params, seed=12), X, y)
+        assert not np.array_equal(b1.raw_predict(X), b3.raw_predict(X))
+
+    def test_scan_train_goss_multiclass(self, monkeypatch):
+        """Multiclass GOSS selects ONE row subset per iteration from the
+        summed |grad| across classes (host-path/LightGBM semantics) and
+        grows all k trees on it."""
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(3000, 8))
+        y = np.digitize(X[:, 0] + X[:, 1], [-0.8, 0.8]).astype(np.float64)
+        params = TrainParams(objective="multiclass", num_class=3,
+                             num_iterations=8, num_leaves=15,
+                             min_data_in_leaf=5, boosting_type="goss",
+                             seed=4)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SCAN_TRAIN", raising=False)
+        b = B.train(params, X, y)
+        acc = np.mean(np.argmax(b.raw_predict(X), axis=1) == y)
+        assert acc > 0.8, acc
 
     def test_sharded_fused_matches_single_device(self, mesh8, monkeypatch):
         """Whole-tree growth under shard_map (psum'd histograms) must produce
