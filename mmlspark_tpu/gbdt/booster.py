@@ -599,7 +599,10 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
 
     iters = params.num_iterations
     M = 2 * params.num_leaves - 1
-    use_mxu = pallas_hist.use_pallas()
+    # same interpret plumbing as tree._grow_tree_device: CPU tests exercise
+    # the Pallas kernels (histogram + tier select) in interpreter mode
+    interpret = pallas_hist.interpret_mode()
+    use_mxu = pallas_hist.use_pallas() or interpret
     objective = params.objective
     alpha = params.alpha
 
@@ -714,7 +717,7 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
                 num_bins=num_bins, max_nodes=M,
                 min_data_in_leaf=config.min_data_in_leaf,
                 max_depth=config.max_depth, use_mxu=use_mxu,
-                has_feature_mask=has_fm)
+                has_feature_mask=has_fm, interpret=interpret)
             rows = out.pop("node_of_row")
             if is_goss:
                 rows = _route_full(out)

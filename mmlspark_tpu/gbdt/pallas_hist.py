@@ -224,6 +224,14 @@ def use_mxu_single_device(bins) -> bool:
     return True
 
 
+def interpret_mode() -> bool:
+    """MMLSPARK_TPU_PALLAS_INTERPRET=1: run the Pallas kernels (histogram,
+    tier select) in interpreter mode — CPU test coverage of the MXU paths.
+    Single parser so the scan path and the per-tree path cannot diverge."""
+    return os.environ.get("MMLSPARK_TPU_PALLAS_INTERPRET",
+                          "") not in ("", "0")
+
+
 def use_pallas() -> bool:
     """True when the Pallas path should be dispatched (TPU backend, not
     disabled via MMLSPARK_TPU_NO_PALLAS)."""

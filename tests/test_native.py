@@ -58,7 +58,11 @@ class TestNative:
         hess = rng.uniform(0.1, 1, n).astype(np.float32)
         mask = rng.random(n) < 0.8
         got = native.histogram(bins, grad, hess, mask, b)
-        want = np.asarray(H.compute_histogram(bins, grad, hess, mask, b))
+        # the JAX engine takes the canonical feature-major [F, N] layout
+        # (histogram.compute_histogram docstring); the C++ path keeps the
+        # row-major host layout it was built for
+        want = np.asarray(H.compute_histogram(
+            np.ascontiguousarray(bins.T), grad, hess, mask, b))
         np.testing.assert_allclose(got, want, atol=1e-3)
 
     def test_forest_predict_matches_host(self, native):
