@@ -820,6 +820,7 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
                 gain=host["gain"][it, kk][:nn].astype(np.float32),
                 count=sums[:, 2].astype(np.int32),
                 shrinkage=lr,
+                weight=sums[:, 1],
             ))
         booster.trees.append(group)
     if timing:

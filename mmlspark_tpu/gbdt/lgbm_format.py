@@ -91,13 +91,21 @@ def _tree_block(tree: Tree, index: int, fold_bias: float = 0.0) -> str:
     num_leaves = len(leaf_ids)
     lines = [f"Tree={index}", f"num_leaves={num_leaves}", "num_cat=0"]
 
+    def node_weight(nid: int) -> str:
+        # real hessian sums when the trainer recorded them (LightGBM uses
+        # leaf_weight for refit/contrib); row counts only as legacy fallback
+        if tree.weight is not None:
+            return _fmt(float(tree.weight[nid]))
+        return str(int(tree.count[nid]))
+
     if num_leaves == 1:
         # stump: LightGBM still writes one leaf_value row
         lines += [
             "split_feature=", "split_gain=", "threshold=", "decision_type=",
             "left_child=", "right_child=",
             "leaf_value=" + _fmt(tree.value[0] * tree.shrinkage + fold_bias),
-            "leaf_weight=0", "leaf_count=" + str(int(tree.count[0])),
+            "leaf_weight=" + node_weight(0),
+            "leaf_count=" + str(int(tree.count[0])),
             "internal_value=", "internal_weight=", "internal_count=",
             f"shrinkage={_fmt(tree.shrinkage)}",
         ]
@@ -115,11 +123,9 @@ def _tree_block(tree: Tree, index: int, fold_bias: float = 0.0) -> str:
     lv = [_fmt(float(tree.value[nid]) * tree.shrinkage + fold_bias)
           for nid in leaf_ids]
     lcount = [str(int(tree.count[nid])) for nid in leaf_ids]
-    # hessian sums are not stored per node in our Tree: weight==count stands
-    # in (LightGBM only needs leaf_weight for refit/contrib paths)
-    lw = [str(int(tree.count[nid])) for nid in leaf_ids]
+    lw = [node_weight(int(nid)) for nid in leaf_ids]
     iv = [_fmt(0.0) for _ in internal_ids]
-    iw = [str(int(tree.count[nid])) for nid in internal_ids]
+    iw = [node_weight(int(nid)) for nid in internal_ids]
     ic = [str(int(tree.count[nid])) for nid in internal_ids]
 
     lines += [
@@ -238,9 +244,17 @@ def _parse_tree(block: Dict[str, str]) -> Tree:
         raise ValueError(
             "categorical splits (num_cat > 0) are not supported by the TPU "
             "engine's tree import — one-hot the categoricals upstream")
+    if int(block.get("is_linear", "0") or 0):
+        raise ValueError(
+            "linear-tree models (is_linear=1) are not supported: leaves hold "
+            "linear models, not constants — retrain without linear_tree")
     leaf_value = _floats(block["leaf_value"])
     leaf_count = _ints(block.get("leaf_count", "")) \
         if block.get("leaf_count") else np.zeros(num_leaves, dtype=np.int64)
+    leaf_weight = _floats(block["leaf_weight"]) \
+        if block.get("leaf_weight") else None
+    int_weight = _floats(block["internal_weight"]) \
+        if block.get("internal_weight") else None
 
     if num_leaves == 1:
         return Tree(
@@ -253,6 +267,8 @@ def _parse_tree(block: Dict[str, str]) -> Tree:
             gain=np.zeros(1, dtype=np.float32),
             count=leaf_count[:1].astype(np.int32),
             shrinkage=1.0,  # leaf_value already includes it
+            weight=(leaf_weight[:1].astype(np.float64)
+                    if leaf_weight is not None else None),
         )
 
     n_int = num_leaves - 1
@@ -293,10 +309,21 @@ def _parse_tree(block: Dict[str, str]) -> Tree:
     # takes in LightGBM: NaN type -> the stored default bit; None type ->
     # NaN is coerced to 0.0 and compared (left iff 0 <= threshold); Zero
     # type -> 0-as-missing goes the default direction, NaN included.
-    # (Exact-0.0 values under Zero type still compare normally here — a
-    # documented divergence; such models arise from sparse training data.)
     missing_type = (decision_type >> 2) & 3
     stored_default = (decision_type & _DEFAULT_LEFT) != 0
+    if (missing_type == 1).any():
+        # Zero type: LightGBM sends exact-0.0 feature values the default
+        # (missing) direction; this engine only applies the default bit to
+        # NaN, so 0.0 compares against the threshold instead. Models with
+        # Zero missing type typically come from sparse training data.
+        import warnings
+
+        warnings.warn(
+            "importing a LightGBM model with missing_type=Zero: exact-0.0 "
+            "feature values follow the threshold compare here, not the "
+            "stored default direction — predictions can differ from "
+            "LightGBM for rows with zero-valued features at those splits",
+            RuntimeWarning, stacklevel=4)
     dleft[:n_int] = np.where(missing_type == 0, 0.0 <= threshold,
                              stored_default)
     left[:n_int] = [flat(c) for c in left_child]
@@ -305,10 +332,16 @@ def _parse_tree(block: Dict[str, str]) -> Tree:
     count[:n_int] = int_count
     value[n_int:] = leaf_value
     count[n_int:] = leaf_count
+    weight = None
+    if leaf_weight is not None and len(leaf_weight) == num_leaves:
+        weight = np.zeros(n_nodes, dtype=np.float64)
+        weight[n_int:] = leaf_weight
+        if int_weight is not None and len(int_weight) == n_int:
+            weight[:n_int] = int_weight
     return Tree(feature=feature, threshold=thr,
                 threshold_bin=np.zeros(n_nodes, dtype=np.int32),
                 default_left=dleft, left=left, right=right, value=value,
-                gain=gain, count=count, shrinkage=1.0)
+                gain=gain, count=count, shrinkage=1.0, weight=weight)
 
 
 def parse_model_string(text: str) -> Booster:
@@ -338,6 +371,19 @@ def from_lightgbm_string(text: str) -> Booster:
     if not is_lightgbm_string(text):
         raise ValueError("not a LightGBM model string (missing 'tree' magic)")
     head = _parse_header(text)
+    version = head.get("version", "")
+    # Version gate, explicit: v2/v3/v4 share the tree-block fields this
+    # parser reads (v4 added linear trees, rejected per-tree below). An
+    # unknown version means fields we have never seen — fail loudly instead
+    # of silently misparsing.
+    if version not in ("v2", "v3", "v4"):
+        raise ValueError(
+            f"unsupported LightGBM model version {version!r}: this parser "
+            f"handles v2/v3/v4 text models")
+    if int(head.get("linear_tree", "0") or 0):
+        raise ValueError(
+            "linear-tree models (linear_tree=1) are not supported: leaves "
+            "hold linear models, not constants — retrain without linear_tree")
     k = int(head.get("num_class", "1"))
     obj_field = head.get("objective", "regression").split()
     objective = obj_field[0] if obj_field else "regression"

@@ -328,6 +328,7 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
     value = [0.0]
     gains = [0.0]
     counts = [0]
+    hweights = [0.0]
 
     def leaf_value(sums):
         g_thr = np.sign(sums[0]) * max(abs(sums[0]) - config.lambda_l1, 0.0)
@@ -345,6 +346,7 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
                          jnp.asarray(float(n), jnp.float32)])
     hist0 = node_hist(ones, totals0)
     counts[0] = n
+    hweights[0] = float(jax.device_get(totals0)[1])
 
     def eval_split(hist):
         b, gain, lsum, rsum = _find_best_split_flat(
@@ -399,6 +401,7 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
             value.append(leaf_value(csum))
             gains.append(0.0)
             counts.append(int(csum[2]))
+            hweights.append(float(csum[1]))
         n_leaves += 1
 
         node_of_row = _route_rows(dev, node_of_row, np.int32(nid),
@@ -425,6 +428,7 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
         value=np.asarray(value, dtype=np.float64),
         gain=np.asarray(gains, dtype=np.float32),
         count=np.asarray(counts, dtype=np.int32),
+        weight=np.asarray(hweights, dtype=np.float64),
     )
     return tree, np.asarray(jax.device_get(node_of_row))
 

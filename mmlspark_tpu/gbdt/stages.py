@@ -191,7 +191,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
         if self.get("weightCol"):
             w = np.asarray(data[self.get("weightCol")], dtype=np.float64)
         for unsupported in ("validationIndicatorCol", "initScoreCol",
-                            "categoricalSlotNames", "categoricalSlotIndexes"):
+                            "categoricalSlotNames", "categoricalSlotIndexes",
+                            "modelString", "numBatches"):
             if self.get(unsupported):
                 raise ValueError(
                     f"{unsupported} is not supported with sparse features "

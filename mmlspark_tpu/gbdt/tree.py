@@ -80,13 +80,14 @@ class Tree:
     gain: np.ndarray           # f32 [nodes], split gain (0 for leaves)
     count: np.ndarray          # i32 [nodes], training rows through the node
     shrinkage: float = 1.0
+    weight: Optional[np.ndarray] = None  # f64 [nodes], hessian sums (None: legacy)
 
     @property
     def num_leaves(self) -> int:
         return int((self.feature == -1).sum())
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "feature": self.feature.tolist(),
             "threshold": self.threshold.tolist(),
             "threshold_bin": self.threshold_bin.tolist(),
@@ -98,6 +99,9 @@ class Tree:
             "count": self.count.tolist(),
             "shrinkage": self.shrinkage,
         }
+        if self.weight is not None:
+            d["weight"] = self.weight.tolist()
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "Tree":
@@ -112,6 +116,8 @@ class Tree:
             gain=np.asarray(d["gain"], dtype=np.float32),
             count=np.asarray(d["count"], dtype=np.int32),
             shrinkage=float(d.get("shrinkage", 1.0)),
+            weight=(np.asarray(d["weight"], dtype=np.float64)
+                    if d.get("weight") is not None else None),
         )
 
 
@@ -510,6 +516,7 @@ def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
         value=value,
         gain=out["gain"][:nn].astype(np.float32),
         count=sums[:, 2].astype(np.int32),
+        weight=sums[:, 1],
     )
     if device_rows:
         return tree, rows_dev
@@ -564,6 +571,7 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
     value = [0.0]
     gains = [0.0]
     counts = [0]
+    hweights = [0.0]
 
     def eval_node(hist) -> Tuple[Optional[H.SplitInfo], np.ndarray]:
         split = H.find_best_split(
@@ -576,6 +584,7 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
     root_sums = np.asarray(jax.device_get(
         H.total_sums(grad, hess, row_mask)), dtype=np.float64)
     counts[0] = int(root_sums[2])
+    hweights[0] = float(root_sums[1])
     root_split = eval_node(root_hist)
 
     heap: List[Tuple[float, int, _Node]] = []
@@ -626,6 +635,7 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
             value.append(v)
             gains.append(0.0)
             counts.append(int(sums[2]))
+            hweights.append(float(sums[1]))
 
         n_leaves += 1
         small_id, big_id = (lid, rid) if lsum[2] <= rsum[2] else (rid, lid)
@@ -683,6 +693,7 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
         value=np.asarray(value, dtype=np.float64),
         gain=np.asarray(gains, dtype=np.float32),
         count=np.asarray(counts, dtype=np.int32),
+        weight=np.asarray(hweights, dtype=np.float64),
     )
     return tree, np.asarray(jax.device_get(node_of_row))
 

@@ -173,6 +173,7 @@ class ServingServer:
         self._threads: List[threading.Thread] = []
         self.requests_served = 0
         self.stats = LatencyStats()
+        self.warmup_ok: Optional[bool] = None  # None until warmup() runs
 
     # -- ingress ---------------------------------------------------------
     def _make_handler(self):
@@ -389,7 +390,15 @@ class ServingServer:
         max_batch_size) by pushing synthetic batches straight through the
         transform. After this, a lone request takes the already-compiled
         batch-1 executable — no first-hit compile, no padding to a bigger
-        bucket (the warm batch-1 fast path of verdict item 4)."""
+        bucket (the warm batch-1 fast path of verdict item 4).
+
+        Returns self; ``warmup_ok`` records whether every synthetic batch
+        transformed cleanly (a failed warmup is logged, not raised — serving
+        must start regardless, but the operator can see the first real
+        request will still pay compile)."""
+        import logging
+
+        self.warmup_ok = True
         sizes = sizes or [1, self.max_batch_size]
         hdrs = dict(headers or {})
         for size in sizes:
@@ -406,7 +415,10 @@ class ServingServer:
                     [{"id": ids, "value": bodies, "headers": hs,
                       "origin": origin}])).collect()
             except Exception:  # warmup must never block serving
-                pass
+                self.warmup_ok = False
+                logging.getLogger("mmlspark_tpu.serving").warning(
+                    "warmup batch of size %d failed — the first real request "
+                    "at this size will pay compile", size, exc_info=True)
         return self
 
     # -- lifecycle -------------------------------------------------------

@@ -240,6 +240,85 @@ class TestStagesSurface:
         b = from_lightgbm_string(base.format(thr="-0.5", dt="10"))
         np.testing.assert_allclose(b.raw_predict(X), [1.0])
 
+    def test_leaf_weight_is_hessian_sum(self):
+        """Export writes real hessian sums as leaf_weight (LightGBM uses
+        them for refit/contrib), not row counts (ADVICE r3)."""
+        X, y = synth()
+        booster = B.train(TrainParams(objective="binary", num_iterations=2,
+                                      num_leaves=7, min_data_in_leaf=5), X, y)
+        tree = booster.trees[0][0]
+        assert tree.weight is not None
+        # binary objective: hess = p(1-p) in (0, 0.25] — NEVER equal to the
+        # integer row count, so a counts fallback would fail this
+        leaves = tree.feature == -1
+        assert (tree.weight[leaves] < tree.count[leaves]).all()
+        text = to_lightgbm_string(booster)
+        lw_line = next(l for l in text.splitlines()
+                       if l.startswith("leaf_weight="))
+        vals = [float(v) for v in lw_line.split("=")[1].split()]
+        np.testing.assert_allclose(sorted(vals),
+                                   sorted(tree.weight[leaves]), rtol=1e-5)
+        # round trip: import recovers the weights
+        imported = from_lightgbm_string(text)
+        it = imported.trees[0][0]
+        assert it.weight is not None
+        np.testing.assert_allclose(sorted(it.weight[it.feature == -1]),
+                                   sorted(tree.weight[leaves]), rtol=1e-5)
+
+    def _minimal(self, version="v3", header_extra="", tree_extra=""):
+        return (
+            f"tree\nversion={version}\nnum_class=1\n"
+            "num_tree_per_iteration=1\n"
+            f"label_index=0\nmax_feature_idx=0\nobjective=regression\n"
+            f"{header_extra}"
+            "feature_names=a\nfeature_infos=none\ntree_sizes=100\n\n"
+            "Tree=0\nnum_leaves=2\nnum_cat=0\n"
+            f"{tree_extra}"
+            "split_feature=0\n"
+            "split_gain=1\nthreshold=0.5\ndecision_type=0\n"
+            "left_child=-1\nright_child=-2\nleaf_value=1 2\n"
+            "leaf_weight=1.5 2.5\nleaf_count=1 1\ninternal_value=0\n"
+            "internal_weight=4.0\ninternal_count=2\nshrinkage=1\n\n\n"
+            "end of trees\n")
+
+    def test_version_matrix(self):
+        """v2/v3/v4 accepted (same tree-block subset); anything else is a
+        loud error, not a silent misparse."""
+        for ok in ("v2", "v3", "v4"):
+            b = from_lightgbm_string(self._minimal(version=ok))
+            np.testing.assert_allclose(
+                b.raw_predict(np.array([[0.0]])), [1.0])
+        for bad in ("v5", "", "3"):
+            with pytest.raises(ValueError, match="version"):
+                from_lightgbm_string(self._minimal(version=bad))
+
+    def test_version_line_missing_rejected(self):
+        text = self._minimal().replace("version=v3\n", "")
+        with pytest.raises(ValueError, match="version"):
+            from_lightgbm_string(text)
+
+    def test_linear_tree_rejected(self):
+        with pytest.raises(ValueError, match="linear"):
+            from_lightgbm_string(
+                self._minimal(version="v4", header_extra="linear_tree=1\n"))
+        with pytest.raises(ValueError, match="linear"):
+            from_lightgbm_string(
+                self._minimal(version="v4", tree_extra="is_linear=1\n"))
+
+    def test_leaf_weight_parsed_when_present(self):
+        b = from_lightgbm_string(self._minimal())
+        t = b.trees[0][0]
+        assert t.weight is not None
+        np.testing.assert_allclose(sorted(t.weight[t.feature == -1]),
+                                   [1.5, 2.5])
+        np.testing.assert_allclose(t.weight[t.feature >= 0], [4.0])
+
+    def test_missing_type_zero_warns(self):
+        # dt = 1<<2 (missing Zero) | default bits
+        text = self._minimal().replace("decision_type=0", "decision_type=4")
+        with pytest.warns(RuntimeWarning, match="missing_type=Zero"):
+            from_lightgbm_string(text)
+
     def test_categorical_rejected(self):
         text = self_text = (
             "tree\nversion=v3\nnum_class=1\nnum_tree_per_iteration=1\n"
