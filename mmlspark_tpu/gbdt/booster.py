@@ -764,7 +764,11 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
     # tunnelled chip at 2M+ rows x 50 iters): bound row*iteration work per
     # dispatch; the (score, comp) carry stays device-resident across chunks,
     # so the host cost is one small fetch per chunk.
-    budget = int(os.environ.get("MMLSPARK_TPU_SCAN_CHUNK_ROWS", str(2 * 10**7)))
+    # 6e7 row-iters ~ 12-20 s of device execution per dispatch at the r4
+    # per-iteration rate — comfortably under the ~40-60 s worker crash
+    # bound while paying the ~0.1 s per-dispatch fetch RTT 6x less often
+    # than the old 2e7 default (tools/profile_gbdt_10m.py history)
+    budget = int(os.environ.get("MMLSPARK_TPU_SCAN_CHUNK_ROWS", str(6 * 10**7)))
     ipc = max(1, min(iters, budget // max(n, 1)))
     n_chunks = -(-iters // ipc)
 
@@ -903,7 +907,6 @@ def train(params: TrainParams,
         mapper = BinMapper.fit(X[:n_real], params.max_bin,
                                params.categorical_feature, seed=params.seed,
                                max_bin_by_feature=params.max_bin_by_feature)
-    bins = mapper.transform(X)
     # the mapper (possibly inherited from init_model with a different max_bin)
     # is the sole authority on bin count — mixing in params.max_bin would corrupt
     # the flat scatter indices in compute_histogram
@@ -912,14 +915,61 @@ def train(params: TrainParams,
     put_bins = bins_put or jax.device_put
     # feature-major [F, N] device layout (column store, like LightGBM's own
     # Dataset): minor dim rows -> no XLA lane padding (an [N, 28] int32
-    # array tiles 28 -> 128 lanes, a 4.6x HBM blowup at 10M rows)
-    bins_fm = np.ascontiguousarray(bins.T)
-    if num_bins <= 256:
-        # ship bins as uint8 (4x less H2D — at 10M rows that's 280 MB vs
-        # 1.1 GB through the host link) and widen once on device
-        bins_dev = _widen_bins(put_bins(jnp.asarray(bins_fm.astype(np.uint8))))
+    # array tiles 28 -> 128 lanes, a 4.6x HBM blowup at 10M rows). Bins ship
+    # as uint8 when they fit (4x less H2D — 280 MB vs 1.1 GB at 10M rows
+    # through the host link) and widen once on device.
+    u8 = num_bins <= 256
+    bin_dtype = np.uint8 if u8 else np.int32
+    timing = os.environ.get("MMLSPARK_TPU_GBDT_TIMING", "") not in ("", "0")
+    t_bins = _now() if timing else 0.0
+    if bins_put is None and n * num_f >= 1 << 22:
+        # Overlapped bin+ship: the MAIN thread bins columns (the host has
+        # one core — a transform pool cannot help) while a single worker
+        # thread ships each finished slab (device_put releases the GIL
+        # during the tunnel write, measured full overlap: 28 slab puts ride
+        # inside the binning wall clock — tools/profile_gbdt_10m.py, r4).
+        import queue
+        import threading
+
+        slabs: List = [None] * num_f
+        slab_q: "queue.Queue" = queue.Queue()
+        worker_err: List[BaseException] = []
+
+        def _put_worker():
+            while True:
+                item = slab_q.get()
+                if item is None:
+                    return
+                fi, arr = item
+                try:
+                    slabs[fi] = jax.device_put(arr)
+                except BaseException as e:  # surface after join, not as a
+                    worker_err.append(e)    # confusing stack(None) TypeError
+                    return
+
+        th = threading.Thread(target=_put_worker, daemon=True)
+        th.start()
+        try:
+            for f in range(num_f):
+                col = mapper.transform_col(f, np.ascontiguousarray(X[:, f]))
+                slab_q.put((f, col.astype(bin_dtype)))
+        finally:
+            slab_q.put(None)
+            th.join()
+        if worker_err:
+            raise worker_err[0]
+        bins_dev = jnp.stack(slabs, axis=0)
+        if u8:
+            bins_dev = _widen_bins(bins_dev)
     else:
-        bins_dev = put_bins(jnp.asarray(bins_fm, dtype=jnp.int32))
+        bins_fm = mapper.transform_fm(X, dtype=bin_dtype)
+        if u8:
+            bins_dev = _widen_bins(put_bins(jnp.asarray(bins_fm)))
+        else:
+            bins_dev = put_bins(jnp.asarray(bins_fm))
+    if timing:
+        print(f"[gbdt-bins] transform+ship {_now() - t_bins:.3f}s",
+              flush=True)
 
     labels = put(jnp.asarray(y, dtype=jnp.float32))
     w_dev = put(jnp.asarray(weights, dtype=jnp.float32)) if weights is not None else None

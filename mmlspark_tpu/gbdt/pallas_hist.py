@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import functools
 import os
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -60,18 +61,30 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _hist_kernel(bins_ref, vals_ref, out_ref, *, nf: int, b_pad: int):
+def _hist_kernel(bins_ref, vals_ref, out_ref, *, nf: int, b_pad: int,
+                 hilo: bool):
     """One row-chunk grid cell, feature-major layout.
 
     bins_ref: [nf, CHUNK] int (feature-major: minor dim = rows, so the HBM
     array carries no lane padding — an [N, F] layout tiles F up to 128 lanes,
     a 4.6x HBM blowup at F=28 that OOMed the 10M-row bench),
-    vals_ref: [3, CHUNK] f32 (pre-masked channels x rows),
+    vals_ref: [3, CHUNK] f32 (pre-masked channels x rows) — or, in hi/lo
+    mode, [5, CHUNK] bf16 (g_hi, g_lo, h_hi, h_lo, mask),
     out_ref:  [3, nf*B_pad] f32 accumulator, VMEM-resident across the grid.
 
     The one-hot is built TRANSPOSED ([B_pad, CHUNK]: sublane broadcast of the
     feature row against a dim-0 iota) and contracted over rows on the MXU —
     no in-kernel transposes or minor-dim reshapes (Mosaic rejects those).
+
+    ``hilo``: the one-hot is EXACT in bf16 (0/1), so splitting grad/hess
+    into bf16 (hi, lo) pairs makes every product exact and turns the 3-pass
+    f32-HIGHEST contraction into ONE bf16 MXU pass over 5 channels (5 rows
+    still pad to the same 8-sublane M tile as 3). The only inexactness is
+    the hi+lo value decomposition itself (~17 mantissa bits vs f32's 24,
+    ~6e-6 relative on grad/hess magnitudes); accumulation stays f32.
+    Measured on the chip via tools/bench_hist.py before being made the TPU
+    default — the exact path stays one env var away
+    (MMLSPARK_TPU_HIST_EXACT=1).
     """
     j = pl.program_id(0)
 
@@ -79,34 +92,44 @@ def _hist_kernel(bins_ref, vals_ref, out_ref, *, nf: int, b_pad: int):
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    vals = vals_ref[...]                                     # [3, CHUNK]
+    vals = vals_ref[...]                          # [3, CHUNK] f32 | [5] bf16
     chunk = vals.shape[1]
     iota0 = jax.lax.broadcasted_iota(jnp.int32, (b_pad, chunk), 0)
     for f in range(nf):                                      # static unroll
         col = bins_ref[f : f + 1, :].astype(jnp.int32)       # [1, CHUNK]
-        onehot_t = (jnp.broadcast_to(col, (b_pad, chunk))
-                    == iota0).astype(jnp.float32)            # [B_pad, CHUNK]
-        acc = jax.lax.dot_general(                           # [3, B_pad] on MXU
-            vals, onehot_t,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            # HIGHEST = full-f32 MXU passes: gradient sums feed split gains,
-            # and the default bf16 rounding of vals costs ~1e-3 relative.
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)
+        onehot = jnp.broadcast_to(col, (b_pad, chunk)) == iota0
+        if hilo:
+            acc5 = jax.lax.dot_general(                      # [5, B_pad], 1 pass
+                vals, onehot.astype(jnp.bfloat16),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc = jnp.concatenate(
+                [acc5[0:1] + acc5[1:2],                      # grad = hi + lo
+                 acc5[2:3] + acc5[3:4],                      # hess = hi + lo
+                 acc5[4:5]], axis=0)                         # count
+        else:
+            acc = jax.lax.dot_general(                       # [3, B_pad] on MXU
+                vals, onehot.astype(jnp.float32),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                # HIGHEST = full-f32 MXU passes: gradient sums feed split
+                # gains, and plain bf16 rounding of vals costs ~1e-3 relative
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)
         out_ref[:, f * b_pad : (f + 1) * b_pad] += acc
 
 
-def _hist_slab(bins_slab, vals, b_pad: int, interpret: bool):
-    """[Fs, N_pad] bins + [3, N_pad] masked vals -> [3, Fs*b_pad] sums."""
+def _hist_slab(bins_slab, vals, b_pad: int, interpret: bool, hilo: bool):
+    """[Fs, N_pad] bins + [3|5, N_pad] masked vals -> [3, Fs*b_pad] sums."""
     fs, n_pad = bins_slab.shape
     n_chunks = n_pad // CHUNK
+    nch = vals.shape[0]
     return pl.pallas_call(
-        functools.partial(_hist_kernel, nf=fs, b_pad=b_pad),
+        functools.partial(_hist_kernel, nf=fs, b_pad=b_pad, hilo=hilo),
         grid=(n_chunks,),
         in_specs=[
             pl.BlockSpec((fs, CHUNK), lambda j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, CHUNK), lambda j: (0, j),
+            pl.BlockSpec((nch, CHUNK), lambda j: (0, j),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((3, fs * b_pad), lambda j: (0, 0),
@@ -114,39 +137,76 @@ def _hist_slab(bins_slab, vals, b_pad: int, interpret: bool):
         out_shape=jax.ShapeDtypeStruct((3, fs * b_pad), jnp.float32),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
-            flops=2 * 3 * n_pad * fs * b_pad,
-            bytes_accessed=bins_slab.size * 4 + vals.size * 4
+            flops=2 * nch * n_pad * fs * b_pad,
+            bytes_accessed=bins_slab.size * 4
+            + vals.size * vals.dtype.itemsize
             + 3 * fs * b_pad * 4,
             transcendentals=0,
         ),
     )(bins_slab, vals)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
+def hist_hilo() -> bool:
+    """bf16 hi/lo histogram contraction (one MXU pass instead of three
+    f32-HIGHEST passes; ~17-bit value mantissa, f32 accumulation).
+    MMLSPARK_TPU_HIST_EXACT=1 restores the full-f32 path."""
+    return os.environ.get("MMLSPARK_TPU_HIST_EXACT", "") in ("", "0")
+
+
 def compute_histogram_mxu(bins_fm, grad, hess, row_mask, num_bins: int,
-                          interpret: bool = False):
+                          interpret: bool = False,
+                          hilo: Optional[bool] = None):
     """[F,N] feature-major int bins + per-row grad/hess + row mask ->
     [F, num_bins, 3] sums.
 
     Drop-in replacement for histogram.compute_histogram's XLA scatter path.
     Rows are padded to a CHUNK multiple here; callers that keep N a CHUNK
     multiple (booster.train pads once on host) make the pad a no-op.
+
+    ``hilo`` resolves from the env OUTSIDE the jit boundary so flipping
+    MMLSPARK_TPU_HIST_EXACT between calls takes effect (it is a static jit
+    arg below — resolving it inside would freeze the first call's value
+    into the cache). Jitted callers (the fused tree/scan bodies) resolve it
+    at their own trace time.
     """
+    if hilo is None:
+        hilo = hist_hilo()
+    return _compute_histogram_mxu(bins_fm, grad, hess, row_mask, num_bins,
+                                  interpret, hilo)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "interpret", "hilo"))
+def _compute_histogram_mxu(bins_fm, grad, hess, row_mask, num_bins: int,
+                           interpret: bool, hilo: bool):
     f, n = bins_fm.shape
     b_pad = max(128, _round_up(num_bins, 128))
     n_pad = _round_up(max(n, 1), CHUNK)
 
     m = row_mask.astype(jnp.float32)
-    # channel-major [3, N]: minor dim rows -> no lane padding (an [N, 3]
-    # layout pads 3 -> 128 lanes, a 42x HBM blowup at large N)
-    vals = jnp.stack([grad * m, hess * m, m], axis=0).astype(jnp.float32)
+    g = (grad * m).astype(jnp.float32)
+    h = (hess * m).astype(jnp.float32)
+    if hilo:
+        # channel-major [5, N] bf16: exact one-hot x (hi, lo) value split —
+        # one bf16 MXU pass reconstructs ~17 value mantissa bits
+        g_hi = g.astype(jnp.bfloat16)
+        h_hi = h.astype(jnp.bfloat16)
+        vals = jnp.stack([
+            g_hi, (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16),
+            h_hi, (h - h_hi.astype(jnp.float32)).astype(jnp.bfloat16),
+            m.astype(jnp.bfloat16)], axis=0)
+    else:
+        # channel-major [3, N]: minor dim rows -> no lane padding (an [N, 3]
+        # layout pads 3 -> 128 lanes, a 42x HBM blowup at large N)
+        vals = jnp.stack([g, h, m], axis=0)
     vals = jnp.pad(vals, ((0, 0), (0, n_pad - n)))
     bins_p = jnp.pad(bins_fm, ((0, 0), (0, n_pad - n)))
 
     slabs = []
     for f0 in range(0, f, FMAX):
         fs = min(FMAX, f - f0)
-        out = _hist_slab(bins_p[f0 : f0 + fs, :], vals, b_pad, interpret)
+        out = _hist_slab(bins_p[f0 : f0 + fs, :], vals, b_pad, interpret,
+                         hilo)
         slabs.append(out.reshape(3, fs, b_pad))
     hist = jnp.concatenate(slabs, axis=1)        # [3, F, b_pad]
     return hist.transpose(1, 2, 0)[:, :num_bins, :]

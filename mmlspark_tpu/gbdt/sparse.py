@@ -198,11 +198,16 @@ def _flat_histogram(dev, grad, hess, node_mask_rows):
 
     One 1-D gather (row routing mask at the nnz entries) + one segment_sum —
     O(nnz) work regardless of F (LightGBM's per-feature nnz iteration,
-    TrainUtils.scala:23-66, as one vectorized pass)."""
+    TrainUtils.scala:23-66, as one vectorized pass).
+
+    ``dev["nnz_valid"]`` (optional, sharded layouts): 0/1 per entry —
+    padding entries in equal-shape per-shard slices contribute nothing."""
     import jax.numpy as jnp
     import jax.ops
 
     m = jnp.take(node_mask_rows, dev["row_of_nnz"]).astype(jnp.float32)
+    if "nnz_valid" in dev:
+        m = m * dev["nnz_valid"]
     g = jnp.take(grad, dev["row_of_nnz"]) * m
     h = jnp.take(hess, dev["row_of_nnz"]) * m
     data = jnp.stack([g, h, m], axis=-1)
@@ -224,10 +229,13 @@ def _zero_completed(dev, flat_hist, node_totals):
 
 
 def _find_best_split_flat(dev, hist, lambda_l1, lambda_l2, min_sum_hessian,
-                          min_data_in_leaf):
+                          min_data_in_leaf, bin_mask=None):
     """Vectorized gain scan over ALL flat bins: candidate t at flat bin b
     sends local bins <= b left. Per-feature left-cumulative sums come from a
-    global cumsum minus the feature's base — no per-feature loop."""
+    global cumsum minus the feature's base — no per-feature loop.
+
+    ``bin_mask``: optional [TB] bool of ALLOWED candidate bins (feature
+    fraction, mapped to the flat bin space by the caller)."""
     import jax.numpy as jnp
 
     from .histogram import _leaf_objective
@@ -245,6 +253,8 @@ def _find_best_split_flat(dev, hist, lambda_l1, lambda_l2, min_sum_hessian,
     ok = ((CL >= min_data_in_leaf) & (CR >= min_data_in_leaf)
           & (HL >= min_sum_hessian) & (HR >= min_sum_hessian)
           & ~dev["is_last_bin"])                          # no split after last
+    if bin_mask is not None:
+        ok &= bin_mask
     gain = jnp.where(ok, gain, -jnp.inf)
     b = jnp.argmax(gain)
     return (b, gain[b], jnp.stack([GL[b], HL[b], CL[b]]),
@@ -306,18 +316,253 @@ def _device_arrays(ds: SparseDataset):
     }
 
 
+_FUSED_SPARSE_GROW_CACHE: dict = {}
+
+
+def _tree_from_fused_out(out_host, config: GrowerConfig,
+                         thresholds: np.ndarray) -> Tree:
+    """Host-side Tree build from the fused grower's fetched arrays, leaf
+    values recomputed in f64 (same precision lineage as the host loop)."""
+    nn = int(out_host["n_nodes"])
+    feature = out_host["feature"][:nn].astype(np.int32)
+    tbin = out_host["threshold_bin"][:nn].astype(np.int32)
+    fbin = out_host["flat_bin"][:nn].astype(np.int64)
+    sums = out_host["sums"][:nn].astype(np.float64)
+    g_thr = np.sign(sums[:, 0]) * np.maximum(
+        np.abs(sums[:, 0]) - config.lambda_l1, 0.0)
+    value = np.where(feature < 0,
+                     -g_thr / (sums[:, 1] + config.lambda_l2), 0.0)
+    if config.max_delta_step > 0:
+        value = np.clip(value, -config.max_delta_step, config.max_delta_step)
+    value[0] = 0.0 if nn == 1 else value[0]
+    threshold = np.where(feature >= 0, thresholds[fbin], 0.0)
+    return Tree(
+        feature=feature,
+        threshold=threshold.astype(np.float64),
+        threshold_bin=tbin,
+        default_left=out_host["default_left"][:nn].astype(bool),
+        left=out_host["left"][:nn].astype(np.int32),
+        right=out_host["right"][:nn].astype(np.int32),
+        value=value,
+        gain=out_host["gain"][:nn].astype(np.float32),
+        count=sums[:, 2].astype(np.int32),
+        weight=sums[:, 1],
+    )
+
+
+def shard_sparse_dataset(ds: SparseDataset, n_shards: int):
+    """Partition rows into ``n_shards`` contiguous, nnz-BALANCED blocks and
+    build equal-shape per-shard nnz/row arrays (shard_map needs identical
+    shard shapes; padding entries carry feat=-1 / nnz_valid=0 so they
+    contribute nothing).
+
+    Returns (host dict of [S, ...] arrays, row_bounds [S+1], r_max).
+    nnz balancing: block boundaries at equal cumulative-nnz quantiles — the
+    reference's equivalent is Spark partition sizing; here the histogram
+    cost is O(local nnz), so balanced nnz = balanced step time."""
+    n = ds.num_rows
+    nnz = len(ds.indices)
+    # boundaries: rows where cumulative nnz crosses each 1/S quantile
+    targets = (np.arange(1, n_shards) * nnz) // n_shards
+    bounds = np.concatenate([
+        [0], np.searchsorted(ds.indptr[1:], targets, side="left") + 1, [n]])
+    bounds = np.maximum.accumulate(bounds)  # monotone under empty blocks
+    r_max = int(np.max(np.diff(bounds))) if n else 1
+    nz_max = int(np.max(ds.indptr[bounds[1:]] - ds.indptr[bounds[:-1]])) \
+        if n else 1
+    nz_max = max(nz_max, 1)
+
+    S = n_shards
+    bin_sh = np.zeros((S, nz_max), dtype=np.int32)
+    rowl_sh = np.zeros((S, nz_max), dtype=np.int32)
+    feat_sh = np.full((S, nz_max), -1, dtype=np.int32)
+    valid_sh = np.zeros((S, nz_max), dtype=np.float32)
+    row_valid = np.zeros((S, r_max), dtype=bool)
+    for s in range(S):
+        r0, r1 = int(bounds[s]), int(bounds[s + 1])
+        e0, e1 = int(ds.indptr[r0]), int(ds.indptr[r1])
+        m = e1 - e0
+        bin_sh[s, :m] = ds.bin_of_nnz[e0:e1]
+        rowl_sh[s, :m] = ds.row_of_nnz[e0:e1] - r0
+        feat_sh[s, :m] = ds.indices[e0:e1]
+        valid_sh[s, :m] = 1.0
+        row_valid[s, : r1 - r0] = True
+    return ({"bin_of_nnz": bin_sh, "row_of_nnz": rowl_sh,
+             "feat_of_nnz": feat_sh, "nnz_valid": valid_sh,
+             "row_valid": row_valid}, bounds, r_max)
+
+
+_SHARDED_SPARSE_GROW_CACHE: dict = {}
+
+
+def grow_tree_sparse_sharded(ds: SparseDataset, dev, sharded, mesh,
+                             grad_sh, hess_sh, row_mask_sh,
+                             config: GrowerConfig, bin_mask=None
+                             ) -> Tuple[Tree, np.ndarray]:
+    """Row-sharded whole-tree growth: the while_loop runs per shard under
+    shard_map with psum'd flat histograms — replicated split decisions,
+    sharded row routing (the dense engine's _grow_tree_device_sharded, on
+    CSR). One dispatch + one collective stream per tree.
+
+    ``sharded``: device dict from shard_sparse_dataset ([S, ...] arrays,
+    device_put with the shard dim split over the mesh's data axis).
+    ``grad_sh``/``hess_sh``/``row_mask_sh``: [S, r_max] sharded arrays.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    M = 2 * config.num_leaves - 1
+    has_bm = bin_mask is not None
+    tb = dev["total_bins"]
+    # key carries EVERY closed-over static (tb, num_features) — all array
+    # data flows through jit arguments, so a cache hit can never serve a
+    # stale dataset (shape changes retrace inside the cached jit)
+    key = (mesh, M, config.min_data_in_leaf, config.max_depth, has_bm,
+           tb, dev["num_features"])
+    if key not in _SHARDED_SPARSE_GROW_CACHE:
+        if len(_SHARDED_SPARSE_GROW_CACHE) >= 8:
+            _SHARDED_SPARSE_GROW_CACHE.pop(
+                next(iter(_SHARDED_SPARSE_GROW_CACHE)))
+        # globals (bin layout) replicate; per-shard arrays split on dim 0;
+        # static ints (segment counts) close over — they must not trace
+        nf_static = dev["num_features"]
+        glob = {k: v for k, v in dev.items()
+                if k not in ("row_of_nnz", "bin_of_nnz", "feat_of_nnz",
+                             "total_bins", "num_features")}
+
+        sh_spec = P(DATA_AXIS)
+        rep = P()
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=({k: sh_spec for k in
+                       ("bin_of_nnz", "row_of_nnz", "feat_of_nnz",
+                        "nnz_valid")},
+                      sh_spec, sh_spec, sh_spec,
+                      {k: rep for k in glob}, rep, rep, rep, rep, rep),
+            out_specs={"node_of_row": sh_spec, "feature": rep,
+                       "threshold_bin": rep, "flat_bin": rep,
+                       "default_left": rep, "left": rep, "right": rep,
+                       "gain": rep, "sums": rep, "n_nodes": rep},
+            # like tree._grow_tree_device_sharded: the while_loop carry
+            # mixes shard-varying (node_of_row) and replicated state
+            check_vma=False)
+        def go(shd, g, h, m, gl, bm, l1, l2, mshp, mgsp):
+            dev_l = dict(gl)
+            dev_l["total_bins"] = tb
+            dev_l["num_features"] = nf_static
+            for kk, v in shd.items():
+                dev_l[kk] = v[0]
+            g, h, m = g[0], h[0], m[0]
+            mask_f = m.astype(jnp.float32)
+            root_tot = jax.lax.psum(
+                jnp.stack([jnp.sum(g * mask_f), jnp.sum(h * mask_f),
+                           jnp.sum(mask_f)]),
+                DATA_AXIS)
+            out = _grow_tree_sparse_body(
+                dev_l, g, h, m, jnp.zeros(g.shape[0], jnp.int32), root_tot,
+                l1, l2, mshp, mgsp, bm, total_bins=tb, max_nodes=M,
+                min_data_in_leaf=config.min_data_in_leaf,
+                max_depth=config.max_depth, has_bin_mask=has_bm,
+                psum_axis=DATA_AXIS)
+            out["node_of_row"] = out["node_of_row"][None, :]
+            return out
+
+        _SHARDED_SPARSE_GROW_CACHE[key] = (jax.jit(go), glob)
+    fn, glob = _SHARDED_SPARSE_GROW_CACHE[key]
+    bm = bin_mask if has_bm else jnp.zeros(0, dtype=bool)
+    out = fn({k: sharded[k] for k in ("bin_of_nnz", "row_of_nnz",
+                                      "feat_of_nnz", "nnz_valid")},
+             grad_sh, hess_sh, row_mask_sh, glob, bm,
+             np.float32(config.lambda_l1), np.float32(config.lambda_l2),
+             np.float32(config.min_sum_hessian_in_leaf),
+             np.float32(config.min_gain_to_split))
+    rows_dev = out.pop("node_of_row")
+    out_host = jax.device_get(out)
+    tree = _tree_from_fused_out(out_host, config, ds.thresholds)
+    return tree, np.asarray(jax.device_get(rows_dev))
+
+
 def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
-                     config: GrowerConfig) -> Tuple[Tree, np.ndarray]:
-    """Leaf-wise growth over the flat sparse bins (host-orchestrated loop;
-    each split = one histogram segment_sum + one flat gain scan)."""
+                     config: GrowerConfig, row_mask=None, bin_mask=None,
+                     use_fused: Optional[bool] = None
+                     ) -> Tuple[Tree, np.ndarray]:
+    """Grow one tree over the flat sparse bins; returns (tree, leaf_of_row).
+
+    Default (``use_fused``): the whole tree grows inside one jitted
+    ``lax.while_loop`` dispatch (_grow_tree_sparse_body) — one fetch per
+    tree. Fallback (state over the memory budget or explicitly disabled):
+    the host-orchestrated per-split loop below.
+
+    ``row_mask``: [N] bool device array — bagging/goss subset (histograms
+    and totals are masked; routing still covers every row).
+    ``bin_mask``: [TB] bool device array of allowed split bins
+    (feature_fraction mapped to the flat space).
+    """
     import heapq
 
     import jax
     import jax.numpy as jnp
 
     n = ds.num_rows
+    if use_fused is None:
+        use_fused = (_fused_sparse_enabled(2 * config.num_leaves - 1,
+                                           ds.total_bins)
+                     and jax.default_backend() != "cpu")
+    if use_fused:
+        M = 2 * config.num_leaves - 1
+        has_bm = bin_mask is not None
+        tb = dev["total_bins"]
+        nf = dev["num_features"]
+        # key carries every closed-over static; array data (the dev dict)
+        # flows through jit arguments — no id()-keying, no pinned device
+        # memory for evicted datasets (numBatches builds a fresh
+        # SparseDataset per batch)
+        key = (M, config.min_data_in_leaf, config.max_depth, has_bm, tb, nf)
+        if key not in _FUSED_SPARSE_GROW_CACHE:
+            if len(_FUSED_SPARSE_GROW_CACHE) >= 16:
+                _FUSED_SPARSE_GROW_CACHE.pop(
+                    next(iter(_FUSED_SPARSE_GROW_CACHE)))
+
+            @jax.jit
+            def _go(devd, gk, hk, mask, bm, l1, l2, msh, mgs):
+                devd = dict(devd)
+                devd["total_bins"] = tb
+                devd["num_features"] = nf
+                mask_f = mask.astype(jnp.float32)
+                root_tot = jnp.stack([jnp.sum(gk * mask_f),
+                                      jnp.sum(hk * mask_f),
+                                      jnp.sum(mask_f)])
+                return _grow_tree_sparse_body(
+                    devd, gk, hk, mask, jnp.zeros(gk.shape[0], jnp.int32),
+                    root_tot, l1, l2, msh, mgs, bm, total_bins=tb,
+                    max_nodes=M, min_data_in_leaf=config.min_data_in_leaf,
+                    max_depth=config.max_depth, has_bin_mask=has_bm)
+
+            _FUSED_SPARSE_GROW_CACHE[key] = _go
+        mask = row_mask if row_mask is not None \
+            else jnp.ones(n, dtype=bool)
+        bm = bin_mask if has_bm else jnp.zeros(0, dtype=bool)
+        dev_arrays = {kk_: v for kk_, v in dev.items()
+                      if kk_ not in ("total_bins", "num_features")}
+        out = _FUSED_SPARSE_GROW_CACHE[key](
+            dev_arrays, mask=mask, bm=bm, gk=grad, hk=hess,
+            l1=np.float32(config.lambda_l1), l2=np.float32(config.lambda_l2),
+            msh=np.float32(config.min_sum_hessian_in_leaf),
+            mgs=np.float32(config.min_gain_to_split))
+        rows_dev = out.pop("node_of_row")
+        out_host = jax.device_get(out)
+        tree = _tree_from_fused_out(out_host, config, ds.thresholds)
+        return tree, np.asarray(jax.device_get(rows_dev))
+
     node_of_row = jnp.zeros(n, dtype=jnp.int32)
-    ones = jnp.ones(n, dtype=bool)
+    ones = row_mask if row_mask is not None else jnp.ones(n, dtype=bool)
 
     feature = [-1]
     threshold = [0.0]
@@ -342,18 +587,20 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
         flat = _flat_histogram(dev, grad, hess, mask_rows)
         return _zero_completed(dev, flat, totals)
 
-    totals0 = jnp.stack([jnp.sum(grad), jnp.sum(hess),
-                         jnp.asarray(float(n), jnp.float32)])
+    mask_f = ones.astype(jnp.float32)
+    totals0 = jnp.stack([jnp.sum(grad * mask_f), jnp.sum(hess * mask_f),
+                         jnp.sum(mask_f)])
     hist0 = node_hist(ones, totals0)
-    counts[0] = n
-    hweights[0] = float(jax.device_get(totals0)[1])
+    totals0_h = np.asarray(jax.device_get(totals0), np.float64)
+    counts[0] = int(totals0_h[2])
+    hweights[0] = float(totals0_h[1])
 
     def eval_split(hist):
         b, gain, lsum, rsum = _find_best_split_flat(
             dev, hist, np.float32(config.lambda_l1),
             np.float32(config.lambda_l2),
             np.float32(config.min_sum_hessian_in_leaf),
-            config.min_data_in_leaf)
+            config.min_data_in_leaf, bin_mask)
         b, gain, lsum, rsum = jax.device_get((b, gain, lsum, rsum))
         f = int(np.searchsorted(ds.feat_offset, b, side="right") - 1)
         t_local = int(b - ds.feat_offset[f])
@@ -374,7 +621,7 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
                                    f, t_local, lsum, rsum, gain)))
             tiebreak += 1
 
-    push(0, 0, hist0, np.asarray(jax.device_get(totals0), np.float64))
+    push(0, 0, hist0, totals0_h)
     n_leaves = 1
 
     while heap and n_leaves < config.num_leaves:
@@ -410,7 +657,7 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
         small_id, big_id = (lid, rid) if lsum[2] <= rsum[2] else (rid, lid)
         small_sums = lsum if small_id == lid else rsum
         big_sums = rsum if small_id == lid else lsum
-        small_hist = node_hist(node_of_row == small_id,
+        small_hist = node_hist(ones & (node_of_row == small_id),
                                jnp.asarray(small_sums, jnp.float32))
         big_hist = hist - small_hist
         for cid, chist, csums in ((small_id, small_hist, small_sums),
@@ -433,33 +680,434 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
     return tree, np.asarray(jax.device_get(node_of_row))
 
 
-def train_sparse(params, ds: SparseDataset, y: np.ndarray,
-                 weights: Optional[np.ndarray] = None):
-    """Boosting over a SparseDataset; returns an ordinary Booster.
+# ---------------------------------------------------------------------------
+# Device-fused whole-tree growth + whole-run scan (the dense engine's
+# booster._train_scan / tree._grow_tree_device_body, ported to the flat
+# ragged bin space — one dispatch chain for the entire boosting run)
+# ---------------------------------------------------------------------------
 
-    Supports the elementwise objectives (binary/regression families);
-    bagging/goss/dart fall back to their dense-path semantics later if
-    needed — the text-pipeline parity target is plain gbdt
-    (docs/lightgbm.md text scenarios)."""
+# Per-node flat-histogram state cap for the fused sparse grower:
+# [2L-1, total_bins, 3] f32. Above this, the host-orchestrated per-split
+# loop runs instead (its live set is the heap frontier only).
+_FUSED_SPARSE_DEFAULT_BUDGET = 2 << 30
+
+
+def _fused_sparse_enabled(max_nodes: int, total_bins: int) -> bool:
+    import os
+
+    if os.environ.get("MMLSPARK_TPU_NO_FUSED_TREE", "") not in ("", "0"):
+        return False
+    budget = int(os.environ.get("MMLSPARK_TPU_FUSED_TREE_BYTES",
+                                _FUSED_SPARSE_DEFAULT_BUDGET))
+    return max_nodes * total_bins * 3 * 4 <= budget
+
+
+def _grow_tree_sparse_body(dev, grad, hess, row_mask, node_of_row, root_tot,
+                           l1, l2, msh, mgs, bin_mask, *, total_bins: int,
+                           max_nodes: int, min_data_in_leaf: int,
+                           max_depth: int, has_bin_mask: bool,
+                           psum_axis=None):
+    """Grow one whole tree over the flat sparse bins inside a single
+    ``lax.while_loop`` (the sparse analogue of tree._grow_tree_device_body).
+
+    ``dev``: the _device_arrays dict (traced pytree — nnz/bin layouts).
+    ``root_tot``: [3] f32 masked (grad, hess, count) node totals (already
+    psum'd by the caller when sharded).
+    ``psum_axis``: set when running per shard under shard_map with rows
+    split over that mesh axis — every histogram is psum'd so all shards
+    take identical split decisions while the row routing stays sharded
+    (LightGBM's socket-ring data-parallel mode as one collective stream,
+    TrainUtils.scala:383-418).
+    Returns flat node arrays sized ``max_nodes`` plus the final row→node
+    routing; node ids are assigned in split order exactly like the dense
+    grower, so serialization/merge see an identical tree shape.
+    """
     import jax
     import jax.numpy as jnp
 
-    from .booster import (Booster, GrowerConfig, default_metric, grad_hess,
-                          init_score)
+    neg_inf = jnp.float32(-jnp.inf)
+    M = max_nodes
+    num_leaves_target = (max_nodes + 1) // 2
+    bm = bin_mask if has_bin_mask else None
 
-    if params.boosting_type != "gbdt":
-        raise ValueError("sparse training supports boosting_type='gbdt'")
+    def best(hist):
+        return _find_best_split_flat(dev, hist, l1, l2, msh,
+                                     min_data_in_leaf, bm)
+
+    def node_hist(mask_rows, totals):
+        flat = _flat_histogram(dev, grad, hess, mask_rows)
+        if psum_axis is not None:
+            flat = jax.lax.psum(flat, psum_axis)
+        return _zero_completed(dev, flat, totals)
+
+    root_hist = node_hist(row_mask, root_tot)
+    b0, gain0, lsum0, rsum0 = best(root_hist)
+    root_ok = jnp.isfinite(gain0) & (gain0 > mgs)
+
+    f32 = jnp.float32
+    state = dict(
+        node_of_row=node_of_row,
+        feature=jnp.full(M, -1, jnp.int32),
+        threshold_bin=jnp.zeros(M, jnp.int32),   # LOCAL bin within feature
+        flat_bin=jnp.zeros(M, jnp.int32),        # flat bin (threshold lookup)
+        default_left=jnp.ones(M, bool),
+        left=jnp.full(M, -1, jnp.int32),
+        right=jnp.full(M, -1, jnp.int32),
+        gain=jnp.zeros(M, f32),
+        sums=jnp.zeros((M, 3), f32).at[0].set(root_tot),
+        depth=jnp.zeros(M, jnp.int32),
+        hists=jnp.zeros((M, total_bins, 3), f32).at[0].set(root_hist),
+        cand_gain=jnp.full(M, -jnp.inf, f32).at[0].set(
+            jnp.where(root_ok, gain0, neg_inf)),
+        cand_bin=jnp.zeros(M, jnp.int32).at[0].set(b0.astype(jnp.int32)),
+        cand_lsum=jnp.zeros((M, 3), f32).at[0].set(lsum0),
+        cand_rsum=jnp.zeros((M, 3), f32).at[0].set(rsum0),
+        n_nodes=jnp.int32(1),
+        n_leaves=jnp.int32(1),
+    )
+
+    def cond(st):
+        return (st["n_leaves"] < num_leaves_target) \
+            & (jnp.max(st["cand_gain"]) > neg_inf)
+
+    def body(st):
+        leaf = jnp.argmax(st["cand_gain"]).astype(jnp.int32)
+        b = st["cand_bin"][leaf]
+        f = dev["feat_of_bin"][b]
+        t_local = b - dev["feat_start_of_bin"][b]
+        dl = dev["zero_local_dev"][f] <= t_local   # absent (0.0) routing
+        lsum = st["cand_lsum"][leaf]
+        rsum = st["cand_rsum"][leaf]
+        lid = st["n_nodes"]
+        rid = lid + 1
+        dchild = st["depth"][leaf] + 1
+
+        node_of_row = _route_rows(dev, st["node_of_row"], leaf, f, t_local,
+                                  lid, rid)
+
+        small_is_left = lsum[2] <= rsum[2]
+        small_id = jnp.where(small_is_left, lid, rid)
+        big_id = jnp.where(small_is_left, rid, lid)
+        small_tot = jnp.where(small_is_left, lsum, rsum)
+        small_mask = row_mask & (node_of_row == small_id)
+        small_hist = node_hist(small_mask, small_tot)
+        big_hist = st["hists"][leaf] - small_hist
+        sb, sg, sl, sr = best(small_hist)
+        bb, bg, bl, br = best(big_hist)
+
+        cg = st["cand_gain"].at[leaf].set(neg_inf)
+        cb = st["cand_bin"]
+        cl, cr = st["cand_lsum"], st["cand_rsum"]
+
+        def push(arrs, nid, bsel, gsel, lsel, rsel, csum):
+            cg, cb, cl, cr = arrs
+            ok = jnp.isfinite(gsel) & (gsel > mgs)
+            ok &= csum[2] >= 2 * min_data_in_leaf
+            if max_depth > 0:
+                ok &= dchild < max_depth
+            return (cg.at[nid].set(jnp.where(ok, gsel, neg_inf)),
+                    cb.at[nid].set(bsel.astype(jnp.int32)),
+                    cl.at[nid].set(lsel), cr.at[nid].set(rsel))
+
+        big_tot = jnp.where(small_is_left, rsum, lsum)
+        arrs = push((cg, cb, cl, cr), small_id, sb, sg, sl, sr, small_tot)
+        cg, cb, cl, cr = push(arrs, big_id, bb, bg, bl, br, big_tot)
+
+        return dict(
+            node_of_row=node_of_row,
+            feature=st["feature"].at[leaf].set(f),
+            threshold_bin=st["threshold_bin"].at[leaf].set(t_local),
+            flat_bin=st["flat_bin"].at[leaf].set(b),
+            default_left=st["default_left"].at[leaf].set(dl),
+            left=st["left"].at[leaf].set(lid),
+            right=st["right"].at[leaf].set(rid),
+            gain=st["gain"].at[leaf].set(st["cand_gain"][leaf]),
+            sums=st["sums"].at[lid].set(lsum).at[rid].set(rsum),
+            depth=st["depth"].at[lid].set(dchild).at[rid].set(dchild),
+            hists=st["hists"].at[small_id].set(small_hist)
+                             .at[big_id].set(big_hist),
+            cand_gain=cg, cand_bin=cb, cand_lsum=cl, cand_rsum=cr,
+            n_nodes=lid + 2, n_leaves=st["n_leaves"] + 1,
+        )
+
+    out = jax.lax.while_loop(cond, body, state)
+    return {k: out[k] for k in (
+        "node_of_row", "feature", "threshold_bin", "flat_bin", "default_left",
+        "left", "right", "gain", "sums", "n_nodes")}
+
+
+def _scan_sparse_ok(params, valid, log) -> bool:
+    """Whole-run-scan eligibility for the sparse path: mirrors
+    booster._scan_train_ok (dart and per-iteration host eval stay on the
+    host loop; lambdarank grads are group-segmented and also host-looped)."""
+    import os
+
+    import jax
+
+    if os.environ.get("MMLSPARK_TPU_NO_SCAN_TRAIN", "") not in ("", "0"):
+        return False
+    if params.boosting_type == "dart" or params.objective == "lambdarank":
+        return False
+    if valid is not None or log is not None or params.train_metric:
+        return False
+    if 2 * params.num_leaves - 1 < 3:
+        return False
+    forced = os.environ.get("MMLSPARK_TPU_SCAN_TRAIN", "") not in ("", "0")
+    if not forced and jax.default_backend() == "cpu":
+        return False
+    return True
+
+
+def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
+                       dev, labels, w_dev, scores, k: int, lr: float,
+                       row_masks, feat_masks) -> None:
+    """ALL boosting iterations in one chunked ``lax.scan`` dispatch over the
+    flat sparse bin space — no per-tree host round trips (the sparse
+    analogue of booster._train_scan; chunking bounds device-runtime per
+    dispatch the same way)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from .booster import grad_hess
+
+    n = ds.num_rows
+    iters = params.num_iterations
+    M = 2 * config.num_leaves - 1
+    tb = dev["total_bins"]
+    objective = params.objective
+    alpha = params.alpha
+    l1 = np.float32(config.lambda_l1)
+    l2 = np.float32(config.lambda_l2)
+    msh = np.float32(config.min_sum_hessian_in_leaf)
+    mgs = np.float32(config.min_gain_to_split)
+    has_fm = feat_masks is not None
+    shrink = np.float32(lr)
+    ones_mask = jnp.ones(n, dtype=bool)
+    bm_dummy = jnp.zeros(0, dtype=bool)
+
+    # in-scan GOSS (mask-only): on-device top-|grad| threshold via count
+    # bisection + Bernoulli "other" draw, amplified small-gradient rows —
+    # the dense scan's selection, minus row compaction (histogram work here
+    # is O(nnz) via segment_sum, which masking does not shrink; compaction
+    # of the nnz stream is a recorded follow-up, BENCH_gbdt_sparse.json)
+    is_goss = params.boosting_type == "goss"
+    if is_goss:
+        top_n = int(n * params.top_rate)
+        other_n = int(n * params.other_rate)
+        goss_amp = np.float32((1.0 - params.top_rate)
+                              / max(params.other_rate, 1e-12))
+        goss_keys = jax.random.split(
+            jax.random.PRNGKey(params.seed or params.bagging_seed), iters)
+
+    def body(carry, xs):
+        score, comp = carry
+        row_mask = xs["rm"] if row_masks is not None else ones_mask
+        if has_fm:
+            bin_mask = jnp.take(xs["fm"], dev["feat_of_bin"])
+        else:
+            bin_mask = bm_dummy
+        g, h = grad_hess(objective, score, labels, w_dev, alpha)
+        if is_goss:
+            g_sel = jnp.abs(g) if g.ndim == 1 else jnp.sum(jnp.abs(g), axis=1)
+            gmax = jnp.max(g_sel).astype(jnp.float32)
+
+            def _bis(_, lohi):
+                lo, hi = lohi
+                mid = 0.5 * (lo + hi)
+                above = jnp.sum(g_sel >= mid, dtype=jnp.int32)
+                return (jnp.where(above >= top_n, mid, lo),
+                        jnp.where(above >= top_n, hi, mid))
+
+            lo, _ = jax.lax.fori_loop(
+                0, 20, _bis,
+                (jnp.float32(0.0), gmax * jnp.float32(1.000001) + 1e-30))
+            is_top = g_sel >= lo
+            count_top = jnp.sum(is_top, dtype=jnp.int32)
+            p_other = other_n / jnp.maximum(
+                (jnp.int32(n) - count_top).astype(jnp.float32), 1.0)
+            u = jax.random.uniform(xs["gk"], (n,))
+            row_mask = is_top | (~is_top & (u < p_other))
+            amp = jnp.where(is_top, jnp.float32(1.0), goss_amp)
+            g = g * (amp if g.ndim == 1 else amp[:, None])
+            h = h * (amp if h.ndim == 1 else amp[:, None])
+
+        mask_f = row_mask.astype(jnp.float32)
+        outs = []
+        for kk in range(k):
+            gk = g if g.ndim == 1 else g[:, kk]
+            hk = h if h.ndim == 1 else h[:, kk]
+            root_tot = jnp.stack([jnp.sum(gk * mask_f), jnp.sum(hk * mask_f),
+                                  jnp.sum(mask_f)])
+            out = _grow_tree_sparse_body(
+                dev, gk, hk, row_mask, jnp.zeros(n, jnp.int32), root_tot,
+                l1, l2, msh, mgs, bin_mask, total_bins=tb, max_nodes=M,
+                min_data_in_leaf=config.min_data_in_leaf,
+                max_depth=config.max_depth, has_bin_mask=has_fm)
+            rows = out.pop("node_of_row")
+            sums, feat = out["sums"], out["feature"]
+            g_thr = jnp.sign(sums[:, 0]) * jnp.maximum(
+                jnp.abs(sums[:, 0]) - l1, 0.0)
+            val = jnp.where(feat < 0, -g_thr / (sums[:, 1] + l2), 0.0)
+            if config.max_delta_step > 0:
+                val = jnp.clip(val, -config.max_delta_step,
+                               config.max_delta_step)
+            val = val.at[0].set(jnp.where(out["n_nodes"] > 1, val[0], 0.0))
+            upd = (val * shrink)[rows]
+            if k == 1:
+                y_ = upd + comp
+                t_ = score + y_
+                score, comp = t_, y_ - (t_ - score)
+            else:
+                s_col, c_col = score[:, kk], comp[:, kk]
+                y_ = upd + c_col
+                t_ = s_col + y_
+                score = score.at[:, kk].set(t_)
+                comp = comp.at[:, kk].set(y_ - (t_ - s_col))
+            outs.append(out)
+        stacked = jax.tree.map(lambda *a: jnp.stack(a), *outs)
+        return (score, comp), stacked
+
+    score0 = jnp.asarray(scores[:, 0] if k == 1 else scores,
+                         dtype=jnp.float32)
+    comp0 = jnp.zeros_like(score0)
+    xs = None
+    if row_masks is not None or has_fm or is_goss:
+        xs = {}
+        if row_masks is not None:
+            xs["rm"] = jnp.asarray(row_masks)
+        if has_fm:
+            xs["fm"] = jnp.asarray(feat_masks)
+        if is_goss:
+            xs["gk"] = goss_keys
+
+    # chunk: bound device-runtime per dispatch (the tunnelled worker dies
+    # past ~40-60s of continuous execution); sparse per-iter work scales
+    # with nnz (histogram streams) + n (routing) + M*tb (state updates)
+    per_iter = len(ds.indices) + n + M * tb // 8
+    budget = int(os.environ.get("MMLSPARK_TPU_SCAN_CHUNK_ROWS",
+                                str(2 * 10**7)))
+    ipc = max(1, min(iters, budget // max(per_iter, 1)))
+
+    carry = (score0, comp0)
+    host_chunks = []
+    done = 0
+    while done < iters:
+        xs_c = None
+        if xs is not None:
+            idx = np.minimum(np.arange(done, done + ipc), iters - 1)
+            xs_c = {kk_: v[idx] for kk_, v in xs.items()}
+        carry, ys = jax.lax.scan(body, carry, xs_c, length=ipc)
+        host_chunks.append(jax.device_get(ys))
+        done += ipc
+    host = jax.tree.map(lambda *c: np.concatenate(c, axis=0), *host_chunks) \
+        if len(host_chunks) > 1 else host_chunks[0]
+    host = jax.tree.map(lambda a: a[:iters], host)
+
+    thresholds = ds.thresholds  # [TB] f64 upper values
+    for it in range(iters):
+        group: List[Tree] = []
+        for kk in range(k):
+            nn = int(host["n_nodes"][it, kk])
+            feature = host["feature"][it, kk][:nn].astype(np.int32)
+            tbin = host["threshold_bin"][it, kk][:nn].astype(np.int32)
+            fbin = host["flat_bin"][it, kk][:nn].astype(np.int64)
+            sums = host["sums"][it, kk][:nn].astype(np.float64)
+            g_thr = np.sign(sums[:, 0]) * np.maximum(
+                np.abs(sums[:, 0]) - config.lambda_l1, 0.0)
+            value = np.where(feature < 0,
+                             -g_thr / (sums[:, 1] + config.lambda_l2), 0.0)
+            if config.max_delta_step > 0:
+                value = np.clip(value, -config.max_delta_step,
+                                config.max_delta_step)
+            value[0] = 0.0 if nn == 1 else value[0]
+            threshold = np.where(feature >= 0, thresholds[fbin], 0.0)
+            group.append(Tree(
+                feature=feature,
+                threshold=threshold.astype(np.float64),
+                threshold_bin=tbin,
+                default_left=host["default_left"][it, kk][:nn].astype(bool),
+                left=host["left"][it, kk][:nn].astype(np.int32),
+                right=host["right"][it, kk][:nn].astype(np.int32),
+                value=value,
+                gain=host["gain"][it, kk][:nn].astype(np.float32),
+                count=sums[:, 2].astype(np.int32),
+                shrinkage=lr,
+                weight=sums[:, 1],
+            ))
+        booster.trees.append(group)
+
+
+def train_sparse(params, ds: SparseDataset, y: np.ndarray,
+                 weights: Optional[np.ndarray] = None,
+                 groups: Optional[np.ndarray] = None,
+                 valid: Optional[Tuple] = None,
+                 valid_groups: Optional[np.ndarray] = None,
+                 init_scores: Optional[np.ndarray] = None,
+                 init_model=None,
+                 log=None,
+                 mesh=None):
+    """Boosting over a SparseDataset; returns an ordinary Booster.
+
+    Carries the reference's FULL sparse param surface — in the reference,
+    CSR data feeds the same native engine with everything enabled
+    (generateSparseDataset → LGBM_DatasetCreateFromCSRSpark,
+    lightgbm/TrainUtils.scala:23-66): bagging (incl. pos/neg and rf),
+    goss, dart, feature_fraction, weights, init scores, lambdarank groups,
+    validation + early stopping, and continued training (init_model).
+
+    The no-valid/no-dart/no-lambdarank case runs the whole boosting run in
+    ONE chunked lax.scan dispatch (_train_scan_sparse); everything else
+    takes the host-orchestrated loop below.
+
+    ``valid``: optional ((indptr, indices, values), y_valid) CSR holdout.
+    ``mesh``: optional jax Mesh — rows are split into nnz-balanced
+    contiguous blocks over the ``data`` axis and each tree grows per shard
+    under shard_map with psum'd flat histograms (grow_tree_sparse_sharded):
+    the CSR counterpart of the dense engine's multi-chip data-parallel
+    path, replacing LightGBM's socket-ring allreduce over sparse partitions
+    (TrainUtils.scala:23-66 + 383-418).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .booster import (_HIGHER_BETTER, Booster, GrowerConfig,
+                          _scan_precompute_masks, default_metric, eval_metric,
+                          grad_hess, init_score, segment_groups)
+
     k = max(params.num_class, 1)
     n = ds.num_rows
     dev = _device_arrays(ds)
     labels = jnp.asarray(y, dtype=jnp.float32)
     w_dev = jnp.asarray(weights, dtype=jnp.float32) \
         if weights is not None else None
+    g_dev = jnp.asarray(groups, dtype=jnp.int32) \
+        if groups is not None else None
+    group_seg = (segment_groups(groups)
+                 if groups is not None and params.objective == "lambdarank"
+                 else None)
+    rng = np.random.default_rng(params.seed or params.bagging_seed)
 
-    base = init_score(params.objective, np.asarray(y, dtype=np.float64), k,
-                      alpha=params.alpha)
-    scores = np.tile(base, (n, 1)).astype(np.float64)
+    if init_scores is not None:
+        base = np.zeros(k, dtype=np.float64)
+        scores = np.broadcast_to(
+            np.asarray(init_scores, dtype=np.float64).reshape(n, -1),
+            (n, k)).copy()
+    else:
+        base = init_score(params.objective, np.asarray(y, dtype=np.float64),
+                          k, alpha=params.alpha)
+        scores = np.tile(base, (n, 1)).astype(np.float64)
     booster = Booster(params, None, base_score=base)
+    if init_model is not None:
+        booster.trees = [list(g) for g in init_model.trees]
+        booster.base_score = init_model.base_score
+        base = booster.base_score
+        if init_model.trees:
+            scores = (np.tile(base, (n, 1))
+                      + predict_csr(init_model.trees,
+                                    ds.indptr, ds.indices, ds.values, k))
+
     config = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
         min_data_in_leaf=params.min_data_in_leaf,
@@ -468,20 +1116,228 @@ def train_sparse(params, ds: SparseDataset, y: np.ndarray,
         lambda_l1=params.lambda_l1, lambda_l2=params.lambda_l2,
         max_delta_step=params.max_delta_step)
 
-    for _ in range(params.num_iterations):
+    is_rf = params.boosting_type == "rf"
+    is_dart = params.boosting_type == "dart"
+    is_goss = params.boosting_type == "goss"
+    lr = 1.0 if is_rf else params.learning_rate
+
+    # ----- mesh sharding context (nnz-balanced contiguous row blocks) ---
+    shard_ctx = None
+    if mesh is not None:
+        from ..parallel.mesh import DATA_AXIS
+
+        n_shards = int(mesh.shape.get(DATA_AXIS, 1))
+        if n_shards > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sh_host, bounds, r_max = shard_sparse_dataset(ds, n_shards)
+            row_sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+            sharded = {kk_: jax.device_put(jnp.asarray(v), row_sharding)
+                       for kk_, v in sh_host.items()
+                       if kk_ != "row_valid"}
+            row_valid = sh_host["row_valid"]
+
+            # one-time gather plan: [S, r_max] indices into a (sentinel-
+            # extended) [N+1] array — per-iteration resharding is a single
+            # fancy-index instead of a Python loop over shards
+            pad_idx = np.full((n_shards, r_max), n, dtype=np.int64)
+            for s in range(n_shards):
+                ln = bounds[s + 1] - bounds[s]
+                pad_idx[s, :ln] = np.arange(bounds[s], bounds[s + 1])
+
+            def _to_shards(a, fill=0):
+                ext = np.append(a, np.asarray(fill, dtype=a.dtype))
+                return ext[pad_idx]
+
+            def _from_shards(a_sh):
+                return np.concatenate(
+                    [a_sh[s, : bounds[s + 1] - bounds[s]]
+                     for s in range(n_shards)])
+
+            shard_ctx = (sharded, row_sharding, _to_shards, _from_shards)
+
+    # ----- whole-run fused scan path ------------------------------------
+    if (shard_ctx is None and _scan_sparse_ok(params, valid, log)
+            and _fused_sparse_enabled(2 * config.num_leaves - 1,
+                                      ds.total_bins)):
+        row_masks, feat_masks, ok = _scan_precompute_masks(
+            params, rng, n, ds.num_features, np.asarray(y), is_rf)
+        if ok:
+            from ..core.runtime import ensure_compile_cache
+
+            ensure_compile_cache()
+            _train_scan_sparse(params, config, booster, ds, dev, labels,
+                               w_dev, scores, k, lr, row_masks, feat_masks)
+            if is_rf and booster.trees:
+                inv = 1.0 / len(booster.trees)
+                for gtrees in booster.trees:
+                    for t in gtrees:
+                        t.shrinkage = inv
+            return booster
+
+    # ----- host-orchestrated loop (valid/early-stop, dart, lambdarank) --
+    metric = params.metric or default_metric(params.objective)
+    higher_better = metric in _HIGHER_BETTER
+    best_val = -np.inf if higher_better else np.inf
+    best_iter = -1
+    rounds_no_improve = 0
+    val_csr = val_y = None
+    val_scores = None
+    if valid is not None:
+        val_csr, val_y = valid
+        nv = len(val_csr[0]) - 1
+        val_scores = np.tile(base, (nv, 1)).astype(np.float64)
+        if init_model is not None and init_model.trees:
+            val_scores += predict_csr(init_model.trees, *val_csr, k)
+
+    def _csr_contrib(tree_group):
+        return predict_csr([tree_group], ds.indptr, ds.indices, ds.values, k)
+
+    bag_mask = np.ones(n, dtype=bool)
+    use_fused = _fused_sparse_enabled(2 * config.num_leaves - 1,
+                                      ds.total_bins)
+    for it in range(params.num_iterations):
+        dropped: List[int] = []
+        if is_dart and booster.trees:
+            n_trees = len(booster.trees)
+            if params.uniform_drop:
+                drop_mask = rng.random(n_trees) < params.drop_rate
+                dropped = list(np.where(drop_mask)[0][: params.max_drop])
+            else:
+                n_drop = min(max(1, int(n_trees * params.drop_rate)),
+                             params.max_drop)
+                dropped = list(rng.choice(n_trees, size=n_drop,
+                                          replace=False))
+            for di in dropped:
+                scores -= _csr_contrib(booster.trees[di])
+                if val_csr is not None:
+                    # keep the holdout scores in lockstep (the dropped
+                    # trees are rescaled below; stale valid contributions
+                    # would corrupt the early-stopping metric)
+                    val_scores -= predict_csr([booster.trees[di]],
+                                              *val_csr, k)
+
         score_dev = jnp.asarray(scores[:, 0] if k == 1 else scores,
                                 dtype=jnp.float32)
         g, h = grad_hess(params.objective, score_dev, labels, w_dev,
-                         params.alpha)
+                         params.alpha, g_dev, group_segments=group_seg)
+
+        # bagging / goss row selection (host RNG: same draws as dense)
+        row_mask = bag_mask
+        if is_goss:
+            g_abs = np.abs(np.asarray(jax.device_get(g)))
+            if g_abs.ndim == 2:
+                g_abs = g_abs.sum(axis=1)
+            top_n = int(n * params.top_rate)
+            other_n = int(n * params.other_rate)
+            order = np.argsort(-g_abs)
+            row_mask = np.zeros(n, dtype=bool)
+            row_mask[order[:top_n]] = True
+            rest = order[top_n:]
+            picked = rng.choice(len(rest), size=min(other_n, len(rest)),
+                                replace=False)
+            row_mask[rest[picked]] = True
+            amplify = (1.0 - params.top_rate) / max(params.other_rate, 1e-12)
+            amp = np.ones(n, dtype=np.float32)
+            amp[rest] = amplify
+            amp_dev = jnp.asarray(amp)
+            g = g * (amp_dev if g.ndim == 1 else amp_dev[:, None])
+            h = h * (amp_dev if h.ndim == 1 else amp_dev[:, None])
+        elif ((params.bagging_fraction < 1.0
+               or params.pos_bagging_fraction < 1.0
+               or params.neg_bagging_fraction < 1.0)
+              and (is_rf or params.bagging_freq > 0)
+              and it % max(params.bagging_freq, 1) == 0):
+            if (params.pos_bagging_fraction < 1.0
+                    or params.neg_bagging_fraction < 1.0):
+                pos = np.asarray(y) > 0.5
+                frac = np.where(pos, params.pos_bagging_fraction,
+                                params.neg_bagging_fraction)
+                bag_mask = rng.random(n) < frac
+            else:
+                bag_mask = rng.random(n) < params.bagging_fraction
+            row_mask = bag_mask
+
+        bin_mask = None
+        if params.feature_fraction < 1.0:
+            m = np.zeros(ds.num_features, dtype=bool)
+            n_feat = max(1, int(ds.num_features * params.feature_fraction))
+            m[rng.choice(ds.num_features, size=n_feat, replace=False)] = True
+            bin_mask = jnp.asarray(m)[dev["feat_of_bin"]]
+
+        mask_dev = jnp.asarray(row_mask) if shard_ctx is None else None
         group: List[Tree] = []
         for kk in range(k):
             gk = g if g.ndim == 1 else g[:, kk]
             hk = h if h.ndim == 1 else h[:, kk]
-            tree, leaf_of_row = grow_tree_sparse(ds, dev, gk, hk, config)
-            tree.shrinkage = params.learning_rate
+            if shard_ctx is not None:
+                sharded, row_sharding, _to_shards, _from_shards = shard_ctx
+                gh = np.asarray(jax.device_get(gk), dtype=np.float32)
+                hh = np.asarray(jax.device_get(hk), dtype=np.float32)
+                g_sh = jax.device_put(jnp.asarray(_to_shards(gh)),
+                                      row_sharding)
+                h_sh = jax.device_put(jnp.asarray(_to_shards(hh)),
+                                      row_sharding)
+                m_sh = jax.device_put(
+                    jnp.asarray(_to_shards(row_mask)
+                                & sh_host["row_valid"]), row_sharding)
+                tree, rows_sh = grow_tree_sparse_sharded(
+                    ds, dev, sharded, mesh, g_sh, h_sh, m_sh, config,
+                    bin_mask=bin_mask)
+                leaf_of_row = _from_shards(rows_sh)
+            else:
+                tree, leaf_of_row = grow_tree_sparse(
+                    ds, dev, gk, hk, config, row_mask=mask_dev,
+                    bin_mask=bin_mask, use_fused=use_fused)
+            shrink = lr
+            if is_dart and dropped:
+                shrink = lr / (len(dropped) + lr)
+            tree.shrinkage = shrink
             group.append(tree)
-            scores[:, kk] += tree.value[leaf_of_row] * params.learning_rate
+            scores[:, kk] += tree.value[leaf_of_row] * shrink
+        if is_dart and dropped:
+            factor = len(dropped) / (len(dropped) + lr)
+            for di in dropped:
+                for kk in range(k):
+                    booster.trees[di][kk].shrinkage *= factor
+                scores += _csr_contrib(booster.trees[di])
+                if val_csr is not None:
+                    val_scores += predict_csr([booster.trees[di]],
+                                              *val_csr, k)
         booster.trees.append(group)
+
+        # eval + early stopping on the CSR holdout
+        if val_csr is not None:
+            val_scores += predict_csr([group], *val_csr, k)
+            vs = val_scores[:, 0] if k == 1 else val_scores
+            m = eval_metric(metric, vs, np.asarray(val_y, dtype=np.float64),
+                            valid_groups)
+            improved = m > best_val if higher_better else m < best_val
+            if improved:
+                best_val, best_iter, rounds_no_improve = \
+                    m, len(booster.trees), 0
+            else:
+                rounds_no_improve += 1
+            if log:
+                log(f"[{it + 1}] valid {metric}={m:.6f}")
+            if params.early_stopping_round > 0 \
+                    and rounds_no_improve >= params.early_stopping_round:
+                booster.best_iteration = best_iter
+                if log:
+                    log(f"early stopping at iteration {it + 1}, "
+                        f"best {best_iter}")
+                break
+        elif log and (it + 1) % 10 == 0:
+            sc = scores[:, 0] if k == 1 else scores
+            m = eval_metric(metric, sc, np.asarray(y, dtype=np.float64),
+                            groups)
+            log(f"[{it + 1}] train {metric}={m:.6f}")
+
+    if is_rf and booster.trees:
+        inv = 1.0 / len(booster.trees)
+        for gtrees in booster.trees:
+            for t in gtrees:
+                t.shrinkage = inv
     return booster
 
 

@@ -160,6 +160,40 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             seed=self.get("seed"),
         )
 
+    def _resolve_mesh(self):
+        """Worker topology shared by the dense and sparse fit paths: an
+        EXPLICITLY configured mesh's data axis is the worker count
+        (ClusterUtil.getNumExecutorCores parity, LightGBMBase.scala:120-128);
+        numWorkers=1 forces single-device training. 'No mesh configured'
+        stays single-device (MeshContext.current, not get): silently
+        adopting a lazily-built all-device mesh row-shards tiny fits onto
+        the per-split collective path — orders of magnitude slower than one
+        device — and would span non-addressable devices multi-host."""
+        if self.get("numWorkers") == 1:
+            return None
+        from ..parallel.mesh import DATA_AXIS, MeshContext
+
+        try:
+            candidate = MeshContext.current()
+            if candidate is not None \
+                    and int(candidate.shape.get(DATA_AXIS, 1)) > 1:
+                return candidate
+        except Exception:
+            pass
+        return None
+
+    def _make_log(self):
+        import logging
+
+        return logging.getLogger("mmlspark_tpu.gbdt").info \
+            if (self.get("verbosity") >= 0
+                or self.get("isProvideTrainingMetric")) else None
+
+    def _init_model(self):
+        if self.get("modelString"):
+            return parse_model_string(self.get("modelString"))
+        return None
+
     def _extract(self, df: DataFrame, data=None):
         """DataFrame -> (X, y, weights, init_scores, valid_mask) numpy arrays."""
         if data is None:
@@ -178,49 +212,94 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
                                     dtype=bool)
         return X, y, w, init_scores, valid_mask
 
-    def _fit_booster_sparse(self, data, objective: str,
-                            num_class: int) -> Booster:
+    def _fit_booster_sparse(self, data, objective: str, num_class: int,
+                            groups: Optional[np.ndarray] = None) -> Booster:
         """CSR training for sparse-row features (TextFeaturizer / VW
         featurizer output) — never densifies, so 2^18-wide hashTF spaces
-        train in O(nnz) memory (generateSparseDataset parity,
-        lightgbm/TrainUtils.scala:23-66)."""
-        from .sparse import SparseDataset, train_sparse
+        train in O(nnz) memory, with the reference's FULL sparse param
+        surface (generateSparseDataset feeds the same engine with
+        everything enabled, lightgbm/TrainUtils.scala:23-66): bagging,
+        goss/dart/rf, feature_fraction, weights, init scores, validation +
+        early stopping, ranker groups, modelString continuation, and
+        numBatches incremental training."""
+        from ..parallel.batching import sparse_width
+        from .sparse import SparseDataset, rows_to_csr, train_sparse
 
         y = np.asarray(data[self.get_or_throw("labelCol")], dtype=np.float64)
         w = None
         if self.get("weightCol"):
             w = np.asarray(data[self.get("weightCol")], dtype=np.float64)
-        for unsupported in ("validationIndicatorCol", "initScoreCol",
-                            "categoricalSlotNames", "categoricalSlotIndexes",
-                            "modelString", "numBatches"):
+        init_scores = None
+        if self.get("initScoreCol"):
+            init_scores = np.asarray(data[self.get("initScoreCol")],
+                                     dtype=np.float64)
+        for unsupported in ("categoricalSlotNames", "categoricalSlotIndexes"):
             if self.get(unsupported):
                 raise ValueError(
-                    f"{unsupported} is not supported with sparse features "
-                    f"yet — densify explicitly (FastVectorAssembler) for "
-                    f"that configuration")
+                    f"{unsupported} is not supported with sparse features — "
+                    f"hashTF/count spaces are numeric; densify explicitly "
+                    f"(FastVectorAssembler) for categorical slots")
         params = self._train_params(objective, num_class)
-        if (params.bagging_fraction < 1.0 or params.feature_fraction < 1.0
-                or params.pos_bagging_fraction < 1.0
-                or params.neg_bagging_fraction < 1.0):
-            raise ValueError(
-                "bagging/feature subsampling is not supported with sparse "
-                "features yet — densify explicitly for that configuration")
-        ds = SparseDataset.from_rows(
-            data[self.get_or_throw("featuresCol")],
-            max_bin=min(params.max_bin, 255))
-        return train_sparse(params, ds, y, weights=w)
+        col = list(data[self.get_or_throw("featuresCol")])
+        width = sparse_width(col)
+
+        valid = None
+        valid_groups = None
+        if self.get("validationIndicatorCol"):
+            vm = np.asarray(data[self.get("validationIndicatorCol")],
+                            dtype=bool)
+            val_col = [v for v, m in zip(col, vm) if m]
+            col = [v for v, m in zip(col, vm) if not m]
+            val_y = y[vm]
+            y = y[~vm]
+            if w is not None:
+                w = w[~vm]
+            if init_scores is not None:
+                init_scores = init_scores[~vm]
+            if groups is not None:
+                valid_groups = groups[vm]
+                groups = groups[~vm]
+            indptr, indices, values, _ = rows_to_csr(val_col, width)
+            valid = ((indptr, indices, values), val_y)
+
+        init = self._init_model()
+        log = self._make_log()
+        mesh = self._resolve_mesh()
+        max_bin = min(params.max_bin, 255)
+        n_batches = self.get("numBatches")
+        if n_batches and n_batches > 1:
+            booster = init
+            bounds = np.linspace(0, len(y), n_batches + 1).astype(int)
+            for b in range(n_batches):
+                sl = slice(bounds[b], bounds[b + 1])
+                ds = SparseDataset.from_rows(col[sl], num_features=width,
+                                             max_bin=max_bin)
+                booster = train_sparse(
+                    params, ds, y[sl],
+                    weights=w[sl] if w is not None else None,
+                    groups=groups[sl] if groups is not None else None,
+                    valid=valid, valid_groups=valid_groups,
+                    init_scores=(init_scores[sl]
+                                 if init_scores is not None else None),
+                    init_model=booster, log=log, mesh=mesh)
+            return booster
+        ds = SparseDataset.from_rows(col, num_features=width,
+                                     max_bin=max_bin)
+        return train_sparse(params, ds, y, weights=w, groups=groups,
+                            valid=valid, valid_groups=valid_groups,
+                            init_scores=init_scores, init_model=init,
+                            log=log, mesh=mesh)
 
     def _fit_booster(self, df: DataFrame, objective: str, num_class: int = 1,
                      groups: Optional[np.ndarray] = None) -> Booster:
-        import logging
-
         from ..parallel.batching import is_sparse_row
 
         data = df.collect()  # ONE materialization for sniff + either path
         fcol = data[self.get_or_throw("featuresCol")]
         first = next((v for v in fcol if v is not None), None)
-        if is_sparse_row(first) and groups is None:
-            return self._fit_booster_sparse(data, objective, num_class)
+        if is_sparse_row(first):
+            return self._fit_booster_sparse(data, objective, num_class,
+                                            groups=groups)
 
         X, y, w, init_scores, valid_mask = self._extract(df, data)
         params = self._train_params(objective, num_class)
@@ -255,33 +334,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             if groups is not None:
                 valid_groups = groups[valid_mask]
                 groups = groups[keep]
-        init = None
-        if self.get("modelString"):
-            init = parse_model_string(self.get("modelString"))
-        log = logging.getLogger("mmlspark_tpu.gbdt").info \
-            if (self.get("verbosity") >= 0
-                or self.get("isProvideTrainingMetric")) else None
-
-        # worker topology: an EXPLICITLY configured mesh's data axis is the
-        # worker count (ClusterUtil.getNumExecutorCores parity,
-        # LightGBMBase.scala:120-128); numWorkers=1 forces single-device
-        # training. Like DNNModel's auto mode, 'no mesh configured' stays
-        # single-device (MeshContext.current, not get): silently adopting a
-        # lazily-built all-device mesh row-shards tiny fits onto the
-        # per-split collective path — orders of magnitude slower than one
-        # device — and would span non-addressable devices multi-host.
-        mesh = None
-        if self.get("numWorkers") != 1:
-            from ..parallel.mesh import DATA_AXIS, MeshContext
-
-            try:
-                candidate = MeshContext.current()
-                if candidate is not None \
-                        and int(candidate.shape.get(DATA_AXIS, 1)) > 1:
-                    mesh = candidate
-            except Exception:
-                mesh = None
-
+        init = self._init_model()
+        log = self._make_log()
+        mesh = self._resolve_mesh()
         n_batches = self.get("numBatches")
         if n_batches and n_batches > 1:
             booster = init

@@ -200,23 +200,6 @@ def _grow_tree_device_body(bins_fm, grad, hess, row_mask, node_of_row,
     # switch would diverge across shards and deadlock the collective) and
     # via MMLSPARK_TPU_NO_GATHER_HIST=1 (exact-order parity for tests: the
     # compacted f32 summation order differs by ulps from the full scan).
-    gather_caps: Tuple[int, ...] = ()
-    if psum_axis is None and os.environ.get(
-            "MMLSPARK_TPU_NO_GATHER_HIST", "") in ("", "0"):
-        n_rows = int(bins_fm.shape[1])
-        caps = []
-        # Tiers start at n/8: the row compaction is an axis-1 gather on the
-        # [F, N] column store, measured ~19 ms per N/2 rows at N=1M — a
-        # gathered histogram only beats the masked full scan when the child
-        # is well under a quarter of the rows. /2 steps bound tier waste at
-        # 2x; at most 5 tiers (each branch compiles its own Pallas kernel).
-        c = (n_rows // 8 + 511) // 512 * 512
-        while c >= max(4096, n_rows // 128) and len(caps) < 5:
-            caps.append(c)
-            c = (c // 2 + 511) // 512 * 512
-        if caps:
-            gather_caps = tuple(caps)
-
     # Tier compaction engine: XLA's nonzero(size)+gather is a full-width
     # cumsum + scatter + 3 gathers (~106 ms at 3.2M rows on the chip, per
     # tiered split); the Pallas stream-select kernel does the same
@@ -225,6 +208,31 @@ def _grow_tree_device_body(bins_fm, grad, hess, row_mask, node_of_row,
     use_sel = (use_mxu
                and pallas_select.use_select(int(bins_fm.shape[1]),
                                             interpret=interpret))
+
+    gather_caps: Tuple[int, ...] = ()
+    if psum_axis is None and os.environ.get(
+            "MMLSPARK_TPU_NO_GATHER_HIST", "") in ("", "0"):
+        n_rows = int(bins_fm.shape[1])
+        caps = []
+        # Tier start (r4 profile, tools/profile_gbdt_10m.py): with the
+        # stream-select kernel the compaction pass streams rows ~5x cheaper
+        # than the histogram kernel (~12.5 vs ~59 ms per 1M rows at F=28),
+        # so compacting pays for EVERY small child — tiers start at n/2
+        # (small children are always <= n/2). The XLA nonzero+gather
+        # fallback is only profitable well below n/4 (axis-1 gather ~19 ms
+        # per n/2 rows at N=1M), so it keeps the old n/8 start. The select
+        # buffer is [cap, 128ch] f32; the n/2 tier is capped to a 4 GB
+        # budget (bins + buffers must fit 15.75 GB HBM at the 10M bench).
+        top_div = 2 if use_sel else 8
+        max_tiers = 7 if use_sel else 5
+        c = (n_rows // top_div + 511) // 512 * 512
+        while c * 132 * 4 > (4 << 30):   # select-buffer HBM budget
+            c = (c // 2 + 511) // 512 * 512
+        while c >= max(4096, n_rows // 128) and len(caps) < max_tiers:
+            caps.append(c)
+            c = (c // 2 + 511) // 512 * 512
+        if caps:
+            gather_caps = tuple(caps)
 
     def small_child_hist(small_mask, small_cnt):
         """Histogram of the masked rows, streaming only a tier-sized

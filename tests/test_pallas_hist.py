@@ -53,6 +53,33 @@ def test_pallas_matches_xla_and_numpy(n, f, b):
     np.testing.assert_allclose(pal, ref, rtol=1e-5, atol=1e-3)
 
 
+def test_hilo_mode_close_to_exact():
+    """The bf16 hi/lo contraction (TPU default: one MXU pass instead of
+    three f32-HIGHEST passes) must agree with the exact path to the hi/lo
+    decomposition error (~17 mantissa bits, ~6e-6 relative)."""
+    rng = np.random.default_rng(3)
+    n, f, b = 4096, 6, 64
+    bins = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    grad = rng.normal(size=n).astype(np.float32) * 10
+    hess = rng.uniform(0.01, 1.0, size=n).astype(np.float32)
+    mask = rng.uniform(size=n) < 0.8
+    interp = jax.default_backend() != "tpu"
+    exact = np.asarray(pallas_hist.compute_histogram_mxu(
+        fm(bins), jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(mask),
+        b, interpret=interp, hilo=False))
+    hilo = np.asarray(pallas_hist.compute_histogram_mxu(
+        fm(bins), jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(mask),
+        b, interpret=interp, hilo=True))
+    # counts are integers summed exactly in both modes
+    np.testing.assert_array_equal(hilo[:, :, 2], exact[:, :, 2])
+    # grad/hess sums: error bounded by the per-element 2^-17 value rounding
+    scale = np.abs(grad[mask]).sum()
+    np.testing.assert_allclose(hilo[:, :, 0], exact[:, :, 0],
+                               atol=2e-5 * scale)
+    np.testing.assert_allclose(hilo[:, :, 1], exact[:, :, 1],
+                               atol=2e-5 * scale)
+
+
 def test_uint8_bins_match_int32():
     """uint8 feature-major bins (the 4x-smaller upload dtype) must produce
     identical histograms after the on-device widen."""
