@@ -20,7 +20,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .binning import BinMapper
-from .tree import GrowerConfig, Tree, grow_tree
+from .tree import GrowerConfig, Tree, build_thresholds, grow_tree
 
 MODEL_FORMAT = "mmlspark_tpu.gbdt.v1"
 
@@ -55,6 +55,12 @@ class TrainParams:
     top_rate: float = 0.2                  # goss
     other_rate: float = 0.1                # goss
     categorical_feature: Tuple[int, ...] = ()
+    # categorical SET-split controls (LightGBM cat_smooth / cat_l2 /
+    # max_cat_threshold defaults): sorted-by-gradient-statistic category
+    # subsets, not ordered-int thresholds
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
     # tree_learner parity (LightGBMParams.scala:13-18). Both values run the
     # exact psum'd-histogram algorithm: voting_parallel is LightGBM's lossy
     # bandwidth optimization for slow networks; exact histograms over ICI
@@ -574,7 +580,8 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
                 mapper: BinMapper, bins_dev, labels, w_dev,
                 scores: np.ndarray, n: int, num_f: int, num_bins: int,
                 k: int, lr: float, row_masks, feat_masks,
-                pad_mask: Optional[np.ndarray] = None) -> None:
+                pad_mask: Optional[np.ndarray] = None,
+                cat_args=None) -> None:
     """Run ALL boosting iterations in ONE jitted lax.scan dispatch.
 
     Each scan step: grad/hess from the running scores, whole-tree growth via
@@ -656,13 +663,18 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
         dl_ = tree_out["default_left"]
         li = tree_out["left"]
         ri = tree_out["right"]
+        cwords = tree_out.get("cat_words")
 
         def rb(j, nor):
             f = feat[j]
             binrow = jax.lax.dynamic_index_in_dim(
                 bins_dev, jnp.maximum(f, 0), axis=0, keepdims=False)
-            new = H.partition_rows(binrow, nor, j, tb[j], dl_[j], li[j],
-                                   ri[j])
+            if cwords is not None:
+                new = H.partition_rows_cat(binrow, nor, j, tb[j], dl_[j],
+                                           li[j], ri[j], cwords[j])
+            else:
+                new = H.partition_rows(binrow, nor, j, tb[j], dl_[j], li[j],
+                                       ri[j])
             return jnp.where(f >= 0, new, nor)
 
         return jax.lax.fori_loop(0, tree_out["n_nodes"], rb,
@@ -717,7 +729,8 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
                 num_bins=num_bins, max_nodes=M,
                 min_data_in_leaf=config.min_data_in_leaf,
                 max_depth=config.max_depth, use_mxu=use_mxu,
-                has_feature_mask=has_fm, interpret=interpret)
+                has_feature_mask=has_fm, interpret=interpret,
+                cat_args=cat_args)
             rows = out.pop("node_of_row")
             if is_goss:
                 rows = _route_full(out)
@@ -810,9 +823,13 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
                 value = np.clip(value, -config.max_delta_step,
                                 config.max_delta_step)
             value[0] = 0.0 if nn == 1 else value[0]
-            threshold = np.array(
-                [mapper.bin_upper_value(int(f), int(t)) if f >= 0 else 0.0
-                 for f, t in zip(feature, tbin)], dtype=np.float64)
+            cat_sets = cat_words_np = None
+            if "cat_words" in host:
+                from .tree import cat_sets_from_words
+
+                cat_sets, cat_words_np = cat_sets_from_words(
+                    host["cat_words"][it, kk][:nn], feature, mapper)
+            threshold = build_thresholds(feature, tbin, cat_sets, mapper)
             group.append(Tree(
                 feature=feature,
                 threshold=threshold,
@@ -825,6 +842,8 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
                 count=sums[:, 2].astype(np.int32),
                 shrinkage=lr,
                 weight=sums[:, 1],
+                cat_sets=cat_sets,
+                cat_bin_words=cat_words_np,
             ))
         booster.trees.append(group)
     if timing:
@@ -1017,7 +1036,19 @@ def train(params: TrainParams,
         min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
         min_gain_to_split=params.min_gain_to_split,
         lambda_l1=params.lambda_l1, lambda_l2=params.lambda_l2,
-        max_delta_step=params.max_delta_step)
+        max_delta_step=params.max_delta_step,
+        cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
+        max_cat_threshold=params.max_cat_threshold)
+
+    # categorical SET splits (LightGBM num_cat machinery): features flagged
+    # categorical split by sorted-gradient-prefix subsets
+    cat_args = None
+    if params.categorical_feature:
+        cat_mask_np = np.zeros(num_f, dtype=bool)
+        cat_mask_np[list(params.categorical_feature)] = True
+        cat_args = (jnp.asarray(cat_mask_np), np.float32(params.cat_smooth),
+                    np.float32(params.cat_l2),
+                    np.int32(params.max_cat_threshold))
 
     is_rf = params.boosting_type == "rf"
     is_dart = params.boosting_type == "dart"
@@ -1036,7 +1067,8 @@ def train(params: TrainParams,
             ensure_compile_cache()
             _train_scan(params, config, booster, mapper, bins_dev, labels,
                         w_dev, scores, n, num_f, num_bins, k, lr,
-                        row_masks, feat_masks, pad_mask=pad_mask)
+                        row_masks, feat_masks, pad_mask=pad_mask,
+                        cat_args=cat_args)
             if is_rf and booster.trees:
                 inv = 1.0 / len(booster.trees)
                 for gtrees in booster.trees:
@@ -1145,7 +1177,8 @@ def train(params: TrainParams,
             hk = h if h.ndim == 1 else h[:, kk]
             tree, leaf_of_row = grow_tree(bins_dev, gk, hk, mask_dev, num_bins,
                                           config, mapper, feature_mask,
-                                          device_rows=fast_scores)
+                                          device_rows=fast_scores,
+                                          cat_args=cat_args)
             shrink = lr
             if is_dart and dropped:
                 shrink = lr / (len(dropped) + lr)  # dart normalization

@@ -24,9 +24,10 @@ Format notes (mirrors LightGBM's tree serialization):
     direction when missing type is NaN, else is coerced to 0.
   - ``leaf_value`` already includes shrinkage; prediction is a plain sum.
 
-Categorical splits (num_cat > 0) are rejected with a clear error — the TPU
-engine one-hots categoricals upstream; a genuine-categorical LightGBM model
-has no faithful mapping onto its trees.
+Categorical set splits round-trip through LightGBM's num_cat machinery:
+``cat_boundaries``/``cat_threshold`` bitsets over category VALUES
+(FindInBitset semantics — membership goes left, missing/unseen right), in
+both directions. Linear trees and unknown versions are rejected loudly.
 """
 
 from __future__ import annotations
@@ -88,8 +89,38 @@ def _tree_block(tree: Tree, index: int, fold_bias: float = 0.0) -> str:
     def child_ref(nid: int) -> int:
         return int_index[nid] if not is_leaf[nid] else ~leaf_index[nid]
 
+    # categorical SET splits -> LightGBM's num_cat machinery: per cat node,
+    # a bitset over category VALUES (FindInBitset(cat_threshold +
+    # cat_boundaries[cat_idx]) in LightGBM's CategoricalDecision)
+    cat_idx_of_node = {}
+    cat_boundaries = [0]
+    cat_words_out: list = []
+    if tree.cat_sets is not None:
+        for nid in internal_ids:
+            s = tree.cat_sets[int(nid)]
+            if s is None:
+                continue
+            if (s < 0).any():
+                raise ValueError(
+                    "cannot serialize categorical split with negative "
+                    "category values to the LightGBM format (its bitsets "
+                    "are over non-negative ints); re-encode with "
+                    "ValueIndexer first")
+            mx = int(s.max())
+            if mx >= 1 << 22:
+                raise ValueError(
+                    f"category value {mx} too large for a LightGBM bitset "
+                    f"(> 2^22); re-encode with ValueIndexer first")
+            w = np.zeros(mx // 32 + 1, dtype=np.uint32)
+            np.bitwise_or.at(w, (s // 32).astype(np.int64),
+                             np.uint32(1) << (s % 32).astype(np.uint32))
+            cat_idx_of_node[int(nid)] = len(cat_boundaries) - 1
+            cat_boundaries.append(cat_boundaries[-1] + len(w))
+            cat_words_out.append(w)
+
     num_leaves = len(leaf_ids)
-    lines = [f"Tree={index}", f"num_leaves={num_leaves}", "num_cat=0"]
+    lines = [f"Tree={index}", f"num_leaves={num_leaves}",
+             f"num_cat={len(cat_words_out)}"]
 
     def node_weight(nid: int) -> str:
         # real hessian sums when the trainer recorded them (LightGBM uses
@@ -115,9 +146,16 @@ def _tree_block(tree: Tree, index: int, fold_bias: float = 0.0) -> str:
     for nid in internal_ids:
         sf.append(str(int(feat[nid])))
         sg.append(_fmt(float(tree.gain[nid])))
-        th.append(_fmt(float(tree.threshold[nid])))
-        d = _MISSING_NAN | (_DEFAULT_LEFT if tree.default_left[nid] else 0)
-        dt.append(str(d))
+        if int(nid) in cat_idx_of_node:
+            # categorical: threshold holds the cat_idx; decision_type is
+            # the categorical bit (missing type None, no default-left)
+            th.append(str(cat_idx_of_node[int(nid)]))
+            dt.append(str(_CATEGORICAL))
+        else:
+            th.append(_fmt(float(tree.threshold[nid])))
+            d = _MISSING_NAN | (_DEFAULT_LEFT if tree.default_left[nid]
+                                else 0)
+            dt.append(str(d))
         lc.append(str(child_ref(int(tree.left[nid]))))
         rc.append(str(child_ref(int(tree.right[nid]))))
     lv = [_fmt(float(tree.value[nid]) * tree.shrinkage + fold_bias)
@@ -135,6 +173,14 @@ def _tree_block(tree: Tree, index: int, fold_bias: float = 0.0) -> str:
         "decision_type=" + " ".join(dt),
         "left_child=" + " ".join(lc),
         "right_child=" + " ".join(rc),
+    ]
+    if cat_words_out:
+        lines += [
+            "cat_boundaries=" + " ".join(str(b) for b in cat_boundaries),
+            "cat_threshold=" + " ".join(
+                str(int(w)) for ws in cat_words_out for w in ws),
+        ]
+    lines += [
         "leaf_value=" + " ".join(lv),
         "leaf_weight=" + " ".join(lw),
         "leaf_count=" + " ".join(lcount),
@@ -240,10 +286,11 @@ def _ints(s: str) -> np.ndarray:
 
 def _parse_tree(block: Dict[str, str]) -> Tree:
     num_leaves = int(block["num_leaves"])
-    if int(block.get("num_cat", "0") or 0) > 0:
-        raise ValueError(
-            "categorical splits (num_cat > 0) are not supported by the TPU "
-            "engine's tree import — one-hot the categoricals upstream")
+    num_cat = int(block.get("num_cat", "0") or 0)
+    cat_boundaries = _ints(block.get("cat_boundaries", "")) \
+        if num_cat else None
+    cat_threshold_words = _ints(block.get("cat_threshold", "")) \
+        if num_cat else None
     if int(block.get("is_linear", "0") or 0):
         raise ValueError(
             "linear-tree models (is_linear=1) are not supported: leaves hold "
@@ -283,10 +330,13 @@ def _parse_tree(block: Dict[str, str]) -> Tree:
     int_count = _ints(block.get("internal_count", "")) \
         if block.get("internal_count") else np.zeros(n_int, dtype=np.int64)
 
-    if (decision_type & _CATEGORICAL).any():
+    is_cat_node = (decision_type & _CATEGORICAL) != 0
+    if is_cat_node.any() and (cat_boundaries is None or not len(cat_boundaries)
+                              or cat_threshold_words is None
+                              or not len(cat_threshold_words)):
         raise ValueError(
-            "categorical splits are not supported by the TPU engine's tree "
-            "import — one-hot the categoricals upstream")
+            "categorical decision_type bit set but the tree block carries "
+            "no cat_boundaries/cat_threshold")
 
     # flatten: internal node i -> flat i; leaf j -> flat n_int + j
     n_nodes = n_int + num_leaves
@@ -332,6 +382,19 @@ def _parse_tree(block: Dict[str, str]) -> Tree:
     count[:n_int] = int_count
     value[n_int:] = leaf_value
     count[n_int:] = leaf_count
+
+    cat_sets = None
+    if is_cat_node.any():
+        cat_sets = [None] * n_nodes
+        for i in np.nonzero(is_cat_node)[0]:
+            ci = int(threshold[i])
+            w = cat_threshold_words[
+                int(cat_boundaries[ci]): int(cat_boundaries[ci + 1])]
+            bits = np.unpackbits(
+                w.astype(np.uint32).view(np.uint8), bitorder="little")
+            cat_sets[int(i)] = np.nonzero(bits)[0].astype(np.int64)
+            thr[int(i)] = 0.0           # threshold held the cat_idx
+            dleft[int(i)] = False       # missing/unseen -> right
     weight = None
     if leaf_weight is not None and len(leaf_weight) == num_leaves:
         weight = np.zeros(n_nodes, dtype=np.float64)
@@ -341,7 +404,8 @@ def _parse_tree(block: Dict[str, str]) -> Tree:
     return Tree(feature=feature, threshold=thr,
                 threshold_bin=np.zeros(n_nodes, dtype=np.int32),
                 default_left=dleft, left=left, right=right, value=value,
-                gain=gain, count=count, shrinkage=1.0, weight=weight)
+                gain=gain, count=count, shrinkage=1.0, weight=weight,
+                cat_sets=cat_sets)
 
 
 def parse_model_string(text: str) -> Booster:

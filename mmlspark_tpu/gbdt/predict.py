@@ -16,9 +16,14 @@ from .tree import Tree
 
 
 def predict_single_tree(tree: Tree, X: np.ndarray) -> np.ndarray:
-    """Host path: [N,F] raw floats -> [N] contributions (incl. shrinkage)."""
+    """Host path: [N,F] raw floats -> [N] contributions (incl. shrinkage).
+
+    Categorical SET nodes (tree.cat_sets): LightGBM semantics — the value
+    is truncated to int and tested for set membership; members go left,
+    everything else (incl. NaN and unseen categories) goes right."""
     n = X.shape[0]
     node = np.zeros(n, dtype=np.int64)
+    has_cat = tree.cat_sets is not None
     active = tree.feature[node] != -1
     while active.any():
         cur = node[active]
@@ -26,6 +31,17 @@ def predict_single_tree(tree: Tree, X: np.ndarray) -> np.ndarray:
         x = X[active, f]
         miss = np.isnan(x)
         go_left = np.where(miss, tree.default_left[cur], x <= tree.threshold[cur])
+        if has_cat:
+            for nid in np.unique(cur):
+                cset = tree.cat_sets[nid]
+                if cset is None:
+                    continue
+                sel = cur == nid
+                xv = x[sel]
+                ok = ~np.isnan(xv)
+                member = np.zeros(len(xv), dtype=bool)
+                member[ok] = np.isin(xv[ok].astype(np.int64), cset)
+                go_left[sel] = member
         node[active] = np.where(go_left, tree.left[cur], tree.right[cur])
         active = tree.feature[node] != -1
     return tree.value[node] * tree.shrinkage
@@ -73,6 +89,34 @@ class DeviceEnsemble:
         self.right = pad([t.right for t in trees], 0, np.int32)
         self.value = pad([np.asarray(t.value) * t.shrinkage for t in trees],
                          0.0, np.float32)
+        # categorical SET nodes: padded per-node value sets [T, m, S] with
+        # NaN fill (== compares false) — built only when the model has any.
+        # High-cardinality sets (imported LightGBM models can carry
+        # thousands of categories per node) would make both the [T, m, S]
+        # tensor and the per-depth-step [N, T, S] gather blow up — those
+        # models take the host traversal instead (self.cat_host_fallback).
+        self.cat_vals = None
+        self.is_cat = None
+        self.cat_host_fallback = False
+        self._tree_groups = tree_groups
+        if any(t.cat_sets is not None for t in trees):
+            smax = max((len(s) for t in trees if t.cat_sets is not None
+                        for s in t.cat_sets if s is not None), default=1)
+            if smax > 256 or self.num_trees * m * smax > 1 << 27:
+                self.cat_host_fallback = True
+            else:
+                cv = np.full((self.num_trees, m, smax), np.nan,
+                             dtype=np.float32)
+                ic = np.zeros((self.num_trees, m), dtype=bool)
+                for i, t in enumerate(trees):
+                    if t.cat_sets is None:
+                        continue
+                    for nid, s in enumerate(t.cat_sets):
+                        if s is not None:
+                            cv[i, nid, : len(s)] = s
+                            ic[i, nid] = True
+                self.cat_vals = cv
+                self.is_cat = ic
         for t in trees:
             self.max_depth = max(self.max_depth, _tree_depth(t))
         self._jitted = None
@@ -91,31 +135,44 @@ class DeviceEnsemble:
         class_onehot = jax.nn.one_hot(
             jnp.asarray(self.class_of_tree), self.num_class, dtype=jnp.float32)
 
+        cat_vals = (jnp.asarray(self.cat_vals)
+                    if self.cat_vals is not None else None)
+        is_cat = jnp.asarray(self.is_cat) if self.is_cat is not None else None
+
         def fwd(X):
             n = X.shape[0]
             t = feature.shape[0]
             node = jnp.zeros((n, t), dtype=jnp.int32)
 
+            t_idx = jnp.arange(t, dtype=jnp.int32)[None, :]
+
             def body(_, node):
-                f = jnp.take_along_axis(feature[None, :, :],
-                                        node[:, :, None], axis=2)[:, :, 0]
-                thr = jnp.take_along_axis(threshold[None, :, :],
-                                          node[:, :, None], axis=2)[:, :, 0]
-                dl = jnp.take_along_axis(default_left[None, :, :],
-                                         node[:, :, None], axis=2)[:, :, 0]
-                l = jnp.take_along_axis(left[None, :, :],
-                                        node[:, :, None], axis=2)[:, :, 0]
-                r = jnp.take_along_axis(right[None, :, :],
-                                        node[:, :, None], axis=2)[:, :, 0]
+                # advanced-index gathers ([T, m][t, node] -> [N, T]): the
+                # take_along_axis(arr[None], node[:, :, None]) form lowered
+                # to a broadcast materializing [N, T, m] per field — ~2.4 GB
+                # at 200k rows x 50 trees and 29x slower end to end
+                # (BENCH_gbdt_train.json predict history)
+                f = feature[t_idx, node]
+                thr = threshold[t_idx, node]
+                dl = default_left[t_idx, node]
+                l = left[t_idx, node]
+                r = right[t_idx, node]
                 x = jnp.take_along_axis(X, jnp.maximum(f, 0), axis=1)
                 miss = jnp.isnan(x)
                 go_left = jnp.where(miss, dl, x <= thr)
+                if cat_vals is not None:
+                    # set membership (truncated-int equality; NaN pads and
+                    # NaN inputs compare false -> right)
+                    sv = cat_vals[t_idx, node]            # [N, T, S]
+                    member = jnp.any(
+                        jnp.trunc(x)[:, :, None] == sv, axis=-1)
+                    icn = is_cat[t_idx, node]             # [N, T]
+                    go_left = jnp.where(icn, member, go_left)
                 nxt = jnp.where(go_left, l, r)
                 return jnp.where(f == -1, node, nxt)
 
             node = jax.lax.fori_loop(0, depth, body, node)
-            leaf_vals = jnp.take_along_axis(value[None, :, :],
-                                            node[:, :, None], axis=2)[:, :, 0]
+            leaf_vals = value[t_idx, node]
             return leaf_vals @ class_onehot          # [N, num_class]
 
         return jax.jit(fwd)
@@ -124,6 +181,9 @@ class DeviceEnsemble:
         """[N,F] float32 -> [N, num_class] summed tree outputs (device)."""
         if self.num_trees == 0:
             return np.zeros((X.shape[0], self.num_class), dtype=np.float64)
+        if self.cat_host_fallback:
+            return predict_ensemble(self._tree_groups, np.asarray(X),
+                                    self.num_class)
         if self._jitted is None:
             self._jitted = self._compile()
         return np.asarray(self._jitted(np.asarray(X, dtype=np.float32)),

@@ -1076,6 +1076,11 @@ def train_sparse(params, ds: SparseDataset, y: np.ndarray,
                           _scan_precompute_masks, default_metric, eval_metric,
                           grad_hess, init_score, segment_groups)
 
+    if params.categorical_feature:
+        raise ValueError(
+            "categorical_feature is not supported on the sparse path "
+            "(set splits need the dense bin space; sparse features are "
+            "numeric TF counts) — densify for categorical slots")
     k = max(params.num_class, 1)
     n = ds.num_rows
     dev = _device_arrays(ds)
@@ -1349,6 +1354,13 @@ def predict_csr(tree_groups: List[List[Tree]], indptr, indices, values,
     Value lookup rides ONE global searchsorted per depth step over the
     composite (row, feature) key — CSR rows are sorted, so
     ``row * (F+1) + feature`` is globally ascending."""
+    for group in tree_groups:
+        for tree in group:
+            if tree.cat_sets is not None:
+                raise ValueError(
+                    "categorical set splits cannot be evaluated on sparse "
+                    "CSR rows (sparse features are numeric); densify for "
+                    "categorical models")
     indptr = np.asarray(indptr, dtype=np.int64)
     indices = np.asarray(indices, dtype=np.int64)
     values = np.asarray(values, dtype=np.float64)

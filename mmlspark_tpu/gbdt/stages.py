@@ -115,6 +115,15 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
         "Feature slot NAMES treated as categorical, resolved against the "
         "features column's slot_names metadata (AssembleFeatures records it)",
         None, ptype=(list, tuple))
+    catSmooth = Param("catSmooth",
+                      "Categorical gradient-statistic smoothing "
+                      "(LightGBM cat_smooth)", 10.0, ptype=float)
+    catL2 = Param("catL2", "Extra L2 for categorical set splits "
+                  "(LightGBM cat_l2)", 10.0, ptype=float)
+    maxCatThreshold = Param("maxCatThreshold",
+                            "Max categories on the left side of a set "
+                            "split (LightGBM max_cat_threshold)", 32,
+                            lambda v: v > 0, int)
     defaultListenPort = Param("defaultListenPort",
                               "Socket-era rendezvous port (reference "
                               "LightGBMConstants.DefaultLocalListenPort; "
@@ -150,6 +159,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             top_rate=self.get("topRate"),
             other_rate=self.get("otherRate"),
             categorical_feature=tuple(self.get("categoricalSlotIndexes") or ()),
+            cat_smooth=self.get("catSmooth"),
+            cat_l2=self.get("catL2"),
+            max_cat_threshold=self.get("maxCatThreshold"),
             parallelism=self.get("parallelism"),
             metric=self.get("metric") or "",
             max_delta_step=self.get("maxDeltaStep"),

@@ -81,10 +81,25 @@ class Tree:
     count: np.ndarray          # i32 [nodes], training rows through the node
     shrinkage: float = 1.0
     weight: Optional[np.ndarray] = None  # f64 [nodes], hessian sums (None: legacy)
+    # categorical SET splits (LightGBM num_cat machinery): for a cat split
+    # node, membership sends a row LEFT. Two views of the same set:
+    #   cat_sets       — per node: sorted int64 category VALUES (raw-float
+    #                    predict + LightGBM interchange), None elsewhere
+    #   cat_bin_words  — [nodes, CW] u32 bitset over BIN ids (binned
+    #                    routing/predict; None for imported models with no
+    #                    bin mapper)
+    cat_sets: Optional[list] = None
+    cat_bin_words: Optional[np.ndarray] = None
 
     @property
     def num_leaves(self) -> int:
         return int((self.feature == -1).sum())
+
+    def is_cat_node(self, nid: int) -> bool:
+        return (self.cat_sets is not None
+                and self.cat_sets[nid] is not None) or (
+            self.cat_bin_words is not None
+            and bool(self.cat_bin_words[nid].any()))
 
     def to_dict(self) -> dict:
         d = {
@@ -101,10 +116,19 @@ class Tree:
         }
         if self.weight is not None:
             d["weight"] = self.weight.tolist()
+        if self.cat_sets is not None:
+            d["cat_sets"] = [s.tolist() if s is not None else None
+                             for s in self.cat_sets]
+        if self.cat_bin_words is not None:
+            d["cat_bin_words"] = self.cat_bin_words.tolist()
         return d
 
     @staticmethod
     def from_dict(d: dict) -> "Tree":
+        cat_sets = None
+        if d.get("cat_sets") is not None:
+            cat_sets = [np.asarray(s, dtype=np.int64) if s is not None
+                        else None for s in d["cat_sets"]]
         return Tree(
             feature=np.asarray(d["feature"], dtype=np.int32),
             threshold=np.asarray(d["threshold"], dtype=np.float64),
@@ -118,6 +142,9 @@ class Tree:
             shrinkage=float(d.get("shrinkage", 1.0)),
             weight=(np.asarray(d["weight"], dtype=np.float64)
                     if d.get("weight") is not None else None),
+            cat_sets=cat_sets,
+            cat_bin_words=(np.asarray(d["cat_bin_words"], dtype=np.uint32)
+                           if d.get("cat_bin_words") is not None else None),
         )
 
 
@@ -131,6 +158,10 @@ class GrowerConfig:
     lambda_l1: float = 0.0
     lambda_l2: float = 0.0
     max_delta_step: float = 0.0         # clamp |leaf value| (0 = off)
+    # categorical set-split controls (LightGBM defaults)
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
 
 
 class _Node:
@@ -150,7 +181,7 @@ def _grow_tree_device_body(bins_fm, grad, hess, row_mask, node_of_row,
                            max_nodes: int, min_data_in_leaf: int,
                            max_depth: int, use_mxu: bool,
                            has_feature_mask: bool, psum_axis=None,
-                           interpret: bool = False):
+                           interpret: bool = False, cat_args=None):
     """Grow one whole tree inside a single jitted ``lax.while_loop``.
 
     The best-first heap becomes an argmax over ``cand_gain`` (−inf marks
@@ -271,11 +302,15 @@ def _grow_tree_device_body(bins_fm, grad, hess, row_mask, node_of_row,
     fm = feature_mask if has_feature_mask else None
     neg_inf = jnp.float32(-jnp.inf)
     M = max_nodes
+    CW = (num_bins + 31) // 32
     num_leaves_target = (max_nodes + 1) // 2
+    # cat_args: (cat_mask [F] bool, cat_smooth, cat_l2, max_cat_threshold)
+    # — None keeps every compiled graph identical to the numerical-only one
+    cat_info = cat_args
 
     def best(hist):
         return H.find_best_split(hist, lambda_l1, lambda_l2, min_sum_hessian,
-                                 min_data_in_leaf, fm)
+                                 min_data_in_leaf, fm, cat_info)
 
     root_hist = hist_fn(bins_fm, grad, hess, row_mask, num_bins)
     root_sums = H.total_sums(grad, hess, row_mask)
@@ -309,6 +344,10 @@ def _grow_tree_device_body(bins_fm, grad, hess, row_mask, node_of_row,
         n_nodes=jnp.int32(1),
         n_leaves=jnp.int32(1),
     )
+    if cat_info is not None:
+        state["cat_words"] = jnp.zeros((M, CW), jnp.uint32)
+        state["cand_cwords"] = jnp.zeros((M, CW), jnp.uint32) \
+            .at[0].set(s0.cat_words)
 
     def cond(st):
         return (st["n_leaves"] < num_leaves_target) \
@@ -325,8 +364,14 @@ def _grow_tree_device_body(bins_fm, grad, hess, row_mask, node_of_row,
         rid = lid + 1
         dchild = st["depth"][leaf] + 1
 
-        node_of_row = H.partition_rows(
-            jnp.take(bins_fm, f, axis=0), st["node_of_row"], leaf, t, dl, lid, rid)
+        if cat_info is not None:
+            node_of_row = H.partition_rows_cat(
+                jnp.take(bins_fm, f, axis=0), st["node_of_row"], leaf, t,
+                dl, lid, rid, st["cand_cwords"][leaf])
+        else:
+            node_of_row = H.partition_rows(
+                jnp.take(bins_fm, f, axis=0), st["node_of_row"], leaf, t,
+                dl, lid, rid)
 
         small_is_left = lsum[2] <= rsum[2]
         small_id = jnp.where(small_is_left, lid, rid)
@@ -338,31 +383,36 @@ def _grow_tree_device_body(bins_fm, grad, hess, row_mask, node_of_row,
         big_hist = H.subtract_histogram(st["hists"][leaf], small_hist)
         s_pair = H.find_best_split_pair(
             jnp.stack([small_hist, big_hist]), lambda_l1, lambda_l2,
-            min_sum_hessian, min_data_in_leaf, fm)
+            min_sum_hessian, min_data_in_leaf, fm, cat_info)
         s_small = jax.tree.map(lambda x: x[0], s_pair)
         s_big = jax.tree.map(lambda x: x[1], s_pair)
 
         cg = st["cand_gain"].at[leaf].set(neg_inf)
         cf, cb, cd = st["cand_feature"], st["cand_bin"], st["cand_dleft"]
         cl, cr = st["cand_lsum"], st["cand_rsum"]
+        cwd = st["cand_cwords"] if cat_info is not None else None
 
         def push(arrs, nid, s, csum):
-            cg, cf, cb, cd, cl, cr = arrs
+            cg, cf, cb, cd, cl, cr, cwd = arrs
             ok = jnp.isfinite(s.gain) & (s.gain > min_gain_to_split)
             ok &= csum[2] >= 2 * min_data_in_leaf
             if max_depth > 0:
                 ok &= dchild < max_depth
+            if cwd is not None:
+                cwd = cwd.at[nid].set(s.cat_words)
             return (cg.at[nid].set(jnp.where(ok, s.gain, neg_inf)),
                     cf.at[nid].set(s.feature), cb.at[nid].set(s.bin),
                     cd.at[nid].set(s.default_left),
-                    cl.at[nid].set(s.left_sum), cr.at[nid].set(s.right_sum))
+                    cl.at[nid].set(s.left_sum), cr.at[nid].set(s.right_sum),
+                    cwd)
 
         small_sums = jnp.where(small_is_left, lsum, rsum)
         big_sums = jnp.where(small_is_left, rsum, lsum)
-        arrs = push((cg, cf, cb, cd, cl, cr), small_id, s_small, small_sums)
-        cg, cf, cb, cd, cl, cr = push(arrs, big_id, s_big, big_sums)
+        arrs = push((cg, cf, cb, cd, cl, cr, cwd), small_id, s_small,
+                    small_sums)
+        cg, cf, cb, cd, cl, cr, cwd = push(arrs, big_id, s_big, big_sums)
 
-        return dict(
+        out = dict(
             node_of_row=node_of_row,
             feature=st["feature"].at[leaf].set(f),
             threshold_bin=st["threshold_bin"].at[leaf].set(t),
@@ -378,11 +428,18 @@ def _grow_tree_device_body(bins_fm, grad, hess, row_mask, node_of_row,
             cand_lsum=cl, cand_rsum=cr,
             n_nodes=lid + 2, n_leaves=st["n_leaves"] + 1,
         )
+        if cat_info is not None:
+            out["cat_words"] = st["cat_words"].at[leaf].set(
+                st["cand_cwords"][leaf])
+            out["cand_cwords"] = cwd
+        return out
 
     out = jax.lax.while_loop(cond, body, state)
-    return {k: out[k] for k in (
-        "node_of_row", "feature", "threshold_bin", "default_left", "left",
-        "right", "gain", "sums", "n_nodes")}
+    keys = ["node_of_row", "feature", "threshold_bin", "default_left",
+            "left", "right", "gain", "sums", "n_nodes"]
+    if cat_info is not None:
+        keys.append("cat_words")
+    return {k: out[k] for k in keys}
 
 
 @functools.partial(
@@ -391,15 +448,15 @@ def _grow_tree_device_body(bins_fm, grad, hess, row_mask, node_of_row,
                      "use_mxu", "has_feature_mask"))
 def _grow_tree_device(bins, grad, hess, row_mask, node_of_row,
                       lambda_l1, lambda_l2, min_sum_hessian, min_gain_to_split,
-                      feature_mask, *, num_bins: int, max_nodes: int,
-                      min_data_in_leaf: int, max_depth: int,
+                      feature_mask, cat_args=None, *, num_bins: int,
+                      max_nodes: int, min_data_in_leaf: int, max_depth: int,
                       use_mxu: bool, has_feature_mask: bool):
     return _grow_tree_device_body(
         bins, grad, hess, row_mask, node_of_row, lambda_l1, lambda_l2,
         min_sum_hessian, min_gain_to_split, feature_mask, num_bins=num_bins,
         max_nodes=max_nodes, min_data_in_leaf=min_data_in_leaf,
         max_depth=max_depth, use_mxu=use_mxu,
-        has_feature_mask=has_feature_mask)
+        has_feature_mask=has_feature_mask, cat_args=cat_args)
 
 
 _SHARDED_GROW_CACHE: Dict[Tuple, Any] = {}
@@ -410,7 +467,7 @@ def _grow_tree_device_sharded(bins, grad, hess, row_mask, node_of_row,
                               min_gain_to_split, feature_mask, *,
                               num_bins: int, max_nodes: int,
                               min_data_in_leaf: int, max_depth: int,
-                              has_feature_mask: bool):
+                              has_feature_mask: bool, cat_args=None):
     """Row-sharded whole-tree growth: the while_loop runs per shard under
     shard_map with psum'd histograms/totals, so every shard takes identical
     split decisions (replicated tree arrays) while ``node_of_row`` stays
@@ -428,42 +485,78 @@ def _grow_tree_device_sharded(bins, grad, hess, row_mask, node_of_row,
     # meshes take (shared parser: pallas_hist.interpret_mode)
     interpret = pallas_hist.interpret_mode()
     use_mxu = pallas_hist.use_pallas() or interpret
+    has_cat = cat_args is not None
     key = (mesh, row_axes, num_bins, max_nodes, min_data_in_leaf, max_depth,
-           has_feature_mask, use_mxu, interpret)
+           has_feature_mask, use_mxu, interpret, has_cat)
     if key not in _SHARDED_GROW_CACHE:
         if len(_SHARDED_GROW_CACHE) >= 16:  # bound compiled-program memory
             _SHARDED_GROW_CACHE.pop(next(iter(_SHARDED_GROW_CACHE)))
         row_spec = P(row_axes)
         rep = P()
+        cat_spec = (rep,) * 4 if has_cat else None
 
         @functools.partial(
             shard_map, mesh=mesh,
             in_specs=(sh.spec, row_spec, row_spec, row_spec, row_spec,
-                      rep, rep, rep, rep, rep),
-            out_specs={"node_of_row": row_spec, "feature": rep,
-                       "threshold_bin": rep, "default_left": rep, "left": rep,
-                       "right": rep, "gain": rep, "sums": rep, "n_nodes": rep},
+                      rep, rep, rep, rep, rep, cat_spec),
+            out_specs=dict(
+                {"node_of_row": row_spec, "feature": rep,
+                 "threshold_bin": rep, "default_left": rep, "left": rep,
+                 "right": rep, "gain": rep, "sums": rep, "n_nodes": rep},
+                **({"cat_words": rep} if has_cat else {})),
             check_vma=False)  # pallas_call can't declare varying-mesh-axes
-        def go(b, g, h, m, rows, l1, l2, msh, mgs, fm):
+        def go(b, g, h, m, rows, l1, l2, msh, mgs, fm, ca):
             return _grow_tree_device_body(
                 b, g, h, m, rows, l1, l2, msh, mgs, fm, num_bins=num_bins,
                 max_nodes=max_nodes, min_data_in_leaf=min_data_in_leaf,
                 max_depth=max_depth, use_mxu=use_mxu,
                 has_feature_mask=has_feature_mask, psum_axis=row_axes,
-                interpret=interpret)
+                interpret=interpret, cat_args=ca)
 
         _SHARDED_GROW_CACHE[key] = jax.jit(go)
     return _SHARDED_GROW_CACHE[key](
         bins, grad, hess, row_mask, node_of_row,
         np.float32(lambda_l1), np.float32(lambda_l2),
         np.float32(min_sum_hessian), np.float32(min_gain_to_split),
-        feature_mask)
+        feature_mask, cat_args)
+
+
+def cat_sets_from_words(words: np.ndarray, feature: np.ndarray,
+                        bin_mapper) -> Tuple[Optional[list],
+                                             Optional[np.ndarray]]:
+    """[nodes, CW] u32 bin-bitsets -> (per-node sorted category-VALUE sets,
+    the words themselves) — None/None when no node has a set."""
+    if words is None or not words.any():
+        return None, None
+    nn = len(feature)
+    sets: list = [None] * nn
+    for nid in range(nn):
+        w = words[nid]
+        if not w.any():
+            continue
+        bits = np.unpackbits(w.view(np.uint8), bitorder="little")
+        bins_in = np.nonzero(bits)[0]            # bin ids (>= 1 by invariant)
+        cats = bin_mapper.categories[int(feature[nid])]
+        sets[nid] = np.sort(cats[bins_in - 1]).astype(np.int64)
+    return sets, words.astype(np.uint32)
+
+
+def build_thresholds(feature, tbin, cat_sets, bin_mapper) -> np.ndarray:
+    """Raw-value thresholds per node: the bin's upper value for numerical
+    splits, 0.0 for leaves AND categorical set nodes (their routing is the
+    membership set, not a threshold). Single source for the fused-grower
+    and whole-run-scan tree builders."""
+    return np.array(
+        [bin_mapper.bin_upper_value(int(f), int(t))
+         if f >= 0 and (cat_sets is None or cat_sets[i] is None) else 0.0
+         for i, (f, t) in enumerate(zip(feature, tbin))], dtype=np.float64)
 
 
 def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
                      config: GrowerConfig, bin_mapper, feature_mask,
                      node_of_row, device_rows: bool = False,
-                     row_sharded: bool = False) -> Tuple[Tree, np.ndarray]:
+                     row_sharded: bool = False,
+                     cat_args=None) -> Tuple[Tree, np.ndarray]:
     """Host wrapper for the one-dispatch-per-tree device grower.
 
     ``device_rows``: return the row→leaf routing as the device array instead
@@ -485,13 +578,13 @@ def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
             bins_dev, grad, hess, row_mask, node_of_row,
             config.lambda_l1, config.lambda_l2,
             config.min_sum_hessian_in_leaf, config.min_gain_to_split,
-            fm, **common)
+            fm, cat_args=cat_args, **common)
     else:
         dev_out = _grow_tree_device(
             bins_dev, grad, hess, row_mask, node_of_row,
             np.float32(config.lambda_l1), np.float32(config.lambda_l2),
             np.float32(config.min_sum_hessian_in_leaf),
-            np.float32(config.min_gain_to_split), fm,
+            np.float32(config.min_gain_to_split), fm, cat_args,
             use_mxu=pallas_hist.use_mxu_single_device(bins_dev), **common)
     rows_dev = dev_out.pop("node_of_row")
     out = jax.device_get(dev_out)
@@ -511,9 +604,11 @@ def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
     # host-path parity: values are assigned at child creation only, so an
     # unsplit root keeps 0.0 (it is never anyone's child)
     value[0] = 0.0 if nn == 1 else value[0]
-    threshold = np.array(
-        [bin_mapper.bin_upper_value(int(f), int(t)) if f >= 0 else 0.0
-         for f, t in zip(feature, tbin)], dtype=np.float64)
+    cat_sets = cat_words_np = None
+    if "cat_words" in out:
+        cat_sets, cat_words_np = cat_sets_from_words(
+            out["cat_words"][:nn], feature, bin_mapper)
+    threshold = build_thresholds(feature, tbin, cat_sets, bin_mapper)
     tree = Tree(
         feature=feature,
         threshold=threshold,
@@ -525,6 +620,8 @@ def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
         gain=out["gain"][:nn].astype(np.float32),
         count=sums[:, 2].astype(np.int32),
         weight=sums[:, 1],
+        cat_sets=cat_sets,
+        cat_bin_words=cat_words_np,
     )
     if device_rows:
         return tree, rows_dev
@@ -533,8 +630,8 @@ def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
 
 def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
               config: GrowerConfig, bin_mapper, feature_mask=None,
-              node_of_row=None, device_rows: bool = False
-              ) -> Tuple[Tree, np.ndarray]:
+              node_of_row=None, device_rows: bool = False,
+              cat_args=None) -> Tuple[Tree, np.ndarray]:
     """Grow one tree; returns (tree, leaf_node_of_row).
 
     ``bins_fm``: [F,N] int (device, FEATURE-MAJOR — the canonical column-store
@@ -567,7 +664,7 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
         return _grow_tree_fused(bins_fm, grad, hess, row_mask, num_bins,
                                 config, bin_mapper, feature_mask, node_of_row,
                                 device_rows=device_rows,
-                                row_sharded=row_sharded)
+                                row_sharded=row_sharded, cat_args=cat_args)
 
     # growable node storage (host lists; frozen to arrays at the end)
     feature = [-1]
@@ -580,12 +677,14 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
     gains = [0.0]
     counts = [0]
     hweights = [0.0]
+    cw = (num_bins + 31) // 32
+    node_cat_words = [np.zeros(cw, dtype=np.uint32)]
 
     def eval_node(hist) -> Tuple[Optional[H.SplitInfo], np.ndarray]:
         split = H.find_best_split(
             hist, config.lambda_l1, config.lambda_l2,
             config.min_sum_hessian_in_leaf, config.min_data_in_leaf,
-            feature_mask)
+            feature_mask, cat_args)
         return jax.device_get(split)
 
     root_hist = H.compute_histogram(bins_fm, grad, hess, row_mask, num_bins)
@@ -615,16 +714,20 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
         s = node.split
         f, t = int(s.feature), int(s.bin)
         lid, rid = len(feature), len(feature) + 1
+        words = np.asarray(s.cat_words, dtype=np.uint32)
+        is_cat_split = bool(words.any())
 
         # record the split on the parent
         feature[node.id] = f
-        threshold[node.id] = bin_mapper.bin_upper_value(f, t)
+        threshold[node.id] = 0.0 if is_cat_split \
+            else bin_mapper.bin_upper_value(f, t)
         threshold_bin[node.id] = t
         default_left[node.id] = bool(s.default_left)
         left[node.id] = lid
         right[node.id] = rid
         gains[node.id] = float(s.gain)
         value[node.id] = 0.0
+        node_cat_words[node.id] = words
 
         lsum = np.asarray(s.left_sum, dtype=np.float64)
         rsum = np.asarray(s.right_sum, dtype=np.float64)
@@ -644,6 +747,7 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
             gains.append(0.0)
             counts.append(int(sums[2]))
             hweights.append(float(sums[1]))
+            node_cat_words.append(np.zeros(cw, dtype=np.uint32))
 
         n_leaves += 1
         small_id, big_id = (lid, rid) if lsum[2] <= rsum[2] else (rid, lid)
@@ -654,7 +758,10 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
             # multi-call path: compute_histogram dispatches to the per-shard
             # Pallas kernel + psum (the fused jit's in-graph scatter would
             # lose ~13x and can OOM at large N — pallas_hist.py:30-35)
-            node_of_row = H.partition_rows(
+            node_of_row = H.partition_rows_cat(
+                bins_fm[f], node_of_row, node.id,
+                np.int32(t), bool(s.default_left), np.int32(lid),
+                np.int32(rid), words) if is_cat_split else H.partition_rows(
                 bins_fm[f], node_of_row, node.id,
                 np.int32(t), bool(s.default_left), np.int32(lid),
                 np.int32(rid))
@@ -682,7 +789,9 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
                     num_bins=num_bins,
                     min_data_in_leaf=config.min_data_in_leaf,
                     use_mxu=use_mxu,
-                    has_feature_mask=feature_mask is not None)
+                    has_feature_mask=feature_mask is not None,
+                    cat_words=words if cat_args is not None else None,
+                    cat_info=cat_args)
             split_small, split_big = jax.device_get((split_small, split_big))
 
         for cid, chist, csplit, csums in (
@@ -691,6 +800,9 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
             if csums[2] >= 2 * config.min_data_in_leaf:
                 push(_Node(cid, node.depth + 1, chist, csums, csplit))
 
+    words_arr = np.stack(node_cat_words)
+    cat_sets, cat_words_np = cat_sets_from_words(
+        words_arr, np.asarray(feature, dtype=np.int32), bin_mapper)
     tree = Tree(
         feature=np.asarray(feature, dtype=np.int32),
         threshold=np.asarray(threshold, dtype=np.float64),
@@ -702,6 +814,8 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
         gain=np.asarray(gains, dtype=np.float32),
         count=np.asarray(counts, dtype=np.int32),
         weight=np.asarray(hweights, dtype=np.float64),
+        cat_sets=cat_sets,
+        cat_bin_words=cat_words_np,
     )
     return tree, np.asarray(jax.device_get(node_of_row))
 
@@ -713,12 +827,17 @@ def predict_tree_binned(tree: Tree, bins: np.ndarray) -> np.ndarray:
     node = np.zeros(n, dtype=np.int64)
     active = tree.feature[node] != -1
     while active.any():
-        f = tree.feature[node[active]]
+        cur = node[active]
+        f = tree.feature[cur]
         b = bins[active, f]
-        t = tree.threshold_bin[node[active]]
-        go_left = np.where(b == 0, tree.default_left[node[active]], b <= t)
-        node[active] = np.where(go_left, tree.left[node[active]],
-                                tree.right[node[active]])
+        t = tree.threshold_bin[cur]
+        go_left = np.where(b == 0, tree.default_left[cur], b <= t)
+        if tree.cat_bin_words is not None:
+            w = tree.cat_bin_words[cur]                     # [A, CW]
+            bit = (w[np.arange(len(b)), b >> 5] >> (b & 31).astype(
+                np.uint32)) & 1
+            go_left = np.where(w.any(axis=1), bit == 1, go_left)
+        node[active] = np.where(go_left, tree.left[cur], tree.right[cur])
         active = tree.feature[node] != -1
     out = tree.value[node] * tree.shrinkage
     return out

@@ -188,6 +188,17 @@ def _merge_sparse(a, b):
 
 
 class _VowpalWabbitModelBase(Model, HasFeaturesCol):
+    """Scoring base for VW models.
+
+    Model interchange surface: ``get_readable_model()`` — the vw
+    ``--readable_model`` text dump (bit-exact murmur hashing makes single
+    weights directly comparable to a vw run). The reference's binary VW
+    blob (``getModel``, vw/VowpalWabbitBaseModel.scala:1-98) is a
+    version-pinned format and a documented NON-GOAL: see docs/vw.md for
+    the rationale; framework persistence round-trips the full learner
+    state (weights + AdaGrad/FTRL accumulators) instead.
+    """
+
     weights = ComplexParam("weights", "Learned weight vector")
     numBits = Param("numBits", "Feature space bits", 18, ptype=int)
     testArgs = Param("testArgs", "Extra args used at test time (parity)", "", ptype=str)

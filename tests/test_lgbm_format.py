@@ -319,8 +319,11 @@ class TestStagesSurface:
         with pytest.warns(RuntimeWarning, match="missing_type=Zero"):
             from_lightgbm_string(text)
 
-    def test_categorical_rejected(self):
-        text = self_text = (
+    def test_malformed_categorical_block_rejected(self):
+        """Categorical decision bit WITHOUT cat_boundaries/cat_threshold is
+        a malformed model — loud error, not a silent misparse (categorical
+        splits themselves import fine: test_gbdt_categorical.py)."""
+        text = (
             "tree\nversion=v3\nnum_class=1\nnum_tree_per_iteration=1\n"
             "label_index=0\nmax_feature_idx=1\nobjective=binary sigmoid:1\n"
             "feature_names=a b\nfeature_infos=none none\ntree_sizes=100\n\n"

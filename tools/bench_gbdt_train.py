@@ -35,7 +35,10 @@ def make_data(n, d, rng):
     return X, y
 
 
-def time_sklearn(X, y, iters):
+def time_sklearn(X, y, iters, acc_rows=1_000_000):
+    """Returns (fit_seconds, train_accuracy) — the accuracy is recorded so
+    every vs_sklearn speed row carries the quality comparison too
+    (round-3 verdict weak #2)."""
     try:
         from sklearn.ensemble import HistGradientBoostingClassifier
 
@@ -44,9 +47,66 @@ def time_sklearn(X, y, iters):
             min_samples_leaf=20, max_bins=255, early_stopping=False)
         t0 = time.perf_counter()
         skl.fit(X, y)
-        return time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        m = min(len(y), acc_rows)
+        acc = float((skl.predict(X[:m]) == y[:m]).mean())
+        return dt, acc
     except Exception:
-        return None
+        return None, None
+
+
+def bench_predict(booster, X, rtt: float):
+    """GBDT scoring throughput (the reference's production surface is
+    per-row predict UDFs, lightgbm/LightGBMBooster.scala:21-148).
+
+    Batch: K chained device-forest dispatches (each input depends on the
+    previous output so calls cannot overlap/elide), ONE fetch, minus the
+    fetch RTT — the tunnel-honest methodology from BENCH_hist.json.
+    Single-row: the plain Python API path, per-call (what a per-row UDF
+    would pay; includes dispatch + fetch every call)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.gbdt.predict import DeviceEnsemble
+
+    k = max(booster.params.num_class, 1)
+    ens = DeviceEnsemble(booster.trees, k)
+    if ens._jitted is None:
+        ens._jitted = ens._compile()
+    fn = ens._jitted
+    Xd = jnp.asarray(X, dtype=jnp.float32)
+    out = fn(Xd)
+    np.asarray(out)  # compile + sync
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(Xd + out[0, 0] * 0.0)
+    np.asarray(out)
+    batch_s = max(time.perf_counter() - t0 - rtt, 1e-9) / iters
+
+    x1 = np.ascontiguousarray(X[:1])
+    booster.raw_predict(x1)
+    t0 = time.perf_counter()
+    n_single = 30
+    for _ in range(n_single):
+        booster.raw_predict(x1)
+    single_ms = (time.perf_counter() - t0) / n_single * 1e3
+    return {"batch_rows_per_sec": round(len(X) / batch_s),
+            "batch_ms": round(batch_s * 1e3, 2),
+            "single_row_ms": round(single_ms, 2)}
+
+
+def _rtt() -> float:
+    import jax.numpy as jnp
+
+    x = jnp.zeros(8, jnp.float32) + 1.0
+    np.asarray(x)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(x + 1.0)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
 
 
 def main():
@@ -75,7 +135,7 @@ def main():
         warm.append(time.perf_counter() - t0)
     fit_s = min(warm)
     acc = float(np.mean((booster.raw_predict(X) > 0) == y))
-    skl_s = time_sklearn(X, y, iters)
+    skl_s, skl_acc = time_sklearn(X, y, iters)
 
     out = {
         "backend": dev.platform,
@@ -85,11 +145,27 @@ def main():
         "rows_per_sec": round(n * iters / fit_s, 1),
         "train_accuracy": round(acc, 4),
         "sklearn_hist_gbdt_seconds": round(skl_s, 2) if skl_s else None,
+        "sklearn_train_accuracy": round(skl_acc, 4) if skl_acc else None,
         "vs_sklearn": round(skl_s / fit_s, 2) if skl_s else None,
         "vs_sklearn_cold": round(skl_s / cold_s, 2) if skl_s else None,
     }
 
     import os
+
+    if on_accel:
+        # model-level check of the default bf16 hi/lo histogram: retrain
+        # the same config with the exact f32 path and record both
+        # accuracies (kernel-level deltas are in pallas_hist.hist_hilo)
+        os.environ["MMLSPARK_TPU_HIST_EXACT"] = "1"
+        try:
+            b_exact = train(params, X, y)
+            out["train_accuracy_exact_hist"] = round(
+                float(np.mean((b_exact.raw_predict(X) > 0) == y)), 4)
+        finally:
+            os.environ.pop("MMLSPARK_TPU_HIST_EXACT", None)
+
+    rtt = _rtt() if on_accel else 0.0
+    out["predict"] = bench_predict(booster, X, rtt)
 
     # GOSS (LightGBM's headline speed feature): in-scan on-device sampling
     # + root row compaction shrinks every histogram/partition pass to the
@@ -117,18 +193,27 @@ def main():
         Xl, yl = make_data(n_large, d, np.random.default_rng(1))
         t0 = time.perf_counter()
         bl = train(params, Xl, yl)
+        large_cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        bl = train(params, Xl, yl)
         large_fit = time.perf_counter() - t0
         acc_l = float(np.mean((bl.raw_predict(Xl[:1_000_000]) > 0)
                               == yl[:1_000_000]))
-        skl_l = time_sklearn(Xl, yl, iters)
+        skl_l, skl_acc_l = time_sklearn(Xl, yl, iters)
         large = {
             "rows": n_large,
+            "fit_seconds_cold": round(large_cold, 2),
             "fit_seconds": round(large_fit, 2),
             "rows_per_sec": round(n_large * iters / large_fit, 1),
             "train_accuracy": round(acc_l, 4),
             "sklearn_hist_gbdt_seconds": round(skl_l, 2) if skl_l else None,
+            "sklearn_train_accuracy": round(skl_acc_l, 4)
+            if skl_acc_l else None,
             "vs_sklearn": round(skl_l / large_fit, 2) if skl_l else None,
+            "vs_sklearn_cold": round(skl_l / large_cold, 2)
+            if skl_l else None,
         }
+        large["predict"] = bench_predict(bl, Xl[:1_000_000], rtt)
         t0 = time.perf_counter()
         blg = train(goss_params, Xl, yl)
         goss_l_cold = time.perf_counter() - t0
