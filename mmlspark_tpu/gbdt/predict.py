@@ -1,9 +1,22 @@
-"""Tree-ensemble prediction: vectorized host path + jitted device kernel.
+"""Tree-ensemble prediction: vectorized host path + jitted device kernels.
 
 LGBM_BoosterPredictForMat/PredictForMatSingle parity (driven by the reference's
-scoring UDFs, lightgbm/LightGBMBooster.scala:21-148). The device kernel pads all
-trees into one SoA tensor and traverses every (row, tree) pair in parallel with a
-bounded gather loop — no per-row JNI calls, one XLA program for the whole forest.
+scoring UDFs, lightgbm/LightGBMBooster.scala:21-148). Two device strategies:
+
+- **GEMM forest** (default for numerical forests): tree traversal
+  reformulated as matrix algebra on the MXU — the TPU-first design, since
+  per-node gathers serialize badly on TPU (measured ~20k rows/s for the
+  gather loop at 200k x 50 trees). Per row: comparison signs s_i = ±1 for
+  every internal node of every tree (one [N, I] gather + compare), then
+  ONE matmul against the ±1/0 path matrix C[i, l] (+1 left-ancestor, -1
+  right-ancestor, 0 non-ancestor): a leaf l is reached iff (S @ C)[l]
+  equals its path length. Leaf values arrive via a second matmul. All
+  products are ±1/0 — exact in bf16 with f32 accumulation; the value
+  matmul runs f32. Rows are chunked so [N, I]/[N, L] activations stay
+  bounded.
+- **Gather loop** (fallback): bounded per-depth gathers over the padded
+  node SoA — used for categorical forests (set membership is not a sign
+  comparison; small models use host traversal outright).
 """
 
 from __future__ import annotations
@@ -120,6 +133,89 @@ class DeviceEnsemble:
         for t in trees:
             self.max_depth = max(self.max_depth, _tree_depth(t))
         self._jitted = None
+        self._gemm = None
+        if self.cat_vals is None and not self.cat_host_fallback:
+            self._build_gemm(trees)
+
+    def _build_gemm(self, trees):
+        """Per-tree padded GEMM layout: comparison-sign x path-matrix
+        forest evaluation (module docstring). Host-built once."""
+        T = self.num_trees
+        i_max = max(max((int((t.feature >= 0).sum()) for t in trees),
+                        default=1), 1)
+        l_max = max(max((t.num_leaves for t in trees), default=1), 1)
+        if T * i_max * l_max > 1 << 27:
+            # imported forests can carry thousands of leaves per tree: the
+            # [T, I, L] path matrix would be GBs — keep the gather kernel
+            self._gemm = None
+            return
+        feat = np.zeros((T, i_max), dtype=np.int32)
+        thr = np.zeros((T, i_max), dtype=np.float32)
+        dl = np.zeros((T, i_max), dtype=bool)
+        ivalid = np.zeros((T, i_max), dtype=np.float32)
+        C = np.zeros((T, i_max, l_max), dtype=np.float32)
+        plen = np.full((T, l_max), -1.0, dtype=np.float32)  # pad unreachable
+        lval = np.zeros((T, l_max), dtype=np.float32)
+        for ti, t in enumerate(trees):
+            int_ids = np.nonzero(t.feature >= 0)[0]
+            int_index = {int(nid): i for i, nid in enumerate(int_ids)}
+            feat[ti, : len(int_ids)] = t.feature[int_ids]
+            thr[ti, : len(int_ids)] = t.threshold[int_ids]
+            dl[ti, : len(int_ids)] = t.default_left[int_ids]
+            ivalid[ti, : len(int_ids)] = 1.0
+            li = 0
+            stack = [(0, [])]
+            while stack:
+                nid, path = stack.pop()
+                if t.feature[nid] == -1:
+                    for ii, sign in path:
+                        C[ti, ii, li] = sign
+                    plen[ti, li] = float(len(path))
+                    lval[ti, li] = float(t.value[nid]) * t.shrinkage
+                    li += 1
+                else:
+                    ii = int_index[int(nid)]
+                    stack.append((int(t.left[nid]), path + [(ii, 1.0)]))
+                    stack.append((int(t.right[nid]), path + [(ii, -1.0)]))
+        self._gemm = (feat, thr, dl, ivalid, C, plen, lval)
+
+    def _compile_gemm(self):
+        import jax
+        import jax.numpy as jnp
+
+        feat_h, thr_h, dl_h, iv_h, C_h, plen_h, lval_h = self._gemm
+        # ±1/0 operands are exact in bf16 (half the MXU passes); CPU XLA
+        # has no bf16xbf16->f32 dot, so it keeps f32 (equally exact)
+        mm_dtype = (jnp.bfloat16 if jax.default_backend() == "tpu"
+                    else jnp.float32)
+        feat = jnp.asarray(feat_h)
+        thr = jnp.asarray(thr_h)
+        dl = jnp.asarray(dl_h)
+        iv = jnp.asarray(iv_h)
+        Cb = jnp.asarray(C_h, dtype=mm_dtype)
+        plen = jnp.asarray(plen_h)
+        lval = jnp.asarray(lval_h)
+        class_onehot = jax.nn.one_hot(
+            jnp.asarray(self.class_of_tree), self.num_class,
+            dtype=jnp.float32)
+
+        def fwd(X):
+            x_sel = X[:, feat]                       # [N, T, I] gather
+            s = jnp.where(jnp.isnan(x_sel),
+                          jnp.where(dl[None], 1.0, -1.0),
+                          jnp.where(x_sel <= thr[None], 1.0, -1.0))
+            s = (s * iv[None]).astype(mm_dtype)      # pad ints contribute 0
+            # z[n,t,l] = sum_i s * C: ±1 products are exact in bf16, the
+            # f32 accumulation holds small integers exactly
+            z = jax.lax.dot_general(
+                s, Cb, ((((2,), (1,)), ((1,), (0,)))),
+                preferred_element_type=jnp.float32)  # [T, N, L]
+            z = jnp.swapaxes(z, 0, 1)                # [N, T, L]
+            reach = (z == plen[None]).astype(jnp.float32)
+            contrib = jnp.sum(reach * lval[None], axis=2)   # [N, T]
+            return contrib @ class_onehot            # [N, K]
+
+        return jax.jit(fwd)
 
     def _compile(self):
         import jax
@@ -177,6 +273,10 @@ class DeviceEnsemble:
 
         return jax.jit(fwd)
 
+    # rows per GEMM dispatch: bounds the [N, T, I]/[N, T, L] activations
+    # (bf16/f32) — 64k rows x 100 trees x 31 nodes ~ 400 MB
+    GEMM_ROW_CHUNK = 1 << 16
+
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
         """[N,F] float32 -> [N, num_class] summed tree outputs (device)."""
         if self.num_trees == 0:
@@ -184,10 +284,26 @@ class DeviceEnsemble:
         if self.cat_host_fallback:
             return predict_ensemble(self._tree_groups, np.asarray(X),
                                     self.num_class)
+        Xf = np.asarray(X, dtype=np.float32)
+        if self._gemm is not None:
+            if self._jitted is None:
+                self._jitted = self._compile_gemm()
+            n = Xf.shape[0]
+            if n <= self.GEMM_ROW_CHUNK:
+                return np.asarray(self._jitted(Xf), dtype=np.float64)
+            outs = []
+            for r0 in range(0, n, self.GEMM_ROW_CHUNK):
+                xc = Xf[r0: r0 + self.GEMM_ROW_CHUNK]
+                m = len(xc)
+                if m < self.GEMM_ROW_CHUNK:  # pad: one compiled shape
+                    xc = np.pad(xc, ((0, self.GEMM_ROW_CHUNK - m), (0, 0)),
+                                constant_values=np.nan)
+                outs.append(np.asarray(self._jitted(xc),
+                                       dtype=np.float64)[:m])
+            return np.concatenate(outs, axis=0)
         if self._jitted is None:
             self._jitted = self._compile()
-        return np.asarray(self._jitted(np.asarray(X, dtype=np.float32)),
-                          dtype=np.float64)
+        return np.asarray(self._jitted(Xf), dtype=np.float64)
 
 
 def _tree_depth(tree: Tree) -> int:

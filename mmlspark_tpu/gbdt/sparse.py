@@ -193,39 +193,114 @@ class SparseDataset:
 # ---------------------------------------------------------------------------
 
 
-def _flat_histogram(dev, grad, hess, node_mask_rows):
-    """Nonzero-entry histogram: [total_bins, 3] sums over the node's rows.
+_PREFIX_BLOCK = 512
 
-    One 1-D gather (row routing mask at the nnz entries) + one segment_sum —
-    O(nnz) work regardless of F (LightGBM's per-feature nnz iteration,
-    TrainUtils.scala:23-66, as one vectorized pass).
 
-    ``dev["nnz_valid"]`` (optional, sharded layouts): 0/1 per entry —
-    padding entries in equal-shape per-shard slices contribute nothing."""
+def _prefix_sum(data, int_channel=None):
+    """Inclusive prefix sum of [C, n] with a LEADING zero column -> [C, n+1]
+    (so ``out[:, k]`` = sum of the first k elements).
+
+    XLA's native cumsum lowering costs ~645 ms at [3, 50M] on the chip —
+    it dominates every sparse split. This is the TPU-native two-level
+    scheme instead: inclusive prefixes WITHIN 512-wide blocks via one
+    upper-triangular matmul on the MXU (the stream-select kernel's trick),
+    plus an ordinary cumsum over the ~n/512 block sums. Also better
+    precision than a flat f32 scan: within-block sums cover <= 512 values.
+    Small inputs keep jnp.cumsum (cheaper to compile, equally fast).
+
+    ``int_channel``: channel whose values are integers (the COUNT channel)
+    — its prefix is computed exactly in int32 (blocked short-scan cumsum +
+    int32 block prefix), because an f32 prefix silently rounds once the
+    running total passes 2^24 (at 50M entries the count channel would be
+    off by up to ~4 per bin difference)."""
     import jax.numpy as jnp
-    import jax.ops
 
-    m = jnp.take(node_mask_rows, dev["row_of_nnz"]).astype(jnp.float32)
+    c, n = data.shape
+    zero = jnp.zeros((c, 1), data.dtype)
+    if n < (1 << 18):
+        return jnp.concatenate([zero, jnp.cumsum(data, axis=1)], axis=1)
+    B = _PREFIX_BLOCK
+    import jax
+
+    n_pad = (n + B - 1) // B * B
+    x = jnp.pad(data, ((0, 0), (0, n_pad - n))).reshape(c, n_pad // B, B)
+    iota = jnp.arange(B, dtype=jnp.int32)
+    ut = (iota[:, None] <= iota[None, :]).astype(jnp.float32)  # [B, B]
+    intra = jax.lax.dot_general(
+        x, ut, (((2,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)          # [c, nb, B] inclusive
+    block_excl = jnp.cumsum(intra[:, :, -1], axis=1) - intra[:, :, -1]
+    cs = (intra + block_excl[:, :, None]).reshape(c, n_pad)[:, :n]
+    if int_channel is not None:
+        xi = jnp.round(x[int_channel]).astype(jnp.int32)   # [nb, B]
+        intra_i = jnp.cumsum(xi, axis=1)                   # short scans
+        bsum = intra_i[:, -1]
+        bexcl = jnp.cumsum(bsum) - bsum
+        cs_i = (intra_i + bexcl[:, None]).reshape(n_pad)[:n]
+        cs = cs.at[int_channel].set(cs_i.astype(jnp.float32))
+    return jnp.concatenate([zero, cs], axis=1)
+
+
+def _entry_gh(dev, grad, hess):
+    """Per-ENTRY grad/hess in bin-sorted order: gathered ONCE per
+    tree/iteration. The 50M-entry random gather costs ~0.45 s on the chip
+    (measured ~30 ns/element — the dominant sparse cost); grad/hess are
+    loop-invariant during a tree, so only the node MASK gather stays in
+    the per-split path."""
+    import jax.numpy as jnp
+
+    rows_bs = dev["row_of_nnz_bs"]
+    return jnp.take(grad, rows_bs), jnp.take(hess, rows_bs)
+
+
+def _flat_histogram(dev, g_bs, h_bs, node_mask_rows):
+    """Nonzero-entry histogram: [3, total_bins] sums over the node's rows —
+    SCATTER-FREE (the TPU has no scatter hardware; jax segment_sum lowers
+    to a serialized XLA scatter that crashed the tunnelled worker at 50M
+    nnz). Entries are pre-sorted by flat bin at dataset build, so the
+    per-bin sums are differences of ONE masked prefix sum at the
+    bin-boundary offsets: O(nnz) block-matmul scan (_prefix_sum) + O(TB)
+    gathers. Per split this costs one [nnz] row-mask gather + the scan.
+
+    ``g_bs``/``h_bs``: per-entry grad/hess from _entry_gh (hoisted out of
+    the split loop — they are tree-invariant).
+    ``dev["nnz_valid"]`` (optional, sharded layouts): 0/1 per BIN-SORTED
+    entry — padding entries in equal-shape per-shard slices contribute
+    nothing.
+
+    ALL flat-histogram tensors are CHANNEL-MAJOR [3, nnz] / [3, TB]: the
+    minor dim must be the big one — a [50M, 3] f32 array tiles 3 -> 128
+    lanes on TPU, a 42x HBM blowup that tried to allocate 25.6 GB at the
+    1M-row text bench (same trap the dense kernels hit in r3)."""
+    import jax.numpy as jnp
+
+    rows_bs = dev["row_of_nnz_bs"]                 # bin-sorted entry order
+    m = jnp.take(node_mask_rows, rows_bs).astype(jnp.float32)
     if "nnz_valid" in dev:
         m = m * dev["nnz_valid"]
-    g = jnp.take(grad, dev["row_of_nnz"]) * m
-    h = jnp.take(hess, dev["row_of_nnz"]) * m
-    data = jnp.stack([g, h, m], axis=-1)
-    return jax.ops.segment_sum(data, dev["bin_of_nnz"],
-                               num_segments=dev["total_bins"])
+    data = jnp.stack([g_bs * m, h_bs * m, m], axis=0)   # [3, nnz]
+    cs = _prefix_sum(data, int_channel=2)
+    return (jnp.take(cs, dev["bin_end"], axis=1)
+            - jnp.take(cs, dev["bin_start"], axis=1))   # [3, TB]
 
 
 def _zero_completed(dev, flat_hist, node_totals):
     """Add the implicit-zero bin of every feature: node totals minus the
-    feature's nonzero-entry sums (LightGBM's default-bin subtraction)."""
+    feature's nonzero-entry sums (LightGBM's default-bin subtraction).
+    Scatter-free: per-feature sums are cumsum differences at the feature
+    boundaries (bins are grouped by feature in the flat space), and the
+    zero-bin add is a masked gather of the per-feature deficit.
+    Channel-major [3, TB] layout throughout (see _flat_histogram)."""
     import jax.numpy as jnp
-    import jax.ops
 
-    feat_sums = jax.ops.segment_sum(flat_hist, dev["feat_of_bin"],
-                                    num_segments=dev["num_features"])
-    zero_sums = node_totals[None, :] - feat_sums          # [F, 3]
-    return flat_hist.at[dev["zero_flat"]].add(
-        jnp.take(zero_sums, dev["present_feats"], axis=0))
+    cs = _prefix_sum(flat_hist, int_channel=2)
+    feat_sums = (jnp.take(cs, dev["feat_offset_dev"][1:], axis=1)
+                 - jnp.take(cs, dev["feat_offset_dev"][:-1], axis=1))
+    zero_sums = node_totals[:, None] - feat_sums          # [3, F]
+    add = jnp.where(dev["is_zero_bin"][None, :],
+                    jnp.take(zero_sums, dev["feat_of_bin"], axis=1), 0.0)
+    return flat_hist + add
 
 
 def _find_best_split_flat(dev, hist, lambda_l1, lambda_l2, min_sum_hessian,
@@ -233,6 +308,7 @@ def _find_best_split_flat(dev, hist, lambda_l1, lambda_l2, min_sum_hessian,
     """Vectorized gain scan over ALL flat bins: candidate t at flat bin b
     sends local bins <= b left. Per-feature left-cumulative sums come from a
     global cumsum minus the feature's base — no per-feature loop.
+    ``hist`` is channel-major [3, TB] (see _flat_histogram).
 
     ``bin_mask``: optional [TB] bool of ALLOWED candidate bins (feature
     fraction, mapped to the flat bin space by the caller)."""
@@ -240,12 +316,13 @@ def _find_best_split_flat(dev, hist, lambda_l1, lambda_l2, min_sum_hessian,
 
     from .histogram import _leaf_objective
 
-    cs = jnp.cumsum(hist, axis=0)                          # [TB, 3]
-    base = cs[dev["feat_start_of_bin"]] - hist[dev["feat_start_of_bin"]]
-    left = cs - base                                       # [TB, 3] within-feature
-    total = left[dev["feat_end_of_bin"]]                   # node totals per bin's feat
-    GL, HL, CL = left[:, 0], left[:, 1], left[:, 2]
-    G, H, C = total[:, 0], total[:, 1], total[:, 2]
+    cs = _prefix_sum(hist, int_channel=2)[:, 1:]           # [3, TB] inclusive
+    base = (jnp.take(cs, dev["feat_start_of_bin"], axis=1)
+            - jnp.take(hist, dev["feat_start_of_bin"], axis=1))
+    left = cs - base                                       # [3, TB] within-feature
+    total = jnp.take(left, dev["feat_end_of_bin"], axis=1)
+    GL, HL, CL = left[0], left[1], left[2]
+    G, H, C = total[0], total[1], total[2]
     GR, HR, CR = G - GL, H - HL, C - CL
     gain = (_leaf_objective(GL, HL, lambda_l1, lambda_l2)
             + _leaf_objective(GR, HR, lambda_l1, lambda_l2)
@@ -265,26 +342,59 @@ def _route_rows(dev, node_of_row, node_id, f, t_local, lid, rid):
     """Send the node's rows left iff value-bin <= t_local; absent entries
     carry the feature's zero bin.
 
-    A row owns at most ONE entry of feature f (CSR distinct indices), so a
-    segment_max over per-entry corrections (sentinel -1 elsewhere) resolves
-    the override without duplicate-index scatter races."""
+    SCATTER-FREE: each row's entry of feature ``f`` (if any) is located by
+    a vectorized binary search inside the row's CSR slice — 32 fixed
+    lower-bound steps of pure gathers over the feature-sorted entries
+    (segment_max over 50M entries lowered to a serialized scatter-max that
+    crashed the tunnelled worker at text scale)."""
+    import jax
     import jax.numpy as jnp
-    import jax.ops
 
     zero_goes_left = dev["zero_local_dev"][f] <= t_local
     default_child = jnp.where(zero_goes_left, lid, rid)
     in_node = node_of_row == node_id
     out = jnp.where(in_node, default_child, node_of_row)
-    # entries of feature f override the default for their rows
-    local_bin = dev["bin_of_nnz"] - dev["feat_offset_dev"][dev["feat_of_nnz"]]
-    is_f = dev["feat_of_nnz"] == f
+
+    feats = dev["feat_of_nnz"]
+    nnz = feats.shape[0]
+    indptr = dev["indptr_dev"]
+    lo0 = indptr[:-1]
+    hi0 = indptr[1:]
+
+    def step(_, lohi):
+        lo, hi = lohi
+        cont = lo < hi
+        mid = (lo + hi) >> 1
+        fm = jnp.take(feats, jnp.clip(mid, 0, nnz - 1))
+        go_hi = fm < f
+        new_lo = jnp.where(go_hi, mid + 1, lo)
+        new_hi = jnp.where(go_hi, hi, mid)
+        return (jnp.where(cont, new_lo, lo), jnp.where(cont, new_hi, hi))
+
+    # per-row search ranges are at most max_row_nnz wide, so
+    # ceil(log2(max_row_nnz)) steps suffice — at avg-50-nnz text data that
+    # is ~9 gathers instead of 32 (each step is a random [N] gather from
+    # the 200 MB entry stream, the dominant routing cost at 50M nnz)
+    n_steps = dev.get("route_steps", 32)
+    lo, _ = jax.lax.fori_loop(0, n_steps, step, (lo0, hi0))
+    pos = jnp.clip(lo, 0, nnz - 1)
+    has = (lo < hi0) & (jnp.take(feats, pos) == f)
+    local_bin = jnp.take(dev["bin_of_nnz"], pos) - dev["feat_offset_dev"][f]
     target = jnp.where(local_bin <= t_local, lid, rid)
-    rows = dev["row_of_nnz"]
-    per_entry = jnp.where(is_f & jnp.take(in_node, rows), target,
-                          jnp.int32(-1))
-    correction = jax.ops.segment_max(per_entry, rows,
-                                     num_segments=node_of_row.shape[0])
-    return jnp.where(correction >= 0, correction, out)
+    return jnp.where(in_node & has, target, out)
+
+
+def _bin_sorted_layout(bin_of_nnz: np.ndarray, total_bins: int):
+    """Host precompute for the scatter-free histogram: a stable sort of
+    entries by flat bin + the per-bin [start, end) offsets into the sorted
+    stream. Returns (order, bin_start [TB], bin_end [TB])."""
+    order = np.argsort(bin_of_nnz, kind="stable")
+    sorted_bins = bin_of_nnz[order]
+    bin_start = np.searchsorted(sorted_bins, np.arange(total_bins),
+                                side="left")
+    bin_end = np.searchsorted(sorted_bins, np.arange(total_bins),
+                              side="right")
+    return order, bin_start.astype(np.int64), bin_end.astype(np.int64)
 
 
 def _device_arrays(ds: SparseDataset):
@@ -299,10 +409,18 @@ def _device_arrays(ds: SparseDataset):
     present = np.nonzero(np.diff(ds.feat_offset) > 0)[0]
     zero_flat = (ds.feat_offset[present]
                  + ds.zero_local[present]).astype(np.int64)
+    is_zero_bin = np.zeros(tb, dtype=bool)
+    is_zero_bin[zero_flat] = True
+    order, bin_start, bin_end = _bin_sorted_layout(ds.bin_of_nnz, tb)
     return {
-        "row_of_nnz": jnp.asarray(ds.row_of_nnz),
         "bin_of_nnz": jnp.asarray(ds.bin_of_nnz, dtype=jnp.int32),
         "feat_of_nnz": jnp.asarray(ds.indices, dtype=jnp.int32),
+        "indptr_dev": jnp.asarray(ds.indptr, dtype=jnp.int32),
+        # bin-sorted views for the scatter-free histogram
+        "row_of_nnz_bs": jnp.asarray(ds.row_of_nnz[order]),
+        "bin_start": jnp.asarray(bin_start, dtype=jnp.int32),
+        "bin_end": jnp.asarray(bin_end, dtype=jnp.int32),
+        "is_zero_bin": jnp.asarray(is_zero_bin),
         "feat_of_bin": jnp.asarray(feat_of_bin, dtype=jnp.int32),
         "feat_start_of_bin": jnp.asarray(feat_start, dtype=jnp.int32),
         "feat_end_of_bin": jnp.asarray(feat_end, dtype=jnp.int32),
@@ -313,10 +431,14 @@ def _device_arrays(ds: SparseDataset):
         "feat_offset_dev": jnp.asarray(ds.feat_offset, dtype=jnp.int32),
         "total_bins": tb,
         "num_features": ds.num_features,
+        "route_steps": int(
+            max(int(np.diff(ds.indptr).max()) if len(ds.indptr) > 1 else 1,
+                1)).bit_length(),
     }
 
 
 _FUSED_SPARSE_GROW_CACHE: dict = {}
+_SPARSE_SCAN_CACHE: dict = {}
 
 
 def _tree_from_fused_out(out_host, config: GrowerConfig,
@@ -373,10 +495,15 @@ def shard_sparse_dataset(ds: SparseDataset, n_shards: int):
     nz_max = max(nz_max, 1)
 
     S = n_shards
+    tb = ds.total_bins
     bin_sh = np.zeros((S, nz_max), dtype=np.int32)
     rowl_sh = np.zeros((S, nz_max), dtype=np.int32)
     feat_sh = np.full((S, nz_max), -1, dtype=np.int32)
-    valid_sh = np.zeros((S, nz_max), dtype=np.float32)
+    row_bs = np.zeros((S, nz_max), dtype=np.int32)
+    valid_bs = np.zeros((S, nz_max), dtype=np.float32)
+    bin_start = np.zeros((S, tb), dtype=np.int32)
+    bin_end = np.zeros((S, tb), dtype=np.int32)
+    indptr_loc = np.zeros((S, r_max + 1), dtype=np.int32)
     row_valid = np.zeros((S, r_max), dtype=bool)
     for s in range(S):
         r0, r1 = int(bounds[s]), int(bounds[s + 1])
@@ -385,10 +512,23 @@ def shard_sparse_dataset(ds: SparseDataset, n_shards: int):
         bin_sh[s, :m] = ds.bin_of_nnz[e0:e1]
         rowl_sh[s, :m] = ds.row_of_nnz[e0:e1] - r0
         feat_sh[s, :m] = ds.indices[e0:e1]
-        valid_sh[s, :m] = 1.0
+        # bin-sorted views of the REAL entries (pads stay at the tail with
+        # valid 0; bin boundaries index only the sorted real stream)
+        order, bs, be = _bin_sorted_layout(
+            ds.bin_of_nnz[e0:e1].astype(np.int64), tb)
+        row_bs[s, :m] = (ds.row_of_nnz[e0:e1] - r0)[order]
+        valid_bs[s, :m] = 1.0
+        bin_start[s] = bs
+        bin_end[s] = be
+        # local CSR offsets for the binary-search routing; empty/pad rows
+        # collapse to [m, m)
+        indptr_loc[s, : r1 - r0 + 1] = ds.indptr[r0: r1 + 1] - e0
+        indptr_loc[s, r1 - r0 + 1:] = m
         row_valid[s, : r1 - r0] = True
     return ({"bin_of_nnz": bin_sh, "row_of_nnz": rowl_sh,
-             "feat_of_nnz": feat_sh, "nnz_valid": valid_sh,
+             "feat_of_nnz": feat_sh, "row_of_nnz_bs": row_bs,
+             "nnz_valid": valid_bs, "bin_start": bin_start,
+             "bin_end": bin_end, "indptr_dev": indptr_loc,
              "row_valid": row_valid}, bounds, r_max)
 
 
@@ -424,7 +564,7 @@ def grow_tree_sparse_sharded(ds: SparseDataset, dev, sharded, mesh,
     # data flows through jit arguments, so a cache hit can never serve a
     # stale dataset (shape changes retrace inside the cached jit)
     key = (mesh, M, config.min_data_in_leaf, config.max_depth, has_bm,
-           tb, dev["num_features"])
+           tb, dev["num_features"], dev.get("route_steps", 32))
     if key not in _SHARDED_SPARSE_GROW_CACHE:
         if len(_SHARDED_SPARSE_GROW_CACHE) >= 8:
             _SHARDED_SPARSE_GROW_CACHE.pop(
@@ -432,18 +572,19 @@ def grow_tree_sparse_sharded(ds: SparseDataset, dev, sharded, mesh,
         # globals (bin layout) replicate; per-shard arrays split on dim 0;
         # static ints (segment counts) close over — they must not trace
         nf_static = dev["num_features"]
+        rs_static = dev.get("route_steps", 32)
+        _PER_SHARD = ("bin_of_nnz", "feat_of_nnz", "row_of_nnz_bs",
+                      "nnz_valid", "bin_start", "bin_end", "indptr_dev")
         glob = {k: v for k, v in dev.items()
-                if k not in ("row_of_nnz", "bin_of_nnz", "feat_of_nnz",
-                             "total_bins", "num_features")}
+                if k not in _PER_SHARD + ("total_bins", "num_features",
+                                          "route_steps")}
 
         sh_spec = P(DATA_AXIS)
         rep = P()
 
         @functools.partial(
             shard_map, mesh=mesh,
-            in_specs=({k: sh_spec for k in
-                       ("bin_of_nnz", "row_of_nnz", "feat_of_nnz",
-                        "nnz_valid")},
+            in_specs=({k: sh_spec for k in _PER_SHARD},
                       sh_spec, sh_spec, sh_spec,
                       {k: rep for k in glob}, rep, rep, rep, rep, rep),
             out_specs={"node_of_row": sh_spec, "feature": rep,
@@ -457,6 +598,7 @@ def grow_tree_sparse_sharded(ds: SparseDataset, dev, sharded, mesh,
             dev_l = dict(gl)
             dev_l["total_bins"] = tb
             dev_l["num_features"] = nf_static
+            dev_l["route_steps"] = rs_static
             for kk, v in shd.items():
                 dev_l[kk] = v[0]
             g, h, m = g[0], h[0], m[0]
@@ -477,8 +619,9 @@ def grow_tree_sparse_sharded(ds: SparseDataset, dev, sharded, mesh,
         _SHARDED_SPARSE_GROW_CACHE[key] = (jax.jit(go), glob)
     fn, glob = _SHARDED_SPARSE_GROW_CACHE[key]
     bm = bin_mask if has_bm else jnp.zeros(0, dtype=bool)
-    out = fn({k: sharded[k] for k in ("bin_of_nnz", "row_of_nnz",
-                                      "feat_of_nnz", "nnz_valid")},
+    out = fn({k: sharded[k] for k in
+              ("bin_of_nnz", "feat_of_nnz", "row_of_nnz_bs",
+               "nnz_valid", "bin_start", "bin_end", "indptr_dev")},
              grad_sh, hess_sh, row_mask_sh, glob, bm,
              np.float32(config.lambda_l1), np.float32(config.lambda_l2),
              np.float32(config.min_sum_hessian_in_leaf),
@@ -520,11 +663,13 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
         has_bm = bin_mask is not None
         tb = dev["total_bins"]
         nf = dev["num_features"]
+        rs = dev.get("route_steps", 32)
         # key carries every closed-over static; array data (the dev dict)
         # flows through jit arguments — no id()-keying, no pinned device
         # memory for evicted datasets (numBatches builds a fresh
         # SparseDataset per batch)
-        key = (M, config.min_data_in_leaf, config.max_depth, has_bm, tb, nf)
+        key = (M, config.min_data_in_leaf, config.max_depth, has_bm, tb,
+               nf, rs)
         if key not in _FUSED_SPARSE_GROW_CACHE:
             if len(_FUSED_SPARSE_GROW_CACHE) >= 16:
                 _FUSED_SPARSE_GROW_CACHE.pop(
@@ -535,6 +680,7 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
                 devd = dict(devd)
                 devd["total_bins"] = tb
                 devd["num_features"] = nf
+                devd["route_steps"] = rs
                 mask_f = mask.astype(jnp.float32)
                 root_tot = jnp.stack([jnp.sum(gk * mask_f),
                                       jnp.sum(hk * mask_f),
@@ -550,7 +696,8 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
             else jnp.ones(n, dtype=bool)
         bm = bin_mask if has_bm else jnp.zeros(0, dtype=bool)
         dev_arrays = {kk_: v for kk_, v in dev.items()
-                      if kk_ not in ("total_bins", "num_features")}
+                      if kk_ not in ("total_bins", "num_features",
+                                     "route_steps")}
         out = _FUSED_SPARSE_GROW_CACHE[key](
             dev_arrays, mask=mask, bm=bm, gk=grad, hk=hess,
             l1=np.float32(config.lambda_l1), l2=np.float32(config.lambda_l2),
@@ -583,8 +730,10 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
                               config.max_delta_step))
         return v
 
+    g_bs, h_bs = _entry_gh(dev, grad, hess)
+
     def node_hist(mask_rows, totals):
-        flat = _flat_histogram(dev, grad, hess, mask_rows)
+        flat = _flat_histogram(dev, g_bs, h_bs, mask_rows)
         return _zero_completed(dev, flat, totals)
 
     mask_f = ones.astype(jnp.float32)
@@ -729,13 +878,14 @@ def _grow_tree_sparse_body(dev, grad, hess, row_mask, node_of_row, root_tot,
     M = max_nodes
     num_leaves_target = (max_nodes + 1) // 2
     bm = bin_mask if has_bin_mask else None
+    g_bs, h_bs = _entry_gh(dev, grad, hess)  # tree-invariant entry gathers
 
     def best(hist):
         return _find_best_split_flat(dev, hist, l1, l2, msh,
                                      min_data_in_leaf, bm)
 
     def node_hist(mask_rows, totals):
-        flat = _flat_histogram(dev, grad, hess, mask_rows)
+        flat = _flat_histogram(dev, g_bs, h_bs, mask_rows)
         if psum_axis is not None:
             flat = jax.lax.psum(flat, psum_axis)
         return _zero_completed(dev, flat, totals)
@@ -756,7 +906,7 @@ def _grow_tree_sparse_body(dev, grad, hess, row_mask, node_of_row, root_tot,
         gain=jnp.zeros(M, f32),
         sums=jnp.zeros((M, 3), f32).at[0].set(root_tot),
         depth=jnp.zeros(M, jnp.int32),
-        hists=jnp.zeros((M, total_bins, 3), f32).at[0].set(root_hist),
+        hists=jnp.zeros((M, 3, total_bins), f32).at[0].set(root_hist),
         cand_gain=jnp.full(M, -jnp.inf, f32).at[0].set(
             jnp.where(root_ok, gain0, neg_inf)),
         cand_bin=jnp.zeros(M, jnp.int32).at[0].set(b0.astype(jnp.int32)),
@@ -884,8 +1034,6 @@ def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
     mgs = np.float32(config.min_gain_to_split)
     has_fm = feat_masks is not None
     shrink = np.float32(lr)
-    ones_mask = jnp.ones(n, dtype=bool)
-    bm_dummy = jnp.zeros(0, dtype=bool)
 
     # in-scan GOSS (mask-only): on-device top-|grad| threshold via count
     # bisection + Bernoulli "other" draw, amplified small-gradient rows —
@@ -901,73 +1049,115 @@ def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
         goss_keys = jax.random.split(
             jax.random.PRNGKey(params.seed or params.bagging_seed), iters)
 
-    def body(carry, xs):
-        score, comp = carry
-        row_mask = xs["rm"] if row_masks is not None else ones_mask
-        if has_fm:
-            bin_mask = jnp.take(xs["fm"], dev["feat_of_bin"])
-        else:
-            bin_mask = bm_dummy
-        g, h = grad_hess(objective, score, labels, w_dev, alpha)
-        if is_goss:
-            g_sel = jnp.abs(g) if g.ndim == 1 else jnp.sum(jnp.abs(g), axis=1)
-            gmax = jnp.max(g_sel).astype(jnp.float32)
+    # The scan is wrapped in a jit whose ARGUMENTS carry every large array
+    # (dev layout, labels, weights): a lax.scan traced outside jit embeds
+    # closed-over device arrays as program CONSTANTS — at 50M-nnz text
+    # scale that serialized ~600 MB of literals into the remote compile
+    # request (observed: multi-minute compiles, then HTTP 413).
+    # locals only below — closing over `dev` inside _run_chunk would pin
+    # the whole dataset's device arrays in the _SPARSE_SCAN_CACHE entry
+    nf_s = dev["num_features"]
+    rs_s = dev.get("route_steps", 32)
+    has_rm = row_masks is not None
 
-            def _bis(_, lohi):
-                lo, hi = lohi
-                mid = 0.5 * (lo + hi)
-                above = jnp.sum(g_sel >= mid, dtype=jnp.int32)
-                return (jnp.where(above >= top_n, mid, lo),
-                        jnp.where(above >= top_n, hi, mid))
+    def _run_chunk(devd, lab, wv, carry, xs_c, ipc):
+        devt = dict(devd)
+        devt["total_bins"] = tb
+        devt["num_features"] = nf_s
+        devt["route_steps"] = rs_s
 
-            lo, _ = jax.lax.fori_loop(
-                0, 20, _bis,
-                (jnp.float32(0.0), gmax * jnp.float32(1.000001) + 1e-30))
-            is_top = g_sel >= lo
-            count_top = jnp.sum(is_top, dtype=jnp.int32)
-            p_other = other_n / jnp.maximum(
-                (jnp.int32(n) - count_top).astype(jnp.float32), 1.0)
-            u = jax.random.uniform(xs["gk"], (n,))
-            row_mask = is_top | (~is_top & (u < p_other))
-            amp = jnp.where(is_top, jnp.float32(1.0), goss_amp)
-            g = g * (amp if g.ndim == 1 else amp[:, None])
-            h = h * (amp if h.ndim == 1 else amp[:, None])
-
-        mask_f = row_mask.astype(jnp.float32)
-        outs = []
-        for kk in range(k):
-            gk = g if g.ndim == 1 else g[:, kk]
-            hk = h if h.ndim == 1 else h[:, kk]
-            root_tot = jnp.stack([jnp.sum(gk * mask_f), jnp.sum(hk * mask_f),
-                                  jnp.sum(mask_f)])
-            out = _grow_tree_sparse_body(
-                dev, gk, hk, row_mask, jnp.zeros(n, jnp.int32), root_tot,
-                l1, l2, msh, mgs, bin_mask, total_bins=tb, max_nodes=M,
-                min_data_in_leaf=config.min_data_in_leaf,
-                max_depth=config.max_depth, has_bin_mask=has_fm)
-            rows = out.pop("node_of_row")
-            sums, feat = out["sums"], out["feature"]
-            g_thr = jnp.sign(sums[:, 0]) * jnp.maximum(
-                jnp.abs(sums[:, 0]) - l1, 0.0)
-            val = jnp.where(feat < 0, -g_thr / (sums[:, 1] + l2), 0.0)
-            if config.max_delta_step > 0:
-                val = jnp.clip(val, -config.max_delta_step,
-                               config.max_delta_step)
-            val = val.at[0].set(jnp.where(out["n_nodes"] > 1, val[0], 0.0))
-            upd = (val * shrink)[rows]
-            if k == 1:
-                y_ = upd + comp
-                t_ = score + y_
-                score, comp = t_, y_ - (t_ - score)
+        def body(carry, xs):
+            score, comp = carry
+            row_mask = (xs["rm"] if has_rm
+                        else jnp.ones(n, dtype=bool))
+            if has_fm:
+                bin_mask = jnp.take(xs["fm"], devt["feat_of_bin"])
             else:
-                s_col, c_col = score[:, kk], comp[:, kk]
-                y_ = upd + c_col
-                t_ = s_col + y_
-                score = score.at[:, kk].set(t_)
-                comp = comp.at[:, kk].set(y_ - (t_ - s_col))
-            outs.append(out)
-        stacked = jax.tree.map(lambda *a: jnp.stack(a), *outs)
-        return (score, comp), stacked
+                bin_mask = jnp.zeros(0, dtype=bool)
+            g, h = grad_hess(objective, score, lab, wv, alpha)
+            if is_goss:
+                g_sel = jnp.abs(g) if g.ndim == 1 \
+                    else jnp.sum(jnp.abs(g), axis=1)
+                gmax = jnp.max(g_sel).astype(jnp.float32)
+
+                def _bis(_, lohi):
+                    lo, hi = lohi
+                    mid = 0.5 * (lo + hi)
+                    above = jnp.sum(g_sel >= mid, dtype=jnp.int32)
+                    return (jnp.where(above >= top_n, mid, lo),
+                            jnp.where(above >= top_n, hi, mid))
+
+                lo, _ = jax.lax.fori_loop(
+                    0, 20, _bis,
+                    (jnp.float32(0.0), gmax * jnp.float32(1.000001) + 1e-30))
+                is_top = g_sel >= lo
+                count_top = jnp.sum(is_top, dtype=jnp.int32)
+                p_other = other_n / jnp.maximum(
+                    (jnp.int32(n) - count_top).astype(jnp.float32), 1.0)
+                u = jax.random.uniform(xs["gk"], (n,))
+                row_mask = is_top | (~is_top & (u < p_other))
+                amp = jnp.where(is_top, jnp.float32(1.0), goss_amp)
+                g = g * (amp if g.ndim == 1 else amp[:, None])
+                h = h * (amp if h.ndim == 1 else amp[:, None])
+
+            mask_f = row_mask.astype(jnp.float32)
+            outs = []
+            for kk in range(k):
+                gk = g if g.ndim == 1 else g[:, kk]
+                hk = h if h.ndim == 1 else h[:, kk]
+                root_tot = jnp.stack([jnp.sum(gk * mask_f),
+                                      jnp.sum(hk * mask_f),
+                                      jnp.sum(mask_f)])
+                out = _grow_tree_sparse_body(
+                    devt, gk, hk, row_mask, jnp.zeros(n, jnp.int32),
+                    root_tot, l1, l2, msh, mgs, bin_mask, total_bins=tb,
+                    max_nodes=M,
+                    min_data_in_leaf=config.min_data_in_leaf,
+                    max_depth=config.max_depth, has_bin_mask=has_fm)
+                rows = out.pop("node_of_row")
+                sums, feat = out["sums"], out["feature"]
+                g_thr = jnp.sign(sums[:, 0]) * jnp.maximum(
+                    jnp.abs(sums[:, 0]) - l1, 0.0)
+                val = jnp.where(feat < 0, -g_thr / (sums[:, 1] + l2), 0.0)
+                if config.max_delta_step > 0:
+                    val = jnp.clip(val, -config.max_delta_step,
+                                   config.max_delta_step)
+                val = val.at[0].set(
+                    jnp.where(out["n_nodes"] > 1, val[0], 0.0))
+                upd = (val * shrink)[rows]
+                if k == 1:
+                    y_ = upd + comp
+                    t_ = score + y_
+                    score, comp = t_, y_ - (t_ - score)
+                else:
+                    s_col, c_col = score[:, kk], comp[:, kk]
+                    y_ = upd + c_col
+                    t_ = s_col + y_
+                    score = score.at[:, kk].set(t_)
+                    comp = comp.at[:, kk].set(y_ - (t_ - s_col))
+                outs.append(out)
+            stacked = jax.tree.map(lambda *a: jnp.stack(a), *outs)
+            return (score, comp), stacked
+
+        return jax.lax.scan(body, carry, xs_c, length=ipc)
+
+    # the jit wrapper is cached on its STATIC closure values — a fresh
+    # jax.jit per train_sparse call recompiled the whole scan every fit
+    # (~250 s at 50M-nnz scale; observed as 'warm' fits slower than cold)
+    cache_key = (tb, dev["num_features"], dev.get("route_steps", 32), n,
+                 iters, k, M, objective, float(alpha), float(shrink),
+                 float(l1), float(l2), float(msh), float(mgs),
+                 config.min_data_in_leaf, config.max_depth,
+                 float(config.max_delta_step), is_goss, has_fm,
+                 row_masks is not None,
+                 (params.top_rate, params.other_rate,
+                  params.seed or params.bagging_seed) if is_goss else None)
+    if cache_key not in _SPARSE_SCAN_CACHE:
+        if len(_SPARSE_SCAN_CACHE) >= 8:
+            _SPARSE_SCAN_CACHE.pop(next(iter(_SPARSE_SCAN_CACHE)))
+        _SPARSE_SCAN_CACHE[cache_key] = jax.jit(
+            _run_chunk, static_argnames=("ipc",))
+    run_chunk = _SPARSE_SCAN_CACHE[cache_key]
 
     score0 = jnp.asarray(scores[:, 0] if k == 1 else scores,
                          dtype=jnp.float32)
@@ -990,6 +1180,8 @@ def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
                                 str(2 * 10**7)))
     ipc = max(1, min(iters, budget // max(per_iter, 1)))
 
+    dev_arrays = {k2: v for k2, v in dev.items()
+                  if k2 not in ("total_bins", "num_features", "route_steps")}
     carry = (score0, comp0)
     host_chunks = []
     done = 0
@@ -998,7 +1190,8 @@ def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
         if xs is not None:
             idx = np.minimum(np.arange(done, done + ipc), iters - 1)
             xs_c = {kk_: v[idx] for kk_, v in xs.items()}
-        carry, ys = jax.lax.scan(body, carry, xs_c, length=ipc)
+        carry, ys = run_chunk(dev_arrays, labels, w_dev, carry, xs_c,
+                              ipc=ipc)
         host_chunks.append(jax.device_get(ys))
         done += ipc
     host = jax.tree.map(lambda *c: np.concatenate(c, axis=0), *host_chunks) \
@@ -1139,7 +1332,7 @@ def train_sparse(params, ds: SparseDataset, y: np.ndarray,
             row_sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
             sharded = {kk_: jax.device_put(jnp.asarray(v), row_sharding)
                        for kk_, v in sh_host.items()
-                       if kk_ != "row_valid"}
+                       if kk_ not in ("row_valid", "row_of_nnz")}
             row_valid = sh_host["row_valid"]
 
             # one-time gather plan: [S, r_max] indices into a (sentinel-

@@ -276,10 +276,14 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
             t0 = time.perf_counter_ns()
             state, loss_sum = sharded(state, ds["indices"], ds["values"],
                                       ds["labels"], ds["weights"])
+            # the loss fetch is the sync point: on async-dispatch plugins
+            # (axon) the call above returns at enqueue, so timing it alone
+            # records ~0 — fetch BEFORE reading the clock
+            loss_host = float(loss_sum)
             dt = time.perf_counter_ns() - t0
             w_sum = float(dataset.weights.sum())
             stats.append(TrainingStats(0, n, dt, dt,
-                                       float(loss_sum) / max(w_sum, 1e-12),
+                                       loss_host / max(w_sum, 1e-12),
                                        w_sum))
     else:
         ds = {"indices": jnp.asarray(dataset.indices),
@@ -289,10 +293,13 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
         for _ in range(config.num_passes):
             t0 = time.perf_counter_ns()
             state, losses = run_pass(state, ds)
+            # fetch-as-sync (see sharded branch): time the execution, not
+            # the async enqueue
+            loss_host = float(jnp.sum(losses))
             dt = time.perf_counter_ns() - t0
             w_sum = float(dataset.weights.sum())
             stats.append(TrainingStats(0, n, dt, dt,
-                                       float(jnp.sum(losses)) / max(w_sum, 1e-12),
+                                       loss_host / max(w_sum, 1e-12),
                                        w_sum))
 
     if config.ftrl:

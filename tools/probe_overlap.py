@@ -1,0 +1,106 @@
+"""Decompose the paced-overlap residual (bench.py paced_overlap_ratio).
+
+The r4 bench measured dispatch enqueue at 0.2 ms — refuting the old
+'~90 ms dispatch floor' explanation for the 0.76 ratio. This probe varies
+one component at a time:
+
+  serial    : sleep(pace) + dispatch per item, one final sync (ratio ~1 =
+              the serial bound is real)
+  prefetch  : the bench's configuration (producer thread paced at compute)
+  pace0     : producer yields instantly -> device-bound floor (~0.5 of
+              the serial bound)
+  nosleep   : prefetcher but producer busy-waits instead of sleeping
+              (isolates time.sleep oversleep on a loaded 1-core host)
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.module import FunctionModel
+    from mmlspark_tpu.models.resnet import resnet
+    from mmlspark_tpu.parallel.batching import DevicePrefetcher
+
+    batch, size, inner = 2048, 224, 8
+    model = resnet(50, num_classes=1000, image_size=size)
+    params = jax.device_put(model.params)
+    rng = np.random.default_rng(0)
+    batches = [jax.device_put(rng.integers(0, 256,
+                                           size=(batch, size, size, 3),
+                                           dtype=np.uint8))
+               for _ in range(2)]
+
+    def fwd(params, x):
+        live = FunctionModel(model.module, params, model.input_shape,
+                             model.layer_names, model.name)
+        return jnp.sum(live.apply(x.astype(np.float32), tap="avgpool"))
+
+    compiled = jax.jit(fwd).lower(params, batches[0]).compile()
+    for _ in range(3):
+        float(compiled(params, batches[0]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(compiled(params, batches[0]))
+        best = min(best, time.perf_counter() - t0)
+    # NOTE: this per-call 'best' includes one fetch RTT; bench.py's on-device
+    # loop number is the cleaner pace, but for a ratio probe this is fine.
+    pace = best
+    k = 16
+    serial_bound = pace + best
+
+    def run(producer):
+        t0 = time.perf_counter()
+        outs = [compiled(params, x) for x in DevicePrefetcher(producer())]
+        total = outs[0]
+        for o in outs[1:]:
+            total = total + o
+        assert np.isfinite(float(total))
+        return (time.perf_counter() - t0) / k
+
+    def paced():
+        for i in range(k):
+            time.sleep(pace)
+            yield batches[i % 2]
+
+    def instant():
+        for i in range(k):
+            yield batches[i % 2]
+
+    def busy():
+        for i in range(k):
+            t_end = time.perf_counter() + pace
+            while time.perf_counter() < t_end:
+                pass
+            yield batches[i % 2]
+
+    # serial reference (no prefetcher)
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(k):
+        time.sleep(pace)
+        outs.append(compiled(params, batches[i % 2]))
+    total = outs[0]
+    for o in outs[1:]:
+        total = total + o
+    assert np.isfinite(float(total))
+    t_serial = (time.perf_counter() - t0) / k
+
+    res = {
+        "pace_ms": round(pace * 1e3, 1),
+        "serial_ratio": round(t_serial / serial_bound, 3),
+        "prefetch_ratio": round(run(paced) / serial_bound, 3),
+        "pace0_ratio": round(run(instant) / serial_bound, 3),
+        "busywait_ratio": round(run(busy) / serial_bound, 3),
+    }
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
