@@ -631,14 +631,15 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
     # row*feature regardless of masking, so a masked goss subset saves
     # nothing — the win comes from COMPACTING the tree's rows to the
     # selected ~(top_rate+other_rate) fraction at the root, shrinking every
-    # histogram/partition pass of the whole tree. Selection is on device:
-    # the top_n |grad| threshold comes from a 20-step count bisection
-    # (scatter-free — TPUs have no scatter hardware), "other" rows are a
-    # Bernoulli draw (rate other_n/remaining) amplified by (1-a)/b exactly
-    # like the host path, and the subset is gathered into a static-capacity
-    # buffer (overflow on gradient ties truncates in row order — LightGBM
-    # breaks ties by sort order, equally arbitrary). Full-row score routing
-    # is recovered by replaying the grown tree's splits over all N rows.
+    # histogram/partition pass of the whole tree. Selection is on device
+    # and EXACT-COUNT (_exact_topk_mask: bitwise bisection with index
+    # tie-break — LightGBM's sorted-GOSS count semantics): exactly top_n
+    # |grad| rows plus exactly other_n uniform draws among the rest,
+    # amplified by (1-a)/b like the host path, gathered into a
+    # static-capacity buffer that by construction can never overflow (the
+    # pre-r4 >=-threshold mask truncated in row order on gradient ties).
+    # Full-row score routing is recovered by replaying the grown tree's
+    # splits over all N rows.
     is_goss = params.boosting_type == "goss"
     if is_goss:
         n_real = int(pad_mask.sum()) if pad_mask is not None else n
@@ -686,29 +687,19 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
         fmask = xs["fm"] if has_fm else fm_dummy
         g, h = grad_hess(objective, score, labels, w_dev, alpha)
         if is_goss:
+            from .sparse import _exact_topk_mask
+
             g_sel = jnp.abs(g) if g.ndim == 1 else jnp.sum(jnp.abs(g), axis=1)
-            g_sel = jnp.where(ones_mask, g_sel, 0.0)
-            gmax = jnp.max(g_sel).astype(jnp.float32)
-
-            def _bis(_, lohi):
-                lo, hi = lohi
-                mid = 0.5 * (lo + hi)
-                above = jnp.sum((g_sel >= mid) & ones_mask, dtype=jnp.int32)
-                take = above >= top_n
-                return (jnp.where(take, mid, lo), jnp.where(take, hi, mid))
-
-            lo, _ = jax.lax.fori_loop(
-                0, 20, _bis,
-                (jnp.float32(0.0), gmax * jnp.float32(1.000001) + 1e-30))
-            is_top = ones_mask & (g_sel >= lo)
-            count_top = jnp.sum(is_top, dtype=jnp.int32)
-            p_other = other_n / jnp.maximum(
-                (jnp.int32(n_real) - count_top).astype(jnp.float32), 1.0)
+            not_real = ~ones_mask if pad_mask is not None else None
+            is_top = _exact_topk_mask(g_sel, top_n, n, exclude=not_real)
             u = jax.random.uniform(xs["gk"], (n,))
-            sel = is_top | (ones_mask & ~is_top & (u < p_other))
+            excl_other = (is_top if not_real is None
+                          else (is_top | not_real))
+            sel = is_top | _exact_topk_mask(u, other_n, n,
+                                            exclude=excl_other)
             amp = jnp.where(is_top, jnp.float32(1.0), goss_amp)
             idx = jnp.nonzero(sel, size=goss_cap, fill_value=0)[0]
-            sel_cnt = jnp.minimum(jnp.sum(sel, dtype=jnp.int32), goss_cap)
+            sel_cnt = jnp.sum(sel, dtype=jnp.int32)  # <= goss_cap always
             mask_it = jnp.arange(goss_cap, dtype=jnp.int32) < sel_cnt
             bins_it = jnp.take(bins_dev, idx, axis=1)
             amp_c = jnp.take(amp, idx)

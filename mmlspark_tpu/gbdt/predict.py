@@ -140,15 +140,29 @@ class DeviceEnsemble:
     def _build_gemm(self, trees):
         """Per-tree padded GEMM layout: comparison-sign x path-matrix
         forest evaluation (module docstring). Host-built once."""
+        import os
+
         T = self.num_trees
         i_max = max(max((int((t.feature >= 0).sum()) for t in trees),
                         default=1), 1)
         l_max = max(max((t.num_leaves for t in trees), default=1), 1)
+        if os.environ.get("MMLSPARK_TPU_NO_GEMM_PREDICT", "") not in ("", "0"):
+            self._gemm = None
+            return
         if T * i_max * l_max > 1 << 27:
             # imported forests can carry thousands of leaves per tree: the
             # [T, I, L] path matrix would be GBs — keep the gather kernel
             self._gemm = None
             return
+        # activations scale with rows x T x (I + L) — x_sel/s [N, T, I]
+        # (f32 + bf16) and z/reach [N, T, L] (f32 x2); shrink the row chunk
+        # so one dispatch stays ~<=1.5 GB (a 1000-tree x 255-leaf imported
+        # forest passes the path-matrix guard but costs ~3.6 MB per row)
+        per_row = T * (6 * i_max + 8 * l_max)
+        budget = 1.5e9
+        chunk = int(budget // max(per_row, 1))
+        self._gemm_row_chunk = max(256, min(self.GEMM_ROW_CHUNK,
+                                            (chunk // 256) * 256))
         feat = np.zeros((T, i_max), dtype=np.int32)
         thr = np.zeros((T, i_max), dtype=np.float32)
         dl = np.zeros((T, i_max), dtype=bool)
@@ -273,9 +287,10 @@ class DeviceEnsemble:
 
         return jax.jit(fwd)
 
-    # rows per GEMM dispatch: bounds the [N, T, I]/[N, T, L] activations
-    # (bf16/f32) — 64k rows x 100 trees x 31 nodes ~ 400 MB
+    # max rows per GEMM dispatch; _build_gemm shrinks it when T*(I+L) makes
+    # the [N, T, I]/[N, T, L] activations large (see per_row budget there)
     GEMM_ROW_CHUNK = 1 << 16
+    _gemm_row_chunk = GEMM_ROW_CHUNK
 
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
         """[N,F] float32 -> [N, num_class] summed tree outputs (device)."""
@@ -289,14 +304,15 @@ class DeviceEnsemble:
             if self._jitted is None:
                 self._jitted = self._compile_gemm()
             n = Xf.shape[0]
-            if n <= self.GEMM_ROW_CHUNK:
+            row_chunk = self._gemm_row_chunk
+            if n <= row_chunk:
                 return np.asarray(self._jitted(Xf), dtype=np.float64)
             outs = []
-            for r0 in range(0, n, self.GEMM_ROW_CHUNK):
-                xc = Xf[r0: r0 + self.GEMM_ROW_CHUNK]
+            for r0 in range(0, n, row_chunk):
+                xc = Xf[r0: r0 + row_chunk]
                 m = len(xc)
-                if m < self.GEMM_ROW_CHUNK:  # pad: one compiled shape
-                    xc = np.pad(xc, ((0, self.GEMM_ROW_CHUNK - m), (0, 0)),
+                if m < row_chunk:  # pad: one compiled shape
+                    xc = np.pad(xc, ((0, row_chunk - m), (0, 0)),
                                 constant_values=np.nan)
                 outs.append(np.asarray(self._jitted(xc),
                                        dtype=np.float64)[:m])

@@ -442,7 +442,7 @@ class TestFusedTreeGrower:
                                    b_host.raw_predict(X), rtol=1e-3, atol=1e-4)
 
     def test_scan_train_goss_matches_host_accuracy(self, monkeypatch):
-        """In-scan GOSS (on-device bisection threshold + compacted growth +
+        """In-scan GOSS (exact-count top-k selection + compacted growth +
         full-row split replay) is a different sampler from the host loop's
         argsort/rng.choice, so trees differ — but it must land at the same
         accuracy, and the full-gbdt accuracy must be within GOSS's expected
@@ -499,6 +499,22 @@ class TestFusedTreeGrower:
         b = B.train(params, X, y)
         acc = np.mean(np.argmax(b.raw_predict(X), axis=1) == y)
         assert acc > 0.8, acc
+
+    def test_scan_train_goss_exact_count_with_padding(self, monkeypatch):
+        """Selection is exactly top_n + other_n rows every iteration —
+        observable as every tree's root count — and CHUNK padding rows
+        (403 % 1024 != 0) are never selected (the exclude branch)."""
+        X, y = synth_binary(403, seed=8)
+        params = TrainParams(objective="binary", num_iterations=4,
+                             num_leaves=7, min_data_in_leaf=5,
+                             boosting_type="goss", top_rate=0.2,
+                             other_rate=0.1, seed=3)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SCAN_TRAIN", raising=False)
+        b = B.train(params, X, y)
+        expect = int(403 * 0.2) + int(403 * 0.1)
+        for group in b.trees:
+            assert int(group[0].count[0]) == expect
 
     def test_sharded_fused_matches_single_device(self, mesh8, monkeypatch):
         """Whole-tree growth under shard_map (psum'd histograms) must produce

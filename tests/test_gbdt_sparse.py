@@ -402,3 +402,200 @@ class TestSparseStages:
         p_host = predict_csr(b_host.trees, indptr, idx, vals, 1)[:, 0]
         p_scan = predict_csr(b_scan.trees, indptr, idx, vals, 1)[:, 0]
         np.testing.assert_allclose(p_scan, p_host, atol=2e-4)
+
+
+class TestSparseCompaction:
+    """Selected-row nnz compaction: the O(selected-nnz) histogram stream
+    behind sparse GOSS/bagging speedups (scan-path only; results must be
+    identical to the uncompacted stream)."""
+
+    def test_exact_topk_mask_counts_and_tiebreak(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.gbdt.sparse import _exact_topk_mask
+
+        rng = np.random.default_rng(3)
+        n = 257
+        # heavy ties: keys quantized to multiples of 1/8
+        key = np.round(rng.random(n).astype(np.float32) * 8) / 8
+        for k in (0, 1, 7, 63, 200, n, 400):
+            m = np.asarray(_exact_topk_mask(jnp.asarray(key), k, n))
+            assert m.sum() == min(k, n), k
+            order = np.lexsort((np.arange(n), -key))
+            expect = np.zeros(n, bool)
+            expect[order[: min(k, n)]] = True
+            np.testing.assert_array_equal(m, expect, err_msg=f"k={k}")
+
+    def test_exact_topk_mask_exclude(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.gbdt.sparse import _exact_topk_mask
+
+        rng = np.random.default_rng(5)
+        n = 128
+        key = rng.random(n).astype(np.float32)
+        excl = np.zeros(n, bool)
+        excl[::2] = True  # half ineligible
+        for k in (1, 10, 64, 100):
+            m = np.asarray(_exact_topk_mask(jnp.asarray(key), k, n,
+                                            exclude=jnp.asarray(excl)))
+            assert not (m & excl).any()
+            assert m.sum() == min(k, 64), k
+            # selected are the top-k eligible keys
+            elig = np.where(~excl)[0]
+            top = elig[np.argsort(-key[elig], kind="stable")[: min(k, 64)]]
+            assert set(np.where(m)[0]) == set(top)
+
+    def test_exact_topk_all_equal_keys(self):
+        """Constant gradients (the tie catastrophe for >=-threshold masks):
+        exactly k lowest-index rows win."""
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.gbdt.sparse import _exact_topk_mask
+
+        key = np.full(50, 0.25, np.float32)
+        m = np.asarray(_exact_topk_mask(jnp.asarray(key), 20, 50))
+        np.testing.assert_array_equal(np.where(m)[0], np.arange(20))
+
+    def _fit_pair(self, monkeypatch, params, n=400, f=12, seed=21):
+        X, y = synth_sparse(n, f, density=0.35, seed=seed)
+        indptr, idx, vals = dense_to_csr(X)
+        ds = SparseDataset.from_csr(indptr, idx, vals, X.shape[1])
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        monkeypatch.setenv("MMLSPARK_TPU_NO_SPARSE_COMPACT", "1")
+        b_plain = train_sparse(params, ds, y)
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SPARSE_COMPACT")
+        monkeypatch.setenv("MMLSPARK_TPU_SPARSE_COMPACT", "1")
+        from mmlspark_tpu.gbdt.sparse import _SPARSE_SCAN_CACHE
+
+        _SPARSE_SCAN_CACHE.clear()  # cache key includes cap; be explicit
+        b_comp = train_sparse(params, ds, y)
+        monkeypatch.delenv("MMLSPARK_TPU_SPARSE_COMPACT")
+        p0 = predict_csr(b_plain.trees, indptr, idx, vals, 1)[:, 0]
+        p1 = predict_csr(b_comp.trees, indptr, idx, vals, 1)[:, 0]
+        return b_plain, b_comp, p0, p1
+
+    def test_compaction_matches_uncompacted_goss_one_iter(self, monkeypatch):
+        """One iteration: identical selection, identical tree (compaction is
+        an exact reformulation of the masked histogram)."""
+        params = TrainParams(objective="binary", boosting_type="goss",
+                             num_iterations=1, num_leaves=7,
+                             min_data_in_leaf=5, top_rate=0.25,
+                             other_rate=0.15, seed=7)
+        b0, b1, p0, p1 = self._fit_pair(monkeypatch, params)
+        t0, t1 = b0.trees[0][0], b1.trees[0][0]
+        np.testing.assert_array_equal(t0.feature, t1.feature)
+        np.testing.assert_array_equal(t0.threshold_bin, t1.threshold_bin)
+        np.testing.assert_array_equal(t0.count, t1.count)
+        np.testing.assert_allclose(p1, p0, atol=1e-5)
+
+    def test_compaction_matches_uncompacted_goss_multi_iter(self, monkeypatch):
+        """Across iterations GOSS selection is DISCONTINUOUS in the scores
+        (exact top-k at the |grad| boundary), so last-ulp histogram
+        reassociation can swap boundary rows and the runs legitimately
+        drift — the claim is equal model quality, not bit-equal trees."""
+        params = TrainParams(objective="binary", boosting_type="goss",
+                             num_iterations=10, num_leaves=7,
+                             min_data_in_leaf=5, top_rate=0.25,
+                             other_rate=0.15, seed=7)
+        b0, b1, p0, p1 = self._fit_pair(monkeypatch, params, n=800)
+        assert len(b0.trees) == len(b1.trees)
+        X, y = synth_sparse(800, 12, density=0.35, seed=21)
+        acc0 = ((p0 + b0.base_score[0] > 0) == y).mean()
+        acc1 = ((p1 + b1.base_score[0] > 0) == y).mean()
+        assert abs(acc0 - acc1) <= 0.02, (acc0, acc1)
+
+    def test_compacted_histogram_exact(self):
+        """The refactored primitive itself: a compacted-stream flat
+        histogram equals the full-stream masked histogram — count channel
+        EXACTLY (int prefix path), grad/hess to f32 reassociation ulp —
+        and remapped bin boundaries cover every selected entry."""
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.gbdt.sparse import (_device_arrays,
+                                              _exact_topk_mask,
+                                              _flat_histogram)
+
+        X, y = synth_sparse(400, 12, density=0.35, seed=21)
+        indptr, idx, vals = dense_to_csr(X)
+        ds = SparseDataset.from_csr(indptr, idx, vals, X.shape[1])
+        dev = _device_arrays(ds)
+        n = ds.num_rows
+        rng = np.random.default_rng(0)
+        g = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+        h = jnp.asarray(np.abs(rng.standard_normal(n)).astype(np.float32))
+        row_mask = _exact_topk_mask(jnp.abs(g), 100, n)
+
+        rbs = dev["row_of_nnz_bs"]
+        hist_full = _flat_histogram(dev, jnp.take(g, rbs), jnp.take(h, rbs),
+                                    row_mask)
+
+        row_nnz = np.diff(ds.indptr)
+        cap = int(np.sort(row_nnz)[::-1][:100].sum())
+        esel = jnp.take(row_mask, rbs)
+        cnt = jnp.cumsum(esel.astype(jnp.int32))
+        iota = jnp.arange(rbs.shape[0], dtype=jnp.int32)
+        sel_idx = jnp.where(esel, cnt - 1, cap + iota)
+        rows_cmp = jnp.zeros(cap, jnp.int32).at[sel_idx].set(
+            rbs, mode="drop", unique_indices=True)
+        cnt0 = jnp.concatenate([jnp.zeros(1, jnp.int32), cnt])
+        devc = dict(dev, row_of_nnz_bs=rows_cmp,
+                    bin_start=jnp.take(cnt0, dev["bin_start"]),
+                    bin_end=jnp.take(cnt0, dev["bin_end"]))
+        hist_cmp = _flat_histogram(devc, jnp.take(g, rows_cmp),
+                                   jnp.take(h, rows_cmp), row_mask)
+
+        total_sel = int(cnt[-1])
+        assert total_sel <= cap
+        assert int(jnp.max(devc["bin_end"])) <= total_sel
+        np.testing.assert_array_equal(np.asarray(hist_cmp[2]),
+                                      np.asarray(hist_full[2]))
+        np.testing.assert_allclose(np.asarray(hist_cmp[:2]),
+                                   np.asarray(hist_full[:2]), atol=1e-4)
+
+    def test_compaction_matches_uncompacted_bagging(self, monkeypatch):
+        """Bit-parity of whole fits is NOT claimed — compacted prefix sums
+        reassociate f32 adds, and one near-tie argmax flip re-routes every
+        later split (same chaos as any reduction-order change); the claim
+        is unchanged model quality on identical host-precomputed masks."""
+        params = TrainParams(objective="binary", num_iterations=10,
+                             num_leaves=7, min_data_in_leaf=5,
+                             bagging_fraction=0.6, bagging_freq=1,
+                             bagging_seed=11)
+        b0, b1, p0, p1 = self._fit_pair(monkeypatch, params, n=800)
+        assert len(b0.trees) == len(b1.trees)
+        X, y = synth_sparse(800, 12, density=0.35, seed=21)
+        acc0 = ((p0 + b0.base_score[0] > 0) == y).mean()
+        acc1 = ((p1 + b1.base_score[0] > 0) == y).mean()
+        assert abs(acc0 - acc1) <= 0.02, (acc0, acc1)
+
+    def test_compact_cap_bounds_selection(self):
+        """The host cap is a true upper bound on any iteration's selected
+        nnz for GOSS (k_sel largest rows) and exact for host masks."""
+        from mmlspark_tpu.gbdt.sparse import _sparse_compact_cap
+
+        X, y = synth_sparse(300, 10, density=0.4, seed=9)
+        indptr, idx, vals = dense_to_csr(X)
+        ds = SparseDataset.from_csr(indptr, idx, vals, X.shape[1])
+        row_nnz = np.diff(ds.indptr)
+        params = TrainParams(objective="binary", boosting_type="goss",
+                             top_rate=0.2, other_rate=0.1)
+        import os
+
+        os.environ["MMLSPARK_TPU_SPARSE_COMPACT"] = "1"
+        try:
+            cap = _sparse_compact_cap(params, ds, None)
+            k_sel = int(300 * 0.2) + int(300 * 0.1)
+            rng = np.random.default_rng(0)
+            for _ in range(20):
+                rows = rng.choice(300, size=k_sel, replace=False)
+                assert row_nnz[rows].sum() <= cap
+            # host masks: cap equals the max selected nnz
+            masks = rng.random((5, 300)) < 0.5
+            params2 = TrainParams(objective="binary",
+                                  bagging_fraction=0.5, bagging_freq=1)
+            cap2 = _sparse_compact_cap(params2, ds, masks)
+            assert cap2 == (masks.astype(np.int64)
+                            @ row_nnz.astype(np.int64)).max()
+        finally:
+            del os.environ["MMLSPARK_TPU_SPARSE_COMPACT"]

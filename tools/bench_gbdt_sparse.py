@@ -89,8 +89,10 @@ def main():
     acc = float(((raw > 0) == y).mean())
 
     # GOSS on the same data (the sampling regime that matters most at
-    # text scale — currently mask-only in-scan selection, no nnz
-    # compaction; recorded so the follow-up has a baseline)
+    # text scale): exact top-k in-scan selection + selected-row nnz
+    # compaction — every per-split stream cost scales with selected nnz
+    # (~30%) instead of total nnz (the round-3 'GOSS shows no speedup'
+    # finding, closed)
     import dataclasses
 
     gp = dataclasses.replace(params, boosting_type="goss", top_rate=0.2,
