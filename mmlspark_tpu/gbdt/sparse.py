@@ -443,6 +443,37 @@ def _find_best_split_flat(dev, hist, lambda_l1, lambda_l2, min_sum_hessian,
             jnp.stack([GR[b], HR[b], CR[b]]))
 
 
+def _row_feature_search(dev, lo0, hi0, f):
+    """Vectorized lower-bound search for each row's entry of feature ``f``
+    (scalar or per-row array) inside the row's feature-sorted CSR slice
+    [lo0, hi0) — pure gathers, no scatter. Per-row ranges are at most
+    max_row_nnz wide, so ceil(log2(max_row_nnz)) steps suffice
+    (dev["route_steps"]) — at avg-50-nnz text data that is ~9 gathers
+    instead of 32 (each step is a random gather from the 200 MB entry
+    stream, the dominant routing cost at 50M nnz). Shared by per-split
+    routing (_route_rows) and the lazy full-N traversal
+    (_assign_leaves_all_rows) so the two can never desynchronize."""
+    import jax
+    import jax.numpy as jnp
+
+    feats = dev["feat_of_nnz"]
+    nnz = feats.shape[0]
+
+    def step(_, lohi):
+        lo, hi = lohi
+        cont = lo < hi
+        mid = (lo + hi) >> 1
+        fm = jnp.take(feats, jnp.clip(mid, 0, nnz - 1))
+        go_hi = fm < f
+        new_lo = jnp.where(go_hi, mid + 1, lo)
+        new_hi = jnp.where(go_hi, hi, mid)
+        return (jnp.where(cont, new_lo, lo), jnp.where(cont, new_hi, hi))
+
+    n_steps = dev.get("route_steps", 32)
+    lo, _ = jax.lax.fori_loop(0, n_steps, step, (lo0, hi0))
+    return lo
+
+
 def _route_rows(dev, node_of_row, node_id, f, t_local, lid, rid):
     """Send the node's rows left iff value-bin <= t_local; absent entries
     carry the feature's zero bin.
@@ -462,31 +493,70 @@ def _route_rows(dev, node_of_row, node_id, f, t_local, lid, rid):
 
     feats = dev["feat_of_nnz"]
     nnz = feats.shape[0]
-    indptr = dev["indptr_dev"]
-    lo0 = indptr[:-1]
-    hi0 = indptr[1:]
+    if "route_lo" in dev:
+        # lazy/compacted mode: the routed "rows" are the SELECTED rows;
+        # their CSR slices into the global entry stream were gathered at
+        # compaction time (slices need not be contiguous across rows)
+        lo0 = dev["route_lo"]
+        hi0 = dev["route_hi"]
+    else:
+        indptr = dev["indptr_dev"]
+        lo0 = indptr[:-1]
+        hi0 = indptr[1:]
 
-    def step(_, lohi):
-        lo, hi = lohi
-        cont = lo < hi
-        mid = (lo + hi) >> 1
-        fm = jnp.take(feats, jnp.clip(mid, 0, nnz - 1))
-        go_hi = fm < f
-        new_lo = jnp.where(go_hi, mid + 1, lo)
-        new_hi = jnp.where(go_hi, hi, mid)
-        return (jnp.where(cont, new_lo, lo), jnp.where(cont, new_hi, hi))
-
-    # per-row search ranges are at most max_row_nnz wide, so
-    # ceil(log2(max_row_nnz)) steps suffice — at avg-50-nnz text data that
-    # is ~9 gathers instead of 32 (each step is a random [N] gather from
-    # the 200 MB entry stream, the dominant routing cost at 50M nnz)
-    n_steps = dev.get("route_steps", 32)
-    lo, _ = jax.lax.fori_loop(0, n_steps, step, (lo0, hi0))
+    lo = _row_feature_search(dev, lo0, hi0, f)
     pos = jnp.clip(lo, 0, nnz - 1)
     has = (lo < hi0) & (jnp.take(feats, pos) == f)
     local_bin = jnp.take(dev["bin_of_nnz"], pos) - dev["feat_offset_dev"][f]
     target = jnp.where(local_bin <= t_local, lid, rid)
     return jnp.where(in_node & has, target, out)
+
+
+def _assign_leaves_all_rows(dev, tree_out, n: int):
+    """Route ALL n rows through a finished tree by level-synchronous
+    traversal: each level advances every row one node via ONE vectorized
+    per-row binary search (the row's entry of its CURRENT node's feature —
+    the search target varies per row, which the lower-bound gathers handle
+    unchanged). Cost is depth x one routing pass instead of
+    (num_leaves-1) x one routing pass — the lazy-routing complement: with
+    per-split routing restricted to the selected rows, this single
+    traversal recovers the full node assignment the score update needs.
+    Absent features carry the zero bin, exactly like _route_rows."""
+    import jax
+    import jax.numpy as jnp
+
+    feat = tree_out["feature"]
+    tb_l = tree_out["threshold_bin"]
+    li = tree_out["left"]
+    ri = tree_out["right"]
+    feats = dev["feat_of_nnz"]
+    bins = dev["bin_of_nnz"]
+    fo = dev["feat_offset_dev"]
+    zl = dev["zero_local_dev"]
+    nnz = feats.shape[0]
+    indptr = dev["indptr_dev"]
+    lo_all, hi_all = indptr[:-1], indptr[1:]
+
+    def cond(state):
+        pos, it = state
+        return (it < feat.shape[0]) & jnp.any(jnp.take(feat, pos) >= 0)
+
+    def body(state):
+        pos, it = state
+        f = jnp.take(feat, pos)                  # [n]; -1 at leaves
+        t_loc = jnp.take(tb_l, pos)
+        f_safe = jnp.maximum(f, 0)
+        lo = _row_feature_search(dev, lo_all, hi_all, f_safe)
+        p = jnp.clip(lo, 0, nnz - 1)
+        has = (lo < hi_all) & (jnp.take(feats, p) == f_safe)
+        lb = jnp.take(bins, p) - jnp.take(fo, f_safe)
+        lb_eff = jnp.where(has, lb, jnp.take(zl, f_safe))
+        nxt = jnp.where(lb_eff <= t_loc, jnp.take(li, pos), jnp.take(ri, pos))
+        return jnp.where(f >= 0, nxt, pos), it + 1
+
+    pos, _ = jax.lax.while_loop(
+        cond, body, (jnp.zeros(n, jnp.int32), jnp.int32(0)))
+    return pos
 
 
 def _bin_sorted_layout(bin_of_nnz: np.ndarray, total_bins: int):
@@ -1111,9 +1181,9 @@ def _scan_sparse_ok(params, valid, log) -> bool:
     return True
 
 
-def _sparse_compact_cap(params, ds, row_masks) -> int:
-    """Static nnz capacity for in-scan selected-row entry compaction, or 0
-    to disable it.
+def _sparse_compact_cap(params, ds, row_masks) -> tuple:
+    """Static capacities ``(cap, sel_cap)`` for in-scan selected-row entry
+    compaction — ``(0, 0)`` disables it.
 
     When a row subset is selected per iteration (GOSS / bagging / rf), the
     histogram stream is compacted to the selected rows' entries, shrinking
@@ -1128,6 +1198,16 @@ def _sparse_compact_cap(params, ds, row_masks) -> int:
     - host-precomputed bagging masks: the per-iteration selected nnz is
       known outright — take the max.
 
+    Returns ``(cap, sel_cap)`` — the nnz capacity and the selected-ROW
+    capacity. sel_cap > 0 additionally enables LAZY ROUTING: per-split
+    routing runs only over the selected rows (the tree's rows), and the
+    full-N node assignment the score update needs is recovered once per
+    tree by level-synchronous traversal (_assign_leaves_all_rows) —
+    depth routing passes instead of num_leaves-1 (at 50M nnz routing is
+    ~0.3 s/split over all 1M rows, the largest per-split cost after
+    compaction). MMLSPARK_TPU_NO_SPARSE_LAZY_ROUTE=1 keeps compaction but
+    routes eagerly.
+
     Gated to TPU at real scale (compaction costs one drop-scatter +
     cumsum per iteration, ~0.85 s at 50M nnz — profitable only when the
     ~30 splits/tree each save a third of their stream costs);
@@ -1139,36 +1219,57 @@ def _sparse_compact_cap(params, ds, row_masks) -> int:
     import jax
 
     if os.environ.get("MMLSPARK_TPU_NO_SPARSE_COMPACT", "") not in ("", "0"):
-        return 0
+        return 0, 0
     n = ds.num_rows
     nnz = int(ds.indptr[-1])
     row_nnz = np.diff(ds.indptr)
     if params.boosting_type == "goss":
         k_sel = int(n * params.top_rate) + int(n * params.other_rate)
         if k_sel <= 0 or k_sel >= n:
-            return 0
+            return 0, 0
         cap = int(np.partition(row_nnz, n - k_sel)[n - k_sel:].sum())
     elif row_masks is not None:
+        k_sel = int(row_masks.sum(axis=1).max())
         cap = int((row_masks.astype(np.int64) @ row_nnz.astype(np.int64))
                   .max())
     else:
-        return 0
+        return 0, 0
     cap = max(cap, 1)
+    sel_cap = max(int(k_sel), 1)
+    if os.environ.get("MMLSPARK_TPU_NO_SPARSE_LAZY_ROUTE",
+                      "") not in ("", "0"):
+        sel_cap = 0
     if os.environ.get("MMLSPARK_TPU_SPARSE_COMPACT", "") not in ("", "0"):
-        return cap
+        # forced mode (tests) bypasses profitability gates, not correctness
+        return cap, sel_cap
+    # lazy-routing profitability: per tree, eager routing costs
+    # (num_leaves-1) full-N passes; lazy costs (num_leaves-1) passes over
+    # the selected fraction PLUS max_depth full-N traversal levels.
+    # Leaf-wise trees on zipf-ish text data grow DEEP (measured: lazy
+    # LOST ~50% at 200k x 31 leaves unbounded — depth ~ num_leaves), so
+    # lazy only turns on when max_depth bounds the traversal and the
+    # model says it wins with margin.
+    splits = max(params.num_leaves - 1, 1)
+    if params.max_depth <= 0:
+        sel_cap = 0
+    else:
+        sel_frac = sel_cap / max(n, 1)
+        if sel_frac * splits + params.max_depth >= 0.9 * splits:
+            sel_cap = 0
     try:
         if jax.default_backend() != "tpu":
-            return 0
+            return 0, 0
     except Exception:
-        return 0
+        return 0, 0
     if nnz < 2_000_000 or cap > int(0.75 * nnz):
-        return 0
-    return cap
+        return 0, 0
+    return cap, sel_cap
 
 
 def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
                        dev, labels, w_dev, scores, k: int, lr: float,
-                       row_masks, feat_masks, compact_cap: int = 0) -> None:
+                       row_masks, feat_masks, compact_cap: int = 0,
+                       sel_cap: int = 0) -> None:
     """ALL boosting iterations in one chunked ``lax.scan`` dispatch over the
     flat sparse bin space — no per-tree host round trips (the sparse
     analogue of booster._train_scan; chunking bounds device-runtime per
@@ -1243,6 +1344,8 @@ def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
                 h = h * (amp if h.ndim == 1 else amp[:, None])
 
             devc = devt
+            lazy = bool(compact_cap and sel_cap)
+            sel_rows = sel_valid = None
             if compact_cap:
                 # selected-row entry compaction: the bin-sorted stream keeps
                 # its order under compaction, so the prefix-sum histogram
@@ -1265,10 +1368,32 @@ def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
                 rows_cmp = jnp.zeros(compact_cap, jnp.int32).at[idx].set(
                     rbs, mode="drop", unique_indices=True)
                 cnt0 = jnp.concatenate([jnp.zeros(1, jnp.int32), cnt])
-                devc = dict(devt,
-                            row_of_nnz_bs=rows_cmp,
-                            bin_start=jnp.take(cnt0, devt["bin_start"]),
-                            bin_end=jnp.take(cnt0, devt["bin_end"]))
+                bstart_c = jnp.take(cnt0, devt["bin_start"])
+                bend_c = jnp.take(cnt0, devt["bin_end"])
+                if lazy:
+                    # lazy routing: re-parameterize the grower so its
+                    # "rows" ARE the selected rows — compacted entries
+                    # reference selected-row POSITIONS, per-split routing
+                    # searches only the selected rows' CSR slices
+                    # (route_lo/route_hi), and the full-N assignment is
+                    # recovered once per tree by level traversal below
+                    cnt_rows = jnp.cumsum(row_mask.astype(jnp.int32))
+                    rank_of_row = cnt_rows - 1       # [N]; valid where sel
+                    sel_rows = jnp.nonzero(row_mask, size=sel_cap,
+                                           fill_value=0)[0]
+                    sel_valid = (jnp.arange(sel_cap, dtype=jnp.int32)
+                                 < cnt_rows[-1])
+                    selpos = jnp.take(rank_of_row, rows_cmp)   # [cap]
+                    ip = devt["indptr_dev"]
+                    devc = dict(devt,
+                                row_of_nnz_bs=selpos,
+                                bin_start=bstart_c, bin_end=bend_c,
+                                route_lo=jnp.take(ip, sel_rows),
+                                route_hi=jnp.take(ip, sel_rows + 1))
+                else:
+                    devc = dict(devt,
+                                row_of_nnz_bs=rows_cmp,
+                                bin_start=bstart_c, bin_end=bend_c)
 
             mask_f = row_mask.astype(jnp.float32)
             outs = []
@@ -1278,13 +1403,24 @@ def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
                 root_tot = jnp.stack([jnp.sum(gk * mask_f),
                                       jnp.sum(hk * mask_f),
                                       jnp.sum(mask_f)])
-                out = _grow_tree_sparse_body(
-                    devc, gk, hk, row_mask, jnp.zeros(n, jnp.int32),
-                    root_tot, l1, l2, msh, mgs, bin_mask, total_bins=tb,
-                    max_nodes=M,
-                    min_data_in_leaf=config.min_data_in_leaf,
-                    max_depth=config.max_depth, has_bin_mask=has_fm)
-                rows = out.pop("node_of_row")
+                if lazy:
+                    out = _grow_tree_sparse_body(
+                        devc, jnp.take(gk, sel_rows), jnp.take(hk, sel_rows),
+                        sel_valid, jnp.zeros(sel_cap, jnp.int32),
+                        root_tot, l1, l2, msh, mgs, bin_mask, total_bins=tb,
+                        max_nodes=M,
+                        min_data_in_leaf=config.min_data_in_leaf,
+                        max_depth=config.max_depth, has_bin_mask=has_fm)
+                    out.pop("node_of_row")   # selected-row ids only
+                    rows = _assign_leaves_all_rows(devt, out, n)
+                else:
+                    out = _grow_tree_sparse_body(
+                        devc, gk, hk, row_mask, jnp.zeros(n, jnp.int32),
+                        root_tot, l1, l2, msh, mgs, bin_mask, total_bins=tb,
+                        max_nodes=M,
+                        min_data_in_leaf=config.min_data_in_leaf,
+                        max_depth=config.max_depth, has_bin_mask=has_fm)
+                    rows = out.pop("node_of_row")
                 sums, feat = out["sums"], out["feature"]
                 g_thr = jnp.sign(sums[:, 0]) * jnp.maximum(
                     jnp.abs(sums[:, 0]) - l1, 0.0)
@@ -1319,7 +1455,7 @@ def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
                  float(l1), float(l2), float(msh), float(mgs),
                  config.min_data_in_leaf, config.max_depth,
                  float(config.max_delta_step), is_goss, has_fm,
-                 compact_cap, row_masks is not None,
+                 compact_cap, sel_cap, row_masks is not None,
                  (params.top_rate, params.other_rate,
                   params.seed or params.bagging_seed) if is_goss else None)
     if cache_key not in _SPARSE_SCAN_CACHE:
@@ -1534,10 +1670,10 @@ def train_sparse(params, ds: SparseDataset, y: np.ndarray,
             from ..core.runtime import ensure_compile_cache
 
             ensure_compile_cache()
+            ccap, scap = _sparse_compact_cap(params, ds, row_masks)
             _train_scan_sparse(params, config, booster, ds, dev, labels,
                                w_dev, scores, k, lr, row_masks, feat_masks,
-                               compact_cap=_sparse_compact_cap(
-                                   params, ds, row_masks))
+                               compact_cap=ccap, sel_cap=scap)
             if is_rf and booster.trees:
                 inv = 1.0 / len(booster.trees)
                 for gtrees in booster.trees:

@@ -584,18 +584,51 @@ class TestSparseCompaction:
 
         os.environ["MMLSPARK_TPU_SPARSE_COMPACT"] = "1"
         try:
-            cap = _sparse_compact_cap(params, ds, None)
+            cap, scap = _sparse_compact_cap(params, ds, None)
             k_sel = int(300 * 0.2) + int(300 * 0.1)
+            assert scap == k_sel
             rng = np.random.default_rng(0)
             for _ in range(20):
                 rows = rng.choice(300, size=k_sel, replace=False)
                 assert row_nnz[rows].sum() <= cap
-            # host masks: cap equals the max selected nnz
+            # host masks: caps equal the max selected nnz / row count
             masks = rng.random((5, 300)) < 0.5
             params2 = TrainParams(objective="binary",
                                   bagging_fraction=0.5, bagging_freq=1)
-            cap2 = _sparse_compact_cap(params2, ds, masks)
+            cap2, scap2 = _sparse_compact_cap(params2, ds, masks)
             assert cap2 == (masks.astype(np.int64)
                             @ row_nnz.astype(np.int64)).max()
+            assert scap2 == masks.sum(axis=1).max()
         finally:
             del os.environ["MMLSPARK_TPU_SPARSE_COMPACT"]
+
+    def test_assign_leaves_matches_eager_routing(self):
+        """The lazy-routing traversal (_assign_leaves_all_rows) lands every
+        row on the same node as per-split eager routing for a real grown
+        tree."""
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.gbdt.booster import grad_hess
+        from mmlspark_tpu.gbdt.sparse import (_assign_leaves_all_rows,
+                                              _device_arrays,
+                                              _grow_tree_sparse_body)
+
+        X, y = synth_sparse(500, 12, density=0.35, seed=33)
+        indptr, idx, vals = dense_to_csr(X)
+        ds = SparseDataset.from_csr(indptr, idx, vals, X.shape[1])
+        devt = _device_arrays(ds)
+        tb = devt["total_bins"]
+        n = ds.num_rows
+        lab = jnp.asarray(y, jnp.float32)
+        g, h = grad_hess("binary", jnp.zeros(n, jnp.float32), lab, None, 0.9)
+        mask = jnp.ones(n, dtype=bool)
+        root_tot = jnp.stack([jnp.sum(g), jnp.sum(h),
+                              jnp.float32(n)])
+        out = _grow_tree_sparse_body(
+            devt, g, h, mask, jnp.zeros(n, jnp.int32), root_tot,
+            np.float32(0), np.float32(0), np.float32(1e-3), np.float32(0),
+            jnp.zeros(0, bool), total_bins=tb, max_nodes=13,
+            min_data_in_leaf=5, max_depth=-1, has_bin_mask=False)
+        eager = np.asarray(out["node_of_row"])
+        lazy = np.asarray(_assign_leaves_all_rows(devt, out, n))
+        np.testing.assert_array_equal(lazy, eager)
