@@ -653,6 +653,29 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
         goss_keys = jax.random.split(
             jax.random.PRNGKey(params.seed or params.bagging_seed), iters)
 
+    # ----- bagging/rf row compaction: the same economics as GOSS — the
+    # histogram kernel streams ~2 MXU cycles per row*feature regardless of
+    # masking, so when host-precomputed bagging masks select a fraction of
+    # rows, gathering them to the buffer front shrinks every histogram and
+    # partition pass of the whole tree. The capacity is exact on the host
+    # (masks are precomputed); full-row score routing is recovered by the
+    # same split replay GOSS uses. Gated to a selected fraction <= 0.625
+    # (above that, the per-iteration gather + replay eats the stream
+    # savings) at real scale; MMLSPARK_TPU_DENSE_BAG_COMPACT=1 forces
+    # (tests), MMLSPARK_TPU_NO_DENSE_BAG_COMPACT=1 kills.
+    bag_cap = 0
+    if (row_masks is not None and not is_goss
+            and os.environ.get("MMLSPARK_TPU_NO_DENSE_BAG_COMPACT",
+                               "") in ("", "0")):
+        max_cnt = int(row_masks.sum(axis=1).max())
+        forced = os.environ.get("MMLSPARK_TPU_DENSE_BAG_COMPACT",
+                                "") not in ("", "0")
+        nr = int(pad_mask.sum()) if pad_mask is not None else n
+        frac = max_cnt / max(nr, 1)
+        if forced or (jax.default_backend() == "tpu"
+                      and nr >= 100_000 and frac <= 0.625):
+            bag_cap = min(n, -(-max(max_cnt, 1) // 512) * 512)
+
     from . import histogram as H
 
     def _route_full(tree_out):
@@ -704,6 +727,12 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
             bins_it = jnp.take(bins_dev, idx, axis=1)
             amp_c = jnp.take(amp, idx)
             nor0 = jnp.zeros(goss_cap, jnp.int32)
+        elif bag_cap:
+            idx = jnp.nonzero(row_mask, size=bag_cap, fill_value=0)[0]
+            sel_cnt = jnp.sum(row_mask, dtype=jnp.int32)  # <= bag_cap
+            mask_it = jnp.arange(bag_cap, dtype=jnp.int32) < sel_cnt
+            bins_it = jnp.take(bins_dev, idx, axis=1)
+            nor0 = jnp.zeros(bag_cap, jnp.int32)
         else:
             bins_it, mask_it = bins_dev, row_mask
             nor0 = jnp.zeros(n, jnp.int32)
@@ -714,6 +743,9 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
             if is_goss:
                 gk = jnp.take(gk, idx) * amp_c
                 hk = jnp.take(hk, idx) * amp_c
+            elif bag_cap:
+                gk = jnp.take(gk, idx)
+                hk = jnp.take(hk, idx)
             out = _grow_tree_device_body(
                 bins_it, gk, hk, mask_it, nor0,
                 l1, l2, msh, mgs, fmask,
@@ -723,7 +755,7 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
                 has_feature_mask=has_fm, interpret=interpret,
                 cat_args=cat_args)
             rows = out.pop("node_of_row")
-            if is_goss:
+            if is_goss or bag_cap:
                 rows = _route_full(out)
             sums, feat = out["sums"], out["feature"]
             g_thr = jnp.sign(sums[:, 0]) * jnp.maximum(

@@ -479,10 +479,10 @@ def _route_rows(dev, node_of_row, node_id, f, t_local, lid, rid):
     carry the feature's zero bin.
 
     SCATTER-FREE: each row's entry of feature ``f`` (if any) is located by
-    a vectorized binary search inside the row's CSR slice — 32 fixed
-    lower-bound steps of pure gathers over the feature-sorted entries
-    (segment_max over 50M entries lowered to a serialized scatter-max that
-    crashed the tunnelled worker at text scale)."""
+    the vectorized lower-bound search of _row_feature_search — pure
+    gathers over the feature-sorted entries (segment_max over 50M entries
+    lowered to a serialized scatter-max that crashed the tunnelled worker
+    at text scale)."""
     import jax
     import jax.numpy as jnp
 

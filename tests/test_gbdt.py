@@ -407,6 +407,32 @@ class TestFusedTreeGrower:
         np.testing.assert_allclose(b_scan.raw_predict(X),
                                    b_host.raw_predict(X), rtol=1e-3, atol=1e-4)
 
+    def test_scan_train_bagging_compaction(self, monkeypatch):
+        """Compacted bagging (rows gathered to the buffer front, full-row
+        routing by split replay) must match the masked path: identical
+        masks -> identical histograms up to f32 reassociation -> same
+        model quality; first tree structurally identical on this data."""
+        X, y = synth_binary(600, seed=11)
+        params = TrainParams(objective="binary", num_iterations=6,
+                             num_leaves=7, min_data_in_leaf=5,
+                             bagging_fraction=0.5, bagging_freq=1, seed=5)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SCAN_TRAIN", raising=False)
+        monkeypatch.setenv("MMLSPARK_TPU_NO_DENSE_BAG_COMPACT", "1")
+        b_mask = B.train(params, X, y)
+        monkeypatch.delenv("MMLSPARK_TPU_NO_DENSE_BAG_COMPACT")
+        monkeypatch.setenv("MMLSPARK_TPU_DENSE_BAG_COMPACT", "1")
+        b_comp = B.train(params, X, y)
+        monkeypatch.delenv("MMLSPARK_TPU_DENSE_BAG_COMPACT")
+        assert len(b_comp.trees) == len(b_mask.trees)
+        np.testing.assert_array_equal(b_comp.trees[0][0].feature,
+                                      b_mask.trees[0][0].feature)
+        np.testing.assert_array_equal(b_comp.trees[0][0].count,
+                                      b_mask.trees[0][0].count)
+        acc_m = np.mean((b_mask.raw_predict(X) > 0) == y)
+        acc_c = np.mean((b_comp.raw_predict(X) > 0) == y)
+        assert abs(acc_m - acc_c) <= 0.02, (acc_m, acc_c)
+
     def test_scan_train_chunked_dispatch(self, monkeypatch):
         """Forcing tiny per-dispatch budgets must produce the same model:
         chunks share one compiled program, surplus overgrown trees are
