@@ -667,14 +667,14 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
     if (row_masks is not None and not is_goss
             and os.environ.get("MMLSPARK_TPU_NO_DENSE_BAG_COMPACT",
                                "") in ("", "0")):
-        max_cnt = int(row_masks.sum(axis=1).max())
         forced = os.environ.get("MMLSPARK_TPU_DENSE_BAG_COMPACT",
                                 "") not in ("", "0")
         nr = int(pad_mask.sum()) if pad_mask is not None else n
-        frac = max_cnt / max(nr, 1)
-        if forced or (jax.default_backend() == "tpu"
-                      and nr >= 100_000 and frac <= 0.625):
-            bag_cap = min(n, -(-max(max_cnt, 1) // 512) * 512)
+        # cheap gates first: the mask reduction scans up to iters x n bools
+        if forced or (jax.default_backend() == "tpu" and nr >= 100_000):
+            max_cnt = int(row_masks.sum(axis=1).max())
+            if forced or max_cnt / max(nr, 1) <= 0.625:
+                bag_cap = min(n, -(-max(max_cnt, 1) // 512) * 512)
 
     from . import histogram as H
 
