@@ -19,6 +19,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..parallel.mesh import fetch_global
+
 from .binning import BinMapper
 from .tree import GrowerConfig, Tree, build_thresholds, grow_tree
 
@@ -821,7 +823,7 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
             idx = np.minimum(np.arange(done, done + ipc), iters - 1)
             xs_c = {k: v[idx] for k, v in xs.items()}
         carry, ys = jax.lax.scan(body, carry, xs_c, length=ipc)
-        host_chunks.append(jax.device_get(ys))
+        host_chunks.append(fetch_global(ys))
         done += ipc
     host = jax.tree.map(lambda *c: np.concatenate(c, axis=0), *host_chunks) \
         if len(host_chunks) > 1 else host_chunks[0]
@@ -1118,7 +1120,7 @@ def train(params: TrainParams,
     def _host_scores():
         if not fast_scores:
             return scores
-        s, c = jax.device_get((score_dev, comp_dev))
+        s, c = fetch_global((score_dev, comp_dev))
         return (np.asarray(s, dtype=np.float64)
                 + np.asarray(c, dtype=np.float64)).reshape(n, -1)
 
@@ -1146,7 +1148,7 @@ def train(params: TrainParams,
         # ----- bagging / goss row selection
         row_mask = bag_mask
         if is_goss:
-            g_abs = np.abs(np.asarray(jax.device_get(g)))
+            g_abs = np.abs(np.asarray(fetch_global(g)))
             if g_abs.ndim == 2:
                 g_abs = g_abs.sum(axis=1)
             # pad rows sit at the end; goss ranks/samples REAL rows only

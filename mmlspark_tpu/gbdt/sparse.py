@@ -34,6 +34,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..parallel.mesh import fetch_global
+
 from .tree import GrowerConfig, Tree
 
 _MAX_SPARSE_BIN = 64  # per-feature cap: count/tf features have few levels
@@ -800,9 +802,9 @@ def grow_tree_sparse_sharded(ds: SparseDataset, dev, sharded, mesh,
              np.float32(config.min_sum_hessian_in_leaf),
              np.float32(config.min_gain_to_split))
     rows_dev = out.pop("node_of_row")
-    out_host = jax.device_get(out)
+    out_host = fetch_global(out)
     tree = _tree_from_fused_out(out_host, config, ds.thresholds)
-    return tree, np.asarray(jax.device_get(rows_dev))
+    return tree, np.asarray(fetch_global(rows_dev))
 
 
 def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
@@ -877,9 +879,9 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
             msh=np.float32(config.min_sum_hessian_in_leaf),
             mgs=np.float32(config.min_gain_to_split))
         rows_dev = out.pop("node_of_row")
-        out_host = jax.device_get(out)
+        out_host = fetch_global(out)
         tree = _tree_from_fused_out(out_host, config, ds.thresholds)
-        return tree, np.asarray(jax.device_get(rows_dev))
+        return tree, np.asarray(fetch_global(rows_dev))
 
     node_of_row = jnp.zeros(n, dtype=jnp.int32)
     ones = row_mask if row_mask is not None else jnp.ones(n, dtype=bool)
@@ -913,7 +915,7 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
     totals0 = jnp.stack([jnp.sum(grad * mask_f), jnp.sum(hess * mask_f),
                          jnp.sum(mask_f)])
     hist0 = node_hist(ones, totals0)
-    totals0_h = np.asarray(jax.device_get(totals0), np.float64)
+    totals0_h = np.asarray(fetch_global(totals0), np.float64)
     counts[0] = int(totals0_h[2])
     hweights[0] = float(totals0_h[1])
 
@@ -923,7 +925,7 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
             np.float32(config.lambda_l2),
             np.float32(config.min_sum_hessian_in_leaf),
             config.min_data_in_leaf, bin_mask)
-        b, gain, lsum, rsum = jax.device_get((b, gain, lsum, rsum))
+        b, gain, lsum, rsum = fetch_global((b, gain, lsum, rsum))
         f = int(np.searchsorted(ds.feat_offset, b, side="right") - 1)
         t_local = int(b - ds.feat_offset[f])
         return f, t_local, float(gain), np.asarray(lsum, np.float64), \
@@ -999,7 +1001,7 @@ def grow_tree_sparse(ds: SparseDataset, dev, grad, hess,
         count=np.asarray(counts, dtype=np.int32),
         weight=np.asarray(hweights, dtype=np.float64),
     )
-    return tree, np.asarray(jax.device_get(node_of_row))
+    return tree, np.asarray(fetch_global(node_of_row))
 
 
 # ---------------------------------------------------------------------------
@@ -1498,7 +1500,7 @@ def _train_scan_sparse(params, config: GrowerConfig, booster, ds,
             xs_c = {kk_: v[idx] for kk_, v in xs.items()}
         carry, ys = run_chunk(dev_arrays, labels, w_dev, carry, xs_c,
                               ipc=ipc)
-        host_chunks.append(jax.device_get(ys))
+        host_chunks.append(fetch_global(ys))
         done += ipc
     host = jax.tree.map(lambda *c: np.concatenate(c, axis=0), *host_chunks) \
         if len(host_chunks) > 1 else host_chunks[0]
@@ -1731,7 +1733,7 @@ def train_sparse(params, ds: SparseDataset, y: np.ndarray,
         # bagging / goss row selection (host RNG: same draws as dense)
         row_mask = bag_mask
         if is_goss:
-            g_abs = np.abs(np.asarray(jax.device_get(g)))
+            g_abs = np.abs(np.asarray(fetch_global(g)))
             if g_abs.ndim == 2:
                 g_abs = g_abs.sum(axis=1)
             top_n = int(n * params.top_rate)
@@ -1778,8 +1780,8 @@ def train_sparse(params, ds: SparseDataset, y: np.ndarray,
             hk = h if h.ndim == 1 else h[:, kk]
             if shard_ctx is not None:
                 sharded, row_sharding, _to_shards, _from_shards = shard_ctx
-                gh = np.asarray(jax.device_get(gk), dtype=np.float32)
-                hh = np.asarray(jax.device_get(hk), dtype=np.float32)
+                gh = np.asarray(fetch_global(gk), dtype=np.float32)
+                hh = np.asarray(fetch_global(hk), dtype=np.float32)
                 g_sh = jax.device_put(jnp.asarray(_to_shards(gh)),
                                       row_sharding)
                 h_sh = jax.device_put(jnp.asarray(_to_shards(hh)),

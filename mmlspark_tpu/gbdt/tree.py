@@ -38,6 +38,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..parallel.mesh import fetch_global
+
 from . import histogram as H
 
 # Per-node histogram buffer cap for the device-fused grower: [2L-1, F, B, 3] f32.
@@ -587,7 +589,7 @@ def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
             np.float32(config.min_gain_to_split), fm, cat_args,
             use_mxu=pallas_hist.use_mxu_single_device(bins_dev), **common)
     rows_dev = dev_out.pop("node_of_row")
-    out = jax.device_get(dev_out)
+    out = fetch_global(dev_out)
 
     nn = int(out["n_nodes"])
     feature = out["feature"][:nn].astype(np.int32)
@@ -625,7 +627,7 @@ def _grow_tree_fused(bins_dev, grad, hess, row_mask, num_bins: int,
     )
     if device_rows:
         return tree, rows_dev
-    return tree, np.asarray(jax.device_get(rows_dev))
+    return tree, np.asarray(fetch_global(rows_dev))
 
 
 def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
@@ -685,10 +687,10 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
             hist, config.lambda_l1, config.lambda_l2,
             config.min_sum_hessian_in_leaf, config.min_data_in_leaf,
             feature_mask, cat_args)
-        return jax.device_get(split)
+        return fetch_global(split)
 
     root_hist = H.compute_histogram(bins_fm, grad, hess, row_mask, num_bins)
-    root_sums = np.asarray(jax.device_get(
+    root_sums = np.asarray(fetch_global(
         H.total_sums(grad, hess, row_mask)), dtype=np.float64)
     counts[0] = int(root_sums[2])
     hweights[0] = float(root_sums[1])
@@ -792,7 +794,7 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
                     has_feature_mask=feature_mask is not None,
                     cat_words=words if cat_args is not None else None,
                     cat_info=cat_args)
-            split_small, split_big = jax.device_get((split_small, split_big))
+            split_small, split_big = fetch_global((split_small, split_big))
 
         for cid, chist, csplit, csums in (
                 (small_id, small_hist, split_small, small_sums),
@@ -817,7 +819,7 @@ def grow_tree(bins_fm, grad, hess, row_mask, num_bins: int,
         cat_sets=cat_sets,
         cat_bin_words=cat_words_np,
     )
-    return tree, np.asarray(jax.device_get(node_of_row))
+    return tree, np.asarray(fetch_global(node_of_row))
 
 
 def predict_tree_binned(tree: Tree, bins: np.ndarray) -> np.ndarray:

@@ -188,6 +188,29 @@ def num_data_shards(mesh) -> int:
     return int(mesh.shape.get(DATA_AXIS, 1) * mesh.shape.get(FSDP_AXIS, 1))
 
 
+def fetch_global(x):
+    """``jax.device_get`` that also works when arrays span PROCESSES (the
+    multi-host counterpart of the reference's executor-to-driver collects,
+    LightGBMBase.scala:157-159): fully-addressable values fetch directly —
+    in single-process runs that is every value, so this is a drop-in;
+    fully-replicated global arrays read the local shard; row-sharded
+    global arrays allgather across processes. Collective when
+    multi-process — every process must call it in lockstep (true for the
+    SPMD host loops that use it)."""
+    import jax
+
+    def one(a):
+        if not isinstance(a, jax.Array) or a.is_fully_addressable:
+            return a
+        if a.is_fully_replicated:
+            return np.asarray(a.addressable_data(0))
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+
+    return jax.device_get(jax.tree.map(one, x))
+
+
 class MeshContext:
     """Process-wide default mesh (lazily built single-axis DP mesh).
 

@@ -98,3 +98,95 @@ def test_two_process_mesh_collectives_and_input_sharding(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert f"WORKER {pid} OK" in out
+
+
+GBDT_WORKER = r"""
+import os, sys
+pid = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["MMLSPARK_COORDINATOR"] = f"localhost:{port}"
+os.environ["MMLSPARK_NUM_PROCESSES"] = "2"
+os.environ["MMLSPARK_PROCESS_ID"] = str(pid)
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+from mmlspark_tpu.gbdt.booster import TrainParams
+from mmlspark_tpu.gbdt import booster as B
+from mmlspark_tpu.gbdt.sparse import SparseDataset, train_sparse, predict_csr
+
+mesh = make_mesh(MeshSpec(data=8))
+assert jax.process_count() == 2
+
+# DENSE: row-sharded whole-tree growth across 2 OS processes (psum'd
+# histograms over the inter-process link) == the single-device fit run
+# in the SAME process (the reference's distributed-vs-local parity,
+# TrainUtils.scala:383-418)
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2048, 8))
+y = (X[:, 0] + X[:, 1] * 0.5 + 0.2 * rng.normal(size=2048) > 0
+     ).astype(np.float64)
+params = TrainParams(objective="binary", num_iterations=3, num_leaves=7,
+                     min_data_in_leaf=5, seed=0)
+b_mp = B.train(params, X, y, mesh=mesh)
+b_single = B.train(params, X, y)
+np.testing.assert_allclose(b_mp.raw_predict(X), b_single.raw_predict(X),
+                           atol=2e-4)
+
+# SPARSE: nnz-balanced row shards, psum'd flat histograms across the
+# processes; prediction parity vs the single-device CSR fit
+n, f = 1200, 12
+Xs = rng.normal(size=(n, f)) * (rng.random((n, f)) < 0.3)
+ys = (Xs[:, 0] * 2 - Xs[:, 1] + Xs[:, 2]
+      + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+indptr = np.zeros(n + 1, np.int64); idxs = []; vals = []
+for i in range(n):
+    nz = np.nonzero(Xs[i])[0]; idxs.append(nz); vals.append(Xs[i][nz])
+    indptr[i + 1] = indptr[i] + len(nz)
+idx = np.concatenate(idxs); val = np.concatenate(vals)
+ds = SparseDataset.from_csr(indptr, idx, val, f)
+b_sp = train_sparse(params, ds, ys, mesh=mesh)
+b_sp1 = train_sparse(params, ds, ys)
+p_mp = predict_csr(b_sp.trees, indptr, idx, val, 1)[:, 0]
+p_1 = predict_csr(b_sp1.trees, indptr, idx, val, 1)[:, 0]
+acc_mp = float((((p_mp + b_sp.base_score[0]) > 0) == ys).mean())
+acc_1 = float((((p_1 + b_sp1.base_score[0]) > 0) == ys).mean())
+assert abs(acc_mp - acc_1) <= 0.02, (acc_mp, acc_1)
+# the established sharded-sparse contract (test_gbdt_sparse sharded gate):
+# scores approximately equal, not bit-equal (psum'd shard histograms)
+assert float(np.mean(np.abs(p_mp - p_1))) < 0.05, \
+    float(np.mean(np.abs(p_mp - p_1)))
+
+print(f"GBDT WORKER {pid} OK", flush=True)
+"""
+
+
+def test_two_process_gbdt_training_parity(tmp_path):
+    """REAL multi-process distributed GBDT: dense and sparse row-sharded
+    training across 2 OS processes (fetch_global allgathers the sharded
+    routing; histograms psum over the inter-process link) matches the
+    single-device fit."""
+    worker = tmp_path / "gbdt_worker.py"
+    worker.write_text(GBDT_WORKER.replace("{repo!r}", repr(str(REPO))))
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("MMLSPARK_", "XLA_", "JAX_"))}
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"GBDT WORKER {pid} OK" in out
