@@ -22,6 +22,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..parallel.mesh import fetch_global
+
 
 @dataclasses.dataclass
 class LearnerConfig:
@@ -278,8 +280,10 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
                                       ds["labels"], ds["weights"])
             # the loss fetch is the sync point: on async-dispatch plugins
             # (axon) the call above returns at enqueue, so timing it alone
-            # records ~0 — fetch BEFORE reading the clock
-            loss_host = float(loss_sum)
+            # records ~0 — fetch BEFORE reading the clock. fetch_global:
+            # under a multi-PROCESS mesh the replicated loss spans
+            # non-addressable devices and a bare float() raises
+            loss_host = float(fetch_global(loss_sum))
             dt = time.perf_counter_ns() - t0
             w_sum = float(dataset.weights.sum())
             stats.append(TrainingStats(0, n, dt, dt,
@@ -306,7 +310,7 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
         w = _ftrl_weights(config, state[0], state[1])
     else:
         w = state[0]
-    return np.asarray(w), stats
+    return np.asarray(fetch_global(w)), stats
 
 
 def predict_linear(w: np.ndarray, dataset: SparseDataset) -> np.ndarray:

@@ -76,9 +76,12 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_mesh_collectives_and_input_sharding(tmp_path):
+def _run_two_workers(tmp_path, script: str, ok_marker: str,
+                     timeout: int = 240):
+    """Launch the worker template in 2 OS processes sharing a rendezvous
+    port; assert both exit 0 and print their ok marker."""
     worker = tmp_path / "worker.py"
-    worker.write_text(WORKER.replace("{repo!r}", repr(str(REPO))))
+    worker.write_text(script.replace("{repo!r}", repr(str(REPO))))
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("MMLSPARK_", "XLA_", "JAX_"))}
@@ -89,7 +92,7 @@ def test_two_process_mesh_collectives_and_input_sharding(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -97,7 +100,11 @@ def test_two_process_mesh_collectives_and_input_sharding(tmp_path):
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
-        assert f"WORKER {pid} OK" in out
+        assert ok_marker.format(pid=pid) in out
+
+
+def test_two_process_mesh_collectives_and_input_sharding(tmp_path):
+    _run_two_workers(tmp_path, WORKER, "WORKER {pid} OK")
 
 
 GBDT_WORKER = r"""
@@ -160,33 +167,38 @@ assert abs(acc_mp - acc_1) <= 0.02, (acc_mp, acc_1)
 assert float(np.mean(np.abs(p_mp - p_1))) < 0.05, \
     float(np.mean(np.abs(p_mp - p_1)))
 
+# VW: per-shard sequential scans with psum-averaged weights between
+# passes (the AllReduce spanning-tree parity) across the 2 processes
+from mmlspark_tpu.vw.learner import (LearnerConfig, predict_linear,
+                                     train_linear)
+from mmlspark_tpu.vw.learner import SparseDataset as VWDataset
+
+nv = 512
+rows = []
+yv = np.zeros(nv)
+for i in range(nv):
+    feats = rng.integers(0, 1 << 10, size=6)
+    vals = np.ones(6, dtype=np.float32)
+    rows.append({"indices": feats, "values": vals})
+    yv[i] = 1.0 if (feats % 7 == 0).any() else -1.0   # VW {-1,+1} labels
+vds = VWDataset.from_rows(rows, yv, num_bits=12)
+cfg = LearnerConfig(loss_function="logistic", num_passes=3, num_bits=12,
+                    learning_rate=0.5)
+w_mp, _ = train_linear(cfg, vds, mesh=mesh)
+w_1, _ = train_linear(cfg, vds)
+pred_mp = predict_linear(w_mp, vds)
+pred_1 = predict_linear(w_1, vds)
+acc_vw_mp = float(((pred_mp > 0) == (yv > 0)).mean())
+acc_vw_1 = float(((pred_1 > 0) == (yv > 0)).mean())
+assert abs(acc_vw_mp - acc_vw_1) <= 0.05, (acc_vw_mp, acc_vw_1)
+
 print(f"GBDT WORKER {pid} OK", flush=True)
 """
 
 
 def test_two_process_gbdt_training_parity(tmp_path):
-    """REAL multi-process distributed GBDT: dense and sparse row-sharded
-    training across 2 OS processes (fetch_global allgathers the sharded
-    routing; histograms psum over the inter-process link) matches the
-    single-device fit."""
-    worker = tmp_path / "gbdt_worker.py"
-    worker.write_text(GBDT_WORKER.replace("{repo!r}", repr(str(REPO))))
-    port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if not k.startswith(("MMLSPARK_", "XLA_", "JAX_"))}
-    procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(pid), str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
-        for pid in (0, 1)]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
-        assert f"GBDT WORKER {pid} OK" in out
+    """REAL multi-process distributed training: dense + sparse row-sharded
+    GBDT and psum-averaged VW across 2 OS processes (fetch_global
+    allgathers the sharded routing) match the single-device fits."""
+    _run_two_workers(tmp_path, GBDT_WORKER, "GBDT WORKER {pid} OK",
+                     timeout=420)
