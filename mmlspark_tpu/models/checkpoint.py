@@ -16,17 +16,23 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ..parallel.mesh import fetch_global
 from .training import TrainState
 
 
 def save_train_state(state: TrainState, path: str) -> None:
-    """Write params + opt_state + step under ``path`` (overwrites)."""
+    """Write params + opt_state + step under ``path`` (overwrites).
+
+    Orbax handles sharded global arrays natively (each process writes its
+    shards); the step counter is fetched via fetch_global because a bare
+    np.asarray of a replicated scalar raises under a multi-process mesh.
+    Collective when multi-process: call from every process."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     ckpt = ocp.PyTreeCheckpointer()
     tree = {"params": state.params, "opt_state": state.opt_state,
-            "step": np.asarray(state.step)}
+            "step": np.asarray(fetch_global(state.step))}
     # block: callers treat save as durable once it returns
     ckpt.save(path, tree, force=True)
 

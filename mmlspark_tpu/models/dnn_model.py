@@ -29,7 +29,8 @@ from ..core.dataframe import DataFrame
 from ..core.pipeline import Model
 from ..core.schema import ColType, Schema
 from ..parallel.batching import DevicePrefetcher, Minibatcher, concat_outputs
-from ..parallel.mesh import DATA_AXIS, MeshContext, data_sharding, replicated_sharding
+from ..parallel.mesh import (DATA_AXIS, MeshContext, data_sharding,
+                             fetch_global, replicated_sharding)
 from .module import FunctionModel
 
 
@@ -207,8 +208,12 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
 
             def drain_one():
                 ys, num_valid = in_flight.pop(0)
+                # fetch_global: under a multi-PROCESS mesh the sharded
+                # output spans non-addressable devices (allgathered);
+                # single-process it is a plain blocking readback
                 outs.append(tuple(
-                    np.asarray(y, dtype=np.float32)[:num_valid] for y in ys))
+                    np.asarray(fetch_global(y),
+                               dtype=np.float32)[:num_valid] for y in ys))
 
             def to_device(batch):
                 """Stack/pad + H2D for one batch — runs on the prefetch

@@ -306,11 +306,15 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
                                        loss_host / max(w_sum, 1e-12),
                                        w_sum))
 
+    # fetch BEFORE the FTRL weight transform: _ftrl_weights runs eager jnp
+    # ops, which raise on non-addressable multi-process state just like a
+    # bare np.asarray would
+    s0, s1 = fetch_global((state[0], state[1]))
     if config.ftrl:
-        w = _ftrl_weights(config, state[0], state[1])
+        w = _ftrl_weights(config, s0, s1)
     else:
-        w = state[0]
-    return np.asarray(fetch_global(w)), stats
+        w = s0
+    return np.asarray(w), stats
 
 
 def predict_linear(w: np.ndarray, dataset: SparseDataset) -> np.ndarray:

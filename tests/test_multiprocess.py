@@ -192,6 +192,13 @@ acc_vw_mp = float(((pred_mp > 0) == (yv > 0)).mean())
 acc_vw_1 = float(((pred_1 > 0) == (yv > 0)).mean())
 assert abs(acc_vw_mp - acc_vw_1) <= 0.05, (acc_vw_mp, acc_vw_1)
 
+# FTRL: the weight transform runs on fetched host state (eager jnp ops on
+# non-addressable multi-process state raised before the fetch was hoisted)
+import dataclasses
+cfg_f = dataclasses.replace(cfg, ftrl=True)
+w_f, _ = train_linear(cfg_f, vds, mesh=mesh)
+assert np.isfinite(w_f).all() and w_f.shape == w_mp.shape
+
 print(f"GBDT WORKER {pid} OK", flush=True)
 """
 
