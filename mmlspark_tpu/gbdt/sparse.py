@@ -1849,6 +1849,61 @@ def train_sparse(params, ds: SparseDataset, y: np.ndarray,
     return booster
 
 
+_FLAT_FOREST_CACHE: dict = {}
+
+
+def _flatten_forest(tree_groups):
+    """Concatenated node arrays + per-tree offsets for the C++ traversal,
+    memoized on the FIRST Tree object's identity (a weakref guards against
+    id reuse after GC — Tree is an eq-dataclass, so it cannot key a
+    WeakKeyDictionary directly) and validated by per-tree shrinkage (dart
+    rescales shrinkage of existing trees in place between iterations;
+    per-partition serving calls must not re-concatenate a large forest
+    every batch)."""
+    import weakref
+
+    first = next(t for g in tree_groups for t in g)
+    shr = tuple(float(t.shrinkage) for g in tree_groups for t in g)
+    key = id(first)
+    cached = _FLAT_FOREST_CACHE.get(key)
+    if cached is not None and cached[0]() is first and cached[1] == shr:
+        return cached[2]
+    feats, thrs, lefts, rights, vals_ = [], [], [], [], []
+    offs, cls = [0], []
+    for group in tree_groups:
+        for kcls, tree in enumerate(group):
+            feats.append(np.asarray(tree.feature, dtype=np.int32))
+            thrs.append(np.asarray(tree.threshold, dtype=np.float64))
+            lefts.append(np.asarray(tree.left, dtype=np.int32))
+            rights.append(np.asarray(tree.right, dtype=np.int32))
+            vals_.append(np.asarray(tree.value, dtype=np.float64))
+            offs.append(offs[-1] + len(tree.feature))
+            cls.append(kcls)
+    flat = (np.concatenate(feats), np.concatenate(thrs),
+            np.concatenate(lefts), np.concatenate(rights),
+            np.concatenate(vals_), np.asarray(offs, dtype=np.int64),
+            np.asarray(shr, dtype=np.float64),
+            np.asarray(cls, dtype=np.int32))
+    if len(_FLAT_FOREST_CACHE) >= 8:
+        _FLAT_FOREST_CACHE.pop(next(iter(_FLAT_FOREST_CACHE)))
+    _FLAT_FOREST_CACHE[key] = (weakref.ref(first), shr, flat)
+    return flat
+
+
+def _predict_csr_native(tree_groups, indptr, indices, values, n: int,
+                        num_class: int):
+    """Flatten the forest and call the C++ traversal
+    (native_loader.csr_forest_predict); None when the library is
+    unavailable so the caller keeps its numpy path."""
+    from .. import native_loader
+
+    if not any(len(g) for g in tree_groups):
+        return np.zeros((n, num_class), dtype=np.float64)
+    flat = _flatten_forest(tree_groups)
+    return native_loader.csr_forest_predict(
+        indptr, indices, values, *flat[:6], flat[6], flat[7], num_class)
+
+
 def predict_csr(tree_groups: List[List[Tree]], indptr, indices, values,
                 num_class: int) -> np.ndarray:
     """[CSR rows] -> [N, num_class] raw score deltas (PredictForCSRSingle
@@ -1869,6 +1924,20 @@ def predict_csr(tree_groups: List[List[Tree]], indptr, indices, values,
     values = np.asarray(values, dtype=np.float64)
     n = len(indptr) - 1
     out = np.zeros((n, num_class), dtype=np.float64)
+
+    # native fast path: flattened per-row traversal in C++ (the reference's
+    # predict is LightGBM's C++ core; the numpy path below stays as the
+    # toolchain-free fallback and the parity reference — gated equal in
+    # tests). MMLSPARK_TPU_NO_NATIVE_CSR_PREDICT=1 disables.
+    import os as _os
+
+    if _os.environ.get("MMLSPARK_TPU_NO_NATIVE_CSR_PREDICT",
+                       "") in ("", "0"):
+        native_out = _predict_csr_native(tree_groups, indptr, indices,
+                                         values, n, num_class)
+        if native_out is not None:
+            return native_out
+
     width = int(indices.max()) + 2 if len(indices) else 1
     row_of = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
     key = row_of * width + indices                    # globally ascending

@@ -38,6 +38,8 @@ def _so_path() -> str:
 
 _SO_PATH = _so_path()
 
+_ABI_VERSION = 2
+
 _lib = None
 _lib_lock = threading.Lock()
 _build_attempted = False
@@ -53,12 +55,22 @@ def _build() -> bool:
     if src is None:
         return False
     os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
-    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", _SO_PATH, src]
+    # build to a temp path + atomic rename: concurrent processes (e.g. the
+    # two-OS-process tests) may race the build — a reader must never dlopen
+    # a half-written .so, and a process that mmapped the old file must not
+    # have its inode rewritten under it (rename unlinks, not overwrites)
+    tmp = f"{_SO_PATH}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", tmp, src]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO_PATH)
         return True
     except Exception as e:  # toolchain missing / compile error -> fallback
         log.warning("native build failed (%s); using numpy fallbacks", e)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -81,10 +93,31 @@ def load() -> Optional[ctypes.CDLL]:
         except OSError as e:
             log.warning("native load failed (%s)", e)
             return None
+        lib.mml_version.restype = ctypes.c_int32
+        if lib.mml_version() != _ABI_VERSION:
+            # stale build from an older source (build-on-first-use only
+            # fires when the .so is absent): rebuild once in place
+            if _build_attempted:
+                log.warning("native ABI mismatch; using numpy fallbacks")
+                return None
+            _build_attempted = True
+            try:
+                os.remove(_SO_PATH)
+            except OSError:
+                pass
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_SO_PATH)
+            except OSError as e:
+                log.warning("native reload failed (%s)", e)
+                return None
+            lib.mml_version.restype = ctypes.c_int32
+            if lib.mml_version() != _ABI_VERSION:
+                log.warning("native ABI mismatch after rebuild; using "
+                            "numpy fallbacks")
+                return None
         _declare(lib)
-        if lib.mml_version() != 1:
-            log.warning("native ABI mismatch; using numpy fallbacks")
-            return None
         _lib = lib
     return _lib
 
@@ -120,6 +153,10 @@ def _declare(lib: ctypes.CDLL) -> None:
                                        i32p, f32p, u8p, i32p, i32p, f32p,
                                        ctypes.c_int32, ctypes.c_int32, i32p,
                                        ctypes.c_int32, f64p]
+    lib.mml_csr_forest_predict.argtypes = [
+        i64p, i64p, f64p, ctypes.c_int64,
+        i32p, f64p, i32p, i32p, f64p,
+        i64p, f64p, i32p, ctypes.c_int32, ctypes.c_int32, f64p]
 
 
 def _ptr(arr: np.ndarray, ctype):
@@ -224,4 +261,44 @@ def forest_predict(X: np.ndarray, feature: np.ndarray, threshold: np.ndarray,
         _ptr(left, ctypes.c_int32), _ptr(right, ctypes.c_int32),
         _ptr(value, ctypes.c_float), t, m, _ptr(cot, ctypes.c_int32),
         num_class, _ptr(out, ctypes.c_double))
+    return out
+
+
+def csr_forest_predict(indptr: np.ndarray, indices: np.ndarray,
+                       values: np.ndarray, feature: np.ndarray,
+                       threshold: np.ndarray, left: np.ndarray,
+                       right: np.ndarray, value: np.ndarray,
+                       tree_offset: np.ndarray, shrinkage: np.ndarray,
+                       class_of_tree: np.ndarray, num_class: int
+                       ) -> Optional[np.ndarray]:
+    """Flattened-forest traversal over CSR rows (numeric splits only; the
+    caller keeps categorical forests on the numpy path). Node arrays are
+    the per-tree arrays concatenated; ``tree_offset`` is the [T+1] node
+    base of each tree; left/right stay tree-local ids."""
+    lib = load()
+    if lib is None:
+        return None
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    feature = np.ascontiguousarray(feature, dtype=np.int32)
+    threshold = np.ascontiguousarray(threshold, dtype=np.float64)
+    left = np.ascontiguousarray(left, dtype=np.int32)
+    right = np.ascontiguousarray(right, dtype=np.int32)
+    value = np.ascontiguousarray(value, dtype=np.float64)
+    tree_offset = np.ascontiguousarray(tree_offset, dtype=np.int64)
+    shrinkage = np.ascontiguousarray(shrinkage, dtype=np.float64)
+    cot = np.ascontiguousarray(class_of_tree, dtype=np.int32)
+    n = len(indptr) - 1
+    n_trees = len(shrinkage)
+    out = np.zeros((n, num_class), dtype=np.float64)
+    lib.mml_csr_forest_predict(
+        _ptr(indptr, ctypes.c_int64), _ptr(indices, ctypes.c_int64),
+        _ptr(values, ctypes.c_double), n,
+        _ptr(feature, ctypes.c_int32), _ptr(threshold, ctypes.c_double),
+        _ptr(left, ctypes.c_int32), _ptr(right, ctypes.c_int32),
+        _ptr(value, ctypes.c_double),
+        _ptr(tree_offset, ctypes.c_int64), _ptr(shrinkage, ctypes.c_double),
+        _ptr(cot, ctypes.c_int32), n_trees, num_class,
+        _ptr(out, ctypes.c_double))
     return out

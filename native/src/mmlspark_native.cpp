@@ -187,6 +187,50 @@ void mml_forest_predict(const float* X, int64_t n, int32_t num_feat,
     }
 }
 
-int32_t mml_version() { return 1; }
+// ---------------------------------------------------------------------------
+// CSR forest predict (PredictForCSRSingle parity,
+// LightGBMBooster.scala:21-148): per-row tree traversal over sparse rows.
+// The row's CSR slice is feature-sorted, so each node's feature value is a
+// lower_bound over at most max_row_nnz entries; absent features carry 0.0
+// and compare against the threshold (the sparse engine's zero-bin
+// semantics — numeric features only; categorical forests take the host
+// path). Mirrors gbdt/sparse.predict_csr exactly; parity is a test gate.
+// ---------------------------------------------------------------------------
+
+void mml_csr_forest_predict(
+        const int64_t* indptr, const int64_t* indices, const double* values,
+        int64_t n_rows,
+        const int32_t* feature, const double* threshold,
+        const int32_t* left, const int32_t* right, const double* value,
+        const int64_t* tree_offset, const double* shrinkage,
+        const int32_t* class_of_tree, int32_t n_trees, int32_t num_class,
+        double* out) {
+    for (int64_t r = 0; r < n_rows; ++r) {
+        const int64_t lo0 = indptr[r], hi0 = indptr[r + 1];
+        double* orow = out + r * num_class;
+        for (int32_t t = 0; t < n_trees; ++t) {
+            const int64_t base = tree_offset[t];
+            const int32_t* feat_t = feature + base;
+            const double* thr_t = threshold + base;
+            const int32_t* l_t = left + base;
+            const int32_t* r_t = right + base;
+            int32_t node = 0;
+            while (feat_t[node] != -1) {
+                const int64_t f = feat_t[node];
+                int64_t lo = lo0, hi = hi0;
+                while (lo < hi) {
+                    const int64_t mid = (lo + hi) >> 1;
+                    if (indices[mid] < f) lo = mid + 1; else hi = mid;
+                }
+                const double x =
+                    (lo < hi0 && indices[lo] == f) ? values[lo] : 0.0;
+                node = (x <= thr_t[node]) ? l_t[node] : r_t[node];
+            }
+            orow[class_of_tree[t]] += value[base + node] * shrinkage[t];
+        }
+    }
+}
+
+int32_t mml_version() { return 2; }
 
 }  // extern "C"

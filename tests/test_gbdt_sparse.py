@@ -632,3 +632,36 @@ class TestSparseCompaction:
         eager = np.asarray(out["node_of_row"])
         lazy = np.asarray(_assign_leaves_all_rows(devt, out, n))
         np.testing.assert_array_equal(lazy, eager)
+
+
+class TestNativeCsrPredict:
+    def test_native_matches_numpy_path(self, monkeypatch):
+        """The C++ flattened-forest traversal is bit-equal to the numpy
+        searchsorted path (same absent->0.0 and x<=threshold semantics),
+        including multiclass column placement."""
+        from mmlspark_tpu import native_loader
+
+        if not native_loader.available():
+            import pytest
+            pytest.skip("native toolchain unavailable")
+        X, y = synth_sparse(500, 14, density=0.3, seed=4)
+        y3 = (np.abs(X[:, 0]) * 2 + X[:, 1] > 0.5).astype(float) \
+            + (X[:, 2] > 0.5)
+        indptr, idx, vals = dense_to_csr(X)
+        ds = SparseDataset.from_csr(indptr, idx, vals, X.shape[1])
+        params = TrainParams(objective="multiclass", num_class=3,
+                             num_iterations=5, num_leaves=7,
+                             min_data_in_leaf=5, seed=0)
+        b = train_sparse(params, ds, y3)
+        monkeypatch.setenv("MMLSPARK_TPU_NO_NATIVE_CSR_PREDICT", "1")
+        ref = predict_csr(b.trees, indptr, idx, vals, 3)
+        monkeypatch.delenv("MMLSPARK_TPU_NO_NATIVE_CSR_PREDICT")
+        fast = predict_csr(b.trees, indptr, idx, vals, 3)
+        np.testing.assert_array_equal(fast, ref)
+
+    def test_empty_forest_and_empty_rows(self):
+        from mmlspark_tpu.gbdt.sparse import predict_csr
+
+        out = predict_csr([], np.zeros(4, np.int64), np.zeros(0, np.int64),
+                          np.zeros(0), 2)
+        np.testing.assert_array_equal(out, np.zeros((3, 2)))
