@@ -60,10 +60,88 @@ def predict_single_tree(tree: Tree, X: np.ndarray) -> np.ndarray:
     return tree.value[node] * tree.shrinkage
 
 
+_FOREST_MEMO: dict = {}
+
+
+def memoize_forest(tree_groups, tag: str, build):
+    """Identity-memoized per-forest arrays for the native predict paths.
+
+    Key: the first Tree object's id + ``tag`` (layout variant) — a weakref
+    guards against id reuse after GC (Tree is an eq-dataclass and cannot
+    key a WeakKeyDictionary). Validated by per-tree shrinkage: the ONLY
+    in-place Tree mutation in the codebase (dart rescales dropped trees'
+    shrinkage between iterations, rf normalizes after training). Any new
+    in-place mutation must extend THIS validation — it covers the dense
+    and CSR layouts at once, which is why the helper is shared."""
+    import weakref
+
+    first = next(t for g in tree_groups for t in g)
+    shr = tuple(float(t.shrinkage) for g in tree_groups for t in g)
+    key = (id(first), tag)
+    cached = _FOREST_MEMO.get(key)
+    if cached is not None and cached[0]() is first and cached[1] == shr:
+        return cached[2]
+    flat = build()
+    if len(_FOREST_MEMO) >= 16:
+        _FOREST_MEMO.pop(next(iter(_FOREST_MEMO)))
+    _FOREST_MEMO[key] = (weakref.ref(first), shr, flat)
+    return flat
+
+
+def pad_soa(vals, fill, dtype, T: int, m: int) -> np.ndarray:
+    """[T, m] padded struct-of-arrays field (shared by the device ensemble
+    and the native host layouts)."""
+    arr = np.full((T, m), fill, dtype=dtype)
+    for i, v in enumerate(vals):
+        arr[i, :len(v)] = v
+    return arr
+
+
+def _padded_forest_f64(tree_groups):
+    """[T, m] padded SoA (f64 thresholds/values, value pre-scaled by
+    shrinkage) for the native host traversal."""
+
+    def build():
+        trees = [t for g in tree_groups for t in g]
+        m = max(len(t.feature) for t in trees)
+        T = len(trees)
+        return (pad_soa([t.feature for t in trees], -1, np.int32, T, m),
+                pad_soa([t.threshold for t in trees], 0.0, np.float64, T, m),
+                pad_soa([t.default_left for t in trees], True, bool, T, m),
+                pad_soa([t.left for t in trees], 0, np.int32, T, m),
+                pad_soa([t.right for t in trees], 0, np.int32, T, m),
+                pad_soa([np.asarray(t.value) * t.shrinkage for t in trees],
+                        0.0, np.float64, T, m),
+                np.array([k for g in tree_groups for k in range(len(g))],
+                         dtype=np.int32))
+
+    return memoize_forest(tree_groups, "dense_f64", build)
+
+
 def predict_ensemble(tree_groups: List[List[Tree]], X: np.ndarray,
                      num_class: int) -> np.ndarray:
-    """[iterations][class] trees -> [N, num_class] raw score deltas."""
+    """[iterations][class] trees -> [N, num_class] raw score deltas.
+
+    Native fast path (numeric forests): one C++ SoA traversal, f64
+    end-to-end — bit-equal to the per-tree numpy loop below, which stays
+    as the toolchain-free fallback, the categorical path, and the parity
+    reference (gated equal in tests). The reference's scoring surface is
+    LightGBM's C++ predict (LightGBMBooster.scala:21-148);
+    MMLSPARK_TPU_NO_NATIVE_PREDICT=1 disables."""
+    import os
+
     n = X.shape[0]
+    trees = [t for g in tree_groups for t in g]
+    if (trees and not any(t.cat_sets is not None for t in trees)
+            and os.environ.get("MMLSPARK_TPU_NO_NATIVE_PREDICT",
+                               "") in ("", "0")):
+        from .. import native_loader
+
+        flat = _padded_forest_f64(tree_groups)
+        res = native_loader.forest_predict_f64(np.asarray(X), *flat,
+                                               num_class)
+        if res is not None:
+            return res
     out = np.zeros((n, num_class), dtype=np.float64)
     for group in tree_groups:
         for k, tree in enumerate(group):
@@ -88,20 +166,17 @@ class DeviceEnsemble:
             return
         m = max(len(t.feature) for t in trees)
         self.max_depth = 0
-
-        def pad(vals, fill, dtype):
-            out = np.full((self.num_trees, m), fill, dtype=dtype)
-            for i, v in enumerate(vals):
-                out[i, :len(v)] = v
-            return out
-
-        self.feature = pad([t.feature for t in trees], -1, np.int32)
-        self.threshold = pad([t.threshold for t in trees], 0.0, np.float32)
-        self.default_left = pad([t.default_left for t in trees], True, bool)
-        self.left = pad([t.left for t in trees], 0, np.int32)
-        self.right = pad([t.right for t in trees], 0, np.int32)
-        self.value = pad([np.asarray(t.value) * t.shrinkage for t in trees],
-                         0.0, np.float32)
+        T = self.num_trees
+        self.feature = pad_soa([t.feature for t in trees], -1, np.int32, T, m)
+        self.threshold = pad_soa([t.threshold for t in trees], 0.0,
+                                 np.float32, T, m)
+        self.default_left = pad_soa([t.default_left for t in trees], True,
+                                    bool, T, m)
+        self.left = pad_soa([t.left for t in trees], 0, np.int32, T, m)
+        self.right = pad_soa([t.right for t in trees], 0, np.int32, T, m)
+        self.value = pad_soa(
+            [np.asarray(t.value) * t.shrinkage for t in trees],
+            0.0, np.float32, T, m)
         # categorical SET nodes: padded per-node value sets [T, m, S] with
         # NaN fill (== compares false) — built only when the model has any.
         # High-cardinality sets (imported LightGBM models can carry

@@ -1849,45 +1849,32 @@ def train_sparse(params, ds: SparseDataset, y: np.ndarray,
     return booster
 
 
-_FLAT_FOREST_CACHE: dict = {}
-
-
 def _flatten_forest(tree_groups):
-    """Concatenated node arrays + per-tree offsets for the C++ traversal,
-    memoized on the FIRST Tree object's identity (a weakref guards against
-    id reuse after GC — Tree is an eq-dataclass, so it cannot key a
-    WeakKeyDictionary directly) and validated by per-tree shrinkage (dart
-    rescales shrinkage of existing trees in place between iterations;
-    per-partition serving calls must not re-concatenate a large forest
-    every batch)."""
-    import weakref
+    """Concatenated node arrays + per-tree offsets for the C++ CSR
+    traversal, memoized/validated by predict.memoize_forest (shared with
+    the dense layout — one shrinkage-invalidation contract)."""
+    from .predict import memoize_forest
 
-    first = next(t for g in tree_groups for t in g)
-    shr = tuple(float(t.shrinkage) for g in tree_groups for t in g)
-    key = id(first)
-    cached = _FLAT_FOREST_CACHE.get(key)
-    if cached is not None and cached[0]() is first and cached[1] == shr:
-        return cached[2]
-    feats, thrs, lefts, rights, vals_ = [], [], [], [], []
-    offs, cls = [0], []
-    for group in tree_groups:
-        for kcls, tree in enumerate(group):
-            feats.append(np.asarray(tree.feature, dtype=np.int32))
-            thrs.append(np.asarray(tree.threshold, dtype=np.float64))
-            lefts.append(np.asarray(tree.left, dtype=np.int32))
-            rights.append(np.asarray(tree.right, dtype=np.int32))
-            vals_.append(np.asarray(tree.value, dtype=np.float64))
-            offs.append(offs[-1] + len(tree.feature))
-            cls.append(kcls)
-    flat = (np.concatenate(feats), np.concatenate(thrs),
-            np.concatenate(lefts), np.concatenate(rights),
-            np.concatenate(vals_), np.asarray(offs, dtype=np.int64),
-            np.asarray(shr, dtype=np.float64),
-            np.asarray(cls, dtype=np.int32))
-    if len(_FLAT_FOREST_CACHE) >= 8:
-        _FLAT_FOREST_CACHE.pop(next(iter(_FLAT_FOREST_CACHE)))
-    _FLAT_FOREST_CACHE[key] = (weakref.ref(first), shr, flat)
-    return flat
+    def build():
+        feats, thrs, lefts, rights, vals_ = [], [], [], [], []
+        offs, shr, cls = [0], [], []
+        for group in tree_groups:
+            for kcls, tree in enumerate(group):
+                feats.append(np.asarray(tree.feature, dtype=np.int32))
+                thrs.append(np.asarray(tree.threshold, dtype=np.float64))
+                lefts.append(np.asarray(tree.left, dtype=np.int32))
+                rights.append(np.asarray(tree.right, dtype=np.int32))
+                vals_.append(np.asarray(tree.value, dtype=np.float64))
+                offs.append(offs[-1] + len(tree.feature))
+                shr.append(float(tree.shrinkage))
+                cls.append(kcls)
+        return (np.concatenate(feats), np.concatenate(thrs),
+                np.concatenate(lefts), np.concatenate(rights),
+                np.concatenate(vals_), np.asarray(offs, dtype=np.int64),
+                np.asarray(shr, dtype=np.float64),
+                np.asarray(cls, dtype=np.int32))
+
+    return memoize_forest(tree_groups, "csr", build)
 
 
 def _predict_csr_native(tree_groups, indptr, indices, values, n: int,
@@ -1923,7 +1910,6 @@ def predict_csr(tree_groups: List[List[Tree]], indptr, indices, values,
     indices = np.asarray(indices, dtype=np.int64)
     values = np.asarray(values, dtype=np.float64)
     n = len(indptr) - 1
-    out = np.zeros((n, num_class), dtype=np.float64)
 
     # native fast path: flattened per-row traversal in C++ (the reference's
     # predict is LightGBM's C++ core; the numpy path below stays as the
@@ -1938,6 +1924,7 @@ def predict_csr(tree_groups: List[List[Tree]], indptr, indices, values,
         if native_out is not None:
             return native_out
 
+    out = np.zeros((n, num_class), dtype=np.float64)
     width = int(indices.max()) + 2 if len(indices) else 1
     row_of = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
     key = row_of * width + indices                    # globally ascending

@@ -26,19 +26,24 @@ _REPO_ROOT = os.path.dirname(_PKG_DIR)
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 
 
+_ABI_VERSION = 3
+
+
 def _so_path() -> str:
     """Repo build dir when the repo layout is present (dev checkout); else a
-    user cache dir (pip-installed: site-packages may be read-only)."""
+    user cache dir (pip-installed: site-packages may be read-only). The ABI
+    version is part of the filename so co-installed package versions
+    sharing a cache dir never clobber each other's build (a shared
+    unversioned path made every fresh process of each version rebuild)."""
+    name = f"libmmlspark_native.v{_ABI_VERSION}.so"
     if os.path.isdir(_NATIVE_DIR):
-        return os.path.join(_NATIVE_DIR, "build", "libmmlspark_native.so")
+        return os.path.join(_NATIVE_DIR, "build", name)
     cache = os.environ.get("XDG_CACHE_HOME",
                            os.path.join(os.path.expanduser("~"), ".cache"))
-    return os.path.join(cache, "mmlspark_tpu", "libmmlspark_native.so")
+    return os.path.join(cache, "mmlspark_tpu", name)
 
 
 _SO_PATH = _so_path()
-
-_ABI_VERSION = 2
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -74,31 +79,37 @@ def _build() -> bool:
         return False
 
 
+def _try_load() -> Optional[ctypes.CDLL]:
+    """dlopen + ABI check; None on any failure (caller decides rebuild)."""
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError as e:
+        log.warning("native load failed (%s)", e)
+        return None
+    lib.mml_version.restype = ctypes.c_int32
+    got = lib.mml_version()
+    if got != _ABI_VERSION:
+        log.warning("native ABI v%s != expected v%s", got, _ABI_VERSION)
+        return None
+    return lib
+
+
 def load() -> Optional[ctypes.CDLL]:
-    """Load (building if needed) the native library; None if unavailable."""
+    """Load (building if needed) the native library; None if unavailable.
+
+    ANY first-load failure — absent, corrupted/half-written, or a stale
+    ABI from older source — gets exactly one rebuild attempt (dlopen
+    failures must rebuild too: build-on-absent alone left a corrupt file
+    permanently wedging the process into numpy fallbacks)."""
     global _lib, _build_attempted
     if _lib is not None:
         return _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH):
+        lib = _try_load() if os.path.exists(_SO_PATH) else None
+        if lib is None:
             if _build_attempted:
-                return None
-            _build_attempted = True
-            if not _build():
-                return None
-        try:
-            lib = ctypes.CDLL(_SO_PATH)
-        except OSError as e:
-            log.warning("native load failed (%s)", e)
-            return None
-        lib.mml_version.restype = ctypes.c_int32
-        if lib.mml_version() != _ABI_VERSION:
-            # stale build from an older source (build-on-first-use only
-            # fires when the .so is absent): rebuild once in place
-            if _build_attempted:
-                log.warning("native ABI mismatch; using numpy fallbacks")
                 return None
             _build_attempted = True
             try:
@@ -107,14 +118,9 @@ def load() -> Optional[ctypes.CDLL]:
                 pass
             if not _build():
                 return None
-            try:
-                lib = ctypes.CDLL(_SO_PATH)
-            except OSError as e:
-                log.warning("native reload failed (%s)", e)
-                return None
-            lib.mml_version.restype = ctypes.c_int32
-            if lib.mml_version() != _ABI_VERSION:
-                log.warning("native ABI mismatch after rebuild; using "
+            lib = _try_load()
+            if lib is None:
+                log.warning("native library unusable after rebuild; using "
                             "numpy fallbacks")
                 return None
         _declare(lib)
@@ -157,6 +163,10 @@ def _declare(lib: ctypes.CDLL) -> None:
         i64p, i64p, f64p, ctypes.c_int64,
         i32p, f64p, i32p, i32p, f64p,
         i64p, f64p, i32p, ctypes.c_int32, ctypes.c_int32, f64p]
+    lib.mml_forest_predict_f64.argtypes = [
+        f64p, ctypes.c_int64, ctypes.c_int32,
+        i32p, f64p, u8p, i32p, i32p, f64p,
+        ctypes.c_int32, ctypes.c_int32, i32p, ctypes.c_int32, f64p]
 
 
 def _ptr(arr: np.ndarray, ctype):
@@ -300,5 +310,37 @@ def csr_forest_predict(indptr: np.ndarray, indices: np.ndarray,
         _ptr(value, ctypes.c_double),
         _ptr(tree_offset, ctypes.c_int64), _ptr(shrinkage, ctypes.c_double),
         _ptr(cot, ctypes.c_int32), n_trees, num_class,
+        _ptr(out, ctypes.c_double))
+    return out
+
+
+def forest_predict_f64(X: np.ndarray, feature: np.ndarray,
+                       threshold: np.ndarray, default_left: np.ndarray,
+                       left: np.ndarray, right: np.ndarray,
+                       value: np.ndarray, class_of_tree: np.ndarray,
+                       num_class: int) -> Optional[np.ndarray]:
+    """f64 dense forest traversal — bit-equal to the Python host path
+    (predict.predict_single_tree) for numeric splits; ``value`` must be
+    pre-scaled by shrinkage. Node arrays are [T, m] padded SoA."""
+    lib = load()
+    if lib is None:
+        return None
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    n, num_feat = X.shape
+    t, m = feature.shape
+    feature = np.ascontiguousarray(feature, dtype=np.int32)
+    threshold = np.ascontiguousarray(threshold, dtype=np.float64)
+    dl = np.ascontiguousarray(default_left, dtype=np.uint8)
+    left = np.ascontiguousarray(left, dtype=np.int32)
+    right = np.ascontiguousarray(right, dtype=np.int32)
+    value = np.ascontiguousarray(value, dtype=np.float64)
+    cot = np.ascontiguousarray(class_of_tree, dtype=np.int32)
+    out = np.zeros((n, num_class), dtype=np.float64)
+    lib.mml_forest_predict_f64(
+        _ptr(X, ctypes.c_double), n, num_feat,
+        _ptr(feature, ctypes.c_int32), _ptr(threshold, ctypes.c_double),
+        _ptr(dl, ctypes.c_uint8), _ptr(left, ctypes.c_int32),
+        _ptr(right, ctypes.c_int32), _ptr(value, ctypes.c_double),
+        t, m, _ptr(cot, ctypes.c_int32), num_class,
         _ptr(out, ctypes.c_double))
     return out

@@ -187,6 +187,32 @@ void mml_forest_predict(const float* X, int64_t n, int32_t num_feat,
     }
 }
 
+// f64 variant: bit-equal to the Python host traversal (f64 features and
+// thresholds; the f32 version above mirrors the device ensemble's layout).
+// value is pre-scaled by shrinkage, like the f32 SoA.
+void mml_forest_predict_f64(const double* X, int64_t n, int32_t num_feat,
+                            const int32_t* feature, const double* threshold,
+                            const uint8_t* default_left, const int32_t* left,
+                            const int32_t* right, const double* value,
+                            int32_t t, int32_t m,
+                            const int32_t* class_of_tree,
+                            int32_t num_class, double* out) {
+    for (int64_t i = 0; i < n; i++) {
+        const double* x = X + i * num_feat;
+        for (int32_t ti = 0; ti < t; ti++) {
+            const int32_t base = ti * m;
+            int32_t node = 0;
+            while (feature[base + node] >= 0) {
+                const double v = x[feature[base + node]];
+                bool go_left = std::isnan(v) ? (bool)default_left[base + node]
+                                             : (v <= threshold[base + node]);
+                node = go_left ? left[base + node] : right[base + node];
+            }
+            out[i * num_class + class_of_tree[ti]] += value[base + node];
+        }
+    }
+}
+
 // ---------------------------------------------------------------------------
 // CSR forest predict (PredictForCSRSingle parity,
 // LightGBMBooster.scala:21-148): per-row tree traversal over sparse rows.
@@ -231,6 +257,6 @@ void mml_csr_forest_predict(
     }
 }
 
-int32_t mml_version() { return 2; }
+int32_t mml_version() { return 3; }
 
 }  // extern "C"

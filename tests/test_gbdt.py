@@ -1223,3 +1223,46 @@ class TestFusedSplitStep:
             has_feature_mask=True)
         ss, sb = jax.device_get((ss, sb))
         assert int(ss.feature) == 0 and int(sb.feature) == 0  # only unmasked
+
+
+class TestNativeDensePredict:
+    def test_native_matches_numpy_path(self, monkeypatch):
+        """The C++ f64 SoA traversal is bit-equal to the per-tree numpy
+        loop, including NaN default-direction routing and multiclass
+        columns."""
+        from mmlspark_tpu import native_loader
+
+        if not native_loader.available():
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(400, 8))
+        y = np.digitize(X[:, 0] + X[:, 1], [-0.5, 0.5]).astype(np.float64)
+        X[rng.random(X.shape) < 0.1] = np.nan   # exercise default_left
+        params = TrainParams(objective="multiclass", num_class=3,
+                             num_iterations=5, num_leaves=7,
+                             min_data_in_leaf=5, seed=0)
+        b = B.train(params, X, y)
+        monkeypatch.setenv("MMLSPARK_TPU_NO_NATIVE_PREDICT", "1")
+        ref = b.raw_predict(X)
+        monkeypatch.delenv("MMLSPARK_TPU_NO_NATIVE_PREDICT")
+        fast = b.raw_predict(X)
+        np.testing.assert_array_equal(fast, ref)
+
+    def test_dart_shrinkage_rescale_invalidates_cache(self, monkeypatch):
+        """Dart rescales tree shrinkage in place between predicts; the
+        padded-forest cache must not serve stale values."""
+        from mmlspark_tpu import native_loader
+        from mmlspark_tpu.gbdt.predict import predict_ensemble
+
+        if not native_loader.available():
+            pytest.skip("native toolchain unavailable")
+        X, y = synth_binary(300, seed=3)
+        params = TrainParams(objective="binary", num_iterations=3,
+                             num_leaves=7, min_data_in_leaf=5)
+        b = B.train(params, X, y)
+        p1 = predict_ensemble(b.trees, X, 1)
+        for g in b.trees:
+            for t in g:
+                t.shrinkage = t.shrinkage * 0.5
+        p2 = predict_ensemble(b.trees, X, 1)
+        np.testing.assert_allclose(p2, p1 * 0.5, rtol=1e-12)
