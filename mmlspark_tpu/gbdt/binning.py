@@ -79,6 +79,9 @@ class BinMapper:
             col = sample[:, f]
             col = col[~np.isnan(col)]
             if f in cat:
+                # inf is not a representable category either: int64 cast of
+                # non-finite values is platform-defined (and warns)
+                col = col[np.isfinite(col)]
                 vals = np.unique(col.astype(np.int64)) if col.size else np.array([0])
                 categories[f] = vals[: fmax - 1]
                 edges.append(np.empty(0))
@@ -102,13 +105,17 @@ class BinMapper:
         """One feature column -> int32 bins (0 = missing)."""
         miss = np.isnan(col)
         if self.categorical[f]:
+            # cast only the FINITE entries: NaN/inf->int64 is a
+            # platform-defined cast (and warns); missing stays bin 0, as
+            # does any category outside the learned set (LightGBM missing
+            # semantics, ref lightgbm/TrainParams.scala)
             cats = self.categories[f]
-            pos = np.searchsorted(cats, col.astype(np.int64))
-            pos = np.clip(pos, 0, len(cats) - 1)
-            known = np.zeros(len(col), dtype=bool)
-            valid = ~miss
-            known[valid] = cats[pos[valid]] == col[valid].astype(np.int64)
-            return np.where(known & ~miss, pos + 1, 0).astype(np.int32)
+            out = np.zeros(len(col), dtype=np.int32)
+            valid = np.isfinite(col)
+            iv = col[valid].astype(np.int64)
+            pos = np.clip(np.searchsorted(cats, iv), 0, len(cats) - 1)
+            out[valid] = np.where(cats[pos] == iv, pos + 1, 0)
+            return out
         bins = np.searchsorted(self.edges[f], col, side="left") + 1
         return np.where(miss, 0, bins).astype(np.int32)
 

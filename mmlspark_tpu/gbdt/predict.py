@@ -66,25 +66,35 @@ _FOREST_MEMO: dict = {}
 def memoize_forest(tree_groups, tag: str, build):
     """Identity-memoized per-forest arrays for the native predict paths.
 
-    Key: the first Tree object's id + ``tag`` (layout variant) — a weakref
-    guards against id reuse after GC (Tree is an eq-dataclass and cannot
-    key a WeakKeyDictionary). Validated by per-tree shrinkage: the ONLY
-    in-place Tree mutation in the codebase (dart rescales dropped trees'
-    shrinkage between iterations, rf normalizes after training). Any new
-    in-place mutation must extend THIS validation — it covers the dense
-    and CSR layouts at once, which is why the helper is shared."""
+    Key: the first Tree object's id + ``tag`` (layout variant). A cache
+    hit must prove the forest is the SAME sequence of Tree objects, not
+    just the same head: boosters continued from one init_model share their
+    prefix trees (Booster.trees copies the list but not the Tree objects),
+    so two distinct forests can agree on (id(first), length, shrinkages).
+    Validation therefore holds a weakref per tree and requires every
+    weakref to resolve to the corresponding tree by identity (weakrefs
+    also guard against id reuse after GC; Tree is an eq-dataclass and
+    cannot key a WeakKeyDictionary). Per-tree shrinkage is checked too:
+    the ONLY in-place Tree mutation in the codebase (dart rescales dropped
+    trees' shrinkage between iterations, rf normalizes after training).
+    Any new in-place mutation must extend THIS validation — it covers the
+    dense and CSR layouts at once, which is why the helper is shared."""
     import weakref
 
-    first = next(t for g in tree_groups for t in g)
-    shr = tuple(float(t.shrinkage) for g in tree_groups for t in g)
-    key = (id(first), tag)
+    trees = [t for g in tree_groups for t in g]
+    shr = tuple(float(t.shrinkage) for t in trees)
+    # first+last+length in the key so prefix-sharing forests (same head,
+    # different tails) cache SIMULTANEOUSLY instead of evicting each other
+    key = (id(trees[0]), id(trees[-1]), len(trees), tag)
     cached = _FOREST_MEMO.get(key)
-    if cached is not None and cached[0]() is first and cached[1] == shr:
+    if (cached is not None and len(cached[0]) == len(trees)
+            and cached[1] == shr
+            and all(r() is t for r, t in zip(cached[0], trees))):
         return cached[2]
     flat = build()
     if len(_FOREST_MEMO) >= 16:
         _FOREST_MEMO.pop(next(iter(_FOREST_MEMO)))
-    _FOREST_MEMO[key] = (weakref.ref(first), shr, flat)
+    _FOREST_MEMO[key] = (tuple(weakref.ref(t) for t in trees), shr, flat)
     return flat
 
 

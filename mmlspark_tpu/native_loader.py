@@ -86,8 +86,14 @@ def _try_load() -> Optional[ctypes.CDLL]:
     except OSError as e:
         log.warning("native load failed (%s)", e)
         return None
-    lib.mml_version.restype = ctypes.c_int32
-    got = lib.mml_version()
+    try:
+        lib.mml_version.restype = ctypes.c_int32
+        got = lib.mml_version()
+    except (OSError, AttributeError) as e:
+        # loadable .so without the symbol (foreign or truncated-but-
+        # linkable file) must trigger the rebuild path, not crash load()
+        log.warning("native ABI probe failed (%s)", e)
+        return None
     if got != _ABI_VERSION:
         log.warning("native ABI v%s != expected v%s", got, _ABI_VERSION)
         return None

@@ -59,6 +59,20 @@ class TestBinning:
         bins = m.transform(X)
         assert bins[1, 0] == 0 and bins[0, 0] >= 1
 
+    def test_categorical_nan_warning_free(self):
+        # NaN in a categorical column: no NaN->int cast (platform-defined,
+        # warns), missing -> bin 0, unseen category -> bin 0
+        import warnings
+
+        X = np.array([[1.0], [np.nan], [4.0], [2.0], [99.0], [np.inf]])
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            m = BinMapper.fit(X, max_bin=8, categorical_indexes=[0])
+            bins = m.transform(X)
+        assert bins[1, 0] == 0          # missing
+        assert bins[5, 0] == 0          # inf: not a representable category
+        assert bins[0, 0] >= 1 and bins[2, 0] >= 1 and bins[3, 0] >= 1
+
     def test_monotonic(self):
         X = np.linspace(0, 1, 50).reshape(-1, 1)
         m = BinMapper.fit(X, max_bin=8)
@@ -770,6 +784,41 @@ class TestBooster:
         assert len(b2.trees) == 10
         merged = b1.merge(b1)
         assert len(merged.trees) == 10
+
+    def test_shared_prefix_continuations_no_cache_collision(self, monkeypatch):
+        # Two boosters continued from ONE init_model share their prefix
+        # Tree objects, have equal length and equal shrinkages — the
+        # forest memo must distinguish them by the identity of EVERY
+        # tree, or the native predict path returns the other model's
+        # scores (round-4 advisor finding).
+        import os
+
+        if os.environ.get("MMLSPARK_TPU_NO_NATIVE_PREDICT", "") not in ("", "0"):
+            pytest.skip("native predict disabled in this environment")
+        X, y = synth_binary(400, seed=0)
+        X2, y2 = synth_binary(400, seed=7)
+        params = TrainParams(objective="binary", num_iterations=5,
+                             num_leaves=7, min_data_in_leaf=5)
+        base = B.train(params, X, y)
+        c1 = B.train(params, X, y, init_model=base)
+        c2 = B.train(params, X2, y2, init_model=base)
+        assert len(c1.trees) == len(c2.trees)
+        r1 = c1.raw_predict(X)   # populates the forest memo for c1
+        r2 = c2.raw_predict(X)   # must NOT hit c1's cache entry
+        # both forests must cache simultaneously (distinct keys), not
+        # mutually evict — alternating serving of the two models would
+        # otherwise rebuild the SoA layout on every call
+        from mmlspark_tpu.gbdt.predict import _FOREST_MEMO
+        keys_before = set(_FOREST_MEMO)
+        c1.raw_predict(X)
+        c2.raw_predict(X)
+        assert set(_FOREST_MEMO) == keys_before
+        monkeypatch.setenv("MMLSPARK_TPU_NO_NATIVE_PREDICT", "1")
+        ref1 = c1.raw_predict(X)
+        ref2 = c2.raw_predict(X)
+        np.testing.assert_allclose(r1, ref1, atol=1e-12)
+        np.testing.assert_allclose(r2, ref2, atol=1e-12)
+        assert np.abs(ref1 - ref2).max() > 0  # the two models DO differ
 
     @pytest.mark.parametrize("boosting", ["rf", "dart", "goss"])
     def test_boosting_variants_run(self, boosting):

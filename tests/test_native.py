@@ -21,6 +21,18 @@ class TestNative:
     def test_builds_and_loads(self, native):
         assert native.load() is not None
 
+    def test_try_load_foreign_so_returns_none(self, monkeypatch):
+        # a loadable .so lacking the mml_version symbol (foreign file at
+        # the cache path) must return None — triggering the rebuild flow —
+        # not raise AttributeError out of load()
+        import ctypes.util
+
+        libm = ctypes.util.find_library("m")
+        if libm is None:
+            pytest.skip("libm not found")
+        monkeypatch.setattr(NL, "_SO_PATH", libm)
+        assert NL._try_load() is None
+
     def test_murmur_batch_matches_python(self, native):
         strings = ["hello", "world", "", "mmlspark_tpu", "日本語テキスト"]
         got = native.murmur3_batch(strings, seed=42)
