@@ -31,7 +31,8 @@ MODEL_FORMAT = "mmlspark_tpu.gbdt.v1"
 class TrainParams:
     """Native-param-string equivalent (reference lightgbm/TrainParams.scala:1-117)."""
 
-    objective: str = "regression"          # regression|regression_l1|quantile|binary|multiclass|lambdarank
+    # regression|regression_l1|quantile|binary|multiclass|lambdarank
+    objective: str = "regression"
     boosting_type: str = "gbdt"            # gbdt|rf|dart|goss
     num_iterations: int = 100
     learning_rate: float = 0.1
@@ -880,6 +881,294 @@ def _train_scan(params: TrainParams, config: GrowerConfig, booster: "Booster",
 # ---------------------------------------------------------------------------
 
 
+def _grad_hess_np(objective: str, scores: np.ndarray, labels: np.ndarray,
+                  weights: Optional[np.ndarray], alpha: float):
+    """Host mirror of grad_hess (same formulas; f32 like the device path —
+    the grower consumes f32 anyway; lambdarank is device-only and gated out
+    of the native path)."""
+    scores = scores.astype(np.float32)
+    labels = labels.astype(np.float32)
+    if objective == "binary":
+        p = 1.0 / (1.0 + np.exp(-scores))
+        g = p - labels
+        h = np.maximum(p * (1.0 - p), 1e-16)
+    elif objective == "multiclass":
+        m = scores - scores.max(axis=-1, keepdims=True)
+        e = np.exp(m)
+        p = e / e.sum(axis=-1, keepdims=True)
+        yh = np.zeros_like(p)
+        yh[np.arange(len(labels)), labels.astype(np.int64)] = 1.0
+        g = p - yh
+        h = np.maximum(2.0 * p * (1.0 - p), 1e-16)
+    elif objective in ("regression", "regression_l2", "l2",
+                       "mean_squared_error"):
+        g = scores - labels
+        h = np.ones_like(scores)
+    elif objective in ("regression_l1", "l1", "mae"):
+        g = np.sign(scores - labels)
+        h = np.ones_like(scores)
+    elif objective == "quantile":
+        diff = scores - labels
+        g = np.where(diff >= 0, 1.0 - alpha, -alpha)
+        h = np.ones_like(scores)
+    elif objective == "huber":
+        g = np.clip(scores - labels, -alpha, alpha)
+        h = np.ones_like(scores)
+    elif objective == "poisson":
+        g = np.exp(scores) - labels
+        h = np.exp(scores)
+    else:
+        raise ValueError(f"Unknown objective {objective!r}")
+    if weights is not None:
+        w = np.asarray(weights, dtype=np.float32)
+        w = w if g.ndim == 1 else w[:, None]
+        g, h = g * w, h * w
+    return g, h
+
+
+_NATIVE_PATH_FORCING_ENVS = (
+    # envs that force a specific XLA training path: honoring them means the
+    # native host engine must stand aside (tests pin paths this way;
+    # NO_SCAN_TRAIN explicitly selects the XLA host loop, not this engine)
+    "MMLSPARK_TPU_SCAN_TRAIN", "MMLSPARK_TPU_NO_SCAN_TRAIN",
+    "MMLSPARK_TPU_FUSED_TREE", "MMLSPARK_TPU_NO_FUSED_TREE",
+    "MMLSPARK_TPU_HIST_EXACT")
+
+
+def _native_train_ok(params: TrainParams, n: int) -> bool:
+    """Route this fit to the native C++ host grower?
+
+    The reference's engine is LightGBM's C++ core (TrainUtils.scala:170-233);
+    this is its small-N equivalent: below ~MMLSPARK_TPU_NATIVE_TRAIN_MAX
+    row*iteration*class work the per-dispatch overhead of any accelerator
+    exceeds what one host core does outright (BENCH_gbdt_train.json: 200k
+    was dispatch-bound at 0.44x sklearn through r4). Large fits keep the
+    whole-run lax.scan device path. MMLSPARK_TPU_NATIVE_TRAIN=1 forces,
+    =0 disables."""
+    env = os.environ.get("MMLSPARK_TPU_NATIVE_TRAIN", "")
+    if env in ("0", "false"):
+        return False
+    if params.categorical_feature or params.objective == "lambdarank":
+        return False
+    if params.max_bin > 255 or (params.max_bin_by_feature
+                                and max(params.max_bin_by_feature) > 255):
+        return False
+    if any(os.environ.get(e, "") not in ("", "0")
+           for e in _NATIVE_PATH_FORCING_ENVS):
+        return False
+    from .. import native_loader
+
+    if not native_loader.available():
+        return False
+    if env in ("1", "true", "force"):
+        return True
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return True
+    except Exception:
+        return True
+    budget = float(os.environ.get("MMLSPARK_TPU_NATIVE_TRAIN_MAX", "2e7"))
+    return n * params.num_iterations * max(params.num_class, 1) <= budget
+
+
+def _train_native(params: TrainParams, X: np.ndarray, y: np.ndarray,
+                  weights, valid, valid_groups, init_scores, init_model,
+                  log) -> Optional[Booster]:
+    """All-host training loop over the C++ grower (no device arrays at all).
+
+    Mirrors the host-orchestrated loop of train() — same objectives,
+    bagging/GOSS/dart/rf selection logic, early stopping, and metric
+    logging — with mml_gbdt_grow_tree replacing the XLA tree grower.
+    Returns None when this fit cannot run natively (mapper with >256 bins
+    inherited from init_model, native lib unavailable at call time)."""
+    from .. import native_loader
+
+    n, num_f = X.shape
+    k = max(params.num_class, 1)
+    objective = params.objective
+    rng = np.random.default_rng(params.seed or params.bagging_seed)
+
+    if init_model is not None and init_model.bin_mapper is not None:
+        mapper = init_model.bin_mapper
+    else:
+        mapper = BinMapper.fit(X, params.max_bin, (), seed=params.seed,
+                               max_bin_by_feature=params.max_bin_by_feature)
+    num_bins = mapper.max_num_bins
+    if num_bins > 256:
+        return None
+    bins_fm = mapper.transform_fm(X, dtype=np.uint8)
+
+    if init_scores is not None:
+        base = np.zeros(k, dtype=np.float64)
+        scores = np.broadcast_to(
+            np.asarray(init_scores, dtype=np.float64).reshape(n, -1),
+            (n, k)).copy()
+    else:
+        base = init_score(objective, np.asarray(y, dtype=np.float64), k,
+                          alpha=params.alpha)
+        scores = np.tile(base, (n, 1)).astype(np.float64)
+    booster = Booster(params, mapper, base_score=base)
+    if init_model is not None:
+        booster.trees = [list(g) for g in init_model.trees]
+        booster.base_score = init_model.base_score
+        if init_model.trees:
+            scores = init_model.raw_predict(
+                X, num_iteration=len(init_model.trees)).reshape(n, -1)
+
+    metric = params.metric or default_metric(objective)
+    higher_better = metric in _HIGHER_BETTER
+    best_val, best_iter, rounds_no_improve = \
+        (-np.inf if higher_better else np.inf), -1, 0
+    val_X, val_y = valid if valid is not None else (None, None)
+
+    is_rf = params.boosting_type == "rf"
+    is_dart = params.boosting_type == "dart"
+    is_goss = params.boosting_type == "goss"
+    lr = 1.0 if is_rf else params.learning_rate
+    bag_mask = np.ones(n, dtype=bool)
+    yv = np.asarray(y, dtype=np.float64)
+    wv = np.asarray(weights, dtype=np.float64) if weights is not None else None
+
+    for it in range(params.num_iterations):
+        dropped: List[int] = []
+        if is_dart and booster.trees:
+            n_trees = len(booster.trees)
+            if params.uniform_drop:
+                drop_mask = rng.random(n_trees) < params.drop_rate
+                dropped = list(np.where(drop_mask)[0][: params.max_drop])
+            else:
+                n_drop = min(max(1, int(n_trees * params.drop_rate)),
+                             params.max_drop)
+                dropped = list(rng.choice(n_trees, size=n_drop,
+                                          replace=False))
+            for di in dropped:
+                for kk in range(k):
+                    scores[:, kk] -= _tree_contrib(booster.trees[di][kk], X)
+
+        sc = scores[:, 0] if k == 1 else scores
+        g, h = _grad_hess_np(objective, sc, yv, wv, params.alpha)
+
+        row_mask = bag_mask
+        if is_goss:
+            g_abs = np.abs(g)
+            if g_abs.ndim == 2:
+                g_abs = g_abs.sum(axis=1)
+            top_n = int(n * params.top_rate)
+            other_n = int(n * params.other_rate)
+            order = np.argsort(-g_abs)
+            row_mask = np.zeros(n, dtype=bool)
+            row_mask[order[:top_n]] = True
+            rest = order[top_n:]
+            picked = rng.choice(len(rest), size=min(other_n, len(rest)),
+                                replace=False)
+            row_mask[rest[picked]] = True
+            amplify = (1.0 - params.top_rate) / max(params.other_rate, 1e-12)
+            amp = np.ones(n)
+            amp[rest] = amplify
+            g, h = g * (amp if g.ndim == 1 else amp[:, None]), \
+                h * (amp if h.ndim == 1 else amp[:, None])
+        elif ((params.bagging_fraction < 1.0
+               or params.pos_bagging_fraction < 1.0
+               or params.neg_bagging_fraction < 1.0)
+              and (is_rf or params.bagging_freq > 0)
+              and it % max(params.bagging_freq, 1) == 0):
+            if (params.pos_bagging_fraction < 1.0
+                    or params.neg_bagging_fraction < 1.0):
+                pos = np.asarray(y) > 0.5
+                frac = np.where(pos, params.pos_bagging_fraction,
+                                params.neg_bagging_fraction)
+                bag_mask = rng.random(n) < frac
+            else:
+                bag_mask = rng.random(n) < params.bagging_fraction
+            row_mask = bag_mask
+
+        feature_mask = None
+        if params.feature_fraction < 1.0:
+            m = np.zeros(num_f, dtype=bool)
+            n_feat = max(1, int(num_f * params.feature_fraction))
+            m[rng.choice(num_f, size=n_feat, replace=False)] = True
+            feature_mask = m
+
+        group: List[Tree] = []
+        for kk in range(k):
+            gk = np.ascontiguousarray(g if g.ndim == 1 else g[:, kk],
+                                      dtype=np.float32)
+            hk = np.ascontiguousarray(h if h.ndim == 1 else h[:, kk],
+                                      dtype=np.float32)
+            res = native_loader.gbdt_grow_tree(
+                bins_fm, gk, hk,
+                None if row_mask.all() else row_mask, feature_mask,
+                num_bins=num_bins, num_leaves=params.num_leaves,
+                max_depth=params.max_depth,
+                min_data_in_leaf=params.min_data_in_leaf,
+                min_sum_hessian=params.min_sum_hessian_in_leaf,
+                min_gain_to_split=params.min_gain_to_split,
+                lambda_l1=params.lambda_l1, lambda_l2=params.lambda_l2,
+                max_delta_step=params.max_delta_step)
+            if res is None:
+                return None
+            feat = res["feature"]
+            thr = np.zeros(len(feat), dtype=np.float64)
+            for i in np.nonzero(feat >= 0)[0]:
+                thr[i] = mapper.bin_upper_value(int(feat[i]),
+                                                int(res["threshold_bin"][i]))
+            tree = Tree(
+                feature=feat, threshold=thr,
+                threshold_bin=res["threshold_bin"],
+                default_left=res["default_left"], left=res["left"],
+                right=res["right"], value=res["value"], gain=res["gain"],
+                count=res["count"], weight=res["weight"])
+            shrink = lr
+            if is_dart and dropped:
+                shrink = lr / (len(dropped) + lr)
+            tree.shrinkage = shrink
+            group.append(tree)
+            scores[:, kk] += tree.value[res["leaf_of_row"]] * shrink
+        if is_dart and dropped:
+            factor = len(dropped) / (len(dropped) + lr)
+            for di in dropped:
+                for kk in range(k):
+                    booster.trees[di][kk].shrinkage *= factor
+                    scores[:, kk] += _tree_contrib(booster.trees[di][kk], X)
+        booster.trees.append(group)
+
+        if params.train_metric and log:
+            tm = eval_metric(metric, scores[:, 0] if k == 1 else scores, yv)
+            log(f"[{it + 1}] train {metric}={tm:.6f}")
+        if val_X is not None:
+            val_scores = booster.raw_predict(
+                val_X, num_iteration=len(booster.trees))
+            m = eval_metric(metric, val_scores,
+                            np.asarray(val_y, dtype=np.float64), valid_groups)
+            improved = m > best_val if higher_better else m < best_val
+            if improved:
+                best_val, best_iter, rounds_no_improve = \
+                    m, len(booster.trees), 0
+            else:
+                rounds_no_improve += 1
+            if log:
+                log(f"[{it + 1}] valid {metric}={m:.6f}")
+            if params.early_stopping_round > 0 \
+                    and rounds_no_improve >= params.early_stopping_round:
+                booster.best_iteration = best_iter
+                if log:
+                    log(f"early stopping at iteration {it + 1}, "
+                        f"best {best_iter}")
+                break
+        elif log and not params.train_metric and (it + 1) % 10 == 0:
+            m = eval_metric(metric, scores[:, 0] if k == 1 else scores, yv)
+            log(f"[{it + 1}] train {metric}={m:.6f}")
+
+    if is_rf and booster.trees:
+        inv = 1.0 / len(booster.trees)
+        for gtrees in booster.trees:
+            for t in gtrees:
+                t.shrinkage = inv
+    return booster
+
+
 def train(params: TrainParams,
           X: np.ndarray, y: np.ndarray,
           weights: Optional[np.ndarray] = None,
@@ -899,6 +1188,14 @@ def train(params: TrainParams,
     zero-hessian padding so they never influence splits (empty-partition
     IgnoreStatus parity, TrainUtils.scala:332-341).
     """
+    # native C++ host engine for small fits (and CPU-only hosts): decided
+    # before ANY device work so the tunnel/H2D is never touched
+    if mesh is None and groups is None and _native_train_ok(params, len(y)):
+        nb = _train_native(params, X, y, weights, valid, valid_groups,
+                           init_scores, init_model, log)
+        if nb is not None:
+            return nb
+
     import jax
     import jax.numpy as jnp
 

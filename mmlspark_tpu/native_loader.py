@@ -26,16 +26,36 @@ _REPO_ROOT = os.path.dirname(_PKG_DIR)
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 
 
-_ABI_VERSION = 3
+_ABI_VERSION = 4
+
+
+def _host_tag() -> str:
+    """Short CPU-identity tag for the cache filename: the build uses
+    -march=native, so a cached .so is only valid on a CPU with the same
+    feature set — a shared cache dir (NFS home, baked image) must rebuild
+    on a different host instead of dying with SIGILL mid-call."""
+    import hashlib
+    import platform
+
+    ident = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    ident += line
+                    break
+    except OSError:
+        pass
+    return hashlib.md5(ident.encode()).hexdigest()[:8]
 
 
 def _so_path() -> str:
     """Repo build dir when the repo layout is present (dev checkout); else a
     user cache dir (pip-installed: site-packages may be read-only). The ABI
-    version is part of the filename so co-installed package versions
-    sharing a cache dir never clobber each other's build (a shared
-    unversioned path made every fresh process of each version rebuild)."""
-    name = f"libmmlspark_native.v{_ABI_VERSION}.so"
+    version AND a host-CPU tag are part of the filename so co-installed
+    package versions (or hosts with different CPU features — the build is
+    -march=native) sharing a cache dir never load each other's build."""
+    name = f"libmmlspark_native.v{_ABI_VERSION}.{_host_tag()}.so"
     if os.path.isdir(_NATIVE_DIR):
         return os.path.join(_NATIVE_DIR, "build", name)
     cache = os.environ.get("XDG_CACHE_HOME",
@@ -65,7 +85,12 @@ def _build() -> bool:
     # a half-written .so, and a process that mmapped the old file must not
     # have its inode rewritten under it (rename unlinks, not overwrites)
     tmp = f"{_SO_PATH}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", tmp, src]
+    # -ffp-contract=off: no FMA contraction — the predict paths are
+    # documented (and test-gated) bit-equal to the numpy references, and
+    # contraction changes their rounding by 1 ulp
+    cmd = ["g++", "-O3", "-march=native", "-ffp-contract=off",
+           "-funroll-loops", "-fPIC", "-shared", "-std=c++17", "-o", tmp,
+           src]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO_PATH)
@@ -173,6 +198,19 @@ def _declare(lib: ctypes.CDLL) -> None:
         f64p, ctypes.c_int64, ctypes.c_int32,
         i32p, f64p, u8p, i32p, i32p, f64p,
         ctypes.c_int32, ctypes.c_int32, i32p, ctypes.c_int32, f64p]
+    lib.mml_bin_column_f64.argtypes = [f64p, ctypes.c_int64, f64p,
+                                       ctypes.c_int32, i32p]
+    lib.mml_bin_matrix_f64_u8.argtypes = [f64p, ctypes.c_int64,
+                                          ctypes.c_int32, f64p, i64p, u8p]
+    lib.mml_bin_matrix_f64_i32.argtypes = [f64p, ctypes.c_int64,
+                                           ctypes.c_int32, f64p, i64p, i32p]
+    lib.mml_gbdt_grow_tree.restype = ctypes.c_int32
+    lib.mml_gbdt_grow_tree.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        f32p, f32p, u8p, u8p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_double, ctypes.c_double,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        i32p, i32p, u8p, i32p, i32p, f64p, f32p, i32p, f64p, i32p]
 
 
 def _ptr(arr: np.ndarray, ctype):
@@ -318,6 +356,104 @@ def csr_forest_predict(indptr: np.ndarray, indices: np.ndarray,
         _ptr(cot, ctypes.c_int32), n_trees, num_class,
         _ptr(out, ctypes.c_double))
     return out
+
+
+def bin_column(vals: np.ndarray, edges: np.ndarray) -> Optional[np.ndarray]:
+    """Numeric-column quantile binning: lower_bound(edges)+1, NaN -> 0."""
+    lib = load()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.float64)
+    edges = np.ascontiguousarray(edges, dtype=np.float64)
+    out = np.empty(len(vals), dtype=np.int32)
+    lib.mml_bin_column_f64(_ptr(vals, ctypes.c_double), len(vals),
+                           _ptr(edges, ctypes.c_double), len(edges),
+                           _ptr(out, ctypes.c_int32))
+    return out
+
+
+def bin_matrix(X: np.ndarray, edges_list, dtype=np.int32
+               ) -> Optional[np.ndarray]:
+    """Row-major [N, F] floats -> feature-major [F, N] bins in ONE blocked
+    pass (numeric features only; NaN -> bin 0)."""
+    lib = load()
+    if lib is None or dtype not in (np.uint8, np.int32):
+        return None
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    n, num_f = X.shape
+    offsets = np.zeros(num_f + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in edges_list], out=offsets[1:])
+    flat = (np.concatenate([np.asarray(e, dtype=np.float64)
+                            for e in edges_list])
+            if offsets[-1] else np.empty(0, dtype=np.float64))
+    flat = np.ascontiguousarray(flat)
+    out = np.empty((num_f, n), dtype=dtype)
+    if dtype == np.uint8:
+        lib.mml_bin_matrix_f64_u8(
+            _ptr(X, ctypes.c_double), n, num_f, _ptr(flat, ctypes.c_double),
+            _ptr(offsets, ctypes.c_int64), _ptr(out, ctypes.c_uint8))
+    else:
+        lib.mml_bin_matrix_f64_i32(
+            _ptr(X, ctypes.c_double), n, num_f, _ptr(flat, ctypes.c_double),
+            _ptr(offsets, ctypes.c_int64), _ptr(out, ctypes.c_int32))
+    return out
+
+
+def gbdt_grow_tree(bins_fm: np.ndarray, grad: np.ndarray, hess: np.ndarray,
+                   row_mask: Optional[np.ndarray],
+                   feature_mask: Optional[np.ndarray], *,
+                   num_bins: int, num_leaves: int, max_depth: int,
+                   min_data_in_leaf: float, min_sum_hessian: float,
+                   min_gain_to_split: float, lambda_l1: float,
+                   lambda_l2: float, max_delta_step: float):
+    """Grow one leaf-wise tree on the host (LightGBM serial learner
+    equivalent; numeric splits only). Returns a dict of flat node arrays
+    (length = node count) + ``leaf_of_row`` [N], or None when the native
+    library is unavailable.
+
+    ``bins_fm``: [F, N] uint8 feature-major bins (0 = missing)."""
+    lib = load()
+    if lib is None or num_bins > 256:
+        return None
+    bins_fm = np.ascontiguousarray(bins_fm, dtype=np.uint8)
+    num_f, n = bins_fm.shape
+    grad = np.ascontiguousarray(grad, dtype=np.float32)
+    hess = np.ascontiguousarray(hess, dtype=np.float32)
+    rm = (np.ascontiguousarray(row_mask, dtype=np.uint8)
+          if row_mask is not None else None)
+    fm = (np.ascontiguousarray(feature_mask, dtype=np.uint8)
+          if feature_mask is not None else None)
+    cap = 2 * num_leaves - 1
+    feature = np.empty(cap, dtype=np.int32)
+    tbin = np.empty(cap, dtype=np.int32)
+    dleft = np.empty(cap, dtype=np.uint8)
+    left = np.empty(cap, dtype=np.int32)
+    right = np.empty(cap, dtype=np.int32)
+    value = np.empty(cap, dtype=np.float64)
+    gain = np.empty(cap, dtype=np.float32)
+    count = np.empty(cap, dtype=np.int32)
+    weight = np.empty(cap, dtype=np.float64)
+    leaf_of_row = np.empty(n, dtype=np.int32)
+    null_u8 = ctypes.POINTER(ctypes.c_uint8)()
+    n_nodes = lib.mml_gbdt_grow_tree(
+        _ptr(bins_fm, ctypes.c_uint8), n, num_f, num_bins,
+        _ptr(grad, ctypes.c_float), _ptr(hess, ctypes.c_float),
+        _ptr(rm, ctypes.c_uint8) if rm is not None else null_u8,
+        _ptr(fm, ctypes.c_uint8) if fm is not None else null_u8,
+        num_leaves, max_depth, float(min_data_in_leaf),
+        float(min_sum_hessian), float(min_gain_to_split),
+        float(lambda_l1), float(lambda_l2), float(max_delta_step),
+        _ptr(feature, ctypes.c_int32), _ptr(tbin, ctypes.c_int32),
+        _ptr(dleft, ctypes.c_uint8), _ptr(left, ctypes.c_int32),
+        _ptr(right, ctypes.c_int32), _ptr(value, ctypes.c_double),
+        _ptr(gain, ctypes.c_float), _ptr(count, ctypes.c_int32),
+        _ptr(weight, ctypes.c_double), _ptr(leaf_of_row, ctypes.c_int32))
+    m = int(n_nodes)
+    return {"feature": feature[:m], "threshold_bin": tbin[:m],
+            "default_left": dleft[:m].astype(bool), "left": left[:m],
+            "right": right[:m], "value": value[:m], "gain": gain[:m],
+            "count": count[:m], "weight": weight[:m],
+            "leaf_of_row": leaf_of_row}
 
 
 def forest_predict_f64(X: np.ndarray, feature: np.ndarray,

@@ -14,6 +14,9 @@
 #include <cstring>
 #include <cmath>
 #include <algorithm>
+#include <limits>
+#include <queue>
+#include <vector>
 
 extern "C" {
 
@@ -257,6 +260,449 @@ void mml_csr_forest_predict(
     }
 }
 
-int32_t mml_version() { return 3; }
+// Quantile-edge binning (BinMapper.transform hot path): bin =
+// lower_bound(edges, v) + 1, NaN -> 0 (missing). Branchless lower_bound
+// (cmov, no mispredicts — edges are < max_bin and L1-resident). Folds the
+// isnan/searchsorted/where/cast numpy passes into one sweep; ctypes
+// releases the GIL during the call, so the device engine's overlapped
+// bin+ship worker keeps streaming while this runs.
+static inline int32_t bin_one(double v, const double* edges,
+                              int32_t n_edges) {
+    if (std::isnan(v)) return 0;
+    const double* p = edges;
+    int32_t len = n_edges;
+    while (len > 1) {
+        const int32_t half = len >> 1;
+        p += (p[half - 1] < v) ? half : 0;
+        len -= half;
+    }
+    return (int32_t)(p - edges) + (p[0] < v) + 1;
+}
 
-}  // extern "C"
+void mml_bin_column_f64(const double* vals, int64_t n, const double* edges,
+                        int32_t n_edges, int32_t* out) {
+    for (int64_t i = 0; i < n; i++) out[i] = bin_one(vals[i], edges, n_edges);
+}
+
+}  // extern "C" (host kernels above; C++ helpers below)
+
+// Whole-matrix binning: row-major X [N, F] -> feature-major bins [F, N],
+// blocked over rows so X is streamed ONCE (a per-column python loop re-reads
+// the full strided matrix F times — the measured bottleneck at 200k x 28).
+// Ragged per-feature edges arrive concatenated with offsets [F+1]; features
+// with zero edges emit bin 1 for non-missing values, like the numpy path.
+template <typename OutT>
+static void bin_matrix(const double* X, int64_t n, int32_t num_f,
+                       const double* edges, const int64_t* offsets,
+                       OutT* out) {
+    // row-outer: X streams sequentially once, and the per-row feature
+    // searches are independent dependency chains the out-of-order core
+    // overlaps (feature-outer re-reads the strided matrix per feature)
+    std::vector<const double*> ef(num_f);
+    std::vector<int32_t> ne(num_f);
+    for (int32_t f = 0; f < num_f; f++) {
+        ef[f] = edges + offsets[f];
+        ne[f] = (int32_t)(offsets[f + 1] - offsets[f]);
+    }
+    for (int64_t i = 0; i < n; i++) {
+        const double* row = X + (size_t)i * num_f;
+        for (int32_t f = 0; f < num_f; f++) {
+            const int32_t nf = ne[f];
+            out[(size_t)f * n + i] = (OutT)(
+                nf == 0 ? (std::isnan(row[f]) ? 0 : 1)
+                        : bin_one(row[f], ef[f], nf));
+        }
+    }
+}
+
+extern "C" void mml_bin_matrix_f64_u8(
+        const double* X, int64_t n, int32_t num_f, const double* edges,
+        const int64_t* offsets, uint8_t* out) {
+    bin_matrix(X, n, num_f, edges, offsets, out);
+}
+
+extern "C" void mml_bin_matrix_f64_i32(
+        const double* X, int64_t n, int32_t num_f, const double* edges,
+        const int64_t* offsets, int32_t* out) {
+    bin_matrix(X, n, num_f, edges, offsets, out);
+}
+
+// ---------------------------------------------------------------------------
+// Leaf-wise GBDT tree growth (LightGBM serial-tree-learner equivalent).
+//
+// The reference's training engine is LightGBM's C++ core driven through
+// LGBM_BoosterUpdateOneIter (lightgbm/TrainUtils.scala:170-233). The TPU
+// engine covers the large-N regime with the whole-run lax.scan on device;
+// THIS grower is the small-N host path, where per-dispatch overhead beats
+// any accelerator win. It mirrors gbdt/tree.grow_tree + histogram.
+// find_best_split numerics (f32 histogram/gain math, f64 leaf values,
+// first-max argmax in [F, B-1] flat order, heap tie-break by insertion
+// order) so trees agree with the XLA host grower on non-degenerate splits.
+// Numeric splits only — categorical forests stay on the XLA paths.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct BestSplit {
+    float gain = -std::numeric_limits<float>::infinity();
+    int32_t feature = 0;
+    int32_t bin = 1;          // rows with bin <= this go left
+    bool default_left = false;
+    float lg = 0, lh = 0;     // left sums (chosen missing direction)
+    int64_t lc = 0;
+    float tg = 0, th = 0;     // node totals
+    int64_t tc = 0;
+};
+
+struct HeapEntry {
+    float gain;
+    int64_t order;      // insertion tie-break: earlier pops first
+    int32_t node;       // node id
+    int32_t hist_slot;  // index into the histogram pool
+    int32_t depth;
+    BestSplit split;    // evaluated once at push; reused at pop
+};
+
+struct HeapCmp {
+    bool operator()(const HeapEntry& a, const HeapEntry& b) const {
+        if (a.gain != b.gain) return a.gain < b.gain;  // max-heap on gain
+        return a.order > b.order;                      // then FIFO
+    }
+};
+
+// Pair-packed histogram slab: (grad, hess) float pairs + separate int32
+// counts (denser hot cells than an [B,3] float layout; counts are exact
+// ints — the f32 counts of the XLA histogram are integer-exact below 2^24
+// per bin, so comparisons agree).
+struct HistSlab {
+    std::vector<float> gh;     // [F * B * 2]
+    std::vector<int32_t> cnt;  // [F * B]
+};
+
+inline float leaf_obj(float G, float H, float l1, float l2) {
+    // -0.5 * T(G)^2 / (H + l2), T = soft-threshold (histogram._leaf_objective)
+    float t = std::copysign(std::max(std::fabs(G) - l1, 0.0f), G);
+    if (G == 0.0f) t = 0.0f;  // sign(0) = 0 in jnp
+    return -0.5f * t * t / (H + l2);
+}
+
+// Mirror of histogram.find_best_split over a pair-packed histogram.
+BestSplit find_best(const HistSlab& hist, int32_t num_f, int32_t b,
+                    const uint8_t* fmask, float l1, float l2,
+                    float min_hess, float min_data) {
+    BestSplit best;
+    const float* gh = hist.gh.data();
+    const int32_t* cnt = hist.cnt.data();
+    // node totals from feature 0 (find_best_split uses total[0])
+    float G = 0, H = 0;
+    int64_t C = 0;
+    for (int32_t t = 0; t < b; t++) {
+        G += gh[(size_t)t * 2 + 0];
+        H += gh[(size_t)t * 2 + 1];
+        C += cnt[t];
+    }
+    best.tg = G; best.th = H; best.tc = C;
+    const float parent = leaf_obj(G, H, l1, l2);
+    for (int32_t f = 0; f < num_f; f++) {
+        if (fmask && !fmask[f]) continue;
+        const float* ghf = gh + (size_t)f * b * 2;
+        const int32_t* cntf = cnt + (size_t)f * b;
+        const float mg = ghf[0], mh = ghf[1];  // missing bin sums
+        const int64_t mc = cntf[0];
+        const bool has_missing = (mc != 0) | (mg != 0.0f) | (mh != 0.0f);
+        float cg = 0, ch = 0;                  // cum over value bins
+        int64_t cc = 0;
+        for (int32_t t = 1; t < b; t++) {
+            cg += ghf[(size_t)t * 2 + 0];
+            ch += ghf[(size_t)t * 2 + 1];
+            cc += cntf[t];
+            // missing -> left (when this feature HAS no missing entries,
+            // both directions evaluate identically and jnp's gain_l >=
+            // gain_r tie picks left — so only this one is computed)
+            float gain_l = -std::numeric_limits<float>::infinity();
+            {
+                const float GL = cg + mg, HL = ch + mh;
+                const float CL = (float)(cc + mc);
+                const float GR = G - GL, HR = H - HL;
+                const float CR = (float)(C - cc - mc);
+                if (CL >= min_data && CR >= min_data && HL >= min_hess &&
+                    HR >= min_hess)
+                    gain_l = -(leaf_obj(GL, HL, l1, l2) +
+                               leaf_obj(GR, HR, l1, l2) - parent);
+            }
+            bool dir_left = true;
+            float gain = gain_l;
+            if (has_missing) {
+                // missing -> right
+                float gain_r = -std::numeric_limits<float>::infinity();
+                const float GL = cg, HL = ch;
+                const float CL = (float)cc;
+                const float GR = G - GL, HR = H - HL;
+                const float CR = (float)(C - cc);
+                if (CL >= min_data && CR >= min_data && HL >= min_hess &&
+                    HR >= min_hess)
+                    gain_r = -(leaf_obj(GL, HL, l1, l2) +
+                               leaf_obj(GR, HR, l1, l2) - parent);
+                dir_left = gain_l >= gain_r;
+                gain = dir_left ? gain_l : gain_r;
+            }
+            if (gain > best.gain) {  // strict: first max in flat (f, t) order
+                best.gain = gain;
+                best.feature = f;
+                best.bin = t;
+                best.default_left = dir_left;
+                best.lg = dir_left ? cg + mg : cg;
+                best.lh = dir_left ? ch + mh : ch;
+                best.lc = dir_left ? cc + mc : cc;
+            }
+        }
+    }
+    return best;
+}
+
+}  // namespace
+
+// Grow ONE leaf-wise tree. bins_fm: [F, N] feature-major uint8 (bin 0 =
+// missing). Outputs are caller-allocated with capacity 2*num_leaves-1;
+// o_leaf_of_row [N] receives the final node id of EVERY row (masked or not
+// — the booster updates all rows' scores). Returns the node count.
+extern "C" int32_t mml_gbdt_grow_tree(
+        const uint8_t* bins_fm, int64_t n, int32_t num_f, int32_t num_bins,
+        const float* grad, const float* hess, const uint8_t* row_mask,
+        const uint8_t* feature_mask,
+        int32_t num_leaves, int32_t max_depth, double min_data_in_leaf,
+        double min_sum_hessian, double min_gain_to_split,
+        double lambda_l1, double lambda_l2, double max_delta_step,
+        int32_t* o_feature, int32_t* o_threshold_bin, uint8_t* o_default_left,
+        int32_t* o_left, int32_t* o_right, double* o_value, float* o_gain,
+        int32_t* o_count, double* o_weight, int32_t* o_leaf_of_row) {
+    const int32_t max_nodes = 2 * num_leaves - 1;
+    const float l1 = (float)lambda_l1, l2 = (float)lambda_l2;
+    const float min_hess = (float)min_sum_hessian;
+    const float min_data = (float)min_data_in_leaf;
+    const size_t gh_sz = (size_t)num_f * num_bins * 2;
+    const size_t cnt_sz = (size_t)num_f * num_bins;
+
+    // init all nodes as leaves
+    for (int32_t i = 0; i < max_nodes; i++) {
+        o_feature[i] = -1; o_threshold_bin[i] = 0; o_default_left[i] = 1;
+        o_left[i] = -1; o_right[i] = -1; o_value[i] = 0.0; o_gain[i] = 0.0f;
+        o_count[i] = 0; o_weight[i] = 0.0;
+    }
+
+    // row index partition: idx grouped per node, [start, len) ranges.
+    std::vector<int64_t> idx(n);
+    for (int64_t i = 0; i < n; i++) idx[i] = i;
+    std::vector<int64_t> node_start(max_nodes, 0), node_len(max_nodes, 0);
+    node_len[0] = n;
+
+    // histogram pool: one slab per live heap entry + 2 scratch
+    std::vector<HistSlab> pool;
+    std::vector<int32_t> free_slots;
+    auto alloc_slot = [&]() -> int32_t {
+        if (!free_slots.empty()) {
+            int32_t s = free_slots.back(); free_slots.pop_back();
+            return s;
+        }
+        pool.push_back({std::vector<float>(gh_sz),
+                        std::vector<int32_t>(cnt_sz)});
+        return (int32_t)pool.size() - 1;
+    };
+
+    // root histogram over masked rows, feature-major (sequential column
+    // reads; per-feature accumulation order is row order, like the scatter)
+    const int32_t root_slot = alloc_slot();
+    {
+        HistSlab& root = pool[root_slot];
+        std::memset(root.gh.data(), 0, gh_sz * sizeof(float));
+        std::memset(root.cnt.data(), 0, cnt_sz * sizeof(int32_t));
+        for (int32_t f = 0; f < num_f; f++) {
+            const uint8_t* col = bins_fm + (size_t)f * n;
+            float* ghf = root.gh.data() + (size_t)f * num_bins * 2;
+            int32_t* cntf = root.cnt.data() + (size_t)f * num_bins;
+            if (row_mask) {
+                for (int64_t i = 0; i < n; i++) {
+                    if (!row_mask[i]) continue;
+                    const uint32_t bv = col[i];
+                    ghf[bv * 2 + 0] += grad[i];
+                    ghf[bv * 2 + 1] += hess[i];
+                    cntf[bv] += 1;
+                }
+            } else {
+                for (int64_t i = 0; i < n; i++) {
+                    const uint32_t bv = col[i];
+                    ghf[bv * 2 + 0] += grad[i];
+                    ghf[bv * 2 + 1] += hess[i];
+                    cntf[bv] += 1;
+                }
+            }
+        }
+    }
+
+    std::priority_queue<HeapEntry, std::vector<HeapEntry>, HeapCmp> heap;
+    int64_t order = 0;
+    {
+        const HistSlab& root = pool[root_slot];
+        float G = 0, H = 0;
+        int64_t C = 0;
+        for (int32_t t = 0; t < num_bins; t++) {
+            G += root.gh[(size_t)t * 2 + 0];
+            H += root.gh[(size_t)t * 2 + 1];
+            C += root.cnt[t];
+        }
+        o_count[0] = (int32_t)C;
+        o_weight[0] = (double)H;
+        BestSplit s = find_best(root, num_f, num_bins, feature_mask, l1, l2,
+                                min_hess, min_data);
+        if (std::isfinite(s.gain) && s.gain > (float)min_gain_to_split &&
+            (max_depth <= 0 || 0 < max_depth)) {
+            heap.push({s.gain, order++, 0, root_slot, 0, s});
+        } else {
+            free_slots.push_back(root_slot);
+        }
+        // an unsplit root keeps value 0.0 (grow_tree parity: the booster's
+        // init_score carries the base prediction)
+    }
+
+    std::vector<int64_t> scratch(n);
+    std::vector<float> gh_gather;  // packed (grad, hess) of gathered rows
+    int32_t n_nodes = 1, n_leaves_cur = 1;
+
+    while (!heap.empty() && n_leaves_cur < num_leaves) {
+        HeapEntry e = heap.top(); heap.pop();
+        const BestSplit& s = e.split;  // evaluated at push time
+        const int32_t nid = e.node, f = s.feature, tb = s.bin;
+        const int32_t lid = n_nodes, rid = n_nodes + 1;
+        n_nodes += 2;
+
+        o_feature[nid] = f;
+        o_threshold_bin[nid] = tb;
+        o_default_left[nid] = s.default_left ? 1 : 0;
+        o_left[nid] = lid; o_right[nid] = rid;
+        o_gain[nid] = s.gain;
+        o_value[nid] = 0.0;
+
+        // stable partition of the node's rows (ALL rows, masked or not —
+        // row order stays ascending so child histograms accumulate in the
+        // same order the masked scatter would)
+        const uint8_t* bf = bins_fm + (size_t)f * n;
+        const int64_t start = node_start[nid], len = node_len[nid];
+        int64_t nl = 0, nr = 0;
+        for (int64_t i = 0; i < len; i++) {
+            const int64_t r = idx[start + i];
+            const uint8_t bv = bf[r];
+            const bool go_left = (bv == 0) ? s.default_left : (bv <= tb);
+            if (go_left) idx[start + nl++] = r;
+            else scratch[nr++] = r;
+        }
+        std::memcpy(idx.data() + start + nl, scratch.data(),
+                    (size_t)nr * sizeof(int64_t));
+        node_start[lid] = start;        node_len[lid] = nl;
+        node_start[rid] = start + nl;   node_len[rid] = nr;
+
+        // child sums from the split (f32 sums like SplitInfo, f64 leaf math)
+        const double lsum[3] = {(double)s.lg, (double)s.lh, (double)s.lc};
+        const double rsum[3] = {(double)(s.tg - s.lg), (double)(s.th - s.lh),
+                                (double)(s.tc - s.lc)};  // counts exact ints
+        for (int32_t ci = 0; ci < 2; ci++) {
+            const double* sums = ci == 0 ? lsum : rsum;
+            const int32_t cid = ci == 0 ? lid : rid;
+            double gt = std::copysign(
+                std::max(std::fabs(sums[0]) - lambda_l1, 0.0), sums[0]);
+            if (sums[0] == 0.0) gt = 0.0;
+            double v = -gt / (sums[1] + lambda_l2);
+            if (max_delta_step > 0)
+                v = std::max(-max_delta_step, std::min(max_delta_step, v));
+            o_value[cid] = v;
+            o_count[cid] = (int32_t)sums[2];
+            o_weight[cid] = sums[1];
+        }
+        n_leaves_cur += 1;
+
+        // smaller child by MASKED count (lsum[2] <= rsum[2] -> left)
+        const bool left_small = lsum[2] <= rsum[2];
+        const int32_t small_id = left_small ? lid : rid;
+        const int32_t big_id = left_small ? rid : lid;
+
+        // small child's histogram from its rows (feature-major: gathers stay
+        // within one column at a time); sibling by subtraction. Masked rows
+        // are compacted once so the per-feature pass touches only them, and
+        // the gathered grad/hess are packed into a contiguous pair buffer so
+        // every feature pass reads them sequentially.
+        const int32_t small_slot = alloc_slot();
+        HistSlab& h_small = pool[small_slot];
+        std::memset(h_small.gh.data(), 0, gh_sz * sizeof(float));
+        std::memset(h_small.cnt.data(), 0, cnt_sz * sizeof(int32_t));
+        {
+            const int64_t ss = node_start[small_id], sl = node_len[small_id];
+            int64_t nm = 0;  // masked rows of the small child -> scratch
+            for (int64_t i = 0; i < sl; i++) {
+                const int64_t r = idx[ss + i];
+                if (!row_mask || row_mask[r]) scratch[nm++] = r;
+            }
+            gh_gather.resize((size_t)nm * 2);
+            for (int64_t i = 0; i < nm; i++) {
+                gh_gather[(size_t)i * 2 + 0] = grad[scratch[i]];
+                gh_gather[(size_t)i * 2 + 1] = hess[scratch[i]];
+            }
+            for (int32_t ff = 0; ff < num_f; ff++) {
+                const uint8_t* col = bins_fm + (size_t)ff * n;
+                float* ghf = h_small.gh.data() + (size_t)ff * num_bins * 2;
+                int32_t* cntf = h_small.cnt.data() + (size_t)ff * num_bins;
+                for (int64_t i = 0; i < nm; i++) {
+                    const uint32_t bv = col[scratch[i]];
+                    ghf[bv * 2 + 0] += gh_gather[(size_t)i * 2 + 0];
+                    ghf[bv * 2 + 1] += gh_gather[(size_t)i * 2 + 1];
+                    cntf[bv] += 1;
+                }
+            }
+        }
+        // parent slab becomes the big child's histogram in place
+        // (subtract_histogram semantics: clamp hess/count at >= 0)
+        const int32_t big_slot = e.hist_slot;
+        {
+            HistSlab& h_big = pool[big_slot];
+            float* bg = h_big.gh.data();
+            const float* sg = h_small.gh.data();
+            for (size_t i = 0; i < gh_sz; i += 2) {
+                bg[i + 0] -= sg[i + 0];
+                bg[i + 1] = std::max(bg[i + 1] - sg[i + 1], 0.0f);
+            }
+            int32_t* bc = h_big.cnt.data();
+            const int32_t* sc = h_small.cnt.data();
+            for (size_t i = 0; i < cnt_sz; i++)
+                bc[i] = std::max(bc[i] - sc[i], 0);
+        }
+
+        // push children: csums[2] >= 2*min_data_in_leaf, gain/depth gates
+        const int32_t child_depth = e.depth + 1;
+        for (int32_t ci = 0; ci < 2; ci++) {
+            const int32_t cid = ci == 0 ? small_id : big_id;
+            const int32_t slot = ci == 0 ? small_slot : big_slot;
+            const double* sums = cid == lid ? lsum : rsum;
+            bool pushed = false;
+            if (sums[2] >= 2.0 * min_data_in_leaf) {
+                BestSplit cs = find_best(pool[slot], num_f, num_bins,
+                                         feature_mask, l1, l2, min_hess,
+                                         min_data);
+                if (std::isfinite(cs.gain) &&
+                    cs.gain > (float)min_gain_to_split &&
+                    (max_depth <= 0 || child_depth < max_depth)) {
+                    heap.push({cs.gain, order++, cid, slot, child_depth, cs});
+                    pushed = true;
+                }
+            }
+            if (!pushed) free_slots.push_back(slot);
+        }
+    }
+
+    // final row -> node routing
+    for (int32_t nid = 0; nid < n_nodes; nid++) {
+        if (o_feature[nid] >= 0) continue;  // internal
+        const int64_t start = node_start[nid], len = node_len[nid];
+        for (int64_t i = 0; i < len; i++) o_leaf_of_row[idx[start + i]] = nid;
+    }
+    return n_nodes;
+}
+
+extern "C" int32_t mml_version() { return 4; }

@@ -1,0 +1,239 @@
+"""Native C++ GBDT training engine (mml_gbdt_grow_tree + booster._train_native).
+
+The reference's training engine is LightGBM's C++ core driven through
+LGBM_BoosterUpdateOneIter (lightgbm/TrainUtils.scala:170-233); the repo's
+native grower is its small-N host equivalent, mirroring the XLA growers'
+split semantics (histogram.find_best_split). These tests gate:
+
+- tree-structure parity vs the XLA host grower on separable data,
+- accuracy parity across objectives and boosting variants,
+- the eligibility gate (env forcing, categorical/lambdarank exclusion),
+- early stopping / continuation / persistence through the native path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import native_loader as NL
+from mmlspark_tpu.gbdt import booster as B
+from mmlspark_tpu.gbdt.booster import Booster, TrainParams
+
+
+def synth(n=2000, f=6, seed=0, classes=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 2.0 - X[:, 1] + 0.5 * X[:, 2]
+    if classes == 2:
+        y = (logit + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    else:
+        q = np.quantile(logit, np.linspace(0, 1, classes + 1)[1:-1])
+        y = np.digitize(logit, q).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def native():
+    if not NL.available():
+        pytest.skip("native toolchain unavailable")
+    return NL
+
+
+def fit_native(params, X, y, **kw):
+    os.environ["MMLSPARK_TPU_NATIVE_TRAIN"] = "1"
+    try:
+        return B.train(params, X, y, **kw)
+    finally:
+        del os.environ["MMLSPARK_TPU_NATIVE_TRAIN"]
+
+
+def fit_xla(params, X, y, **kw):
+    os.environ["MMLSPARK_TPU_NATIVE_TRAIN"] = "0"
+    try:
+        return B.train(params, X, y, **kw)
+    finally:
+        del os.environ["MMLSPARK_TPU_NATIVE_TRAIN"]
+
+
+class TestStructureParity:
+    @pytest.mark.parametrize("objective", ["binary", "regression"])
+    def test_trees_match_xla_host_grower(self, native, objective):
+        X, y = synth(2000)
+        params = TrainParams(objective=objective, num_iterations=5,
+                             num_leaves=15, min_data_in_leaf=20,
+                             learning_rate=0.1, seed=0)
+        bn = fit_native(params, X, y)
+        bx = fit_xla(params, X, y)
+        assert len(bn.trees) == len(bx.trees)
+        for gn, gx in zip(bn.trees, bx.trees):
+            for tn, tx in zip(gn, gx):
+                np.testing.assert_array_equal(tn.feature, tx.feature)
+                np.testing.assert_array_equal(tn.threshold_bin,
+                                              tx.threshold_bin)
+                np.testing.assert_array_equal(tn.left, tx.left)
+                np.testing.assert_array_equal(tn.right, tx.right)
+                np.testing.assert_array_equal(tn.default_left,
+                                              tx.default_left)
+                # identical structure; values carry f32 accumulation-order
+                # noise (sequential C++ sums vs the XLA scatter)
+                np.testing.assert_allclose(tn.value, tx.value, rtol=5e-3,
+                                           atol=1e-5)
+                np.testing.assert_array_equal(tn.count, tx.count)
+
+    def test_missing_values_match(self, native):
+        X, y = synth(1500)
+        X[::7, 1] = np.nan
+        X[::11, 0] = np.nan
+        params = TrainParams(objective="binary", num_iterations=4,
+                             num_leaves=7, min_data_in_leaf=10, seed=0)
+        bn, bx = fit_native(params, X, y), fit_xla(params, X, y)
+        for gn, gx in zip(bn.trees, bx.trees):
+            for tn, tx in zip(gn, gx):
+                np.testing.assert_array_equal(tn.feature, tx.feature)
+                np.testing.assert_array_equal(tn.default_left,
+                                              tx.default_left)
+        np.testing.assert_allclose(bn.raw_predict(X), bx.raw_predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_constraints_respected(self, native):
+        X, y = synth(1200)
+        params = TrainParams(objective="binary", num_iterations=3,
+                             num_leaves=31, max_depth=3, min_data_in_leaf=50,
+                             lambda_l2=2.0, seed=0)
+        bn = fit_native(params, X, y)
+        for g in bn.trees:
+            for t in g:
+                assert t.num_leaves <= 2 ** 3
+                leaf_counts = t.count[t.feature == -1]
+                assert (leaf_counts >= 50).all()
+                # depth bound: walk every leaf
+                depth = np.zeros(len(t.feature), dtype=int)
+                for nid in range(len(t.feature)):
+                    if t.feature[nid] >= 0:
+                        depth[t.left[nid]] = depth[nid] + 1
+                        depth[t.right[nid]] = depth[nid] + 1
+                assert depth.max() <= 3
+
+
+class TestAccuracyParity:
+    @pytest.mark.parametrize("boosting", ["gbdt", "goss", "rf", "dart"])
+    def test_boosting_variants(self, native, boosting):
+        X, y = synth(4000, seed=1)
+        params = TrainParams(objective="binary", boosting_type=boosting,
+                             num_iterations=15, num_leaves=15,
+                             min_data_in_leaf=20, bagging_fraction=0.8,
+                             bagging_freq=1, seed=0)
+        bn = fit_native(params, X, y)
+        acc = np.mean((bn.raw_predict(X) > 0) == y)
+        assert acc > 0.85
+
+    def test_multiclass(self, native):
+        X, y = synth(3000, classes=3, seed=2)
+        params = TrainParams(objective="multiclass", num_class=3,
+                             num_iterations=10, num_leaves=15,
+                             min_data_in_leaf=20, seed=0)
+        bn = fit_native(params, X, y)
+        pred = bn.raw_predict(X).argmax(axis=1)
+        assert np.mean(pred == y) > 0.8
+
+    @pytest.mark.parametrize("objective", ["regression", "regression_l1",
+                                           "quantile", "huber", "poisson"])
+    def test_regression_objectives(self, native, objective):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(2000, 5))
+        y = np.abs(X[:, 0] * 3 + X[:, 1] + rng.normal(0, 0.3, 2000)) + 0.1
+        params = TrainParams(objective=objective, num_iterations=10,
+                             num_leaves=15, min_data_in_leaf=20, seed=0)
+        bn = fit_native(params, X, y)
+        pred = bn.raw_predict(X)
+        if objective == "poisson":
+            pred = np.exp(pred)
+        if objective == "quantile":
+            # a 0.9-quantile predictor is judged by coverage, not MSE
+            cov = np.mean(y <= pred)
+            assert 0.8 < cov <= 1.0, cov
+        else:
+            # better than predicting the mean
+            assert np.mean((pred - y) ** 2) < np.var(y)
+
+    def test_weights_shift_the_fit(self, native):
+        X, y = synth(2000, seed=4)
+        w = np.where(y > 0, 10.0, 1.0)
+        params = TrainParams(objective="binary", num_iterations=8,
+                             num_leaves=7, min_data_in_leaf=10, seed=0)
+        b_w = fit_native(params, X, y, weights=w)
+        b_u = fit_native(params, X, y)
+        # upweighting positives raises predicted scores on average
+        assert b_w.raw_predict(X).mean() > b_u.raw_predict(X).mean()
+
+    def test_feature_fraction(self, native):
+        X, y = synth(2000, seed=5)
+        params = TrainParams(objective="binary", num_iterations=10,
+                             num_leaves=7, min_data_in_leaf=10,
+                             feature_fraction=0.5, seed=0)
+        bn = fit_native(params, X, y)
+        assert np.mean((bn.raw_predict(X) > 0) == y) > 0.8
+
+
+class TestNativeFlow:
+    def test_early_stopping(self, native):
+        X, y = synth(3000, seed=6)
+        Xv, yv = synth(800, seed=7)
+        params = TrainParams(objective="binary", num_iterations=200,
+                             num_leaves=31, min_data_in_leaf=2,
+                             early_stopping_round=5)
+        bn = fit_native(params, X, y, valid=(Xv, yv))
+        assert bn.best_iteration > 0
+        assert len(bn.trees) < 200
+
+    def test_continuation_and_merge(self, native):
+        X, y = synth(1500, seed=8)
+        params = TrainParams(objective="binary", num_iterations=5,
+                             num_leaves=7, min_data_in_leaf=5, seed=0)
+        b1 = fit_native(params, X, y)
+        b2 = fit_native(params, X, y, init_model=b1)
+        assert len(b2.trees) == 10
+        np.testing.assert_allclose(
+            b2.raw_predict(X[:50]),
+            Booster.from_string(b2.to_string()).raw_predict(X[:50]),
+            atol=1e-12)
+
+    def test_log_and_train_metric(self, native):
+        X, y = synth(1000, seed=9)
+        lines = []
+        params = TrainParams(objective="binary", num_iterations=3,
+                             num_leaves=7, min_data_in_leaf=5,
+                             train_metric=True)
+        fit_native(params, X, y, log=lines.append)
+        assert len(lines) == 3 and "train binary_logloss" in lines[0]
+
+    def test_gate_excludes_categorical_and_lambdarank(self, native):
+        p_cat = TrainParams(objective="binary", categorical_feature=(0,))
+        assert not B._native_train_ok(p_cat, 100)
+        p_rank = TrainParams(objective="lambdarank")
+        assert not B._native_train_ok(p_rank, 100)
+        p_bins = TrainParams(objective="binary", max_bin=1024)
+        assert not B._native_train_ok(p_bins, 100)
+
+    def test_gate_respects_path_forcing_envs(self, native, monkeypatch):
+        p = TrainParams(objective="binary")
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
+        assert not B._native_train_ok(p, 100)
+        monkeypatch.delenv("MMLSPARK_TPU_SCAN_TRAIN")
+        monkeypatch.setenv("MMLSPARK_TPU_NATIVE_TRAIN", "0")
+        assert not B._native_train_ok(p, 100)
+
+    def test_lgbm_text_roundtrip(self, native):
+        from mmlspark_tpu.gbdt.lgbm_format import (
+            from_lightgbm_string,
+            to_lightgbm_string,
+        )
+
+        X, y = synth(1200, seed=10)
+        params = TrainParams(objective="binary", num_iterations=5,
+                             num_leaves=7, min_data_in_leaf=5, seed=0)
+        bn = fit_native(params, X, y)
+        back = from_lightgbm_string(to_lightgbm_string(bn))
+        np.testing.assert_allclose(back.raw_predict(X[:100]),
+                                   bn.raw_predict(X[:100]), atol=1e-6)
