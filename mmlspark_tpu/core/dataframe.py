@@ -166,7 +166,8 @@ class DataFrame:
 
     # -- columnar ops ----------------------------------------------------
     def select(self, *names: str) -> "DataFrame":
-        names = tuple(n for group in names for n in (group if isinstance(group, (list, tuple)) else [group]))
+        names = tuple(n for group in names for n in
+                      (group if isinstance(group, (list, tuple)) else [group]))
         for n in names:
             self._schema.require(n)
         parts = [{n: p[n] for n in names} for p in self._partitions]
@@ -283,7 +284,8 @@ class DataFrame:
                 if v.dtype == object:
                     keep &= np.array([x is not None for x in v], dtype=bool)
                 elif v.dtype.kind == "f":
-                    keep &= ~np.isnan(v) if v.ndim == 1 else ~np.isnan(v).any(axis=tuple(range(1, v.ndim)))
+                    keep &= (~np.isnan(v) if v.ndim == 1 else
+                             ~np.isnan(v).any(axis=tuple(range(1, v.ndim))))
             return keep
         return self.filter(mask)
 

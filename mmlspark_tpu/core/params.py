@@ -57,7 +57,8 @@ class Param:
             raise ValueError(f"Param '{self.name}' failed validation with value {value!r}")
 
     def coerce(self, value: Any) -> Any:
-        if value is not None and self.ptype is float and isinstance(value, int) and not isinstance(value, bool):
+        if (value is not None and self.ptype is float
+                and isinstance(value, int) and not isinstance(value, bool)):
             return float(value)
         return value
 
@@ -99,7 +100,8 @@ class ServiceParam(Param):
             return
         if not (isinstance(value, dict) and (set(value) <= {"value", "col"}) and len(value) == 1):
             raise TypeError(
-                f"ServiceParam '{self.name}' expects {{'value': v}} or {{'col': name}}, got {value!r}"
+                f"ServiceParam '{self.name}' expects {{'value': v}} or "
+                f"{{'col': name}}, got {value!r}"
             )
         if "col" in value and not isinstance(value["col"], str):
             raise TypeError(f"ServiceParam '{self.name}' column name must be str")

@@ -246,7 +246,8 @@ class ImageSetAugmenter(Transformer, HasInputCol, HasOutputCol):
                     if row is None:
                         out[i] = None
                         continue
-                    img = ImageSchema.to_array(row) if ImageSchema.is_image(row) else np.asarray(row)
+                    img = (ImageSchema.to_array(row)
+                           if ImageSchema.is_image(row) else np.asarray(row))
                     origin = row.get("origin", "") if isinstance(row, dict) else ""
                     out[i] = ImageSchema.make(ops.flip(img, code), origin)
                 return out

@@ -87,10 +87,12 @@ class PortForwarder:
                 self._proc, self.remote_port = proc, port
                 return proc, port
             last_err = f"ssh exited rc={proc.returncode} for port {port}"
+        cmd = shlex.join(build_ssh_command(
+            self.username, self.ssh_host, self.ssh_port, self.bind_address,
+            self.remote_port_start, self.local_host, self.local_port))
         raise RuntimeError(
             f"could not establish reverse forward after "
-            f"{self.max_retries + 1} attempts: {last_err} "
-            f"(cmd: {shlex.join(build_ssh_command(self.username, self.ssh_host, self.ssh_port, self.bind_address, self.remote_port_start, self.local_host, self.local_port))})")
+            f"{self.max_retries + 1} attempts: {last_err} (cmd: {cmd})")
 
     @property
     def remote_address(self) -> str:

@@ -395,7 +395,8 @@ class SummarizeData(Transformer):
             if self.get("basic"):
                 has = numeric.size > 0
                 stats["Mean"] = float(numeric.mean()) if has else None
-                stats["Standard Deviation"] = float(numeric.std(ddof=1)) if numeric.size > 1 else None
+                stats["Standard Deviation"] = (
+                    float(numeric.std(ddof=1)) if numeric.size > 1 else None)
                 stats["Min"] = float(numeric.min()) if has else None
                 stats["Max"] = float(numeric.max()) if has else None
             if self.get("sample"):

@@ -1,0 +1,19 @@
+"""Style gate as a test: a style break fails the suite locally, not just CI.
+
+Reference parity: scalastyle runs before everything in CI
+(pipeline.yaml:30-42); here the committed rule set (tools/ci/stylecheck.py)
+is additionally part of `pytest tests/`.
+"""
+
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT / "tools" / "ci"))
+
+import stylecheck  # noqa: E402
+
+
+def test_repo_passes_style_gate():
+    errors = stylecheck.run(ROOT)
+    assert not errors, "\n".join(errors)

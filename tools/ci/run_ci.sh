@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Local CI entry point — the same gate as .github/workflows/ci.yml, runnable
+# with one command on a dev checkout (reference analogue: the sbt tasks the
+# pipeline calls, runnable locally).
+#
+#   tools/ci/run_ci.sh            # style + full matrix + flaky lane + smoke
+#   tools/ci/run_ci.sh style      # style gate only
+#   tools/ci/run_ci.sh tests      # per-package matrix only
+#   tools/ci/run_ci.sh flaky      # retried serving suites only
+set -u
+cd "$(dirname "$0")/../.."
+
+stage="${1:-all}"
+rc=0
+
+if [ "$stage" = "style" ] || [ "$stage" = "all" ]; then
+  echo "=== style gate ==="
+  python tools/ci/stylecheck.py || exit 1  # style gates everything (pipeline.yaml:30-42)
+  [ "$stage" = "style" ] && exit 0
+fi
+
+# per-package matrix — keep in sync with ci.yml's `suite:` list
+PACKAGES=(
+  "tests/test_core.py tests/test_stages.py tests/test_featurize_train.py"
+  "tests/test_gbdt.py tests/test_pallas_hist.py tests/test_benchmarks.py tests/test_lgbm_format.py tests/test_gbdt_sparse.py tests/test_gbdt_categorical.py tests/test_gbdt_native_train.py"
+  "tests/test_vw.py tests/test_automl_recommendation.py tests/test_lime.py"
+  "tests/test_models.py tests/test_onnx.py tests/test_downloader.py tests/test_native.py"
+  "tests/test_cognitive.py tests/test_style.py tests/test_helm_chart.py"
+  "tests/test_fuzzing.py"
+  "tests/test_attention.py tests/test_parallel_pp_ep.py"
+  "tests/test_codegen_cli.py tests/test_rgen.py tests/test_plot.py tests/test_datagen.py"
+  "tests/test_benchmarks_extended.py"
+  "tests/test_multiprocess.py"
+  "tests/test_examples.py"
+)
+
+if [ "$stage" = "tests" ] || [ "$stage" = "all" ]; then
+  for pkg in "${PACKAGES[@]}"; do
+    echo "=== package: $pkg ==="
+    # shellcheck disable=SC2086
+    python -m pytest $pkg -q || rc=1
+  done
+  [ "$stage" = "tests" ] && exit $rc
+fi
+
+if [ "$stage" = "flaky" ] || [ "$stage" = "all" ]; then
+  echo "=== flaky-retried serving suites (pipeline.yaml:286-291) ==="
+  ok=1
+  for attempt in 1 2 3; do
+    if python -m pytest tests/test_io_serving.py -q; then ok=0; break; fi
+    echo "flaky attempt $attempt failed; retrying"
+  done
+  [ $ok -ne 0 ] && rc=1
+fi
+
+if [ "$stage" = "all" ]; then
+  echo "=== entry-point smoke (driver contract) ==="
+  python __graft_entry__.py || rc=1
+fi
+
+exit $rc

@@ -1,0 +1,79 @@
+"""In-repo style gate (scalastyle-config.xml equivalent, self-contained).
+
+The reference enforces committed style rules in CI before anything else
+(pipeline.yaml:30-42). This image ships no ruff/flake8, so the gate is a
+dependency-free checker enforcing the rule set below; `.github/workflows/
+ci.yml` maps the same rules onto ruff for environments that have it
+(E501/W191/W291/W292/F401-adjacent). Runs as part of the suite
+(tests/test_style.py) so a style break fails `pytest` locally, not just CI.
+
+Rules (committed, like scalastyle-config.xml):
+  max-line-length 100 | no tabs | no trailing whitespace | file ends with
+  exactly one newline | no merge-conflict markers | no star imports in
+  library code | no mutable default arguments (list/dict/set literals).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+MAX_LINE = 100
+CHECKED_DIRS = ("mmlspark_tpu", "tests", "tools", "examples")
+_MUTABLE_DEFAULT = re.compile(r"def \w+\([^)]*=\s*(\[\]|\{\}|set\(\))")
+_CONFLICT = re.compile(r"^(<{7}|>{7}|={7})( |$)")
+
+
+def check_file(path: Path) -> list:
+    errors = []
+    try:
+        text = path.read_text(encoding="utf-8")
+    except UnicodeDecodeError:
+        return [f"{path}:1: not valid utf-8"]
+    lines = text.split("\n")
+    for i, line in enumerate(lines, 1):
+        if len(line) > MAX_LINE:
+            errors.append(f"{path}:{i}: line too long ({len(line)} > {MAX_LINE})")
+        if "\t" in line:
+            errors.append(f"{path}:{i}: tab character")
+        if line != line.rstrip():
+            errors.append(f"{path}:{i}: trailing whitespace")
+        if _CONFLICT.match(line):
+            errors.append(f"{path}:{i}: merge conflict marker")
+        if _MUTABLE_DEFAULT.search(line):
+            errors.append(f"{path}:{i}: mutable default argument")
+        if ("import *" in line and line.strip().startswith("from")
+                and "mmlspark_tpu" in str(path)):
+            errors.append(f"{path}:{i}: star import in library code")
+    if text and not text.endswith("\n"):
+        errors.append(f"{path}:{len(lines)}: missing trailing newline")
+    if text.endswith("\n\n"):
+        errors.append(f"{path}:{len(lines)}: multiple trailing newlines")
+    return errors
+
+
+def run(root: Path) -> list:
+    errors = []
+    for d in CHECKED_DIRS:
+        base = root / d
+        if not base.is_dir():
+            continue
+        for path in sorted(base.rglob("*.py")):
+            errors.extend(check_file(path))
+    return errors
+
+
+def main() -> int:
+    root = Path(__file__).resolve().parents[2]
+    errors = run(root)
+    for e in errors:
+        print(e)
+    n_files = sum(1 for d in CHECKED_DIRS if (root / d).is_dir()
+                  for _ in (root / d).rglob("*.py"))
+    print(f"stylecheck: {n_files} files, {len(errors)} error(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
