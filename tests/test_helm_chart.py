@@ -1,0 +1,127 @@
+"""Serving-cluster chart render tests (reference: tools/helm/spark charts).
+
+The chart (tools/helm/mmlspark-serving) deploys RoutingFront + N
+ServingServer workers with optional token auth, SSH port-forwarding, TPU
+nodepool scheduling, and a multi-host training StatefulSet. Rendered
+through the in-repo subset renderer (tools/k8s/render.py) — the same
+templates render identically under real helm."""
+
+import pathlib
+import sys
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT / "tools" / "k8s"))
+
+import render  # noqa: E402
+
+CHART = ROOT / "tools" / "helm" / "mmlspark-serving"
+
+
+def render_docs(overrides=None, release="mmlspark"):
+    text = render.render_chart(CHART, overrides, release_name=release)
+    docs = [d for d in yaml.safe_load_all(text) if d]
+    return text, docs
+
+
+def by_kind_name(docs, kind, suffix):
+    for d in docs:
+        if d["kind"] == kind and d["metadata"]["name"].endswith(suffix):
+            return d
+    found = [(d["kind"], d["metadata"]["name"]) for d in docs]
+    raise AssertionError(f"no {kind} *{suffix} in {found}")
+
+
+class TestDefaults:
+    def test_default_render_front_and_workers(self):
+        _, docs = render_docs()
+        front = by_kind_name(docs, "Deployment", "-front")
+        svc = by_kind_name(docs, "Service", "-front")
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        assert front["spec"]["replicas"] == 1
+        assert worker["spec"]["replicas"] == 2
+        assert svc["spec"]["ports"][0]["port"] == 8898
+        wc = worker["spec"]["template"]["spec"]["containers"][0]
+        assert wc["ports"][0]["containerPort"] == 8899
+        # worker registers against the front service by release name
+        assert "http://mmlspark-front:8898/" in wc["args"][0]
+        # defaults: no token secret, no forwarding env, no TPU resources
+        env_names = [e["name"] for e in wc.get("env", [])]
+        assert "FORWARD_SSH_HOST" not in env_names
+        assert "MMLSPARK_TOKEN" not in env_names
+        assert "resources" not in wc
+
+    def test_release_name_propagates(self):
+        _, docs = render_docs(release="prod")
+        by_kind_name(docs, "Deployment", "prod-front")
+        by_kind_name(docs, "Deployment", "prod-worker")
+
+
+class TestOptions:
+    def test_token_auth_wires_secret(self):
+        _, docs = render_docs({"token": {"enabled": True,
+                                         "value": "s3cret"}})
+        secret = by_kind_name(docs, "Secret", "mmlspark-token")
+        assert secret["stringData"]["token"] == "s3cret"
+        for suffix in ("-front", "-worker"):
+            dep = by_kind_name(docs, "Deployment", suffix)
+            env = dep["spec"]["template"]["spec"]["containers"][0]["env"]
+            tok = [e for e in env if e["name"] == "MMLSPARK_TOKEN"]
+            assert tok and tok[0]["valueFrom"]["secretKeyRef"]["name"] == \
+                "mmlspark-token"
+
+    def test_port_forwarding_env(self):
+        _, docs = render_docs({"portForwarding": {
+            "enabled": True, "sshHost": "gw.example.com",
+            "remotePortStart": 9100}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value")
+               for e in worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["FORWARD_SSH_HOST"] == "gw.example.com"
+        assert env["FORWARD_PORT_START"] == "9100"
+
+    def test_tpu_nodepool(self):
+        _, docs = render_docs({"tpu": {"enabled": True, "count": 4}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        spec = worker["spec"]["template"]["spec"]
+        assert spec["nodeSelector"][
+            "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+        res = spec["containers"][0]["resources"]["limits"]
+        assert res["google.com/tpu"] == 4
+
+    def test_scaling_workers(self):
+        _, docs = render_docs({"worker": {"replicas": 8}})
+        assert by_kind_name(docs, "Deployment",
+                            "-worker")["spec"]["replicas"] == 8
+
+    def test_train_statefulset(self):
+        _, docs = render_docs({"train": {"enabled": True, "replicas": 4}})
+        ss = by_kind_name(docs, "StatefulSet", "-train")
+        svc = by_kind_name(docs, "Service", "-train")
+        assert ss["spec"]["replicas"] == 4
+        assert svc["spec"]["clusterIP"] == "None"  # headless
+        args = ss["spec"]["template"]["spec"]["containers"][0]["args"][0]
+        assert "initialize_distributed" in args
+        assert "mmlspark-train-0.mmlspark-train:8476" in args
+        assert "num_processes=4" in args
+
+    def test_chart_code_snippets_reference_real_api(self):
+        # the pod commands import these symbols; keep the chart honest
+        from mmlspark_tpu.parallel.mesh import initialize_distributed  # noqa
+        from mmlspark_tpu.serving import (  # noqa
+            RoutingFront,
+            register_worker,
+            serve_pipeline,
+        )
+        from mmlspark_tpu.serving.port_forwarding import PortForwarder  # noqa
+        import inspect
+
+        sig = inspect.signature(initialize_distributed)
+        assert {"coordinator_address", "num_processes",
+                "process_id"} <= set(sig.parameters)
+        sig = inspect.signature(PortForwarder)
+        assert {"username", "ssh_host", "ssh_port",
+                "remote_port_start", "local_port"} <= set(sig.parameters)

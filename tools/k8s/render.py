@@ -1,0 +1,161 @@
+"""Helm-chart subset renderer: render tools/helm/* without helm.
+
+This image ships no helm binary, so charts are render-tested (and usable on
+clusters without helm) through this renderer. It implements the exact subset
+the in-repo charts use — helm itself renders them identically:
+
+  {{ .Values.path.to.key }}   value substitution (also .Release.Name,
+                              .Chart.Name)
+  {{- if .Values.x }} ...
+  {{- end }}                  boolean-truthy conditional blocks (may nest)
+
+Usage:
+  python tools/k8s/render.py tools/helm/mmlspark-serving [overrides.yaml]
+  python tools/k8s/render.py ... | kubectl apply -f -
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+_EXPR = re.compile(r"\{\{-?\s*([^}]+?)\s*-?\}\}")
+_IF = re.compile(r"^\s*\{\{-?\s*if\s+(.+?)\s*-?\}\}\s*$")
+_END = re.compile(r"^\s*\{\{-?\s*end\s*-?\}\}\s*$")
+
+
+def _parse_simple_yaml(text: str):
+    """Minimal YAML subset parser for values files (maps, scalars; two-space
+    indents). Falls back to pyyaml when available (full YAML)."""
+    try:
+        import yaml
+
+        return yaml.safe_load(text) or {}
+    except ImportError:
+        pass
+    root: dict = {}
+    stack = [(-1, root)]
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].rstrip() if not raw.strip().startswith("#") \
+            else ""
+        if not line.strip():
+            continue
+        indent = len(line) - len(line.lstrip())
+        key, _, val = line.strip().partition(":")
+        val = val.strip()
+        while stack and stack[-1][0] >= indent:
+            stack.pop()
+        parent = stack[-1][1]
+        if not val:
+            child: dict = {}
+            parent[key] = child
+            stack.append((indent, child))
+        else:
+            parent[key] = _coerce(val)
+    return root
+
+
+def _coerce(val: str):
+    if val.startswith(('"', "'")) and val.endswith(val[0]):
+        return val[1:-1]
+    if val in ("true", "True"):
+        return True
+    if val in ("false", "False"):
+        return False
+    try:
+        return int(val)
+    except ValueError:
+        pass
+    try:
+        return float(val)
+    except ValueError:
+        return val
+
+
+def _lookup(ctx: dict, expr: str):
+    expr = expr.strip()
+    if not expr.startswith("."):
+        raise ValueError(f"unsupported template expr: {expr!r}")
+    cur = ctx
+    for part in expr[1:].split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def render_template(text: str, ctx: dict) -> str:
+    """Render one template: conditionals first (line-based), then value
+    substitution."""
+    out_lines = []
+    # stack of bools: are we emitting at this nesting level?
+    emit_stack = [True]
+    for line in text.split("\n"):
+        m = _IF.match(line)
+        if m:
+            cond = bool(_lookup(ctx, m.group(1))) if all(emit_stack) else False
+            emit_stack.append(cond)
+            continue
+        if _END.match(line):
+            if len(emit_stack) == 1:
+                raise ValueError("unbalanced {{ end }}")
+            emit_stack.pop()
+            continue
+        if all(emit_stack):
+            out_lines.append(_EXPR.sub(
+                lambda m2: _fmt(_lookup(ctx, m2.group(1))), line))
+    if len(emit_stack) != 1:
+        raise ValueError("unclosed {{ if }}")
+    return "\n".join(out_lines)
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def _deep_update(base: dict, override: dict) -> dict:
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _deep_update(base[k], v)
+        else:
+            base[k] = v
+    return base
+
+
+def render_chart(chart_dir, overrides: dict | None = None,
+                 release_name: str = "mmlspark") -> str:
+    """Render every template of a chart; returns concatenated YAML docs."""
+    chart_dir = Path(chart_dir)
+    values = _parse_simple_yaml((chart_dir / "values.yaml").read_text())
+    if overrides:
+        _deep_update(values, overrides)
+    chart_meta = _parse_simple_yaml((chart_dir / "Chart.yaml").read_text())
+    ctx = {"Values": values,
+           "Release": {"Name": release_name},
+           "Chart": {"Name": chart_meta.get("name", chart_dir.name)}}
+    docs = []
+    for tpl in sorted((chart_dir / "templates").glob("*.yaml")):
+        rendered = render_template(tpl.read_text(), ctx).strip()
+        if rendered and rendered != "---":
+            docs.append(f"# Source: {tpl.name}\n{rendered}")
+    return "\n---\n".join(docs) + "\n"
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    overrides = None
+    if len(sys.argv) > 2:
+        overrides = _parse_simple_yaml(Path(sys.argv[2]).read_text())
+    sys.stdout.write(render_chart(sys.argv[1], overrides))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
