@@ -101,6 +101,137 @@ class DevicePrefetcher:
             self.close()
 
 
+class DynamicBufferedBatcher:
+    """Background-thread buffered batcher over any iterator: a producer
+    thread fills a bounded buffer (backpressure — it blocks at
+    ``max_buffer`` items); each ``next()`` drains EVERYTHING currently
+    buffered into one list, so batch size adapts to the consumer's speed
+    (slow consumer -> bigger batches, fast consumer -> batches of 1).
+
+    Reference parity: DynamicBufferedBatcher (stages/Batchers.scala:12-60)
+    — the iterator primitive under DynamicMiniBatchTransformer. Producer
+    exceptions re-raise at the consumer; ``close()`` releases the thread.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator, max_buffer: int = 1000):
+        import queue
+        import threading
+
+        if max_buffer <= 0:
+            raise ValueError("max_buffer must be positive")
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_buffer)
+        self._err: List[BaseException] = []
+        self._stop = threading.Event()
+
+        def offer(item) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in it:
+                    if self._stop.is_set() or not offer(item):
+                        return
+            except BaseException as e:  # noqa: BLE001 - re-raised at consumer
+                self._err.append(e)
+            finally:
+                offer(self._DONE)
+
+        self._thread = threading.Thread(target=produce, daemon=True,
+                                        name="dynamic-batcher")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+
+    def __iter__(self):
+        import queue
+
+        try:
+            done = False
+            while not done:
+                batch = [self._q.get()]  # block for at least one item
+                try:
+                    while True:
+                        batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    pass
+                if batch and batch[-1] is self._DONE:
+                    batch.pop()
+                    done = True
+                if batch:
+                    yield batch
+            if self._err:
+                raise self._err[0]
+        finally:
+            self.close()
+
+
+class TimeIntervalBatcher:
+    """Time-windowed batcher over any iterator: a producer thread buffers
+    items; batches flush every ``interval_s`` seconds (whatever arrived in
+    the window, >= 1 item) or at ``max_batch_size``, whichever first.
+
+    Reference parity: TimeIntervalMiniBatchTransformer's iterator
+    (stages/Batchers.scala:98-160). Windows with no items yield nothing
+    (the reference blocks for the first element too).
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator, interval_s: float = 1.0,
+                 max_batch_size: int = int(1e9), max_buffer: int = 1000):
+        self._interval = float(interval_s)
+        self._max_batch = int(max_batch_size)
+        self._inner = DynamicBufferedBatcher(it, max_buffer)
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def __iter__(self):
+        import queue
+        import time as _time
+
+        q, done_tok = self._inner._q, self._inner._DONE
+        try:
+            done = False
+            while not done:
+                batch = [q.get()]  # block for the window's first element
+                if batch[0] is done_tok:
+                    break
+                deadline = _time.monotonic() + self._interval
+                while len(batch) < self._max_batch:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        item = q.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    if item is done_tok:
+                        done = True
+                        break
+                    batch.append(item)
+                if batch:
+                    yield batch
+            if self._inner._err:
+                raise self._inner._err[0]
+        finally:
+            self.close()
+
+
 def next_bucket(n: int, buckets: Optional[Sequence[int]] = None, multiple: int = 8) -> int:
     """Smallest allowed static size >= n. Default: next power of two >= max(n, multiple)."""
     if n <= 0:

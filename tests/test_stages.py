@@ -254,3 +254,86 @@ class TestDevicePrefetcher:
         assert next(it) == 1
         with pytest.raises(RuntimeError, match="decode failed"):
             list(it)
+
+
+class TestIteratorBatchers:
+    """Public iterator-level batcher primitives (stages/Batchers.scala:12-160
+    — DynamicBufferedBatcher's buffered background thread + bounded-queue
+    backpressure, TimeIntervalBatcher's windowed flush)."""
+
+    def test_dynamic_batches_everything_in_order(self):
+        from mmlspark_tpu.parallel.batching import DynamicBufferedBatcher
+
+        batches = list(DynamicBufferedBatcher(iter(range(50))))
+        flat = [x for b in batches for x in b]
+        assert flat == list(range(50))
+        assert all(len(b) >= 1 for b in batches)
+
+    def test_dynamic_adapts_to_slow_consumer(self):
+        import time
+
+        from mmlspark_tpu.parallel.batching import DynamicBufferedBatcher
+
+        def producer():
+            for i in range(30):
+                time.sleep(0.002)
+                yield i
+
+        sizes = []
+        for batch in DynamicBufferedBatcher(producer()):
+            sizes.append(len(batch))
+            time.sleep(0.03)  # slow consumer: items pile up between pulls
+        assert sum(sizes) == 30
+        assert max(sizes) > 1  # buffering visibly batched
+
+    def test_dynamic_backpressure_bounds_buffer(self):
+        import time
+
+        from mmlspark_tpu.parallel.batching import DynamicBufferedBatcher
+
+        produced = []
+
+        def producer():
+            for i in range(100):
+                produced.append(i)
+                yield i
+
+        b = DynamicBufferedBatcher(producer(), max_buffer=5)
+        time.sleep(0.15)  # producer runs ahead only to the buffer bound
+        assert len(produced) <= 7  # 5 queued + the one in-flight + margin
+        flat = [x for batch in b for x in batch]
+        assert flat == list(range(100))
+
+    def test_dynamic_producer_exception(self):
+        from mmlspark_tpu.parallel.batching import DynamicBufferedBatcher
+
+        def bad():
+            yield 1
+            raise ValueError("boom")
+
+        with pytest.raises(ValueError, match="boom"):
+            for _ in DynamicBufferedBatcher(bad()):
+                pass
+
+    def test_time_interval_windows(self):
+        import time
+
+        from mmlspark_tpu.parallel.batching import TimeIntervalBatcher
+
+        def producer():
+            for i in range(6):
+                time.sleep(0.02)
+                yield i
+
+        batches = list(TimeIntervalBatcher(producer(), interval_s=0.05))
+        flat = [x for b in batches for x in b]
+        assert flat == list(range(6))
+        assert len(batches) >= 2  # windows split the stream
+
+    def test_time_interval_max_batch_size(self):
+        from mmlspark_tpu.parallel.batching import TimeIntervalBatcher
+
+        batches = list(TimeIntervalBatcher(iter(range(10)), interval_s=5.0,
+                                           max_batch_size=3))
+        assert [len(b) for b in batches][:3] == [3, 3, 3]
+        assert [x for b in batches for x in b] == list(range(10))
