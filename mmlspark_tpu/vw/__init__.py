@@ -18,11 +18,12 @@ from .stages import (
     VowpalWabbitClassifier,
     VowpalWabbitRegressionModel,
     VowpalWabbitRegressor,
+    parse_readable_model,
 )
 
 __all__ = [
     "LearnerConfig", "SparseDataset", "VowpalWabbitClassificationModel",
     "VowpalWabbitClassifier", "VowpalWabbitFeaturizer",
     "VowpalWabbitInteractions", "VowpalWabbitRegressionModel",
-    "VowpalWabbitRegressor", "train_linear",
+    "VowpalWabbitRegressor", "parse_readable_model", "train_linear",
 ]

@@ -9,7 +9,7 @@ plus typed params — both supported here and parsed into LearnerConfig.
 from __future__ import annotations
 
 import shlex
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -87,6 +87,38 @@ def parse_vw_args(args: str, base: Optional[LearnerConfig] = None) -> LearnerCon
     return cfg
 
 
+def parse_readable_model(text: str) -> Tuple[int, np.ndarray]:
+    """Parse a ``--readable_model`` text dump back into (num_bits, weights).
+
+    Closes the interchange loop of ``get_readable_model``: continued
+    training from a text dump (the reference's initialModel semantics,
+    vw/VowpalWabbitBase.scala:120-122, for the documented text surface —
+    docs/vw.md). Accepts both this repo's dump (``bits:N`` header) and a
+    real vw dump (``Num weight bits:N`` header, informational header lines
+    before the ``index:weight`` section are skipped)."""
+    num_bits = 18
+    entries = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or ":" not in line:
+            continue
+        key, _, val = line.rpartition(":")
+        key = key.strip()
+        if key in ("bits", "Num weight bits"):
+            num_bits = int(val)
+            continue
+        try:
+            idx, w = int(key), float(val)
+        except ValueError:
+            continue  # vw header lines (Version, Min label, ...)
+        entries.append((idx, w))
+    mask = (1 << num_bits) - 1
+    weights = np.zeros(1 << num_bits, dtype=np.float64)
+    for i, w in entries:
+        weights[i & mask] = w
+    return num_bits, weights
+
+
 class _VowpalWabbitBase(HasFeaturesCol, HasLabelCol, HasWeightCol):
     """Shared params (vw/VowpalWabbitBase.scala)."""
 
@@ -121,6 +153,16 @@ class _VowpalWabbitBase(HasFeaturesCol, HasLabelCol, HasWeightCol):
         if self.get("l2") is not None:
             cfg.l2 = self.get("l2")
         return parse_vw_args(self.get("passThroughArgs"), cfg)
+
+    def set_initial_model_readable(self, text: str) -> "_VowpalWabbitBase":
+        """Warm-start from a ``--readable_model`` text dump: sets numBits
+        from the dump's header and initialModel to its weights
+        (initialModel continuation semantics,
+        vw/VowpalWabbitBase.scala:120-122)."""
+        bits, weights = parse_readable_model(text)
+        self.set("numBits", bits)
+        self.set("initialModel", weights)
+        return self
 
     def _dataset(self, df: DataFrame, cfg: LearnerConfig,
                  label_transform=None) -> SparseDataset:

@@ -408,3 +408,49 @@ def test_readable_model_dump():
     idx, wval = lines[1].split(":")
     w = np.asarray(model.get("weights"))
     assert abs(w[int(idx)] - float(wval)) < 1e-5
+
+
+def test_readable_model_import_continue_training():
+    """Round trip the text dump: export -> parse -> continue training, and
+    compare against continuing from the in-memory weights directly
+    (initialModel semantics, vw/VowpalWabbitBase.scala:120-122). The dump
+    stores 6-decimal weights, so parity is tolerance-based."""
+    from mmlspark_tpu.vw import VowpalWabbitClassifier, parse_readable_model
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    df = DataFrame.from_dict(
+        {"features": [X[i] for i in range(len(X))], "label": y})
+    m1 = VowpalWabbitClassifier(numPasses=2).fit(df)
+    text = m1.get_readable_model()
+
+    bits, weights = parse_readable_model(text)
+    assert bits == 18
+    w1 = np.asarray(m1.get("weights"), dtype=np.float64)
+    np.testing.assert_allclose(weights, w1, atol=5e-7)
+
+    cont_text = (VowpalWabbitClassifier(numPasses=2)
+                 .set_initial_model_readable(text).fit(df))
+    cont_mem = VowpalWabbitClassifier(numPasses=2,
+                                      initialModel=w1).fit(df)
+    p_text = np.asarray(cont_text.transform(df).column("rawPrediction"),
+                        dtype=np.float64)
+    p_mem = np.asarray(cont_mem.transform(df).column("rawPrediction"),
+                       dtype=np.float64)
+    np.testing.assert_allclose(p_text, p_mem, atol=1e-3)
+    # continuation actually moved the weights
+    assert np.abs(np.asarray(cont_text.get("weights")) - w1).max() > 0
+
+
+def test_parse_readable_model_vw_header_format():
+    """A real vw dump has informational headers and 'Num weight bits'."""
+    from mmlspark_tpu.vw import parse_readable_model
+
+    text = ("Version 8.7.0\nId \nMin label:-1\nMax label:1\n"
+            "Num weight bits:10\nlda:0\n0 ngram:\n1 skip:\n"
+            "options:\nCheckpoint state, not reproducible\n"
+            "5:0.25\n1023:-1.5\n")
+    bits, w = parse_readable_model(text)
+    assert bits == 10 and len(w) == 1024
+    assert w[5] == 0.25 and w[1023] == -1.5
