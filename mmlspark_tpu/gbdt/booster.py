@@ -1057,10 +1057,13 @@ def _train_native(params: TrainParams, X: np.ndarray, y: np.ndarray,
                 g_abs = g_abs.sum(axis=1)
             top_n = int(n * params.top_rate)
             other_n = int(n * params.other_rate)
-            order = np.argsort(-g_abs)
+            # argpartition: the top-|g| SET is what GOSS needs, not its
+            # order — O(n) beats the device path's full argsort here
+            # (selection was ~20% of the native 200k GOSS fit)
+            part = np.argpartition(-g_abs, max(top_n - 1, 0))
             row_mask = np.zeros(n, dtype=bool)
-            row_mask[order[:top_n]] = True
-            rest = order[top_n:]
+            row_mask[part[:top_n]] = True
+            rest = part[top_n:]
             picked = rng.choice(len(rest), size=min(other_n, len(rest)),
                                 replace=False)
             row_mask[rest[picked]] = True
