@@ -38,7 +38,10 @@ def main():
     y = (margin > 0).astype(np.float64)
 
     rows = [{"indices": idx[i], "values": val[i]} for i in range(n)]
-    ds = SparseDataset.from_rows(rows, y, num_bits=dim_bits)
+    # VW label convention: logistic learns on {-1,+1} (the stage does this
+    # conversion via labelConversion; the raw learner API expects it done)
+    y_pm = np.where(y > 0, 1.0, -1.0)
+    ds = SparseDataset.from_rows(rows, y_pm, num_bits=dim_bits)
 
     cfg = LearnerConfig(num_bits=dim_bits, loss_function="logistic",
                         num_passes=1, learning_rate=0.5)
@@ -60,9 +63,10 @@ def main():
     import dataclasses as _dc
 
     cfg_multi = _dc.replace(cfg, num_passes=5)
-    _, mstats = train_linear(cfg_multi, ds)
+    w5, mstats = train_linear(cfg_multi, ds)
     per_pass_s = [s.total_time_ns / 1e9 for s in mstats[1:]]
     resident_s = min(per_pass_s)
+    acc5 = float(np.mean((predict_linear(np.asarray(w5), ds) > 0) == y))
 
     # featurizer throughput (host-side hashing path)
     words = np.array([" ".join(f"w{t}" for t in rng.integers(0, 5000, 12))
@@ -112,42 +116,62 @@ def main():
     import sys
 
     scaling = {}
-    try:
+    curve = {}
+    # repo root from the imported package (robust under `python - < tool`
+    # invocations where __file__ is '<stdin>')
+    import mmlspark_tpu as _pkg
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        _pkg.__file__)))
+    for shards in (1, 2, 4, 8):
+        # one subprocess per shard count: make_mesh requires the spec to
+        # consume the whole device set, so the virtual CPU device count is
+        # set to the shard count each time
         code = (
+            f"import sys; sys.path.insert(0, {repo_root!r})\n"
             "import os\n"
-            "os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8'\n"
+            f"os.environ['XLA_FLAGS']="
+            f"'--xla_force_host_platform_device_count={shards}'\n"
             "import jax; jax.config.update('jax_platforms','cpu')\n"
-            "import json, time, numpy as np, dataclasses\n"
-            "from mmlspark_tpu.vw.learner import LearnerConfig, SparseDataset, train_linear\n"
+            "import json, time, numpy as np\n"
+            "from mmlspark_tpu.vw.learner import LearnerConfig, "
+            "SparseDataset, train_linear\n"
             "from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh\n"
-            f"n, nnz, bits = {min(n, 100_000)}, {nnz}, {dim_bits}\n"
+            f"n, nnz, bits, shards = {min(n, 100_000)}, {nnz}, {dim_bits}, "
+            f"{shards}\n"
             "rng = np.random.default_rng(0)\n"
             "idx = rng.integers(0, 1 << bits, size=(n, nnz)).astype(np.int32)\n"
             "val = (rng.normal(size=(n, nnz)) / np.sqrt(nnz)).astype(np.float32)\n"
             "w_true = rng.normal(size=1 << bits).astype(np.float32)\n"
             "y = ((w_true[idx] * val).sum(axis=1) > 0).astype(np.float64)\n"
             "rows = [{'indices': idx[i], 'values': val[i]} for i in range(n)]\n"
-            "ds = SparseDataset.from_rows(rows, y, num_bits=bits)\n"
-            "out = {}\n"
-            "for shards in (1, 2, 4, 8):\n"
-            "    mesh = make_mesh(MeshSpec(data=shards)) if shards > 1 else None\n"
-            "    cfg = LearnerConfig(num_bits=bits, loss_function='logistic', num_passes=3)\n"
-            "    w, stats = train_linear(cfg, ds, mesh=mesh)  # compile+warm\n"
-            "    t0 = time.perf_counter()\n"
-            "    w, stats = train_linear(cfg, ds, mesh=mesh)\n"
-            "    dt = time.perf_counter() - t0\n"
-            "    out[str(shards)] = round(3 * n / dt, 1)\n"
-            "print(json.dumps(out))\n")
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        proc = subprocess.run([sys.executable, "-c", code], cwd=repo_root,
-                              capture_output=True, text=True, timeout=900,
-                              env=env)
-        scaling = {"shard_scaling_examples_per_sec_cpu_mesh":
-                   json.loads(proc.stdout.strip().splitlines()[-1])}
-    except Exception as e:
-        scaling = {"shard_scaling_error": str(e)[:200]}
+            "ds = SparseDataset.from_rows(rows, np.where(y > 0, 1.0, -1.0), "
+            "num_bits=bits)\n"
+            "mesh = make_mesh(MeshSpec(data=shards)) if shards > 1 else None\n"
+            "cfg = LearnerConfig(num_bits=bits, loss_function='logistic', "
+            "num_passes=3)\n"
+            "train_linear(cfg, ds, mesh=mesh)\n"
+            "t0 = time.perf_counter()\n"
+            "train_linear(cfg, ds, mesh=mesh)\n"
+            "print(json.dumps(round(3 * n / (time.perf_counter() - t0), 1)))\n")
+        try:
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  cwd=repo_root, capture_output=True,
+                                  text=True, timeout=900, env=env)
+            curve[str(shards)] = json.loads(
+                proc.stdout.strip().splitlines()[-1])
+        except Exception:
+            curve[str(shards)] = {"error": (proc.stderr or "")[-200:]
+                                  if "proc" in dir() else "spawn failed"}
+    scaling = {"shard_scaling_examples_per_sec_cpu_mesh": curve,
+               "shard_scaling_note":
+               "per-shard sequential scan + psum weight averaging between "
+               "passes (the --span_server AllReduce replacement, "
+               "vw/VowpalWabbitBase.scala:314-342) on ONE host core "
+               "emulating N devices — the curve shows the algorithmic "
+               "scaling shape; real chips add real parallel compute"}
 
     print(json.dumps({
         "backend": dev.platform,
@@ -157,6 +181,7 @@ def main():
         "device_resident_pass_seconds": [round(s, 3) for s in per_pass_s],
         "first_pass_with_compile_s": round(compile_s, 2),
         "train_accuracy": round(acc, 4),
+        "train_accuracy_5_passes": round(acc5, 4),
         "featurizer_rows_per_sec": round(feat_rows_per_s, 1),
         **skl, **scaling,
     }))
