@@ -552,6 +552,10 @@ extern "C" int32_t mml_gbdt_grow_tree(
                 }
             }
         }
+        // root-only buffers: release before the split loop (child
+        // histograms use the scratch/gh_gather pattern below)
+        std::vector<int64_t>().swap(mrows);
+        std::vector<float>().swap(mgh);
     }
 
     std::priority_queue<HeapEntry, std::vector<HeapEntry>, HeapCmp> heap;

@@ -115,7 +115,6 @@ def main():
     import subprocess
     import sys
 
-    scaling = {}
     curve = {}
     # repo root from the imported package (robust under `python - < tool`
     # invocations where __file__ is '<stdin>')
@@ -154,6 +153,7 @@ def main():
             "t0 = time.perf_counter()\n"
             "train_linear(cfg, ds, mesh=mesh)\n"
             "print(json.dumps(round(3 * n / (time.perf_counter() - t0), 1)))\n")
+        proc = None
         try:
             env = dict(os.environ)
             env.pop("JAX_PLATFORMS", None)
@@ -162,9 +162,10 @@ def main():
                                   text=True, timeout=900, env=env)
             curve[str(shards)] = json.loads(
                 proc.stdout.strip().splitlines()[-1])
-        except Exception:
-            curve[str(shards)] = {"error": (proc.stderr or "")[-200:]
-                                  if "proc" in dir() else "spawn failed"}
+        except Exception as e:
+            stderr_tail = (proc.stderr or "")[-200:] if proc is not None \
+                else ""
+            curve[str(shards)] = {"error": f"{e!r} {stderr_tail}".strip()}
     scaling = {"shard_scaling_examples_per_sec_cpu_mesh": curve,
                "shard_scaling_note":
                "per-shard sequential scan + psum weight averaging between "
