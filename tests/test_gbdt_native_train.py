@@ -221,8 +221,26 @@ class TestNativeFlow:
         monkeypatch.setenv("MMLSPARK_TPU_SCAN_TRAIN", "1")
         assert not B._native_train_ok(p, 100)
         monkeypatch.delenv("MMLSPARK_TPU_SCAN_TRAIN")
+        # NO_SCAN_TRAIN selects the XLA host loop, not this engine
+        monkeypatch.setenv("MMLSPARK_TPU_NO_SCAN_TRAIN", "1")
+        assert not B._native_train_ok(p, 100)
+        monkeypatch.delenv("MMLSPARK_TPU_NO_SCAN_TRAIN")
         monkeypatch.setenv("MMLSPARK_TPU_NATIVE_TRAIN", "0")
         assert not B._native_train_ok(p, 100)
+
+    def test_gate_size_threshold_on_accelerators(self, native, monkeypatch):
+        # engine routing regression pin: on an accelerator backend the
+        # bench-scale 200k x 50 fit stays native and the 10M x 50 fit
+        # stays on the device scan engine (measured crossover ~1M,
+        # docs/gbdt.md); CPU backends are always native-eligible
+        import jax
+
+        p = TrainParams(objective="binary", num_iterations=50)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert B._native_train_ok(p, 200_000)
+        assert not B._native_train_ok(p, 10_000_000)
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert B._native_train_ok(p, 10_000_000)
 
     def test_lgbm_text_roundtrip(self, native):
         from mmlspark_tpu.gbdt.lgbm_format import (
