@@ -26,7 +26,7 @@ _REPO_ROOT = os.path.dirname(_PKG_DIR)
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 
 
-_ABI_VERSION = 4
+_ABI_VERSION = 5
 
 
 def _host_tag() -> str:
@@ -204,6 +204,11 @@ def _declare(lib: ctypes.CDLL) -> None:
                                           ctypes.c_int32, f64p, i64p, u8p]
     lib.mml_bin_matrix_f64_i32.argtypes = [f64p, ctypes.c_int64,
                                            ctypes.c_int32, f64p, i64p, i32p]
+    lib.mml_vw_train_pass.argtypes = [
+        i32p, f32p, f32p, f32p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_float, ctypes.c_int32,
+        f32p, f32p, f32p, f64p]
     lib.mml_gbdt_grow_tree.restype = ctypes.c_int32
     lib.mml_gbdt_grow_tree.argtypes = [
         u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
@@ -370,6 +375,38 @@ def bin_column(vals: np.ndarray, edges: np.ndarray) -> Optional[np.ndarray]:
                            _ptr(edges, ctypes.c_double), len(edges),
                            _ptr(out, ctypes.c_int32))
     return out
+
+
+_LOSS_IDS = {"squared": 0, "logistic": 1, "hinge": 2, "quantile": 3}
+
+
+def vw_train_pass(indices: np.ndarray, values: np.ndarray,
+                  labels: np.ndarray, weights: np.ndarray,
+                  w: np.ndarray, g2: np.ndarray, t: float, *,
+                  loss: str, tau: float, lr: float, power_t: float,
+                  initial_t: float, l2: float, adaptive: bool):
+    """One sequential learning pass IN PLACE over ``w``/``g2`` (padded
+    sparse examples). Returns (new_t, loss_sum) or None when unavailable.
+    Mirrors vw/learner.make_scan_pass's f32 update exactly."""
+    lib = load()
+    if lib is None or loss not in _LOSS_IDS:
+        return None
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    values = np.ascontiguousarray(values, dtype=np.float32)
+    labels = np.ascontiguousarray(labels, dtype=np.float32)
+    weights = np.ascontiguousarray(weights, dtype=np.float32)
+    assert w.dtype == np.float32 and g2.dtype == np.float32
+    n, k = indices.shape
+    t_box = np.array([t], dtype=np.float32)
+    loss_out = np.zeros(1, dtype=np.float64)
+    lib.mml_vw_train_pass(
+        _ptr(indices, ctypes.c_int32), _ptr(values, ctypes.c_float),
+        _ptr(labels, ctypes.c_float), _ptr(weights, ctypes.c_float),
+        n, k, _LOSS_IDS[loss], float(tau), float(lr), float(power_t),
+        float(initial_t), float(l2), int(adaptive),
+        _ptr(w, ctypes.c_float), _ptr(g2, ctypes.c_float),
+        _ptr(t_box, ctypes.c_float), _ptr(loss_out, ctypes.c_double))
+    return float(t_box[0]), float(loss_out[0])
 
 
 def bin_matrix(X: np.ndarray, edges_list, dtype=np.int32

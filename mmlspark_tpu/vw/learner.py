@@ -198,6 +198,27 @@ def _ftrl_weights(config: LearnerConfig, z, n_acc):
                                    / config.ftrl_alpha + config.l2))
 
 
+def _native_pass_ok(config: LearnerConfig) -> bool:
+    """Route single-shard training to the native C++ sequential learner?
+
+    Default on: a sequential per-example update stream is latency-bound on
+    an accelerator, exactly like the reference's VW (a C++ core driven
+    per row). FTRL and unsupported losses stay on the scan path;
+    MMLSPARK_TPU_NATIVE_VW=0 disables (tests pin the scan path with it)."""
+    import os
+
+    if os.environ.get("MMLSPARK_TPU_NATIVE_VW", "") in ("0", "false"):
+        return False
+    if config.ftrl:
+        return False
+    if config.loss_function not in ("squared", "logistic", "hinge",
+                                    "quantile"):
+        return False
+    from .. import native_loader
+
+    return native_loader.load() is not None
+
+
 def train_linear(config: LearnerConfig, dataset: SparseDataset,
                  initial_weights: Optional[np.ndarray] = None,
                  mesh=None) -> Tuple[np.ndarray, List[TrainingStats]]:
@@ -289,6 +310,42 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
             stats.append(TrainingStats(0, n, dt, dt,
                                        loss_host / max(w_sum, 1e-12),
                                        w_sum))
+    elif (_native_pass_ok(config)
+          and int(np.min(dataset.indices, initial=0)) >= 0
+          and int(np.max(dataset.indices, initial=-1)) < dim):
+        # native C++ sequential pass (VW's own architecture: a C core doing
+        # per-example updates, vw/VowpalWabbitBase.scala:218-305). Sequential
+        # SGD is latency-bound on an accelerator (~115k ex/s through the
+        # scan vs millions/s on one host core), so the single-shard regime
+        # runs on the host; mesh fits keep the psum-averaged scan path.
+        # Index bounds are validated above: the C kernel indexes raw memory
+        # where XLA's scatter would clamp/drop OOB indices (datasets built
+        # by from_rows are always masked in-range; hand-built ones may not
+        # be and fall through to the scan engine).
+        from .. import native_loader
+
+        # FORCED copy: np.asarray of a jax array is a zero-copy READ-ONLY
+        # view on CPU-addressable backends — the in-place ctypes update
+        # must never alias (and mutate) caller-owned initial_weights
+        w_np = np.array(np.asarray(state[0]), dtype=np.float32)
+        g2_np = np.zeros(dim, dtype=np.float32)
+        t_val = 0.0
+        w_sum = float(dataset.weights.sum())
+        for _ in range(config.num_passes):
+            t0 = time.perf_counter_ns()
+            res = native_loader.vw_train_pass(
+                dataset.indices, dataset.values, dataset.labels,
+                dataset.weights, w_np, g2_np, t_val,
+                loss=config.loss_function, tau=config.quantile_tau,
+                lr=config.learning_rate, power_t=config.power_t,
+                initial_t=config.initial_t, l2=config.l2,
+                adaptive=config.adaptive)
+            dt = time.perf_counter_ns() - t0
+            assert res is not None  # _native_pass_ok verified lib + loss
+            t_val, loss_sum = res
+            stats.append(TrainingStats(0, n, dt, dt,
+                                       loss_sum / max(w_sum, 1e-12), w_sum))
+        return w_np, stats
     else:
         ds = {"indices": jnp.asarray(dataset.indices),
               "values": jnp.asarray(dataset.values),

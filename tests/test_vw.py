@@ -443,6 +443,138 @@ def test_readable_model_import_continue_training():
     assert np.abs(np.asarray(cont_text.get("weights")) - w1).max() > 0
 
 
+class TestNativeLearner:
+    """Native C++ sequential pass vs the jitted scan (same f32 update
+    semantics, two-phase duplicate-index handling; reference architecture:
+    VW's C++ core driven per example, vw/VowpalWabbitBase.scala:218-305)."""
+
+    @pytest.mark.parametrize("loss", ["squared", "logistic", "hinge",
+                                      "quantile"])
+    def test_native_matches_scan(self, loss, monkeypatch):
+        from mmlspark_tpu import native_loader as NL
+        from mmlspark_tpu.vw.learner import (
+            LearnerConfig,
+            SparseDataset,
+            train_linear,
+        )
+
+        if not NL.available():
+            pytest.skip("native toolchain unavailable")
+        monkeypatch.delenv("MMLSPARK_TPU_NATIVE_VW", raising=False)
+        rows, raws = synth_sparse(300, num_bits=10)
+        y = np.where(raws > 0, 1.0, -1.0) if loss != "quantile" \
+            else np.abs(raws)
+        ds = SparseDataset.from_rows(rows, y, num_bits=10)
+        cfg = LearnerConfig(num_bits=10, loss_function=loss, num_passes=3,
+                            learning_rate=0.4, l2=1e-4)
+        w_nat, stats_nat = train_linear(cfg, ds)
+        monkeypatch.setenv("MMLSPARK_TPU_NATIVE_VW", "0")
+        w_scan, stats_scan = train_linear(cfg, ds)
+        np.testing.assert_allclose(w_nat, np.asarray(w_scan), rtol=1e-3,
+                                   atol=2e-4)
+        assert abs(stats_nat[-1].average_loss
+                   - stats_scan[-1].average_loss) < 1e-3
+
+    def test_native_nonadaptive_decay(self, monkeypatch):
+        from mmlspark_tpu import native_loader as NL
+        from mmlspark_tpu.vw.learner import (
+            LearnerConfig,
+            SparseDataset,
+            train_linear,
+        )
+
+        if not NL.available():
+            pytest.skip("native toolchain unavailable")
+        monkeypatch.delenv("MMLSPARK_TPU_NATIVE_VW", raising=False)
+        rows, raws = synth_sparse(300, num_bits=10, seed=3)
+        y = np.where(raws > 0, 1.0, -1.0)
+        ds = SparseDataset.from_rows(rows, y, num_bits=10)
+        cfg = LearnerConfig(num_bits=10, loss_function="logistic",
+                            num_passes=2, adaptive=False, learning_rate=0.4,
+                            initial_t=1.0)
+        w_nat, _ = train_linear(cfg, ds)
+        monkeypatch.setenv("MMLSPARK_TPU_NATIVE_VW", "0")
+        w_scan, _ = train_linear(cfg, ds)
+        np.testing.assert_allclose(w_nat, np.asarray(w_scan), rtol=1e-3,
+                                   atol=2e-4)
+
+    def test_native_warm_start_does_not_mutate_source(self, monkeypatch):
+        # np.asarray of a jax array is a zero-copy READ-ONLY view on
+        # CPU-addressable backends; the in-place native update must copy —
+        # warm-starting model2 from model1's weights must not corrupt
+        # model1 (r5 review finding)
+        from mmlspark_tpu import native_loader as NL
+        from mmlspark_tpu.vw.learner import (
+            LearnerConfig,
+            SparseDataset,
+            train_linear,
+        )
+
+        if not NL.available():
+            pytest.skip("native toolchain unavailable")
+        monkeypatch.delenv("MMLSPARK_TPU_NATIVE_VW", raising=False)
+        rows, raws = synth_sparse(200, num_bits=10, seed=9)
+        y = np.where(raws > 0, 1.0, -1.0)
+        ds = SparseDataset.from_rows(rows, y, num_bits=10)
+        cfg = LearnerConfig(num_bits=10, loss_function="logistic",
+                            num_passes=2)
+        w1, _ = train_linear(cfg, ds)
+        snap = np.array(np.asarray(w1))
+        w2, _ = train_linear(cfg, ds, initial_weights=w1)
+        np.testing.assert_array_equal(np.asarray(w1), snap)
+        assert np.abs(np.asarray(w2) - snap).max() > 0
+
+    def test_native_oob_indices_fall_back_to_scan(self, monkeypatch):
+        # hand-built datasets may carry out-of-range indices; the C kernel
+        # must never see them (XLA clamps, raw memory corrupts)
+        import dataclasses
+
+        from mmlspark_tpu import native_loader as NL
+        from mmlspark_tpu.vw.learner import (
+            LearnerConfig,
+            SparseDataset,
+            train_linear,
+        )
+
+        if not NL.available():
+            pytest.skip("native toolchain unavailable")
+        monkeypatch.delenv("MMLSPARK_TPU_NATIVE_VW", raising=False)
+        rows, raws = synth_sparse(100, num_bits=10, seed=11)
+        y = np.where(raws > 0, 1.0, -1.0)
+        ds = SparseDataset.from_rows(rows, y, num_bits=10)
+        bad = dataclasses.replace(
+            ds, indices=ds.indices.copy()) if dataclasses.is_dataclass(ds) \
+            else ds
+        bad.indices[0, 0] = 1 << 12  # >= dim for num_bits=10
+        cfg = LearnerConfig(num_bits=10, loss_function="logistic",
+                            num_passes=1)
+        w, _ = train_linear(cfg, bad)  # must not crash the process
+        assert np.isfinite(np.asarray(w)).all()
+
+    def test_native_continuation_and_weights(self, monkeypatch):
+        from mmlspark_tpu import native_loader as NL
+        from mmlspark_tpu.vw.learner import (
+            LearnerConfig,
+            SparseDataset,
+            predict_linear,
+            train_linear,
+        )
+
+        if not NL.available():
+            pytest.skip("native toolchain unavailable")
+        monkeypatch.delenv("MMLSPARK_TPU_NATIVE_VW", raising=False)
+        rows, raws = synth_sparse(400, num_bits=10, seed=5)
+        y = np.where(raws > 0, 1.0, -1.0)
+        wts = np.where(y > 0, 2.0, 1.0)
+        ds = SparseDataset.from_rows(rows, y, wts, num_bits=10)
+        cfg = LearnerConfig(num_bits=10, loss_function="logistic",
+                            num_passes=4)
+        w1, _ = train_linear(cfg, ds)
+        w2, _ = train_linear(cfg, ds, initial_weights=w1)  # warm start
+        acc = np.mean((predict_linear(np.asarray(w2), ds) > 0) == (y > 0))
+        assert acc > 0.9
+
+
 def test_parse_readable_model_vw_header_format():
     """A real vw dump has informational headers and 'Num weight bits'."""
     from mmlspark_tpu.vw import parse_readable_model
