@@ -897,7 +897,12 @@ def _grad_hess_np(objective: str, scores: np.ndarray, labels: np.ndarray,
         e = np.exp(m)
         p = e / e.sum(axis=-1, keepdims=True)
         yh = np.zeros_like(p)
-        yh[np.arange(len(labels)), labels.astype(np.int64)] = 1.0
+        li = labels.astype(np.int64)
+        # out-of-range labels get a zero one-hot row (jax.nn.one_hot
+        # semantics — the device engine accepts them; fancy indexing
+        # would crash or wrap)
+        ok = (li >= 0) & (li < p.shape[-1])
+        yh[np.nonzero(ok)[0], li[ok]] = 1.0
         g = p - yh
         h = np.maximum(2.0 * p * (1.0 - p), 1e-16)
     elif objective in ("regression", "regression_l2", "l2",
@@ -962,15 +967,20 @@ def _native_train_ok(params: TrainParams, n: int) -> bool:
         return False
     if env in ("1", "true", "force"):
         return True
+    # size budget FIRST: small fits are native on every backend, so the
+    # decision must not initialize the accelerator (the whole point of
+    # this engine is that the tunnel/H2D is never touched for them)
+    budget = float(os.environ.get("MMLSPARK_TPU_NATIVE_TRAIN_MAX", "2e7"))
+    if n * params.num_iterations * max(params.num_class, 1) <= budget:
+        return True
+    # above budget the device engine is the default — consulting the
+    # backend here is free, those fits initialize it anyway
     try:
         import jax
 
-        if jax.default_backend() == "cpu":
-            return True
+        return jax.default_backend() == "cpu"
     except Exception:
         return True
-    budget = float(os.environ.get("MMLSPARK_TPU_NATIVE_TRAIN_MAX", "2e7"))
-    return n * params.num_iterations * max(params.num_class, 1) <= budget
 
 
 def _train_native(params: TrainParams, X: np.ndarray, y: np.ndarray,

@@ -149,11 +149,21 @@ class DynamicBufferedBatcher:
         self._thread.start()
 
     def close(self) -> None:
+        import queue
+
         self._stop.set()
         try:
             while True:
                 self._q.get_nowait()
         except Exception:
+            pass
+        # wake any consumer blocked in a no-timeout get(): the producer's
+        # offer(_DONE) gives up once _stop is set, so DONE must be fed from
+        # here (the drain above guarantees space; a racing put is fine to
+        # drop — the consumer only needs one)
+        try:
+            self._q.put_nowait(self._DONE)
+        except queue.Full:
             pass
 
     def __iter__(self):

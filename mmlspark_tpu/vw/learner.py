@@ -229,10 +229,59 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
     """
     import time
 
+    dim = 1 << config.num_bits
+    n = len(dataset.labels)
+    n_shards = 1
+    if mesh is not None:
+        from ..parallel.mesh import DATA_AXIS
+
+        n_shards = int(mesh.shape.get(DATA_AXIS, 1))
+
+    if (n_shards == 1 and _native_pass_ok(config)
+            and int(np.min(dataset.indices, initial=0)) >= 0
+            and int(np.max(dataset.indices, initial=-1)) < dim):
+        # native C++ sequential pass (VW's own architecture: a C core doing
+        # per-example updates, vw/VowpalWabbitBase.scala:218-305). Sequential
+        # SGD is latency-bound on an accelerator (~115k ex/s through the
+        # scan vs millions/s on one host core), so the single-shard regime
+        # runs on the host; mesh fits keep the psum-averaged scan path.
+        # Decided BEFORE any jnp state exists — this branch must never
+        # initialize a device or ship the 2^bits weight vector anywhere.
+        # Index bounds are validated above: the C kernel indexes raw memory
+        # where XLA's scatter would clamp/drop OOB indices (datasets built
+        # by from_rows are always masked in-range; hand-built ones may not
+        # be and fall through to the scan engine).
+        from .. import native_loader
+
+        # FORCED copy: the in-place ctypes update must never alias (and
+        # mutate) caller-owned initial_weights (a zero-copy jax-array view
+        # is read-only; a caller numpy array would be silently trained on)
+        w_np = (np.array(np.asarray(initial_weights), dtype=np.float32)
+                if initial_weights is not None
+                else np.zeros(dim, dtype=np.float32))
+        g2_np = np.zeros(dim, dtype=np.float32)
+        t_val = 0.0
+        w_sum = float(dataset.weights.sum())
+        stats = []
+        for _ in range(config.num_passes):
+            t0 = time.perf_counter_ns()
+            res = native_loader.vw_train_pass(
+                dataset.indices, dataset.values, dataset.labels,
+                dataset.weights, w_np, g2_np, t_val,
+                loss=config.loss_function, tau=config.quantile_tau,
+                lr=config.learning_rate, power_t=config.power_t,
+                initial_t=config.initial_t, l2=config.l2,
+                adaptive=config.adaptive)
+            dt = time.perf_counter_ns() - t0
+            assert res is not None  # _native_pass_ok verified lib + loss
+            t_val, loss_sum = res
+            stats.append(TrainingStats(0, n, dt, dt,
+                                       loss_sum / max(w_sum, 1e-12), w_sum))
+        return w_np, stats
+
     import jax
     import jax.numpy as jnp
 
-    dim = 1 << config.num_bits
     w0 = (jnp.asarray(initial_weights, dtype=jnp.float32)
           if initial_weights is not None else jnp.zeros(dim, dtype=jnp.float32))
     if config.ftrl:
@@ -246,13 +295,6 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
 
     run_pass = make_scan_pass(config)
     stats: List[TrainingStats] = []
-
-    n = len(dataset.labels)
-    n_shards = 1
-    if mesh is not None:
-        from ..parallel.mesh import DATA_AXIS
-
-        n_shards = int(mesh.shape.get(DATA_AXIS, 1))
 
     if n_shards > 1:
         from jax.sharding import PartitionSpec as P
@@ -310,42 +352,6 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
             stats.append(TrainingStats(0, n, dt, dt,
                                        loss_host / max(w_sum, 1e-12),
                                        w_sum))
-    elif (_native_pass_ok(config)
-          and int(np.min(dataset.indices, initial=0)) >= 0
-          and int(np.max(dataset.indices, initial=-1)) < dim):
-        # native C++ sequential pass (VW's own architecture: a C core doing
-        # per-example updates, vw/VowpalWabbitBase.scala:218-305). Sequential
-        # SGD is latency-bound on an accelerator (~115k ex/s through the
-        # scan vs millions/s on one host core), so the single-shard regime
-        # runs on the host; mesh fits keep the psum-averaged scan path.
-        # Index bounds are validated above: the C kernel indexes raw memory
-        # where XLA's scatter would clamp/drop OOB indices (datasets built
-        # by from_rows are always masked in-range; hand-built ones may not
-        # be and fall through to the scan engine).
-        from .. import native_loader
-
-        # FORCED copy: np.asarray of a jax array is a zero-copy READ-ONLY
-        # view on CPU-addressable backends — the in-place ctypes update
-        # must never alias (and mutate) caller-owned initial_weights
-        w_np = np.array(np.asarray(state[0]), dtype=np.float32)
-        g2_np = np.zeros(dim, dtype=np.float32)
-        t_val = 0.0
-        w_sum = float(dataset.weights.sum())
-        for _ in range(config.num_passes):
-            t0 = time.perf_counter_ns()
-            res = native_loader.vw_train_pass(
-                dataset.indices, dataset.values, dataset.labels,
-                dataset.weights, w_np, g2_np, t_val,
-                loss=config.loss_function, tau=config.quantile_tau,
-                lr=config.learning_rate, power_t=config.power_t,
-                initial_t=config.initial_t, l2=config.l2,
-                adaptive=config.adaptive)
-            dt = time.perf_counter_ns() - t0
-            assert res is not None  # _native_pass_ok verified lib + loss
-            t_val, loss_sum = res
-            stats.append(TrainingStats(0, n, dt, dt,
-                                       loss_sum / max(w_sum, 1e-12), w_sum))
-        return w_np, stats
     else:
         ds = {"indices": jnp.asarray(dataset.indices),
               "values": jnp.asarray(dataset.values),

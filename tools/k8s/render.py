@@ -41,9 +41,17 @@ def _parse_simple_yaml(text: str):
             else ""
         if not line.strip():
             continue
+        if line.strip().startswith("- ") or line.strip() == "-":
+            # lists (and block scalars below) are outside this fallback's
+            # subset — refusing beats silently mangling a manifest
+            raise ValueError(
+                "values file uses YAML lists; install pyyaml to render it")
         indent = len(line) - len(line.lstrip())
         key, _, val = line.strip().partition(":")
         val = val.strip()
+        if val in ("|", ">", "|-", ">-"):
+            raise ValueError(
+                "values file uses block scalars; install pyyaml to render it")
         while stack and stack[-1][0] >= indent:
             stack.pop()
         parent = stack[-1][1]
