@@ -231,7 +231,8 @@ def compute_histogram_sharded(bins_fm, grad, hess, row_mask, num_bins: int,
     and must be a concrete jax.Array with a NamedSharding whose spec shards
     dim 1 (the row dim)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+
+    from ..parallel.mesh import shard_map_compat as shard_map
 
     sh = bins_fm.sharding
     mesh = sh.mesh

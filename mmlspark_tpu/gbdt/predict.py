@@ -169,6 +169,7 @@ class DeviceEnsemble:
     def __init__(self, tree_groups: List[List[Tree]], num_class: int):
         trees = [t for g in tree_groups for t in g]
         self.num_class = num_class
+        self.last_ingest_stats = None  # set by chunked ring scoring
         self.class_of_tree = np.array(
             [k for g in tree_groups for k in range(len(g))], dtype=np.int32)
         self.num_trees = len(trees)
@@ -392,15 +393,33 @@ class DeviceEnsemble:
             row_chunk = self._gemm_row_chunk
             if n <= row_chunk:
                 return np.asarray(self._jitted(Xf), dtype=np.float64)
-            outs = []
-            for r0 in range(0, n, row_chunk):
-                xc = Xf[r0: r0 + row_chunk]
-                m = len(xc)
-                if m < row_chunk:  # pad: one compiled shape
-                    xc = np.pad(xc, ((0, row_chunk - m), (0, 0)),
-                                constant_values=np.nan)
-                outs.append(np.asarray(self._jitted(xc),
-                                       dtype=np.float64)[:m])
+            # chunked scoring rides the shared transfer ring: chunk i+1's
+            # pad + H2D overlaps chunk i's forest GEMM instead of the old
+            # serial dispatch-readback-dispatch loop
+            import jax
+
+            from ..parallel.batching import Batch
+            from ..parallel.ingest import IngestStats, TransferRing
+
+            def chunks():
+                for r0 in range(0, n, row_chunk):
+                    xc = Xf[r0: r0 + row_chunk]
+                    m = len(xc)
+                    if m < row_chunk:  # pad: one compiled shape
+                        xc = np.pad(xc, ((0, row_chunk - m), (0, 0)),
+                                    constant_values=np.nan)
+                    mask = np.zeros(row_chunk, dtype=bool)
+                    mask[:m] = True
+                    yield Batch({"x": xc}, mask, m)
+
+            self.last_ingest_stats = IngestStats()
+            ring = TransferRing(
+                chunks(),
+                put=lambda b: (jax.device_put(b.arrays["x"]), b.num_valid),
+                step=lambda s: (self._jitted(s[0]), s[1]),
+                fetch=lambda h: np.asarray(h[0], dtype=np.float64)[:h[1]],
+                depth=2, stats=self.last_ingest_stats)
+            outs = list(ring)
             return np.concatenate(outs, axis=0)
         if self._jitted is None:
             self._jitted = self._compile()

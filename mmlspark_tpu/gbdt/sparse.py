@@ -727,8 +727,9 @@ def grow_tree_sparse_sharded(ds: SparseDataset, dev, sharded, mesh,
 
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat as shard_map
 
     from ..parallel.mesh import DATA_AXIS
 

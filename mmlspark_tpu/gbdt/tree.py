@@ -476,9 +476,9 @@ def _grow_tree_device_sharded(bins, grad, hess, row_mask, node_of_row,
     sharded. One dispatch + one collective stream per tree instead of
     one host round trip per split."""
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from ..parallel.mesh import shard_map_compat as shard_map
     from . import pallas_hist
 
     sh = bins.sharding

@@ -9,6 +9,14 @@ points; resize happens host-side per image, then DNNModel runs the jitted batche
 forward fetching the tapped activation directly — no unroll/re-roll round trip
 through flat vectors (the CHW unroll existed only because CNTK consumed flat
 buffers; XLA consumes [B,H,W,C] natively).
+
+Wire format: batches ship to the device **uint8** (the decoded pixel dtype)
+by default; the ``scaleFactor`` multiply, float cast, and any NCHW layout
+transpose are fused into the compiled forward via a PreprocessSpec
+(parallel/ingest.py) — 4x fewer host->device bytes than the old host-side
+``astype(float32) * scale`` with identical numerics (uint8 -> f32 cast and
+an f32 multiply are exact). ``hostPreprocess=True`` restores the legacy
+float32-wire host path.
 """
 
 from __future__ import annotations
@@ -24,10 +32,16 @@ from ..core.schema import ColType, ImageSchema, Schema
 from ..models.dnn_model import DNNModel
 from ..models.module import FunctionModel
 from ..ops import image as ops
+from ..parallel.ingest import PreprocessSpec
 
 
 class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
-    """Featurize images (or encoded-image bytes) through a headless CNN."""
+    """Featurize images (or encoded-image bytes) through a headless CNN.
+
+    Batches ride the host->device link in their decoded dtype (uint8 by
+    default — the uint8-wire default); ``scaleFactor`` scaling and NCHW
+    layout transposes run inside the compiled forward (see PreprocessSpec).
+    """
 
     model = ComplexParam("model", "The FunctionModel backbone")
     cutOutputLayers = Param("cutOutputLayers",
@@ -37,6 +51,15 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
     batchSize = Param("batchSize", "Eval minibatch size", 64, lambda v: v > 0, int)
     scaleFactor = Param("scaleFactor", "Multiply pixel values (1/255 to normalize)",
                         1.0, ptype=float)
+    hostPreprocess = Param(
+        "hostPreprocess",
+        "Do the float cast / scale / layout transpose on the HOST per image "
+        "(the legacy float32 wire format, 4x the H2D bytes). Default False: "
+        "pixels stay uint8 on the wire and preprocessing fuses into the "
+        "compiled forward.", False, ptype=bool)
+    ringDepth = Param("ringDepth",
+                      "In-flight batches in the DNN transfer ring", 2,
+                      lambda v: v > 0, int)
 
     def __init__(self, **kwargs):
         kwargs.setdefault("inputCol", "image")
@@ -69,9 +92,16 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         else:
             h, w, c = model.input_shape
         scale = self.get("scaleFactor")
+        host_pre = self.get("hostPreprocess")
+        # device-side preprocess: float cast + scale on device, plus the
+        # HWC -> CHW layout move for ONNX backbones; the wire keeps the
+        # decoded dtype (uint8 images: 4x fewer H2D bytes)
+        spec = PreprocessSpec(scale=scale,
+                              transpose=(2, 0, 1) if fmt == "NCHW" else None)
 
-        # 1. normalize input rows to fixed-shape HWC float32 arrays (auto-resize,
-        #    reference ImageFeaturizer.scala:141-165)
+        # 1. normalize input rows to fixed-shape HWC arrays (auto-resize,
+        #    reference ImageFeaturizer.scala:141-165); dtype is preserved
+        #    (wire dtype) unless hostPreprocess is set
         def prep(part):
             col = part[in_col]
             out = np.empty(len(col), dtype=object)
@@ -96,9 +126,8 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                 if img.shape[2] != c:
                     img = (np.repeat(img[:, :, :1], c, axis=2) if img.shape[2] < c
                            else img[:, :, :c])
-                img = img.astype(np.float32) * np.float32(scale)
-                out[i] = np.ascontiguousarray(img.transpose(2, 0, 1)) \
-                    if fmt == "NCHW" else img
+                out[i] = spec.apply_host_row(img) if host_pre \
+                    else np.ascontiguousarray(img)
             return out
 
         prepped = df.with_column("__dnn_input__", prep)
@@ -106,16 +135,26 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
             prepped = prepped.dropna(subset=["__dnn_input__"])
 
         node = self._output_node(model)
-        key = (id(model), node, out_col, self.get("batchSize"))
+        key = (id(model), node, out_col, self.get("batchSize"),
+               None if host_pre else spec, self.get("ringDepth"))
         if self._dnn_cache is None or self._dnn_cache[0] != key:
             dnn = DNNModel(inputCol="__dnn_input__", outputCol=out_col,
-                           batchSize=self.get("batchSize"))
+                           batchSize=self.get("batchSize"),
+                           ringDepth=self.get("ringDepth"))
             dnn.set_model(model)
+            if not host_pre:
+                dnn.set_preprocess(spec)
             if node is not None:
                 dnn.set_output_node(node)
             self._dnn_cache = (key, dnn)
         dnn = self._dnn_cache[1]
         return dnn.transform(prepped).drop("__dnn_input__")
+
+    @property
+    def last_ingest_stats(self):
+        """Ingest decomposition of the most recent transform (delegates to
+        the wrapped DNNModel) — None before the first transform."""
+        return self._dnn_cache[1].last_ingest_stats if self._dnn_cache else None
 
     def transform_schema(self, schema: Schema) -> Schema:
         schema.require(self.get_or_throw("inputCol"))

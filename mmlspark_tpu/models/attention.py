@@ -146,10 +146,13 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int,
     m = jnp.full((B, H, T), -jnp.inf, dtype=jnp.float32)
     l = jnp.zeros((B, H, T), dtype=jnp.float32)
     # mark the fresh accumulators as device-varying over the ring axis
-    # (shard_map's vma typing requires scan carries in == carries out)
-    o, m, l = (jax.lax.pcast(a, (axis_name,), to="varying")
-               if hasattr(jax.lax, "pcast") else jax.lax.pvary(a, (axis_name,))
-               for a in (o, m, l))
+    # (shard_map's vma typing requires scan carries in == carries out;
+    # jax < 0.5 has neither pcast nor pvary and no vma typing to satisfy)
+    _vary = getattr(jax.lax, "pcast", None)
+    if _vary is not None:
+        o, m, l = (_vary(a, (axis_name,), to="varying") for a in (o, m, l))
+    elif hasattr(jax.lax, "pvary"):
+        o, m, l = (jax.lax.pvary(a, (axis_name,)) for a in (o, m, l))
 
     def block(carry, step):
         o, m, l, kb, vb = carry

@@ -28,7 +28,8 @@ from ..core.params import ComplexParam, HasBatchSize, HasInputCol, HasOutputCol,
 from ..core.dataframe import DataFrame
 from ..core.pipeline import Model
 from ..core.schema import ColType, Schema
-from ..parallel.batching import DevicePrefetcher, Minibatcher, concat_outputs
+from ..parallel.batching import Minibatcher, concat_outputs
+from ..parallel.ingest import IngestStats, PreprocessSpec, TransferRing
 from ..parallel.mesh import (DATA_AXIS, MeshContext, data_sharding,
                              fetch_global, replicated_sharding)
 from .module import FunctionModel
@@ -57,6 +58,21 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
                       "form of outputCol, all fetched in ONE forward pass "
                       "(cntk/CNTKModel.scala:215-223)", None, ptype=dict)
     batchSize = Param("batchSize", "Rows per evaluation minibatch", 64, lambda v: v > 0, int)
+    preprocess = ComplexParam(
+        "preprocess",
+        "PreprocessSpec fused into the compiled forward (cast/scale/offset/"
+        "layout-transpose run on device, so input batches ride the host link "
+        "in their wire dtype — uint8 pixels = 4x fewer H2D bytes). "
+        "Single-input models only.")
+    ringDepth = Param("ringDepth",
+                      "In-flight batches in the transfer ring: the next "
+                      "batches' H2D + compute overlap the previous fetch",
+                      2, lambda v: v > 0, int)
+    donateInputs = Param("donateInputs",
+                         "Donate the input batch buffer into the compiled "
+                         "step so XLA reuses the staging allocation. None "
+                         "(default) = auto: on for accelerator backends, off "
+                         "on CPU where donation is a no-op.", None, ptype=bool)
     useMesh = Param("useMesh",
                     "Shard eval batches over the active mesh data axis; "
                     "None (default) = auto: on whenever a >1-device mesh has "
@@ -67,6 +83,14 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self._jit_cache: Dict[Tuple, Any] = {}
+        self._last_ingest_stats: Optional[IngestStats] = None
+
+    @property
+    def last_ingest_stats(self) -> Optional[IngestStats]:
+        """Ingest decomposition of the most recent transform() (queue/h2d/
+        compute/readback per batch, bytes, overlap ratio) — the e2e-vs-
+        per-call gap as a measured quantity."""
+        return self._last_ingest_stats
 
     # -- fluent setters mirroring the reference API -----------------------
     def set_model(self, model: FunctionModel) -> "DNNModel":
@@ -84,6 +108,12 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
 
     def set_mini_batch_size(self, n: int) -> "DNNModel":
         return self.set("batchSize", n)
+
+    def set_preprocess(self, spec: Optional[PreprocessSpec]) -> "DNNModel":
+        return self.set("preprocess", spec)
+
+    def set_ring_depth(self, n: int) -> "DNNModel":
+        return self.set("ringDepth", n)
 
     def set_feed_dict(self, *args) -> "DNNModel":
         """set_feed_dict({arg: col, ...}) or set_feed_dict(arg, col)."""
@@ -114,23 +144,38 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
         return in_map, out_map
 
     # -- compiled forward -------------------------------------------------
-    def _compiled(self, taps: Tuple[Optional[str], ...], multi_in: bool):
+    def _compiled(self, taps: Tuple[Optional[str], ...], multi_in: bool,
+                  spec: Optional[PreprocessSpec] = None,
+                  donate: bool = False):
         """jit-compiled (params, x) -> tuple of activations, one per tap
         (all fetched in ONE forward). ``x`` is an array, or a dict of arrays
-        for multi-input models."""
+        for multi-input models.
+
+        ``spec``: PreprocessSpec fused ahead of the forward — the wire
+        carries the raw batch dtype (uint8 pixels) and XLA folds the
+        cast/scale/transpose into the first layer's own input cast.
+        ``donate``: donate the batch argument so XLA reuses its staging
+        buffer across steps (used only when the caller committed the batch
+        to device; a no-op on CPU)."""
         import jax
 
         model = self.get_model()
-        key = ("fwd", id(model), taps, multi_in)
+        # even an identity-scale spec keeps its dtype cast: the wire batch
+        # may be uint8 and the module must see spec.dtype (a float cast of
+        # an already-float input is free in XLA)
+        key = ("fwd", id(model), taps, multi_in, spec, donate)
         if key not in self._jit_cache:
 
             def fwd(params, x):
+                if spec is not None:
+                    x = spec.apply_device(x)
                 live = FunctionModel(model.module, params, model.input_shape,
                                      model.layer_names, model.name)
                 acts = live.apply_taps(x, list(taps))
                 return tuple(acts[t] for t in taps)
 
-            self._jit_cache[key] = jax.jit(fwd)
+            self._jit_cache[key] = jax.jit(
+                fwd, donate_argnums=(1,)) if donate else jax.jit(fwd)
         return self._jit_cache[key]
 
     def transform_schema(self, schema: Schema) -> Schema:
@@ -166,9 +211,21 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
         # GraphModule validates the incomplete feed instead of silently
         # binding the column to the primary input
         multi_in = list(in_map) != model.argument_names()[:1]
-        fwd = self._compiled(taps, multi_in)
+        spec: Optional[PreprocessSpec] = self.get("preprocess")
+        if spec is not None and multi_in:
+            raise ValueError(
+                "preprocess spec applies to single-input models only "
+                "(feedDict consumers preprocess per column upstream)")
+        fwd = self._compiled(taps, multi_in, spec)
+        donate = self.get("donateInputs")
+        if donate is None:
+            donate = jax.default_backend() != "cpu"  # CPU donation is a no-op
+        fwd_donated = self._compiled(taps, multi_in, spec, donate=True) \
+            if donate else None
         batcher = Minibatcher(self.get("batchSize"), bucket=True,
                               dtype=np.float32, preserve_int=True)
+        stats = IngestStats()
+        self._last_ingest_stats = stats
 
         params_dev = jax.device_put(model.params)  # resident once (broadcast parity)
 
@@ -200,25 +257,11 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
                 return part
             sub = {c: part[c][valid_idx] for c in in_cols}
             outs = []
-            # pipelined dispatch: keep up to 2 batches in flight so the next
-            # batch's H2D + compute overlaps the previous fetch (jax dispatch
-            # is async; only the np.asarray readback blocks). The per-row JNI
-            # loop this replaces was fully serial (CNTKModel.scala:129-136).
-            in_flight: list = []
-
-            def drain_one():
-                ys, num_valid = in_flight.pop(0)
-                # fetch_global: under a multi-PROCESS mesh the sharded
-                # output spans non-addressable devices (allgathered);
-                # single-process it is a plain blocking readback
-                outs.append(tuple(
-                    np.asarray(fetch_global(y),
-                               dtype=np.float32)[:num_valid] for y in ys))
 
             def to_device(batch):
-                """Stack/pad + H2D for one batch — runs on the prefetch
-                thread so the NEXT batch's transfer overlaps this one's
-                compute (DynamicBufferedBatcher parity,
+                """Stack/pad + H2D for one batch — runs on the ring's
+                prefetch thread so the NEXT batch's transfer overlaps this
+                one's compute (DynamicBufferedBatcher parity,
                 stages/Batchers.scala:12-160)."""
                 if multi_in:
                     x = {name: batch.arrays[col]
@@ -241,20 +284,37 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
                         x = jax.device_put(x)
                 return x, batch.num_valid
 
-            prefetch = DevicePrefetcher(batcher.batches(sub, in_cols),
-                                        put=to_device, depth=2)
+            def step(staged):
+                x, num_valid = staged
+                # the donated executable only when the batch is device-
+                # committed (uncommitted host arrays — the mesh-indivisible
+                # case — have no staging buffer to reuse)
+                leaves = list(x.values()) if isinstance(x, dict) else [x]
+                f = fwd_donated if (fwd_donated is not None and
+                                    all(isinstance(v, jax.Array)
+                                        for v in leaves)) else fwd
+                return f(params_dev, x), num_valid
+
+            def fetch(handle):
+                # fetch_global: under a multi-PROCESS mesh the sharded
+                # output spans non-addressable devices (allgathered);
+                # single-process it is a plain blocking readback
+                ys, num_valid = handle
+                return tuple(np.asarray(fetch_global(y),
+                                        dtype=np.float32)[:num_valid]
+                             for y in ys)
+
+            ring = TransferRing(batcher.batches(sub, in_cols),
+                                put=to_device, step=step, fetch=fetch,
+                                depth=self.get("ringDepth"), stats=stats)
             try:
-                for x, num_valid in prefetch:
-                    in_flight.append((fwd(params_dev, x), num_valid))
-                    if len(in_flight) >= 2:
-                        drain_one()
-                while in_flight:
-                    drain_one()
+                for out in ring:
+                    outs.append(out)
             finally:
                 # a failed forward/readback must not strand the producer
                 # thread blocked on the bounded queue (it pins device
                 # buffers for the process lifetime)
-                prefetch.close()
+                ring.close()
             for ci, c in enumerate(out_cols):
                 full = concat_outputs([o[ci] for o in outs])
                 for j, i in enumerate(valid_idx):

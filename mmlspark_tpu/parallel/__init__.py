@@ -2,6 +2,7 @@ from .batching import (
     Batch, Minibatcher, concat_outputs, densify_sparse, is_sparse_row,
     next_bucket, pad_batch, sparse_width, stack_rows,
 )
+from .ingest import IngestStats, PreprocessSpec, TransferRing
 from .mesh import (
     DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS,
     MeshContext, MeshSpec, data_sharding, initialize_distributed, make_mesh,

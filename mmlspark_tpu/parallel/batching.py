@@ -88,10 +88,24 @@ class DevicePrefetcher:
         except Exception:
             pass
 
+    def _get(self):
+        """Blocking get that an external close() can always interrupt: poll
+        with a timeout and re-check _stop, so a consumer is never stranded
+        on an empty queue whose producer already gave up (the DONE injection
+        can lose the race with close()'s drain)."""
+        import queue
+
+        while True:
+            try:
+                return self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return self._DONE
+
     def __iter__(self):
         try:
             while True:
-                item = self._q.get()
+                item = self._get()
                 if item is self._DONE:
                     if self._err:
                         raise self._err[0]
@@ -166,21 +180,41 @@ class DynamicBufferedBatcher:
         except queue.Full:
             pass
 
+    def _get(self):
+        """Blocking get interruptible by an external close(): poll with a
+        timeout and re-check _stop (close()'s drain can race a blocked
+        producer put and lose the injected DONE on a re-filled queue)."""
+        import queue
+
+        while True:
+            try:
+                return self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return self._DONE
+
     def __iter__(self):
         import queue
 
         try:
             done = False
             while not done:
-                batch = [self._q.get()]  # block for at least one item
+                batch = [self._get()]  # block for at least one item
                 try:
                     while True:
                         batch.append(self._q.get_nowait())
                 except queue.Empty:
                     pass
-                if batch and batch[-1] is self._DONE:
-                    batch.pop()
-                    done = True
+                # scan the WHOLE batch for the sentinel: a producer blocked in
+                # put() when close() drained can land items AFTER the injected
+                # DONE, so it is not necessarily last — anything behind it is
+                # abandoned by close() semantics, and the opaque sentinel must
+                # never leak to the consumer as data
+                for i, item in enumerate(batch):
+                    if item is self._DONE:
+                        batch = batch[:i]
+                        done = True
+                        break
                 if batch:
                     yield batch
             if self._err:
@@ -218,7 +252,8 @@ class TimeIntervalBatcher:
         try:
             done = False
             while not done:
-                batch = [q.get()]  # block for the window's first element
+                # _get: interruptible by close() (returns DONE once stopped)
+                batch = [self._inner._get()]  # block for the first element
                 if batch[0] is done_tok:
                     break
                 deadline = _time.monotonic() + self._interval

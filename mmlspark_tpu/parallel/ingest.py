@@ -1,0 +1,347 @@
+"""Unified device-ingest layer: uint8 wire format + transfer ring + stats.
+
+The framework's data plane. BENCH_r05 showed the flagship featurize path
+computing at ~11.5k images/sec/chip per-call but only ~260 images/sec
+end-to-end: the DataFrame -> device ingest path, not XLA compute, was the
+bottleneck (h2d_gbps = 0.036). Two structural fixes live here:
+
+  - **uint8 on the wire** (``PreprocessSpec``): the host stops doing
+    ``astype(float32) * scale`` (+ layout transpose) per image; batches ship
+    in their decoded dtype (uint8 pixels = 4x fewer H2D bytes) and the
+    cast/scale/transpose runs INSIDE the consumer's jitted forward, where
+    XLA fuses it with the first conv's bf16 cast for free.
+  - **transfer ring** (``TransferRing``): a configurable number of in-flight
+    batches replaces ad-hoc double buffering. H2D runs on a background
+    thread (overlapping the previous batch's compute), up to ``depth``
+    dispatched steps stay in flight, and results drain in order. Every
+    stage is timed per batch into an ``IngestStats`` object, so the
+    e2e-vs-per-call gap is a first-class measured quantity.
+
+Consumers: DNNModel (models/dnn_model.py) for the DataFrame eval path,
+DeviceEnsemble (gbdt/predict.py) for chunked GBDT scoring, and bench.py's
+e2e section. The ring is generic — anything shaped
+``host batches -> stage -> dispatch -> readback`` can ride it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .batching import DevicePrefetcher
+
+
+# ---------------------------------------------------------------------------
+# PreprocessSpec: host preprocessing moved into the compiled forward
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PreprocessSpec:
+    """Device-side preprocessing fused into a jitted forward.
+
+    Describes what the host USED to do to each row before batching —
+    ``astype(float32) * scale + offset`` and an optional per-row axes
+    transpose (NHWC -> NCHW for ONNX imports) — so the wire carries the raw
+    decoded dtype and the work runs on device, inside jit. Hashable, so
+    compiled-forward caches can key on it.
+
+    ``transpose`` is the PER-ROW axes permutation (e.g. ``(2, 0, 1)`` for
+    HWC -> CHW); the batched device op shifts it past the leading batch dim.
+    ``dtype``: compute dtype after the cast (float32 unless doing f64
+    numerics experiments).
+    """
+
+    scale: float = 1.0
+    offset: float = 0.0
+    transpose: Optional[Tuple[int, ...]] = None
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.transpose is not None:
+            object.__setattr__(self, "transpose",
+                               tuple(int(a) for a in self.transpose))
+
+    @property
+    def is_identity(self) -> bool:
+        return (self.scale == 1.0 and self.offset == 0.0
+                and self.transpose is None and self.dtype == "float32")
+
+    def _batch_axes(self, ndim: int) -> Tuple[int, ...]:
+        perm = self.transpose
+        if perm is None or len(perm) != ndim - 1:
+            raise ValueError(
+                f"transpose {perm} does not match per-row rank {ndim - 1}")
+        return (0,) + tuple(a + 1 for a in perm)
+
+    def apply_device(self, x):
+        """Batched [B, ...] device op, trace-safe under jit."""
+        import jax.numpy as jnp
+
+        dt = getattr(jnp, self.dtype)
+        y = x.astype(dt)
+        if self.scale != 1.0:
+            y = y * dt(self.scale)
+        if self.offset != 0.0:
+            y = y + dt(self.offset)
+        if self.transpose is not None:
+            y = jnp.transpose(y, self._batch_axes(y.ndim))
+        return y
+
+    def apply_host(self, x: np.ndarray) -> np.ndarray:
+        """Numpy reference of ``apply_device`` on a [B, ...] batch — the
+        numerical-parity oracle (uint8 -> f32 cast and an f32 multiply are
+        exact, so host and device agree bitwise) and the fallback for
+        consumers that never reach a device."""
+        dt = np.dtype(self.dtype).type
+        y = x.astype(dt)
+        if self.scale != 1.0:
+            y = y * dt(self.scale)
+        if self.offset != 0.0:
+            y = y + dt(self.offset)
+        if self.transpose is not None:
+            y = np.transpose(y, self._batch_axes(y.ndim))
+        return y
+
+    def apply_host_row(self, img: np.ndarray) -> np.ndarray:
+        """Per-row host application (the legacy featurizer prep path)."""
+        return self.apply_host(img[None])[0]
+
+
+# ---------------------------------------------------------------------------
+# IngestStats: per-batch ingest decomposition
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BatchTiming:
+    """Wall-clock decomposition of one batch through the ring (seconds).
+
+    ``queue_s``  — consumer wait for the prefetched batch (producer-bound
+                   time: decode/stack upstream plus H2D not yet hidden).
+    ``h2d_s``    — host->device transfer, measured ON the producer thread
+                   (device_put + block-until-ready), so it overlaps compute.
+    ``dispatch_s`` — host cost of enqueueing the compiled step (async).
+    ``compute_s``  — residual wait for the step's outputs at drain time
+                   (0 when compute fully hid behind later batches' ingest).
+    ``readback_s`` — device->host fetch of the outputs.
+    ``bytes_in`` — wire bytes shipped for this batch.
+    ``rows``     — valid rows in the batch.
+    """
+
+    queue_s: float = 0.0
+    h2d_s: float = 0.0
+    dispatch_s: float = 0.0
+    compute_s: float = 0.0
+    readback_s: float = 0.0
+    bytes_in: int = 0
+    rows: int = 0
+
+
+class IngestStats:
+    """Accumulates ``BatchTiming`` rows plus ring wall time; ``summary()``
+    renders the e2e decomposition bench.py and the serving stats endpoint
+    surface. Safe to share across sequential ring runs (partitions of one
+    transform accumulate into one object)."""
+
+    def __init__(self):
+        self.records: List[BatchTiming] = []
+        self.wall_s: float = 0.0
+
+    def record(self, t: BatchTiming) -> None:
+        self.records.append(t)
+
+    def add_wall(self, seconds: float) -> None:
+        self.wall_s += seconds
+
+    @property
+    def num_batches(self) -> int:
+        return len(self.records)
+
+    def summary(self) -> Dict[str, Any]:
+        if not self.records:
+            return {"n_batches": 0}
+        cols = {f: float(sum(getattr(r, f) for r in self.records))
+                for f in ("queue_s", "h2d_s", "dispatch_s", "compute_s",
+                          "readback_s")}
+        total_bytes = int(sum(r.bytes_in for r in self.records))
+        rows = int(sum(r.rows for r in self.records))
+        serial = sum(cols.values())
+        n = len(self.records)
+        out: Dict[str, Any] = {
+            "n_batches": n,
+            "rows": rows,
+            "bytes": total_bytes,
+            "wall_s": round(self.wall_s, 6),
+            # < 1.0 means the ring hid ingest behind compute (and vice
+            # versa); 1.0 = fully serial pipeline
+            "overlap_ratio": round(self.wall_s / serial, 4) if serial > 0
+            else None,
+            "h2d_gbps": round(total_bytes / cols["h2d_s"] / 1e9, 4)
+            if cols["h2d_s"] > 0 else None,
+        }
+        for f, v in cols.items():
+            out[f] = round(v, 6)
+            out[f"{f[:-2]}_ms_per_batch"] = round(v / n * 1e3, 4)
+        return out
+
+
+def _tree_rows(item: Any) -> int:
+    """Valid rows in a batch: Batch.num_valid when present, else the leading
+    dim of a raw array batch."""
+    nv = getattr(item, "num_valid", None)
+    if nv is not None:
+        return int(nv)
+    shape = getattr(item, "shape", None)
+    if shape:
+        return int(shape[0])
+    return 0
+
+
+def _tree_nbytes(item: Any) -> int:
+    """Total nbytes of arrays inside an arbitrary batch structure."""
+    if hasattr(item, "nbytes"):
+        return int(item.nbytes)
+    if hasattr(item, "arrays"):  # parallel.batching.Batch
+        return _tree_nbytes(item.arrays)
+    if isinstance(item, dict):
+        return sum(_tree_nbytes(v) for v in item.values())
+    if isinstance(item, (list, tuple)):
+        return sum(_tree_nbytes(v) for v in item)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# TransferRing
+# ---------------------------------------------------------------------------
+
+
+class TransferRing:
+    """N-slot host->device->compute->host pipeline over an iterator of
+    batches, draining results IN ORDER.
+
+    Stage contract (each arbitrary pytrees between stages):
+
+      - ``put(item)``    host batch -> staged device input. Runs on the
+                         prefetch thread, so its H2D overlaps the consumer's
+                         dispatch/drain; the ring additionally blocks the
+                         producer thread until the staged arrays are ready,
+                         which (a) makes ``h2d_s`` a real transfer time and
+                         (b) paces the producer at link speed instead of
+                         queueing unbounded device memory.
+      - ``step(staged)`` dispatch the compiled computation; returns a handle
+                         (device arrays + any metadata). Must not block —
+                         jax dispatch is async.
+      - ``fetch(handle)`` blocking readback -> the item the ring yields.
+
+    ``depth`` bounds dispatched-but-undrained steps (the old hardwired
+    2-deep ``in_flight`` list generalized); ``prefetch`` bounds staged
+    batches waiting between put and step (defaults to ``depth``).
+
+    Replaces the reference's background-thread batcher pair
+    (stages/Batchers.scala:12-160) as the single overlap primitive shared by
+    DNN eval, GBDT scoring, and bench. Iterate once; ``close()`` (idempotent,
+    called by ``__iter__``'s finally) releases the producer thread mid-stream
+    without stranding it on the bounded queue.
+    """
+
+    def __init__(self, it: Iterator, put: Optional[Callable] = None,
+                 step: Optional[Callable] = None,
+                 fetch: Optional[Callable] = None,
+                 depth: int = 2, prefetch: Optional[int] = None,
+                 stats: Optional[IngestStats] = None):
+        if depth <= 0:
+            raise ValueError("depth must be positive")
+        self.depth = depth
+        self.stats = stats if stats is not None else IngestStats()
+        self._step = step if step is not None else (lambda x: x)
+        self._fetch = fetch if fetch is not None else _default_fetch
+        self._user_put = put
+
+        def timed_put(item):
+            timing = BatchTiming(bytes_in=_tree_nbytes(item),
+                                 rows=_tree_rows(item))
+            t0 = time.perf_counter()
+            staged = put(item) if put is not None else item
+            _block_ready(staged)
+            timing.h2d_s = time.perf_counter() - t0
+            return staged, timing
+
+        self._prefetch = DevicePrefetcher(
+            it, put=timed_put, depth=max(1, prefetch or depth))
+
+    def close(self) -> None:
+        self._prefetch.close()
+
+    def __iter__(self):
+        inflight: "deque" = deque()
+        src = iter(self._prefetch)
+        wall0 = time.perf_counter()
+        try:
+            while True:
+                tq = time.perf_counter()
+                try:
+                    staged, timing = next(src)
+                except StopIteration:
+                    break
+                timing.queue_s = time.perf_counter() - tq
+                td = time.perf_counter()
+                handle = self._step(staged)
+                timing.dispatch_s = time.perf_counter() - td
+                inflight.append((handle, timing))
+                if len(inflight) >= self.depth:
+                    yield self._drain(inflight)
+            while inflight:
+                yield self._drain(inflight)
+        finally:
+            self.stats.add_wall(time.perf_counter() - wall0)
+            self.close()
+
+    def _drain(self, inflight: "deque"):
+        handle, timing = inflight.popleft()
+        t0 = time.perf_counter()
+        _block_ready(handle)
+        t1 = time.perf_counter()
+        timing.compute_s = t1 - t0
+        out = self._fetch(handle)
+        timing.readback_s = time.perf_counter() - t1
+        self.stats.record(timing)
+        return out
+
+
+def _block_ready(tree: Any) -> Any:
+    """Wait for every jax array in ``tree``; no-op for host-only values
+    (keeps the ring usable before jax is imported / with numpy stages)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return tree
+    try:
+        return jax.block_until_ready(tree)
+    except Exception:
+        return tree
+
+
+def _default_fetch(handle: Any) -> Any:
+    """Readback: device arrays -> numpy, structure preserved."""
+    import sys
+
+    jax = sys.modules.get("jax")
+
+    def one(v):
+        if jax is not None and isinstance(v, jax.Array):
+            return np.asarray(v)
+        return v
+
+    if isinstance(handle, tuple):
+        return tuple(one(v) for v in handle)
+    if isinstance(handle, list):
+        return [one(v) for v in handle]
+    if isinstance(handle, dict):
+        return {k: one(v) for k, v in handle.items()}
+    return one(handle)

@@ -66,9 +66,11 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params,
     stage = jax.lax.axis_index(axis_name)
     local = jax.tree.map(lambda a: a[0], stacked_params)  # [1,...] -> [...]
 
-    microbatches = (jax.lax.pcast(microbatches, (axis_name,), to="varying")
-                    if hasattr(jax.lax, "pcast")
-                    else jax.lax.pvary(microbatches, (axis_name,)))
+    # jax < 0.5 has neither pcast nor pvary (and no vma typing to satisfy)
+    if hasattr(jax.lax, "pcast"):
+        microbatches = jax.lax.pcast(microbatches, (axis_name,), to="varying")
+    elif hasattr(jax.lax, "pvary"):
+        microbatches = jax.lax.pvary(microbatches, (axis_name,))
     # derived arrays inherit the varying type from microbatches
     state = jnp.zeros_like(microbatches[0])
     outputs = jnp.zeros_like(microbatches)

@@ -141,8 +141,14 @@ class ServingServer:
                  max_batch_size: int = 64, max_wait_ms: float = 5.0,
                  slot_timeout_s: float = 60.0, token: Optional[str] = None,
                  journal_path: Optional[str] = None,
-                 name: str = "serving"):
+                 name: str = "serving",
+                 ingest_stats: Optional[Callable[[], Optional[dict]]] = None):
         self.transform = transform
+        # optional provider of the device-ingest decomposition (queue/h2d/
+        # compute/readback — parallel/ingest.IngestStats.summary) merged into
+        # the /_mmlspark/stats payload; serve_pipeline wires it automatically
+        # for stages that expose last_ingest_stats
+        self.ingest_stats = ingest_stats
         self.host = host
         self.port = port
         self.slot_timeout_s = slot_timeout_s
@@ -212,8 +218,17 @@ class ServingServer:
                     return
                 if path == "/_mmlspark/stats":
                     # latency decomposition endpoint (verdict item: prove the
-                    # framework's share of serving latency is sub-ms)
-                    body = json.dumps(server.stats.summary()).encode("utf-8")
+                    # framework's share of serving latency is sub-ms); with a
+                    # device pipeline behind the transform, "compute" further
+                    # decomposes into the ingest stages (queue/h2d/compute/
+                    # readback per batch)
+                    summary = server.stats.summary()
+                    if server.ingest_stats is not None:
+                        try:
+                            summary["ingest"] = server.ingest_stats()
+                        except Exception as e:  # noqa: BLE001
+                            summary["ingest"] = {"error": str(e)}
+                    body = json.dumps(summary).encode("utf-8")
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
@@ -520,7 +535,13 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                     break
         return out
 
+    ingest = None
+    if hasattr(stage, "last_ingest_stats"):
+        def ingest():
+            s = stage.last_ingest_stats
+            return s.summary() if s is not None else None
+
     return ServingServer(transform, host=host, port=port, api_path=api_path,
                          reply_col=reply_col, max_batch_size=max_batch_size,
                          max_wait_ms=max_wait_ms, token=token,
-                         journal_path=journal_path)
+                         journal_path=journal_path, ingest_stats=ingest)

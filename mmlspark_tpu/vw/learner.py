@@ -263,6 +263,7 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
         t_val = 0.0
         w_sum = float(dataset.weights.sum())
         stats = []
+        native_ok = True
         for _ in range(config.num_passes):
             t0 = time.perf_counter_ns()
             res = native_loader.vw_train_pass(
@@ -273,11 +274,19 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
                 initial_t=config.initial_t, l2=config.l2,
                 adaptive=config.adaptive)
             dt = time.perf_counter_ns() - t0
-            assert res is not None  # _native_pass_ok verified lib + loss
+            if res is None:
+                # the .so (or its symbol) went away between the
+                # _native_pass_ok probe and the call — fall through to the
+                # jax scan engine below, restarting from initial_weights
+                # (mirrors binning.transform_col's bin_column fallback; an
+                # assert here would strip under python -O and unpack None)
+                native_ok = False
+                break
             t_val, loss_sum = res
             stats.append(TrainingStats(0, n, dt, dt,
                                        loss_sum / max(w_sum, 1e-12), w_sum))
-        return w_np, stats
+        if native_ok:
+            return w_np, stats
 
     import jax
     import jax.numpy as jnp
@@ -299,7 +308,9 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
     if n_shards > 1:
         from jax.sharding import PartitionSpec as P
 
-        shard_map = jax.shard_map
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:  # jax < 0.6 ships it under experimental
+            from jax.experimental.shard_map import shard_map
 
         pad = (-n) % n_shards
 
@@ -320,9 +331,12 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
             local = {"indices": indices, "values": values,
                      "labels": labels, "weights": weights}
             # carry starts replicated but the scan makes it shard-varying:
-            # mark it varying up front (jax vma typing for scan-in-shard_map)
-            state = jax.tree.map(
-                lambda s: jax.lax.pcast(s, (DATA_AXIS,), to="varying"), state)
+            # mark it varying up front (jax vma typing for scan-in-shard_map;
+            # older jax has no pcast and no vma typing to satisfy)
+            pcast = getattr(jax.lax, "pcast", None)
+            if pcast is not None:
+                state = jax.tree.map(
+                    lambda s: pcast(s, (DATA_AXIS,), to="varying"), state)
             state, losses = run_pass(state, local)
             # between-pass model averaging over the data axis (VW sync point);
             # pmean also restores the replicated (invariant) type for out_specs P()

@@ -97,6 +97,7 @@ def parse_readable_model(text: str) -> Tuple[int, np.ndarray]:
     real vw dump (``Num weight bits:N`` header, informational header lines
     before the ``index:weight`` section are skipped)."""
     num_bits = 18
+    saw_bits = False
     entries = []
     for line in text.splitlines():
         line = line.strip()
@@ -106,16 +107,35 @@ def parse_readable_model(text: str) -> Tuple[int, np.ndarray]:
         key = key.strip()
         if key in ("bits", "Num weight bits"):
             num_bits = int(val)
+            saw_bits = True
             continue
         try:
             idx, w = int(key), float(val)
         except ValueError:
             continue  # vw header lines (Version, Min label, ...)
         entries.append((idx, w))
-    mask = (1 << num_bits) - 1
-    weights = np.zeros(1 << num_bits, dtype=np.float64)
+    if entries and not saw_bits:
+        import warnings
+
+        warnings.warn(
+            "readable model has weight entries but no bits header "
+            "('bits:N' / 'Num weight bits:N') — assuming the VW default of "
+            "18; a dump from a different-bit model would load corrupted",
+            stacklevel=2)
+    size = 1 << num_bits
+    oob = [i for i, _ in entries if i >= size or i < 0]
+    if oob:
+        # silently wrapping with `i & mask` would alias distinct weights
+        # onto the same bucket — a corrupted model with no error signal
+        why = "is missing" if not saw_bits else "disagrees with its entries"
+        raise ValueError(
+            f"readable model has {len(oob)} weight indices outside the "
+            f"{num_bits}-bit feature space (max index {max(oob)} >= "
+            f"{size}); the dump's bits header {why} — re-dump with the "
+            f"matching numBits")
+    weights = np.zeros(size, dtype=np.float64)
     for i, w in entries:
-        weights[i & mask] = w
+        weights[i] = w
     return num_bits, weights
 
 

@@ -18,6 +18,14 @@ import jax
 # The env may pin JAX_PLATFORMS to a TPU plugin before we run; force CPU for tests.
 jax.config.update("jax_platforms", "cpu")
 
+# jax < 0.6 compat: shard_map lives under jax.experimental there. Library code
+# gates this itself (parallel/mesh.py, vw/learner.py); tests use jax.shard_map
+# directly, so alias it once here.
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map_compat
+
+    jax.shard_map = _shard_map_compat
+
 import numpy as np
 import pytest
 

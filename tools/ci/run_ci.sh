@@ -24,7 +24,7 @@ PACKAGES=(
   "tests/test_core.py tests/test_stages.py tests/test_featurize_train.py"
   "tests/test_gbdt.py tests/test_pallas_hist.py tests/test_benchmarks.py tests/test_lgbm_format.py tests/test_gbdt_sparse.py tests/test_gbdt_categorical.py tests/test_gbdt_native_train.py"
   "tests/test_vw.py tests/test_automl_recommendation.py tests/test_lime.py"
-  "tests/test_models.py tests/test_onnx.py tests/test_downloader.py tests/test_native.py"
+  "tests/test_models.py tests/test_onnx.py tests/test_downloader.py tests/test_native.py tests/test_ingest.py"
   "tests/test_cognitive.py tests/test_style.py tests/test_helm_chart.py"
   "tests/test_fuzzing.py"
   "tests/test_attention.py tests/test_parallel_pp_ep.py"

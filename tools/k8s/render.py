@@ -37,8 +37,7 @@ def _parse_simple_yaml(text: str):
     root: dict = {}
     stack = [(-1, root)]
     for raw in text.splitlines():
-        line = raw.split("#", 1)[0].rstrip() if not raw.strip().startswith("#") \
-            else ""
+        line = "" if raw.strip().startswith("#") else _strip_comment(raw)
         if not line.strip():
             continue
         if line.strip().startswith("- ") or line.strip() == "-":
@@ -62,6 +61,24 @@ def _parse_simple_yaml(text: str):
         else:
             parent[key] = _coerce(val)
     return root
+
+
+def _strip_comment(line: str) -> str:
+    """Strip a trailing ``#`` comment per YAML rules: only a ``#`` that sits
+    OUTSIDE quoted scalars and is preceded by whitespace (or starts the
+    line) opens a comment — ``image: "repo#tag"`` and ``passwd: a#b`` are
+    values, not comments (the old ``split('#')`` silently truncated them)."""
+    quote = None
+    for i, ch in enumerate(line):
+        if quote is not None:
+            if ch == quote:
+                quote = None
+            continue
+        if ch in "\"'":
+            quote = ch
+        elif ch == "#" and (i == 0 or line[i - 1] in " \t"):
+            return line[:i].rstrip()
+    return line.rstrip()
 
 
 def _coerce(val: str):
