@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..core import faults
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, HasOutputCol, Param, ServiceParam
 from ..core.pipeline import Transformer
@@ -47,6 +48,9 @@ class CognitiveServicesBase(HasServiceParams, HasOutputCol):
     concurrency = Param("concurrency", "Concurrent requests", 1, ptype=int)
     timeout = Param("timeout", "Request timeout (s)", 60.0, ptype=float)
     handler = ComplexParam("handler", "Injected (HTTPRequestData)->HTTPResponseData")
+    retryPolicy = ComplexParam(
+        "retryPolicy", "core.faults.RetryPolicy for the default HTTP handler "
+        "(jittered backoff, sleep budget, deterministic when seeded)")
     pollingDelayMs = Param("pollingDelayMs", "Async poll interval", 300, ptype=int)
     maxPollingRetries = Param("maxPollingRetries", "Async poll attempts", 100,
                               ptype=int)
@@ -152,7 +156,10 @@ class CognitiveServicesBase(HasServiceParams, HasOutputCol):
         out_col = self.get_or_throw("outputCol")
         err_col = self.get("errorCol")
         handler = self.get("handler") or (
-            lambda r: send_with_retries(r, timeout=self.get("timeout")))
+            lambda r: send_with_retries(
+                r, timeout=self.get("timeout"),
+                policy=self.get("retryPolicy"),
+                deadline=faults.deadline_from_headers(r.headers)))
 
         def fn(part):
             names = list(part)

@@ -1,4 +1,8 @@
 from .dataframe import DataFrame
+from .faults import (
+    DEADLINE_HEADER, Deadline, FaultInjector, InjectedFault, RetryPolicy,
+    deadline_from_headers,
+)
 from .params import Param, Params, ComplexParam, ServiceParam
 from .pipeline import (
     Estimator, Evaluator, Model, Pipeline, PipelineModel, PipelineStage, Transformer,

@@ -1,0 +1,402 @@
+"""Unified fault-tolerance layer: retry policy, deadlines, fault injection.
+
+The reference leans on Spark task retry + epoch replay for resilience
+(HTTPSourceV2's epoch machinery, `FaultToleranceUtils.retryWithTimeout`);
+the TPU-native stack has no scheduler to lean on, so the equivalent contract
+is a framework-level layer (the Automap argument, arxiv 2112.02958: cross-
+cutting machinery belongs in the framework, not per-stage ad-hoc code):
+
+  - ``RetryPolicy``    — jittered exponential backoff with a total sleep
+    budget and deadline awareness; adopted by io/http.send_with_retries,
+    cognitive/base, serving/routing health probes, and downloader retries.
+  - ``Deadline``       — absolute wall-clock deadline carried end-to-end in
+    the ``X-MMLSpark-Deadline`` header (epoch seconds): expired requests are
+    dropped pre-transform with 504 instead of burning a batch slot.
+  - ``FaultInjector``  — deterministic, seedable chaos: named injection
+    points (HTTP send, worker forward, ingest H2D, journal write/commit,
+    train step) so a chaos scenario replays EXACTLY under a fixed seed.
+  - atomic-file helpers (tmp + rename + fsync, EXDEV-safe rename) shared by
+    the journal compactor, GBDT checkpoints, and the model downloader.
+
+See docs/faults.md for the resilience contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import os
+import random
+import shutil
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+
+#: header carrying the absolute request deadline (unix epoch seconds, float)
+DEADLINE_HEADER = "X-MMLSpark-Deadline"
+
+
+class Deadline:
+    """Absolute wall-clock deadline (epoch seconds). Propagates across
+    machines via ``X-MMLSpark-Deadline`` — absolute time, not a countdown, so
+    queue/transfer delays between hops count against it."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float):
+        self.at = float(at)
+
+    @classmethod
+    def from_timeout(cls, seconds: float) -> "Deadline":
+        return cls(time.time() + seconds)
+
+    @staticmethod
+    def from_header(value: Optional[str]) -> Optional["Deadline"]:
+        if not value:
+            return None
+        try:
+            return Deadline(float(value))
+        except (TypeError, ValueError):
+            return None
+
+    def to_header(self) -> str:
+        return repr(self.at)
+
+    def remaining(self) -> float:
+        return max(0.0, self.at - time.time())
+
+    def expired(self) -> bool:
+        return time.time() >= self.at
+
+    def cap(self, wait: float) -> float:
+        """Clamp a candidate sleep/timeout to the time left."""
+        return min(wait, self.remaining())
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging sugar
+        return f"Deadline(at={self.at!r}, remaining={self.remaining():.3f}s)"
+
+
+def deadline_from_headers(headers: Optional[Mapping[str, str]]
+                          ) -> Optional[Deadline]:
+    """Case-insensitive ``X-MMLSpark-Deadline`` lookup on any mapping
+    (http.client message objects and plain dicts alike)."""
+    if not headers:
+        return None
+    get = getattr(headers, "get", None)
+    if get is not None:
+        v = get(DEADLINE_HEADER) or get(DEADLINE_HEADER.lower())
+        if v is not None:
+            return Deadline.from_header(v)
+    low = DEADLINE_HEADER.lower()
+    for k in headers:
+        if str(k).lower() == low:
+            return Deadline.from_header(headers[k])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff with a retry budget and deadline cap.
+
+    ``jitter`` is the +/- fraction applied to each backoff (0.2 => +/-20%);
+    with ``seed`` set the jitter stream is deterministic (chaos replay).
+    ``budget_s`` bounds the TOTAL time slept across all retries of one call.
+    """
+
+    max_retries: int = 3
+    base_s: float = 0.1
+    multiplier: float = 2.0
+    max_backoff_s: float = 30.0
+    jitter: float = 0.2
+    budget_s: Optional[float] = None
+    seed: Optional[int] = None
+
+    def make_rng(self) -> random.Random:
+        return random.Random(self.seed)  # Random(None) seeds from entropy
+
+    def next_wait(self, attempt: int,
+                  rng: Optional[random.Random] = None) -> float:
+        """Backoff for ``attempt`` (0-based), jittered."""
+        base = min(self.base_s * (self.multiplier ** attempt),
+                   self.max_backoff_s)
+        if self.jitter <= 0:
+            return base
+        r = rng if rng is not None else self.make_rng()
+        return max(0.0, base * (1.0 + self.jitter * r.uniform(-1.0, 1.0)))
+
+    def backoffs(self, deadline: Optional[Deadline] = None):
+        """Yield up to ``max_retries`` jittered waits, stopping early when the
+        sleep budget or the deadline is exhausted. Each yielded wait is
+        already capped at the remaining budget/deadline."""
+        rng = self.make_rng()
+        spent = 0.0
+        for attempt in range(self.max_retries):
+            wait = self.next_wait(attempt, rng)
+            if self.budget_s is not None:
+                left = self.budget_s - spent
+                if left <= 0:
+                    return
+                wait = min(wait, left)
+            if deadline is not None:
+                left = deadline.remaining()
+                if left <= 0:
+                    return
+                wait = min(wait, left)
+            spent += wait
+            yield wait
+
+    def run(self, fn: Callable[[], Any], *,
+            should_retry: Callable[[BaseException], bool] = lambda e: True,
+            deadline: Optional[Deadline] = None,
+            sleep_fn: Callable[[float], None] = time.sleep) -> Any:
+        """Call ``fn`` with retries; re-raises the last error when the retry
+        budget / deadline / attempt count is exhausted."""
+        last: Optional[BaseException] = None
+        waits = self.backoffs(deadline)
+        while True:
+            try:
+                return fn()
+            except BaseException as e:  # noqa: BLE001 - policy decides
+                last = e
+                if not should_retry(e):
+                    raise
+            try:
+                wait = next(waits)
+            except StopIteration:
+                raise last
+            sleep_fn(wait)
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+# Named injection points (the instrumented seams of the framework):
+HTTP_SEND = "http.send"            # io/http.send_request, before the socket
+WORKER_FORWARD = "worker.forward"  # serving/routing forward-to-worker
+INGEST_H2D = "ingest.h2d"          # parallel/ingest TransferRing staging
+JOURNAL_WRITE = "journal.write"    # serving/journal entry append
+JOURNAL_COMMIT = "journal.commit"  # serving/journal epoch commit
+TRAIN_STEP = "train.step"          # gbdt boosting iteration / DNN train step
+
+ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
+              JOURNAL_COMMIT, TRAIN_STEP)
+
+
+class InjectedFault(OSError):
+    """Raised by an armed injection point. Subclasses OSError so transport-
+    level seams (worker forward, HTTP send) treat it as a connection-class
+    failure and exercise their real retry/eviction paths."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed fault: fire on exact call indices (``at``, 1-based), every
+    Nth call (``every``), or with probability ``p`` (seeded — deterministic).
+    ``times`` caps total fires (-1 = unlimited). ``delay_s`` sleeps at the
+    point; ``exc`` (when not None) then raises."""
+
+    point: str
+    at: Tuple[int, ...] = ()
+    every: int = 0
+    p: float = 0.0
+    times: int = -1
+    delay_s: float = 0.0
+    exc: Optional[type] = InjectedFault
+    message: str = ""
+
+
+class FaultInjector:
+    """Deterministic, seedable chaos driver.
+
+    Usage::
+
+        with FaultInjector(seed=7).plan(faults.WORKER_FORWARD, at=(1,)):
+            ...   # first worker forward fails with InjectedFault
+
+    Same seed + same plan => the identical fault sequence, so a chaos
+    scenario replays exactly. Thread-safe: counters are lock-guarded (the
+    instrumented seams run on server/producer threads).
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._specs: Dict[str, FaultSpec] = {}
+        self._calls: Dict[str, int] = {}
+        self._fires: Dict[str, int] = {}
+        self._rngs: Dict[str, random.Random] = {}
+        self._log: List[Tuple[str, int, Dict[str, Any]]] = []
+        self._lock = threading.Lock()
+        self._prev: Optional["FaultInjector"] = None
+
+    def plan(self, point: str, *, at: Tuple[int, ...] = (), every: int = 0,
+             p: float = 0.0, times: int = -1, delay_s: float = 0.0,
+             exc: Optional[type] = InjectedFault,
+             message: str = "") -> "FaultInjector":
+        self._specs[point] = FaultSpec(point, tuple(at), every, p, times,
+                                       delay_s, exc, message)
+        # per-point deterministic stream: stable across runs and independent
+        # of arming order
+        self._rngs[point] = random.Random(
+            self.seed ^ zlib.crc32(point.encode("utf-8")))
+        return self
+
+    # -- firing (called from instrumented library code via module fire()) --
+    def check(self, point: str, **ctx: Any) -> None:
+        spec = self._specs.get(point)
+        if spec is None:
+            return
+        with self._lock:
+            n = self._calls.get(point, 0) + 1
+            self._calls[point] = n
+            should = False
+            if spec.times < 0 or self._fires.get(point, 0) < spec.times:
+                if spec.at and n in spec.at:
+                    should = True
+                elif spec.every and n % spec.every == 0:
+                    should = True
+                elif spec.p > 0 and self._rngs[point].random() < spec.p:
+                    should = True
+            if should:
+                self._fires[point] = self._fires.get(point, 0) + 1
+                self._log.append((point, n, dict(ctx)))
+        if not should:
+            return
+        if spec.delay_s > 0:
+            time.sleep(spec.delay_s)
+        if spec.exc is not None:
+            raise spec.exc(spec.message
+                           or f"injected fault at {point!r} (call #{n})")
+
+    # -- introspection -----------------------------------------------------
+    def fired(self, point: Optional[str] = None
+              ) -> List[Tuple[str, int, Dict[str, Any]]]:
+        with self._lock:
+            return [e for e in self._log if point is None or e[0] == point]
+
+    def calls(self, point: str) -> int:
+        with self._lock:
+            return self._calls.get(point, 0)
+
+    # -- lifecycle ---------------------------------------------------------
+    def install(self) -> "FaultInjector":
+        global _ACTIVE
+        self._prev = _ACTIVE
+        _ACTIVE = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE
+        _ACTIVE = self._prev
+        self._prev = None
+
+    def __enter__(self) -> "FaultInjector":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def fire(point: str, **ctx: Any) -> None:
+    """Injection-point hook: no-op unless a FaultInjector is installed (one
+    None check on the hot path)."""
+    inj = _ACTIVE
+    if inj is not None:
+        inj.check(point, **ctx)
+
+
+# ---------------------------------------------------------------------------
+# Atomic file helpers (shared by journal compaction, GBDT checkpoints,
+# downloader staging)
+# ---------------------------------------------------------------------------
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss. Best
+    effort: some filesystems/platforms reject O_RDONLY dir fsync."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Durable atomic file write: tmp in the same directory + flush + fsync +
+    rename + directory fsync. A crash at any point leaves either the old
+    complete file or the new complete file — never a torn one."""
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(text)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(d)
+
+
+def rename_with_exdev_fallback(src: str, dst: str,
+                               _rename: Callable[[str, str], None] = os.rename
+                               ) -> None:
+    """``os.rename`` that degrades to copy + same-filesystem rename when src
+    and dst live on different filesystems (EXDEV) — staging dirs on tmpfs,
+    destinations on a persistent volume. The final hop into ``dst`` is still
+    an atomic rename on dst's filesystem."""
+    try:
+        _rename(src, dst)
+        return
+    except OSError as e:
+        if e.errno != errno.EXDEV:
+            raise
+    stage = f"{dst}.xdev.{os.getpid()}"
+    try:
+        if os.path.isdir(src):
+            shutil.copytree(src, stage)
+        else:
+            shutil.copy2(src, stage)
+        os.rename(stage, dst)
+    except BaseException:
+        if os.path.isdir(stage):
+            shutil.rmtree(stage, ignore_errors=True)
+        else:
+            try:
+                os.remove(stage)
+            except OSError:
+                pass
+        raise
+    if os.path.isdir(src):
+        shutil.rmtree(src, ignore_errors=True)
+    else:
+        try:
+            os.remove(src)
+        except OSError:
+            pass

@@ -10,6 +10,7 @@ import shutil
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
+from ..core.faults import RetryPolicy, rename_with_exdev_fallback
 from ..models.module import FunctionModel
 
 
@@ -44,10 +45,16 @@ class FaultToleranceUtils:
     @staticmethod
     def retry_with_timeout(fn: Callable[[], Any], retries: int = 3,
                            timeout_s: float = 60.0,
-                           backoff_s: float = 1.0) -> Any:
+                           backoff_s: float = 1.0,
+                           policy: Optional[RetryPolicy] = None) -> Any:
         from concurrent.futures import ThreadPoolExecutor
         from concurrent.futures import TimeoutError as FutureTimeout
 
+        # jittered exponential backoff between attempts (core.faults policy;
+        # seed the policy for a deterministic wait sequence)
+        pol = policy or RetryPolicy(max_retries=retries, base_s=backoff_s,
+                                    multiplier=2.0, jitter=0.1)
+        rng = pol.make_rng()
         last: Optional[Exception] = None
         for attempt in range(retries):
             # Non-context-managed on purpose: `with` would join the worker on exit,
@@ -65,7 +72,7 @@ class FaultToleranceUtils:
                 last = e
             finally:
                 pool.shutdown(wait=False, cancel_futures=True)
-            time.sleep(backoff_s * (2 ** attempt))
+            time.sleep(pol.next_wait(attempt, rng))
         raise last  # type: ignore[misc]
 
 
@@ -160,7 +167,10 @@ class ModelDownloader:
                             f"hash mismatch for {schema.name}: {got} != {schema.hash}")
                 if os.path.exists(dest):
                     shutil.rmtree(dest) if os.path.isdir(dest) else os.remove(dest)
-                os.rename(staged, dest)
+                # EXDEV-safe: staging (often tmpfs) and the destination cache
+                # may live on different filesystems; the final hop into dest
+                # stays an atomic same-fs rename either way
+                rename_with_exdev_fallback(staged, dest)
             finally:
                 shutil.rmtree(stage, ignore_errors=True)
             return dest

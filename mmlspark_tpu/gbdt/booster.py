@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core import faults as _faults
 from ..parallel.mesh import fetch_global
 
 from .binning import BinMapper
@@ -503,7 +504,7 @@ def _widen_bins(b):
 
 
 def _scan_train_ok(params: TrainParams, objective: str, valid, log,
-                   shard_put) -> bool:
+                   shard_put, checkpoint=None) -> bool:
     """Can this run take the whole-training-in-one-dispatch lax.scan path?
 
     The scan path removes EVERY per-iteration host round trip (the per-tree
@@ -528,6 +529,10 @@ def _scan_train_ok(params: TrainParams, objective: str, valid, log,
     if valid is not None or log is not None or params.train_metric:
         return False
     if shard_put is not None:
+        return False
+    if checkpoint is not None:
+        # iteration-level checkpointing needs a per-iteration host boundary;
+        # the whole-run scan has none
         return False
     max_nodes = 2 * params.num_leaves - 1
     if max_nodes < 3:
@@ -1042,6 +1047,7 @@ def _train_native(params: TrainParams, X: np.ndarray, y: np.ndarray,
     wv = np.asarray(weights, dtype=np.float64) if weights is not None else None
 
     for it in range(params.num_iterations):
+        _faults.fire(_faults.TRAIN_STEP, iteration=it, engine="native")
         dropped: List[int] = []
         if is_dart and booster.trees:
             n_trees = len(booster.trees)
@@ -1191,7 +1197,7 @@ def train(params: TrainParams,
           init_scores: Optional[np.ndarray] = None,
           init_model: Optional[Booster] = None,
           log: Optional[Callable[[str], None]] = None,
-          mesh=None) -> Booster:
+          mesh=None, checkpoint=None) -> Booster:
     """Full training: bin, boost, early-stop. Returns a Booster.
 
     ``mesh``: optional jax Mesh — rows are sharded over the ``data`` axis and the
@@ -1200,10 +1206,21 @@ def train(params: TrainParams,
     (TrainUtils.scala:383-418). Rows are padded to a shard multiple with
     zero-hessian padding so they never influence splits (empty-partition
     IgnoreStatus parity, TrainUtils.scala:332-341).
+
+    ``checkpoint``: optional gbdt.checkpoint.CheckpointConfig — atomically
+    persists the model + loop state every k iterations and resumes an
+    interrupted fit from the last checkpoint, replaying the remaining
+    iterations identically to an uninterrupted run (pins the fit to the
+    per-iteration host-orchestrated loop; see CheckpointConfig docs).
     """
+    if checkpoint is not None:
+        from .checkpoint import (check_params_match, load_checkpoint,
+                                 save_checkpoint)
     # native C++ host engine for small fits (and CPU-only hosts): decided
-    # before ANY device work so the tunnel/H2D is never touched
-    if mesh is None and groups is None and _native_train_ok(params, len(y)):
+    # before ANY device work so the tunnel/H2D is never touched.
+    # Checkpointed fits skip it — the native loop keeps its state in C++.
+    if mesh is None and groups is None and checkpoint is None \
+            and _native_train_ok(params, len(y)):
         nb = _train_native(params, X, y, weights, valid, valid_groups,
                            init_scores, init_model, log)
         if nb is not None:
@@ -1391,9 +1408,34 @@ def train(params: TrainParams,
     lr = 1.0 if is_rf else params.learning_rate
     bag_mask = np.ones(n, dtype=bool)  # persists across iters (bagging_freq reuse)
 
+    # ----- checkpoint resume: restore model + loop state (scores, RNG
+    # stream, bagging mask, early-stopping bookkeeping) so iterations
+    # start_it..N replay the uninterrupted computation exactly
+    start_it = 0
+    if checkpoint is not None and checkpoint.resume:
+        ck = load_checkpoint(checkpoint.path)
+        if ck is not None:
+            check_params_match(ck["params"], dataclasses.asdict(params),
+                               checkpoint.path)
+            restored = Booster.from_string(ck["model"])
+            booster.trees = restored.trees
+            booster.base_score = restored.base_score
+            if ck["scores"].shape != (n, k):
+                raise ValueError(
+                    f"checkpoint {checkpoint.path!r} scores shape "
+                    f"{ck['scores'].shape} does not match this dataset "
+                    f"({(n, k)}); resume requires the same data and mesh")
+            scores = ck["scores"]
+            rng.bit_generator.state = ck["rng_state"]
+            bag_mask = ck["bag_mask"].astype(bool)
+            best_val = ck["best_val"]
+            best_iter = ck["best_iter"]
+            rounds_no_improve = ck["rounds_no_improve"]
+            start_it = int(ck["iteration"])
+
     # whole-run fused path: every boosting iteration inside ONE lax.scan
     # dispatch — no per-tree host round trips at all
-    if _scan_train_ok(params, objective, valid, log, shard_put):
+    if _scan_train_ok(params, objective, valid, log, shard_put, checkpoint):
         row_masks, feat_masks, ok = _scan_precompute_masks(
             params, rng, n, num_f, np.asarray(y), is_rf)
         if ok:
@@ -1434,7 +1476,10 @@ def train(params: TrainParams,
         return (np.asarray(s, dtype=np.float64)
                 + np.asarray(c, dtype=np.float64)).reshape(n, -1)
 
-    for it in range(params.num_iterations):
+    for it in range(start_it, params.num_iterations):
+        # chaos seam: a planned fault here simulates preemption mid-train
+        # (the last checkpoint is on disk; resume replays from it)
+        _faults.fire(_faults.TRAIN_STEP, iteration=it)
         # ----- dart: drop a subset of existing trees from the current scores
         dropped: List[int] = []
         if is_dart and booster.trees:
@@ -1571,6 +1616,21 @@ def train(params: TrainParams,
                             np.asarray(y[:n_real], dtype=np.float64),
                             groups[:n_real] if groups is not None else None)
             log(f"[{it + 1}] train {metric}={m:.6f}")
+
+        # ----- atomic checkpoint every k iterations (and at the end)
+        if checkpoint is not None and (
+                (it + 1) % max(checkpoint.every_k, 1) == 0
+                or it + 1 == params.num_iterations):
+            save_checkpoint(
+                checkpoint.path,
+                params_dict=dataclasses.asdict(params),
+                model_string=booster.to_string(),
+                iteration=it + 1,
+                scores=_host_scores() if fast_scores else scores,
+                rng_state=rng.bit_generator.state,
+                bag_mask=bag_mask,
+                best_val=best_val, best_iter=best_iter,
+                rounds_no_improve=rounds_no_improve)
 
     if is_rf and booster.trees:
         inv = 1.0 / len(booster.trees)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -25,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..core import faults
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, HasInputCol, HasOutputCol, Param
 from ..core.pipeline import Transformer
@@ -72,12 +74,22 @@ class HTTPResponseData:
 
 RETRYABLE_CODES = {403, 408, 429, 500, 502, 503, 504}
 
+#: +/- jitter fraction applied to the legacy fixed backoff list (decorrelates
+#: synchronized retry storms from many partitions hitting one rate-limited
+#: host; a seeded RetryPolicy gives a deterministic stream instead)
+_LEGACY_JITTER = 0.2
 
-def send_request(req: HTTPRequestData, timeout: float = 60.0) -> HTTPResponseData:
+
+def send_request(req: HTTPRequestData, timeout: float = 60.0,
+                 deadline: Optional[faults.Deadline] = None
+                 ) -> HTTPResponseData:
+    if deadline is not None:
+        timeout = max(deadline.cap(timeout), 1e-3)
     r = urllib.request.Request(req.url, data=req.entity,
                                headers=req.headers or {},
                                method=req.method or "GET")
     try:
+        faults.fire(faults.HTTP_SEND, url=req.url, method=req.method)
         with urllib.request.urlopen(r, timeout=timeout) as resp:
             return HTTPResponseData(
                 statusCode=resp.status,
@@ -92,26 +104,85 @@ def send_request(req: HTTPRequestData, timeout: float = 60.0) -> HTTPResponseDat
         return HTTPResponseData(statusCode=0, statusLine=str(e))
 
 
+def parse_retry_after(value: Optional[str],
+                      now: Optional[float] = None) -> Optional[float]:
+    """Seconds to wait from a Retry-After header: numeric seconds OR an
+    HTTP-date (RFC 9110 both forms). None when unparseable."""
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except (TypeError, ValueError):
+        pass
+    from email.utils import parsedate_to_datetime
+
+    try:
+        dt = parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if dt is None:
+        return None
+    if dt.tzinfo is None:
+        from datetime import timezone
+
+        dt = dt.replace(tzinfo=timezone.utc)
+    return max(0.0, dt.timestamp() - (time.time() if now is None else now))
+
+
 def send_with_retries(req: HTTPRequestData, retry_backoffs_ms=(100, 500, 1000),
                       timeout: float = 60.0,
-                      sleep_fn: Callable[[float], None] = time.sleep
+                      sleep_fn: Callable[[float], None] = time.sleep,
+                      policy: Optional[faults.RetryPolicy] = None,
+                      deadline: Optional[faults.Deadline] = None
                       ) -> HTTPResponseData:
-    """Status-aware retry: retryable codes back off; 429 honors Retry-After
-    (io/http/HTTPClients.scala:73-117)."""
-    resp = send_request(req, timeout)
-    for backoff_ms in retry_backoffs_ms:
+    """Status-aware retry: retryable codes back off with jitter; 429/503
+    honor Retry-After (numeric seconds or HTTP-date), and every honored wait
+    is capped at the request deadline (io/http/HTTPClients.scala:73-117).
+
+    ``policy``: a core.faults.RetryPolicy replacing the legacy fixed backoff
+    list (seedable jitter, sleep budget). ``deadline``: when set, no sleep or
+    socket timeout extends past it; once expired the last response returns
+    as-is instead of retrying into a lost cause.
+    """
+    rng = policy.make_rng() if policy is not None else random.Random()
+    n_attempts = policy.max_retries if policy is not None \
+        else len(retry_backoffs_ms)
+    budget_left = policy.budget_s if policy is not None else None
+
+    def _send():
+        # the deadline arg is only threaded through when set: injected test
+        # handlers replace send_request with (req, timeout) signatures
+        if deadline is None:
+            return send_request(req, timeout)
+        return send_request(req, timeout, deadline)
+
+    resp = _send()
+    for attempt in range(n_attempts):
         if resp.statusCode == 200 or resp.statusCode not in RETRYABLE_CODES | {0}:
             return resp
-        wait = backoff_ms / 1000.0
-        if resp.statusCode == 429 and resp.headers:
-            ra = resp.headers.get("Retry-After") or resp.headers.get("retry-after")
-            if ra:
-                try:
-                    wait = float(ra)
-                except ValueError:
-                    pass
+        if policy is not None:
+            wait = policy.next_wait(attempt, rng)
+        else:
+            base = retry_backoffs_ms[attempt] / 1000.0
+            wait = max(0.0, base * (1.0 + _LEGACY_JITTER * rng.uniform(-1, 1)))
+        if resp.statusCode in (429, 503) and resp.headers:
+            ra = parse_retry_after(
+                resp.headers.get("Retry-After")
+                or resp.headers.get("retry-after"))
+            if ra is not None:
+                wait = ra  # server-directed wait: exact, not jittered
+        if budget_left is not None:
+            if budget_left <= 0:
+                return resp
+            wait = min(wait, budget_left)
+            budget_left -= wait
+        if deadline is not None:
+            remaining = deadline.remaining()
+            if remaining <= 0:
+                return resp
+            wait = min(wait, remaining)  # cap the honored wait at the deadline
         sleep_fn(wait)
-        resp = send_request(req, timeout)
+        resp = _send()
     return resp
 
 
@@ -173,7 +244,9 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
         conc = self.get("concurrency")
         timeout = self.get("timeout")
         handler = self.get("handler") or (
-            lambda r: send_with_retries(r, timeout=timeout))
+            lambda r: send_with_retries(
+                r, timeout=timeout,
+                deadline=faults.deadline_from_headers(r.headers)))
 
         def fn(p):
             col = p[in_col]
@@ -281,7 +354,9 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
         in_parser = self.get_or_throw("inputParser")
         out_parser = self.get("outputParser") or JSONOutputParser()
         handler = self.get("handler") or (
-            lambda r: send_with_retries(r, timeout=self.get("timeout")))
+            lambda r: send_with_retries(
+                r, timeout=self.get("timeout"),
+                deadline=faults.deadline_from_headers(r.headers)))
         conc = self.get("concurrency")
 
         def fn(part):

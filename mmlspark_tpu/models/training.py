@@ -17,11 +17,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+import signal as _signal
+import threading
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import numpy as np
 
 from .module import Module, Sequential
+from ..core import faults
 from ..parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
 
 
@@ -141,6 +144,115 @@ def make_train_step(module: Module, optimizer, bn_momentum: float = 0.9) -> Call
         return TrainState(params, opt_state, state.step + 1), metrics
 
     return step
+
+
+class PreemptionGuard:
+    """Turns a preemption signal (SIGTERM — what TPU VMs get on maintenance
+    events and spot reclaims) into a flag the training loop polls between
+    steps, so the loop checkpoints and exits cleanly instead of dying
+    mid-step.
+
+    ``request()`` triggers the same path programmatically (tests, cluster
+    agents that learn of preemption out-of-band). Installing the handler only
+    works on the main thread; elsewhere the guard silently degrades to the
+    programmatic path.
+    """
+
+    def __init__(self, signals: Tuple[int, ...] = (_signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev: Dict[int, Any] = {}
+
+    def request(self) -> None:
+        self._event.set()
+
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def __enter__(self) -> "PreemptionGuard":
+        for sig in self.signals:
+            try:
+                self._prev[sig] = _signal.signal(
+                    sig, lambda *_: self._event.set())
+            except ValueError:  # not the main thread
+                pass
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for sig, prev in self._prev.items():
+            try:
+                _signal.signal(sig, prev)
+            except ValueError:
+                pass
+        self._prev.clear()
+
+
+@dataclasses.dataclass
+class TrainLoopResult:
+    state: TrainState
+    steps_run: int
+    preempted: bool
+    last_metrics: Optional[Dict[str, float]]
+
+
+def run_train_loop(state: TrainState, step_fn: Callable, batches: Iterable,
+                   *, checkpoint_path: Optional[str] = None,
+                   every_k: int = 100,
+                   guard: Optional[PreemptionGuard] = None,
+                   resume: bool = True,
+                   log: Optional[Callable[[str], None]] = None
+                   ) -> TrainLoopResult:
+    """Drive ``step_fn`` over ``batches`` with checkpoint/resume and a
+    preemption hook — the DNN counterpart of the GBDT checkpointed fit.
+
+    ``checkpoint_path``: TrainState saved there every ``every_k`` steps and
+    on preemption (models.checkpoint/orbax — sharded arrays restore onto
+    their original device placement via the live ``state`` as reference).
+    ``resume=True`` restores it when present and skips the already-trained
+    prefix of ``batches`` by the restored step counter — a deterministic
+    (seeded/indexed) batch stream therefore replays the exact uninterrupted
+    schedule. ``guard``: a PreemptionGuard polled between steps; when it
+    fires, the loop checkpoints once more and returns ``preempted=True``.
+    """
+    from .checkpoint import load_train_state, save_train_state
+
+    start_step = 0
+    if checkpoint_path is not None and resume:
+        import os
+
+        if os.path.exists(checkpoint_path):
+            state = load_train_state(checkpoint_path, like=state)
+            start_step = int(np.asarray(state.step))
+            if log:
+                log(f"resumed from {checkpoint_path} at step {start_step}")
+
+    steps_run = 0
+    metrics_out: Optional[Dict[str, float]] = None
+    dirty = False  # steps since the last save
+    preempted = False
+    for i, batch in enumerate(batches):
+        if i < start_step:
+            continue  # replayed prefix: already folded into the checkpoint
+        if guard is not None and guard.requested():
+            preempted = True
+            break
+        faults.fire(faults.TRAIN_STEP, step=i, engine="dnn")
+        state, metrics = step_fn(state, batch)
+        steps_run += 1
+        dirty = True
+        metrics_out = metrics
+        if checkpoint_path is not None and steps_run % max(every_k, 1) == 0:
+            save_train_state(state, checkpoint_path)
+            dirty = False
+    else:
+        if guard is not None and guard.requested():
+            preempted = True
+    if checkpoint_path is not None and (dirty or preempted):
+        save_train_state(state, checkpoint_path)
+    if metrics_out is not None:
+        metrics_out = {k: float(v) for k, v in metrics_out.items()}
+    return TrainLoopResult(state=state, steps_run=steps_run,
+                           preempted=preempted, last_metrics=metrics_out)
 
 
 def param_sharding_rules(params, mesh):

@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from ..core import faults
 from .batching import DevicePrefetcher
 
 
@@ -266,6 +267,11 @@ class TransferRing:
             timing = BatchTiming(bytes_in=_tree_nbytes(item),
                                  rows=_tree_rows(item))
             t0 = time.perf_counter()
+            # chaos seam: an injected delay here shows up in h2d_s (slow
+            # link), an injected exception re-raises at the consumer via the
+            # prefetcher (transfer failure mid-stream)
+            faults.fire(faults.INGEST_H2D, rows=timing.rows,
+                        nbytes=timing.bytes_in)
             staged = put(item) if put is not None else item
             _block_ready(staged)
             timing.h2d_s = time.perf_counter() - t0

@@ -24,6 +24,9 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..core import faults
+from ..core.faults import fsync_dir
+
 
 class RequestJournal:
     def __init__(self, path: str):
@@ -43,6 +46,7 @@ class RequestJournal:
 
     def append(self, epoch: int, rid: int, body: bytes,
                headers: Optional[Dict[str, str]] = None) -> None:
+        faults.fire(faults.JOURNAL_WRITE, epoch=epoch, n=1)
         with self._lock:
             self._fh.write(self._entry(epoch, rid, body, headers) + "\n")
             self._fh.flush()
@@ -54,12 +58,14 @@ class RequestJournal:
         ``entries``: iterable of (rid, body, headers)."""
         lines = [self._entry(epoch, rid, body, headers)
                  for rid, body, headers in entries]
+        faults.fire(faults.JOURNAL_WRITE, epoch=epoch, n=len(lines))
         with self._lock:
             self._fh.write("\n".join(lines) + "\n")
             self._fh.flush()
             os.fsync(self._fh.fileno())
 
     def commit(self, epoch: int) -> None:
+        faults.fire(faults.JOURNAL_COMMIT, epoch=epoch)
         with self._lock:
             self._fh.write(json.dumps({"op": "commit",
                                        "epoch": int(epoch)}) + "\n")
@@ -110,14 +116,34 @@ class RequestJournal:
 
     def compact(self) -> None:
         """Rewrite the journal keeping only uncommitted epochs, preserving
-        their epoch numbers (a late commit of a live epoch must still match)."""
+        their epoch numbers (a late commit of a live epoch must still match).
+
+        Atomic AND durable: the replacement is fully written + fsynced before
+        the rename, and the directory is fsynced after — a crash at any point
+        mid-compact leaves either the complete old journal or the complete
+        new one, never a torn file that loses uncommitted epochs."""
         with self._lock:
             self._fh.close()
-            pending = self._pending_by_epoch(self.path)
-            tmp = self.path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as fh:
-                for epoch in sorted(pending):
-                    for rid, body, headers in pending[epoch]:
-                        fh.write(self._entry(epoch, rid, body, headers) + "\n")
-            os.replace(tmp, self.path)
-            self._fh = open(self.path, "a", encoding="utf-8")
+            try:
+                pending = self._pending_by_epoch(self.path)
+                tmp = self.path + ".tmp"
+                try:
+                    with open(tmp, "w", encoding="utf-8") as fh:
+                        for epoch in sorted(pending):
+                            for rid, body, headers in pending[epoch]:
+                                fh.write(self._entry(epoch, rid, body,
+                                                     headers) + "\n")
+                        fh.flush()
+                        os.fsync(fh.fileno())
+                    os.replace(tmp, self.path)
+                except BaseException:
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+                    raise
+                fsync_dir(os.path.dirname(os.path.abspath(self.path)))
+            finally:
+                # reopen even on failure: the journal must stay writable
+                # (the old complete file is still in place)
+                self._fh = open(self.path, "a", encoding="utf-8")

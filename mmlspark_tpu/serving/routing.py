@@ -3,8 +3,15 @@
 Reference: HTTPSourceV2.scala:113-173 — the driver runs an HttpServer; every
 WorkerServer POSTs its ServiceInfo{name, host, port} to register, and public
 traffic is spread across registered workers. Worker loss is handled by retrying
-on another worker and evicting the dead one (Spark task retry gave the
-reference this for free; here it's explicit).
+on another worker (Spark task retry gave the reference this for free; here
+it's explicit) — but unlike the pre-fault-layer build, failing workers are NOT
+blacklisted forever: each worker runs a circuit breaker (closed -> open on
+``max_failures`` consecutive failures), and open workers are health-probed on
+a jittered backoff and re-admitted when they answer again.
+
+Deadline contract: requests carrying ``X-MMLSpark-Deadline`` (epoch seconds)
+are rejected with 504 once expired — before any forward — and the per-worker
+forward timeout is capped at the remaining deadline.
 
 TPU-native deployment note: one RoutingFront per serving cluster (typically on
 the coordinator host), one ServingServer per TPU host; the pipeline inside
@@ -18,62 +25,108 @@ from __future__ import annotations
 import itertools
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.error import HTTPError, URLError
 from urllib.parse import urlsplit
 from urllib.request import Request, urlopen
 
+from ..core import faults
+from ..core.faults import RetryPolicy, deadline_from_headers
+
+#: circuit-breaker states (per registered worker)
+CLOSED = "closed"          # healthy: receives traffic
+OPEN = "open"              # tripped: excluded from routing, health-probed
+HALF_OPEN = "half_open"    # probe succeeded: routed again, one failure re-opens
+
+
+class _WorkerCircuit:
+    __slots__ = ("state", "failures", "next_probe", "probe_attempt")
+
+    def __init__(self):
+        self.state = CLOSED
+        self.failures = 0
+        self.next_probe = 0.0
+        self.probe_attempt = 0
+
 
 class RoutingFront:
-    """HTTP front: register workers, round-robin public requests, evict dead.
+    """HTTP front: register workers, round-robin public requests, circuit-
+    break dead ones and re-admit them when health probes succeed.
 
     Endpoints:
       POST /_mmlspark/register   {"address": "http://host:port/api"} -> 200
-      GET  /_mmlspark/workers    -> {"workers": [...]}
-      anything else              -> forwarded to a worker (retry across
-                                    workers; a worker failing ``max_failures``
-                                    consecutive times is evicted)
+      GET  /_mmlspark/workers    -> {"workers": [...], "states": {...}}
+      anything else              -> forwarded to a routable worker (retry
+                                    across workers; ``max_failures``
+                                    consecutive failures trip the worker's
+                                    breaker OPEN — probed, not blacklisted)
     """
 
     REGISTER_PATH = "/_mmlspark/register"
     WORKERS_PATH = "/_mmlspark/workers"
+    #: probed path on the worker host: cheap on ServingServer (stats
+    #: endpoint); any HTTP answer — 404 included — proves liveness elsewhere
+    PROBE_PATH = "/_mmlspark/stats"
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  forward_timeout_s: float = 70.0, max_failures: int = 3,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 probe_interval_s: float = 0.5,
+                 probe_timeout_s: float = 2.0,
+                 probe_policy: Optional[RetryPolicy] = None):
         self.host = host
         self.port = port
         self.forward_timeout_s = forward_timeout_s
         self.max_failures = max_failures
         self.token = token  # when set, /register requires X-MMLSpark-Token
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        # probe backoff: open workers are re-probed on a jittered exponential
+        # schedule (deterministic when the policy is seeded)
+        self.probe_policy = probe_policy or RetryPolicy(
+            max_retries=1 << 30, base_s=probe_interval_s, multiplier=2.0,
+            max_backoff_s=max(probe_interval_s * 16, probe_interval_s),
+            jitter=0.2, seed=0)
+        self._probe_rng = self.probe_policy.make_rng()
         self._workers: List[str] = []
-        self._failures: Dict[str, int] = {}
+        self._circuits: Dict[str, _WorkerCircuit] = {}
         self._lock = threading.Lock()
         self._rr = itertools.count()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
 
     # -- worker management ------------------------------------------------
     def register(self, address: str) -> None:
         with self._lock:
             if address not in self._workers:
                 self._workers.append(address)
-            self._failures[address] = 0
+            self._circuits[address] = _WorkerCircuit()
 
     def deregister(self, address: str) -> None:
         with self._lock:
             if address in self._workers:
                 self._workers.remove(address)
-            self._failures.pop(address, None)
+            self._circuits.pop(address, None)
 
     @property
     def workers(self) -> List[str]:
+        """Routable workers (breaker closed or half-open)."""
         with self._lock:
-            return list(self._workers)
+            return [w for w in self._workers
+                    if self._circuits[w].state != OPEN]
+
+    @property
+    def worker_states(self) -> Dict[str, str]:
+        with self._lock:
+            return {w: self._circuits[w].state for w in self._workers}
 
     def _pick_order(self) -> List[str]:
         with self._lock:
-            ws = list(self._workers)
+            ws = [w for w in self._workers
+                  if self._circuits[w].state != OPEN]
         if not ws:
             return []
         start = next(self._rr) % len(ws)
@@ -81,14 +134,59 @@ class RoutingFront:
 
     def _note_failure(self, address: str) -> None:
         with self._lock:
-            n = self._failures.get(address, 0) + 1
-            self._failures[address] = n
-            if n >= self.max_failures and address in self._workers:
-                self._workers.remove(address)
+            c = self._circuits.get(address)
+            if c is None:
+                return
+            c.failures += 1
+            # a half-open worker re-opens on its first failure; a closed one
+            # trips after max_failures consecutive failures
+            if c.state == HALF_OPEN or c.failures >= self.max_failures:
+                c.state = OPEN
+                c.probe_attempt = 0
+                c.next_probe = time.monotonic() + self.probe_policy.next_wait(
+                    0, self._probe_rng)
 
     def _note_success(self, address: str) -> None:
         with self._lock:
-            self._failures[address] = 0
+            c = self._circuits.get(address)
+            if c is not None:
+                c.failures = 0
+                c.state = CLOSED
+
+    # -- health probing (re-admission instead of permanent blacklist) -----
+    def _probe(self, address: str) -> bool:
+        parts = urlsplit(address)
+        url = f"{parts.scheme}://{parts.netloc}{self.PROBE_PATH}"
+        try:
+            with urlopen(Request(url, method="GET"),
+                         timeout=self.probe_timeout_s):
+                return True
+        except HTTPError:
+            return True  # the worker answered: alive, path just unsupported
+        except (URLError, OSError):
+            return False
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(min(self.probe_interval_s, 0.1)):
+            now = time.monotonic()
+            with self._lock:
+                due = [w for w in self._workers
+                       if self._circuits[w].state == OPEN
+                       and now >= self._circuits[w].next_probe]
+            for addr in due:
+                alive = self._probe(addr)
+                with self._lock:
+                    c = self._circuits.get(addr)
+                    if c is None or c.state != OPEN:
+                        continue
+                    if alive:
+                        c.state = HALF_OPEN
+                        c.failures = 0
+                    else:
+                        c.probe_attempt += 1
+                        c.next_probe = time.monotonic() + \
+                            self.probe_policy.next_wait(
+                                c.probe_attempt, self._probe_rng)
 
     # -- HTTP ---------------------------------------------------------------
     def _make_handler(self):
@@ -105,10 +203,13 @@ class RoutingFront:
                 return self.rfile.read(length) if length else b""
 
             def _respond(self, status: int, body: bytes,
-                         ctype: str = "application/json"):
+                         ctype: str = "application/json",
+                         extra: Optional[Dict[str, str]] = None):
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -131,7 +232,14 @@ class RoutingFront:
                     return
                 if path == RoutingFront.WORKERS_PATH:
                     self._respond(200, json.dumps(
-                        {"workers": front.workers}).encode())
+                        {"workers": front.workers,
+                         "states": front.worker_states}).encode())
+                    return
+                # deadline gate: an expired request is dropped HERE, before
+                # any forward burns a worker slot
+                dl = deadline_from_headers(self.headers)
+                if dl is not None and dl.expired():
+                    self._respond(504, b'{"error": "deadline expired"}')
                     return
                 # forward to a worker, retrying across the ring; a request is
                 # only REPLAYED on another worker when the failure shows it
@@ -140,7 +248,8 @@ class RoutingFront:
                 # worker is mid-compute, so replaying would double-process it
                 order = front._pick_order()
                 if not order:
-                    self._respond(503, b'{"error": "no workers registered"}')
+                    self._respond(503, b'{"error": "no workers registered"}',
+                                  extra={"Retry-After": "1"})
                     return
                 idempotent = self.command in ("GET", "HEAD")
                 for addr in order:
@@ -157,9 +266,17 @@ class RoutingFront:
                                            self.headers.items()
                                            if k.lower() not in
                                            ("host", "content-length")})
+                    timeout = front.forward_timeout_s
+                    if dl is not None:
+                        if dl.expired():
+                            self._respond(
+                                504, b'{"error": "deadline expired"}')
+                            return
+                        timeout = max(dl.cap(timeout), 1e-3)
                     try:
-                        with urlopen(req,
-                                     timeout=front.forward_timeout_s) as resp:
+                        faults.fire(faults.WORKER_FORWARD, addr=addr,
+                                    path=path)
+                        with urlopen(req, timeout=timeout) as resp:
                             front._note_success(addr)
                             self._respond(
                                 resp.status, resp.read(),
@@ -195,15 +312,23 @@ class RoutingFront:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "RoutingFront":
+        self._stop.clear()
         self._httpd = ThreadingHTTPServer((self.host, self.port),
                                           self._make_handler())
         self.port = self._httpd.server_address[1]
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True,
                              name="routing-front")
         t.start()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True, name="routing-front-probe")
+        self._probe_thread.start()
         return self
 
     def stop(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+            self._probe_thread = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.dataframe import DataFrame
+from ..core.faults import deadline_from_headers
 
 #: header carrying the shared cluster secret for internal endpoints
 TOKEN_HEADER = "X-MMLSpark-Token"
@@ -142,7 +143,8 @@ class ServingServer:
                  slot_timeout_s: float = 60.0, token: Optional[str] = None,
                  journal_path: Optional[str] = None,
                  name: str = "serving",
-                 ingest_stats: Optional[Callable[[], Optional[dict]]] = None):
+                 ingest_stats: Optional[Callable[[], Optional[dict]]] = None,
+                 max_queue: int = 0, drain_timeout_s: float = 5.0):
         self.transform = transform
         # optional provider of the device-ingest decomposition (queue/h2d/
         # compute/readback — parallel/ingest.IngestStats.summary) merged into
@@ -158,6 +160,12 @@ class ServingServer:
         self.max_wait_ms = max_wait_ms
         self.name = name
         self.token = token
+        # bounded admission: above max_queue pending requests, new arrivals
+        # load-shed with 503 + Retry-After instead of growing latency without
+        # bound (0 = unbounded, the legacy behavior)
+        self.max_queue = max_queue
+        self.drain_timeout_s = drain_timeout_s
+        self._draining = threading.Event()
         # write-ahead journal => epoch/commit semantics (journal.py): each
         # drained batch is an epoch, committed once every request is answered
         self._journal = None
@@ -238,6 +246,37 @@ class ServingServer:
                 if path != server.api_path:
                     self.send_error(404)
                     return
+                # -- admission control (hardened serving path) -------------
+                if server._draining.is_set():
+                    # graceful drain: stop accepting, finish what's in flight
+                    body = b'{"error": "server draining"}'
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                dl = deadline_from_headers(self.headers)
+                if dl is not None and dl.expired():
+                    # already dead on arrival: never burns a batch slot
+                    body = b'{"error": "deadline expired"}'
+                    self.send_response(504)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if server.max_queue and \
+                        server._queue.qsize() >= server.max_queue:
+                    body = b'{"error": "admission queue full"}'
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 slot = _ReplySlot()
                 slot.t_in = time.perf_counter()
                 with server._id_lock:
@@ -295,6 +334,21 @@ class ServingServer:
             batch = self._drain_batch()
             if not batch:
                 continue
+            # deadline gate: requests whose deadline expired while queued are
+            # answered 504 HERE — pre-journal, pre-transform — so a backed-up
+            # server never spends compute on replies nobody is waiting for
+            live = []
+            for rid, body, hdrs in batch:
+                dl = deadline_from_headers(hdrs)
+                if dl is not None and dl.expired():
+                    self._fulfill(rid, 504,
+                                  b'{"error": "deadline expired in queue"}',
+                                  content_type="application/json")
+                else:
+                    live.append((rid, body, hdrs))
+            batch = live
+            if not batch:
+                continue
             t_drain = time.perf_counter()
             with self._id_lock:
                 for rid, _, _ in batch:
@@ -315,7 +369,13 @@ class ServingServer:
                     self._epoch += 1
                     epoch = self._epoch
                     self._epoch_rids[epoch] = {int(r) for r in ids}
-                self._journal.append_many(epoch, batch)
+                try:
+                    self._journal.append_many(epoch, batch)
+                except Exception:  # noqa: BLE001 — serve degraded, not dead
+                    # a journal WRITE failure must not take serving down: the
+                    # batch is answered synchronously below, so the only loss
+                    # window is a crash mid-transform of this one epoch
+                    pass
             df = DataFrame([{"id": ids, "value": bodies, "headers": headers,
                              "origin": origin}])
             try:
@@ -349,19 +409,28 @@ class ServingServer:
                         {"error": str(e)}).encode("utf-8"))
             self._maybe_commit_epochs()
 
-    def _maybe_commit_epochs(self) -> None:
+    def _maybe_commit_epochs(self, force: bool = False) -> None:
         """Commit every epoch whose requests are all answered or abandoned
         (their slots are gone) — HTTPSourceV2 commit() parity. Called from
         the batcher thread and peer-reply handler threads; _journal_lock
-        serializes the check-commit-delete so an epoch commits exactly once."""
-        if self._journal is None or self._stop.is_set():
+        serializes the check-commit-delete so an epoch commits exactly once.
+
+        A commit WRITE failure (disk error, injected fault) must not kill the
+        serving loop: the epoch stays pending and the commit retries on the
+        next call — uncommitted epochs replay after a crash, which is exactly
+        the at-least-once contract. ``force`` commits during shutdown (after
+        ``_stop`` is set but before the journal closes)."""
+        if self._journal is None or (self._stop.is_set() and not force):
             return
         with self._id_lock:
             live = set(self._slots)
         with self._journal_lock:
             for epoch in sorted(self._epoch_rids):
                 if not (self._epoch_rids[epoch] & live):
-                    self._journal.commit(epoch)
+                    try:
+                        self._journal.commit(epoch)
+                    except Exception:  # noqa: BLE001 — retried next round
+                        continue
                     del self._epoch_rids[epoch]
 
     def _fulfill(self, rid: int, status: int, reply: Any,
@@ -450,7 +519,20 @@ class ServingServer:
         self._threads = [t_http, t_loop]
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = True) -> None:
+        """Graceful by default: stop ACCEPTING (new requests get 503 +
+        Retry-After), flush the in-flight epochs (queued requests still get
+        answered), then shut down and commit/close the journal. ``drain=False``
+        is the old hard stop (chaos tests use it to simulate a crash)."""
+        if drain and self._httpd is not None and not self._stop.is_set():
+            self._draining.set()
+            deadline = time.perf_counter() + self.drain_timeout_s
+            while time.perf_counter() < deadline:
+                with self._id_lock:
+                    pending = bool(self._slots)
+                if self._queue.empty() and not pending:
+                    break
+                time.sleep(0.01)
         self._stop.set()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -461,6 +543,12 @@ class ServingServer:
             if t.name.endswith("-batcher"):
                 t.join(timeout=5)
         if self._journal is not None:
+            # final commit sweep: fully-answered epochs are committed even
+            # though _stop is set, so a clean shutdown leaves nothing to replay
+            try:
+                self._maybe_commit_epochs(force=True)
+            except Exception:  # noqa: BLE001 — closing anyway
+                pass
             self._journal.close()
 
     @property
@@ -516,7 +604,8 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    parse: str = "json", host: str = "127.0.0.1", port: int = 0,
                    api_path: str = "/", max_batch_size: int = 64,
                    max_wait_ms: float = 5.0, token: Optional[str] = None,
-                   journal_path: Optional[str] = None) -> ServingServer:
+                   journal_path: Optional[str] = None,
+                   max_queue: int = 0) -> ServingServer:
     """Serve a fitted Transformer: request body -> ``input_col`` -> stage ->
     ``reply_col`` (IOImplicits fluent sugar parity, io/IOImplicits.scala:182-213).
 
@@ -544,4 +633,5 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
     return ServingServer(transform, host=host, port=port, api_path=api_path,
                          reply_col=reply_col, max_batch_size=max_batch_size,
                          max_wait_ms=max_wait_ms, token=token,
-                         journal_path=journal_path, ingest_stats=ingest)
+                         journal_path=journal_path, ingest_stats=ingest,
+                         max_queue=max_queue)

@@ -1,0 +1,955 @@
+"""Chaos suite for the fault-tolerance layer (core/faults.py).
+
+Every scenario is deterministic: seeded FaultInjector plans, seeded
+RetryPolicy jitter, injected sleeps <= 0.2s. Covers the resilience contract
+end to end (docs/faults.md): retry policy + deadline propagation, chaos
+injection points, atomic-file helpers, journal crash recovery, circuit-
+breaker routing with health-probe re-admission, bounded admission + graceful
+drain, GBDT mid-train resume, and the preemption-aware DNN train loop.
+"""
+
+import errno
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.faults import (
+    DEADLINE_HEADER,
+    Deadline,
+    FaultInjector,
+    InjectedFault,
+    RetryPolicy,
+    atomic_write_text,
+    deadline_from_headers,
+    rename_with_exdev_fallback,
+)
+
+pytestmark = pytest.mark.faults
+
+
+def _post(url, obj, timeout=15, headers=None):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 headers=hdrs, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+def _post_status(url, obj, timeout=15, headers=None):
+    """Status + parsed body + headers, HTTP errors included."""
+    try:
+        return _post(url, obj, timeout, headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {}), dict(e.headers)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / Deadline
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_jitter_is_deterministic_under_seed(self):
+        p = RetryPolicy(max_retries=5, base_s=0.1, jitter=0.3, seed=7)
+        assert list(p.backoffs()) == list(p.backoffs())
+        q = RetryPolicy(max_retries=5, base_s=0.1, jitter=0.3, seed=8)
+        assert list(p.backoffs()) != list(q.backoffs())
+
+    def test_backoff_grows_and_caps(self):
+        p = RetryPolicy(max_retries=6, base_s=0.1, multiplier=2.0,
+                        max_backoff_s=0.4, jitter=0.0)
+        waits = list(p.backoffs())
+        assert waits == [0.1, 0.2, 0.4, 0.4, 0.4, 0.4]
+
+    def test_budget_bounds_total_sleep(self):
+        p = RetryPolicy(max_retries=50, base_s=1.0, jitter=0.0, budget_s=2.5)
+        waits = list(p.backoffs())
+        assert sum(waits) <= 2.5 + 1e-9
+
+    def test_deadline_stops_run(self):
+        """Each wait is capped at the remaining deadline and the retry loop
+        stops once it lapses: a 10s backoff against a 50ms deadline sleeps at
+        most ~50ms total, then re-raises."""
+        p = RetryPolicy(max_retries=50, base_s=10.0, jitter=0.0)
+        dl = Deadline.from_timeout(0.05)
+        calls, slept = [], []
+
+        def boom():
+            calls.append(1)
+            raise ValueError("down")
+
+        with pytest.raises(ValueError):
+            p.run(boom, deadline=dl,
+                  sleep_fn=lambda s: (slept.append(s), time.sleep(s)))
+        assert len(calls) <= 3
+        assert all(w <= 0.05 + 1e-6 for w in slept)
+
+    def test_run_retries_then_raises(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise ValueError("nope")
+
+        p = RetryPolicy(max_retries=3, base_s=0.001, jitter=0.0)
+        slept = []
+        with pytest.raises(ValueError):
+            p.run(boom, sleep_fn=slept.append)
+        assert len(calls) == 4 and len(slept) == 3
+
+    def test_run_respects_should_retry(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise KeyError("fatal")
+
+        p = RetryPolicy(max_retries=5, base_s=0.001)
+        with pytest.raises(KeyError):
+            p.run(boom, should_retry=lambda e: not isinstance(e, KeyError),
+                  sleep_fn=lambda s: None)
+        assert len(calls) == 1
+
+
+class TestDeadline:
+    def test_header_round_trip(self):
+        dl = Deadline.from_timeout(30)
+        back = Deadline.from_header(dl.to_header())
+        assert back is not None and abs(back.at - dl.at) < 1e-9
+
+    def test_case_insensitive_lookup(self):
+        dl = Deadline.from_timeout(30)
+        got = deadline_from_headers({DEADLINE_HEADER.lower(): dl.to_header()})
+        assert got is not None and abs(got.at - dl.at) < 1e-9
+        assert deadline_from_headers({}) is None
+        assert deadline_from_headers(None) is None
+        assert deadline_from_headers({DEADLINE_HEADER: "garbage"}) is None
+
+    def test_cap_and_expiry(self):
+        dl = Deadline(time.time() - 1)
+        assert dl.expired() and dl.remaining() == 0.0 and dl.cap(5.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Retry-After parsing + send_with_retries hardening
+# ---------------------------------------------------------------------------
+
+
+class TestRetryAfter:
+    def test_numeric_seconds(self):
+        from mmlspark_tpu.io.http import parse_retry_after
+
+        assert parse_retry_after("2.5") == 2.5
+        assert parse_retry_after("-3") == 0.0
+
+    def test_http_date(self):
+        from email.utils import formatdate
+
+        from mmlspark_tpu.io.http import parse_retry_after
+
+        now = time.time()
+        wait = parse_retry_after(formatdate(now + 60, usegmt=True), now=now)
+        assert wait is not None and 58 <= wait <= 61
+        # a date in the past means "retry now", not a negative sleep
+        assert parse_retry_after(formatdate(now - 60, usegmt=True),
+                                 now=now) == 0.0
+
+    def test_garbage_is_none(self):
+        from mmlspark_tpu.io.http import parse_retry_after
+
+        assert parse_retry_after("soon") is None
+        assert parse_retry_after("") is None
+        assert parse_retry_after(None) is None
+
+
+class TestSendWithRetries:
+    def _flaky(self, replies):
+        """send_request stub yielding canned responses."""
+        from mmlspark_tpu.io.http import HTTPResponseData
+
+        it = iter(replies)
+
+        def fake(req, timeout=60.0, deadline=None):
+            code, headers = next(it)
+            return HTTPResponseData(code, str(code), headers=headers)
+
+        return fake
+
+    def test_retry_after_http_date_honored(self, monkeypatch):
+        from email.utils import formatdate
+
+        import mmlspark_tpu.io.http as H
+
+        ra = formatdate(time.time() + 40, usegmt=True)
+        monkeypatch.setattr(H, "send_request", self._flaky(
+            [(429, {"Retry-After": ra}), (200, None)]))
+        slept = []
+        resp = H.send_with_retries(H.HTTPRequestData("http://x"),
+                                   sleep_fn=slept.append)
+        assert resp.statusCode == 200
+        assert len(slept) == 1 and 35 <= slept[0] <= 41
+
+    def test_retry_after_capped_at_deadline(self, monkeypatch):
+        import mmlspark_tpu.io.http as H
+
+        monkeypatch.setattr(H, "send_request", self._flaky(
+            [(429, {"Retry-After": "300"}), (200, None)]))
+        slept = []
+        resp = H.send_with_retries(
+            H.HTTPRequestData("http://x"), sleep_fn=slept.append,
+            deadline=Deadline.from_timeout(2.0))
+        assert resp.statusCode == 200
+        assert slept and slept[0] <= 2.0  # not the server's 300s
+
+    def test_expired_deadline_returns_without_retry(self, monkeypatch):
+        import mmlspark_tpu.io.http as H
+
+        monkeypatch.setattr(H, "send_request", self._flaky(
+            [(503, None)] * 5))
+        slept = []
+        resp = H.send_with_retries(
+            H.HTTPRequestData("http://x"), sleep_fn=slept.append,
+            deadline=Deadline(time.time() - 1))
+        assert resp.statusCode == 503 and slept == []
+
+    def test_policy_jitter_deterministic(self, monkeypatch):
+        import mmlspark_tpu.io.http as H
+
+        pol = RetryPolicy(max_retries=3, base_s=0.1, jitter=0.5, seed=3)
+        runs = []
+        for _ in range(2):
+            monkeypatch.setattr(H, "send_request", self._flaky(
+                [(503, None)] * 3 + [(200, None)]))
+            slept = []
+            H.send_with_retries(H.HTTPRequestData("http://x"),
+                                sleep_fn=slept.append, policy=pol)
+            runs.append(slept)
+        assert runs[0] == runs[1] and len(runs[0]) == 3
+
+    def test_legacy_backoffs_are_jittered(self, monkeypatch):
+        import mmlspark_tpu.io.http as H
+
+        monkeypatch.setattr(H, "send_request", self._flaky(
+            [(500, None), (500, None), (500, None), (200, None)]))
+        slept = []
+        H.send_with_retries(H.HTTPRequestData("http://x"),
+                            sleep_fn=slept.append)
+        for base, got in zip((0.1, 0.5, 1.0), slept):
+            assert abs(got - base) <= base * 0.2 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_fires_on_exact_call_indices(self):
+        with FaultInjector(seed=1).plan(faults.HTTP_SEND, at=(2, 4)) as inj:
+            fired = []
+            for i in range(5):
+                try:
+                    faults.fire(faults.HTTP_SEND)
+                except InjectedFault:
+                    fired.append(i + 1)
+            assert fired == [2, 4]
+        assert faults.active() is None
+
+    def test_probability_stream_replays_under_seed(self):
+        def run():
+            with FaultInjector(seed=42).plan(faults.TRAIN_STEP, p=0.3,
+                                             times=-1) as inj:
+                hits = []
+                for i in range(50):
+                    try:
+                        faults.fire(faults.TRAIN_STEP, iteration=i)
+                    except InjectedFault:
+                        hits.append(i)
+                return hits
+
+        a, b = run(), run()
+        assert a == b and 5 <= len(a) <= 25
+
+    def test_times_caps_fires_and_log_records(self):
+        with FaultInjector().plan(faults.JOURNAL_WRITE, every=1,
+                                  times=2) as inj:
+            n_raised = 0
+            for _ in range(5):
+                try:
+                    faults.fire(faults.JOURNAL_WRITE, epoch=9)
+                except InjectedFault:
+                    n_raised += 1
+            assert n_raised == 2
+            assert [c["epoch"] for _, _, c in inj.fired()] == [9, 9]
+            assert inj.calls(faults.JOURNAL_WRITE) == 5
+
+    def test_noop_when_not_installed(self):
+        faults.fire(faults.HTTP_SEND)  # must not raise
+
+    def test_delay_without_exception(self):
+        with FaultInjector().plan(faults.INGEST_H2D, at=(1,), delay_s=0.05,
+                                  exc=None):
+            t0 = time.perf_counter()
+            faults.fire(faults.INGEST_H2D)
+            assert time.perf_counter() - t0 >= 0.045
+
+
+# ---------------------------------------------------------------------------
+# Atomic file helpers
+# ---------------------------------------------------------------------------
+
+
+class TestAtomicFiles:
+    def test_atomic_write_replaces_whole_file(self, tmp_path):
+        p = str(tmp_path / "f.txt")
+        atomic_write_text(p, "one")
+        atomic_write_text(p, "two")
+        assert open(p).read() == "two"
+        assert [f for f in os.listdir(tmp_path) if ".tmp" in f] == []
+
+    def test_exdev_fallback_file(self, tmp_path, monkeypatch):
+        src, dst = str(tmp_path / "src.bin"), str(tmp_path / "dst.bin")
+        with open(src, "wb") as fh:
+            fh.write(b"payload")
+        real_rename = os.rename
+
+        def exdev_once(a, b):
+            if a == src:
+                raise OSError(errno.EXDEV, "cross-device link")
+            real_rename(a, b)
+
+        rename_with_exdev_fallback(src, dst, _rename=exdev_once)
+        assert open(dst, "rb").read() == b"payload"
+        assert not os.path.exists(src)
+
+    def test_exdev_fallback_directory(self, tmp_path):
+        src = tmp_path / "srcdir"
+        src.mkdir()
+        (src / "a.txt").write_text("A")
+        dst = str(tmp_path / "dstdir")
+
+        def always_exdev(a, b):
+            raise OSError(errno.EXDEV, "cross-device link")
+
+        rename_with_exdev_fallback(str(src), dst, _rename=always_exdev)
+        assert open(os.path.join(dst, "a.txt")).read() == "A"
+        assert not os.path.exists(src)
+
+    def test_non_exdev_errors_propagate(self, tmp_path):
+        def eperm(a, b):
+            raise OSError(errno.EPERM, "no")
+
+        with pytest.raises(OSError) as ei:
+            rename_with_exdev_fallback(str(tmp_path / "x"),
+                                       str(tmp_path / "y"), _rename=eperm)
+        assert ei.value.errno == errno.EPERM
+
+
+# ---------------------------------------------------------------------------
+# Journal chaos: crash windows around append/commit/compact
+# ---------------------------------------------------------------------------
+
+
+def _echo_transform(df):
+    from mmlspark_tpu.serving.stages import parse_request
+
+    parsed = parse_request(df, "data", parse="json")
+    return parsed.with_column(
+        "reply", lambda p: [{"sum": float(np.sum(v))} for v in p["data"]])
+
+
+class TestJournalChaos:
+    def test_crash_between_append_and_commit_replays(self, tmp_path):
+        """The at-least-once window: entries journaled, commit never lands.
+        Recovery must return exactly those requests."""
+        from mmlspark_tpu.serving import RequestJournal, ServingServer
+
+        jpath = str(tmp_path / "wal.jsonl")
+        with FaultInjector(seed=0).plan(faults.JOURNAL_COMMIT, every=1):
+            srv = ServingServer(_echo_transform, port=0, max_wait_ms=2.0,
+                                journal_path=jpath)
+            srv.start()
+            try:
+                status, body, _ = _post(srv.address, {"data": [1, 2]})
+                assert status == 200 and body["sum"] == 3.0
+            finally:
+                srv.stop(drain=False)  # hard stop: the crash
+        replay = RequestJournal.recover(jpath)
+        assert [json.loads(b)["data"] for _, b, _ in replay] == [[1, 2]]
+
+    def test_journal_write_failure_degrades_not_dies(self, tmp_path):
+        """An injected append failure must not take serving down."""
+        from mmlspark_tpu.serving import ServingServer
+
+        jpath = str(tmp_path / "wal.jsonl")
+        with FaultInjector(seed=0).plan(faults.JOURNAL_WRITE, at=(1,)):
+            with ServingServer(_echo_transform, port=0, max_wait_ms=2.0,
+                               journal_path=jpath) as srv:
+                status, body, _ = _post(srv.address, {"data": [4]})
+                assert status == 200 and body["sum"] == 4.0
+                status, body, _ = _post(srv.address, {"data": [5]})
+                assert status == 200 and body["sum"] == 5.0
+
+    def test_commit_retries_after_transient_failure(self, tmp_path):
+        """A commit that fails once lands on a later sweep — the epoch must
+        not replay after a clean shutdown."""
+        from mmlspark_tpu.serving import RequestJournal, ServingServer
+
+        jpath = str(tmp_path / "wal.jsonl")
+        with FaultInjector(seed=0).plan(faults.JOURNAL_COMMIT, at=(1,)):
+            with ServingServer(_echo_transform, port=0, max_wait_ms=2.0,
+                               journal_path=jpath) as srv:
+                status, body, _ = _post(srv.address, {"data": [7]})
+                assert status == 200
+        assert RequestJournal.recover(jpath) == []
+
+    def test_compact_crash_preserves_old_journal(self, tmp_path,
+                                                 monkeypatch):
+        """Crash mid-compact (fsync of the replacement raises) must leave the
+        complete OLD journal, keep uncommitted epochs recoverable, and keep
+        the journal writable."""
+        from mmlspark_tpu.serving import RequestJournal
+
+        jpath = str(tmp_path / "wal.jsonl")
+        j = RequestJournal(jpath)
+        j.append(1, 10, b"keep-me", {})
+        j.commit(1)
+        j.append(2, 11, b"uncommitted", {})
+        before = open(jpath).read()
+
+        real_fsync = os.fsync
+
+        def fsync_boom(fd):
+            raise OSError(errno.EIO, "injected fsync failure")
+
+        monkeypatch.setattr(os, "fsync", fsync_boom)
+        with pytest.raises(OSError):
+            j.compact()
+        monkeypatch.setattr(os, "fsync", real_fsync)
+
+        assert open(jpath).read() == before  # old file intact, not torn
+        assert [r for r, _, _ in RequestJournal.recover(jpath)] == [11]
+        j.append(3, 12, b"still-writable", {})  # handle reopened
+        j.close()
+        assert [r for r, _, _ in RequestJournal.recover(jpath)] == [11, 12]
+
+    def test_compact_keeps_uncommitted_and_drops_committed(self, tmp_path):
+        from mmlspark_tpu.serving import RequestJournal
+
+        jpath = str(tmp_path / "wal.jsonl")
+        j = RequestJournal(jpath)
+        j.append(1, 1, b"done", {})
+        j.commit(1)
+        j.append(2, 2, b"live", {})
+        j.compact()
+        j.close()
+        assert [r for r, _, _ in RequestJournal.recover(jpath)] == [2]
+        assert not os.path.exists(jpath + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# Routing chaos: circuit breaker, probes, worker kill mid-request
+# ---------------------------------------------------------------------------
+
+
+class _ToggleWorker:
+    """Raw HTTP worker whose liveness flips under test control. When dead it
+    resets connections (a killed process), when alive it answers JSON."""
+
+    def __init__(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _serve(self):
+                if not outer.alive:
+                    # simulate a killed worker: drop the connection
+                    self.connection.close()
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                body = json.dumps({"worker": "toggle"}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = _serve
+            do_POST = _serve
+
+        self.alive = True
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.address = f"http://127.0.0.1:{self._httpd.server_address[1]}/"
+        self._t = threading.Thread(target=self._httpd.serve_forever,
+                                   daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class TestRoutingChaos:
+    def _front(self, **kw):
+        from mmlspark_tpu.serving import RoutingFront
+
+        kw.setdefault("probe_interval_s", 0.05)
+        kw.setdefault("probe_timeout_s", 1.0)
+        kw.setdefault("probe_policy", RetryPolicy(
+            max_retries=1 << 30, base_s=0.05, multiplier=1.0,
+            max_backoff_s=0.05, jitter=0.0, seed=0))
+        return RoutingFront(port=0, max_failures=2, **kw)
+
+    def test_no_workers_503_with_retry_after(self):
+        with self._front() as front:
+            status, body, headers = _post_status(front.address, {"x": 1})
+            assert status == 503 and "Retry-After" in headers
+
+    def test_breaker_opens_worker_stays_registered(self):
+        dead = "http://127.0.0.1:9/"
+        live = _ToggleWorker()
+        try:
+            with self._front() as front:
+                front.register(live.address)
+                front.register(dead)
+                for _ in range(4):
+                    status, body, _ = _post_status(front.address, {"x": 1})
+                    assert status == 200 and body["worker"] == "toggle"
+                assert front.workers == [live.address]  # dead one excluded
+                assert front.worker_states[dead] == "open"  # NOT forgotten
+        finally:
+            live.stop()
+
+    def test_worker_kill_mid_stream_recovers_via_reroute(self):
+        """One worker dies (connection reset); the front re-routes to the
+        survivor and every request still answers 200."""
+        w1, w2 = _ToggleWorker(), _ToggleWorker()
+        try:
+            with self._front() as front:
+                front.register(w1.address)
+                front.register(w2.address)
+                w1.alive = False  # kill one mid-traffic
+                for i in range(6):
+                    status, body, _ = _post_status(front.address, {"i": i})
+                    assert status == 200 and body["worker"] == "toggle"
+                assert front.worker_states[w1.address] == "open"
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_health_probe_readmits_recovered_worker(self):
+        w = _ToggleWorker()
+        try:
+            with self._front() as front:
+                front.register(w.address)
+                w.alive = False
+                for _ in range(3):
+                    _post_status(front.address, {"x": 1}, timeout=5)
+                assert front.worker_states[w.address] == "open"
+                w.alive = True  # worker comes back
+                deadline = time.time() + 5
+                while (front.worker_states[w.address] == "open"
+                       and time.time() < deadline):
+                    time.sleep(0.02)
+                assert front.worker_states[w.address] in ("half_open",
+                                                          "closed")
+                status, body, _ = _post_status(front.address, {"x": 2})
+                assert status == 200  # traffic flows again
+                assert front.worker_states[w.address] == "closed"
+        finally:
+            w.stop()
+
+    def test_expired_deadline_rejected_pre_forward(self):
+        w = _ToggleWorker()
+        try:
+            with self._front() as front:
+                front.register(w.address)
+                expired = Deadline(time.time() - 5).to_header()
+                status, body, _ = _post_status(
+                    front.address, {"x": 1},
+                    headers={DEADLINE_HEADER: expired})
+                assert status == 504
+                live = Deadline.from_timeout(30).to_header()
+                status, body, _ = _post_status(
+                    front.address, {"x": 1},
+                    headers={DEADLINE_HEADER: live})
+                assert status == 200
+        finally:
+            w.stop()
+
+    def test_injected_forward_fault_exercises_retry(self):
+        """A planned WORKER_FORWARD fault behaves like a transport failure:
+        the front retries the other worker, the request still answers."""
+        w1, w2 = _ToggleWorker(), _ToggleWorker()
+        try:
+            with self._front() as front:
+                front.register(w1.address)
+                front.register(w2.address)
+                with FaultInjector(seed=0).plan(faults.WORKER_FORWARD,
+                                                at=(1,)) as inj:
+                    status, body, _ = _post_status(front.address, {"x": 1})
+                    assert status == 200
+                    assert len(inj.fired(faults.WORKER_FORWARD)) == 1
+        finally:
+            w1.stop()
+            w2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Serving hardening: deadline in queue, admission bound, graceful drain
+# ---------------------------------------------------------------------------
+
+
+class TestServingHardening:
+    def test_expired_deadline_rejected_at_ingress(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        with ServingServer(_echo_transform, port=0, max_wait_ms=2.0) as srv:
+            expired = Deadline(time.time() - 5).to_header()
+            status, body, _ = _post_status(
+                srv.address, {"data": [1]},
+                headers={DEADLINE_HEADER: expired})
+            assert status == 504
+
+    def test_deadline_expiring_in_queue_gets_504_not_compute(self):
+        """A request whose deadline lapses while queued is answered 504 by
+        the batcher without reaching the transform."""
+        from mmlspark_tpu.serving import ServingServer
+
+        seen = []
+
+        def transform(df):
+            seen.extend(int(r) for r in df.collect()["id"])
+            return _echo_transform(df)
+
+        gate = threading.Event()
+
+        def gated(df):
+            gate.wait(5)
+            return transform(df)
+
+        with ServingServer(gated, port=0, max_wait_ms=1.0,
+                           max_batch_size=1) as srv:
+            # first request occupies the loop inside the gated transform
+            t1 = threading.Thread(target=_post_status, args=(
+                srv.address, {"data": [1]}))
+            t1.start()
+            time.sleep(0.1)
+            # second request: deadline lapses while it waits in the queue
+            res = {}
+
+            def second():
+                hdr = {DEADLINE_HEADER: Deadline.from_timeout(0.2).to_header()}
+                res["status"], _, _ = _post_status(
+                    srv.address, {"data": [2]}, headers=hdr)
+
+            t2 = threading.Thread(target=second)
+            t2.start()
+            time.sleep(0.4)  # let the deadline lapse before opening the gate
+            gate.set()
+            t1.join(10)
+            t2.join(10)
+            assert res["status"] == 504
+            assert len(seen) == 1  # the expired request never hit compute
+
+    def test_admission_queue_load_sheds_503(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        gate = threading.Event()
+
+        def slow(df):
+            gate.wait(5)
+            return _echo_transform(df)
+
+        with ServingServer(slow, port=0, max_wait_ms=1.0, max_batch_size=1,
+                           max_queue=1) as srv:
+            threads = []
+            codes = []
+            lock = threading.Lock()
+
+            def client(i):
+                status, _, headers = _post_status(srv.address, {"data": [i]},
+                                                  timeout=10)
+                with lock:
+                    codes.append((status, headers.get("Retry-After")))
+
+            for i in range(6):
+                threads.append(threading.Thread(target=client, args=(i,)))
+                threads[-1].start()
+                time.sleep(0.05)
+            gate.set()
+            for t in threads:
+                t.join(10)
+            shed = [c for c in codes if c[0] == 503]
+            assert shed, f"expected load shedding, got {codes}"
+            assert all(ra is not None for _, ra in shed)
+            assert any(s == 200 for s, _ in codes)
+
+    def test_graceful_drain_answers_inflight_then_rejects(self, tmp_path):
+        from mmlspark_tpu.serving import RequestJournal, ServingServer
+
+        jpath = str(tmp_path / "wal.jsonl")
+        gate = threading.Event()
+
+        def slow(df):
+            gate.wait(5)
+            return _echo_transform(df)
+
+        srv = ServingServer(slow, port=0, max_wait_ms=1.0,
+                            journal_path=jpath, drain_timeout_s=5.0)
+        srv.start()
+        res = {}
+
+        def client():
+            res["status"], res["body"], _ = _post_status(
+                srv.address, {"data": [1, 2, 3]}, timeout=15)
+
+        t = threading.Thread(target=client)
+        t.start()
+        time.sleep(0.2)  # request is in flight behind the gate
+
+        stopper = threading.Thread(target=srv.stop)  # drain=True default
+        stopper.start()
+        time.sleep(0.2)
+        gate.set()  # in-flight transform completes during the drain
+        stopper.join(10)
+        t.join(10)
+        assert res["status"] == 200 and res["body"]["sum"] == 6.0
+        # a clean drain leaves nothing to replay
+        assert RequestJournal.recover(jpath) == []
+
+
+# ---------------------------------------------------------------------------
+# Ingest H2D chaos
+# ---------------------------------------------------------------------------
+
+
+class TestIngestChaos:
+    def test_injected_h2d_delay_shows_in_timings(self):
+        from mmlspark_tpu.parallel.ingest import TransferRing
+
+        batches = [np.ones((4, 4), dtype=np.float32)] * 3
+        with FaultInjector().plan(faults.INGEST_H2D, at=(2,), delay_s=0.1,
+                                  exc=None):
+            ring = TransferRing(iter(batches), depth=1)
+            out = list(ring)
+        assert len(out) == 3
+        h2d = [t.h2d_s for t in ring.stats.records]
+        assert h2d[1] >= 0.09  # the injected slow link is visible
+        assert h2d[0] < 0.09
+
+    def test_injected_h2d_failure_surfaces_to_consumer(self):
+        from mmlspark_tpu.parallel.ingest import TransferRing
+
+        batches = [np.ones((2, 2), dtype=np.float32)] * 4
+        with FaultInjector().plan(faults.INGEST_H2D, at=(2,)):
+            ring = TransferRing(iter(batches), depth=1)
+            with pytest.raises(InjectedFault):
+                list(ring)
+
+
+# ---------------------------------------------------------------------------
+# GBDT checkpoint/resume
+# ---------------------------------------------------------------------------
+
+
+def _synth_binary(n=300, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 0]
+    y = (logit + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+class TestGBDTCheckpointResume:
+    def _params(self, **kw):
+        from mmlspark_tpu.gbdt import TrainParams
+
+        base = dict(objective="binary", num_iterations=8, num_leaves=7,
+                    min_data_in_leaf=5, bagging_fraction=0.8,
+                    bagging_freq=1, seed=3)
+        base.update(kw)
+        return TrainParams(**base)
+
+    def test_interrupted_resume_is_identical(self, tmp_path):
+        """Train interrupted at iteration k (injected preemption) then
+        resumed must produce the SAME model as an uninterrupted run."""
+        from mmlspark_tpu.gbdt import booster as B
+        from mmlspark_tpu.gbdt.checkpoint import CheckpointConfig
+
+        X, y = _synth_binary()
+        p = self._params()
+        full = B.train(p, X, y, checkpoint=CheckpointConfig(
+            str(tmp_path / "full.ckpt"), every_k=3))
+
+        ckpt = str(tmp_path / "interrupted.ckpt")
+        with FaultInjector(seed=0).plan(faults.TRAIN_STEP, at=(6,)):
+            with pytest.raises(InjectedFault):
+                B.train(p, X, y,
+                        checkpoint=CheckpointConfig(ckpt, every_k=3))
+        # the pre-preemption checkpoint is on disk at iteration 3
+        from mmlspark_tpu.gbdt.checkpoint import load_checkpoint
+
+        assert load_checkpoint(ckpt)["iteration"] == 3
+        resumed = B.train(p, X, y,
+                          checkpoint=CheckpointConfig(ckpt, every_k=3))
+        assert resumed.to_string() == full.to_string()
+        np.testing.assert_array_equal(resumed.raw_predict(X),
+                                      full.raw_predict(X))
+
+    def test_checkpoint_cadence_and_final(self, tmp_path):
+        from mmlspark_tpu.gbdt import booster as B
+        from mmlspark_tpu.gbdt.checkpoint import (CheckpointConfig,
+                                                  load_checkpoint)
+
+        X, y = _synth_binary()
+        ckpt = str(tmp_path / "m.ckpt")
+        B.train(self._params(), X, y,
+                checkpoint=CheckpointConfig(ckpt, every_k=3))
+        ck = load_checkpoint(ckpt)
+        assert ck["iteration"] == 8  # final checkpoint written at the end
+
+    def test_param_mismatch_refuses_resume(self, tmp_path):
+        from mmlspark_tpu.gbdt import booster as B
+        from mmlspark_tpu.gbdt.checkpoint import CheckpointConfig
+
+        X, y = _synth_binary()
+        ckpt = str(tmp_path / "m.ckpt")
+        B.train(self._params(), X, y,
+                checkpoint=CheckpointConfig(ckpt, every_k=3))
+        with pytest.raises(ValueError, match="different train params"):
+            B.train(self._params(learning_rate=0.27), X, y,
+                    checkpoint=CheckpointConfig(ckpt, every_k=3))
+
+    def test_atomicity_survives_crash_mid_save(self, tmp_path, monkeypatch):
+        """A crash inside the checkpoint write leaves the previous complete
+        checkpoint (tmp + rename: never a torn file)."""
+        from mmlspark_tpu.gbdt.checkpoint import (load_checkpoint,
+                                                  save_checkpoint)
+
+        path = str(tmp_path / "c.ckpt")
+        args = dict(params_dict={"a": 1}, model_string="tree v1",
+                    scores=np.zeros((4, 1)), rng_state={"s": 1},
+                    bag_mask=np.ones(4, dtype=bool), best_val=0.5,
+                    best_iter=2, rounds_no_improve=0)
+        save_checkpoint(path, iteration=3, **args)
+
+        def replace_boom(a, b):
+            raise OSError(errno.EIO, "injected crash mid-rename")
+
+        monkeypatch.setattr(os, "replace", replace_boom)
+        with pytest.raises(OSError):
+            save_checkpoint(path, iteration=4, **args)
+        monkeypatch.undo()
+        ck = load_checkpoint(path)
+        assert ck["iteration"] == 3  # previous complete checkpoint intact
+
+
+# ---------------------------------------------------------------------------
+# DNN train loop: preemption hook + checkpoint/resume
+# ---------------------------------------------------------------------------
+
+
+class TestDNNTrainLoop:
+    def _setup(self):
+        from mmlspark_tpu.models import training as T
+        from mmlspark_tpu.models.module import Dense, Sequential
+
+        module = Sequential([("fc", Dense(2))], name="tiny")
+        opt = T.make_optimizer(learning_rate=0.1)
+        state = T.init_train_state(module, (4,), opt, seed=0)
+        step = T.compile_train_step(module, opt)
+        return T, state, step
+
+    @staticmethod
+    def _batches(n, seed=0):
+        rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            x = rng.normal(size=(8, 4)).astype(np.float32)
+            y = (x[:, 0] > 0).astype(np.int32)
+            out.append({"x": x, "y": y})
+        return out
+
+    def test_preemption_signal_checkpoints_and_stops(self, tmp_path):
+        T, state, step = self._setup()
+        ckpt = str(tmp_path / "dnn_ckpt")
+        guard = T.PreemptionGuard()
+        batches = self._batches(10)
+
+        def preempting(batches):
+            for i, b in enumerate(batches):
+                if i == 4:
+                    guard.request()  # SIGTERM equivalent, delivered manually
+                yield b
+
+        res = T.run_train_loop(state, step, preempting(batches),
+                               checkpoint_path=ckpt, every_k=100,
+                               guard=guard)
+        assert res.preempted and res.steps_run == 4
+        assert os.path.isdir(ckpt) or os.path.exists(ckpt)
+
+        # resume finishes the remaining steps
+        T2, state2, step2 = self._setup()
+        res2 = T.run_train_loop(state2, step2, self._batches(10),
+                                checkpoint_path=ckpt, guard=None)
+        assert not res2.preempted and res2.steps_run == 6
+        assert int(np.asarray(res2.state.step)) == 10
+
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        T, state, step = self._setup()
+        batches = self._batches(8)
+        full = T.run_train_loop(state, step, batches)
+        assert full.steps_run == 8
+
+        T2, stateA, stepA = self._setup()
+        ckpt = str(tmp_path / "halfway")
+        half = T.run_train_loop(stateA, stepA, batches[:4],
+                                checkpoint_path=ckpt, every_k=4)
+        assert half.steps_run == 4
+        T3, stateB, stepB = self._setup()
+        res = T.run_train_loop(stateB, stepB, batches,
+                               checkpoint_path=ckpt, every_k=100)
+        assert res.steps_run == 4  # only the un-trained suffix ran
+        import jax
+
+        for a, b in zip(jax.tree.leaves(res.state.params),
+                        jax.tree.leaves(full.state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_train_step_injection_point_fires(self):
+        T, state, step = self._setup()
+        with FaultInjector(seed=0).plan(faults.TRAIN_STEP, at=(3,)) as inj:
+            with pytest.raises(InjectedFault):
+                T.run_train_loop(state, step, self._batches(5))
+            assert len(inj.fired(faults.TRAIN_STEP)) == 1
+
+    def test_preemption_guard_signal_handler_roundtrip(self):
+        import signal as S
+
+        T, _, _ = self._setup()
+        prev = S.getsignal(S.SIGUSR1)
+        guard = T.PreemptionGuard(signals=(S.SIGUSR1,))
+        with guard:
+            os.kill(os.getpid(), S.SIGUSR1)
+            deadline = time.time() + 2
+            while not guard.requested() and time.time() < deadline:
+                time.sleep(0.01)
+            assert guard.requested()
+        # handler restored after exit
+        assert S.getsignal(S.SIGUSR1) == prev

@@ -26,6 +26,7 @@ PACKAGES=(
   "tests/test_vw.py tests/test_automl_recommendation.py tests/test_lime.py"
   "tests/test_models.py tests/test_onnx.py tests/test_downloader.py tests/test_native.py tests/test_ingest.py"
   "tests/test_cognitive.py tests/test_style.py tests/test_helm_chart.py"
+  "tests/test_faults.py -m faults"
   "tests/test_fuzzing.py"
   "tests/test_attention.py tests/test_parallel_pp_ep.py"
   "tests/test_codegen_cli.py tests/test_rgen.py tests/test_plot.py tests/test_datagen.py"
