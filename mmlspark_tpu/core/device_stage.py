@@ -1,0 +1,175 @@
+"""Device-stage contract: how a pipeline stage joins a fused XLA program.
+
+PR 1 measured the flagship featurize path at ~11.5k images/sec per-call but
+~260 end-to-end: the stage-BOUNDARY cost (D2H readback, host re-batching,
+fresh H2D) dominated, not XLA compute. Operator fusion across stage
+boundaries is the standard fix (TVM, arXiv:1802.04799); this module defines
+the contract a stage implements to participate:
+
+    stage.device_fn(schema) -> Optional[DeviceFn]
+
+A ``DeviceFn`` describes the stage as a jittable column program plus the
+host-side shims the fused executor (core/fusion.py) needs at segment edges:
+
+  - ``fn(params, env)``      the traceable body: reads batched [B, ...]
+                             arrays out of ``env`` (a dict keyed by column
+                             name), returns the dict of columns it writes.
+                             Raise ``FusionUnsupported`` at TRACE time when
+                             the incoming shapes/dtypes rule fusion out —
+                             the executor falls back to the host path.
+  - ``prepare(cols, ctx)``   host per-row prep applied only to SEGMENT-
+                             EXTERNAL inputs (struct -> array conversion,
+                             decode, host-exact ops like resize whose f64
+                             arithmetic cannot be reproduced bitwise on
+                             device). MUST reuse the unfused code path so
+                             fused == unfused stays bitwise.
+  - ``finalize(outs, ctx)``  host per-partition post-processing of the
+                             stage's device outputs after readback (rebuild
+                             image structs, f64 casts, objective transforms)
+                             — again the exact unfused code.
+
+The bitwise contract: everything placed in ``fn`` must be provably exact
+between the host numpy implementation and XLA — value-preserving moves
+(crop/flip/transpose/concat), exact casts (uint8 -> f32), identical
+elementwise IEEE arithmetic, or literally the same traced jaxpr (NN
+forwards, the GBDT forest kernels). Anything else belongs in ``prepare``/
+``finalize`` where the unfused host code runs unchanged.
+
+``CompileCache`` is the shared executable cache for fused segments, keyed by
+(segment identity, bucketed batch shape, dtypes) with hit/miss/compile-time
+counters — the per-shape cost visibility of the TPU performance-model work
+(arXiv:2008.01040) applied to fused programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+class FusionUnsupported(Exception):
+    """A stage cannot join (or continue) a fused segment for the observed
+    schema/shapes/dtypes. Raised at plan or trace time; the executor falls
+    back to the unfused host path for the segment, never failing the
+    transform."""
+
+
+@dataclasses.dataclass
+class DeviceFn:
+    """One stage's slice of a fused device program (see module docstring).
+
+    ``key`` must be hashable and identify the traced computation (stage
+    class, column names, op list, model identity...): it keys the shared
+    compile cache together with the batch shape signature.
+
+    ``device_outputs``: the env keys the executor reads back for this stage
+    (defaults to ``out_cols``); internal keys (prefixed ``__``) let a stage
+    compute raw device values that only ``finalize`` consumes — e.g. the
+    GBDT forest scores, finalized into probability/prediction columns in
+    f64 on host. A stage with internal outputs is ``terminal``: nothing
+    downstream can consume its finalized columns on device.
+
+    ``null_policy``: "propagate" = rows with a null input produce null
+    outputs (DNN semantics); "fallback" = nulls in this stage's external
+    inputs force the segment onto the host path (stages whose host code
+    gives nulls a value, e.g. the assembler's NaN fill).
+    """
+
+    key: Tuple
+    in_cols: Tuple[str, ...]
+    out_cols: Tuple[str, ...]
+    fn: Callable[[Any, Dict[str, Any]], Dict[str, Any]]
+    params: Any = None
+    prepare: Optional[Callable] = None
+    finalize: Optional[Callable] = None
+    device_outputs: Optional[Tuple[str, ...]] = None
+    accepts: Optional[Callable] = None   # ({col: probe_row}) -> bool
+    null_policy: str = "propagate"
+    reject_sparse: bool = True
+    drop_invalid: bool = False
+    # fn can consume input produced by an upstream device stage in the same
+    # segment (False when `prepare` does host work fn cannot replicate —
+    # the planner then starts a new segment at this stage)
+    internal_ok: bool = True
+    terminal: bool = False
+    # heavy = worth a device round-trip on its own (NN forward, forest
+    # kernel); a segment of only light stages executes on the host path
+    heavy: bool = False
+
+    def __post_init__(self):
+        self.in_cols = tuple(self.in_cols)
+        self.out_cols = tuple(self.out_cols)
+        if self.device_outputs is None:
+            self.device_outputs = self.out_cols
+        else:
+            self.device_outputs = tuple(self.device_outputs)
+
+
+class CompileCache:
+    """Shared fused-executable cache with hit/miss/compile-time counters.
+
+    Key: (segment key, bucketed batch shape+dtype signature). Value: the
+    compiled callable. AOT compilation (jit -> lower -> compile) is timed so
+    ``compile_time_s`` measures XLA work, not the first batch's compute.
+    """
+
+    def __init__(self, capacity: int = 256):
+        self._capacity = capacity
+        self._entries: Dict[Tuple, Any] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.compile_time_s = 0.0
+
+    def get(self, key: Tuple, builder: Callable[[], Any]) -> Any:
+        with self._lock:
+            if key in self._entries:
+                self.hits += 1
+                return self._entries[key]
+        # build OUTSIDE the lock: XLA compiles can take seconds and other
+        # segments/threads must not serialize behind them
+        t0 = time.perf_counter()
+        fn = builder()
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.misses += 1
+            self.compile_time_s += dt
+            if key not in self._entries:
+                if len(self._entries) >= self._capacity:
+                    self._entries.pop(next(iter(self._entries)))
+                self._entries[key] = fn
+            return self._entries[key]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.hits = 0
+            self.misses = 0
+            self.compile_time_s = 0.0
+
+    @property
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else None,
+                "compile_time_s": round(self.compile_time_s, 6),
+            }
+
+
+_GLOBAL_CACHE = CompileCache()
+
+
+def compile_cache() -> CompileCache:
+    """The process-wide fused-executable cache (shared across pipelines and
+    the serving loop, so warm executables survive re-planning)."""
+    return _GLOBAL_CACHE

@@ -364,6 +364,27 @@ def atomic_write_text(path: str, text: str) -> None:
     fsync_dir(d)
 
 
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Binary sibling of :func:`atomic_write_text`: tmp in the same
+    directory + flush + fsync + rename + directory fsync (used by the
+    model downloader's remote fetch path)."""
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(d)
+
+
 def rename_with_exdev_fallback(src: str, dst: str,
                                _rename: Callable[[str, str], None] = os.rename
                                ) -> None:

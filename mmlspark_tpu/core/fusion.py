@@ -1,0 +1,582 @@
+"""Device-resident pipeline fusion: adjacent stages -> one XLA program.
+
+``PipelineModel.transform`` executes stage-by-stage through host numpy:
+every boundary between two device-capable stages pays a D2H readback, a
+host re-batching pass, and a fresh H2D upload. This module removes those
+boundaries (the TVM argument, arXiv:1802.04799, applied to SparkML-style
+Transformer chains):
+
+  - ``plan(stages, schema)`` partitions a fitted stage list into maximal
+    runs of device-capable stages (``stage.device_fn(schema)`` — see
+    core/device_stage.py) plus host stages. A host-only stage splits a
+    segment; a ``terminal`` device stage (one whose outputs finalize on
+    host, e.g. GBDT's f64 objective transforms) ends one.
+  - ``Segment`` composes its stages' device fns into ONE jittable program:
+    batches stack once, ride the TransferRing (parallel/ingest.py — uint8
+    wire in, H2D on the prefetch thread, one dispatch, one readback), and
+    every executable is cached in the shared CompileCache keyed by
+    (segment, bucketed batch shape, dtype).
+  - ``FusedPipelineModel`` is the drop-in runner ``PipelineModel.fuse()``
+    returns. Fused output is BITWISE-IDENTICAL to the unfused chain: device
+    fns carry only provably-exact ops; anything host-flavored runs in the
+    stages' prepare/finalize hooks using the unfused code paths, and any
+    partition the contract cannot hold for (ragged rows, sparse rows,
+    nulls into NaN-filling stages, unsupported dtypes) falls back to the
+    host path per partition — never a wrong answer, never a failure.
+
+Batch bucketing mirrors parallel/batching.py (power-of-two buckets) so a
+segment compiles O(log n) shapes; `fusion_stats()` exposes the segment
+layout, per-segment ingest decomposition, compile-cache hit rate, and any
+fallbacks taken.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import profiling
+from .dataframe import DataFrame
+from .device_stage import CompileCache, DeviceFn, FusionUnsupported, compile_cache
+from .pipeline import PipelineModel, Transformer
+from .schema import Schema
+
+
+class _HostFallback(Exception):
+    """Internal: this partition (or segment) must run the unfused path."""
+
+
+# ---------------------------------------------------------------------------
+# Planning
+# ---------------------------------------------------------------------------
+
+
+class HostStage:
+    """Plan node: a stage executed through its normal transform()."""
+
+    __slots__ = ("stage",)
+
+    def __init__(self, stage: Transformer):
+        self.stage = stage
+
+    @property
+    def label(self) -> str:
+        return type(self.stage).__name__
+
+    def describe(self) -> Dict[str, Any]:
+        return {"kind": "host", "stages": [self.label]}
+
+
+class Segment:
+    """Plan node: a maximal run of device-capable stages fused into one
+    compiled program per (batch shape, dtype) signature."""
+
+    def __init__(self):
+        self.stages: List[Transformer] = []
+        self.dfns: List[DeviceFn] = []
+
+    # -- construction ----------------------------------------------------
+    def add(self, stage: Transformer, dfn: DeviceFn) -> None:
+        self.stages.append(stage)
+        self.dfns.append(dfn)
+
+    def can_accept(self, dfn: DeviceFn) -> bool:
+        if not self.dfns:
+            return True
+        written = self.written_cols
+        internal_in = set(dfn.in_cols) & written
+        if internal_in and not dfn.internal_ok:
+            return False
+        # a prepare hook may only own external inputs no earlier stage reads
+        if dfn.prepare is not None:
+            earlier_ext = {c for d in self.dfns for c in d.in_cols
+                           if c not in written}
+            if set(dfn.in_cols) & earlier_ext:
+                return False
+        return True
+
+    # -- derived layout --------------------------------------------------
+    @property
+    def written_cols(self) -> set:
+        return {c for d in self.dfns for c in d.out_cols}
+
+    @property
+    def external_in_cols(self) -> List[str]:
+        ext: List[str] = []
+        written: set = set()
+        for d in self.dfns:
+            for c in d.in_cols:
+                if c not in written and c not in ext:
+                    ext.append(c)
+            written |= set(d.out_cols)
+        return ext
+
+    @property
+    def key(self) -> Tuple:
+        return tuple(d.key for d in self.dfns)
+
+    @property
+    def label(self) -> str:
+        return "+".join(type(s).__name__ for s in self.stages)
+
+    @property
+    def heavy(self) -> bool:
+        return any(d.heavy for d in self.dfns)
+
+    def readback_plan(self) -> List[Tuple[str, int]]:
+        """(env key, writer dfn index) pairs the executor reads back: each
+        column at its FINAL value plus every internal ``__`` key."""
+        final_writer: Dict[str, int] = {}
+        for i, d in enumerate(self.dfns):
+            for c in d.out_cols:
+                final_writer[c] = i
+        out: List[Tuple[str, int]] = []
+        for i, d in enumerate(self.dfns):
+            for k in d.device_outputs:
+                if k.startswith("__") or final_writer.get(k) == i:
+                    out.append((k, i))
+        return out
+
+    def batch_size(self) -> int:
+        for s in self.stages:
+            if s.has_param("batchSize") and s.get("batchSize"):
+                return int(s.get("batchSize"))
+        return 256
+
+    def ring_depth(self) -> int:
+        for s in self.stages:
+            if s.has_param("ringDepth") and s.get("ringDepth"):
+                return int(s.get("ringDepth"))
+        return 2
+
+    def describe(self) -> Dict[str, Any]:
+        return {"kind": "fused", "stages": [type(s).__name__ for s in self.stages],
+                "in_cols": self.external_in_cols,
+                "out_cols": sorted(self.written_cols),
+                "batch_size": self.batch_size()}
+
+
+def plan(stages: Sequence[Transformer], schema: Schema) -> List[Any]:
+    """Partition a fitted stage chain into HostStage / Segment plan nodes.
+
+    Walks the chain threading the schema through ``transform_schema``; each
+    stage offers a DeviceFn via ``device_fn(schema)`` (None = host-only).
+    Segments that carry no heavy stage are demoted to host stages — a
+    device round-trip for column plumbing alone is a loss.
+    """
+    nodes: List[Any] = []
+    cur: Optional[Segment] = None
+
+    def close():
+        nonlocal cur
+        if cur is not None:
+            if cur.heavy:
+                nodes.append(cur)
+            else:
+                nodes.extend(HostStage(s) for s in cur.stages)
+            cur = None
+
+    for stage in stages:
+        dfn: Optional[DeviceFn] = None
+        try:
+            dfn = stage.device_fn(schema)
+        except FusionUnsupported:
+            dfn = None
+        except Exception:  # defensive: a probing failure must not kill transform
+            dfn = None
+        if dfn is None:
+            close()
+            nodes.append(HostStage(stage))
+        else:
+            if cur is not None and not cur.can_accept(dfn):
+                close()
+            if cur is None:
+                cur = Segment()
+            cur.add(stage, dfn)
+            if dfn.terminal:
+                close()
+        try:
+            schema = stage.transform_schema(schema.copy())
+        except Exception:
+            schema = schema  # schema-opaque stage: keep going with what we have
+    close()
+    return nodes
+
+
+# ---------------------------------------------------------------------------
+# Execution
+# ---------------------------------------------------------------------------
+
+
+def _stack_col(col: np.ndarray, allow_sparse: bool) -> np.ndarray:
+    """Valid-subset column -> dense [n, ...] array, preserving the wire
+    dtype (uint8 pixels stay uint8); f64/i64 narrow exactly like the
+    unfused Minibatcher's stack_rows(float32)/device ingestion do."""
+    from ..parallel.batching import densify_sparse, is_sparse_row, sparse_width
+
+    if col.dtype != object:
+        arr = np.asarray(col)
+    else:
+        probe = next((v for v in col if v is not None), None)
+        if probe is None:
+            arr = np.zeros((len(col), 0), dtype=np.float32)
+        elif is_sparse_row(probe):
+            if not allow_sparse:
+                raise _HostFallback("sparse rows")
+            width = sparse_width(col)
+            if width > (1 << 22):
+                raise _HostFallback(f"sparse width {width} too large")
+            arr = densify_sparse(col, width, dtype=np.float32)
+        else:
+            rows = [np.asarray(v) for v in col]
+            shapes = {r.shape for r in rows}
+            if len(shapes) > 1:
+                raise _HostFallback(f"ragged rows {sorted(shapes)}")
+            arr = np.stack(rows)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    elif arr.dtype == np.int64:
+        arr = arr.astype(np.int32)
+    elif arr.dtype == object:
+        raise _HostFallback("non-array object rows")
+    return np.ascontiguousarray(arr)
+
+
+def _probe_info(col: np.ndarray) -> Dict[str, Any]:
+    from ..parallel.batching import is_sparse_row
+
+    if col.dtype != object:
+        return {"dtype": col.dtype, "ndim": col.ndim - 1, "sparse": False}
+    probe = next((v for v in col if v is not None), None)
+    if probe is None:
+        return {"dtype": None, "ndim": None, "sparse": False}
+    if is_sparse_row(probe):
+        return {"dtype": np.dtype(np.float32), "ndim": 1, "sparse": True}
+    arr = np.asarray(probe)
+    return {"dtype": arr.dtype, "ndim": arr.ndim, "sparse": False}
+
+
+def _default_finalize(outs: Dict[str, np.ndarray], ctx: Dict) -> Dict[str, np.ndarray]:
+    """Readback arrays -> partition columns: 1-D stays a numeric column,
+    [n, ...] becomes an object column of per-row views (DNN output parity)."""
+    cols: Dict[str, np.ndarray] = {}
+    for name, arr in outs.items():
+        if arr.ndim <= 1:
+            cols[name] = arr
+        else:
+            obj = np.empty(len(arr), dtype=object)
+            for i in range(len(arr)):
+                obj[i] = arr[i]
+            cols[name] = obj
+    return cols
+
+
+class SegmentExecutor:
+    """Runs one Segment over a DataFrame, partition by partition, through
+    the TransferRing with compile-cache-backed fused executables."""
+
+    def __init__(self, segment: Segment, cache: Optional[CompileCache] = None):
+        self.segment = segment
+        self.cache = cache if cache is not None else compile_cache()
+        self.fallbacks: List[str] = []
+
+    # -- host path -------------------------------------------------------
+    def _host_partition(self, part: Dict[str, np.ndarray], schema: Schema
+                        ) -> List[Dict[str, np.ndarray]]:
+        sub = DataFrame([dict(part)], schema.copy())
+        for s in self.segment.stages:
+            sub = s.transform(sub)
+        return sub.partitions
+
+    # -- fused path ------------------------------------------------------
+    def run(self, df: DataFrame, stats) -> DataFrame:
+        import jax
+
+        seg = self.segment
+        params_dev = jax.device_put(tuple(d.params for d in seg.dfns))
+        out_parts: List[Dict[str, np.ndarray]] = []
+        for part in df.partitions:
+            try:
+                out_parts.append(
+                    self._run_partition(dict(part), params_dev, stats))
+            except _HostFallback as e:
+                self.fallbacks.append(f"{seg.label}: {e}")
+                out_parts.extend(self._host_partition(part, df.schema))
+        chained = df.schema.copy()
+        for s in seg.stages:
+            try:
+                chained = s.transform_schema(chained)
+            except Exception:
+                pass
+        # overlay the chained types onto the partitions' actual column order,
+        # inferring any column a stage's transform_schema didn't declare
+        inferred = DataFrame(out_parts)
+        types = {name: chained.types.get(name, inferred.schema.types[name])
+                 for name in inferred.schema.names}
+        meta = {k: v for k, v in chained.metadata.items() if k in types}
+        return DataFrame(out_parts, Schema(types, meta))
+
+    def _run_partition(self, part: Dict[str, np.ndarray], params_dev,
+                       stats) -> Dict[str, np.ndarray]:
+        import jax
+
+        from ..parallel.batching import Batch, next_bucket, pad_batch
+        from ..parallel.ingest import TransferRing
+
+        seg = self.segment
+        ext = seg.external_in_cols
+        for c in ext:
+            if c not in part:
+                raise _HostFallback(f"missing column {c!r}")
+        n = len(part[ext[0]]) if ext else 0
+
+        # nulls into a NaN-filling stage cannot propagate-as-null: host path
+        for dfn in seg.dfns:
+            if dfn.null_policy != "fallback":
+                continue
+            for c in dfn.in_cols:
+                if c in ext and part[c].dtype == object and \
+                        any(v is None for v in part[c]):
+                    raise _HostFallback(f"nulls in {c!r}")
+
+        valid = np.ones(n, dtype=bool)
+        for c in ext:
+            col = part[c]
+            if col.dtype == object:
+                valid &= np.array([v is not None for v in col], dtype=bool)
+        sub = {c: part[c][valid] for c in ext}
+        ctx: Dict[str, Any] = {}
+
+        # host prep (segment-external inputs only): the unfused per-row
+        # code. A column an EARLIER in-segment stage writes is internal to
+        # this stage even when it shares the external column's name — its
+        # value arrives device-resident, so prepare must not touch it.
+        written: set = set()
+        for dfn in seg.dfns:
+            if dfn.prepare is not None:
+                mine = {c: sub[c] for c in dfn.in_cols
+                        if c in sub and c not in written}
+                if mine:
+                    sub.update(dfn.prepare(mine, ctx))
+            written |= set(dfn.out_cols)
+        # prep can null rows (decode failures): shrink validity like dropNa
+        n_valid = int(valid.sum())
+        if n_valid:
+            keep = np.ones(n_valid, dtype=bool)
+            for c in ext:
+                col = sub[c]
+                if col.dtype == object:
+                    keep &= np.array([v is not None for v in col], dtype=bool)
+            if not keep.all():
+                sub = {c: v[keep] for c, v in sub.items()}
+                for k, v in list(ctx.items()):
+                    if isinstance(v, np.ndarray) and len(v) == n_valid:
+                        ctx[k] = v[keep]
+                idx = np.flatnonzero(valid)
+                valid = np.zeros(n, dtype=bool)
+                valid[idx[keep]] = True
+                n_valid = int(valid.sum())
+
+        # runtime dtype gates
+        probes = {c: _probe_info(sub[c]) for c in ext}
+        for dfn, stage in zip(seg.dfns, seg.stages):
+            mine = {c: probes[c] for c in dfn.in_cols if c in probes}
+            if mine and dfn.reject_sparse and any(p["sparse"] for p in mine.values()):
+                raise _HostFallback("sparse rows")
+            if mine and dfn.accepts is not None and not dfn.accepts(mine):
+                raise _HostFallback(f"{type(stage).__name__} dtype gate")
+
+        readback = seg.readback_plan()
+        collected: Dict[str, List[np.ndarray]] = {k: [] for k, _ in readback}
+        if n_valid > 0:
+            allow_sparse = all(not d.reject_sparse for d in seg.dfns)
+            dense = {c: _stack_col(sub[c], allow_sparse) for c in ext}
+            batch_size = seg.batch_size()
+            keys = [k for k, _ in readback]
+
+            def batches():
+                for start in range(0, n_valid, batch_size):
+                    stop = min(start + batch_size, n_valid)
+                    m = stop - start
+                    target = batch_size if m == batch_size \
+                        else min(next_bucket(m), batch_size)
+                    arrays = {c: pad_batch(dense[c][start:stop], target)
+                              for c in ext}
+                    mask = np.zeros(target, dtype=bool)
+                    mask[:m] = True
+                    yield Batch(arrays, mask, m)
+
+            def put(batch):
+                return jax.device_put(batch.arrays), batch.num_valid
+
+            def step(staged):
+                x, m = staged
+                sig = tuple((c, tuple(np.shape(x[c])), str(x[c].dtype))
+                            for c in ext)
+                compiled = self.cache.get(
+                    (seg.key, sig), lambda: self._build(params_dev, x, keys))
+                with profiling.annotate(f"fused:{seg.label}"):
+                    return compiled(params_dev, x), m
+
+            def fetch(handle):
+                ys, m = handle
+                return tuple(np.asarray(y)[:m] for y in ys)
+
+            ring = TransferRing(batches(), put=put, step=step, fetch=fetch,
+                                depth=seg.ring_depth(), stats=stats)
+            try:
+                for out in ring:
+                    for k, y in zip(keys, out):
+                        collected[k].append(y)
+            except FusionUnsupported as e:
+                raise _HostFallback(str(e))
+            finally:
+                ring.close()
+
+        full = {k: (np.concatenate(v, axis=0) if v
+                    else np.zeros((0,), dtype=np.float32))
+                for k, v in collected.items()}
+
+        # finalize per writer stage (stage order), scatter into the partition
+        by_writer: Dict[int, Dict[str, np.ndarray]] = {}
+        for k, i in readback:
+            by_writer.setdefault(i, {})[k] = full[k]
+        out_part = dict(part)
+        for i, dfn in enumerate(seg.dfns):
+            outs = by_writer.get(i)
+            if outs is None:
+                continue
+            if n_valid == 0:
+                cols = {c: np.empty(0, dtype=object) for c in dfn.out_cols}
+            elif dfn.finalize is not None:
+                cols = dfn.finalize(outs, ctx)
+            else:
+                cols = _default_finalize(outs, ctx)
+            for c in dfn.out_cols:
+                if c not in cols:
+                    continue
+                col = cols[c]
+                if n_valid == n:
+                    out_part[c] = col
+                else:
+                    scat = np.empty(n, dtype=object)
+                    scat[np.flatnonzero(valid)] = col
+                    out_part[c] = scat
+        if any(d.drop_invalid for d in seg.dfns) and n_valid < n:
+            out_part = {k: v[valid] for k, v in out_part.items()}
+        return out_part
+
+    def _build(self, params_dev, x: Dict[str, Any], keys: List[str]):
+        """AOT-compile the fused program for one shape signature."""
+        import jax
+
+        seg = self.segment
+
+        def fused(params_tuple, cols):
+            env = dict(cols)
+            for dfn, p in zip(seg.dfns, params_tuple):
+                env.update(dfn.fn(p, env))
+            return tuple(env[k] for k in keys)
+
+        jitted = jax.jit(fused)
+        specs = {c: jax.ShapeDtypeStruct(tuple(np.shape(v)),
+                                         np.asarray(v).dtype
+                                         if not hasattr(v, "dtype") else v.dtype)
+                 for c, v in x.items()}
+        try:
+            return jitted.lower(params_dev, specs).compile()
+        except FusionUnsupported:
+            raise
+        except Exception:
+            # AOT path unavailable on this jax: the jitted callable still
+            # compiles (and caches) per shape on first dispatch
+            jax.eval_shape(jitted, params_dev, specs)  # trace gates fire NOW
+            return jitted
+
+
+# ---------------------------------------------------------------------------
+# FusedPipelineModel
+# ---------------------------------------------------------------------------
+
+
+class FusedPipelineModel(PipelineModel):
+    """PipelineModel whose transform executes the fused plan.
+
+    Fusion is an EXECUTION STRATEGY, not a persisted artifact: save() writes
+    a plain PipelineModel (load + ``.fuse()`` to re-fuse), and the class is
+    kept out of the stage registry (``_abstract``).
+    """
+
+    _abstract = True
+
+    def __init__(self, stages=None, cache: Optional[CompileCache] = None, **kwargs):
+        super().__init__(stages, **kwargs)
+        self._cache = cache if cache is not None else compile_cache()
+        self._plans: Dict[Tuple, List[Any]] = {}
+        self._seg_stats: Dict[str, Any] = {}
+        self._last_fallbacks: List[str] = []
+        self._last_plan: Optional[List[Any]] = None
+
+    def fuse(self) -> "FusedPipelineModel":
+        return self
+
+    def _plan_for(self, schema: Schema) -> List[Any]:
+        key = tuple(schema.types.items())
+        if key not in self._plans:
+            self._plans[key] = plan(self._stages, schema.copy())
+        return self._plans[key]
+
+    def transform(self, df: DataFrame, fused: bool = True) -> DataFrame:
+        if not fused:
+            return PipelineModel.transform(self, df)
+        from ..parallel.ingest import IngestStats
+
+        nodes = self._plan_for(df.schema)
+        self._last_plan = nodes
+        self._seg_stats = {}
+        self._last_fallbacks = []
+        for node in nodes:
+            if isinstance(node, Segment):
+                stats = IngestStats()
+                self._seg_stats[node.label] = stats
+                ex = SegmentExecutor(node, self._cache)
+                df = ex.run(df, stats)
+                self._last_fallbacks.extend(ex.fallbacks)
+            else:
+                df = node.stage.transform(df)
+        return df
+
+    # -- stats surface (bench + serving /_mmlspark/stats) -----------------
+    @property
+    def last_ingest_stats(self):
+        """Aggregated ingest decomposition across fused segments of the most
+        recent transform (None before the first / when nothing fused)."""
+        from ..parallel.ingest import IngestStats
+
+        if not self._seg_stats:
+            return None
+        agg = IngestStats()
+        for s in self._seg_stats.values():
+            agg.records.extend(s.records)
+            agg.wall_s += s.wall_s
+        return agg
+
+    def fusion_stats(self) -> Dict[str, Any]:
+        """Segment layout + per-segment ingest + compile-cache counters."""
+        nodes = self._last_plan or []
+        return {
+            "segments": [n.describe() for n in nodes],
+            "n_fused_segments": sum(isinstance(n, Segment) for n in nodes),
+            "per_segment": {label: s.summary()
+                            for label, s in self._seg_stats.items()},
+            "fallbacks": list(self._last_fallbacks),
+            "compile_cache": self._cache.stats(),
+        }
+
+    @property
+    def last_fusion_stats(self) -> Dict[str, Any]:
+        return self.fusion_stats()
+
+    def save(self, path: str, overwrite: bool = True) -> None:
+        PipelineModel(self._stages).save(path, overwrite=overwrite)

@@ -61,6 +61,15 @@ class PipelineStage(Params):
         """Schema-only validation/propagation hook. Default: identity."""
         return schema
 
+    def device_fn(self, schema: Schema):
+        """Device-stage contract hook (core/device_stage.py): return a
+        ``DeviceFn`` describing this stage as a jittable column program so
+        the fusion planner (core/fusion.py) can compile it into a shared
+        XLA program with its neighbors, or None (default) for host-only
+        stages. Implementations must keep the bitwise contract: fused
+        output == unfused output on every partition the DeviceFn accepts."""
+        return None
+
     # persistence (implemented in serialize.py to avoid circular imports)
     def save(self, path: str, overwrite: bool = True) -> None:
         from .serialize import save_stage
@@ -167,10 +176,25 @@ class PipelineModel(Model):
     def stages(self) -> List[Transformer]:
         return self._stages
 
-    def transform(self, df: DataFrame) -> DataFrame:
+    def transform(self, df: DataFrame, fused: bool = False) -> DataFrame:
+        if fused:
+            return self.fuse().transform(df)
         for s in self._stages:
             df = s.transform(df)
         return df
+
+    def fuse(self) -> "PipelineModel":
+        """Compile adjacent device-capable stages into shared XLA programs
+        (core/fusion.py). Returns a FusedPipelineModel whose transform is
+        bitwise-identical to this chain but keeps intermediates on device
+        across stage boundaries; host-only stages still run per-stage.
+        The fused runner is cached — repeated fuse() calls share compiled
+        executables."""
+        if getattr(self, "_fused_runner", None) is None:
+            from .fusion import FusedPipelineModel
+
+            self._fused_runner = FusedPipelineModel(self._stages)
+        return self._fused_runner
 
     def transform_schema(self, schema: Schema) -> Schema:
         for s in self._stages:

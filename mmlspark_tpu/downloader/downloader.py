@@ -10,7 +10,8 @@ import shutil
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
-from ..core.faults import RetryPolicy, rename_with_exdev_fallback
+from ..core.faults import (RetryPolicy, atomic_write_bytes,
+                           rename_with_exdev_fallback)
 from ..models.module import FunctionModel
 
 
@@ -99,23 +100,72 @@ class ModelDownloader:
     """Fetch models from a repo into a local cache, verified and retried.
 
     ``repo``: local directory holding ``<name>.meta`` files (+ payload dirs),
-    or an ``http(s)://`` base URL (fetched through the retrying client —
-    unavailable in egress-less environments, error surfaces clearly).
+    or an ``http(s)://`` base URL. Remote repos are fetched through the
+    in-repo retrying HTTP client (io/http.send_with_retries driven by a
+    core.faults.RetryPolicy): ``<repo>/index.json`` lists the available
+    ``*.meta`` names (or inline schema objects), ``<repo>/<name>.meta``
+    holds a schema, and each schema's ``uri`` points at a single payload
+    FILE (e.g. an ``.onnx``) fetched with sha256 verification and a
+    durable atomic write (tmp + fsync + rename, core/faults.py).
+
+    ``http_send``: injectable ``(HTTPRequestData, timeout) -> response``
+    transport — tests serve a repo from a dict without touching the
+    network; production uses the default retrying client.
     """
 
-    def __init__(self, local_path: str, repo: Optional[str] = None):
+    def __init__(self, local_path: str, repo: Optional[str] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 http_send: Optional[Callable] = None,
+                 timeout_s: float = 60.0):
         self.local_path = local_path
         self.repo = repo
+        self.retry_policy = retry_policy or RetryPolicy(max_retries=3,
+                                                        base_s=0.5)
+        self._http_send = http_send
+        self.timeout_s = timeout_s
         os.makedirs(local_path, exist_ok=True)
+
+    # -- remote transport -------------------------------------------------
+    @property
+    def is_remote(self) -> bool:
+        return bool(self.repo) and self.repo.startswith(("http://", "https://"))
+
+    def _fetch_url(self, url: str) -> bytes:
+        """GET ``url`` through the retrying client; non-200 raises IOError."""
+        from ..io.http import HTTPRequestData, send_with_retries
+
+        req = HTTPRequestData(url=url, method="GET")
+        if self._http_send is not None:
+            resp = self._http_send(req, self.timeout_s)
+        else:
+            resp = send_with_retries(req, timeout=self.timeout_s,
+                                     policy=self.retry_policy)
+        if resp is None or resp.statusCode != 200 or resp.entity is None:
+            code = resp.statusCode if resp is not None else "no response"
+            raise IOError(f"GET {url} failed: {code}")
+        return resp.entity
 
     # -- listing ---------------------------------------------------------
     def get_models(self) -> Iterator[ModelSchema]:
         """Iterate schemas in the remote/local repo (ModelDownloader.getModels)."""
-        if self.repo is None or self.repo.startswith(("http://", "https://")):
-            if self.repo is not None:
-                raise RuntimeError(
-                    "remote repo listing requires network access; use a local repo")
+        if self.repo is None:
             return iter(())
+        if self.is_remote:
+            base = self.repo.rstrip("/")
+            index = json.loads(self._fetch_url(f"{base}/index.json"))
+
+            def gen_remote():
+                for entry in index:
+                    if isinstance(entry, dict):
+                        yield ModelSchema(**entry)
+                    else:
+                        name = str(entry)
+                        if name.endswith(".meta"):
+                            name = name[: -len(".meta")]
+                        yield ModelSchema.from_json(
+                            self._fetch_url(f"{base}/{name}.meta").decode("utf-8"))
+
+            return gen_remote()
         metas = [f for f in sorted(os.listdir(self.repo)) if f.endswith(".meta")]
 
         def gen():
@@ -145,8 +195,41 @@ class ModelDownloader:
                 return self._localized(schema, dest)
         src = schema.uri
         if src.startswith(("http://", "https://")):
-            raise RuntimeError(
-                f"remote model fetch for {schema.name!r} requires network access")
+
+            def fetch():
+                # unique staging dir + atomic write + atomic rename: a
+                # timed-out prior attempt still running in its abandoned
+                # thread can never collide, and a crash mid-write leaves no
+                # torn payload (core/faults.py durability contract)
+                import tempfile
+
+                stage = tempfile.mkdtemp(prefix=f".{schema.name}.",
+                                         dir=self.local_path)
+                staged = os.path.join(stage, "payload")
+                try:
+                    atomic_write_bytes(staged, self._fetch_url(src))
+                    if schema.hash:
+                        got = _sha256_dir(staged)
+                        if got != schema.hash:
+                            raise IOError(f"hash mismatch for {schema.name}: "
+                                          f"{got} != {schema.hash}")
+                    if os.path.exists(dest):
+                        if os.path.isdir(dest):
+                            shutil.rmtree(dest)
+                        else:
+                            os.remove(dest)
+                    rename_with_exdev_fallback(staged, dest)
+                finally:
+                    shutil.rmtree(stage, ignore_errors=True)
+                return dest
+
+            FaultToleranceUtils.retry_with_timeout(
+                fetch, retries=self.retry_policy.max_retries,
+                policy=self.retry_policy)
+            local = self._localized(schema, dest)
+            with open(meta_dest, "w") as f:
+                f.write(local.to_json())
+            return local
 
         def copy():
             # unique staging dir + atomic rename: a timed-out prior attempt still
@@ -185,9 +268,21 @@ class ModelDownloader:
         return self.download_model(name)
 
     def _find(self, name: str) -> ModelSchema:
-        for s in self.get_models():
-            if s.name == name:
-                return s
+        if self.is_remote:
+            # direct meta fetch first (no index.json required), then listing
+            try:
+                base = self.repo.rstrip("/")
+                return ModelSchema.from_json(
+                    self._fetch_url(f"{base}/{name}.meta").decode("utf-8"))
+            except IOError:
+                pass
+        try:
+            for s in self.get_models():
+                if s.name == name:
+                    return s
+        except IOError as e:
+            raise ModelNotFoundError(
+                f"No model named {name!r} in repo {self.repo!r}: {e}")
         raise ModelNotFoundError(f"No model named {name!r} in repo {self.repo!r}")
 
     @staticmethod

@@ -15,10 +15,44 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.dataframe import DataFrame
+from ..core.device_stage import DeviceFn, FusionUnsupported
 from ..core.params import ComplexParam, HasInputCols, HasOutputCol, Param
 from ..core.pipeline import Estimator, Model, Transformer
 from ..core.schema import ColType, Schema
 from ..ops.hashing import hash_string
+
+#: dtypes that widen to float64 EXACTLY through a float32 device compute —
+#: the precondition for a fused assembler to reproduce the host's f64
+#: feature vectors bitwise (f64 inputs would narrow lossily on the wire)
+_F32_EXACT_DTYPES = frozenset(
+    np.dtype(t) for t in (np.float32, np.bool_, np.uint8, np.int8,
+                          np.uint16, np.int16))
+
+
+def _f32_exact_accepts(in_cols):
+    def accepts(probes):
+        for c in in_cols:
+            p = probes.get(c)
+            if p is None or p["dtype"] is None:
+                continue
+            if p["sparse"] or p["dtype"] not in _F32_EXACT_DTYPES:
+                return False
+        return True
+    return accepts
+
+
+def _vector_f64_finalize(out_col):
+    """f32 device batch -> f64 per-row vectors: exact widening, matching
+    the host assembler's float64 output for f32-exact inputs."""
+
+    def finalize(outs, ctx):
+        arr = np.asarray(outs[out_col], dtype=np.float64)
+        obj = np.empty(len(arr), dtype=object)
+        for i in range(len(arr)):
+            obj[i] = arr[i]
+        return {out_col: obj}
+
+    return finalize
 
 
 class FastVectorAssembler(Transformer, HasInputCols, HasOutputCol):
@@ -81,6 +115,29 @@ class FastVectorAssembler(Transformer, HasInputCols, HasOutputCol):
         out = schema.copy()
         out.types[self.get_or_throw("outputCol")] = ColType.VECTOR
         return out
+
+    def device_fn(self, schema: Schema):
+        """Fusion contract: concatenation is pure value movement, so the
+        assembled vector computes on device in f32 and widens to the host's
+        f64 exactly — gated (accepts) to f32-exact input dtypes; nulls take
+        the host path (their NaN-fill semantics aren't null-propagation)."""
+        in_cols = tuple(self.get_or_throw("inputCols"))
+        out_col = self.get_or_throw("outputCol")
+
+        def fn(params, env):
+            import jax.numpy as jnp
+
+            parts = []
+            for c in in_cols:
+                v = env[c].astype(jnp.float32)
+                parts.append(v.reshape(v.shape[0], -1))
+            return {out_col: jnp.concatenate(parts, axis=1)}
+
+        return DeviceFn(
+            key=("FastVectorAssembler", in_cols, out_col),
+            in_cols=in_cols, out_cols=(out_col,), fn=fn,
+            finalize=_vector_f64_finalize(out_col),
+            accepts=_f32_exact_accepts(in_cols), null_policy="fallback")
 
 
 class AssembleFeatures(Estimator, HasInputCols, HasOutputCol):
@@ -223,6 +280,51 @@ class AssembleFeaturesModel(Model, HasInputCols, HasOutputCol):
         if names is not None:
             out.meta(self.get_or_throw("outputCol"))["slot_names"] = names
         return out
+
+    def device_fn(self, schema: Schema):
+        """Fusion contract: numeric (NaN -> mean fill) and vector encoders
+        mirror exactly on device; string one-hot/hash encoders are host-only
+        (the whole stage stays host when any is present). Numeric encoders
+        additionally require an f32-representable fill — the host imputes in
+        f64, and a non-representable mean cannot round-trip the f32 wire."""
+        encoders = self.get("encoders")
+        if not encoders:
+            return None
+        if any(e["kind"] not in ("numeric", "vector") for e in encoders):
+            return None
+        for e in encoders:
+            if e["kind"] == "numeric" and \
+                    float(np.float32(e["fill"])) != float(e["fill"]):
+                return None
+        in_cols = tuple(e["col"] for e in encoders)
+        out_col = self.get_or_throw("outputCol")
+
+        def fn(params, env):
+            import jax.numpy as jnp
+
+            parts = []
+            for e in encoders:
+                v = env[e["col"]].astype(jnp.float32)
+                if e["kind"] == "numeric":
+                    if v.ndim != 1:
+                        raise FusionUnsupported("numeric encoder expects scalars")
+                    v = jnp.where(jnp.isnan(v), jnp.float32(e["fill"]), v)
+                    v = v.reshape(-1, 1)
+                else:
+                    if v.ndim != 2 or v.shape[1] != e["dim"]:
+                        raise FusionUnsupported(
+                            f"vector dim {v.shape} != fitted {e['dim']}")
+                parts.append(v)
+            return {out_col: jnp.concatenate(parts, axis=1)}
+
+        return DeviceFn(
+            key=("AssembleFeaturesModel", in_cols, out_col,
+                 tuple(tuple(sorted((k, v) for k, v in e.items()
+                                    if not isinstance(v, (list, np.ndarray))))
+                       for e in encoders)),
+            in_cols=in_cols, out_cols=(out_col,), fn=fn,
+            finalize=_vector_f64_finalize(out_col),
+            accepts=_f32_exact_accepts(in_cols), null_policy="fallback")
 
 
 class Featurize(Estimator):

@@ -131,7 +131,11 @@ class BinMapper:
     def transform(self, X: np.ndarray) -> np.ndarray:
         """Float [N,F] -> int32 bins [N,F] (0 = missing)."""
         n, num_f = X.shape
-        assert num_f == self.num_features, (num_f, self.num_features)
+        if num_f != self.num_features:
+            # explicit check: under `python -O` a bare assert disappears and
+            # mismatched widths would bin silently against wrong edges
+            raise ValueError(f"feature count {num_f} != fitted "
+                             f"{self.num_features}")
         out = np.zeros((n, num_f), dtype=np.int32)
         for f in range(num_f):
             out[:, f] = self.transform_col(f, X[:, f])
@@ -147,7 +151,9 @@ class BinMapper:
         import os
 
         n, num_f = X.shape
-        assert num_f == self.num_features, (num_f, self.num_features)
+        if num_f != self.num_features:
+            raise ValueError(f"feature count {num_f} != fitted "
+                             f"{self.num_features}")
         if (not any(self.categorical) and dtype in (np.uint8, np.int32)
                 and X.dtype == np.float64 and n * num_f >= 1 << 18):
             # native whole-matrix pass: streams row-major X ONCE instead of

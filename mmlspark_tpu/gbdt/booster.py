@@ -456,7 +456,11 @@ class Booster:
     @staticmethod
     def from_string(s: str) -> "Booster":
         d = json.loads(s)
-        assert d.get("format") == MODEL_FORMAT, f"bad model format {d.get('format')}"
+        if d.get("format") != MODEL_FORMAT:
+            # explicit check (a bare assert vanishes under `python -O` and a
+            # foreign payload would then explode deep inside TrainParams)
+            raise ValueError(f"bad model format {d.get('format')!r}; "
+                             f"expected {MODEL_FORMAT!r}")
         p = d["params"]
         p["categorical_feature"] = tuple(p.get("categorical_feature", ()))
         p["max_bin_by_feature"] = tuple(p.get("max_bin_by_feature", ()))

@@ -378,6 +378,20 @@ class DeviceEnsemble:
     GEMM_ROW_CHUNK = 1 << 16
     _gemm_row_chunk = GEMM_ROW_CHUNK
 
+    def device_forward(self):
+        """The traced forest kernel X[f32] -> [N, num_class] f32 raw scores
+        for pipeline fusion, or None when only the host traversal is valid
+        (empty/categorical-fallback forests). Returns the SAME jitted
+        callable predict_raw dispatches — calling it inside an enclosing
+        jit inlines the identical jaxpr, so a fused segment's forest
+        arithmetic is bitwise-equal to the standalone path."""
+        if self.num_trees == 0 or self.cat_host_fallback:
+            return None
+        if self._jitted is None:
+            self._jitted = (self._compile_gemm() if self._gemm is not None
+                            else self._compile())
+        return self._jitted
+
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
         """[N,F] float32 -> [N, num_class] summed tree outputs (device)."""
         if self.num_trees == 0:

@@ -452,6 +452,48 @@ class _LightGBMModelBase(Model, HasFeaturesCol):
     def get_model_string(self) -> str:
         return self.get_or_throw("model")
 
+    # -- pipeline fusion ---------------------------------------------------
+    def _device_scores(self):
+        """(featuresCol, raw env key, traceable fn) for fusion, or None when
+        the forest only has a host path (empty / categorical fallback).
+        The fn inlines the SAME jitted forest kernel predict_raw uses."""
+        from ..core.device_stage import FusionUnsupported
+
+        fwd = self._ensemble().device_forward()
+        if fwd is None:
+            return None
+        feats = self.get_or_throw("featuresCol")
+        raw_key = f"__gbdt_raw__{self.uid}"
+
+        def fn(params, env):
+            import jax.numpy as jnp
+
+            X = env[feats]
+            if X.ndim != 2:
+                raise FusionUnsupported(f"features must be [N, F], got {X.shape}")
+            return {raw_key: fwd(X.astype(jnp.float32))}
+
+        return feats, raw_key, fn
+
+    def _score_device_fn(self, finalize, extra_out_cols):
+        """Build the terminal DeviceFn shared by the model subclasses:
+        forest scores on device, f64 base-score/objective math in the
+        host finalize (bitwise-identical to the unfused score())."""
+        from ..core.device_stage import DeviceFn
+
+        base = self._device_scores()
+        if base is None:
+            return None
+        feats, raw_key, fn = base
+        return DeviceFn(
+            key=(type(self).__name__, self.uid, feats),
+            in_cols=(feats,), out_cols=tuple(extra_out_cols), fn=fn,
+            device_outputs=(raw_key,), finalize=finalize,
+            # nulls/sparse rows take the unfused path (CSR predict / the
+            # host error), identically to the per-stage chain
+            null_policy="fallback", reject_sparse=True,
+            terminal=True, heavy=True)
+
 
 # ---------------------------------------------------------------------------
 # Classifier
@@ -495,30 +537,46 @@ class LightGBMClassificationModel(_LightGBMModelBase):
     predictionCol = Param("predictionCol", "Predicted label column", "prediction",
                           ptype=str)
 
+    def _score_columns(self, raw: np.ndarray) -> dict:
+        """[N, K] f64 raw scores (base score included) -> output columns.
+        Shared by transform() and the fused finalize so both paths run the
+        identical f64 objective math."""
+        if self.booster.params.objective == "binary":
+            p1 = 1 / (1 + np.exp(-raw[:, 0]))
+            proba = np.stack([1 - p1, p1], axis=1)
+            rawcol = np.stack([-raw[:, 0], raw[:, 0]], axis=1)
+        else:
+            e = np.exp(raw - raw.max(axis=1, keepdims=True))
+            proba = e / e.sum(axis=1, keepdims=True)
+            rawcol = raw
+        pred = np.argmax(proba, axis=1).astype(np.float64)
+        n = len(pred)
+        raw_obj = np.empty(n, dtype=object)
+        proba_obj = np.empty(n, dtype=object)
+        for i in range(n):
+            raw_obj[i] = rawcol[i]
+            proba_obj[i] = proba[i]
+        return {self.get("rawPredictionCol"): raw_obj,
+                self.get("probabilityCol"): proba_obj,
+                self.get("predictionCol"): pred}
+
     def transform(self, df: DataFrame) -> DataFrame:
         def score(part):
-            raw = self._raw_scores(part)
-            if self.booster.params.objective == "binary":
-                p1 = 1 / (1 + np.exp(-raw[:, 0]))
-                proba = np.stack([1 - p1, p1], axis=1)
-                rawcol = np.stack([-raw[:, 0], raw[:, 0]], axis=1)
-            else:
-                e = np.exp(raw - raw.max(axis=1, keepdims=True))
-                proba = e / e.sum(axis=1, keepdims=True)
-                rawcol = raw
-            pred = np.argmax(proba, axis=1).astype(np.float64)
-            n = len(pred)
-            raw_obj = np.empty(n, dtype=object)
-            proba_obj = np.empty(n, dtype=object)
-            for i in range(n):
-                raw_obj[i] = rawcol[i]
-                proba_obj[i] = proba[i]
-            part[self.get("rawPredictionCol")] = raw_obj
-            part[self.get("probabilityCol")] = proba_obj
-            part[self.get("predictionCol")] = pred
+            part.update(self._score_columns(self._raw_scores(part)))
             return part
 
         return df.map_partitions(score)
+
+    def device_fn(self, schema: Schema):
+        def finalize(outs, ctx):
+            raw_key = next(iter(outs))
+            raw = np.asarray(outs[raw_key], dtype=np.float64) \
+                + self.booster.base_score[None, :]
+            return self._score_columns(raw)
+
+        return self._score_device_fn(
+            finalize, (self.get("rawPredictionCol"),
+                       self.get("probabilityCol"), self.get("predictionCol")))
 
     def transform_schema(self, schema: Schema) -> Schema:
         out = schema.copy()
@@ -557,15 +615,35 @@ class LightGBMRegressor(Estimator, _LightGBMParams):
 class LightGBMRegressionModel(_LightGBMModelBase):
     predictionCol = Param("predictionCol", "Prediction column", "prediction", ptype=str)
 
+    def _prediction_column(self, raw: np.ndarray) -> np.ndarray:
+        """[N, 1] f64 raw (base score included) -> prediction values (shared
+        by transform() and the fused finalize)."""
+        raw = raw[:, 0]
+        if self.booster.params.objective == "poisson":
+            raw = np.exp(raw)
+        return raw
+
     def transform(self, df: DataFrame) -> DataFrame:
         def score(part):
-            raw = self._raw_scores(part)[:, 0]
-            if self.booster.params.objective == "poisson":
-                raw = np.exp(raw)
-            part[self.get("predictionCol")] = raw
+            part[self.get("predictionCol")] = \
+                self._prediction_column(self._raw_scores(part))
             return part
 
         return df.map_partitions(score)
+
+    def device_fn(self, schema: Schema):
+        def finalize(outs, ctx):
+            raw_key = next(iter(outs))
+            raw = np.asarray(outs[raw_key], dtype=np.float64) \
+                + self.booster.base_score[None, :]
+            return {self.get("predictionCol"): self._prediction_column(raw)}
+
+        return self._score_device_fn(finalize, (self.get("predictionCol"),))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        out = schema.copy()
+        out.types[self.get("predictionCol")] = ColType.FLOAT64
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -601,3 +679,17 @@ class LightGBMRankerModel(_LightGBMModelBase):
             return part
 
         return df.map_partitions(score)
+
+    def device_fn(self, schema: Schema):
+        def finalize(outs, ctx):
+            raw_key = next(iter(outs))
+            raw = np.asarray(outs[raw_key], dtype=np.float64) \
+                + self.booster.base_score[None, :]
+            return {self.get("predictionCol"): raw[:, 0]}
+
+        return self._score_device_fn(finalize, (self.get("predictionCol"),))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        out = schema.copy()
+        out.types[self.get("predictionCol")] = ColType.FLOAT64
+        return out

@@ -26,6 +26,7 @@ from typing import Optional
 import numpy as np
 
 from ..core.dataframe import DataFrame
+from ..core.device_stage import DeviceFn, FusionUnsupported
 from ..core.params import ComplexParam, HasInputCol, HasOutputCol, Param
 from ..core.pipeline import Transformer
 from ..core.schema import ColType, ImageSchema, Schema
@@ -155,6 +156,96 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         """Ingest decomposition of the most recent transform (delegates to
         the wrapped DNNModel) — None before the first transform."""
         return self._dnn_cache[1].last_ingest_stats if self._dnn_cache else None
+
+    def device_fn(self, schema: Schema):
+        """Fusion contract: decode/resize/channel-fix run per-row in
+        `prepare` (the unfused host prep); the device body is the channel
+        fix mirror + PreprocessSpec + ONE forward to the tapped activation.
+        Upstream in-segment image stages feed it device-resident batches —
+        trace-time shape gates fall back when (H, W) does not match the
+        backbone."""
+        model: Optional[FunctionModel] = self.get("model")
+        if model is None:
+            return None
+        from ..parallel.mesh import DATA_AXIS, MeshContext
+
+        mesh = MeshContext.current()
+        if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
+            return None  # mesh-sharded eval keeps the unfused path
+        fmt = getattr(model, "data_format", "NHWC")
+        if fmt == "NCHW":
+            c, h, w = model.input_shape
+        else:
+            h, w, c = model.input_shape
+        spec = PreprocessSpec(scale=self.get("scaleFactor"),
+                              transpose=(2, 0, 1) if fmt == "NCHW" else None)
+        node = self._output_node(model)
+        in_col = self.get_or_throw("inputCol")
+        out_col = self.get_or_throw("outputCol")
+        key = ("ImageFeaturizer", in_col, out_col, id(model), node, spec,
+               h, w, c)
+
+        def prepare(cols, ctx):
+            # the unfused per-row prep (decode -> resize -> channel fix);
+            # the spec runs on DEVICE in both hostPreprocess modes — its ops
+            # are exact, so the wire stays the decoded dtype
+            col = cols[in_col]
+            out = np.empty(len(col), dtype=object)
+            for i, row in enumerate(col):
+                img = None
+                if row is None:
+                    pass
+                elif isinstance(row, (bytes, bytearray)):
+                    img = ops.decode_image(bytes(row))
+                elif ImageSchema.is_image(row):
+                    img = ImageSchema.to_array(row)
+                else:
+                    img = np.asarray(row)
+                    if img.ndim == 1:
+                        img = np.moveaxis(img.reshape(c, h, w), 0, -1)
+                if img is None:
+                    out[i] = None
+                    continue
+                img = ops.resize(img, h, w)
+                if img.ndim == 2:
+                    img = img[:, :, None]
+                if img.shape[2] != c:
+                    img = (np.repeat(img[:, :, :1], c, axis=2)
+                           if img.shape[2] < c else img[:, :, :c])
+                out[i] = np.ascontiguousarray(img)
+            return {in_col: out}
+
+        def accepts(probes):
+            p = probes.get(in_col)
+            if p is None or p["dtype"] is None:
+                return True
+            return p["dtype"].kind in "fuib" and p["ndim"] in (2, 3)
+
+        def fn(params, env):
+            import jax.numpy as jnp
+
+            x = env[in_col]
+            if x.ndim == 3:
+                x = x[:, :, :, None]
+            if x.ndim != 4:
+                raise FusionUnsupported("image batch must be [B,H,W,C]")
+            if (x.shape[1], x.shape[2]) != (h, w):
+                raise FusionUnsupported(
+                    f"input {x.shape[1]}x{x.shape[2]} != backbone {h}x{w}; "
+                    f"resize upstream (host prep only runs at segment heads)")
+            x = ops.fix_channels_batch(x, c)
+            y = spec.apply_device(x)
+            live = FunctionModel(model.module, params, model.input_shape,
+                                 model.layer_names, model.name)
+            act = live.apply_taps(y, [node])[node]
+            # f32 on device == the unfused host-side np.asarray(y, float32)
+            return {out_col: act.astype(jnp.float32)}
+
+        return DeviceFn(
+            key=key, in_cols=(in_col,), out_cols=(out_col,), fn=fn,
+            params=model.params, prepare=prepare, accepts=accepts,
+            reject_sparse=False, drop_invalid=bool(self.get("dropNa")),
+            heavy=True)
 
     def transform_schema(self, schema: Schema) -> Schema:
         schema.require(self.get_or_throw("inputCol"))

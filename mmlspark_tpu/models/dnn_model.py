@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..core.device_stage import DeviceFn
 from ..core.params import ComplexParam, HasBatchSize, HasInputCol, HasOutputCol, Param
 from ..core.dataframe import DataFrame
 from ..core.pipeline import Model
@@ -177,6 +178,53 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
             self._jit_cache[key] = jax.jit(
                 fwd, donate_argnums=(1,)) if donate else jax.jit(fwd)
         return self._jit_cache[key]
+
+    def device_fn(self, schema: Schema):
+        """Fusion contract: single-input eval fuses as [optional
+        PreprocessSpec] + ONE forward fetching every tap — the same traced
+        jaxpr the unfused _compiled() path jits, so fused == unfused
+        bitwise. Mesh-sharded eval and dict-feed (multi-input) models keep
+        the unfused path."""
+        model = self.get("model")
+        if model is None or self.get("useMesh") is True:
+            return None
+        from ..parallel.mesh import DATA_AXIS, MeshContext
+
+        mesh = MeshContext.current()
+        if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
+            return None
+        in_map, out_map = self._io_maps(model)
+        if list(in_map) != model.argument_names()[:1]:
+            return None  # multi-input feedDict eval stays unfused
+        in_col = list(in_map.values())[0]
+        out_cols = tuple(out_map)
+        taps = tuple(out_map[c] for c in out_cols)
+        spec: Optional[PreprocessSpec] = self.get("preprocess")
+        key = ("DNNModel", id(model), in_col, out_cols, taps, spec)
+
+        def fn(params, env):
+            import jax.numpy as jnp
+
+            x = env[in_col]
+            if spec is not None:
+                x = spec.apply_device(x)
+            live = FunctionModel(model.module, params, model.input_shape,
+                                 model.layer_names, model.name)
+            acts = live.apply_taps(x, list(taps))
+            # f32 on device == the unfused np.asarray(y, float32) readback
+            return {c: acts[t].astype(jnp.float32)
+                    for c, t in zip(out_cols, taps)}
+
+        def accepts(probes):
+            p = probes.get(in_col)
+            if p is None or p["dtype"] is None:
+                return True
+            return p["sparse"] or p["dtype"].kind in "fuib"
+
+        return DeviceFn(
+            key=key, in_cols=(in_col,), out_cols=out_cols, fn=fn,
+            params=model.params, accepts=accepts, reject_sparse=False,
+            heavy=True)
 
     def transform_schema(self, schema: Schema) -> Schema:
         if self.get("model") is None:

@@ -395,7 +395,11 @@ def vw_train_pass(indices: np.ndarray, values: np.ndarray,
     values = np.ascontiguousarray(values, dtype=np.float32)
     labels = np.ascontiguousarray(labels, dtype=np.float32)
     weights = np.ascontiguousarray(weights, dtype=np.float32)
-    assert w.dtype == np.float32 and g2.dtype == np.float32
+    if w.dtype != np.float32 or g2.dtype != np.float32:
+        # in-place C++ update needs f32 buffers; a bare assert here would
+        # vanish under `python -O` and hand the kernel mistyped pointers —
+        # degrade to the scan engine instead (the None contract above)
+        return None
     n, k = indices.shape
     t_box = np.array([t], dtype=np.float32)
     loss_out = np.zeros(1, dtype=np.float64)

@@ -283,3 +283,88 @@ def unroll_batch_chw(x):
 
     b = x.shape[0]
     return jnp.moveaxis(x, -1, 1).reshape(b, -1)
+
+
+# ---------------------------------------------------------------------------
+# Device-EXACT batched mirrors (pipeline fusion, core/fusion.py)
+#
+# Each op below reproduces its host sibling BITWISE on [B,H,W,C] batches:
+# pure value moves (crop/flip/reverse), exact casts, or the identical
+# elementwise IEEE-f32 expression tree (XLA CPU/TPU do not reassociate or
+# contract elementwise chains). Ops whose host path computes through f64
+# (resize's interpolation weights, the cumsum blurs) have NO device mirror —
+# the fused executor runs those on the host in a segment's `prepare` using
+# the per-image functions above, which is what keeps fused == unfused exact.
+# ---------------------------------------------------------------------------
+
+
+def crop_batch(x, cx: int, cy: int, height: int, width: int):
+    """Batched mirror of ``crop`` (numpy slicing semantics, any dtype)."""
+    return x[:, cy:cy + height, cx:cx + width]
+
+
+def flip_batch(x, flip_code: int = 1):
+    """Batched mirror of ``flip`` (OpenCV Core.flip codes)."""
+    if flip_code == 0:
+        return x[:, ::-1]
+    if flip_code > 0:
+        return x[:, :, ::-1]
+    return x[:, ::-1, ::-1]
+
+
+def threshold_batch(x, thresh: float, max_val: float, kind: str = "binary"):
+    """Batched mirror of ``threshold``: f32 compare + select, exact."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    t = jnp.float32(thresh)
+    m = jnp.float32(max_val)
+    z = jnp.float32(0.0)
+    if kind == "binary":
+        return jnp.where(xf > t, m, z)
+    if kind == "binary_inv":
+        return jnp.where(xf > t, z, m)
+    if kind == "trunc":
+        return jnp.minimum(xf, t)
+    if kind == "tozero":
+        return jnp.where(xf > t, xf, z)
+    if kind == "tozero_inv":
+        return jnp.where(xf > t, z, xf)
+    raise ValueError(f"Unknown threshold kind {kind!r}")
+
+
+def color_format_batch(x, code: str):
+    """Batched mirror of ``color_format``. The gray path spells out the f32
+    weighted sum in the same left-to-right order numpy's 3-element matvec
+    evaluates, so host and device agree bitwise (verified in tests)."""
+    import jax.numpy as jnp
+
+    if code in ("gray", "grayscale"):
+        if x.ndim == 3 or x.shape[-1] == 1:
+            return x
+        xf = x[..., :3].astype(jnp.float32)
+        g = (xf[..., 0] * jnp.float32(0.299)
+             + xf[..., 1] * jnp.float32(0.587)) + xf[..., 2] * jnp.float32(0.114)
+        if x.dtype == jnp.uint8:
+            g = jnp.clip(jnp.rint(g), 0, 255).astype(jnp.uint8)
+        else:
+            g = g.astype(x.dtype)
+        return g[..., None]
+    if code in ("bgr2rgb", "rgb2bgr"):
+        return x[..., ::-1]
+    raise ValueError(f"Unknown color format {code!r}")
+
+
+def fix_channels_batch(x, c: int):
+    """Batched mirror of the featurizer's channel fix: repeat a single
+    channel up to ``c`` or slice extras off (exact value moves)."""
+    import jax.numpy as jnp
+
+    if x.ndim == 3:
+        x = x[:, :, :, None]
+    have = x.shape[3]
+    if have == c:
+        return x
+    if have < c:
+        return jnp.repeat(x[:, :, :, :1], c, axis=3)
+    return x[:, :, :, :c]

@@ -144,6 +144,7 @@ class ServingServer:
                  journal_path: Optional[str] = None,
                  name: str = "serving",
                  ingest_stats: Optional[Callable[[], Optional[dict]]] = None,
+                 fusion_stats: Optional[Callable[[], Optional[dict]]] = None,
                  max_queue: int = 0, drain_timeout_s: float = 5.0):
         self.transform = transform
         # optional provider of the device-ingest decomposition (queue/h2d/
@@ -151,6 +152,10 @@ class ServingServer:
         # the /_mmlspark/stats payload; serve_pipeline wires it automatically
         # for stages that expose last_ingest_stats
         self.ingest_stats = ingest_stats
+        # optional provider of the pipeline-fusion report (segment layout,
+        # per-segment compute, compile-cache hit rate — core/fusion.py
+        # fusion_stats()); serve_pipeline wires it for fused pipelines
+        self.fusion_stats = fusion_stats
         self.host = host
         self.port = port
         self.slot_timeout_s = slot_timeout_s
@@ -236,6 +241,11 @@ class ServingServer:
                             summary["ingest"] = server.ingest_stats()
                         except Exception as e:  # noqa: BLE001
                             summary["ingest"] = {"error": str(e)}
+                    if server.fusion_stats is not None:
+                        try:
+                            summary["fusion"] = server.fusion_stats()
+                        except Exception as e:  # noqa: BLE001
+                            summary["fusion"] = {"error": str(e)}
                     body = json.dumps(summary).encode("utf-8")
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -605,13 +615,23 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    api_path: str = "/", max_batch_size: int = 64,
                    max_wait_ms: float = 5.0, token: Optional[str] = None,
                    journal_path: Optional[str] = None,
-                   max_queue: int = 0) -> ServingServer:
+                   max_queue: int = 0, fused: bool = False) -> ServingServer:
     """Serve a fitted Transformer: request body -> ``input_col`` -> stage ->
     ``reply_col`` (IOImplicits fluent sugar parity, io/IOImplicits.scala:182-213).
 
     parse: 'json' (body -> dict/array) | 'text' | 'bytes'.
+
+    ``fused=True`` compiles a PipelineModel's device-capable stages into
+    shared XLA programs (``PipelineModel.fuse()``, core/fusion.py): the
+    batch loop then executes the fused executables, and
+    ``/_mmlspark/stats`` reports the segment layout, compile-cache hit
+    rate, and per-segment compute alongside the ingest decomposition.
     """
+    from ..core.pipeline import PipelineModel
     from .stages import parse_request
+
+    if fused and isinstance(stage, PipelineModel):
+        stage = stage.fuse()
 
     def transform(df: DataFrame) -> DataFrame:
         parsed = parse_request(df, input_col, parse=parse)
@@ -630,8 +650,12 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
             s = stage.last_ingest_stats
             return s.summary() if s is not None else None
 
+    fusion = None
+    if hasattr(stage, "fusion_stats"):
+        fusion = stage.fusion_stats
+
     return ServingServer(transform, host=host, port=port, api_path=api_path,
                          reply_col=reply_col, max_batch_size=max_batch_size,
                          max_wait_ms=max_wait_ms, token=token,
                          journal_path=journal_path, ingest_stats=ingest,
-                         max_queue=max_queue)
+                         fusion_stats=fusion, max_queue=max_queue)

@@ -53,8 +53,32 @@ class UDFTransformer(Transformer, HasInputCol, HasOutputCol):
 
     udf = ComplexParam("udf", "Per-row value function")
     vectorizedUdf = ComplexParam("vectorizedUdf", "Whole-column function")
+    deviceUdf = ComplexParam(
+        "deviceUdf",
+        "Optional jittable batched mirror of the udf: [B, ...] array -> "
+        "[B, ...] array, row-independent and BITWISE-equal to the host "
+        "udf on its accepted dtypes. When set, pipeline fusion "
+        "(core/fusion.py) can compile this stage into a shared XLA "
+        "program with its neighbors; the host udf remains the fallback "
+        "and the parity oracle.")
     inputCols = Param("inputCols", "Multiple input columns (udf gets one arg each)",
                       None, ptype=(list, tuple))
+
+    def device_fn(self, schema):
+        from ..core.device_stage import DeviceFn
+
+        dev = self.get("deviceUdf")
+        if dev is None or self.get("inputCols"):
+            return None
+        in_col = self.get_or_throw("inputCol")
+        out_col = self.get_or_throw("outputCol")
+
+        def fn(params, env):
+            return {out_col: dev(env[in_col])}
+
+        return DeviceFn(
+            key=("UDFTransformer", in_col, out_col, id(dev)),
+            in_cols=(in_col,), out_cols=(out_col,), fn=fn)
 
     def transform(self, df: DataFrame) -> DataFrame:
         out_col = self.get_or_throw("outputCol")

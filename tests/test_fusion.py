@@ -1,0 +1,493 @@
+"""Device-resident pipeline fusion (core/fusion.py).
+
+The load-bearing contract: ``PipelineModel.fuse()`` output is BITWISE
+identical to the unfused stage-by-stage chain — same values, same dtypes,
+same nulls — across image chains, featurize->GBDT, featurize->DNN, split
+segments, and every fallback path. Plus: compile-cache reuse, bucketing,
+profiler annotation, and the serving round trip.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.device_stage import CompileCache, compile_cache
+from mmlspark_tpu.core.fusion import FusedPipelineModel, HostStage, Segment, plan
+from mmlspark_tpu.core.pipeline import PipelineModel
+from mmlspark_tpu.core.schema import ImageSchema
+from mmlspark_tpu.featurize.assemble import FastVectorAssembler
+from mmlspark_tpu.gbdt.stages import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.image.featurizer import ImageFeaturizer
+from mmlspark_tpu.image.stages import ImageTransformer, ResizeImageTransformer
+from mmlspark_tpu.models.dnn_model import DNNModel
+from mmlspark_tpu.models.module import (BatchNorm, Conv2D, Dense, FunctionModel,
+                                        GlobalAvgPool, Sequential, relu)
+from mmlspark_tpu.stages.basic import UDFTransformer
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+
+def toy_cnn(size=16, c=3):
+    mod = Sequential([("conv", Conv2D(8, (3, 3))), ("bn", BatchNorm()),
+                      ("act", relu()), ("pool", GlobalAvgPool()),
+                      ("head", Dense(4))], name="toycnn")
+    params, _ = mod.init(jax.random.PRNGKey(0), (size, size, c))
+    return FunctionModel(mod, params, (size, size, c),
+                         layer_names=["head", "pool"], name="toycnn")
+
+
+def toy_mlp(d_in=4):
+    mod = Sequential([("d1", Dense(8)), ("act", relu()), ("d2", Dense(3))],
+                     name="toymlp")
+    params, _ = mod.init(jax.random.PRNGKey(1), (d_in,))
+    return FunctionModel(mod, params, (d_in,), layer_names=["d2", "d1"],
+                         name="toymlp")
+
+
+def image_df(n=23, seed=3, parts=2, null_at=None):
+    rng = np.random.default_rng(seed)
+    rows = np.empty(n, dtype=object)
+    for i in range(n):
+        rows[i] = ImageSchema.make(
+            rng.integers(0, 256, (20 + i % 3, 24, 3), dtype=np.uint8),
+            f"img{i}")
+    if null_at is not None:
+        rows[null_at] = None
+    return DataFrame.from_dict({"image": rows, "idx": np.arange(float(n))},
+                               num_partitions=parts)
+
+
+def tabular_df(n=120, seed=5, parts=3, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n).astype(dtype)
+    b = rng.normal(size=(n, 3)).astype(dtype)
+    y = (a + b[:, 0] > 0).astype(np.float64)
+    return DataFrame.from_dict(
+        {"a": a, "b": [b[i] for i in range(n)], "label": y},
+        num_partitions=parts)
+
+
+def assert_bitwise(ref_df, got_df):
+    """Exact equality: columns, row counts, values AND dtypes."""
+    assert ref_df.columns == got_df.columns
+    rc, gc = ref_df.collect(), got_df.collect()
+    for name in ref_df.columns:
+        a, b = rc[name], gc[name]
+        assert len(a) == len(b), f"{name}: {len(a)} vs {len(b)} rows"
+        if a.dtype != object and b.dtype != object:
+            assert a.dtype == b.dtype, f"{name}: {a.dtype} vs {b.dtype}"
+            np.testing.assert_array_equal(a, b, err_msg=name)
+            continue
+        for i, (x, y) in enumerate(zip(a, b)):
+            if x is None or y is None:
+                assert x is None and y is None, f"{name} row {i} null mismatch"
+            elif ImageSchema.is_image(x) or ImageSchema.is_image(y):
+                dx, dy = ImageSchema.to_array(x), ImageSchema.to_array(y)
+                assert dx.dtype == dy.dtype, f"{name} row {i} image dtype"
+                np.testing.assert_array_equal(dx, dy, err_msg=f"{name} row {i}")
+                assert x["origin"] == y["origin"], f"{name} row {i} origin"
+            elif isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+                x, y = np.asarray(x), np.asarray(y)
+                assert x.dtype == y.dtype, \
+                    f"{name} row {i}: {x.dtype} vs {y.dtype}"
+                np.testing.assert_array_equal(x, y, err_msg=f"{name} row {i}")
+            else:
+                assert x == y, f"{name} row {i}: {x!r} != {y!r}"
+
+
+def fused_of(pm, cache=None):
+    return FusedPipelineModel(pm.stages, cache=cache or CompileCache())
+
+
+# --------------------------------------------------------------------------
+# bitwise parity across representative pipelines
+# --------------------------------------------------------------------------
+
+
+class TestBitwiseParity:
+    def test_image_chain(self):
+        df = image_df()
+        pm = PipelineModel([
+            ImageTransformer().resize(16, 16).flip(1).threshold(100.0, 255.0),
+            ImageFeaturizer(scaleFactor=1 / 255., batchSize=8)
+            .set_model(toy_cnn())])
+        fused = fused_of(pm)
+        assert_bitwise(pm.transform(df), fused.transform(df))
+        stats = fused.fusion_stats()
+        assert stats["n_fused_segments"] == 1
+        assert stats["fallbacks"] == []
+        seg = stats["segments"][0]
+        assert seg["stages"] == ["ImageTransformer", "ImageFeaturizer"]
+
+    def test_image_chain_with_null_and_dropna(self):
+        df = image_df(null_at=7)
+        pm = PipelineModel([
+            ImageTransformer().resize(16, 16).flip(1),
+            ImageFeaturizer(scaleFactor=1 / 255., batchSize=8, dropNa=True)
+            .set_model(toy_cnn())])
+        fused = fused_of(pm)
+        ref, got = pm.transform(df), fused.transform(df)
+        assert ref.count() == got.count() == 22  # the null row dropped
+        assert_bitwise(ref, got)
+
+    def test_resize_stage_heads_a_segment(self):
+        df = image_df(n=11)
+        pm = PipelineModel([
+            ResizeImageTransformer(height=16, width=16, nChannels=3),
+            ImageFeaturizer(scaleFactor=1 / 255., batchSize=8)
+            .set_model(toy_cnn())])
+        fused = fused_of(pm)
+        assert_bitwise(pm.transform(df), fused.transform(df))
+        assert fused.fusion_stats()["n_fused_segments"] == 1
+
+    def test_featurize_gbdt_classifier(self):
+        df = tabular_df()
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        model = LightGBMClassifier(labelCol="label", numIterations=8,
+                                   numLeaves=7).fit(asm.transform(df))
+        pm = PipelineModel([asm, model])
+        fused = fused_of(pm)
+        assert_bitwise(pm.transform(df), fused.transform(df))
+        assert fused.fusion_stats()["fallbacks"] == []
+
+    def test_featurize_gbdt_regressor(self):
+        df = tabular_df(seed=6)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        model = LightGBMRegressor(labelCol="label", numIterations=5) \
+            .fit(asm.transform(df))
+        pm = PipelineModel([asm, model])
+        assert_bitwise(pm.transform(df), fused_of(pm).transform(df))
+
+    def test_featurize_dnn(self):
+        df = tabular_df(seed=7)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        dnn = DNNModel(inputCol="features", outputCol="emb", batchSize=16)
+        dnn.set_model(toy_mlp())
+        pm = PipelineModel([asm, dnn])
+        fused = fused_of(pm)
+        assert_bitwise(pm.transform(df), fused.transform(df))
+        seg = fused.fusion_stats()["segments"][0]
+        assert seg["stages"] == ["FastVectorAssembler", "DNNModel"]
+
+    def test_dnn_null_rows_propagate(self):
+        rng = np.random.default_rng(9)
+        rows = np.empty(20, dtype=object)
+        for i in range(20):
+            rows[i] = rng.normal(size=4).astype(np.float32)
+        rows[3] = None
+        df = DataFrame.from_dict({"x": rows}, num_partitions=2)
+        dnn = DNNModel(inputCol="x", outputCol="emb", batchSize=8)
+        dnn.set_model(toy_mlp())
+        pm = PipelineModel([dnn])
+        ref, got = pm.transform(df), fused_of(pm).transform(df)
+        assert got.collect()["emb"][3] is None
+        assert_bitwise(ref, got)
+
+    def test_udf_device_mirror_fuses(self):
+        rng = np.random.default_rng(11)
+        rows = np.empty(30, dtype=object)
+        for i in range(30):
+            rows[i] = rng.normal(size=4).astype(np.float32)
+        df = DataFrame.from_dict({"x": rows}, num_partitions=2)
+
+        def host_double(col):
+            out = np.empty(len(col), dtype=object)
+            for i, v in enumerate(col):
+                out[i] = v * np.float32(2.0)
+            return out
+
+        udf = UDFTransformer(inputCol="x", outputCol="x2",
+                             vectorizedUdf=host_double,
+                             deviceUdf=lambda x: x * np.float32(2.0))
+        dnn = DNNModel(inputCol="x2", outputCol="emb", batchSize=8)
+        dnn.set_model(toy_mlp())
+        pm = PipelineModel([udf, dnn])
+        fused = fused_of(pm)
+        assert_bitwise(pm.transform(df), fused.transform(df))
+        seg = fused.fusion_stats()["segments"][0]
+        assert seg["stages"] == ["UDFTransformer", "DNNModel"]
+
+    def test_transform_fused_kwarg(self):
+        df = tabular_df(seed=8)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        dnn = DNNModel(inputCol="features", outputCol="emb", batchSize=16)
+        dnn.set_model(toy_mlp())
+        pm = PipelineModel([asm, dnn])
+        assert_bitwise(pm.transform(df), pm.transform(df, fused=True))
+        assert pm.fuse() is pm.fuse()  # cached runner
+
+
+# --------------------------------------------------------------------------
+# planning: splits, demotion, terminal stages
+# --------------------------------------------------------------------------
+
+
+class TestPlanning:
+    def test_host_stage_splits_segment(self):
+        df = tabular_df(seed=12)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+
+        def host_sum(col):
+            return np.asarray([float(v.sum()) for v in col], dtype=np.float64)
+
+        udf = UDFTransformer(inputCol="features", outputCol="fsum",
+                             vectorizedUdf=host_sum)  # no device mirror
+        dnn = DNNModel(inputCol="features", outputCol="emb", batchSize=16)
+        dnn.set_model(toy_mlp())
+        pm = PipelineModel([asm, udf, dnn])
+        fused = fused_of(pm)
+        nodes = fused._plan_for(df.schema)
+        kinds = [type(n).__name__ for n in nodes]
+        # the host-only UDF splits; the lone assembler run is demoted to
+        # host (no heavy stage to amortize a device round trip)
+        assert kinds == ["HostStage", "HostStage", "Segment"]
+        assert_bitwise(pm.transform(df), fused.transform(df))
+
+    def test_light_only_segment_demoted(self):
+        df = tabular_df(seed=13)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        nodes = plan([asm], df.schema.copy())
+        assert all(isinstance(n, HostStage) for n in nodes)
+
+    def test_gbdt_is_terminal(self):
+        df = tabular_df(seed=14)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        model = LightGBMRegressor(labelCol="label", numIterations=3) \
+            .fit(asm.transform(df))
+        dnn = DNNModel(inputCol="features", outputCol="emb", batchSize=16)
+        dnn.set_model(toy_mlp())
+        nodes = plan([asm, model, dnn], df.schema.copy())
+        segs = [n for n in nodes if isinstance(n, Segment)]
+        # GBDT finalizes on host (f64 objective math) => ends its segment
+        assert [s.describe()["stages"] for s in segs] == \
+            [["FastVectorAssembler", "LightGBMRegressionModel"], ["DNNModel"]]
+
+    def test_image_host_prefix_op_starts_new_segment(self):
+        # a mid-chain resize cannot replay on device-resident input: the
+        # planner must split rather than silently lose exactness
+        df = image_df(n=9)
+        t1 = ImageTransformer().resize(16, 16).flip(1)
+        t2 = ImageTransformer().resize(8, 8)  # host-prep op, internal input
+        feat = ImageFeaturizer(scaleFactor=1 / 255., batchSize=8) \
+            .set_model(toy_cnn(size=8))
+        pm = PipelineModel([t1, t2, feat])
+        fused = fused_of(pm)
+        nodes = fused._plan_for(df.schema)
+        # t2's host-prep resize cannot consume t1's device output: t1 is cut
+        # off (and, alone, demoted to host); t2 heads the fused segment
+        assert [type(n).__name__ for n in nodes] == ["HostStage", "Segment"]
+        assert nodes[1].describe()["stages"] == \
+            ["ImageTransformer", "ImageFeaturizer"]
+        assert_bitwise(pm.transform(df), fused.transform(df))
+
+
+# --------------------------------------------------------------------------
+# fallbacks: anything the bitwise contract cannot hold for -> host path
+# --------------------------------------------------------------------------
+
+
+class TestFallbacks:
+    def test_f64_inputs_fall_back(self):
+        df = tabular_df(seed=15, dtype=np.float64)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        model = LightGBMRegressor(labelCol="label", numIterations=4) \
+            .fit(asm.transform(df))
+        pm = PipelineModel([asm, model])
+        fused = fused_of(pm)
+        assert_bitwise(pm.transform(df), fused.transform(df))
+        assert any("dtype gate" in f for f in fused.fusion_stats()["fallbacks"])
+
+    def test_sparse_rows_fall_back(self):
+        rng = np.random.default_rng(16)
+        n = 40
+        dense = rng.normal(size=(n, 4)).astype(np.float64)
+        y = (dense[:, 0] > 0).astype(np.float64)
+        feats = np.empty(n, dtype=object)
+        for i in range(n):
+            feats[i] = {"indices": np.array([0, 2]),
+                        "values": dense[i, [0, 2]], "size": 4}
+        df_fit = DataFrame.from_dict(
+            {"features": [dense[i] for i in range(n)], "label": y})
+        model = LightGBMClassifier(labelCol="label", numIterations=4,
+                                   numLeaves=5).fit(df_fit)
+        df = DataFrame.from_dict({"features": feats}, num_partitions=2)
+        pm = PipelineModel([model])
+        fused = fused_of(pm)
+        assert_bitwise(pm.transform(df), fused.transform(df))
+        assert any("sparse" in f for f in fused.fusion_stats()["fallbacks"])
+
+    def test_ragged_rows_fall_back(self):
+        rng = np.random.default_rng(17)
+        rows = np.empty(12, dtype=object)
+        for i in range(12):
+            rows[i] = rng.normal(size=4 if i % 2 else 5).astype(np.float32)
+        df = DataFrame.from_dict({"x": rows})
+        dnn = DNNModel(inputCol="x", outputCol="emb", batchSize=8)
+        dnn.set_model(toy_mlp())
+        pm = PipelineModel([dnn])
+        fused = fused_of(pm)
+        with pytest.raises(ValueError):
+            pm.transform(df).collect()  # unfused raises on ragged rows too
+        with pytest.raises(ValueError):
+            fused.transform(df).collect()
+
+    def test_shape_mismatch_falls_back_to_host(self):
+        # featurizer fed 8x8 device batches but backbone wants 16x16: the
+        # trace gate fires and the segment reruns on host (bitwise anyway)
+        df = image_df(n=9)
+        t1 = ImageTransformer().resize(8, 8).flip(1)
+        feat = ImageFeaturizer(scaleFactor=1 / 255., batchSize=8) \
+            .set_model(toy_cnn(size=16))
+        pm = PipelineModel([t1, feat])
+        fused = fused_of(pm)
+        assert_bitwise(pm.transform(df), fused.transform(df))
+        assert len(fused.fusion_stats()["fallbacks"]) > 0
+
+
+# --------------------------------------------------------------------------
+# compile cache + bucketing
+# --------------------------------------------------------------------------
+
+
+class TestCompileCache:
+    def test_executables_reused_across_calls(self):
+        df = tabular_df(seed=18)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        dnn = DNNModel(inputCol="features", outputCol="emb", batchSize=16)
+        dnn.set_model(toy_mlp())
+        cache = CompileCache()
+        fused = fused_of(PipelineModel([asm, dnn]), cache=cache)
+        fused.transform(df)  # warmup: compiles
+        warm = cache.stats()
+        assert warm["misses"] >= 1
+        for _ in range(3):
+            fused.transform(df)
+        stats = cache.stats()
+        assert stats["misses"] == warm["misses"]  # no recompiles
+        post = ((stats["hits"] - warm["hits"])
+                / max((stats["hits"] - warm["hits"])
+                      + (stats["misses"] - warm["misses"]), 1))
+        assert post >= 0.9  # acceptance: hit rate after warmup
+        assert stats["compile_time_s"] > 0
+
+    def test_bucketed_shapes_bound_compiles(self):
+        # ragged partition tails pad to power-of-two buckets: many partition
+        # sizes, O(log batch) compiled shapes
+        rng = np.random.default_rng(19)
+        cache = CompileCache()
+        dnn = DNNModel(inputCol="x", outputCol="emb", batchSize=16)
+        dnn.set_model(toy_mlp())
+        fused = fused_of(PipelineModel([dnn]), cache=cache)
+        for n in (5, 9, 16, 23, 31, 37):
+            rows = np.empty(n, dtype=object)
+            for i in range(n):
+                rows[i] = rng.normal(size=4).astype(np.float32)
+            fused.transform(DataFrame.from_dict({"x": rows}))
+        # buckets: 8, 16 (and full 16-batches) => at most 3 distinct shapes
+        assert cache.entries <= 3
+
+    def test_global_cache_shared(self):
+        assert compile_cache() is compile_cache()
+
+
+# --------------------------------------------------------------------------
+# observability: profiler annotations + stats surfaces
+# --------------------------------------------------------------------------
+
+
+class TestObservability:
+    def test_annotate_named_per_segment(self, monkeypatch):
+        from mmlspark_tpu.core import fusion as fusion_mod
+
+        seen = []
+        import contextlib
+
+        @contextlib.contextmanager
+        def recording_annotate(name):
+            seen.append(name)
+            yield
+
+        monkeypatch.setattr(fusion_mod.profiling, "annotate",
+                            recording_annotate)
+        df = tabular_df(seed=20)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        dnn = DNNModel(inputCol="features", outputCol="emb", batchSize=16)
+        dnn.set_model(toy_mlp())
+        fused = fused_of(PipelineModel([asm, dnn]))
+        fused.transform(df)
+        assert any(s == "fused:FastVectorAssembler+DNNModel" for s in seen)
+
+    def test_ingest_stats_surface(self):
+        df = tabular_df(seed=21)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        dnn = DNNModel(inputCol="features", outputCol="emb", batchSize=16)
+        dnn.set_model(toy_mlp())
+        fused = fused_of(PipelineModel([asm, dnn]))
+        assert fused.last_ingest_stats is None
+        fused.transform(df)
+        summary = fused.last_ingest_stats.summary()
+        assert summary["rows"] == df.count()
+        assert summary["bytes"] > 0
+        per_seg = fused.fusion_stats()["per_segment"]
+        assert list(per_seg) == ["FastVectorAssembler+DNNModel"]
+
+    def test_fused_model_not_registered_and_saves_plain(self, tmp_path):
+        from mmlspark_tpu.core.pipeline import (PipelineStage,
+                                                registered_stages)
+
+        assert "FusedPipelineModel" not in registered_stages()
+        dnn = DNNModel(inputCol="x", outputCol="emb", batchSize=8)
+        dnn.set_model(toy_mlp())
+        fused = fused_of(PipelineModel([dnn]))
+        path = str(tmp_path / "fused_pm")
+        fused.save(path)
+        loaded = PipelineStage.load(path)
+        assert type(loaded) is PipelineModel  # fusion is not persisted
+        rng = np.random.default_rng(22)
+        rows = np.empty(6, dtype=object)
+        for i in range(6):
+            rows[i] = rng.normal(size=4).astype(np.float32)
+        df = DataFrame.from_dict({"x": rows})
+        assert_bitwise(loaded.transform(df), fused.transform(df))
+
+
+# --------------------------------------------------------------------------
+# serving round trip
+# --------------------------------------------------------------------------
+
+
+class TestServingFused:
+    def test_round_trip_and_stats(self):
+        from mmlspark_tpu.serving.server import serve_pipeline
+
+        dnn = DNNModel(inputCol="x", outputCol="reply", batchSize=8)
+        dnn.set_model(toy_mlp())
+        pm = PipelineModel([dnn])
+        server = serve_pipeline(pm, input_col="x", reply_col="reply",
+                                parse="json", port=0, fused=True)
+        with server:
+            body = json.dumps([0.5, -1.0, 2.0, 0.25]).encode("utf-8")
+            req = urllib.request.Request(server.address, data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                got = np.asarray(json.loads(resp.read()), dtype=np.float32)
+            # oracle: the unfused chain on the same parsed payload
+            x = np.empty(1, dtype=object)
+            x[0] = np.asarray([0.5, -1.0, 2.0, 0.25], dtype=np.float64)
+            ref = pm.transform(DataFrame.from_dict({"x": x})) \
+                .collect()["reply"][0]
+            np.testing.assert_array_equal(ref, got)
+            stats_url = server.address.rstrip("/") + "/_mmlspark/stats"
+            with urllib.request.urlopen(stats_url, timeout=10) as resp:
+                stats = json.loads(resp.read())
+        assert "fusion" in stats
+        assert stats["fusion"]["n_fused_segments"] == 1
+        assert stats["fusion"]["compile_cache"]["hits"] >= 1

@@ -21,7 +21,7 @@ fi
 
 # per-package matrix — keep in sync with ci.yml's `suite:` list
 PACKAGES=(
-  "tests/test_core.py tests/test_stages.py tests/test_featurize_train.py"
+  "tests/test_core.py tests/test_stages.py tests/test_featurize_train.py tests/test_fusion.py"
   "tests/test_gbdt.py tests/test_pallas_hist.py tests/test_benchmarks.py tests/test_lgbm_format.py tests/test_gbdt_sparse.py tests/test_gbdt_categorical.py tests/test_gbdt_native_train.py"
   "tests/test_vw.py tests/test_automl_recommendation.py tests/test_lime.py"
   "tests/test_models.py tests/test_onnx.py tests/test_downloader.py tests/test_native.py tests/test_ingest.py"
