@@ -38,14 +38,24 @@ def main():
     assert np.allclose(preds, expected[:10]), (preds, expected[:10])
     print(f"single-worker: 10 predictions match batch scoring")
 
-    # scaled out: two workers + routing front
+    # async pipelined executor: batch N+1 drains while batch N computes;
+    # replies are bitwise-identical to the sync loop (docs/serving.md)
+    with serve_pipeline(model, input_col="features",
+                        reply_col="prediction", port=0,
+                        async_exec=True, inflight=2) as server:
+        apreds = [query(server.address, X[i].tolist()) for i in range(10)]
+    assert apreds == preds, (apreds, preds)
+    print("async executor: replies identical to the sync loop")
+
+    # scaled out: two workers + routing front (capacity-weighted)
     with serve_pipeline(model, input_col="features",
                         reply_col="prediction", port=0) as w1, \
             serve_pipeline(model, input_col="features",
-                           reply_col="prediction", port=0) as w2, \
+                           reply_col="prediction", port=0,
+                           async_exec=True, replicas=2) as w2, \
             RoutingFront(port=0) as front:
-        register_worker(front.address, w1.address)
-        register_worker(front.address, w2.address)
+        register_worker(front.address, w1.address, capacity=w1.capacity)
+        register_worker(front.address, w2.address, capacity=w2.capacity)
         preds = [query(front.address, X[i].tolist()) for i in range(10)]
         served = w1.requests_served + w2.requests_served
     assert np.allclose(preds, expected[:10])

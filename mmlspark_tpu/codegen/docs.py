@@ -24,6 +24,14 @@ _PACKAGES = [
 ]
 
 
+#: index lines for hand-maintained API pages (non-stage surfaces)
+_EXTRA_INDEX = [
+    "- [serving](serving.md) (hand-maintained; not stage-registry classes): "
+    "`ServingServer`, `serve_pipeline`, `AdaptiveBatchController`, "
+    "`ReplicaSet`, `PipelinedExecutor`, `RoutingFront`",
+]
+
+
 def _import_all() -> None:
     for pkg in _PACKAGES:
         importlib.import_module(pkg)
@@ -91,6 +99,9 @@ def generate_docs(path: str = "docs/api") -> List[str]:
         written.append(fname)
         index.append(f"- [{pkg}]({pkg}.md): " + ", ".join(
             f"`{n}`" for n in names))
+    # hand-maintained pages for surfaces that are not registered stages
+    # (kept out of the reflection walk; listed so the index stays complete)
+    index.extend(_EXTRA_INDEX)
     with open(os.path.join(path, "README.md"), "w") as f:
         f.write("\n".join(index) + "\n")
     written.append(os.path.join(path, "README.md"))

@@ -32,6 +32,7 @@ fallbacks taken.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -303,27 +304,31 @@ class SegmentExecutor:
             except _HostFallback as e:
                 self.fallbacks.append(f"{seg.label}: {e}")
                 out_parts.extend(self._host_partition(part, df.schema))
+        return self._overlay(df, out_parts)
+
+    def _overlay(self, df: DataFrame, out_parts: List[Dict[str, np.ndarray]]
+                 ) -> DataFrame:
+        """Overlay the chained stage schema onto the produced partitions,
+        inferring any column a stage's transform_schema didn't declare."""
         chained = df.schema.copy()
-        for s in seg.stages:
+        for s in self.segment.stages:
             try:
                 chained = s.transform_schema(chained)
             except Exception:
                 pass
-        # overlay the chained types onto the partitions' actual column order,
-        # inferring any column a stage's transform_schema didn't declare
         inferred = DataFrame(out_parts)
         types = {name: chained.types.get(name, inferred.schema.types[name])
                  for name in inferred.schema.names}
         meta = {k: v for k, v in chained.metadata.items() if k in types}
         return DataFrame(out_parts, Schema(types, meta))
 
-    def _run_partition(self, part: Dict[str, np.ndarray], params_dev,
-                       stats) -> Dict[str, np.ndarray]:
-        import jax
-
-        from ..parallel.batching import Batch, next_bucket, pad_batch
-        from ..parallel.ingest import TransferRing
-
+    def _prep_partition(self, part: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Host-side prep for one partition — validity masks, per-stage
+        prepare hooks, dtype/sparse/null gates, dense stacking — everything
+        up to (but excluding) device dispatch. Raises _HostFallback when the
+        fused contract cannot hold; returns the execution state shared by
+        the blocking ring path (``_run_partition``) and the non-blocking
+        submit path (``submit_run``)."""
         seg = self.segment
         ext = seg.external_in_cols
         for c in ext:
@@ -388,52 +393,155 @@ class SegmentExecutor:
                 raise _HostFallback(f"{type(stage).__name__} dtype gate")
 
         readback = seg.readback_plan()
-        collected: Dict[str, List[np.ndarray]] = {k: [] for k, _ in readback}
+        state: Dict[str, Any] = {
+            "part": part, "sub": sub, "ctx": ctx, "valid": valid, "n": n,
+            "n_valid": n_valid, "ext": ext, "readback": readback,
+            "keys": [k for k, _ in readback]}
         if n_valid > 0:
             allow_sparse = all(not d.reject_sparse for d in seg.dfns)
-            dense = {c: _stack_col(sub[c], allow_sparse) for c in ext}
-            batch_size = seg.batch_size()
-            keys = [k for k, _ in readback]
-
-            def batches():
-                for start in range(0, n_valid, batch_size):
-                    stop = min(start + batch_size, n_valid)
-                    m = stop - start
-                    target = batch_size if m == batch_size \
-                        else min(next_bucket(m), batch_size)
-                    arrays = {c: pad_batch(dense[c][start:stop], target)
+            state["dense"] = {c: _stack_col(sub[c], allow_sparse)
                               for c in ext}
-                    mask = np.zeros(target, dtype=bool)
-                    mask[:m] = True
-                    yield Batch(arrays, mask, m)
+        return state
 
-            def put(batch):
-                return jax.device_put(batch.arrays), batch.num_valid
+    def _batches(self, state: Dict[str, Any]):
+        """Padded/bucketed Batch stream over the partition's dense arrays."""
+        from ..parallel.batching import Batch, next_bucket, pad_batch
 
-            def step(staged):
-                x, m = staged
-                sig = tuple((c, tuple(np.shape(x[c])), str(x[c].dtype))
-                            for c in ext)
-                compiled = self.cache.get(
-                    (seg.key, sig), lambda: self._build(params_dev, x, keys))
-                with profiling.annotate(f"fused:{seg.label}"):
-                    return compiled(params_dev, x), m
+        batch_size = self.segment.batch_size()
+        dense, ext = state["dense"], state["ext"]
+        n_valid = state["n_valid"]
+        for start in range(0, n_valid, batch_size):
+            stop = min(start + batch_size, n_valid)
+            m = stop - start
+            target = batch_size if m == batch_size \
+                else min(next_bucket(m), batch_size)
+            arrays = {c: pad_batch(dense[c][start:stop], target)
+                      for c in ext}
+            mask = np.zeros(target, dtype=bool)
+            mask[:m] = True
+            yield Batch(arrays, mask, m)
 
-            def fetch(handle):
-                ys, m = handle
-                return tuple(np.asarray(y)[:m] for y in ys)
+    @staticmethod
+    def _put(batch):
+        import jax
 
-            ring = TransferRing(batches(), put=put, step=step, fetch=fetch,
-                                depth=seg.ring_depth(), stats=stats)
+        return jax.device_put(batch.arrays), batch.num_valid
+
+    def _make_step(self, params_dev, state: Dict[str, Any]):
+        """Dispatch closure: staged batch -> (device outputs, num_valid).
+        Non-blocking (jax dispatch is async); executables come from the
+        shared CompileCache keyed by (segment, shape signature)."""
+        seg, ext, keys = self.segment, state["ext"], state["keys"]
+
+        def step(staged):
+            x, m = staged
+            sig = tuple((c, tuple(np.shape(x[c])), str(x[c].dtype))
+                        for c in ext)
+            compiled = self.cache.get(
+                (seg.key, sig), lambda: self._build(params_dev, x, keys))
+            with profiling.annotate(f"fused:{seg.label}"):
+                return compiled(params_dev, x), m
+
+        return step
+
+    @staticmethod
+    def _fetch(handle):
+        ys, m = handle
+        return tuple(np.asarray(y)[:m] for y in ys)
+
+    def _run_partition(self, part: Dict[str, np.ndarray], params_dev,
+                       stats) -> Dict[str, np.ndarray]:
+        from ..parallel.ingest import TransferRing
+
+        state = self._prep_partition(part)
+        collected: Dict[str, List[np.ndarray]] = {k: []
+                                                  for k in state["keys"]}
+        if state["n_valid"] > 0:
+            ring = TransferRing(self._batches(state), put=self._put,
+                                step=self._make_step(params_dev, state),
+                                fetch=self._fetch,
+                                depth=self.segment.ring_depth(), stats=stats)
             try:
                 for out in ring:
-                    for k, y in zip(keys, out):
+                    for k, y in zip(state["keys"], out):
                         collected[k].append(y)
             except FusionUnsupported as e:
                 raise _HostFallback(str(e))
             finally:
                 ring.close()
+        return self._emit_partition(state, collected)
 
+    def submit_run(self, df: DataFrame, stats):
+        """Non-blocking segment execution: prep + H2D-stage + DISPATCH every
+        partition's batches now, hand the device-resident handles to the
+        returned zero-arg ``resolve()`` which performs readback + finalize
+        (the serving executor runs it on its dedicated readback thread).
+        ``resolve()`` output is bitwise-identical to ``run()``.
+
+        Host-fallback partitions (ragged/sparse/null/dtype violations)
+        execute synchronously at submit time — never a wrong answer."""
+        import jax
+
+        from ..parallel.ingest import timed_stage
+
+        seg = self.segment
+        wall0 = time.perf_counter()
+        params_dev = jax.device_put(tuple(d.params for d in seg.dfns))
+        pendings: List[Tuple[str, Any, Any]] = []
+        for part in df.partitions:
+            try:
+                state = self._prep_partition(dict(part))
+                handles = []
+                if state["n_valid"] > 0:
+                    step = self._make_step(params_dev, state)
+                    for batch in self._batches(state):
+                        staged, timing = timed_stage(self._put, batch)
+                        td = time.perf_counter()
+                        handle = step(staged)
+                        timing.dispatch_s = time.perf_counter() - td
+                        handles.append((handle, timing))
+                pendings.append(("device", state, handles))
+            except _HostFallback as e:
+                self.fallbacks.append(f"{seg.label}: {e}")
+                pendings.append(
+                    ("host", self._host_partition(part, df.schema), None))
+
+        def resolve() -> DataFrame:
+            from ..parallel.ingest import _block_ready
+
+            out_parts: List[Dict[str, np.ndarray]] = []
+            for kind, payload, handles in pendings:
+                if kind == "host":
+                    out_parts.extend(payload)
+                    continue
+                state = payload
+                collected: Dict[str, List[np.ndarray]] = {
+                    k: [] for k in state["keys"]}
+                for handle, timing in handles:
+                    t0 = time.perf_counter()
+                    _block_ready(handle)
+                    t1 = time.perf_counter()
+                    timing.compute_s = t1 - t0
+                    out = self._fetch(handle)
+                    timing.readback_s = time.perf_counter() - t1
+                    stats.record(timing)
+                    for k, y in zip(state["keys"], out):
+                        collected[k].append(y)
+                out_parts.append(self._emit_partition(state, collected))
+            stats.add_wall(time.perf_counter() - wall0)
+            return self._overlay(df, out_parts)
+
+        return resolve
+
+    def _emit_partition(self, state: Dict[str, Any],
+                        collected: Dict[str, List[np.ndarray]]
+                        ) -> Dict[str, np.ndarray]:
+        """Readback arrays -> finalized partition columns (per writer
+        stage, scattered over the validity mask)."""
+        seg = self.segment
+        part, ctx = state["part"], state["ctx"]
+        valid, n, n_valid = state["valid"], state["n"], state["n_valid"]
+        readback = state["readback"]
         full = {k: (np.concatenate(v, axis=0) if v
                     else np.zeros((0,), dtype=np.float32))
                 for k, v in collected.items()}
@@ -546,6 +654,46 @@ class FusedPipelineModel(PipelineModel):
             else:
                 df = node.stage.transform(df)
         return df
+
+    def transform_submit(self, df: DataFrame):
+        """Non-blocking transform: run host stages and all but a TRAILING
+        fused segment now; the trailing segment's batches are H2D-staged and
+        dispatched (device-resident, jax async dispatch) and the returned
+        zero-arg ``resolve()`` performs readback + finalize.
+        ``transform_submit(df)()`` is bitwise-identical to ``transform(df)``
+        — the serving executor uses this split to fulfill replies from its
+        dedicated readback thread while the next batch dispatches."""
+        from ..parallel.ingest import IngestStats
+
+        nodes = self._plan_for(df.schema)
+        self._last_plan = nodes
+        self._seg_stats = {}
+        self._last_fallbacks = []
+        tail = nodes[-1] if nodes and isinstance(nodes[-1], Segment) else None
+        body = nodes[:-1] if tail is not None else nodes
+        for node in body:
+            if isinstance(node, Segment):
+                stats = IngestStats()
+                self._seg_stats[node.label] = stats
+                ex = SegmentExecutor(node, self._cache)
+                df = ex.run(df, stats)
+                self._last_fallbacks.extend(ex.fallbacks)
+            else:
+                df = node.stage.transform(df)
+        if tail is None:
+            out = df
+            return lambda: out
+        stats = IngestStats()
+        self._seg_stats[tail.label] = stats
+        ex = SegmentExecutor(tail, self._cache)
+        resolve = ex.submit_run(df, stats)
+
+        def done() -> DataFrame:
+            out = resolve()
+            self._last_fallbacks.extend(ex.fallbacks)
+            return out
+
+        return done
 
     # -- stats surface (bench + serving /_mmlspark/stats) -----------------
     @property

@@ -133,7 +133,8 @@ def send_with_retries(req: HTTPRequestData, retry_backoffs_ms=(100, 500, 1000),
                       timeout: float = 60.0,
                       sleep_fn: Callable[[float], None] = time.sleep,
                       policy: Optional[faults.RetryPolicy] = None,
-                      deadline: Optional[faults.Deadline] = None
+                      deadline: Optional[faults.Deadline] = None,
+                      send: Optional[Callable] = None
                       ) -> HTTPResponseData:
     """Status-aware retry: retryable codes back off with jitter; 429/503
     honor Retry-After (numeric seconds or HTTP-date), and every honored wait
@@ -142,7 +143,10 @@ def send_with_retries(req: HTTPRequestData, retry_backoffs_ms=(100, 500, 1000),
     ``policy``: a core.faults.RetryPolicy replacing the legacy fixed backoff
     list (seedable jitter, sleep budget). ``deadline``: when set, no sleep or
     socket timeout extends past it; once expired the last response returns
-    as-is instead of retrying into a lost cause.
+    as-is instead of retrying into a lost cause. ``send``: per-attempt
+    transport override (``(req, timeout[, deadline]) -> HTTPResponseData``)
+    so callers can inject an offline transport while keeping the full retry
+    behavior.
     """
     rng = policy.make_rng() if policy is not None else random.Random()
     n_attempts = policy.max_retries if policy is not None \
@@ -152,6 +156,9 @@ def send_with_retries(req: HTTPRequestData, retry_backoffs_ms=(100, 500, 1000),
     def _send():
         # the deadline arg is only threaded through when set: injected test
         # handlers replace send_request with (req, timeout) signatures
+        if send is not None:
+            return send(req, timeout) if deadline is None \
+                else send(req, timeout, deadline)
         if deadline is None:
             return send_request(req, timeout)
         return send_request(req, timeout, deadline)

@@ -216,6 +216,24 @@ def _tree_nbytes(item: Any) -> int:
     return 0
 
 
+def timed_stage(put: Optional[Callable], item: Any) -> Tuple[Any, "BatchTiming"]:
+    """Stage one host batch toward the device with ingest accounting: fires
+    the INGEST_H2D chaos seam, runs ``put`` (the H2D transfer), blocks until
+    the staged arrays are device-resident, and returns (staged, timing) with
+    ``h2d_s`` filled. The single staging primitive shared by TransferRing's
+    producer thread and the serving executor's fused submit path
+    (core/fusion.py ``SegmentExecutor.submit_run``)."""
+    timing = BatchTiming(bytes_in=_tree_nbytes(item), rows=_tree_rows(item))
+    t0 = time.perf_counter()
+    # chaos seam: an injected delay here shows up in h2d_s (slow link), an
+    # injected exception surfaces at the consumer (transfer failure)
+    faults.fire(faults.INGEST_H2D, rows=timing.rows, nbytes=timing.bytes_in)
+    staged = put(item) if put is not None else item
+    _block_ready(staged)
+    timing.h2d_s = time.perf_counter() - t0
+    return staged, timing
+
+
 # ---------------------------------------------------------------------------
 # TransferRing
 # ---------------------------------------------------------------------------
@@ -263,22 +281,9 @@ class TransferRing:
         self._fetch = fetch if fetch is not None else _default_fetch
         self._user_put = put
 
-        def timed_put(item):
-            timing = BatchTiming(bytes_in=_tree_nbytes(item),
-                                 rows=_tree_rows(item))
-            t0 = time.perf_counter()
-            # chaos seam: an injected delay here shows up in h2d_s (slow
-            # link), an injected exception re-raises at the consumer via the
-            # prefetcher (transfer failure mid-stream)
-            faults.fire(faults.INGEST_H2D, rows=timing.rows,
-                        nbytes=timing.bytes_in)
-            staged = put(item) if put is not None else item
-            _block_ready(staged)
-            timing.h2d_s = time.perf_counter() - t0
-            return staged, timing
-
         self._prefetch = DevicePrefetcher(
-            it, put=timed_put, depth=max(1, prefetch or depth))
+            it, put=lambda item: timed_stage(put, item),
+            depth=max(1, prefetch or depth))
 
     def close(self) -> None:
         self._prefetch.close()

@@ -13,7 +13,10 @@ from .routing import RoutingFront, register_worker
 from .port_forwarding import PortForwarder, build_ssh_command
 from .journal import RequestJournal
 from .stages import parse_request, make_reply
+from .executor import (AdaptiveBatchController, PipelinedExecutor, Replica,
+                       ReplicaSet)
 
-__all__ = ["PortForwarder", "RequestJournal", "RoutingFront", "ServingServer",
-           "build_ssh_command", "make_reply", "parse_request",
-           "register_worker", "reply_to", "serve_pipeline"]
+__all__ = ["AdaptiveBatchController", "PipelinedExecutor", "PortForwarder",
+           "Replica", "ReplicaSet", "RequestJournal", "RoutingFront",
+           "ServingServer", "build_ssh_command", "make_reply",
+           "parse_request", "register_worker", "reply_to", "serve_pipeline"]

@@ -1,0 +1,491 @@
+"""Async pipelined serving: overlapped drain/compute/readback executor.
+
+``ServingServer._loop`` is strictly serial — drain -> transform -> fulfill ->
+drain — so the device idles during host drain/journal/fulfill and the host
+idles during compute. This module rebuilds the hot path as a pipelined
+executor (the Orca/continuous-batching shape; cf. TVM's decoupled
+schedule/compute split, arXiv:1802.04799):
+
+    ingress queue --[drain/coalesce/journal]--> submit queue
+                  --[compute: one worker per replica]--> ready queue
+                  --[readback/fulfill thread]--> reply slots
+
+  - The DRAIN stage coalesces batch N+1 while batch N computes. Once the
+    coalescing window closes it keeps absorbing arrivals until an in-flight
+    slot frees (bounded by ``inflight``), so a saturated server forms
+    convoy-merged batches with no idle coalescing sleep — the static
+    ``max_wait_ms`` tax the sync loop pays every cycle.
+  - The COMPUTE stage runs one worker per replica. Transforms that expose a
+    ``submit()`` protocol (fused pipelines — core/fusion.py
+    ``transform_submit``) dispatch without blocking and hand a
+    device-resident pending handle downstream, exploiting JAX async
+    dispatch; plain transforms compute in place (their XLA sections release
+    the GIL, so drain/readback still overlap them).
+  - The READBACK thread resolves pending outputs, fulfills reply slots,
+    feeds the adaptive controller, and commits journal epochs.
+
+Epoch/journal at-least-once semantics, deadline 504 gates, and graceful
+drain are shared with the sync loop (both paths call the same
+``_prepare_batch`` / ``_apply_output`` server helpers), so replies are
+bitwise-identical between the two modes.
+
+``ReplicaSet`` places R copies of the transform round-robin across
+``jax.local_devices()`` — on a multi-chip host each replica computes on its
+own device; on a single-device host replicas still pipeline host-side work.
+``AdaptiveBatchController`` replaces the static coalescing window with a
+self-tuning one that holds queue wait ~= alpha * compute time (the
+``max_wait_sweep`` in BENCH_serving.json shows the static optimum shifts
+with load).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import queue as queue_mod
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["AdaptiveBatchController", "PipelinedExecutor", "Replica",
+           "ReplicaSet"]
+
+
+# ---------------------------------------------------------------------------
+# Adaptive batching controller
+# ---------------------------------------------------------------------------
+
+
+class AdaptiveBatchController:
+    """Self-tuning coalescing window: hold queue_ms ~= alpha * compute_ms.
+
+    The static ``max_wait_ms`` has a load-dependent optimum (the
+    ``max_wait_sweep_resnet18`` in BENCH_serving.json: 0 ms serializes
+    requests behind full computes under load, while any wait at all is pure
+    added latency for a single-stream client). Under the executor's
+    slot-aware drain, BACKPRESSURE already merges convoys while every
+    in-flight slot is busy — the explicit window only delays dispatch when
+    a slot is FREE. So the window's job reduces to: spend at most
+    ``alpha * compute`` of extra latency coalescing co-arrivals, minus the
+    queue wait the load already imposes:
+
+        window = clamp(alpha * compute_ewma - queue_ewma, min, max)
+
+    gated on co-arrival evidence (batch-rows EWMA > 1): a single-stream
+    client never pays a coalescing wait nobody else will join. At
+    saturation queue_ewma ~ compute_ewma, so the window collapses to
+    ``min_wait_ms`` and batching comes entirely from backpressure; under
+    light concurrent load the window opens to merge near-simultaneous
+    arrivals within the latency budget.
+    """
+
+    def __init__(self, alpha: float = 0.5, min_wait_ms: float = 0.0,
+                 max_wait_ms: float = 50.0, init_wait_ms: float = 5.0,
+                 ewma: float = 0.25, solo_rows: float = 1.2):
+        self.alpha = float(alpha)
+        self.min_wait_ms = float(min_wait_ms)
+        self.max_wait_ms = float(max_wait_ms)
+        self.ewma = float(ewma)
+        #: batch-rows EWMA at or below this means "no co-arrivals": the
+        #: window stays at min (waiting coalesces nothing)
+        self.solo_rows = float(solo_rows)
+        self._wait = min(max(float(init_wait_ms), self.min_wait_ms),
+                         self.max_wait_ms)
+        self._compute_ms: Optional[float] = None
+        self._queue_ms: Optional[float] = None
+        self._rows: Optional[float] = None
+        self._depth: float = 0.0
+        self._updates = 0
+        self._lock = threading.Lock()
+
+    def window_ms(self) -> float:
+        with self._lock:
+            return self._wait
+
+    def _ewma(self, prev: Optional[float], x: float) -> float:
+        return x if prev is None else (1 - self.ewma) * prev + self.ewma * x
+
+    def observe(self, compute_s: float, queue_s: float, batch_rows: int,
+                queue_depth: int) -> None:
+        """Feed one completed batch: compute+readback seconds, mean queue
+        wait of its rows, its row count, and the ingress depth left behind."""
+        with self._lock:
+            self._updates += 1
+            self._compute_ms = self._ewma(self._compute_ms, compute_s * 1e3)
+            self._queue_ms = self._ewma(self._queue_ms, queue_s * 1e3)
+            self._rows = self._ewma(self._rows, float(batch_rows))
+            self._depth = self._ewma(self._depth, float(queue_depth))
+            if self._rows <= self.solo_rows:
+                w = self.min_wait_ms
+            else:
+                w = self.alpha * self._compute_ms - self._queue_ms
+            self._wait = min(self.max_wait_ms, max(self.min_wait_ms, w))
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            rnd = lambda v: None if v is None else round(v, 4)  # noqa: E731
+            return {"wait_ms": round(self._wait, 4),
+                    "compute_ewma_ms": rnd(self._compute_ms),
+                    "queue_ewma_ms": rnd(self._queue_ms),
+                    "rows_ewma": rnd(self._rows),
+                    "target_queue_ms": rnd(
+                        None if self._compute_ms is None
+                        else self.alpha * self._compute_ms),
+                    "depth_ewma": round(self._depth, 3),
+                    "alpha": self.alpha, "updates": self._updates}
+
+
+# ---------------------------------------------------------------------------
+# Replicas
+# ---------------------------------------------------------------------------
+
+
+class Replica:
+    """One placed copy of the serving transform (device + counters)."""
+
+    __slots__ = ("index", "device", "transform", "batches", "rows", "busy_s")
+
+    def __init__(self, index: int, device: Any, transform: Callable):
+        self.index = index
+        self.device = device
+        self.transform = transform
+        self.batches = 0
+        self.rows = 0
+        self.busy_s = 0.0
+
+
+class ReplicaSet:
+    """R replicas of the serving transform placed round-robin across local
+    devices (the data-parallel dispatch of Automap, arXiv:2112.02958,
+    applied to whole serving batches).
+
+    ``devices`` defaults to ``jax.local_devices()`` (a single ``None``
+    pseudo-device when jax is unavailable, keeping the executor usable for
+    host-only transforms). ``transform_factory(index, device)`` builds a
+    per-replica transform — per-replica CompileCaches, per-replica model
+    copies; the default shares ``transform`` across replicas (jit dispatch
+    is thread-safe and executables are cached per device).
+    """
+
+    def __init__(self, transform: Optional[Callable] = None, n: int = 1,
+                 devices: Optional[List[Any]] = None,
+                 transform_factory: Optional[Callable] = None):
+        if transform is None and transform_factory is None:
+            raise ValueError("need transform or transform_factory")
+        if devices is None:
+            devices = self._local_devices()
+        if not devices:
+            devices = [None]
+        self.replicas: List[Replica] = []
+        for i in range(max(1, int(n))):
+            dev = devices[i % len(devices)]
+            t = transform_factory(i, dev) if transform_factory is not None \
+                else transform
+            self.replicas.append(Replica(i, dev, t))
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    @staticmethod
+    def _local_devices() -> List[Any]:
+        try:
+            import jax
+
+            return list(jax.local_devices())
+        except Exception:  # noqa: BLE001 — host-only deployment
+            return []
+
+    @staticmethod
+    def _device_ctx(device: Any):
+        if device is None:
+            return contextlib.nullcontext()
+        import sys
+
+        jax = sys.modules.get("jax")
+        dd = getattr(jax, "default_device", None) if jax is not None else None
+        if dd is None:
+            return contextlib.nullcontext()
+        return dd(device)
+
+    def run(self, replica: Replica, df):
+        """Full transform on the replica's device (dispatch + readback)."""
+        with self._device_ctx(replica.device):
+            return replica.transform(df)
+
+    def submit(self, replica: Replica, df):
+        """Non-blocking dispatch when the transform supports the submit
+        protocol: returns a zero-arg resolve() or None (no protocol)."""
+        sub = getattr(replica.transform, "submit", None)
+        if sub is None:
+            return None
+        with self._device_ctx(replica.device):
+            return sub(df)
+
+    def describe(self, wall_s: float) -> List[Dict[str, Any]]:
+        out = []
+        for r in self.replicas:
+            out.append({
+                "replica": r.index,
+                "device": str(r.device) if r.device is not None else None,
+                "batches": r.batches, "rows": r.rows,
+                "busy_s": round(r.busy_s, 6),
+                "utilization": round(r.busy_s / wall_s, 4)
+                if wall_s > 0 else None})
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Pipelined executor
+# ---------------------------------------------------------------------------
+
+
+_SENTINEL = object()
+
+
+class PipelinedExecutor:
+    """Drain/compute/readback pipeline over a ServingServer's ingress queue.
+
+    Bounded by ``inflight`` (number of batches past drain and not yet
+    fulfilled — the explicit in-flight depth knob): the drain thread
+    acquires a slot before journaling/staging a batch, the readback thread
+    releases it after fulfillment, and while the drain thread waits for a
+    slot it keeps absorbing ingress arrivals into the forming batch
+    (continuous batching).
+    """
+
+    def __init__(self, server, replica_set: ReplicaSet,
+                 controller: Optional[AdaptiveBatchController] = None,
+                 inflight: int = 2, timeline_cap: int = 512):
+        self.server = server
+        self.replicas = replica_set
+        self.controller = controller
+        self.inflight = max(1, int(inflight))
+        self._submit_q: "queue_mod.Queue" = queue_mod.Queue()
+        self._ready_q: "queue_mod.Queue" = queue_mod.Queue()
+        self._slots = threading.Semaphore(self.inflight)
+        self._stop = server._stop
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.epochs = 0
+        self._timeline: "deque" = deque(maxlen=timeline_cap)
+        self._busy = {"drain": 0.0, "readback": 0.0}
+        # pipeline-active wall clock: accumulates only while >= 1 batch is in
+        # flight, so overlap_ratio is not diluted by idle-server time
+        self._active = 0
+        self._active_t0 = 0.0
+        self._active_wall = 0.0
+        self.threads: List[threading.Thread] = []
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "PipelinedExecutor":
+        name = self.server.name
+        self.threads = [threading.Thread(target=self._drain_loop, daemon=True,
+                                         name=f"{name}-drain")]
+        for r in self.replicas.replicas:
+            self.threads.append(threading.Thread(
+                target=self._compute_loop, args=(r,), daemon=True,
+                name=f"{name}-compute-{r.index}"))
+        self.threads.append(threading.Thread(
+            target=self._readback_loop, daemon=True, name=f"{name}-readback"))
+        for t in self.threads:
+            t.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Join the pipeline: the server has already set ``_stop`` (and, on
+        graceful drain, waited for in-flight slots to empty). Sentinels
+        flush the stage queues so workers exit after finishing queued work."""
+        self.server._wake.set()
+        for t in self.threads:
+            if t.name.endswith("-drain"):
+                t.join(timeout=timeout)
+        for _ in self.replicas.replicas:
+            self._submit_q.put(_SENTINEL)
+        for t in self.threads:
+            if "-compute-" in t.name:
+                t.join(timeout=timeout)
+        self._ready_q.put(_SENTINEL)
+        for t in self.threads:
+            if t.name.endswith("-readback"):
+                t.join(timeout=timeout)
+
+    # -- bookkeeping -----------------------------------------------------
+    def _mark(self, stage: str, seq: int, t0: float, t1: float,
+              replica: Optional[int] = None) -> None:
+        with self._lock:
+            self._timeline.append({"stage": stage, "seq": seq,
+                                   "t0": t0, "t1": t1, "replica": replica})
+
+    def timeline(self) -> List[Dict[str, Any]]:
+        """Recent (stage, seq, t0, t1, replica) events — overlap forensics."""
+        with self._lock:
+            return list(self._timeline)
+
+    def _enter_pipe(self) -> None:
+        with self._lock:
+            if self._active == 0:
+                self._active_t0 = time.perf_counter()
+            self._active += 1
+
+    def _exit_pipe(self) -> None:
+        with self._lock:
+            self._active -= 1
+            if self._active == 0:
+                self._active_wall += time.perf_counter() - self._active_t0
+
+    # -- stage 1: drain / coalesce / journal -----------------------------
+    def _gather(self, first) -> Optional[list]:
+        """Continuous batching: coalesce a batch AND acquire an in-flight
+        slot, with the two waits merged. While every slot is busy,
+        coalescing is free — the batch keeps absorbing arrivals with no
+        dispatch to delay (this is where convoys merge under load). Once a
+        slot is held, only the adaptive window keeps the batch open, so a
+        free device never idles behind a coalescing sleep (the static
+        ``max_wait_ms`` tax the sync loop pays every cycle). Returns the
+        batch with the slot HELD, or None on stop (slot released)."""
+        srv = self.server
+        batch = [first]
+        window = self.controller.window_ms() \
+            if self.controller is not None else srv.max_wait_ms
+        deadline = time.perf_counter() + window / 1000.0
+        acquired = self._slots.acquire(blocking=False)
+        while len(batch) < srv.max_batch_size:
+            if self._stop.is_set():
+                break
+            now = time.perf_counter()
+            if acquired:
+                remaining = deadline - now
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(srv._queue.get(timeout=remaining))
+                except queue_mod.Empty:
+                    break
+            else:
+                while len(batch) < srv.max_batch_size:
+                    try:
+                        batch.append(srv._queue.get_nowait())
+                    except queue_mod.Empty:
+                        break
+                acquired = self._slots.acquire(timeout=0.002)
+        while not acquired:  # batch full (or stopping): still need the slot
+            if self._stop.is_set():
+                break
+            acquired = self._slots.acquire(timeout=0.002)
+        if self._stop.is_set() and not acquired:
+            for item in batch:  # hard stop: requeue, do not strand
+                srv._queue.put(item)
+            return None
+        return batch
+
+    def _drain_loop(self) -> None:
+        srv = self.server
+        while not self._stop.is_set():
+            first = srv._next_request()
+            if first is None:
+                continue
+            t_c0 = time.perf_counter()
+            batch = self._gather(first)
+            if batch is None:
+                return
+            self._enter_pipe()
+            t_p0 = time.perf_counter()
+            prep = srv._prepare_batch(batch)
+            t_p1 = time.perf_counter()
+            if prep is None:  # every request expired while queued
+                self._slots.release()
+                self._exit_pipe()
+                continue
+            with self._lock:
+                self._seq += 1
+                prep.seq = self._seq
+                self._busy["drain"] += t_p1 - t_p0
+            self._mark("drain", prep.seq, t_c0, t_p1)
+            self._submit_q.put(prep)
+
+    # -- stage 2: compute (one worker per replica) -----------------------
+    def _compute_loop(self, replica: Replica) -> None:
+        srv = self.server
+        while True:
+            prep = self._submit_q.get()
+            if prep is _SENTINEL:
+                return
+            # in-flight deadline gate: a request whose deadline expired while
+            # the batch sat staged gets its 504 NOW, pre-dispatch
+            prep = srv._regate_inflight(prep)
+            if prep is None:
+                self._slots.release()
+                self._exit_pipe()
+                continue
+            t0 = time.perf_counter()
+            pending = out = err = None
+            try:
+                pending = self.replicas.submit(replica, prep.df)
+                if pending is None:
+                    out = self.replicas.run(replica, prep.df)
+            except Exception as e:  # noqa: BLE001 — batch fails, not server
+                err = e
+            t1 = time.perf_counter()
+            with self._lock:
+                replica.busy_s += t1 - t0
+                replica.batches += 1
+                replica.rows += prep.n
+            self._mark("compute", prep.seq, t0, t1, replica.index)
+            self._ready_q.put((prep, pending, out, err, t1 - t0))
+
+    # -- stage 3: readback / fulfill -------------------------------------
+    def _readback_loop(self) -> None:
+        srv = self.server
+        while True:
+            item = self._ready_q.get()
+            if item is _SENTINEL:
+                return
+            prep, pending, out, err, compute_s = item
+            t0 = time.perf_counter()
+            if err is not None:
+                srv._fail_batch(prep.ids, err)
+            else:
+                try:
+                    if pending is not None:
+                        out = pending()
+                    srv._apply_output(prep.ids, out)
+                except Exception as e:  # noqa: BLE001
+                    srv._fail_batch(prep.ids, e)
+            t1 = time.perf_counter()
+            with self._lock:
+                self._busy["readback"] += t1 - t0
+                self.epochs += 1
+            self._mark("readback", prep.seq, t0, t1)
+            self._slots.release()
+            self._exit_pipe()
+            if self.controller is not None:
+                self.controller.observe(compute_s + (t1 - t0), prep.queue_s,
+                                        prep.n, srv._queue.qsize())
+            srv._maybe_commit_epochs()
+
+    # -- stats surface (/_mmlspark/stats "async" section) ----------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            wall = self._active_wall
+            if self._active > 0:
+                wall += time.perf_counter() - self._active_t0
+            drain_s = self._busy["drain"]
+            readback_s = self._busy["readback"]
+            epochs = self.epochs
+        compute_s = sum(r.busy_s for r in self.replicas.replicas)
+        serial = drain_s + compute_s + readback_s
+        return {
+            "mode": "pipelined",
+            "inflight": self.inflight,
+            "epochs": epochs,
+            "replicas": self.replicas.describe(wall),
+            "controller": self.controller.state()
+            if self.controller is not None else None,
+            "busy_s": {"drain": round(drain_s, 6),
+                       "compute": round(compute_s, 6),
+                       "readback": round(readback_s, 6)},
+            "active_wall_s": round(wall, 6),
+            # > 1.0 means stages genuinely overlapped (stage-busy seconds
+            # exceed the wall time the pipeline was occupied); 1.0 = serial
+            "overlap_ratio": round(serial / wall, 4) if wall > 0 else None,
+        }

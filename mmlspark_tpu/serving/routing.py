@@ -92,6 +92,7 @@ class RoutingFront:
         self._probe_rng = self.probe_policy.make_rng()
         self._workers: List[str] = []
         self._circuits: Dict[str, _WorkerCircuit] = {}
+        self._capacity: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._rr = itertools.count()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -99,17 +100,22 @@ class RoutingFront:
         self._probe_thread: Optional[threading.Thread] = None
 
     # -- worker management ------------------------------------------------
-    def register(self, address: str) -> None:
+    def register(self, address: str, capacity: int = 1) -> None:
+        """``capacity`` is the worker's concurrent-batch hint (its replica
+        count under the async executor — ServingServer.capacity): weighted
+        round-robin sends a worker with R replicas R slots per cycle."""
         with self._lock:
             if address not in self._workers:
                 self._workers.append(address)
             self._circuits[address] = _WorkerCircuit()
+            self._capacity[address] = max(1, int(capacity))
 
     def deregister(self, address: str) -> None:
         with self._lock:
             if address in self._workers:
                 self._workers.remove(address)
             self._circuits.pop(address, None)
+            self._capacity.pop(address, None)
 
     @property
     def workers(self) -> List[str]:
@@ -123,14 +129,32 @@ class RoutingFront:
         with self._lock:
             return {w: self._circuits[w].state for w in self._workers}
 
-    def _pick_order(self) -> List[str]:
+    @property
+    def worker_capacities(self) -> Dict[str, int]:
         with self._lock:
-            ws = [w for w in self._workers
-                  if self._circuits[w].state != OPEN]
+            return {w: self._capacity.get(w, 1) for w in self._workers}
+
+    def _pick_order(self) -> List[str]:
+        """Capacity-weighted round-robin: a worker with capacity R (R
+        replicas) occupies R slots in the rotation, so traffic matches the
+        cluster's real concurrent-batch capacity. The returned order is
+        deduplicated — retries still walk DISTINCT workers."""
+        with self._lock:
+            ws: List[str] = []
+            for w in self._workers:
+                if self._circuits[w].state != OPEN:
+                    ws.extend([w] * self._capacity.get(w, 1))
         if not ws:
             return []
         start = next(self._rr) % len(ws)
-        return ws[start:] + ws[:start]
+        rotated = ws[start:] + ws[:start]
+        seen = set()
+        order = []
+        for w in rotated:
+            if w not in seen:
+                seen.add(w)
+                order.append(w)
+        return order
 
     def _note_failure(self, address: str) -> None:
         with self._lock:
@@ -224,7 +248,9 @@ class RoutingFront:
                         self._respond(403, b'{"error": "bad cluster token"}')
                         return
                     try:
-                        front.register(json.loads(body.decode())["address"])
+                        msg = json.loads(body.decode())
+                        front.register(msg["address"],
+                                       capacity=int(msg.get("capacity", 1)))
                         self._respond(200, b"{}")
                     except Exception as e:  # noqa: BLE001
                         self._respond(400, json.dumps(
@@ -233,7 +259,8 @@ class RoutingFront:
                 if path == RoutingFront.WORKERS_PATH:
                     self._respond(200, json.dumps(
                         {"workers": front.workers,
-                         "states": front.worker_states}).encode())
+                         "states": front.worker_states,
+                         "capacity": front.worker_capacities}).encode())
                     return
                 # deadline gate: an expired request is dropped HERE, before
                 # any forward burns a worker slot
@@ -345,10 +372,15 @@ class RoutingFront:
 
 
 def register_worker(front_address: str, worker_address: str,
-                    timeout: float = 10.0, token: Optional[str] = None) -> None:
-    """Worker-side registration call (ServiceInfo POST parity)."""
+                    timeout: float = 10.0, token: Optional[str] = None,
+                    capacity: int = 1) -> None:
+    """Worker-side registration call (ServiceInfo POST parity).
+
+    ``capacity``: concurrent-batch hint for weighted routing — pass the
+    worker's ``ServingServer.capacity`` (replica count under async_exec)."""
     from .server import _post_json
 
     parts = urlsplit(front_address)
     url = f"{parts.scheme}://{parts.netloc}{RoutingFront.REGISTER_PATH}"
-    _post_json(url, {"address": worker_address}, timeout=timeout, token=token)
+    _post_json(url, {"address": worker_address, "capacity": int(capacity)},
+               timeout=timeout, token=token)

@@ -14,6 +14,17 @@ Reference mapping (SURVEY §3.4, HTTPSourceV2.scala):
 
 The batching loop keeps the pipeline's jitted stages warm: after the first
 batch, steady-state latency is queue wait + one compiled forward.
+
+Two execution modes share the same ingress, journal, deadline-gate, and
+reply machinery (so replies are bitwise-identical between them):
+
+  - ``async_exec=False`` (default): the serial ``_loop`` above — drain ->
+    transform -> fulfill -> drain.
+  - ``async_exec=True``: the pipelined executor (serving/executor.py) —
+    batch N+1 drains/journals/stages while batch N computes, ``replicas``
+    copies dispatch round-robin across local devices, a dedicated readback
+    thread fulfills reply slots, and the coalescing window self-tunes
+    (``adaptive_batching``).
 """
 
 from __future__ import annotations
@@ -36,19 +47,39 @@ TOKEN_HEADER = "X-MMLSpark-Token"
 
 
 def _post_json(url: str, payload: dict, timeout: float = 10.0,
-               token: Optional[str] = None) -> None:
-    """POST a JSON payload; any 2xx is success, errors raise (HTTPError for
-    >=400 via urlopen, RuntimeError for odd non-2xx successes)."""
-    from urllib.request import Request, urlopen
+               token: Optional[str] = None,
+               policy: Optional["RetryPolicy"] = None,
+               transport: Optional[Callable] = None) -> None:
+    """POST a JSON payload through the shared retry stack
+    (``io.http.send_with_retries`` + ``core.faults.RetryPolicy``) like every
+    other network path: transient transport failures and retryable statuses
+    back off and retry; a definitive error raises ``HTTPError`` (the legacy
+    urlopen contract callers rely on) and an exhausted connection failure
+    raises ``URLError``. ``transport`` overrides the per-attempt send
+    (``(req, timeout[, deadline]) -> HTTPResponseData``) so tests stay
+    offline while still exercising the retry loop."""
+    import io as io_mod
+    from urllib.error import HTTPError, URLError
+
+    from ..core.faults import RetryPolicy
+    from ..io.http import HTTPRequestData, send_with_retries
 
     headers = {"Content-Type": "application/json"}
     if token is not None:
         headers[TOKEN_HEADER] = token
-    req = Request(url, data=json.dumps(payload).encode("utf-8"),
-                  method="POST", headers=headers)
-    with urlopen(req, timeout=timeout) as resp:
-        if not 200 <= resp.status < 300:
-            raise RuntimeError(f"POST {url} failed: {resp.status}")
+    req = HTTPRequestData(url=url, method="POST", headers=headers,
+                          entity=json.dumps(payload).encode("utf-8"))
+    if policy is None:
+        # the reply hop is latency-sensitive: short backoffs, bounded budget
+        policy = RetryPolicy(max_retries=3, base_s=0.05, budget_s=5.0)
+    resp = send_with_retries(req, timeout=timeout, policy=policy,
+                             send=transport)
+    if resp.statusCode == 0:
+        raise URLError(resp.statusLine or f"POST {url} failed")
+    if not 200 <= resp.statusCode < 300:
+        raise HTTPError(url, resp.statusCode, resp.statusLine or "error",
+                        resp.headers or {},
+                        io_mod.BytesIO(resp.entity or b""))
 
 
 class _ReplySlot:
@@ -84,6 +115,11 @@ class LatencyStats:
         self._lock = threading.Lock()
         self._cap = cap
         self._rows: List[tuple] = []  # (queue_s, compute_s, total_s, batch)
+        # load-shed visibility: (status, reason) -> count, so the adaptive
+        # controller's effect on shed rate is observable next to the
+        # latency percentiles (503 = admission/drain sheds, 504 = deadline
+        # gates and slot timeouts)
+        self._shed: Dict[tuple, int] = {}
 
     def record(self, queue_s: float, compute_s: float, total_s: float,
                batch: int) -> None:
@@ -92,11 +128,30 @@ class LatencyStats:
                 del self._rows[: self._cap // 4]
             self._rows.append((queue_s, compute_s, total_s, batch))
 
+    def record_shed(self, status: int, reason: str) -> None:
+        """Count one load-shed/drop: status is the HTTP code returned
+        (503/504), reason a short slug (queue_full, draining,
+        deadline_ingress, deadline_queue, deadline_inflight, slot_timeout)."""
+        with self._lock:
+            key = (int(status), str(reason))
+            self._shed[key] = self._shed.get(key, 0) + 1
+
+    def shed_summary(self) -> Dict[str, Any]:
+        with self._lock:
+            shed = dict(self._shed)
+        by_status: Dict[str, int] = {}
+        by_reason: Dict[str, int] = {}
+        for (status, reason), n in shed.items():
+            by_status[str(status)] = by_status.get(str(status), 0) + n
+            by_reason[reason] = by_reason.get(reason, 0) + n
+        return {"total": sum(shed.values()), "by_status": by_status,
+                "by_reason": by_reason}
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             rows = list(self._rows)
         if not rows:
-            return {"n": 0}
+            return {"n": 0, "shed": self.shed_summary()}
         arr = np.asarray(rows)
         q, c, t = arr[:, 0] * 1e3, arr[:, 1] * 1e3, arr[:, 2] * 1e3
         o = t - q - c
@@ -109,7 +164,24 @@ class LatencyStats:
         return {"n": len(rows),
                 "queue_ms": pct(q), "compute_ms": pct(c),
                 "overhead_ms": pct(o), "total_ms": pct(t),
-                "mean_batch": round(float(np.mean(arr[:, 3])), 2)}
+                "mean_batch": round(float(np.mean(arr[:, 3])), 2),
+                "shed": self.shed_summary()}
+
+
+class _Prepared:
+    """One drained batch, deadline-gated, stamped, and journaled — the unit
+    that flows through the sync loop and the async executor's stages."""
+
+    __slots__ = ("rows", "ids", "df", "epoch", "queue_s", "n", "seq")
+
+    def __init__(self, rows, ids, df, epoch, queue_s):
+        self.rows = rows        # [(rid, body, headers), ...]
+        self.ids = ids          # np.int64 array
+        self.df = df            # ingress DataFrame (id/value/headers/origin)
+        self.epoch = epoch      # journal epoch (None when journaling is off)
+        self.queue_s = queue_s  # mean ingress->drain wait of the batch
+        self.n = len(rows)
+        self.seq = 0            # executor pipeline sequence number
 
 
 class ServingServer:
@@ -145,7 +217,10 @@ class ServingServer:
                  name: str = "serving",
                  ingest_stats: Optional[Callable[[], Optional[dict]]] = None,
                  fusion_stats: Optional[Callable[[], Optional[dict]]] = None,
-                 max_queue: int = 0, drain_timeout_s: float = 5.0):
+                 max_queue: int = 0, drain_timeout_s: float = 5.0,
+                 async_exec: bool = False, inflight: int = 2,
+                 replicas: int = 1, adaptive_batching: bool = True,
+                 devices: Optional[list] = None, controller=None):
         self.transform = transform
         # optional provider of the device-ingest decomposition (queue/h2d/
         # compute/readback — parallel/ingest.IngestStats.summary) merged into
@@ -181,7 +256,20 @@ class ServingServer:
             from .journal import RequestJournal
 
             self._journal = RequestJournal(journal_path)
+        # async pipelined executor knobs (serving/executor.py): when
+        # async_exec is set, start() runs the drain/compute/readback pipeline
+        # instead of the serial loop — same batch semantics, same replies
+        self.async_exec = bool(async_exec)
+        self.inflight = max(1, int(inflight))
+        self.replicas = max(1, int(replicas))
+        self.adaptive_batching = bool(adaptive_batching)
+        self._devices = devices
+        self._controller = controller
+        self._executor = None
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
+        # wake latch: set on every enqueue and on stop(), so the batcher's
+        # first-request wait is event-driven instead of a 0.2s poll
+        self._wake = threading.Event()
         self._slots: Dict[int, _ReplySlot] = {}
         # random start: ids are routing handles that ride to peer workers, so
         # don't make them guessable from zero (defense alongside `token`)
@@ -236,6 +324,11 @@ class ServingServer:
                     # decomposes into the ingest stages (queue/h2d/compute/
                     # readback per batch)
                     summary = server.stats.summary()
+                    if server._executor is not None:
+                        try:
+                            summary["async"] = server._executor.stats()
+                        except Exception as e:  # noqa: BLE001
+                            summary["async"] = {"error": str(e)}
                     if server.ingest_stats is not None:
                         try:
                             summary["ingest"] = server.ingest_stats()
@@ -259,6 +352,7 @@ class ServingServer:
                 # -- admission control (hardened serving path) -------------
                 if server._draining.is_set():
                     # graceful drain: stop accepting, finish what's in flight
+                    server.stats.record_shed(503, "draining")
                     body = b'{"error": "server draining"}'
                     self.send_response(503)
                     self.send_header("Content-Type", "application/json")
@@ -270,6 +364,7 @@ class ServingServer:
                 dl = deadline_from_headers(self.headers)
                 if dl is not None and dl.expired():
                     # already dead on arrival: never burns a batch slot
+                    server.stats.record_shed(504, "deadline_ingress")
                     body = b'{"error": "deadline expired"}'
                     self.send_response(504)
                     self.send_header("Content-Type", "application/json")
@@ -279,6 +374,7 @@ class ServingServer:
                     return
                 if server.max_queue and \
                         server._queue.qsize() >= server.max_queue:
+                    server.stats.record_shed(503, "queue_full")
                     body = b'{"error": "admission queue full"}'
                     self.send_response(503)
                     self.send_header("Content-Type", "application/json")
@@ -294,10 +390,12 @@ class ServingServer:
                     server._next_id += 1
                     server._slots[rid] = slot
                 server._queue.put((rid, body, dict(self.headers.items())))
+                server._wake.set()
                 ok = slot.event.wait(timeout=server.slot_timeout_s)
                 with server._id_lock:
                     server._slots.pop(rid, None)
                 if not ok:
+                    server.stats.record_shed(504, "slot_timeout")
                     self.send_error(504, "batch timeout")
                     return
                 self.send_response(slot.status)
@@ -320,15 +418,30 @@ class ServingServer:
         return Handler
 
     # -- batching loop (the continuous query) ----------------------------
-    def _drain_batch(self):
-        """Block for the first request, then gather up to max_batch_size within
-        max_wait_ms (DynamicBatcher semantics, stages/Batchers.scala)."""
-        try:
-            first = self._queue.get(timeout=0.2)
-        except queue_mod.Empty:
-            return None
+    def _next_request(self):
+        """Stop-aware wait for the first queued request: wakes immediately
+        on a new arrival or on stop() via the ``_wake`` latch (the old fixed
+        0.2s poll burned 5 idle wakeups/sec and held shutdown up to 200ms).
+        Returns None when stopping."""
+        while True:
+            try:
+                return self._queue.get_nowait()
+            except queue_mod.Empty:
+                pass
+            if self._stop.is_set():
+                return None
+            self._wake.clear()
+            # re-check after clear: an enqueue between get_nowait and clear
+            # would otherwise be a lost wakeup
+            if not self._queue.empty():
+                continue
+            self._wake.wait(timeout=1.0)  # timeout = lost-wakeup safety net
+
+    def _coalesce(self, first, max_wait_ms: float):
+        """Gather up to max_batch_size requests within ``max_wait_ms`` after
+        ``first`` (DynamicBatcher semantics, stages/Batchers.scala)."""
         batch = [first]
-        deadline = time.perf_counter() + self.max_wait_ms / 1000.0
+        deadline = time.perf_counter() + max_wait_ms / 1000.0
         while len(batch) < self.max_batch_size:
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
@@ -339,84 +452,146 @@ class ServingServer:
                 break
         return batch
 
+    def _drain_batch(self, max_wait_ms: Optional[float] = None):
+        """Block for the first request, then gather up to max_batch_size
+        within the coalescing window (``max_wait_ms`` overrides the static
+        knob — the async executor passes the adaptive controller's window)."""
+        first = self._next_request()
+        if first is None:
+            return None
+        return self._coalesce(
+            first, self.max_wait_ms if max_wait_ms is None else max_wait_ms)
+
+    def _gate_deadlines(self, batch, stage: str):
+        """Answer 504 for requests whose deadline expired while queued or
+        staged (pre-journal for the queue gate, pre-dispatch for the
+        in-flight gate) so a backed-up server never spends compute on
+        replies nobody is waiting for. Returns the live rows."""
+        live = []
+        for rid, body, hdrs in batch:
+            dl = deadline_from_headers(hdrs)
+            if dl is not None and dl.expired():
+                self.stats.record_shed(504, f"deadline_{stage}")
+                self._fulfill(
+                    rid, 504,
+                    b'{"error": "deadline expired in %s"}' %
+                    (b"queue" if stage == "queue" else b"flight"),
+                    content_type="application/json")
+            else:
+                live.append((rid, body, hdrs))
+        return live
+
+    def _build_df(self, batch):
+        """Ingress rows -> (ids array, transform input DataFrame)."""
+        ids = np.array([b[0] for b in batch], dtype=np.int64)
+        bodies = np.empty(len(batch), dtype=object)
+        headers = np.empty(len(batch), dtype=object)
+        for i, (_, body, hdrs) in enumerate(batch):
+            bodies[i] = body
+            headers[i] = hdrs
+        origin = np.empty(len(batch), dtype=object)
+        origin[:] = self.address
+        df = DataFrame([{"id": ids, "value": bodies, "headers": headers,
+                         "origin": origin}])
+        return ids, df
+
+    def _prepare_batch(self, batch) -> Optional[_Prepared]:
+        """Deadline-gate, stamp, journal, and build the transform input for
+        one drained batch — shared by the sync loop and the async executor
+        so both modes have identical epoch/journal/gate semantics. Returns
+        None when every request expired while queued."""
+        batch = self._gate_deadlines(batch, "queue")
+        if not batch:
+            return None
+        t_drain = time.perf_counter()
+        waits = []
+        with self._id_lock:
+            for rid, _, _ in batch:
+                s = self._slots.get(rid)
+                if s is not None:
+                    s.t_drain = t_drain
+                    s.batch = len(batch)
+                    waits.append(t_drain - s.t_in)
+        ids, df = self._build_df(batch)
+        epoch = None
+        if self._journal is not None:
+            with self._journal_lock:
+                self._epoch += 1
+                epoch = self._epoch
+                self._epoch_rids[epoch] = {int(r) for r in ids}
+            try:
+                self._journal.append_many(epoch, batch)
+            except Exception:  # noqa: BLE001 — serve degraded, not dead
+                # a journal WRITE failure must not take serving down: the
+                # batch is still answered below, so the only loss window is
+                # a crash mid-transform of this one epoch
+                pass
+        queue_s = float(sum(waits) / len(waits)) if waits else 0.0
+        return _Prepared(batch, ids, df, epoch, queue_s)
+
+    def _regate_inflight(self, prep: _Prepared) -> Optional[_Prepared]:
+        """Re-run the deadline gate on a staged batch just before dispatch
+        (async executor: a request can expire while its batch waits in the
+        submit queue). Returns the surviving _Prepared or None."""
+        live = self._gate_deadlines(prep.rows, "inflight")
+        if len(live) == len(prep.rows):
+            return prep
+        if not live:
+            return None
+        ids, df = self._build_df(live)
+        out = _Prepared(live, ids, df, prep.epoch, prep.queue_s)
+        out.seq = prep.seq
+        return out
+
+    def _apply_output(self, ids, out) -> None:
+        """Fulfill reply slots from a transform output DataFrame (errors
+        degrade to 500s for the whole batch, never kill the loop)."""
+        try:
+            data = out.collect()
+            has_rows = any(len(v) for v in data.values())
+            if "id" in data and self.reply_col in data:
+                out_ids, replies = data["id"], data[self.reply_col]
+            elif not has_rows:
+                # empty output => nothing answered locally (handoff)
+                out_ids, replies = (), ()
+            else:
+                # rows but no id/reply column: a misconfigured transform,
+                # not a handoff — fail fast instead of letting every
+                # client hang to the slot timeout
+                raise KeyError(
+                    f"transform output has rows but no 'id' + "
+                    f"'{self.reply_col}' columns (got {list(data)})")
+            for rid, reply in zip(out_ids, replies):
+                if reply is None:
+                    self._fulfill(int(rid), 204, b"")
+                else:
+                    self._fulfill(int(rid), 200, reply)
+            # rows ABSENT from the output stay pending: another worker may
+            # answer them via the internal replyTo endpoint; otherwise the
+            # slot times out with 504 (HTTPSourceV2 leaves unanswered
+            # requests to the epoch timeout the same way)
+        except Exception as e:  # noqa: BLE001 — failed batch -> 500s
+            self._fail_batch(ids, e)
+
+    def _fail_batch(self, ids, e: BaseException) -> None:
+        for rid in ids:
+            self._fulfill(int(rid), 500, json.dumps(
+                {"error": str(e)}).encode("utf-8"))
+
     def _loop(self):
         while not self._stop.is_set():
             batch = self._drain_batch()
             if not batch:
                 continue
-            # deadline gate: requests whose deadline expired while queued are
-            # answered 504 HERE — pre-journal, pre-transform — so a backed-up
-            # server never spends compute on replies nobody is waiting for
-            live = []
-            for rid, body, hdrs in batch:
-                dl = deadline_from_headers(hdrs)
-                if dl is not None and dl.expired():
-                    self._fulfill(rid, 504,
-                                  b'{"error": "deadline expired in queue"}',
-                                  content_type="application/json")
-                else:
-                    live.append((rid, body, hdrs))
-            batch = live
-            if not batch:
+            prep = self._prepare_batch(batch)
+            if prep is None:
                 continue
-            t_drain = time.perf_counter()
-            with self._id_lock:
-                for rid, _, _ in batch:
-                    s = self._slots.get(rid)
-                    if s is not None:
-                        s.t_drain = t_drain
-                        s.batch = len(batch)
-            ids = np.array([b[0] for b in batch], dtype=np.int64)
-            bodies = np.empty(len(batch), dtype=object)
-            headers = np.empty(len(batch), dtype=object)
-            for i, (_, body, hdrs) in enumerate(batch):
-                bodies[i] = body
-                headers[i] = hdrs
-            origin = np.empty(len(batch), dtype=object)
-            origin[:] = self.address
-            if self._journal is not None:
-                with self._journal_lock:
-                    self._epoch += 1
-                    epoch = self._epoch
-                    self._epoch_rids[epoch] = {int(r) for r in ids}
-                try:
-                    self._journal.append_many(epoch, batch)
-                except Exception:  # noqa: BLE001 — serve degraded, not dead
-                    # a journal WRITE failure must not take serving down: the
-                    # batch is answered synchronously below, so the only loss
-                    # window is a crash mid-transform of this one epoch
-                    pass
-            df = DataFrame([{"id": ids, "value": bodies, "headers": headers,
-                             "origin": origin}])
             try:
-                out = self.transform(df)
-                data = out.collect()
-                has_rows = any(len(v) for v in data.values())
-                if "id" in data and self.reply_col in data:
-                    out_ids, replies = data["id"], data[self.reply_col]
-                elif not has_rows:
-                    # empty output => nothing answered locally (handoff)
-                    out_ids, replies = (), ()
-                else:
-                    # rows but no id/reply column: a misconfigured transform,
-                    # not a handoff — fail fast instead of letting every
-                    # client hang to the slot timeout
-                    raise KeyError(
-                        f"transform output has rows but no 'id' + "
-                        f"'{self.reply_col}' columns (got {list(data)})")
-                for rid, reply in zip(out_ids, replies):
-                    if reply is None:
-                        self._fulfill(int(rid), 204, b"")
-                    else:
-                        self._fulfill(int(rid), 200, reply)
-                # rows ABSENT from the output stay pending: another worker may
-                # answer them via the internal replyTo endpoint; otherwise the
-                # slot times out with 504 (HTTPSourceV2 leaves unanswered
-                # requests to the epoch timeout the same way)
-            except Exception as e:  # failed batch -> 500s, keep serving
-                for rid in ids:
-                    self._fulfill(int(rid), 500, json.dumps(
-                        {"error": str(e)}).encode("utf-8"))
+                out = self.transform(prep.df)
+            except Exception as e:  # noqa: BLE001 — keep serving
+                self._fail_batch(prep.ids, e)
+            else:
+                self._apply_output(prep.ids, out)
             self._maybe_commit_epochs()
 
     def _maybe_commit_epochs(self, force: bool = False) -> None:
@@ -515,18 +690,42 @@ class ServingServer:
                     "at this size will pay compile", size, exc_info=True)
         return self
 
+    @property
+    def capacity(self) -> int:
+        """Concurrent-batch capacity hint for the RoutingFront: the number
+        of whole batches this worker can have in flight at once."""
+        return self.replicas if self.async_exec else 1
+
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingServer":
         self._httpd = ThreadingHTTPServer((self.host, self.port),
                                           self._make_handler())
         self.port = self._httpd.server_address[1]  # resolve port 0
-        t_http = threading.Thread(target=self._httpd.serve_forever, daemon=True,
-                                  name=f"{self.name}-http")
-        t_loop = threading.Thread(target=self._loop, daemon=True,
-                                  name=f"{self.name}-batcher")
+        t_http = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            daemon=True, name=f"{self.name}-http")
         t_http.start()
-        t_loop.start()
-        self._threads = [t_http, t_loop]
+        self._threads = [t_http]
+        if self.async_exec:
+            from .executor import (AdaptiveBatchController, PipelinedExecutor,
+                                   ReplicaSet)
+
+            ctrl = self._controller
+            if ctrl is None and self.adaptive_batching:
+                ctrl = AdaptiveBatchController(
+                    init_wait_ms=self.max_wait_ms,
+                    max_wait_ms=max(self.max_wait_ms * 4, 50.0))
+            self._executor = PipelinedExecutor(
+                self, ReplicaSet(self.transform, n=self.replicas,
+                                 devices=self._devices),
+                controller=ctrl, inflight=self.inflight)
+            self._executor.start()
+            self._threads.extend(self._executor.threads)
+        else:
+            t_loop = threading.Thread(target=self._loop, daemon=True,
+                                      name=f"{self.name}-batcher")
+            t_loop.start()
+            self._threads.append(t_loop)
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -544,11 +743,14 @@ class ServingServer:
                     break
                 time.sleep(0.01)
         self._stop.set()
+        self._wake.set()  # release a batcher blocked on the first-get wait
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
-        # join the batcher before closing the journal: an in-flight batch
-        # must finish its append/commit on an open file
+        # join the batcher/pipeline before closing the journal: an in-flight
+        # batch must finish its append/commit on an open file
+        if self._executor is not None:
+            self._executor.stop()
         for t in self._threads:
             if t.name.endswith("-batcher"):
                 t.join(timeout=5)
@@ -573,14 +775,20 @@ class ServingServer:
 
 
 def reply_to(origin_address: str, rid: int, reply: Any, status: int = 200,
-             timeout: float = 10.0, token: Optional[str] = None) -> None:
+             timeout: float = 10.0, token: Optional[str] = None,
+             policy: Optional["RetryPolicy"] = None,
+             transport: Optional[Callable] = None) -> None:
     """Answer a request pending on another worker (sendReplyUDF/replyTo parity,
     ServingUDFs.scala:36-48): POST the reply to ``origin``'s internal handler,
-    which responds on the cached exchange.
+    which responds on the cached exchange. The hop rides the shared retry
+    stack (``send_with_retries`` + ``RetryPolicy``) — transient network
+    failures back off and retry instead of dropping the reply.
 
     ``origin_address``: the ``origin`` column value the request carried
     (http://host:port/api); the internal endpoint lives on the same server.
     ``token``: the cluster secret, when the origin server was started with one.
+    ``policy``/``transport``: retry policy override and injectable
+    per-attempt send (tests stay offline).
     """
     import base64
     from urllib.parse import urlsplit
@@ -597,7 +805,8 @@ def reply_to(origin_address: str, rid: int, reply: Any, status: int = 200,
     _post_json(url, {"id": int(rid), "status": int(status),
                      "content_type": ctype,
                      "body_b64": base64.b64encode(body).decode("ascii")},
-               timeout=timeout, token=token)
+               timeout=timeout, token=token, policy=policy,
+               transport=transport)
 
 
 def _json_default(o):
@@ -615,7 +824,10 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    api_path: str = "/", max_batch_size: int = 64,
                    max_wait_ms: float = 5.0, token: Optional[str] = None,
                    journal_path: Optional[str] = None,
-                   max_queue: int = 0, fused: bool = False) -> ServingServer:
+                   max_queue: int = 0, fused: bool = False,
+                   async_exec: bool = False, inflight: int = 2,
+                   replicas: int = 1,
+                   adaptive_batching: bool = True) -> ServingServer:
     """Serve a fitted Transformer: request body -> ``input_col`` -> stage ->
     ``reply_col`` (IOImplicits fluent sugar parity, io/IOImplicits.scala:182-213).
 
@@ -626,6 +838,14 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
     batch loop then executes the fused executables, and
     ``/_mmlspark/stats`` reports the segment layout, compile-cache hit
     rate, and per-segment compute alongside the ingest decomposition.
+
+    ``async_exec=True`` serves through the pipelined executor
+    (serving/executor.py): batch N+1 drains/journals while batch N computes
+    (``inflight`` bounds staged-but-unfulfilled batches), ``replicas``
+    copies of the pipeline dispatch round-robin across local devices, and
+    the coalescing window self-tunes (``adaptive_batching``). With
+    ``fused=True`` the executor additionally splits dispatch from readback
+    via the fused pipeline's non-blocking ``transform_submit``.
     """
     from ..core.pipeline import PipelineModel
     from .stages import parse_request
@@ -633,9 +853,7 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
     if fused and isinstance(stage, PipelineModel):
         stage = stage.fuse()
 
-    def transform(df: DataFrame) -> DataFrame:
-        parsed = parse_request(df, input_col, parse=parse)
-        out = stage.transform(parsed)
+    def _map_reply(out: DataFrame) -> DataFrame:
         if reply_col not in out.schema:
             for pname in ("outputCol", "predictionCol"):
                 if stage.has_param(pname) and stage.get(pname) in out.schema:
@@ -643,6 +861,20 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                                           lambda p, _c=stage.get(pname): p[_c])
                     break
         return out
+
+    def transform(df: DataFrame) -> DataFrame:
+        parsed = parse_request(df, input_col, parse=parse)
+        return _map_reply(stage.transform(parsed))
+
+    if hasattr(stage, "transform_submit"):
+        # submit protocol: dispatch without readback, hand the pending
+        # device-resident result to the executor's readback thread
+        def _submit(df: DataFrame):
+            parsed = parse_request(df, input_col, parse=parse)
+            pend = stage.transform_submit(parsed)
+            return lambda: _map_reply(pend())
+
+        transform.submit = _submit
 
     ingest = None
     if hasattr(stage, "last_ingest_stats"):
@@ -658,4 +890,7 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                          reply_col=reply_col, max_batch_size=max_batch_size,
                          max_wait_ms=max_wait_ms, token=token,
                          journal_path=journal_path, ingest_stats=ingest,
-                         fusion_stats=fusion, max_queue=max_queue)
+                         fusion_stats=fusion, max_queue=max_queue,
+                         async_exec=async_exec, inflight=inflight,
+                         replicas=replicas,
+                         adaptive_batching=adaptive_batching)

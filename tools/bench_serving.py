@@ -12,6 +12,12 @@ wakeup + HTTP write = ``queue_ms`` + ``overhead_ms``) from the model's
 (``compute_ms``, which on a tunnelled chip includes the ~90 ms dispatch
 RTT). The reference's sub-ms claim is about the framework share.
 
+The ``load_async`` section A/Bs the sync loop against the pipelined
+executor (serving/executor.py): sync vs async inflight=2 vs multi-replica,
+on the local endpoint and on an RTT-emulated tunnelled endpoint, plus a
+bitwise reply-parity check. ``--only load_async`` runs just that section
+(for merging into an existing artifact).
+
 Prints one JSON line with latencies in milliseconds.
 """
 
@@ -98,7 +104,192 @@ def _load(url: str, payload: bytes, n_clients: int, duration_s: float):
             "p99_ms": round(float(np.percentile(a, 99)), 3)}
 
 
+def _load_keepalive(host: str, port: int, payload: bytes, n_clients: int,
+                    duration_s: float, path: str = "/"):
+    """Persistent-connection load generator (http.client, one connection per
+    client thread). The urlopen-based ``_load`` pays a fresh TCP connect +
+    handler-thread spawn per request — on a 1-core host that connection
+    churn dominates the p99 tail and masks the serving loop entirely. The
+    load_async A/B uses THIS generator for both sides so the comparison
+    measures the executor, not the socket factory."""
+    import http.client
+    import threading
+
+    lat: list = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(n_clients + 1)
+    stop_at = [0.0]
+
+    def client():
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        local = []
+        barrier.wait()
+        while time.perf_counter() < stop_at[0]:
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", path, body=payload,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+            except Exception:  # noqa: BLE001 — reconnect and continue
+                conn.close()
+                conn = http.client.HTTPConnection(host, port, timeout=60)
+                continue
+            local.append(time.perf_counter() - t0)
+        conn.close()
+        with lock:
+            lat.extend(local)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    stop_at[0] = time.perf_counter() + 1e9  # armed below
+    barrier.wait()
+    t_start = time.perf_counter()
+    stop_at[0] = t_start + duration_s
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    if not lat:
+        return {"clients": n_clients, "duration_s": round(wall, 2),
+                "requests": 0, "qps": 0.0, "error": "all requests failed"}
+    a = np.asarray(lat) * 1e3
+    return {"clients": n_clients, "duration_s": round(wall, 2),
+            "requests": len(a), "qps": round(len(a) / wall, 1),
+            "p50_ms": round(float(np.percentile(a, 50)), 3),
+            "p99_ms": round(float(np.percentile(a, 99)), 3)}
+
+
+def _make_rtt_transform(base, rtt_s: float):
+    """Emulate the tunnelled-accelerator serving path (this artifact's TPU
+    sections note ~90ms dispatch+fetch RTT per batch through the ssh
+    tunnel): compute runs locally, then the reply spends ``rtt_s`` off-host
+    (a GIL-releasing sleep — link time, not CPU). The sync loop pays it
+    serially per batch; the async executor's submit/readback split overlaps
+    it with the next batch's compute, exactly as jax async dispatch does
+    against a real remote chip."""
+
+    def transform(df):
+        out = base(df)
+        out.collect()
+        time.sleep(rtt_s)
+        return out
+
+    def submit(df):
+        out = base(df)
+        out.collect()
+        t_done = time.perf_counter() + rtt_s
+
+        def resolve():
+            rem = t_done - time.perf_counter()
+            if rem > 0:
+                time.sleep(rem)
+            return out
+
+        return resolve
+
+    transform.submit = submit
+    return transform
+
+
+def _bitwise_parity(make_server, payloads) -> bool:
+    """Same request sequence, sequential, against a sync and an async
+    server: replies must match byte-for-byte."""
+    import urllib.request as _ur
+
+    def collect(server):
+        out = []
+        with server:
+            for p in payloads:
+                req = _ur.Request(server.address, data=p, method="POST")
+                with _ur.urlopen(req, timeout=60) as resp:
+                    out.append((resp.status, resp.read()))
+        return out
+
+    return collect(make_server(False)) == collect(make_server(True))
+
+
+def _load_async_section(featurize, img, n_clients, duration, reps=3):
+    """The overlapped-executor A/B (load_async): sync loop vs pipelined
+    executor (inflight=2) vs multi-replica, on the local endpoint and on
+    the RTT-emulated tunnelled endpoint. Best-of-N per config — the
+    repo's convention for shared noisy hosts (see bench.py paced_overlap):
+    environmental stalls only ever DEFLATE a config's number, so max-of-N
+    measures the framework."""
+    import jax
+
+    from mmlspark_tpu.serving import ServingServer
+
+    n_dev = len(jax.local_devices())
+    n_rep = max(2, n_dev)
+    configs = {
+        "sync": {},
+        "async_inflight2": {"async_exec": True, "inflight": 2, "replicas": 1},
+        f"async_inflight2_replicas{n_rep}": {
+            "async_exec": True, "inflight": 2, "replicas": n_rep},
+        "async_inflight4": {"async_exec": True, "inflight": 4, "replicas": 1},
+    }
+    rtt_s = 0.09
+    endpoints = {"local": featurize,
+                 "rtt90": _make_rtt_transform(featurize, rtt_s)}
+    out = {}
+    for ep_name, transform in endpoints.items():
+        ep = {}
+        for name, kw in configs.items():
+            best = None
+            for _ in range(reps):
+                with ServingServer(transform, port=0, max_wait_ms=5.0,
+                                   max_batch_size=64, **kw) as server:
+                    server.warmup(img, sizes=[1, 8, 16, 32, 64])
+                    r = _load_keepalive(server.host, server.port, img,
+                                        n_clients, duration)
+                    d = server.stats.summary()
+                    r["mean_batch"] = d.get("mean_batch")
+                    r["queue_ms_p95"] = (d.get("queue_ms") or {}).get("p95")
+                    r["shed"] = (d.get("shed") or {}).get("total")
+                    if server._executor is not None:
+                        es = server._executor.stats()
+                        r["overlap_ratio"] = es["overlap_ratio"]
+                        r["controller_wait_ms"] = (
+                            es["controller"] or {}).get("wait_ms")
+                        r["replica_batches"] = [x["batches"]
+                                                for x in es["replicas"]]
+                if best is None or (r.get("qps") or 0) > (best.get("qps") or 0):
+                    best = r
+            ep[name] = best
+        sync_qps = ep["sync"].get("qps") or 0
+        sync_p99 = ep["sync"].get("p99_ms") or 0
+        a = ep["async_inflight2"]
+        ep["ab_inflight2"] = {
+            "qps_ratio": round((a.get("qps") or 0) / sync_qps, 3)
+            if sync_qps else None,
+            "p99_ratio": round((a.get("p99_ms") or 0) / sync_p99, 3)
+            if sync_p99 else None}
+        out[ep_name] = ep
+
+    def make_server(async_exec):
+        from mmlspark_tpu.serving import ServingServer as S
+
+        return S(featurize, port=0, max_wait_ms=1.0,
+                 async_exec=async_exec, inflight=2)
+
+    out["bitwise_identical"] = _bitwise_parity(
+        make_server, [img] * 6)
+    out["note"] = (
+        "best-of-%d per config, persistent-connection clients; local = "
+        "model in-process (a 1-core CPU host is total-work bound: the sync "
+        "loop is already near the amortization ceiling there, so ratios "
+        "hover near 1); rtt90 = the tunnelled-chip deployment the TPU "
+        "sections of this file measure (~90ms off-host dispatch+fetch RTT "
+        "per batch), which the executor's submit/readback split overlaps "
+        "with the next batch's compute" % reps)
+    return out
+
+
 def main():
+    import argparse
+
     import jax
 
     from mmlspark_tpu.core.dataframe import DataFrame
@@ -107,22 +298,16 @@ def main():
     from mmlspark_tpu.serving import ServingServer
     from mmlspark_tpu.serving.stages import parse_request
 
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", choices=["all", "load_async"], default="all",
+                    help="load_async: run just the overlapped-executor A/B "
+                         "section (merge into an existing artifact)")
+    args = ap.parse_args()
+
     platform = jax.devices()[0].platform
     n = 200 if platform != "cpu" else 50
-
-    # --- echo endpoint (pipeline-overhead floor)
-    def echo(df):
-        parsed = parse_request(df, "data", parse="json")
-        return parsed.with_column(
-            "reply", lambda p: [float(np.sum(v)) for v in p["data"]])
-
-    # max_wait_ms=0: single-stream latency mode (batch waits only add
-    # latency when requests arrive sequentially)
-    with ServingServer(echo, port=0, max_wait_ms=0.0) as server:
-        server.warmup(json.dumps({"data": [1, 2, 3]}).encode())
-        echo_stats = _measure(server.address,
-                              json.dumps({"data": [1, 2, 3]}).encode(), n)
-        echo_decomp = _decomposition(server)
+    n_clients = 16
+    duration = 8.0 if platform != "cpu" else 3.0
 
     # --- model endpoint: ResNet-18 featurize of a 64x64 image
     model = resnet(18, num_classes=16, image_size=64, width=16)
@@ -143,6 +328,28 @@ def main():
 
     img = np.random.default_rng(0).integers(
         0, 256, size=(64, 64, 3), dtype=np.uint8).tobytes()
+
+    if args.only == "load_async":
+        print(json.dumps({
+            "backend": platform,
+            "load_async": _load_async_section(
+                featurize, img, n_clients, max(duration, 8.0))}))
+        return
+
+    # --- echo endpoint (pipeline-overhead floor)
+    def echo(df):
+        parsed = parse_request(df, "data", parse="json")
+        return parsed.with_column(
+            "reply", lambda p: [float(np.sum(v)) for v in p["data"]])
+
+    # max_wait_ms=0: single-stream latency mode (batch waits only add
+    # latency when requests arrive sequentially)
+    with ServingServer(echo, port=0, max_wait_ms=0.0) as server:
+        server.warmup(json.dumps({"data": [1, 2, 3]}).encode())
+        echo_stats = _measure(server.address,
+                              json.dumps({"data": [1, 2, 3]}).encode(), n)
+        echo_decomp = _decomposition(server)
+
     with ServingServer(featurize, port=0, max_wait_ms=0.0) as server:
         # pre-compile batch sizes 1 and max (warm batch-1 fast path)
         server.warmup(img)
@@ -152,8 +359,6 @@ def main():
     # --- load: concurrent clients against the COALESCING loop
     # (max_wait_ms > 0) — proves batching engages (mean_batch > 1) and
     # records the throughput the reference's serving story claims
-    n_clients = 16
-    duration = 8.0 if platform != "cpu" else 3.0
     with ServingServer(echo, port=0, max_wait_ms=2.0,
                        max_batch_size=64) as server:
         server.warmup(json.dumps({"data": [1, 2, 3]}).encode(),
@@ -202,6 +407,8 @@ def main():
                          "load-section claims; server_decomposition is the "
                          "serving loop's own queue/compute/overhead clocks"},
         "max_wait_sweep_resnet18": sweep,
+        "load_async": _load_async_section(featurize, img, n_clients,
+                                          max(duration, 8.0)),
         "note": "framework share = queue_ms + overhead_ms; compute_ms on the "
                 "tunnelled chip includes ~90ms dispatch RTT per model batch "
                 "(colocated hosts do not pay it)"}))

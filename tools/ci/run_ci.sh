@@ -26,6 +26,7 @@ PACKAGES=(
   "tests/test_vw.py tests/test_automl_recommendation.py tests/test_lime.py"
   "tests/test_models.py tests/test_onnx.py tests/test_downloader.py tests/test_native.py tests/test_ingest.py"
   "tests/test_cognitive.py tests/test_style.py tests/test_helm_chart.py"
+  "tests/test_serving_async.py"
   "tests/test_faults.py -m faults"
   "tests/test_fuzzing.py"
   "tests/test_attention.py tests/test_parallel_pp_ep.py"
@@ -48,7 +49,7 @@ if [ "$stage" = "flaky" ] || [ "$stage" = "all" ]; then
   echo "=== flaky-retried serving suites (pipeline.yaml:286-291) ==="
   ok=1
   for attempt in 1 2 3; do
-    if python -m pytest tests/test_io_serving.py -q; then ok=0; break; fi
+    if python -m pytest tests/test_io_serving.py tests/test_serving_async.py -q; then ok=0; break; fi
     echo "flaky attempt $attempt failed; retrying"
   done
   [ $ok -ne 0 ] && rc=1
