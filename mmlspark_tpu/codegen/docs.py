@@ -29,6 +29,9 @@ _EXTRA_INDEX = [
     "- [serving](serving.md) (hand-maintained; not stage-registry classes): "
     "`ServingServer`, `serve_pipeline`, `AdaptiveBatchController`, "
     "`ReplicaSet`, `PipelinedExecutor`, `RoutingFront`",
+    "- [obs](obs.md) (hand-maintained; not stage-registry classes): "
+    "`MetricsRegistry`, `Counter`, `Gauge`, `Histogram`, `Tracer`, "
+    "`SpanContext`, `TrainRecorder`, bridge adapters",
 ]
 
 

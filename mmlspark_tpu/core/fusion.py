@@ -294,8 +294,12 @@ class SegmentExecutor:
     def run(self, df: DataFrame, stats) -> DataFrame:
         import jax
 
+        from ..obs.trace import current_batch
+
         seg = self.segment
         params_dev = jax.device_put(tuple(d.params for d in seg.dfns))
+        obs = current_batch()  # serving batch's trace binding (or None)
+        t_wall, t0 = time.time(), time.perf_counter()
         out_parts: List[Dict[str, np.ndarray]] = []
         for part in df.partitions:
             try:
@@ -304,6 +308,10 @@ class SegmentExecutor:
             except _HostFallback as e:
                 self.fallbacks.append(f"{seg.label}: {e}")
                 out_parts.extend(self._host_partition(part, df.schema))
+        if obs is not None:
+            tracer, ctxs = obs
+            tracer.record_batch(f"segment:{seg.label}", ctxs, t_wall,
+                                time.perf_counter() - t0)
         return self._overlay(df, out_parts)
 
     def _overlay(self, df: DataFrame, out_parts: List[Dict[str, np.ndarray]]
@@ -482,10 +490,13 @@ class SegmentExecutor:
         execute synchronously at submit time — never a wrong answer."""
         import jax
 
+        from ..obs.trace import current_batch
         from ..parallel.ingest import timed_stage
 
         seg = self.segment
+        obs = current_batch()  # serving batch's trace binding (or None)
         wall0 = time.perf_counter()
+        t_wall = time.time()
         params_dev = jax.device_put(tuple(d.params for d in seg.dfns))
         pendings: List[Tuple[str, Any, Any]] = []
         for part in df.partitions:
@@ -495,7 +506,8 @@ class SegmentExecutor:
                 if state["n_valid"] > 0:
                     step = self._make_step(params_dev, state)
                     for batch in self._batches(state):
-                        staged, timing = timed_stage(self._put, batch)
+                        staged, timing = timed_stage(self._put, batch,
+                                                     obs=obs)
                         td = time.perf_counter()
                         handle = step(staged)
                         timing.dispatch_s = time.perf_counter() - td
@@ -529,6 +541,10 @@ class SegmentExecutor:
                         collected[k].append(y)
                 out_parts.append(self._emit_partition(state, collected))
             stats.add_wall(time.perf_counter() - wall0)
+            if obs is not None:
+                tracer, ctxs = obs
+                tracer.record_batch(f"segment:{seg.label}", ctxs, t_wall,
+                                    time.perf_counter() - wall0)
             return self._overlay(df, out_parts)
 
         return resolve

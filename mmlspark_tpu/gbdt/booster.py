@@ -1050,7 +1050,11 @@ def _train_native(params: TrainParams, X: np.ndarray, y: np.ndarray,
     yv = np.asarray(y, dtype=np.float64)
     wv = np.asarray(weights, dtype=np.float64) if weights is not None else None
 
+    from ..obs.metrics import TrainRecorder
+
+    recorder = TrainRecorder("gbdt_native")
     for it in range(params.num_iterations):
+        _it_t0 = _now()
         _faults.fire(_faults.TRAIN_STEP, iteration=it, engine="native")
         dropped: List[int] = []
         if is_dart and booster.trees:
@@ -1159,12 +1163,14 @@ def _train_native(params: TrainParams, X: np.ndarray, y: np.ndarray,
 
         if params.train_metric and log:
             tm = eval_metric(metric, scores[:, 0] if k == 1 else scores, yv)
+            recorder.metric(f"train_{metric}", tm)
             log(f"[{it + 1}] train {metric}={tm:.6f}")
         if val_X is not None:
             val_scores = booster.raw_predict(
                 val_X, num_iteration=len(booster.trees))
             m = eval_metric(metric, val_scores,
                             np.asarray(val_y, dtype=np.float64), valid_groups)
+            recorder.metric(f"valid_{metric}", m)
             improved = m > best_val if higher_better else m < best_val
             if improved:
                 best_val, best_iter, rounds_no_improve = \
@@ -1179,10 +1185,12 @@ def _train_native(params: TrainParams, X: np.ndarray, y: np.ndarray,
                 if log:
                     log(f"early stopping at iteration {it + 1}, "
                         f"best {best_iter}")
+                recorder.step(_now() - _it_t0, examples=n)
                 break
         elif log and not params.train_metric and (it + 1) % 10 == 0:
             m = eval_metric(metric, scores[:, 0] if k == 1 else scores, yv)
             log(f"[{it + 1}] train {metric}={m:.6f}")
+        recorder.step(_now() - _it_t0, examples=n)
 
     if is_rf and booster.trees:
         inv = 1.0 / len(booster.trees)
@@ -1480,7 +1488,11 @@ def train(params: TrainParams,
         return (np.asarray(s, dtype=np.float64)
                 + np.asarray(c, dtype=np.float64)).reshape(n, -1)
 
+    from ..obs.metrics import TrainRecorder
+
+    recorder = TrainRecorder("gbdt")
     for it in range(start_it, params.num_iterations):
+        _it_t0 = _now()
         # chaos seam: a planned fault here simulates preemption mid-train
         # (the last checkpoint is on disk; resume replays from it)
         _faults.fire(_faults.TRAIN_STEP, iteration=it)
@@ -1595,11 +1607,13 @@ def train(params: TrainParams,
                              else host_sc[:n_real],
                              np.asarray(y[:n_real], dtype=np.float64),
                              groups[:n_real] if groups is not None else None)
+            recorder.metric(f"train_{metric}", tm)
             log(f"[{it + 1}] train {metric}={tm:.6f}")
         if val_X is not None:
             val_scores = booster.raw_predict(val_X, num_iteration=len(booster.trees))
             m = eval_metric(metric, val_scores, np.asarray(val_y, dtype=np.float64),
                             valid_groups)
+            recorder.metric(f"valid_{metric}", m)
             improved = m > best_val if higher_better else m < best_val
             if improved:
                 best_val, best_iter, rounds_no_improve = m, len(booster.trees), 0
@@ -1612,6 +1626,7 @@ def train(params: TrainParams,
                 booster.best_iteration = best_iter
                 if log:
                     log(f"early stopping at iteration {it + 1}, best {best_iter}")
+                recorder.step(_now() - _it_t0, examples=n_real)
                 break
         elif log and not params.train_metric and (it + 1) % 10 == 0:
             host_sc = _host_scores()[:n_real]
@@ -1625,6 +1640,7 @@ def train(params: TrainParams,
         if checkpoint is not None and (
                 (it + 1) % max(checkpoint.every_k, 1) == 0
                 or it + 1 == params.num_iterations):
+            _ck_t0 = _now()
             save_checkpoint(
                 checkpoint.path,
                 params_dict=dataclasses.asdict(params),
@@ -1635,6 +1651,8 @@ def train(params: TrainParams,
                 bag_mask=bag_mask,
                 best_val=best_val, best_iter=best_iter,
                 rounds_no_improve=rounds_no_improve)
+            recorder.checkpoint(_now() - _ck_t0)
+        recorder.step(_now() - _it_t0, examples=n_real)
 
     if is_rf and booster.trees:
         inv = 1.0 / len(booster.trees)

@@ -224,6 +224,27 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
                                     dtype=bool)
         return X, y, w, init_scores, valid_mask
 
+    def _timed_fit(self, fit_fn) -> Booster:
+        """Run one booster fit with fit-level observability: wall seconds
+        and completed-fit count land in the obs default registry under this
+        estimator's class name, next to the per-iteration
+        ``mmlspark_train_*`` series the boost loops emit."""
+        import time
+
+        from ..obs.metrics import default_registry
+
+        t0 = time.perf_counter()
+        booster = fit_fn()
+        reg = default_registry()
+        est = type(self).__name__
+        reg.gauge("mmlspark_train_fit_seconds",
+                  "wall seconds of the last booster fit",
+                  ("estimator",)).labels(estimator=est).set(
+            time.perf_counter() - t0)
+        reg.counter("mmlspark_train_fits_total", "booster fits completed",
+                    ("estimator",)).labels(estimator=est).inc()
+        return booster
+
     def _fit_booster_sparse(self, data, objective: str, num_class: int,
                             groups: Optional[np.ndarray] = None) -> Booster:
         """CSR training for sparse-row features (TextFeaturizer / VW
@@ -518,8 +539,8 @@ class LightGBMClassifier(Estimator, _LightGBMParams):
             raise ValueError(
                 f"Labels must be 0..K-1 (got {classes[:10]}); use ValueIndexer first")
         objective = "binary" if num_class <= 2 else "multiclass"
-        booster = self._fit_booster(df, objective,
-                                    1 if num_class <= 2 else num_class)
+        booster = self._timed_fit(lambda: self._fit_booster(
+            df, objective, 1 if num_class <= 2 else num_class))
         return LightGBMClassificationModel(
             booster=booster,
             featuresCol=self.get("featuresCol"),
@@ -604,7 +625,7 @@ class LightGBMRegressor(Estimator, _LightGBMParams):
             "regression": "regression", "quantile": "quantile",
             "huber": "huber", "poisson": "poisson",
         }.get(self.get("applicationName"), "regression")
-        booster = self._fit_booster(df, objective)
+        booster = self._timed_fit(lambda: self._fit_booster(df, objective))
         return LightGBMRegressionModel(
             booster=booster,
             featuresCol=self.get("featuresCol"),
@@ -662,7 +683,8 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
         raw_groups = df.column(group_col)
         _, groups = np.unique(np.asarray([str(g) for g in raw_groups]),
                               return_inverse=True)
-        booster = self._fit_booster(df, "lambdarank", groups=groups.astype(np.int64))
+        booster = self._timed_fit(lambda: self._fit_booster(
+            df, "lambdarank", groups=groups.astype(np.int64)))
         return LightGBMRankerModel(
             booster=booster,
             featuresCol=self.get("featuresCol"),

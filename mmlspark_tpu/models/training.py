@@ -195,13 +195,26 @@ class TrainLoopResult:
     last_metrics: Optional[Dict[str, float]]
 
 
+def _batch_rows(batch) -> Optional[int]:
+    """Leading-dim row count of a step batch (tuple/dict/array pytrees);
+    None when nothing array-like is found."""
+    if isinstance(batch, (tuple, list)) and batch:
+        return _batch_rows(batch[0])
+    if isinstance(batch, dict) and batch:
+        return _batch_rows(next(iter(batch.values())))
+    shape = getattr(batch, "shape", None)
+    if shape:
+        return int(shape[0])
+    return None
+
+
 def run_train_loop(state: TrainState, step_fn: Callable, batches: Iterable,
                    *, checkpoint_path: Optional[str] = None,
                    every_k: int = 100,
                    guard: Optional[PreemptionGuard] = None,
                    resume: bool = True,
-                   log: Optional[Callable[[str], None]] = None
-                   ) -> TrainLoopResult:
+                   log: Optional[Callable[[str], None]] = None,
+                   registry=None) -> TrainLoopResult:
     """Drive ``step_fn`` over ``batches`` with checkpoint/resume and a
     preemption hook — the DNN counterpart of the GBDT checkpointed fit.
 
@@ -213,8 +226,23 @@ def run_train_loop(state: TrainState, step_fn: Callable, batches: Iterable,
     (seeded/indexed) batch stream therefore replays the exact uninterrupted
     schedule. ``guard``: a PreemptionGuard polled between steps; when it
     fires, the loop checkpoints once more and returns ``preempted=True``.
+
+    ``registry``: obs MetricsRegistry receiving the per-step series
+    (``mmlspark_train_*{engine="dnn"}``: step time, examples/s, loss,
+    checkpoint latency); defaults to the process-wide registry so
+    ``/_mmlspark/metrics`` scrapes see training progress.
     """
+    import time as _time
+
     from .checkpoint import load_train_state, save_train_state
+    from ..obs.metrics import TrainRecorder
+
+    recorder = TrainRecorder("dnn", registry=registry)
+
+    def _save_timed(st):
+        t0 = _time.perf_counter()
+        save_train_state(st, checkpoint_path)
+        recorder.checkpoint(_time.perf_counter() - t0)
 
     start_step = 0
     if checkpoint_path is not None and resume:
@@ -237,18 +265,22 @@ def run_train_loop(state: TrainState, step_fn: Callable, batches: Iterable,
             preempted = True
             break
         faults.fire(faults.TRAIN_STEP, step=i, engine="dnn")
+        t_step = _time.perf_counter()
         state, metrics = step_fn(state, batch)
+        dur = _time.perf_counter() - t_step
         steps_run += 1
         dirty = True
         metrics_out = metrics
+        recorder.step(dur, examples=_batch_rows(batch),
+                      loss=(metrics or {}).get("loss"))
         if checkpoint_path is not None and steps_run % max(every_k, 1) == 0:
-            save_train_state(state, checkpoint_path)
+            _save_timed(state)
             dirty = False
     else:
         if guard is not None and guard.requested():
             preempted = True
     if checkpoint_path is not None and (dirty or preempted):
-        save_train_state(state, checkpoint_path)
+        _save_timed(state)
     if metrics_out is not None:
         metrics_out = {k: float(v) for k, v in metrics_out.items()}
     return TrainLoopResult(state=state, steps_run=steps_run,

@@ -1,0 +1,28 @@
+"""Unified observability: metrics registry, Prometheus exposition, tracing.
+
+Three pillars (docs/observability.md):
+
+  - ``obs.metrics``  — MetricsRegistry (thread-safe counters/gauges/
+    histograms with label sets) + the Prometheus text-format writer served
+    at ``/_mmlspark/metrics`` on every ServingServer and RoutingFront.
+  - ``obs.bridge``   — scrape-time adapters folding the pre-existing stats
+    surfaces (IngestStats, LatencyStats, CompileCache, executor timelines,
+    circuit breakers) into the registry, so ``/_mmlspark/stats`` and
+    Prometheus report from one source of truth.
+  - ``obs.trace``    — span context propagated across HTTP hops via the
+    ``X-MMLSpark-Trace`` header (deadline-header pattern), with JSONL and
+    Perfetto exporters and head-based sampling.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricFamily,
+                      MetricsRegistry, Sample, TrainRecorder,
+                      default_registry, set_default_registry)
+from .trace import (Span, SpanContext, TRACE_HEADER, Tracer, batch_context,
+                    current_batch, parse_trace_header)
+from . import bridge
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricFamily",
+           "MetricsRegistry", "Sample", "Span", "SpanContext",
+           "TRACE_HEADER", "Tracer", "TrainRecorder", "batch_context",
+           "bridge", "current_batch", "default_registry",
+           "parse_trace_header", "set_default_registry"]

@@ -1,0 +1,276 @@
+"""Bridge adapters: fold the existing stats surfaces into a MetricsRegistry.
+
+PRs 1-4 each grew an ad-hoc stats object — IngestStats (parallel/ingest.py),
+LatencyStats + shed counters (serving/server.py), CompileCache hit/miss
+(core/device_stage.py via core/fusion.py), PipelinedExecutor busy/overlap
+(serving/executor.py), and the RoutingFront circuit breakers. These adapters
+register scrape-time COLLECTORS that read those live objects and render them
+as Prometheus families, so the JSON ``/_mmlspark/stats`` payload and the
+``/_mmlspark/metrics`` exposition report from one source of truth — there is
+no second set of counters to drift.
+
+Naming conventions (docs/observability.md): every series is prefixed
+``mmlspark_``, seconds are ``_seconds``/``_seconds_total``, monotonic counts
+are ``_total``, and enum-ish states are one-hot gauges (``state`` label).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from .metrics import MetricFamily, MetricsRegistry
+
+__all__ = ["fold_front", "fold_server", "fold_tracer"]
+
+
+def _num(v: Any) -> Optional[float]:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f
+
+
+# ---------------------------------------------------------------------------
+# ServingServer
+# ---------------------------------------------------------------------------
+
+
+def _latency_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
+    served = MetricFamily(
+        "mmlspark_latency_window_requests", "gauge",
+        "requests in the rolling latency window")
+    served.add(summary.get("n", 0))
+    yield served
+    lat = MetricFamily(
+        "mmlspark_request_latency_ms", "gauge",
+        "rolling-window request latency decomposition "
+        "(component x p50/p95/mean)")
+    for component in ("queue", "compute", "overhead", "total"):
+        block = summary.get(f"{component}_ms") or {}
+        for stat, v in block.items():
+            f = _num(v)
+            if f is not None:
+                lat.add(f, {"component": component, "stat": stat})
+    yield lat
+    mb = _num(summary.get("mean_batch"))
+    if mb is not None:
+        fam = MetricFamily("mmlspark_mean_batch_rows", "gauge",
+                           "mean drained batch size (rolling window)")
+        fam.add(mb)
+        yield fam
+    shed = (summary.get("shed") or {})
+    sheds = MetricFamily(
+        "mmlspark_sheds_total", "counter",
+        "load-shed responses by HTTP status and reason")
+    for status, n in (shed.get("by_status") or {}).items():
+        sheds.add(n, {"kind": "status", "value": str(status)})
+    for reason, n in (shed.get("by_reason") or {}).items():
+        sheds.add(n, {"kind": "reason", "value": str(reason)})
+    yield sheds
+
+
+def _ingest_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
+    stage = MetricFamily(
+        "mmlspark_ingest_stage_seconds_total", "counter",
+        "cumulative device-ingest decomposition (TransferRing stages)")
+    for name in ("queue_s", "h2d_s", "dispatch_s", "compute_s",
+                 "readback_s"):
+        f = _num(summary.get(name))
+        if f is not None:
+            stage.add(f, {"stage": name[:-2]})
+    yield stage
+    scalars = (("mmlspark_ingest_batches_total", "counter", "n_batches",
+                "batches through the transfer ring"),
+               ("mmlspark_ingest_rows_total", "counter", "rows",
+                "rows through the transfer ring"),
+               ("mmlspark_ingest_bytes_total", "counter", "bytes",
+                "wire bytes shipped host->device"),
+               ("mmlspark_ingest_overlap_ratio", "gauge", "overlap_ratio",
+                "ring wall / serial stage time (<1 = overlapped)"),
+               ("mmlspark_ingest_h2d_gbps", "gauge", "h2d_gbps",
+                "host->device transfer bandwidth"))
+    for mname, mtype, key, help in scalars:
+        f = _num(summary.get(key))
+        if f is not None:
+            yield MetricFamily(mname, mtype, help).add(f)
+
+
+def _fusion_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
+    cache = stats.get("compile_cache") or {}
+    for key, mtype, help in (
+            ("hits", "counter", "fused-executable cache hits"),
+            ("misses", "counter", "fused-executable cache misses"),
+            ("entries", "gauge", "live fused executables"),
+            ("compile_s", "counter", "seconds spent compiling fused "
+                                     "executables")):
+        f = _num(cache.get(key))
+        if f is not None:
+            yield MetricFamily(f"mmlspark_compile_cache_{key}"
+                               + ("_total" if mtype == "counter" else ""),
+                               mtype, help).add(f)
+    rate = _num(cache.get("hit_rate"))
+    if rate is not None:
+        yield MetricFamily("mmlspark_compile_cache_hit_rate", "gauge",
+                           "hits / (hits + misses)").add(rate)
+    nseg = _num(stats.get("n_fused_segments"))
+    if nseg is not None:
+        yield MetricFamily("mmlspark_fused_segments", "gauge",
+                           "device-fused segments in the active plan"
+                           ).add(nseg)
+    fallbacks = stats.get("fallbacks")
+    if fallbacks is not None:
+        yield MetricFamily("mmlspark_fusion_fallbacks", "gauge",
+                           "partitions that fell back to the host path "
+                           "on the last transform").add(len(fallbacks))
+
+
+def _executor_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
+    busy = MetricFamily("mmlspark_executor_busy_seconds_total", "counter",
+                        "pipelined-executor stage busy time")
+    for stage, v in (stats.get("busy_s") or {}).items():
+        f = _num(v)
+        if f is not None:
+            busy.add(f, {"stage": stage})
+    yield busy
+    for key, mtype, help in (
+            ("epochs", "counter", "batches through the pipelined executor"),
+            ("inflight", "gauge", "configured in-flight slot depth"),
+            ("overlap_ratio", "gauge",
+             "stage-busy seconds / pipeline-active wall (>1 = overlapped)"),
+            ("active_wall_s", "counter",
+             "wall seconds with >=1 batch in flight")):
+        f = _num(stats.get(key))
+        if f is not None:
+            name = f"mmlspark_executor_{key}"
+            if mtype == "counter" and not name.endswith("_total") \
+                    and not name.endswith("_s"):
+                name += "_total"
+            yield MetricFamily(name, mtype, help).add(f)
+    reps = MetricFamily("mmlspark_replica_busy_seconds_total", "counter",
+                        "per-replica transform busy time")
+    util = MetricFamily("mmlspark_replica_utilization", "gauge",
+                        "per-replica busy / pipeline-active wall")
+    rows = MetricFamily("mmlspark_replica_rows_total", "counter",
+                        "rows computed per replica")
+    for r in (stats.get("replicas") or []):
+        labels = {"replica": str(r.get("replica"))}
+        for fam, key in ((reps, "busy_s"), (util, "utilization"),
+                         (rows, "rows")):
+            f = _num(r.get(key))
+            if f is not None:
+                fam.add(f, labels)
+    yield reps
+    yield util
+    yield rows
+
+
+def fold_server(registry: MetricsRegistry, server: Any) -> None:
+    """Register collectors reading a ServingServer's live stats surfaces:
+    LatencyStats window + shed counters, the admission queue, the async
+    executor, and the ingest/fusion providers when wired (serve_pipeline).
+    Safe to call before start() — everything is read at scrape time."""
+
+    def collect() -> List[MetricFamily]:
+        fams: List[MetricFamily] = []
+        fams.append(MetricFamily(
+            "mmlspark_requests_served_total", "counter",
+            "requests answered (all statuses) since process start").add(
+                server.requests_served))
+        fams.append(MetricFamily(
+            "mmlspark_queue_depth", "gauge",
+            "requests waiting for a batch slot").add(server._queue.qsize()))
+        fams.append(MetricFamily(
+            "mmlspark_draining", "gauge",
+            "1 while the server refuses new work (graceful stop)").add(
+                1.0 if server._draining.is_set() else 0.0))
+        fams.extend(_latency_families(server.stats.summary()))
+        if server._executor is not None:
+            try:
+                fams.extend(_executor_families(server._executor.stats()))
+            except Exception:  # noqa: BLE001 — executor mid-shutdown
+                pass
+        if server.ingest_stats is not None:
+            try:
+                s = server.ingest_stats()
+                if s:
+                    fams.extend(_ingest_families(s))
+            except Exception:  # noqa: BLE001
+                pass
+        if server.fusion_stats is not None:
+            try:
+                s = server.fusion_stats()
+                if s:
+                    fams.extend(_fusion_families(s))
+            except Exception:  # noqa: BLE001
+                pass
+        return fams
+
+    registry.register_collector(collect)
+
+
+# ---------------------------------------------------------------------------
+# RoutingFront
+# ---------------------------------------------------------------------------
+
+
+def fold_front(registry: MetricsRegistry, front: Any) -> None:
+    """Register collectors for a RoutingFront: registered-worker count,
+    one-hot circuit-breaker states, and capacity weights."""
+
+    def collect() -> List[MetricFamily]:
+        states = front.worker_states
+        caps = front.worker_capacities
+        fams = [MetricFamily(
+            "mmlspark_workers", "gauge",
+            "registered workers by routability").add(
+                sum(1 for s in states.values() if s != "open"),
+                {"routable": "true"}).add(
+                sum(1 for s in states.values() if s == "open"),
+                {"routable": "false"})]
+        st = MetricFamily(
+            "mmlspark_worker_circuit_state", "gauge",
+            "one-hot circuit-breaker state per worker "
+            "(closed/half_open/open)")
+        for w, s in states.items():
+            for name in ("closed", "half_open", "open"):
+                st.add(1.0 if s == name else 0.0,
+                       {"worker": w, "state": name})
+        fams.append(st)
+        cap = MetricFamily("mmlspark_worker_capacity", "gauge",
+                           "concurrent-batch capacity weight per worker")
+        for w, c in caps.items():
+            cap.add(c, {"worker": w})
+        fams.append(cap)
+        return fams
+
+    registry.register_collector(collect)
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+# ---------------------------------------------------------------------------
+
+
+def fold_tracer(registry: MetricsRegistry, tracer: Any) -> None:
+    """Trace-pipeline health: originated/joined/head-dropped traces and the
+    buffered span count, so sampling behavior is itself observable."""
+
+    def collect() -> List[MetricFamily]:
+        s = tracer.stats()
+        fams = [MetricFamily(
+            "mmlspark_trace_sample_rate", "gauge",
+            "head-based sampling probability at this ingress").add(
+                s["sample_rate"])]
+        tr = MetricFamily("mmlspark_traces_total", "counter",
+                          "ingress trace decisions by kind")
+        tr.add(s["started"], {"kind": "started"})
+        tr.add(s["joined"], {"kind": "joined"})
+        tr.add(s["dropped"], {"kind": "dropped"})
+        fams.append(tr)
+        fams.append(MetricFamily(
+            "mmlspark_trace_buffered_spans", "gauge",
+            "finished spans held in the tracer ring").add(s["buffered"]))
+        return fams
+
+    registry.register_collector(collect)

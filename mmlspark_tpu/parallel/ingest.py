@@ -216,14 +216,22 @@ def _tree_nbytes(item: Any) -> int:
     return 0
 
 
-def timed_stage(put: Optional[Callable], item: Any) -> Tuple[Any, "BatchTiming"]:
+def timed_stage(put: Optional[Callable], item: Any,
+                obs: Optional[tuple] = None) -> Tuple[Any, "BatchTiming"]:
     """Stage one host batch toward the device with ingest accounting: fires
     the INGEST_H2D chaos seam, runs ``put`` (the H2D transfer), blocks until
     the staged arrays are device-resident, and returns (staged, timing) with
     ``h2d_s`` filled. The single staging primitive shared by TransferRing's
     producer thread and the serving executor's fused submit path
-    (core/fusion.py ``SegmentExecutor.submit_run``)."""
+    (core/fusion.py ``SegmentExecutor.submit_run``).
+
+    ``obs``: optional (Tracer, sampled contexts) pair — the serving batch's
+    trace binding (obs.trace.current_batch), captured by the CALLER on the
+    transform thread because this often runs on the ring's producer thread,
+    which does not inherit the contextvar. When set, the H2D transfer is
+    recorded as an ``h2d`` span on every traced request in the batch."""
     timing = BatchTiming(bytes_in=_tree_nbytes(item), rows=_tree_rows(item))
+    t_wall = time.time()
     t0 = time.perf_counter()
     # chaos seam: an injected delay here shows up in h2d_s (slow link), an
     # injected exception surfaces at the consumer (transfer failure)
@@ -231,6 +239,10 @@ def timed_stage(put: Optional[Callable], item: Any) -> Tuple[Any, "BatchTiming"]
     staged = put(item) if put is not None else item
     _block_ready(staged)
     timing.h2d_s = time.perf_counter() - t0
+    if obs is not None:
+        tracer, ctxs = obs
+        tracer.record_batch("h2d", ctxs, t_wall, timing.h2d_s,
+                            bytes=timing.bytes_in, rows=timing.rows)
     return staged, timing
 
 
@@ -281,8 +293,14 @@ class TransferRing:
         self._fetch = fetch if fetch is not None else _default_fetch
         self._user_put = put
 
+        # capture the serving batch's trace binding HERE (the ring is built
+        # on the transform thread, inside obs.trace.batch_context); the
+        # producer thread the prefetcher spawns would see an empty context
+        from ..obs.trace import current_batch
+
+        obs = current_batch()
         self._prefetch = DevicePrefetcher(
-            it, put=lambda item: timed_stage(put, item),
+            it, put=lambda item: timed_stage(put, item, obs=obs),
             depth=max(1, prefetch or depth))
 
     def close(self) -> None:

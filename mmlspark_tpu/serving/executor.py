@@ -47,6 +47,8 @@ import queue as queue_mod
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from ..obs import trace as obs_trace
+
 __all__ = ["AdaptiveBatchController", "PipelinedExecutor", "Replica",
            "ReplicaSet"]
 
@@ -389,6 +391,7 @@ class PipelinedExecutor:
             if batch is None:
                 return
             self._enter_pipe()
+            t_w0 = time.time()
             t_p0 = time.perf_counter()
             prep = srv._prepare_batch(batch)
             t_p1 = time.perf_counter()
@@ -401,6 +404,7 @@ class PipelinedExecutor:
                 prep.seq = self._seq
                 self._busy["drain"] += t_p1 - t_p0
             self._mark("drain", prep.seq, t_c0, t_p1)
+            srv._trace_batch("drain", prep, t_w0, t_p1 - t_p0)
             self._submit_q.put(prep)
 
     # -- stage 2: compute (one worker per replica) -----------------------
@@ -417,12 +421,17 @@ class PipelinedExecutor:
                 self._slots.release()
                 self._exit_pipe()
                 continue
+            t_w0 = time.time()
             t0 = time.perf_counter()
             pending = out = err = None
             try:
-                pending = self.replicas.submit(replica, prep.df)
-                if pending is None:
-                    out = self.replicas.run(replica, prep.df)
+                # batch_context: traced requests visible to the H2D staging
+                # and fused-segment layers under this dispatch
+                with obs_trace.batch_context(srv.tracer,
+                                             list(prep.ctxs.values())):
+                    pending = self.replicas.submit(replica, prep.df)
+                    if pending is None:
+                        out = self.replicas.run(replica, prep.df)
             except Exception as e:  # noqa: BLE001 — batch fails, not server
                 err = e
             t1 = time.perf_counter()
@@ -431,6 +440,8 @@ class PipelinedExecutor:
                 replica.batches += 1
                 replica.rows += prep.n
             self._mark("compute", prep.seq, t0, t1, replica.index)
+            srv._trace_batch("dispatch", prep, t_w0, t1 - t0,
+                             replica=replica.index)
             self._ready_q.put((prep, pending, out, err, t1 - t0))
 
     # -- stage 3: readback / fulfill -------------------------------------
@@ -441,6 +452,7 @@ class PipelinedExecutor:
             if item is _SENTINEL:
                 return
             prep, pending, out, err, compute_s = item
+            t_w0 = time.time()
             t0 = time.perf_counter()
             if err is not None:
                 srv._fail_batch(prep.ids, err)
@@ -456,6 +468,7 @@ class PipelinedExecutor:
                 self._busy["readback"] += t1 - t0
                 self.epochs += 1
             self._mark("readback", prep.seq, t0, t1)
+            srv._trace_batch("readback", prep, t_w0, t1 - t0)
             self._slots.release()
             self._exit_pipe()
             if self.controller is not None:

@@ -34,6 +34,9 @@ from urllib.request import Request, urlopen
 
 from ..core import faults
 from ..core.faults import RetryPolicy, deadline_from_headers
+from ..obs import bridge as obs_bridge
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import TRACE_HEADER, Tracer
 
 #: circuit-breaker states (per registered worker)
 CLOSED = "closed"          # healthy: receives traffic
@@ -66,16 +69,23 @@ class RoutingFront:
 
     REGISTER_PATH = "/_mmlspark/register"
     WORKERS_PATH = "/_mmlspark/workers"
-    #: probed path on the worker host: cheap on ServingServer (stats
-    #: endpoint); any HTTP answer — 404 included — proves liveness elsewhere
-    PROBE_PATH = "/_mmlspark/stats"
+    #: probed path on the worker host: constant-cost on ServingServer
+    #: (healthz — the old /_mmlspark/stats probe payload scaled with the
+    #: latency window and executor timeline); any HTTP answer — 404
+    #: included — proves liveness elsewhere
+    PROBE_PATH = "/_mmlspark/healthz"
+    #: the front's own Prometheus exposition + liveness probe
+    METRICS_PATH = "/_mmlspark/metrics"
+    HEALTH_PATH = "/_mmlspark/healthz"
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  forward_timeout_s: float = 70.0, max_failures: int = 3,
                  token: Optional[str] = None,
                  probe_interval_s: float = 0.5,
                  probe_timeout_s: float = 2.0,
-                 probe_policy: Optional[RetryPolicy] = None):
+                 probe_policy: Optional[RetryPolicy] = None,
+                 obs: bool = True, tracer: Optional[Tracer] = None,
+                 trace_sample_rate: float = 1.0):
         self.host = host
         self.port = port
         self.forward_timeout_s = forward_timeout_s
@@ -98,6 +108,26 @@ class RoutingFront:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
+        # observability: registry (worker circuit states + forward
+        # outcomes) and tracer (ingress + per-attempt forward spans; the
+        # trace context rides X-MMLSpark-Trace to the worker)
+        self.obs_enabled = bool(obs)
+        self.registry: Optional[MetricsRegistry] = None
+        self.tracer: Optional[Tracer] = None
+        self._forwards = None
+        if self.obs_enabled:
+            self.registry = MetricsRegistry()
+            self.tracer = tracer if tracer is not None else Tracer(
+                sample_rate=trace_sample_rate, service="routing-front")
+            obs_bridge.fold_front(self.registry, self)
+            obs_bridge.fold_tracer(self.registry, self.tracer)
+            self._forwards = self.registry.counter(
+                "mmlspark_front_requests_total",
+                "public requests by routing outcome", ("outcome",))
+
+    def _count(self, outcome: str) -> None:
+        if self._forwards is not None:
+            self._forwards.labels(outcome=outcome).inc()
 
     # -- worker management ------------------------------------------------
     def register(self, address: str, capacity: int = 1) -> None:
@@ -262,11 +292,43 @@ class RoutingFront:
                          "states": front.worker_states,
                          "capacity": front.worker_capacities}).encode())
                     return
+                if path == RoutingFront.HEALTH_PATH:
+                    self._respond(200, json.dumps(
+                        {"ok": True,
+                         "workers": len(front.workers)}).encode())
+                    return
+                if path == RoutingFront.METRICS_PATH:
+                    if front.registry is None:
+                        self._respond(
+                            404, b'{"error": "observability disabled"}')
+                        return
+                    self._respond(
+                        200, front.registry.exposition().encode("utf-8"),
+                        ctype=MetricsRegistry.CONTENT_TYPE)
+                    return
+                # trace ingress: the front originates (or continues) the
+                # trace; each forward attempt ships a child context to the
+                # worker via X-MMLSpark-Trace, so worker spans link up
+                tctx = front.tracer.ingress(self.headers) \
+                    if front.tracer is not None else None
+                t_w0, t_p0 = time.time(), time.perf_counter()
+
+                def respond(status, body, ctype="application/json",
+                            extra=None, outcome=None):
+                    self._respond(status, body, ctype, extra)
+                    if outcome is not None:
+                        front._count(outcome)
+                    if tctx is not None and tctx.sampled:
+                        front.tracer.record(
+                            "ingress", tctx, t_w0,
+                            time.perf_counter() - t_p0, status=int(status))
+
                 # deadline gate: an expired request is dropped HERE, before
                 # any forward burns a worker slot
                 dl = deadline_from_headers(self.headers)
                 if dl is not None and dl.expired():
-                    self._respond(504, b'{"error": "deadline expired"}')
+                    respond(504, b'{"error": "deadline expired"}',
+                            outcome="deadline_expired")
                     return
                 # forward to a worker, retrying across the ring; a request is
                 # only REPLAYED on another worker when the failure shows it
@@ -275,8 +337,8 @@ class RoutingFront:
                 # worker is mid-compute, so replaying would double-process it
                 order = front._pick_order()
                 if not order:
-                    self._respond(503, b'{"error": "no workers registered"}',
-                                  extra={"Retry-After": "1"})
+                    respond(503, b'{"error": "no workers registered"}',
+                            extra={"Retry-After": "1"}, outcome="no_workers")
                     return
                 idempotent = self.command in ("GET", "HEAD")
                 for addr in order:
@@ -287,50 +349,75 @@ class RoutingFront:
                     wpath = parts.path if path in ("", "/") else incoming.path
                     query = f"?{incoming.query}" if incoming.query else ""
                     url = f"{parts.scheme}://{parts.netloc}{wpath or '/'}{query}"
+                    drop = {"host", "content-length"}
+                    fwd = None
+                    if tctx is not None:
+                        # replace any incoming trace header with this
+                        # attempt's context: the child of the forward span
+                        # when sampled, or the flags=00 context when not —
+                        # the head decision made at ingress MUST propagate,
+                        # otherwise the worker would re-roll sampling
+                        drop.add(TRACE_HEADER.lower())
+                        if tctx.sampled:
+                            fwd = front.tracer.child(tctx)
+                    hdrs = {k: v for k, v in self.headers.items()
+                            if k.lower() not in drop}
+                    if tctx is not None:
+                        hdrs[TRACE_HEADER] = (fwd or tctx).to_header()
                     req = Request(url, data=body if body else None,
-                                  method=self.command,
-                                  headers={k: v for k, v in
-                                           self.headers.items()
-                                           if k.lower() not in
-                                           ("host", "content-length")})
+                                  method=self.command, headers=hdrs)
                     timeout = front.forward_timeout_s
                     if dl is not None:
                         if dl.expired():
-                            self._respond(
-                                504, b'{"error": "deadline expired"}')
+                            respond(504, b'{"error": "deadline expired"}',
+                                    outcome="deadline_expired")
                             return
                         timeout = max(dl.cap(timeout), 1e-3)
+                    t_f0w, t_f0 = time.time(), time.perf_counter()
+
+                    def fwd_span(**attrs):
+                        if fwd is not None:
+                            front.tracer.record(
+                                "forward", fwd, t_f0w,
+                                time.perf_counter() - t_f0,
+                                worker=addr, **attrs)
+
                     try:
                         faults.fire(faults.WORKER_FORWARD, addr=addr,
                                     path=path)
                         with urlopen(req, timeout=timeout) as resp:
                             front._note_success(addr)
-                            self._respond(
+                            fwd_span(status=resp.status)
+                            respond(
                                 resp.status, resp.read(),
                                 resp.headers.get("Content-Type",
-                                                 "application/json"))
+                                                 "application/json"),
+                                outcome="forwarded")
                             return
                     except HTTPError as e:
                         # worker answered (e.g. 500 from the pipeline):
                         # authoritative, do not retry elsewhere
                         front._note_success(addr)
-                        self._respond(e.code, e.read() or b"",
-                                      e.headers.get("Content-Type",
-                                                    "text/plain"))
+                        fwd_span(status=e.code)
+                        respond(e.code, e.read() or b"",
+                                e.headers.get("Content-Type", "text/plain"),
+                                outcome="forwarded")
                         return
                     except (URLError, OSError) as e:
                         front._note_failure(addr)
+                        fwd_span(error=str(getattr(e, "reason", e)))
                         reason = getattr(e, "reason", e)
                         timed_out = isinstance(reason, TimeoutError) or \
                             "timed out" in str(reason).lower()
                         if timed_out and not idempotent:
-                            self._respond(504, json.dumps(
+                            respond(504, json.dumps(
                                 {"error": f"worker {addr} timed out; not "
                                           f"replayed (non-idempotent)"}
-                            ).encode())
+                            ).encode(), outcome="timeout_unreplayed")
                             return
                         continue
-                self._respond(502, b'{"error": "all workers failed"}')
+                respond(502, b'{"error": "all workers failed"}',
+                        outcome="all_workers_failed")
 
             do_POST = _handle
             do_GET = _handle

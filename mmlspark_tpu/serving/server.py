@@ -41,6 +41,10 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.faults import deadline_from_headers
+from ..obs import bridge as obs_bridge
+from ..obs import trace as obs_trace
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import Tracer
 
 #: header carrying the shared cluster secret for internal endpoints
 TOKEN_HEADER = "X-MMLSpark-Token"
@@ -172,9 +176,9 @@ class _Prepared:
     """One drained batch, deadline-gated, stamped, and journaled — the unit
     that flows through the sync loop and the async executor's stages."""
 
-    __slots__ = ("rows", "ids", "df", "epoch", "queue_s", "n", "seq")
+    __slots__ = ("rows", "ids", "df", "epoch", "queue_s", "n", "seq", "ctxs")
 
-    def __init__(self, rows, ids, df, epoch, queue_s):
+    def __init__(self, rows, ids, df, epoch, queue_s, ctxs=None):
         self.rows = rows        # [(rid, body, headers), ...]
         self.ids = ids          # np.int64 array
         self.df = df            # ingress DataFrame (id/value/headers/origin)
@@ -182,6 +186,8 @@ class _Prepared:
         self.queue_s = queue_s  # mean ingress->drain wait of the batch
         self.n = len(rows)
         self.seq = 0            # executor pipeline sequence number
+        # rid -> sampled SpanContext for traced requests in this batch
+        self.ctxs = ctxs if ctxs is not None else {}
 
 
 class ServingServer:
@@ -207,6 +213,14 @@ class ServingServer:
 
     # internal reply endpoint (cross-machine replyTo, HTTPSourceV2.scala:516-545)
     INTERNAL_REPLY_PATH = "/_mmlspark/reply"
+    #: Prometheus text-format exposition (obs/metrics.py registry + bridge)
+    METRICS_PATH = "/_mmlspark/metrics"
+    #: constant-cost liveness probe (the RoutingFront's PROBE_PATH): a tiny
+    #: fixed payload instead of the full /_mmlspark/stats summary, whose
+    #: cost scales with the latency window / executor timeline sizes
+    HEALTH_PATH = "/_mmlspark/healthz"
+    #: buffered spans as JSON (debug surface; exporters write JSONL/Perfetto)
+    TRACE_PATH = "/_mmlspark/trace"
 
     def __init__(self, transform: Callable[[DataFrame], DataFrame],
                  host: str = "127.0.0.1", port: int = 8898,
@@ -220,7 +234,9 @@ class ServingServer:
                  max_queue: int = 0, drain_timeout_s: float = 5.0,
                  async_exec: bool = False, inflight: int = 2,
                  replicas: int = 1, adaptive_batching: bool = True,
-                 devices: Optional[list] = None, controller=None):
+                 devices: Optional[list] = None, controller=None,
+                 obs: bool = True, tracer: Optional[Tracer] = None,
+                 trace_sample_rate: float = 1.0):
         self.transform = transform
         # optional provider of the device-ingest decomposition (queue/h2d/
         # compute/readback — parallel/ingest.IngestStats.summary) merged into
@@ -281,6 +297,20 @@ class ServingServer:
         self.requests_served = 0
         self.stats = LatencyStats()
         self.warmup_ok: Optional[bool] = None  # None until warmup() runs
+        # observability (obs/): per-server metrics registry with bridge
+        # collectors over the existing stats surfaces + a tracer whose
+        # head-based sampling decision rides X-MMLSpark-Trace across hops.
+        # ``obs=False`` strips the whole layer (the bench A/B baseline).
+        self.obs_enabled = bool(obs)
+        self.registry: Optional[MetricsRegistry] = None
+        self.tracer: Optional[Tracer] = None
+        self._traces: Dict[int, obs_trace.SpanContext] = {}
+        if self.obs_enabled:
+            self.registry = MetricsRegistry()
+            self.tracer = tracer if tracer is not None else Tracer(
+                sample_rate=trace_sample_rate, service=name)
+            obs_bridge.fold_server(self.registry, self)
+            obs_bridge.fold_tracer(self.registry, self.tracer)
 
     # -- ingress ---------------------------------------------------------
     def _make_handler(self):
@@ -312,6 +342,7 @@ class ServingServer:
                             content_type=msg.get("content_type"))
                         server._maybe_commit_epochs()
                         self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
                         self.send_header("Content-Length", "0")
                         self.end_headers()
                     except Exception as e:  # noqa: BLE001
@@ -340,6 +371,44 @@ class ServingServer:
                         except Exception as e:  # noqa: BLE001
                             summary["fusion"] = {"error": str(e)}
                     body = json.dumps(summary).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == ServingServer.HEALTH_PATH:
+                    # constant-cost liveness probe: payload size does not
+                    # scale with the stats window (the old PROBE_PATH did)
+                    body = json.dumps(
+                        {"ok": True,
+                         "draining": server._draining.is_set()}
+                    ).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == ServingServer.METRICS_PATH:
+                    if server.registry is None:
+                        self.send_error(404, "observability disabled")
+                        return
+                    body = server.registry.exposition().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     MetricsRegistry.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == ServingServer.TRACE_PATH:
+                    if server.tracer is None:
+                        self.send_error(404, "observability disabled")
+                        return
+                    body = json.dumps(
+                        {"stats": server.tracer.stats(),
+                         "spans": server.tracer.spans()}).encode("utf-8")
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
@@ -383,19 +452,35 @@ class ServingServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                # trace ingress: continue the hop in X-MMLSpark-Trace or
+                # originate one (head-based sampling decides HERE; batch
+                # stages only ever see sampled contexts)
+                tctx = None
+                if server.tracer is not None:
+                    tctx = server.tracer.ingress(self.headers)
+                    if not tctx.sampled:
+                        tctx = None
                 slot = _ReplySlot()
                 slot.t_in = time.perf_counter()
+                t_wall_in = time.time()
                 with server._id_lock:
                     rid = server._next_id
                     server._next_id += 1
                     server._slots[rid] = slot
+                    if tctx is not None:
+                        server._traces[rid] = tctx
                 server._queue.put((rid, body, dict(self.headers.items())))
                 server._wake.set()
                 ok = slot.event.wait(timeout=server.slot_timeout_s)
                 with server._id_lock:
                     server._slots.pop(rid, None)
+                    server._traces.pop(rid, None)
                 if not ok:
                     server.stats.record_shed(504, "slot_timeout")
+                    if tctx is not None:
+                        server.tracer.record(
+                            "ingress", tctx, t_wall_in,
+                            time.perf_counter() - slot.t_in, status=504)
                     self.send_error(504, "batch timeout")
                     return
                 self.send_response(slot.status)
@@ -411,6 +496,13 @@ class ServingServer:
                     server.stats.record(slot.t_drain - slot.t_in,
                                         slot.t_done - slot.t_drain,
                                         t_end - slot.t_in, slot.batch)
+                if tctx is not None:
+                    # the request's root span on this hop: covers queue wait,
+                    # batch stages (its children), and the reply write
+                    server.tracer.record(
+                        "ingress", tctx, t_wall_in,
+                        time.perf_counter() - slot.t_in,
+                        status=slot.status, batch=slot.batch)
 
             do_POST = _handle
             do_GET = _handle
@@ -505,6 +597,7 @@ class ServingServer:
             return None
         t_drain = time.perf_counter()
         waits = []
+        ctxs = {}
         with self._id_lock:
             for rid, _, _ in batch:
                 s = self._slots.get(rid)
@@ -512,6 +605,9 @@ class ServingServer:
                     s.t_drain = t_drain
                     s.batch = len(batch)
                     waits.append(t_drain - s.t_in)
+                ctx = self._traces.get(rid)
+                if ctx is not None:
+                    ctxs[rid] = ctx
         ids, df = self._build_df(batch)
         epoch = None
         if self._journal is not None:
@@ -527,7 +623,7 @@ class ServingServer:
                 # a crash mid-transform of this one epoch
                 pass
         queue_s = float(sum(waits) / len(waits)) if waits else 0.0
-        return _Prepared(batch, ids, df, epoch, queue_s)
+        return _Prepared(batch, ids, df, epoch, queue_s, ctxs=ctxs)
 
     def _regate_inflight(self, prep: _Prepared) -> Optional[_Prepared]:
         """Re-run the deadline gate on a staged batch just before dispatch
@@ -539,9 +635,19 @@ class ServingServer:
         if not live:
             return None
         ids, df = self._build_df(live)
-        out = _Prepared(live, ids, df, prep.epoch, prep.queue_s)
+        keep = {rid for rid, _, _ in live}
+        ctxs = {rid: c for rid, c in prep.ctxs.items() if rid in keep}
+        out = _Prepared(live, ids, df, prep.epoch, prep.queue_s, ctxs=ctxs)
         out.seq = prep.seq
         return out
+
+    def _trace_batch(self, name: str, prep: "_Prepared", t0_wall: float,
+                     dur_s: float, **attrs) -> None:
+        """Record one batch-stage span per traced request in ``prep``
+        (no-op when obs is off or nothing in the batch is sampled)."""
+        if self.tracer is not None and prep.ctxs:
+            self.tracer.record_batch(name, list(prep.ctxs.values()),
+                                     t0_wall, dur_s, rows=prep.n, **attrs)
 
     def _apply_output(self, ids, out) -> None:
         """Fulfill reply slots from a transform output DataFrame (errors
@@ -583,15 +689,29 @@ class ServingServer:
             batch = self._drain_batch()
             if not batch:
                 continue
+            tw, tp = time.time(), time.perf_counter()
             prep = self._prepare_batch(batch)
             if prep is None:
                 continue
+            self._trace_batch("drain", prep, tw, time.perf_counter() - tp)
+            tw, tp = time.time(), time.perf_counter()
             try:
-                out = self.transform(prep.df)
+                # batch_context makes the traced requests visible to deep
+                # layers (TransferRing H2D staging, fused segments)
+                with obs_trace.batch_context(self.tracer,
+                                             list(prep.ctxs.values())):
+                    out = self.transform(prep.df)
             except Exception as e:  # noqa: BLE001 — keep serving
+                self._trace_batch("dispatch", prep, tw,
+                                  time.perf_counter() - tp, error=str(e))
                 self._fail_batch(prep.ids, e)
             else:
+                self._trace_batch("dispatch", prep, tw,
+                                  time.perf_counter() - tp)
+                tw, tp = time.time(), time.perf_counter()
                 self._apply_output(prep.ids, out)
+                self._trace_batch("readback", prep, tw,
+                                  time.perf_counter() - tp)
             self._maybe_commit_epochs()
 
     def _maybe_commit_epochs(self, force: bool = False) -> None:
@@ -826,8 +946,9 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    journal_path: Optional[str] = None,
                    max_queue: int = 0, fused: bool = False,
                    async_exec: bool = False, inflight: int = 2,
-                   replicas: int = 1,
-                   adaptive_batching: bool = True) -> ServingServer:
+                   replicas: int = 1, adaptive_batching: bool = True,
+                   obs: bool = True,
+                   trace_sample_rate: float = 1.0) -> ServingServer:
     """Serve a fitted Transformer: request body -> ``input_col`` -> stage ->
     ``reply_col`` (IOImplicits fluent sugar parity, io/IOImplicits.scala:182-213).
 
@@ -893,4 +1014,5 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                          fusion_stats=fusion, max_queue=max_queue,
                          async_exec=async_exec, inflight=inflight,
                          replicas=replicas,
-                         adaptive_batching=adaptive_batching)
+                         adaptive_batching=adaptive_batching, obs=obs,
+                         trace_sample_rate=trace_sample_rate)

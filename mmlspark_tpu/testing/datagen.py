@@ -7,9 +7,11 @@ substrate: instead of per-row RDD generators, whole columns are drawn
 vectorized from a seeded ``numpy.random.Generator``, and missing values are
 injected column-wise. The option space matches the reference — per-column
 (column-kind x data-kind) choices sampled from a constrained set, optional
-missing-value injection with a target rate — plus vector columns, which the
-reference left as a TODO (DatasetOptions.scala:12 "TODO: add Categorical,
-DenseVector, SparseVector").
+missing-value injection with a target rate — plus vector columns and
+categorical columns, both of which the reference left as a TODO
+(DatasetOptions.scala:12 "TODO: add Categorical, DenseVector,
+SparseVector"; categorical is opt-in via EXTENDED_DATA_KINDS so seeded
+draws from the default kind set are unchanged).
 
 Used by tests/test_fuzzing.py to drive featurize stages over randomly-shaped
 inputs, the way VerifyGenerateDataset + the featurize fuzz suites use it in
@@ -30,6 +32,18 @@ from ..core.dataframe import DataFrame
 #: date/timestamp are drawn as numpy datetime64 -> object columns)
 DATA_KINDS = ("string", "int", "double", "boolean", "date", "timestamp",
               "byte", "short")
+
+#: extension kinds resolving the reference TODO (DatasetOptions.scala:12
+#: "TODO: add Categorical, DenseVector, SparseVector"): ``categorical``
+#: draws from a small per-column vocabulary (``cat_0..cat_{k-1}`` strings),
+#: the low-cardinality shape ValueIndexer/observability mixed-dtype tests
+#: need. Kept OUT of DATA_KINDS so the default sampling distribution — and
+#: every seeded draw existing suites depend on — is unchanged; opt in per
+#: column via ``ColumnOptions(data_kinds=("categorical", ...))``.
+EXTENDED_DATA_KINDS = DATA_KINDS + ("categorical",)
+
+#: categorical vocabulary size range drawn per column
+CATEGORICAL_CARDINALITY = (2, 8)
 
 #: column kinds (reference ColumnOptions — Scalar only; vector is our
 #: extension for the VectorAssembler/featurize paths)
@@ -62,7 +76,7 @@ class ColumnOptions:
     missing: MissingOptions = MissingOptions()
 
     def __post_init__(self):
-        bad = set(self.data_kinds) - set(DATA_KINDS)
+        bad = set(self.data_kinds) - set(EXTENDED_DATA_KINDS)
         if bad:
             raise ValueError(f"unknown data kinds: {sorted(bad)}")
         bad = set(self.column_kinds) - set(COLUMN_KINDS)
@@ -137,6 +151,15 @@ def _draw_scalar(kind: str, n: int, rng: np.random.Generator) -> np.ndarray:
             ts = np.datetime64(int(secs[i]), "s")
             out[i] = ts.astype("datetime64[D]") if kind == "date" else ts
         return out
+    if kind == "categorical":
+        # low-cardinality string vocabulary (the reference TODO's
+        # Categorical): k levels drawn once per column, then sampled per
+        # row — every level name is stable across seeds for a fixed rng
+        # stream, so ValueIndexer round-trips are reproducible
+        lo, hi = CATEGORICAL_CARDINALITY
+        k = int(rng.integers(lo, hi + 1))
+        levels = np.array([f"cat_{i}" for i in range(k)], dtype=object)
+        return levels[rng.integers(0, k, size=n)]
     raise ValueError(f"unknown data kind {kind!r}")
 
 

@@ -173,9 +173,24 @@ class ComputePerInstanceStatistics(Transformer, HasLabelCol, HasScoredLabelsCol,
 
 
 class MetricsLogger:
-    """Metric emission into the logging system (ComputeModelStatistics.scala:461+)."""
+    """Metric emission (ComputeModelStatistics.scala:461-470 parity, both
+    halves): every metric goes to the logging system AND into an obs
+    MetricsRegistry as ``mmlspark_eval_metric{metric=...}`` gauges — the
+    reference pushed into Spark's metrics sink; here the registry makes
+    eval results scrapeable at ``/_mmlspark/metrics``, not just a returned
+    DataFrame. Non-numeric values are logged but not gauged."""
 
     @staticmethod
-    def log_metrics(metrics: Dict[str, Any]) -> None:
+    def log_metrics(metrics: Dict[str, Any], registry=None) -> None:
+        from ..obs.metrics import default_registry
+
+        reg = registry if registry is not None else default_registry()
+        gauge = reg.gauge("mmlspark_eval_metric",
+                          "last ComputeModelStatistics value per metric",
+                          ("metric",))
         for k, v in metrics.items():
             log.info("metric %s=%s", k, v)
+            try:
+                gauge.labels(metric=str(k)).set(float(v))
+            except (TypeError, ValueError):
+                pass

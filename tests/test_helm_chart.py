@@ -60,6 +60,28 @@ class TestDefaults:
         by_kind_name(docs, "Deployment", "prod-worker")
 
 
+class TestMetrics:
+    def test_prometheus_annotations_default_on(self):
+        _, docs = render_docs()
+        for suffix, port in (("-front", 8898), ("-worker", 8899)):
+            dep = by_kind_name(docs, "Deployment", suffix)
+            meta = dep["spec"]["template"]["metadata"]
+            ann = meta["annotations"]
+            assert ann["prometheus.io/scrape"] == "true"
+            assert ann["prometheus.io/path"] == "/_mmlspark/metrics"
+            assert ann["prometheus.io/port"] == str(port)
+            ports = dep["spec"]["template"]["spec"]["containers"][0]["ports"]
+            assert ports[0]["name"] == "http-metrics"
+            assert ports[0]["containerPort"] == port
+
+    def test_metrics_disabled_drops_annotations(self):
+        _, docs = render_docs({"metrics": {"enabled": False}})
+        for suffix in ("-front", "-worker"):
+            dep = by_kind_name(docs, "Deployment", suffix)
+            meta = dep["spec"]["template"]["metadata"]
+            assert "annotations" not in meta
+
+
 class TestOptions:
     def test_token_auth_wires_secret(self):
         _, docs = render_docs({"token": {"enabled": True,
