@@ -28,10 +28,15 @@ _PACKAGES = [
 _EXTRA_INDEX = [
     "- [serving](serving.md) (hand-maintained; not stage-registry classes): "
     "`ServingServer`, `serve_pipeline`, `AdaptiveBatchController`, "
-    "`ReplicaSet`, `PipelinedExecutor`, `RoutingFront`",
+    "`ReplicaSet`, `PipelinedExecutor`, `RoutingFront`, `AsyncHTTPServer`, "
+    "`AsyncConnectionPool`, `TenantAdmission`",
     "- [obs](obs.md) (hand-maintained; not stage-registry classes): "
     "`MetricsRegistry`, `Counter`, `Gauge`, `Histogram`, `Tracer`, "
     "`SpanContext`, `TrainRecorder`, bridge adapters",
+    "- wire frames (`mmlspark_tpu.io.binary`, hand-maintained spec in "
+    "[docs/serving.md](../serving.md)): `encode_frame`, `decode_frame`, "
+    "`frame_info`, `FRAME_CONTENT_TYPE` — the zero-copy binary columnar "
+    "request format",
 ]
 
 

@@ -67,6 +67,8 @@ def _latency_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
         sheds.add(n, {"kind": "status", "value": str(status)})
     for reason, n in (shed.get("by_reason") or {}).items():
         sheds.add(n, {"kind": "reason", "value": str(reason)})
+    for tenant, n in (shed.get("by_tenant") or {}).items():
+        sheds.add(n, {"kind": "tenant", "value": str(tenant)})
     yield sheds
 
 
@@ -165,10 +167,54 @@ def _executor_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
     yield rows
 
 
+def _wire_families(server: Any) -> Iterable[MetricFamily]:
+    """Per-wire-format ingress counters (the binary frame wire A/B signal:
+    requests and body bytes by ``format`` = json | binary)."""
+    with server._wire_lock:
+        counts = dict(server.wire_counts)
+        nbytes = dict(server.wire_bytes)
+    reqs = MetricFamily("mmlspark_wire_requests_total", "counter",
+                        "public requests by wire format")
+    byts = MetricFamily("mmlspark_wire_bytes_total", "counter",
+                        "request body bytes by wire format")
+    for fmt, n in counts.items():
+        reqs.add(n, {"format": fmt})
+    for fmt, n in nbytes.items():
+        byts.add(n, {"format": fmt})
+    yield reqs
+    yield byts
+
+
+def _tenant_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
+    """Per-tenant admission-class gauges/counters (weighted-fair shedding:
+    a light tenant's shed rate staying below a heavy tenant's is readable
+    straight off mmlspark_tenant_sheds_total)."""
+    weight = MetricFamily("mmlspark_tenant_weight", "gauge",
+                          "configured admission weight per tenant")
+    inflight = MetricFamily("mmlspark_tenant_inflight", "gauge",
+                            "admitted-unanswered requests per tenant")
+    admitted = MetricFamily("mmlspark_tenant_admitted_total", "counter",
+                            "admissions per tenant")
+    shed = MetricFamily("mmlspark_tenant_sheds_total", "counter",
+                        "weighted-fair sheds per tenant")
+    for tenant, s in summary.items():
+        labels = {"tenant": tenant}
+        for fam, key in ((weight, "weight"), (inflight, "inflight"),
+                         (admitted, "admitted"), (shed, "shed")):
+            f = _num(s.get(key))
+            if f is not None:
+                fam.add(f, labels)
+    yield weight
+    yield inflight
+    yield admitted
+    yield shed
+
+
 def fold_server(registry: MetricsRegistry, server: Any) -> None:
     """Register collectors reading a ServingServer's live stats surfaces:
-    LatencyStats window + shed counters, the admission queue, the async
-    executor, and the ingest/fusion providers when wired (serve_pipeline).
+    LatencyStats window + shed counters, the admission queue, wire-format
+    and tenant admission counters, the async executor, and the
+    ingest/fusion providers when wired (serve_pipeline).
     Safe to call before start() — everything is read at scrape time."""
 
     def collect() -> List[MetricFamily]:
@@ -185,6 +231,10 @@ def fold_server(registry: MetricsRegistry, server: Any) -> None:
             "1 while the server refuses new work (graceful stop)").add(
                 1.0 if server._draining.is_set() else 0.0))
         fams.extend(_latency_families(server.stats.summary()))
+        if getattr(server, "_wire_lock", None) is not None:
+            fams.extend(_wire_families(server))
+        if getattr(server, "_tenants", None) is not None:
+            fams.extend(_tenant_families(server._tenants.summary()))
         if server._executor is not None:
             try:
                 fams.extend(_executor_families(server._executor.stats()))

@@ -191,6 +191,49 @@ class IngestStats:
         return out
 
 
+def rows_to_batch(rows) -> np.ndarray:
+    """Per-row arrays -> one contiguous [B, ...] batch for H2D staging.
+
+    The binary-wire ingest path: ``decode_frame`` hands each request's
+    payload back as a zero-copy VIEW over its body bytes, and this is the
+    single host copy that remains — the batch stack that doubles as the
+    transfer ring's staging buffer (uint8 on the wire, cast/scale on
+    device via PreprocessSpec).
+
+    Fast path: when the rows are adjacent views over ONE buffer (a client
+    shipped a whole batch in one frame column, or journal replay of a
+    concatenated region), the batch is a strided view — zero copies
+    end-to-end. Otherwise ``np.stack``. Rows must agree on shape and dtype
+    (ragged batches stay on the per-row host path)."""
+    arrs = [np.asarray(r) for r in rows]
+    if not arrs:
+        raise ValueError("rows_to_batch needs at least one row")
+    shape, dt = arrs[0].shape, arrs[0].dtype
+    for a in arrs[1:]:
+        if a.shape != shape or a.dtype != dt:
+            raise ValueError(
+                f"ragged batch: {a.shape}/{a.dtype} vs {shape}/{dt}")
+    if len(arrs) == 1:
+        return arrs[0][None] if arrs[0].flags["C_CONTIGUOUS"] \
+            else np.ascontiguousarray(arrs[0])[None]
+    nb = arrs[0].nbytes
+    if nb and all(a.flags["C_CONTIGUOUS"] for a in arrs):
+        try:
+            ptr0 = arrs[0].__array_interface__["data"][0]
+            adjacent = all(
+                a.__array_interface__["data"][0] == ptr0 + i * nb
+                for i, a in enumerate(arrs))
+        except (KeyError, TypeError):
+            adjacent = False
+        if adjacent:
+            # one spanning view over the shared buffer; arrs[0] rides along
+            # as .base so the underlying memory stays alive
+            return np.lib.stride_tricks.as_strided(
+                arrs[0], shape=(len(arrs),) + shape,
+                strides=(nb,) + arrs[0].strides)
+    return np.stack(arrs)
+
+
 def _tree_rows(item: Any) -> int:
     """Valid rows in a batch: Batch.num_valid when present, else the leading
     dim of a raw array batch."""

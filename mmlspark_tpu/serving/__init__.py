@@ -15,8 +15,13 @@ from .journal import RequestJournal
 from .stages import parse_request, make_reply
 from .executor import (AdaptiveBatchController, PipelinedExecutor, Replica,
                        ReplicaSet)
+from .aio import AsyncConnectionPool, AsyncHTTPServer
+from .tenants import TENANT_HEADER, TenantAdmission, tenants_from_spec
 
-__all__ = ["AdaptiveBatchController", "PipelinedExecutor", "PortForwarder",
+__all__ = ["AdaptiveBatchController", "AsyncConnectionPool",
+           "AsyncHTTPServer", "PipelinedExecutor", "PortForwarder",
            "Replica", "ReplicaSet", "RequestJournal", "RoutingFront",
-           "ServingServer", "build_ssh_command", "make_reply",
-           "parse_request", "register_worker", "reply_to", "serve_pipeline"]
+           "ServingServer", "TENANT_HEADER", "TenantAdmission",
+           "build_ssh_command", "make_reply", "parse_request",
+           "register_worker", "reply_to", "serve_pipeline",
+           "tenants_from_spec"]

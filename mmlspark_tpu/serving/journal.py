@@ -10,10 +10,18 @@ has been answered (or abandoned by its client). After a crash, ``recover``
 returns the uncommitted requests so a supervisor can re-submit them to a
 fresh server — at-least-once processing for side-effecting pipelines.
 
-Format: JSONL, one op per line:
+Format: JSONL with an optional length-prefixed binary record variant.
+JSON records (one op per line):
     {"op": "entry", "epoch": E, "id": rid, "body_b64": ..., "headers": {...}}
     {"op": "commit", "epoch": E}
-``compact`` rewrites the file dropping committed epochs.
+Binary records (bodies that are wire frames — io/binary.py magic — would
+pay a 33% base64 inflation as JSON; instead the header line carries the
+byte count and the raw body follows verbatim):
+    {"op": "entry_bin", "epoch": E, "id": rid, "nbytes": N, "headers": ...}
+    <N raw body bytes>\\n
+Readers handle both variants in one file, so a journal written before the
+binary wire existed replays unchanged. ``compact`` rewrites the file
+dropping committed epochs, preserving each entry's record variant.
 """
 
 from __future__ import annotations
@@ -22,10 +30,11 @@ import base64
 import json
 import os
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..core import faults
 from ..core.faults import fsync_dir
+from ..io.binary import is_frame
 
 
 class RequestJournal:
@@ -33,22 +42,31 @@ class RequestJournal:
         self.path = path
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._fh = open(path, "a", encoding="utf-8")
+        self._fh = open(path, "ab")
 
     # -- write side (server) ----------------------------------------------
     @staticmethod
-    def _entry(epoch: int, rid: int, body: bytes,
-               headers: Optional[Dict[str, str]]) -> str:
-        return json.dumps({
+    def _record(epoch: int, rid: int, body: bytes,
+                headers: Optional[Dict[str, str]]) -> bytes:
+        """One journal record, variant chosen by the body: wire frames are
+        stored raw behind a length-prefixed header line (no base64
+        inflation); everything else stays a plain JSONL entry."""
+        body = bytes(body)
+        if is_frame(body):
+            head = json.dumps({
+                "op": "entry_bin", "epoch": int(epoch), "id": int(rid),
+                "nbytes": len(body), "headers": dict(headers or {})})
+            return head.encode("utf-8") + b"\n" + body + b"\n"
+        return (json.dumps({
             "op": "entry", "epoch": int(epoch), "id": int(rid),
-            "body_b64": base64.b64encode(bytes(body)).decode("ascii"),
-            "headers": dict(headers or {})})
+            "body_b64": base64.b64encode(body).decode("ascii"),
+            "headers": dict(headers or {})}) + "\n").encode("utf-8")
 
     def append(self, epoch: int, rid: int, body: bytes,
                headers: Optional[Dict[str, str]] = None) -> None:
         faults.fire(faults.JOURNAL_WRITE, epoch=epoch, n=1)
         with self._lock:
-            self._fh.write(self._entry(epoch, rid, body, headers) + "\n")
+            self._fh.write(self._record(epoch, rid, body, headers))
             self._fh.flush()
             os.fsync(self._fh.fileno())
 
@@ -56,19 +74,20 @@ class RequestJournal:
         """Journal a whole epoch with ONE flush+fsync (the hot batch path:
         durability is per-epoch, so per-request fsyncs buy nothing).
         ``entries``: iterable of (rid, body, headers)."""
-        lines = [self._entry(epoch, rid, body, headers)
-                 for rid, body, headers in entries]
-        faults.fire(faults.JOURNAL_WRITE, epoch=epoch, n=len(lines))
+        recs = [self._record(epoch, rid, body, headers)
+                for rid, body, headers in entries]
+        faults.fire(faults.JOURNAL_WRITE, epoch=epoch, n=len(recs))
         with self._lock:
-            self._fh.write("\n".join(lines) + "\n")
+            self._fh.write(b"".join(recs))
             self._fh.flush()
             os.fsync(self._fh.fileno())
 
     def commit(self, epoch: int) -> None:
         faults.fire(faults.JOURNAL_COMMIT, epoch=epoch)
         with self._lock:
-            self._fh.write(json.dumps({"op": "commit",
-                                       "epoch": int(epoch)}) + "\n")
+            self._fh.write((json.dumps({"op": "commit",
+                                        "epoch": int(epoch)}) +
+                            "\n").encode("utf-8"))
             self._fh.flush()
             os.fsync(self._fh.fileno())
 
@@ -84,20 +103,30 @@ class RequestJournal:
             return {}
         entries: Dict[int, List[Tuple[int, bytes, Dict[str, str]]]] = {}
         committed = set()
-        with open(path, encoding="utf-8") as fh:
+        with open(path, "rb") as fh:
             for line in fh:
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
+                    rec = json.loads(line.decode("utf-8"))
+                except (json.JSONDecodeError, UnicodeDecodeError):
                     # torn final line from a crash mid-append — exactly the
                     # case recovery exists for; skip it (that request never
                     # reached the transform)
                     continue
+                if not isinstance(rec, dict) or "op" not in rec:
+                    continue
                 if rec["op"] == "commit":
                     committed.add(rec["epoch"])
+                elif rec["op"] == "entry_bin":
+                    # length-prefixed raw body follows the header line
+                    body = fh.read(int(rec["nbytes"]))
+                    if len(body) != int(rec["nbytes"]):
+                        continue  # torn binary tail: crash mid-append
+                    fh.read(1)  # trailing newline
+                    entries.setdefault(rec["epoch"], []).append(
+                        (rec["id"], body, rec.get("headers", {})))
                 else:
                     entries.setdefault(rec["epoch"], []).append(
                         (rec["id"], base64.b64decode(rec["body_b64"]),
@@ -128,11 +157,11 @@ class RequestJournal:
                 pending = self._pending_by_epoch(self.path)
                 tmp = self.path + ".tmp"
                 try:
-                    with open(tmp, "w", encoding="utf-8") as fh:
+                    with open(tmp, "wb") as fh:
                         for epoch in sorted(pending):
                             for rid, body, headers in pending[epoch]:
-                                fh.write(self._entry(epoch, rid, body,
-                                                     headers) + "\n")
+                                fh.write(self._record(epoch, rid, body,
+                                                      headers))
                         fh.flush()
                         os.fsync(fh.fileno())
                     os.replace(tmp, self.path)
@@ -146,4 +175,4 @@ class RequestJournal:
             finally:
                 # reopen even on failure: the journal must stay writable
                 # (the old complete file is still in place)
-                self._fh = open(self.path, "a", encoding="utf-8")
+                self._fh = open(self.path, "ab")

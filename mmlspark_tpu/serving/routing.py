@@ -85,7 +85,8 @@ class RoutingFront:
                  probe_timeout_s: float = 2.0,
                  probe_policy: Optional[RetryPolicy] = None,
                  obs: bool = True, tracer: Optional[Tracer] = None,
-                 trace_sample_rate: float = 1.0):
+                 trace_sample_rate: float = 1.0,
+                 http_mode: str = "thread"):
         self.host = host
         self.port = port
         self.forward_timeout_s = forward_timeout_s
@@ -93,6 +94,18 @@ class RoutingFront:
         self.token = token  # when set, /register requires X-MMLSpark-Token
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
+        # HTTP transport: "thread" = ThreadingHTTPServer + one urlopen
+        # socket per forward; "async" = event-loop ingress (serving/aio.py)
+        # + pooled keep-alive worker connections — the hop stops paying a
+        # TCP connect per forwarded request, and frame bodies pass through
+        # as the same opaque bytes (no decode/re-encode on this hop in
+        # either mode)
+        if http_mode not in ("thread", "async"):
+            raise ValueError(f"http_mode must be 'thread' or 'async', "
+                             f"got {http_mode!r}")
+        self.http_mode = http_mode
+        self._aio = None
+        self._pool = None  # AsyncConnectionPool (async mode, loop thread)
         # probe backoff: open workers are re-probed on a jittered exponential
         # schedule (deterministic when the policy is seeded)
         self.probe_policy = probe_policy or RetryPolicy(
@@ -243,6 +256,41 @@ class RoutingFront:
                                 c.probe_attempt, self._probe_rng)
 
     # -- HTTP ---------------------------------------------------------------
+    def _control(self, path: str, body: bytes, headers
+                 ) -> Optional[tuple]:
+        """Control-plane endpoints shared by both transports: returns
+        (status, content_type, body) or None when the request should be
+        forwarded to a worker."""
+        if path == RoutingFront.REGISTER_PATH:
+            from .server import TOKEN_HEADER
+            if self.token is not None and \
+                    headers.get(TOKEN_HEADER) != self.token:
+                return (403, "application/json",
+                        b'{"error": "bad cluster token"}')
+            try:
+                msg = json.loads(body.decode())
+                self.register(msg["address"],
+                              capacity=int(msg.get("capacity", 1)))
+                return (200, "application/json", b"{}")
+            except Exception as e:  # noqa: BLE001
+                return (400, "application/json",
+                        json.dumps({"error": str(e)}).encode())
+        if path == RoutingFront.WORKERS_PATH:
+            return (200, "application/json", json.dumps(
+                {"workers": self.workers,
+                 "states": self.worker_states,
+                 "capacity": self.worker_capacities}).encode())
+        if path == RoutingFront.HEALTH_PATH:
+            return (200, "application/json", json.dumps(
+                {"ok": True, "workers": len(self.workers)}).encode())
+        if path == RoutingFront.METRICS_PATH:
+            if self.registry is None:
+                return (404, "application/json",
+                        b'{"error": "observability disabled"}')
+            return (200, MetricsRegistry.CONTENT_TYPE,
+                    self.registry.exposition().encode("utf-8"))
+        return None
+
     def _make_handler(self):
         front = self
 
@@ -271,40 +319,10 @@ class RoutingFront:
                 incoming = urlsplit(self.path)
                 path = incoming.path.rstrip("/")
                 body = self._read_body()
-                if path == RoutingFront.REGISTER_PATH:
-                    from .server import TOKEN_HEADER
-                    if front.token is not None and \
-                            self.headers.get(TOKEN_HEADER) != front.token:
-                        self._respond(403, b'{"error": "bad cluster token"}')
-                        return
-                    try:
-                        msg = json.loads(body.decode())
-                        front.register(msg["address"],
-                                       capacity=int(msg.get("capacity", 1)))
-                        self._respond(200, b"{}")
-                    except Exception as e:  # noqa: BLE001
-                        self._respond(400, json.dumps(
-                            {"error": str(e)}).encode())
-                    return
-                if path == RoutingFront.WORKERS_PATH:
-                    self._respond(200, json.dumps(
-                        {"workers": front.workers,
-                         "states": front.worker_states,
-                         "capacity": front.worker_capacities}).encode())
-                    return
-                if path == RoutingFront.HEALTH_PATH:
-                    self._respond(200, json.dumps(
-                        {"ok": True,
-                         "workers": len(front.workers)}).encode())
-                    return
-                if path == RoutingFront.METRICS_PATH:
-                    if front.registry is None:
-                        self._respond(
-                            404, b'{"error": "observability disabled"}')
-                        return
-                    self._respond(
-                        200, front.registry.exposition().encode("utf-8"),
-                        ctype=MetricsRegistry.CONTENT_TYPE)
+                ctrl = front._control(path, body, self.headers)
+                if ctrl is not None:
+                    status, ctype, cbody = ctrl
+                    self._respond(status, cbody, ctype)
                     return
                 # trace ingress: the front originates (or continues) the
                 # trace; each forward attempt ships a child context to the
@@ -424,15 +442,126 @@ class RoutingFront:
 
         return Handler
 
+    async def _aio_handle(self, req):
+        """Async-transport handler (serving/aio.py): same control plane,
+        circuit-breaker notes, deadline gates, trace spans, and
+        idempotent-replay rules as the threaded handler — but forwards ride
+        the keep-alive connection pool instead of a fresh urlopen socket,
+        and request/response bodies pass through as opaque bytes."""
+        import asyncio
+
+        from .aio import HTTPResponse
+        from ..obs.trace import TRACE_HEADER
+
+        incoming = urlsplit(req.path)
+        path = incoming.path.rstrip("/")
+        body = req.body
+        ctrl = self._control(path, body, req.headers)
+        if ctrl is not None:
+            status, ctype, cbody = ctrl
+            return HTTPResponse(status, cbody, ctype)
+        tctx = self.tracer.ingress(req.headers) \
+            if self.tracer is not None else None
+        t_w0, t_p0 = time.time(), time.perf_counter()
+
+        def respond(status, rbody, ctype="application/json", extra=None,
+                    outcome=None):
+            if outcome is not None:
+                self._count(outcome)
+            if tctx is not None and tctx.sampled:
+                self.tracer.record("ingress", tctx, t_w0,
+                                   time.perf_counter() - t_p0,
+                                   status=int(status))
+            return HTTPResponse(status, rbody, ctype, extra)
+
+        dl = deadline_from_headers(req.headers)
+        if dl is not None and dl.expired():
+            return respond(504, b'{"error": "deadline expired"}',
+                           outcome="deadline_expired")
+        order = self._pick_order()
+        if not order:
+            return respond(503, b'{"error": "no workers registered"}',
+                           extra={"Retry-After": "1"}, outcome="no_workers")
+        idempotent = req.method in ("GET", "HEAD")
+        for addr in order:
+            parts = urlsplit(addr)
+            wpath = parts.path if path in ("", "/") else incoming.path
+            query = f"?{incoming.query}" if incoming.query else ""
+            url = f"{parts.scheme}://{parts.netloc}{wpath or '/'}{query}"
+            drop = {"host", "content-length", "connection"}
+            fwd = None
+            if tctx is not None:
+                # the head sampling decision made at ingress MUST propagate
+                # (same rule as the threaded handler)
+                drop.add(TRACE_HEADER.lower())
+                if tctx.sampled:
+                    fwd = self.tracer.child(tctx)
+            hdrs = {k: v for k, v in req.headers.items()
+                    if k.lower() not in drop}
+            if tctx is not None:
+                hdrs[TRACE_HEADER] = (fwd or tctx).to_header()
+            timeout = self.forward_timeout_s
+            if dl is not None:
+                if dl.expired():
+                    return respond(504, b'{"error": "deadline expired"}',
+                                   outcome="deadline_expired")
+                timeout = max(dl.cap(timeout), 1e-3)
+            t_f0w, t_f0 = time.time(), time.perf_counter()
+
+            def fwd_span(**attrs):
+                if fwd is not None:
+                    self.tracer.record("forward", fwd, t_f0w,
+                                       time.perf_counter() - t_f0,
+                                       worker=addr, **attrs)
+
+            try:
+                faults.fire(faults.WORKER_FORWARD, addr=addr, path=path)
+                status, rhdrs, rbody = await self._pool.request(
+                    req.method, url, body=body, headers=hdrs,
+                    timeout=timeout)
+            except (asyncio.TimeoutError, OSError) as e:
+                # transport failure: same taxonomy as the urlopen path —
+                # note the breaker, replay only when safe
+                self._note_failure(addr)
+                fwd_span(error=str(e))
+                timed_out = isinstance(e, asyncio.TimeoutError) or \
+                    isinstance(e, TimeoutError) or \
+                    "timed out" in str(e).lower()
+                if timed_out and not idempotent:
+                    return respond(504, json.dumps(
+                        {"error": f"worker {addr} timed out; not "
+                                  f"replayed (non-idempotent)"}
+                    ).encode(), outcome="timeout_unreplayed")
+                continue
+            # ANY worker answer — 2xx or an error status — is authoritative
+            # (the threaded handler's urlopen/HTTPError split, merged)
+            self._note_success(addr)
+            fwd_span(status=status)
+            return respond(status, rbody,
+                           rhdrs.get("Content-Type", "application/json"),
+                           outcome="forwarded")
+        return respond(502, b'{"error": "all workers failed"}',
+                       outcome="all_workers_failed")
+
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "RoutingFront":
         self._stop.clear()
-        self._httpd = ThreadingHTTPServer((self.host, self.port),
-                                          self._make_handler())
-        self.port = self._httpd.server_address[1]
-        t = threading.Thread(target=self._httpd.serve_forever, daemon=True,
-                             name="routing-front")
-        t.start()
+        if self.http_mode == "async":
+            from .aio import AsyncConnectionPool, AsyncHTTPServer
+
+            self._pool = AsyncConnectionPool()
+            self._aio = AsyncHTTPServer(self.host, self.port,
+                                        self._aio_handle,
+                                        name="routing-front-aio")
+            self._aio.start()
+            self.port = self._aio.port
+        else:
+            self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                              self._make_handler())
+            self.port = self._httpd.server_address[1]
+            t = threading.Thread(target=self._httpd.serve_forever,
+                                 daemon=True, name="routing-front")
+            t.start()
         self._probe_thread = threading.Thread(
             target=self._probe_loop, daemon=True, name="routing-front-probe")
         self._probe_thread.start()
@@ -446,6 +575,16 @@ class RoutingFront:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+        if self._aio is not None:
+            if self._pool is not None and self._aio.loop is not None \
+                    and self._aio.loop.is_running():
+                # close pooled worker sockets on their own loop
+                try:
+                    self._aio.loop.call_soon_threadsafe(self._pool.close)
+                except RuntimeError:
+                    pass
+            self._aio.stop()
+            self._aio = None
 
     @property
     def address(self) -> str:

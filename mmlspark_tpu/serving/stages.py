@@ -1,29 +1,75 @@
 """Request/reply column sugar (reference ServingImplicits parseRequest/makeReply,
-io/IOImplicits.scala:182-213 + ServingUDFs.scala:16-50)."""
+io/IOImplicits.scala:182-213 + ServingUDFs.scala:16-50).
+
+Wire negotiation happens HERE, per row: a request whose Content-Type is
+``application/x-mmlspark-frame`` (io/binary.py) decodes as a binary column
+frame — numpy views over the body bytes, zero-copy, no JSON parse, no
+base64 — regardless of the ``parse`` mode JSON clients use, so one endpoint
+serves both wires and replies stay bitwise-identical between them."""
 
 from __future__ import annotations
 
 import json
-from typing import Any, Optional
+from typing import Optional
 
 import numpy as np
 
 from ..core.dataframe import DataFrame
+from ..io.binary import FRAME_CONTENT_TYPE, FrameError, decode_frame, is_frame
+
+
+def _row_content_type(headers) -> str:
+    if not headers:
+        return ""
+    get = getattr(headers, "get", None)
+    v = get("Content-Type") if get is not None else None
+    if v is None:
+        low = "content-type"
+        for k in headers:
+            if str(k).lower() == low:
+                v = headers[k]
+                break
+    return str(v or "").split(";")[0].strip().lower()
+
+
+def _decode_frame_row(raw: bytes):
+    """Frame body -> parsed value: single-column frames unwrap to the bare
+    array (mirroring the JSON single-'data'-key unwrap), multi-column frames
+    stay a {name: array} dict. Views over ``raw`` — zero-copy."""
+    cols = decode_frame(raw)
+    if len(cols) == 1:
+        return next(iter(cols.values()))
+    return cols
 
 
 def parse_request(df: DataFrame, output_col: str, parse: str = "json",
-                  value_col: str = "value") -> DataFrame:
+                  value_col: str = "value",
+                  headers_col: Optional[str] = "headers") -> DataFrame:
     """Decode the raw request-body column: json -> dict/list (dict payloads with
-    a single 'data'/'value' key unwrap to the value), text -> str, bytes -> raw."""
+    a single 'data'/'value' key unwrap to the value), text -> str, bytes -> raw.
+    Rows negotiated as binary frames (Content-Type + magic) decode to numpy
+    views whatever ``parse`` says; a frame that fails validation parses to
+    None (the ingress already 400s malformed frames — this covers journal
+    replay and direct DataFrame use)."""
+    use_headers = headers_col if headers_col in (df.schema or []) else None
 
     def fn(p):
         col = p[value_col]
+        hdrs = p[use_headers] if use_headers else None
         out = np.empty(len(col), dtype=object)
         for i, body in enumerate(col):
             if body is None:
                 out[i] = None
                 continue
             raw = bytes(body)
+            if is_frame(raw) and (
+                    hdrs is None
+                    or _row_content_type(hdrs[i]) == FRAME_CONTENT_TYPE):
+                try:
+                    out[i] = _decode_frame_row(raw)
+                except FrameError:
+                    out[i] = None
+                continue
             if parse == "bytes":
                 out[i] = raw
             elif parse == "text":

@@ -130,6 +130,60 @@ class TestOptions:
         assert "mmlspark-train-0.mmlspark-train:8476" in args
         assert "num_processes=4" in args
 
+    def test_wire_and_http_mode_env_plumbing(self):
+        _, docs = render_docs()
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        # defaults: binary wire on, async HTTP transport, no tenants
+        assert env["MMLSPARK_WIRE_BINARY"] == "true"
+        assert env["MMLSPARK_HTTP_MODE"] == "async"
+        assert "MMLSPARK_TENANTS" not in env
+        front = by_kind_name(docs, "Deployment", "-front")
+        fenv = {e["name"]: e.get("value") for e in
+                front["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert fenv["MMLSPARK_HTTP_MODE"] == "async"
+
+    def test_wire_binary_off(self):
+        _, docs = render_docs({"wire": {"binary": False},
+                               "worker": {"httpMode": "thread"}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MMLSPARK_WIRE_BINARY"] == "false"
+        assert env["MMLSPARK_HTTP_MODE"] == "thread"
+
+    def test_tenants_env_plumbing(self):
+        _, docs = render_docs({"tenants": {
+            "enabled": True, "weights": "gold=3,free=1", "maxQueue": 128}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MMLSPARK_TENANTS"] == "gold=3,free=1"
+        assert env["MMLSPARK_MAX_QUEUE"] == "128"
+        # empty weights + enabled -> uniform-weight sentinel
+        _, docs = render_docs({"tenants": {"enabled": True}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MMLSPARK_TENANTS"] == "true"
+
+    def test_bootstrap_python_compiles(self):
+        """The pod commands are Python source built by the templates; a
+        template expression the renderer can't evaluate (the old
+        ``| default`` gap rendered ``async_exec=,``) must fail HERE, not
+        in a CrashLooping pod."""
+        _, docs = render_docs({"tenants": {"enabled": True},
+                               "train": {"enabled": True}})
+        checked = 0
+        for d in docs:
+            tpl = d.get("spec", {}).get("template", {}) if d else {}
+            for c in tpl.get("spec", {}).get("containers", []):
+                if c.get("command") == ["python", "-c"]:
+                    compile(c["args"][0], d["metadata"]["name"], "exec")
+                    checked += 1
+        assert checked >= 2  # front + worker (+ train job)
+
     def test_chart_code_snippets_reference_real_api(self):
         # the pod commands import these symbols; keep the chart honest
         from mmlspark_tpu.parallel.mesh import initialize_distributed  # noqa

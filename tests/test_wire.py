@@ -1,0 +1,596 @@
+"""Binary wire protocol + async serving front tests (ISSUE 6 tentpole).
+
+Covers: golden-bytes frame codec round trip, malformed/truncated/hostile
+frame rejection (bounded header, no attacker-sized allocations), JSON vs
+binary bitwise reply parity across BOTH HTTP transports, keep-alive
+multi-request connections + 64-connection concurrency without
+thread-per-connection growth, per-tenant weighted-fair shedding under
+synthetic overload, journal binary records, and the zero-copy batch
+stacker."""
+
+import http.client
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.io.binary import (FRAME_CONTENT_TYPE, FrameError,
+                                    decode_frame, encode_frame, frame_info,
+                                    is_frame)
+from mmlspark_tpu.parallel.ingest import rows_to_batch
+from mmlspark_tpu.serving import (RequestJournal, RoutingFront, ServingServer,
+                                  TenantAdmission, register_worker,
+                                  serve_pipeline, tenants_from_spec)
+from mmlspark_tpu.serving.stages import parse_request
+
+
+def _echo_sum(df):
+    """Wire-agnostic endpoint: body -> array (JSON list or frame column)
+    -> sum, so the same logical payload replies identically on both wires."""
+    parsed = parse_request(df, "data", parse="json")
+    return parsed.with_column(
+        "reply",
+        lambda p: [None if v is None else float(np.asarray(v).sum())
+                   for v in p["data"]])
+
+
+def _post(address, body, headers=None, timeout=15):
+    req = urllib.request.Request(address, data=body, method="POST",
+                                 headers=dict(headers or {}))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# ---------------------------------------------------------------------------
+# Frame codec
+# ---------------------------------------------------------------------------
+
+
+class TestFrameCodec:
+    def test_round_trip_views(self):
+        img = (np.arange(64 * 64 * 3, dtype=np.uint8) % 251).reshape(
+            64, 64, 3)
+        buf = encode_frame({"img": img})
+        out = decode_frame(buf)
+        assert list(out) == ["img"]
+        assert out["img"].dtype == np.uint8
+        np.testing.assert_array_equal(out["img"], img)
+        # zero-copy: the decoded array is a view over the frame buffer
+        assert out["img"].base is not None
+
+    def test_golden_bytes(self):
+        """The v1 wire layout is pinned byte-for-byte: any codec change that
+        shifts these bytes is a protocol break, not a refactor."""
+        buf = encode_frame({"x": np.arange(6, dtype=np.uint8).reshape(2, 3)})
+        golden = bytes.fromhex(
+            "4d4d5346"          # magic "MMSF"
+            "01" "00" "01"      # version, flags, ncols
+            "2700000000000000"  # total_len = 39
+            "1000"              # header_len = 16
+            "01" "78"           # name_len, "x"
+            "01" "02"           # dtype=uint8, ndim=2
+            "02000000" "03000000"  # dims
+            "06000000"          # payload_len
+            "000102030405")     # payload
+        assert buf == golden
+        np.testing.assert_array_equal(
+            decode_frame(golden)["x"],
+            np.arange(6, dtype=np.uint8).reshape(2, 3))
+
+    def test_multi_column_dtypes_and_scalars(self):
+        cols = {"f32": np.linspace(0, 1, 12, dtype=np.float32).reshape(3, 4),
+                "i64": np.array([-5, 9], dtype=np.int64),
+                "scalar": np.array(7, dtype=np.int32),
+                "empty": np.zeros((0, 2), dtype=np.float64)}
+        out = decode_frame(encode_frame(cols))
+        assert list(out) == list(cols)
+        for k in cols:
+            assert out[k].dtype == cols[k].dtype
+            assert out[k].shape == cols[k].shape
+            np.testing.assert_array_equal(out[k], cols[k])
+
+    def test_non_contiguous_input_encodes(self):
+        t = np.arange(64, dtype=np.float32).reshape(8, 8).T[::2]
+        np.testing.assert_array_equal(decode_frame(encode_frame({"t": t}))["t"], t)
+
+    def test_truncated_and_malformed_rejected(self):
+        buf = encode_frame({"x": np.arange(6, dtype=np.uint8)})
+        for bad in (b"", buf[:3], buf[:17], buf[:-1], buf + b"Z",
+                    b"XXXX" + buf[4:], b"\x00" * 40):
+            with pytest.raises(FrameError):
+                frame_info(bad)
+            with pytest.raises(FrameError):
+                decode_frame(bad)
+
+    def test_hostile_length_fields_no_alloc(self):
+        """A forged total_len/header_len/payload_len can only raise — the
+        decoder validates every length against the real buffer before
+        building a single view."""
+        import struct
+
+        buf = bytearray(encode_frame({"x": np.arange(6, dtype=np.uint8)}))
+        hostile_total = bytearray(buf)
+        struct.pack_into("<Q", hostile_total, 7, 1 << 62)
+        with pytest.raises(FrameError):
+            frame_info(bytes(hostile_total))
+        hostile_hlen = bytearray(buf)
+        struct.pack_into("<H", hostile_hlen, 15, 0xFFFF)
+        with pytest.raises(FrameError):
+            frame_info(bytes(hostile_hlen))
+        hostile_ncols = bytearray(buf)
+        hostile_ncols[6] = 255
+        with pytest.raises(FrameError):
+            frame_info(bytes(hostile_ncols))
+
+    def test_oversized_frame_rejected_by_cap(self):
+        buf = encode_frame({"x": np.zeros(1024, dtype=np.uint8)})
+        with pytest.raises(FrameError):
+            frame_info(buf, max_bytes=512)
+
+    def test_unsupported_dtype_rejected_at_encode(self):
+        with pytest.raises(FrameError):
+            encode_frame({"o": np.array(["a"], dtype=object)})
+
+    def test_is_frame_sniff(self):
+        assert is_frame(encode_frame({"x": np.zeros(1, np.uint8)}))
+        assert not is_frame(b'{"data": [1]}')
+        assert not is_frame(b"MM")
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy batch stacking (parallel/ingest.rows_to_batch)
+# ---------------------------------------------------------------------------
+
+
+class TestRowsToBatch:
+    def test_adjacent_views_stack_zero_copy(self):
+        base = np.arange(4 * 6, dtype=np.uint8).reshape(4, 6)
+        batch = rows_to_batch([base[i] for i in range(4)])
+        np.testing.assert_array_equal(batch, base)
+        assert batch.base is not None  # strided view, no copy
+
+    def test_batched_frame_column_is_zero_copy_end_to_end(self):
+        """A client shipping a whole batch in one frame column: decode gives
+        a [B, ...] view, rows_to_batch of its rows re-spans it — no copy
+        anywhere between the HTTP body and the H2D staging buffer."""
+        batch = (np.arange(8 * 6, dtype=np.uint8) % 199).reshape(8, 2, 3)
+        col = decode_frame(encode_frame({"img": batch}))["img"]
+        restacked = rows_to_batch([col[i] for i in range(8)])
+        np.testing.assert_array_equal(restacked, batch)
+        assert restacked.base is not None
+
+    def test_separate_buffers_copy_once(self):
+        rows = [np.arange(6, dtype=np.float32) + i for i in range(3)]
+        batch = rows_to_batch(rows)
+        assert batch.shape == (3, 6)
+        np.testing.assert_array_equal(batch[2], rows[2])
+
+    def test_ragged_rejected(self):
+        with pytest.raises(ValueError):
+            rows_to_batch([np.zeros(3), np.zeros(4)])
+        with pytest.raises(ValueError):
+            rows_to_batch([])
+
+
+# ---------------------------------------------------------------------------
+# JSON <-> binary reply parity, across both HTTP transports and exec modes
+# ---------------------------------------------------------------------------
+
+
+class TestWireParity:
+    PAYLOAD = [1.0, 2.5, 3.5]
+
+    def _bodies(self):
+        json_body = json.dumps({"data": self.PAYLOAD}).encode()
+        frame_body = encode_frame(
+            {"data": np.asarray(self.PAYLOAD, dtype=np.float64)})
+        return json_body, frame_body
+
+    def test_json_binary_bitwise_parity_all_modes(self):
+        json_body, frame_body = self._bodies()
+        replies = {}
+        for http_mode in ("thread", "async"):
+            for async_exec in (False, True):
+                with ServingServer(_echo_sum, port=0, max_wait_ms=0.0,
+                                   http_mode=http_mode,
+                                   async_exec=async_exec) as server:
+                    j = _post(server.address, json_body)
+                    b = _post(server.address, frame_body,
+                              {"Content-Type": FRAME_CONTENT_TYPE})
+                replies[(http_mode, async_exec)] = (j, b)
+                assert j[0] == b[0] == 200
+                assert j[1] == b[1], (http_mode, async_exec, j, b)
+        # every mode produced the same bytes
+        assert len(set(replies.values())) == 1
+
+    def test_malformed_frame_400_before_batch_slot(self):
+        with ServingServer(_echo_sum, port=0, max_wait_ms=0.0,
+                           http_mode="async") as server:
+            _, frame_body = self._bodies()
+            status, body = _post(server.address, frame_body[:-3],
+                                 {"Content-Type": FRAME_CONTENT_TYPE})
+            assert status == 400
+            assert b"bad frame" in body
+            shed = server.stats.shed_summary()
+            assert shed["by_reason"].get("bad_frame") == 1
+            # the malformed frame never became a batch: nothing served
+            assert server.requests_served == 0
+
+    def test_wire_counters_and_stats_section(self):
+        json_body, frame_body = self._bodies()
+        with ServingServer(_echo_sum, port=0, max_wait_ms=0.0,
+                           http_mode="async") as server:
+            _post(server.address, json_body)
+            _post(server.address, frame_body,
+                  {"Content-Type": FRAME_CONTENT_TYPE})
+            status, raw = _post(
+                server.address.rstrip("/") + "/_mmlspark/stats", b"")
+            stats = json.loads(raw)
+            assert stats["wire"]["requests"] == {"json": 1, "binary": 1}
+            assert stats["wire"]["bytes"]["binary"] == len(frame_body)
+            assert stats["http"]["requests_total"] >= 2
+            # Prometheus exposition carries the format labels
+            _, metrics = _post(
+                server.address.rstrip("/") + "/_mmlspark/metrics", b"")
+            text = metrics.decode()
+            assert 'mmlspark_wire_requests_total{format="binary"} 1' in text
+            assert 'mmlspark_wire_bytes_total{format="binary"} %d' \
+                % len(frame_body) in text
+            # traced binary requests carry a "frame" span (header
+            # validation cost + wire bytes)
+            _, traces = _post(
+                server.address.rstrip("/") + "/_mmlspark/trace", b"")
+            spans = json.loads(traces)["spans"]
+            frame_spans = [s for s in spans if s.get("name") == "frame"]
+            assert frame_spans
+            assert frame_spans[0]["attrs"]["bytes"] == len(frame_body)
+
+
+# ---------------------------------------------------------------------------
+# Keep-alive + concurrency (the async front's reason to exist)
+# ---------------------------------------------------------------------------
+
+
+class TestAsyncFront:
+    def test_keepalive_multi_request_single_connection(self):
+        with ServingServer(_echo_sum, port=0, max_wait_ms=0.0,
+                           http_mode="async") as server:
+            conn = http.client.HTTPConnection(server.host, server.port,
+                                              timeout=10)
+            body = json.dumps({"data": [1, 2, 3]}).encode()
+            for _ in range(8):
+                conn.request("POST", "/", body=body)
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert resp.read() == b"6.0"
+            conn.close()
+            assert server._aio.connections_total == 1
+            assert server._aio.requests_total == 8
+
+    def test_64_concurrent_keepalive_connections_no_thread_growth(self):
+        n = 64
+        with ServingServer(_echo_sum, port=0, max_wait_ms=2.0,
+                           max_batch_size=n, http_mode="async") as server:
+            conns = []
+            for _ in range(n):
+                c = http.client.HTTPConnection(server.host, server.port,
+                                               timeout=30)
+                c.connect()
+                conns.append(c)
+            deadline = time.time() + 5
+            while server._aio.open_connections < n and time.time() < deadline:
+                time.sleep(0.01)
+            threads_with_open_conns = threading.active_count()
+            body = json.dumps({"data": [2, 2]}).encode()
+            for c in conns:
+                c.request("POST", "/", body=body)
+            for c in conns:
+                resp = c.getresponse()
+                assert resp.status == 200
+                assert resp.read() == b"4.0"
+            threads_after = threading.active_count()
+            assert server._aio.peak_open_connections >= n
+            # thread-per-connection would add ~64 threads; the event loop
+            # adds none per connection (slack for unrelated pool threads)
+            assert threads_after - threads_with_open_conns < 8
+            for c in conns:
+                c.close()
+
+    def test_pipelined_requests_one_connection_share_a_batch(self):
+        """Two requests written back-to-back on one connection are both
+        read before dispatch (pipelined reads) and coalesce into one
+        batch under a nonzero wait window."""
+        import socket
+
+        with ServingServer(_echo_sum, port=0, max_wait_ms=50.0,
+                           max_batch_size=8, http_mode="async") as server:
+            body = json.dumps({"data": [1, 2]}).encode()
+            raw = (b"POST / HTTP/1.1\r\nHost: x\r\n"
+                   b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+            sk = socket.create_connection((server.host, server.port),
+                                          timeout=10)
+            sk.sendall(raw * 2)
+            buf = b""
+            while buf.count(b"3.0") < 2:
+                chunk = sk.recv(4096)
+                assert chunk, buf
+                buf += chunk
+            sk.close()
+            batches = [r[3] for r in server.stats._rows]
+            assert max(batches) >= 2, batches  # coalesced, not serial
+
+    def test_routing_front_async_forwards_frames_opaquely(self):
+        seen = []
+
+        def capture(df):
+            data = df.collect()
+            seen.extend(bytes(b) for b in data["value"])
+            return _echo_sum(df)
+
+        frame_body = encode_frame(
+            {"data": np.asarray([4.0, 5.0], dtype=np.float64)})
+        for mode in ("thread", "async"):
+            seen.clear()
+            with ServingServer(capture, port=0, max_wait_ms=0.0,
+                               http_mode=mode) as worker, \
+                    RoutingFront(port=0, http_mode=mode) as front:
+                register_worker(front.address, worker.address)
+                status, body = _post(front.address, frame_body,
+                                     {"Content-Type": FRAME_CONTENT_TYPE})
+                assert status == 200
+                assert body == b"9.0"
+                # the hop forwarded the exact frame bytes — no re-encode
+                assert seen == [frame_body]
+
+    def test_front_async_connection_pool_reuses_worker_sockets(self):
+        with ServingServer(_echo_sum, port=0, max_wait_ms=0.0,
+                           http_mode="async") as worker, \
+                RoutingFront(port=0, http_mode="async") as front:
+            register_worker(front.address, worker.address)
+            body = json.dumps({"data": [1, 1]}).encode()
+            for _ in range(5):
+                status, out = _post(front.address, body)
+                assert (status, out) == (200, b"2.0")
+            # register + 5 forwards over ONE pooled worker connection
+            # (urlopen-per-forward would open 5)
+            assert worker._aio.connections_total <= 2
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant weighted-fair admission
+# ---------------------------------------------------------------------------
+
+
+class TestTenantAdmission:
+    def test_spec_parsing(self):
+        ta = tenants_from_spec("gold=3, free=1")
+        assert ta.weight("gold") == 3.0 and ta.weight("free") == 1.0
+        assert tenants_from_spec("") is None
+        assert tenants_from_spec("false") is None
+        assert isinstance(tenants_from_spec("true"), TenantAdmission)
+        with pytest.raises(ValueError):
+            tenants_from_spec("oops")
+
+    def test_tenant_of_header_lookup(self):
+        assert TenantAdmission.tenant_of(
+            {"X-MMLSpark-Tenant": "a"}) == "a"
+        assert TenantAdmission.tenant_of(
+            {"x-mmlspark-tenant": "b"}) == "b"
+        assert TenantAdmission.tenant_of({}) == "default"
+        assert TenantAdmission.tenant_of(None) == "default"
+
+    def test_work_conserving_below_cap(self):
+        ta = TenantAdmission({"heavy": 1.0, "light": 1.0})
+        # queue not full: everyone admitted regardless of share
+        for _ in range(5):
+            assert ta.try_admit("heavy", queue_depth=3, max_queue=8)
+
+    def test_weighted_fair_shed_distribution(self):
+        """Synthetic overload: heavy floods a full queue, light trickles.
+        Heavy sheds once over its share; light (under share) keeps
+        getting in — light's shed rate stays below heavy's."""
+        ta = TenantAdmission({"heavy": 1.0, "light": 1.0})
+        max_queue = 8
+        heavy_sent = heavy_ok = light_sent = light_ok = 0
+        for _ in range(20):  # heavy fills the queue and keeps hammering
+            heavy_sent += 1
+            if ta.try_admit("heavy", queue_depth=max_queue,
+                            max_queue=max_queue):
+                heavy_ok += 1
+        for _ in range(3):
+            light_sent += 1
+            if ta.try_admit("light", queue_depth=max_queue,
+                            max_queue=max_queue):
+                light_ok += 1
+        s = ta.summary()
+        # heavy alone owns the whole queue (work-conserving: quota =
+        # max_queue while it is the only active tenant), then sheds at it
+        assert heavy_ok == max_queue
+        # light stays under ITS share (max_queue/2 once both active) and
+        # keeps getting in even though the queue is full
+        assert light_ok == 3
+        heavy_rate = s["heavy"]["shed"] / heavy_sent
+        light_rate = s["light"]["shed"] / light_sent
+        assert light_rate < heavy_rate
+        # releases free the share again
+        for _ in range(heavy_ok):
+            ta.release("heavy")
+        assert ta.try_admit("heavy", queue_depth=max_queue,
+                            max_queue=max_queue)
+
+    def test_http_overload_sheds_heavy_not_light(self):
+        """End-to-end: a blocked transform + full queue -> the flooding
+        tenant 503s (tenant_over_share) while the light tenant is still
+        admitted; after release everyone admitted completes with 200."""
+        gate = threading.Event()
+
+        def gated(df):
+            gate.wait(20)
+            return _echo_sum(df)
+
+        body = json.dumps({"data": [1, 2]}).encode()
+        results = {}
+        lock = threading.Lock()
+
+        def client(name, tenant):
+            status, out = _post(server.address, body,
+                                {"X-MMLSpark-Tenant": tenant}, timeout=30)
+            with lock:
+                results[name] = (status, out)
+
+        with ServingServer(gated, port=0, max_wait_ms=0.0, max_batch_size=1,
+                           max_queue=2, slot_timeout_s=30.0,
+                           http_mode="async",
+                           tenants={"heavy": 1.0, "light": 1.0}) as server:
+            threads = []
+            # A drains into the blocked batch; B, C fill the queue
+            for name in ("A", "B", "C"):
+                t = threading.Thread(target=client, args=(name, "heavy"),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+                deadline = time.time() + 5
+                while time.time() < deadline:
+                    with server._id_lock:
+                        n_slots = len(server._slots)
+                    if n_slots == {"A": 1, "B": 2, "C": 3}[name]:
+                        break
+                    time.sleep(0.01)
+            assert server._queue.qsize() >= server.max_queue
+            # heavy is over its share of the full queue -> immediate 503
+            status, out = _post(server.address, body,
+                                {"X-MMLSpark-Tenant": "heavy"})
+            assert status == 503
+            assert b"tenant over admission share" in out
+            # light is under its share -> admitted despite the full queue
+            t = threading.Thread(target=client, args=("L", "light"),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                with server._id_lock:
+                    if len(server._slots) == 4:
+                        break
+                time.sleep(0.01)
+            with server._id_lock:
+                assert len(server._slots) == 4  # light got in
+            gate.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert all(r == (200, b"3.0") for r in results.values()), results
+            shed = server.stats.shed_summary()
+            assert shed["by_tenant"].get("heavy", 0) >= 1
+            assert shed["by_tenant"].get("light", 0) == 0
+            tn = server._tenants.summary()
+            assert tn["light"]["shed"] == 0 and tn["heavy"]["shed"] >= 1
+
+    def test_tenant_metrics_exposition(self):
+        with ServingServer(_echo_sum, port=0, max_wait_ms=0.0,
+                           http_mode="async",
+                           tenants={"gold": 3.0}) as server:
+            body = json.dumps({"data": [1]}).encode()
+            _post(server.address, body, {"X-MMLSpark-Tenant": "gold"})
+            _, metrics = _post(
+                server.address.rstrip("/") + "/_mmlspark/metrics", b"")
+            text = metrics.decode()
+            assert 'mmlspark_tenant_admitted_total{tenant="gold"} 1' in text
+            assert 'mmlspark_tenant_weight{tenant="gold"} 3' in text
+
+
+# ---------------------------------------------------------------------------
+# Journal binary records
+# ---------------------------------------------------------------------------
+
+
+class TestJournalBinaryRecords:
+    def test_frame_bodies_stored_raw_and_replayed_bitwise(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        frame = encode_frame(
+            {"img": (np.arange(333, dtype=np.uint8) % 97)})
+        j = RequestJournal(path)
+        j.append_many(1, [(10, b'{"data": [1]}', {"k": "v"}),
+                          (11, frame,
+                           {"Content-Type": FRAME_CONTENT_TYPE})])
+        j.close()
+        rec = RequestJournal.recover(path)
+        assert [(r[0]) for r in rec] == [10, 11]
+        assert rec[1][1] == frame  # bitwise
+        assert rec[1][2] == {"Content-Type": FRAME_CONTENT_TYPE}
+        # no base64 inflation: file holds the frame verbatim
+        raw = open(path, "rb").read()
+        assert frame in raw
+
+    def test_commit_and_compact_preserve_variants(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        frame = encode_frame({"x": np.arange(64, dtype=np.uint8)})
+        j = RequestJournal(path)
+        j.append(1, 1, frame, {})
+        j.append(2, 2, b"plain", {})
+        j.commit(1)
+        j.compact()
+        j.close()
+        rec = RequestJournal.recover(path)
+        assert rec == [(2, b"plain", {})]
+
+    def test_torn_binary_tail_skipped(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        frame = encode_frame({"x": np.arange(64, dtype=np.uint8)})
+        j = RequestJournal(path)
+        j.append(1, 1, b"ok", {})
+        j.append(2, 2, frame, {})
+        j.close()
+        # crash mid-append: binary body truncated
+        raw = open(path, "rb").read()
+        open(path, "wb").write(raw[:-20])
+        rec = RequestJournal.recover(path)
+        assert rec == [(1, b"ok", {})]
+
+    def test_legacy_jsonl_still_readable(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write('{"op": "entry", "epoch": 1, "id": 5, '
+                     '"body_b64": "aGk=", "headers": {}}\n')
+        assert RequestJournal.recover(path) == [(5, b"hi", {})]
+
+    def test_binary_request_journaled_through_server(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        frame = encode_frame(
+            {"data": np.asarray([2.0, 3.0], dtype=np.float64)})
+        with ServingServer(_echo_sum, port=0, max_wait_ms=0.0,
+                           http_mode="async", journal_path=path) as server:
+            status, out = _post(server.address, frame,
+                                {"Content-Type": FRAME_CONTENT_TYPE})
+            assert (status, out) == (200, b"5.0")
+        raw = open(path, "rb").read()
+        assert frame in raw  # journaled raw, not base64-inflated
+
+
+# ---------------------------------------------------------------------------
+# serve_pipeline integration (frame -> stage -> reply)
+# ---------------------------------------------------------------------------
+
+
+class TestServePipelineWire:
+    def test_frame_and_json_through_serve_pipeline(self):
+        from mmlspark_tpu.stages.basic import UDFTransformer
+
+        stage = UDFTransformer(
+            inputCol="data", outputCol="out",
+            udf=lambda v: float(np.asarray(v).sum()) * 2)
+        server = serve_pipeline(stage, input_col="data", port=0,
+                                max_wait_ms=0.0, http_mode="async")
+        with server:
+            j = _post(server.address,
+                      json.dumps({"data": [1.0, 2.0]}).encode())
+            b = _post(server.address,
+                      encode_frame({"data": np.asarray([1.0, 2.0])}),
+                      {"Content-Type": FRAME_CONTENT_TYPE})
+            assert j == b == (200, b"6.0")

@@ -105,7 +105,8 @@ def _load(url: str, payload: bytes, n_clients: int, duration_s: float):
 
 
 def _load_keepalive(host: str, port: int, payload: bytes, n_clients: int,
-                    duration_s: float, path: str = "/"):
+                    duration_s: float, path: str = "/",
+                    headers: dict = None):
     """Persistent-connection load generator (http.client, one connection per
     client thread). The urlopen-based ``_load`` pays a fresh TCP connect +
     handler-thread spawn per request — on a 1-core host that connection
@@ -115,6 +116,8 @@ def _load_keepalive(host: str, port: int, payload: bytes, n_clients: int,
     import http.client
     import threading
 
+    hdrs = dict(headers) if headers else \
+        {"Content-Type": "application/json"}
     lat: list = []
     lock = threading.Lock()
     barrier = threading.Barrier(n_clients + 1)
@@ -127,8 +130,7 @@ def _load_keepalive(host: str, port: int, payload: bytes, n_clients: int,
         while time.perf_counter() < stop_at[0]:
             t0 = time.perf_counter()
             try:
-                conn.request("POST", path, body=payload,
-                             headers={"Content-Type": "application/json"})
+                conn.request("POST", path, body=payload, headers=hdrs)
                 resp = conn.getresponse()
                 resp.read()
             except Exception:  # noqa: BLE001 — reconnect and continue
@@ -287,6 +289,162 @@ def _load_async_section(featurize, img, n_clients, duration, reps=3):
     return out
 
 
+def _wire_section(n_clients, duration, reps=3):
+    """JSON-vs-binary wire A/B (the zero-copy frame protocol, io/binary.py):
+    the SAME logical uint8 image request shipped as base64-JSON vs a binary
+    column frame, against the same wire-agnostic endpoint. Measures (a)
+    ingress payload bytes, (b) per-request host decode time (json.loads +
+    b64decode + frombuffer vs the frame codec's zero-copy header parse),
+    (c) persistent-connection serving throughput on the local and
+    rtt90-emulated endpoints (async HTTP front), (d) bitwise reply parity
+    across wire x exec-mode, and (e) the 64-connection keep-alive load the
+    async front is built for."""
+    import base64
+    import threading
+
+    from mmlspark_tpu.io.binary import FRAME_CONTENT_TYPE, encode_frame
+    from mmlspark_tpu.serving import ServingServer
+    from mmlspark_tpu.serving.stages import parse_request
+
+    img = np.random.default_rng(0).integers(
+        0, 256, size=(64, 64, 3), dtype=np.uint8)
+    json_body = json.dumps({
+        "img_b64": base64.b64encode(img.tobytes()).decode("ascii"),
+        "shape": [64, 64, 3], "dtype": "uint8"}).encode()
+    frame_body = encode_frame({"img": img})
+    frame_hdrs = {"Content-Type": FRAME_CONTENT_TYPE}
+
+    def transform(df):
+        parsed = parse_request(df, "data", parse="json")
+
+        def to_reply(p):
+            out = []
+            for v in p["data"]:
+                if isinstance(v, np.ndarray):  # frame wire: zero-copy view
+                    arr = v
+                else:  # JSON wire: b64 decode + reshape
+                    arr = np.frombuffer(
+                        base64.b64decode(v["img_b64"]),
+                        dtype=v["dtype"]).reshape(v["shape"])
+                m = arr.astype(np.float32).mean(axis=(0, 1))
+                out.append([round(float(x), 6) for x in m])
+            return out
+
+        return parsed.with_column("reply", to_reply)
+
+    out = {"payload_bytes": {
+        "json_b64": len(json_body), "binary_frame": len(frame_body),
+        "reduction": round(1 - len(frame_body) / len(json_body), 4)}}
+
+    # -- host decode microbench (per-request decode tax, no HTTP) --------
+    def time_decode(fn, reps_dec=2000):
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(reps_dec):
+                fn()
+            dt = (time.perf_counter() - t0) / reps_dec
+            best = dt if best is None else min(best, dt)
+        return best
+
+    def dec_json():
+        v = json.loads(json_body.decode("utf-8"))
+        np.frombuffer(base64.b64decode(v["img_b64"]),
+                      dtype=v["dtype"]).reshape(v["shape"])
+
+    def dec_frame():
+        from mmlspark_tpu.io.binary import decode_frame
+
+        decode_frame(frame_body)
+
+    js, fs = time_decode(dec_json), time_decode(dec_frame)
+    out["host_decode_us"] = {
+        "json_b64": round(js * 1e6, 3), "binary_frame": round(fs * 1e6, 3),
+        "speedup": round(js / fs, 2) if fs > 0 else None}
+
+    # -- bitwise reply parity: wire x exec mode --------------------------
+    def collect(async_exec, body, hdrs):
+        import urllib.request as _ur
+
+        with ServingServer(transform, port=0, max_wait_ms=1.0,
+                           async_exec=async_exec,
+                           http_mode="async") as server:
+            outs = []
+            for _ in range(4):
+                req = _ur.Request(server.address, data=body, method="POST",
+                                  headers=hdrs)
+                with _ur.urlopen(req, timeout=60) as resp:
+                    outs.append((resp.status, resp.read()))
+            return outs
+
+    sync_j = collect(False, json_body, {})
+    out["bitwise_identical"] = (
+        sync_j == collect(False, frame_body, frame_hdrs)
+        == collect(True, json_body, {})
+        == collect(True, frame_body, frame_hdrs))
+
+    # -- serving A/B: persistent connections, local + rtt90 --------------
+    rtt_s = 0.09
+    endpoints = {"local": transform,
+                 "rtt90": _make_rtt_transform(transform, rtt_s)}
+    wires = {"json_b64": (json_body, None),
+             "binary_frame": (frame_body, frame_hdrs)}
+    for ep_name, ep_transform in endpoints.items():
+        ep = {}
+        for wire_name, (body, hdrs) in wires.items():
+            best = None
+            for _ in range(reps):
+                with ServingServer(ep_transform, port=0, max_wait_ms=5.0,
+                                   max_batch_size=64, async_exec=True,
+                                   http_mode="async") as server:
+                    server.warmup(body, headers=hdrs or {},
+                                  sizes=[1, 8, 16])
+                    r = _load_keepalive(server.host, server.port, body,
+                                        n_clients, duration, headers=hdrs)
+                    d = server.stats.summary()
+                    r["mean_batch"] = d.get("mean_batch")
+                    r["queue_ms_p95"] = (d.get("queue_ms") or {}).get("p95")
+                if best is None or (r.get("qps") or 0) > (best.get("qps")
+                                                          or 0):
+                    best = r
+            ep[wire_name] = best
+        jq = ep["json_b64"].get("qps") or 0
+        ep["ab"] = {"qps_ratio": round(
+            (ep["binary_frame"].get("qps") or 0) / jq, 3) if jq else None}
+        out[ep_name] = ep
+
+    # -- 64 keep-alive connections on ONE event-loop thread --------------
+    threads_before = threading.active_count()
+    with ServingServer(transform, port=0, max_wait_ms=5.0,
+                       max_batch_size=64, http_mode="async") as server:
+        transport_threads = threading.active_count() - threads_before
+        server.warmup(frame_body, headers=frame_hdrs, sizes=[1, 16, 64])
+        r = _load_keepalive(server.host, server.port, frame_body, 64,
+                            min(duration, 4.0), headers=frame_hdrs)
+        aio = server._aio.stats()
+        out["front_64conn"] = {
+            "qps": r.get("qps"), "p50_ms": r.get("p50_ms"),
+            "p99_ms": r.get("p99_ms"),
+            "peak_open_connections": aio["peak_open_connections"],
+            "server_threads_total": transport_threads,
+            "note": "64 keep-alive clients on the event-loop transport: "
+                    "server_threads_total is every thread the server "
+                    "started (HTTP transport + batcher), measured — not "
+                    "one per connection"}
+
+    out["note"] = (
+        "best-of-%d per config, persistent connections, async HTTP front + "
+        "pipelined executor both wires; payload = 64x64x3 uint8 image "
+        "(12288 raw bytes): base64-JSON pays the 4/3 inflation + "
+        "json.loads + b64decode per request, the binary frame ships raw "
+        "bytes + a 39-byte header and decodes to zero-copy views; on this "
+        "1-core CPU container both wires share one core with the model, "
+        "so qps_ratio understates the win a network-attached deployment "
+        "sees (bytes reduction and decode speedup are the structural "
+        "numbers)" % reps)
+    return out
+
+
 def _obs_overhead_section(echo, payload, n):
     """A/B the observability layer's hot-path cost: identical echo servers
     with the obs layer on (per-request tracing at sample_rate=1.0 — the
@@ -333,17 +491,24 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
-                    choices=["all", "load_async", "obs_overhead"],
+                    choices=["all", "load_async", "obs_overhead", "wire"],
                     default="all",
                     help="load_async: run just the overlapped-executor A/B "
                          "section; obs_overhead: just the observability "
-                         "on/off A/B (merge into an existing artifact)")
+                         "on/off A/B; wire: just the JSON-vs-binary frame "
+                         "A/B (merge into an existing artifact)")
     args = ap.parse_args()
 
     platform = jax.devices()[0].platform
     n = 200 if platform != "cpu" else 50
     n_clients = 16
     duration = 8.0 if platform != "cpu" else 3.0
+
+    if args.only == "wire":
+        print(json.dumps({
+            "backend": platform,
+            "wire": _wire_section(n_clients, max(duration, 4.0))}))
+        return
 
     # --- model endpoint: ResNet-18 featurize of a 64x64 image
     model = resnet(18, num_classes=16, image_size=64, width=16)

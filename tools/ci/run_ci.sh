@@ -27,6 +27,7 @@ PACKAGES=(
   "tests/test_models.py tests/test_onnx.py tests/test_downloader.py tests/test_native.py tests/test_ingest.py"
   "tests/test_cognitive.py tests/test_style.py tests/test_helm_chart.py"
   "tests/test_serving_async.py"
+  "tests/test_wire.py"
   "tests/test_faults.py -m faults"
   "tests/test_fuzzing.py"
   "tests/test_attention.py tests/test_parallel_pp_ep.py"

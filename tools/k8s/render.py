@@ -6,6 +6,9 @@ the in-repo charts use — helm itself renders them identically:
 
   {{ .Values.path.to.key }}   value substitution (also .Release.Name,
                               .Chart.Name)
+  {{ .Values.x | default v }} helm's ``default`` filter: the literal ``v``
+                              when the value is unset/empty (nil, "",
+                              false, 0 — helm's empty set)
   {{- if .Values.x }} ...
   {{- end }}                  boolean-truthy conditional blocks (may nest)
 
@@ -110,6 +113,23 @@ def _lookup(ctx: dict, expr: str):
     return cur
 
 
+def _eval_expr(ctx: dict, expr: str):
+    """A lookup plus the one filter the charts use: ``| default <literal>``
+    (helm semantics: the default replaces helm-empty values — nil, "",
+    false, 0). The old renderer silently dropped piped exprs, rendering
+    ``async_exec=`` into the worker bootstrap — a chart bug invisible
+    until a pod ran it."""
+    parts = [p.strip() for p in expr.split("|")]
+    val = _lookup(ctx, parts[0])
+    for filt in parts[1:]:
+        name, _, arg = filt.partition(" ")
+        if name != "default":
+            raise ValueError(f"unsupported template filter: {filt!r}")
+        if val is None or val == "" or val is False or val == 0:
+            val = _coerce(arg.strip())
+    return val
+
+
 def render_template(text: str, ctx: dict) -> str:
     """Render one template: conditionals first (line-based), then value
     substitution."""
@@ -129,7 +149,7 @@ def render_template(text: str, ctx: dict) -> str:
             continue
         if all(emit_stack):
             out_lines.append(_EXPR.sub(
-                lambda m2: _fmt(_lookup(ctx, m2.group(1))), line))
+                lambda m2: _fmt(_eval_expr(ctx, m2.group(1))), line))
     if len(emit_stack) != 1:
         raise ValueError("unclosed {{ if }}")
     return "\n".join(out_lines)
