@@ -32,7 +32,8 @@ _EXTRA_INDEX = [
     "`AsyncConnectionPool`, `TenantAdmission`",
     "- [obs](obs.md) (hand-maintained; not stage-registry classes): "
     "`MetricsRegistry`, `Counter`, `Gauge`, `Histogram`, `Tracer`, "
-    "`SpanContext`, `TrainRecorder`, bridge adapters",
+    "`SpanContext`, `TrainRecorder`, bridge adapters, perf attribution "
+    "(`extract_cost`, `attribute_segments`, `SLOConfig`, `SLOTracker`)",
     "- wire frames (`mmlspark_tpu.io.binary`, hand-maintained spec in "
     "[docs/serving.md](../serving.md)): `encode_frame`, `decode_frame`, "
     "`frame_info`, `FRAME_CONTENT_TYPE` — the zero-copy binary columnar "

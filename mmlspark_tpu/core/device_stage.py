@@ -108,34 +108,64 @@ class DeviceFn:
 
 
 class CompileCache:
-    """Shared fused-executable cache with hit/miss/compile-time counters.
+    """Shared fused-executable cache with hit/miss/compile-time counters
+    and per-(segment, shape-bucket) XLA cost records.
 
     Key: (segment key, bucketed batch shape+dtype signature). Value: the
     compiled callable. AOT compilation (jit -> lower -> compile) is timed so
     ``compile_time_s`` measures XLA work, not the first batch's compute.
+
+    At miss time the freshly-compiled executable's ``cost_analysis()`` /
+    ``memory_analysis()`` are harvested (obs/perf.py ``extract_cost`` —
+    getattr-gated, every absence degrades to "no record") and stored under
+    the human-readable ``(label, shape)`` pair the caller passes, feeding
+    the ``mmlspark_segment_cost_*`` families and the roofline report.
+
+    Concurrency contract: counter updates AND cost capture happen under the
+    cache lock in one acquisition, so a concurrent ``stats()`` scrape never
+    sees a torn hits/misses/compile_time_s triple. ``reset()`` bumps a
+    generation counter; a build that a reset raced still installs its
+    (valid) executable but does NOT book its miss/compile-time/cost into
+    the post-reset counters — cleared stats never mix epochs.
     """
 
     def __init__(self, capacity: int = 256):
         self._capacity = capacity
         self._entries: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
+        self._gen = 0
+        self._costs: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self.hits = 0
         self.misses = 0
         self.compile_time_s = 0.0
 
-    def get(self, key: Tuple, builder: Callable[[], Any]) -> Any:
+    def get(self, key: Tuple, builder: Callable[[], Any],
+            label: Optional[str] = None,
+            shape: Optional[str] = None) -> Any:
         with self._lock:
             if key in self._entries:
                 self.hits += 1
                 return self._entries[key]
+            gen = self._gen
         # build OUTSIDE the lock: XLA compiles can take seconds and other
         # segments/threads must not serialize behind them
         t0 = time.perf_counter()
         fn = builder()
         dt = time.perf_counter() - t0
+        cost = None
+        if label is not None:
+            from ..obs.perf import extract_cost
+
+            cost = extract_cost(fn)
         with self._lock:
-            self.misses += 1
-            self.compile_time_s += dt
+            stale = self._gen != gen  # reset() raced the build
+            if not stale:
+                self.misses += 1
+                self.compile_time_s += dt
+                if label is not None:
+                    rec = dict(cost or {})
+                    rec["compile_s"] = round(dt, 6)
+                    self._costs[(str(label), str(shape))] = rec
             if key not in self._entries:
                 if len(self._entries) >= self._capacity:
                     self._entries.pop(next(iter(self._entries)))
@@ -144,15 +174,44 @@ class CompileCache:
 
     def clear(self) -> None:
         with self._lock:
+            self._gen += 1
             self._entries.clear()
+            self._costs.clear()
             self.hits = 0
             self.misses = 0
             self.compile_time_s = 0.0
+
+    #: reset() is clear() — the name the obs layer documents
+    reset = clear
 
     @property
     def entries(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    def costs(self) -> Dict[str, Dict[str, Dict[str, Any]]]:
+        """{segment label: {shape bucket: cost record}} — flops /
+        bytes_accessed / peak_memory_bytes / compile_s per compiled
+        executable (whatever subset the backend reported)."""
+        with self._lock:
+            out: Dict[str, Dict[str, Dict[str, Any]]] = {}
+            for (label, shape), rec in self._costs.items():
+                out.setdefault(label, {})[shape] = dict(rec)
+            return out
+
+    def segment_cost(self, label: str) -> Optional[Dict[str, float]]:
+        """Mean per-batch cost across this segment's compiled shape buckets
+        (span attrs + quick attribution), or None when nothing recorded."""
+        with self._lock:
+            recs = [r for (lab, _), r in self._costs.items() if lab == label]
+        if not recs:
+            return None
+        out: Dict[str, float] = {"shape_buckets": float(len(recs))}
+        for k in ("flops", "bytes_accessed", "peak_memory_bytes"):
+            vals = [r[k] for r in recs if isinstance(r.get(k), (int, float))]
+            if vals:
+                out[k] = sum(vals) / len(vals)
+        return out
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

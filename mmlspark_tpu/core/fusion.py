@@ -282,6 +282,19 @@ class SegmentExecutor:
         self.cache = cache if cache is not None else compile_cache()
         self.fallbacks: List[str] = []
 
+    def _cost_attrs(self) -> Dict[str, Any]:
+        """XLA cost attrs for this segment's trace spans (mean per-batch
+        flops/bytes across compiled shape buckets; empty when the backend
+        reported none) — a traced p99 spike carries its cost context."""
+        cost = self.cache.segment_cost(self.segment.label)
+        if not cost:
+            return {}
+        out: Dict[str, Any] = {}
+        for k in ("flops", "bytes_accessed", "peak_memory_bytes"):
+            if k in cost:
+                out[k] = round(cost[k], 1)
+        return out
+
     # -- host path -------------------------------------------------------
     def _host_partition(self, part: Dict[str, np.ndarray], schema: Schema
                         ) -> List[Dict[str, np.ndarray]]:
@@ -311,7 +324,8 @@ class SegmentExecutor:
         if obs is not None:
             tracer, ctxs = obs
             tracer.record_batch(f"segment:{seg.label}", ctxs, t_wall,
-                                time.perf_counter() - t0)
+                                time.perf_counter() - t0,
+                                **self._cost_attrs())
         return self._overlay(df, out_parts)
 
     def _overlay(self, df: DataFrame, out_parts: List[Dict[str, np.ndarray]]
@@ -445,8 +459,12 @@ class SegmentExecutor:
             x, m = staged
             sig = tuple((c, tuple(np.shape(x[c])), str(x[c].dtype))
                         for c in ext)
+            shape_key = ";".join(
+                f"{c}={'x'.join(str(d) for d in shp)}:{dt}"
+                for c, shp, dt in sig)
             compiled = self.cache.get(
-                (seg.key, sig), lambda: self._build(params_dev, x, keys))
+                (seg.key, sig), lambda: self._build(params_dev, x, keys),
+                label=seg.label, shape=shape_key)
             with profiling.annotate(f"fused:{seg.label}"):
                 return compiled(params_dev, x), m
 
@@ -544,7 +562,8 @@ class SegmentExecutor:
             if obs is not None:
                 tracer, ctxs = obs
                 tracer.record_batch(f"segment:{seg.label}", ctxs, t_wall,
-                                    time.perf_counter() - wall0)
+                                    time.perf_counter() - wall0,
+                                    **self._cost_attrs())
             return self._overlay(df, out_parts)
 
         return resolve
@@ -722,20 +741,33 @@ class FusedPipelineModel(PipelineModel):
             return None
         agg = IngestStats()
         for s in self._seg_stats.values():
-            agg.records.extend(s.records)
-            agg.wall_s += s.wall_s
+            agg.merge(s)
         return agg
 
     def fusion_stats(self) -> Dict[str, Any]:
-        """Segment layout + per-segment ingest + compile-cache counters."""
+        """Segment layout + per-segment ingest + compile-cache counters +
+        XLA cost records and the roofline attribution built from them
+        (obs/perf.py): measured-vs-bound per segment with a dominant
+        bottleneck label. Cost/roofline sections are empty (never failing)
+        when the backend reports no cost analysis."""
         nodes = self._last_plan or []
+        per_segment = {label: s.summary()
+                       for label, s in self._seg_stats.items()}
+        costs = self._cache.costs()
+        try:
+            from ..obs.perf import attribute_segments
+
+            roofline = attribute_segments(per_segment, costs)
+        except Exception:  # noqa: BLE001 — attribution must not break stats
+            roofline = {}
         return {
             "segments": [n.describe() for n in nodes],
             "n_fused_segments": sum(isinstance(n, Segment) for n in nodes),
-            "per_segment": {label: s.summary()
-                            for label, s in self._seg_stats.items()},
+            "per_segment": per_segment,
             "fallbacks": list(self._last_fallbacks),
             "compile_cache": self._cache.stats(),
+            "segment_costs": costs,
+            "roofline": roofline,
         }
 
     @property

@@ -91,11 +91,24 @@ def _ingest_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
                ("mmlspark_ingest_overlap_ratio", "gauge", "overlap_ratio",
                 "ring wall / serial stage time (<1 = overlapped)"),
                ("mmlspark_ingest_h2d_gbps", "gauge", "h2d_gbps",
-                "host->device transfer bandwidth"))
+                "host->device transfer bandwidth"),
+               ("mmlspark_transfer_ring_depth", "gauge", "ring_depth",
+                "configured in-flight slot depth of the transfer ring"))
     for mname, mtype, key, help in scalars:
         f = _num(summary.get(key))
         if f is not None:
             yield MetricFamily(mname, mtype, help).add(f)
+    occ = MetricFamily(
+        "mmlspark_transfer_ring_occupancy", "gauge",
+        "observed dispatched-but-undrained steps in the ring "
+        "(mean/max per dispatch; max == depth means the ring saturated)")
+    for stat, key in (("mean", "ring_occupancy_mean"),
+                      ("max", "ring_occupancy_max")):
+        f = _num(summary.get(key))
+        if f is not None:
+            occ.add(f, {"stat": stat})
+    if occ.samples:
+        yield occ
 
 
 def _fusion_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
@@ -125,6 +138,12 @@ def _fusion_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
         yield MetricFamily("mmlspark_fusion_fallbacks", "gauge",
                            "partitions that fell back to the host path "
                            "on the last transform").add(len(fallbacks))
+    # per-(segment, shape-bucket) XLA costs + roofline attribution
+    # (obs/perf.py; families absent when the backend reports no cost data)
+    from .perf import segment_families
+
+    for fam in segment_families(stats):
+        yield fam
 
 
 def _executor_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
@@ -138,6 +157,8 @@ def _executor_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
     for key, mtype, help in (
             ("epochs", "counter", "batches through the pipelined executor"),
             ("inflight", "gauge", "configured in-flight slot depth"),
+            ("inflight_active", "gauge",
+             "batches currently in flight (== inflight means saturated)"),
             ("overlap_ratio", "gauge",
              "stage-busy seconds / pipeline-active wall (>1 = overlapped)"),
             ("active_wall_s", "counter",

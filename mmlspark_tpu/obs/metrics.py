@@ -31,11 +31,13 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
-           "Sample", "TrainRecorder", "default_registry",
-           "set_default_registry"]
+__all__ = ["COMPILE_BUCKETS", "Counter", "DEFAULT_BUCKETS", "Gauge",
+           "Histogram", "MetricFamily", "MetricsRegistry",
+           "SERVING_LATENCY_BUCKETS", "Sample", "TrainRecorder",
+           "default_registry", "set_default_registry"]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -44,16 +46,29 @@ _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: ms-scale buckets for serving latency histograms (sub-ms floor through
+#: the slot timeout) — pass at registration; DEFAULT_BUCKETS is unchanged
+SERVING_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                           0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+#: seconds-scale buckets for XLA compile / warmup timings
+COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0, 300.0)
+
 
 class Sample:
-    """One exposition line: ``name{labels} value``."""
+    """One exposition line: ``name{labels} value``; ``exemplar`` (set only
+    on histogram ``_bucket`` samples that captured one) is rendered in
+    OpenMetrics syntax when the writer is asked for it."""
 
-    __slots__ = ("name", "labels", "value")
+    __slots__ = ("name", "labels", "value", "exemplar")
 
-    def __init__(self, name: str, labels: Dict[str, str], value: float):
+    def __init__(self, name: str, labels: Dict[str, str], value: float,
+                 exemplar: Optional[Dict[str, Any]] = None):
         self.name = name
         self.labels = labels
         self.value = value
+        self.exemplar = exemplar
 
 
 class MetricFamily:
@@ -73,9 +88,10 @@ class MetricFamily:
         self.samples = samples if samples is not None else []
 
     def add(self, value: float, labels: Optional[Dict[str, str]] = None,
-            suffix: str = "") -> "MetricFamily":
+            suffix: str = "",
+            exemplar: Optional[Dict[str, Any]] = None) -> "MetricFamily":
         self.samples.append(Sample(self.name + suffix, dict(labels or {}),
-                                   float(value)))
+                                   float(value), exemplar))
         return self
 
 
@@ -130,8 +146,9 @@ class _Bound:
     def set(self, value: float) -> None:
         self._inst._set(self._key, value)
 
-    def observe(self, value: float) -> None:
-        self._inst._observe(self._key, value)
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
+        self._inst._observe(self._key, value, exemplar)
 
     @property
     def value(self) -> float:
@@ -192,7 +209,17 @@ class Gauge(Counter):
 class Histogram(_Instrument):
     """Bucketed distribution (step times, latencies): per label set keeps
     per-bucket counts + sum + count, rendered as the cumulative
-    ``_bucket{le=...}`` / ``_sum`` / ``_count`` series."""
+    ``_bucket{le=...}`` / ``_sum`` / ``_count`` series.
+
+    Bucket boundaries are per-metric at registration (serving latency wants
+    ms-scale — SERVING_LATENCY_BUCKETS; compile times want seconds-scale —
+    COMPILE_BUCKETS); re-registering the same name with different buckets
+    raises (one name, one meaning — MetricsRegistry enforces it).
+
+    ``observe(value, exemplar={"trace_id": ...})`` pins the exemplar to the
+    bucket the observation lands in (last-write-wins per bucket, with the
+    observed value and a unix timestamp) — the metrics->traces link: a p99
+    bucket carries the trace_id of a request that landed there."""
 
     mtype = "histogram"
 
@@ -205,39 +232,79 @@ class Histogram(_Instrument):
             raise ValueError(f"{self.name}: need at least one bucket")
         self.buckets = tuple(bs)
 
-    def observe(self, value: float) -> None:
-        self._observe((), value)
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
+        self._observe((), value, exemplar)
 
-    def _observe(self, key: Tuple[str, ...], value: float) -> None:
+    def _observe(self, key: Tuple[str, ...], value: float,
+                 exemplar: Optional[Dict[str, str]] = None) -> None:
         v = float(value)
         with self._lock:
             state = self._values.get(key)
             if state is None:
                 state = {"counts": [0] * len(self.buckets),
-                         "sum": 0.0, "count": 0}
+                         "sum": 0.0, "count": 0, "exemplars": {}}
                 self._values[key] = state
+            idx = len(self.buckets)  # +Inf overflow bucket
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     state["counts"][i] += 1
+                    idx = i
                     break
             state["sum"] += v
             state["count"] += 1
+            if exemplar:
+                state["exemplars"][idx] = {
+                    "labels": {str(k): str(lv) for k, lv in exemplar.items()},
+                    "value": v, "ts": time.time()}
 
     def collect(self) -> MetricFamily:
         fam = MetricFamily(self.name, self.mtype, self.help)
         with self._lock:
             for key, state in sorted(self._values.items()):
                 labels = self._label_dict(key)
+                exemplars = state.get("exemplars", {})
                 cum = 0
-                for b, c in zip(self.buckets, state["counts"]):
+                for i, (b, c) in enumerate(zip(self.buckets,
+                                               state["counts"])):
                     cum += c
                     fam.add(cum, {**labels, "le": _fmt_float(b)},
-                            suffix="_bucket")
+                            suffix="_bucket", exemplar=exemplars.get(i))
                 fam.add(state["count"], {**labels, "le": "+Inf"},
-                        suffix="_bucket")
+                        suffix="_bucket",
+                        exemplar=exemplars.get(len(self.buckets)))
                 fam.add(state["sum"], labels, suffix="_sum")
                 fam.add(state["count"], labels, suffix="_count")
         return fam
+
+    def snapshot(self, **labels: str) -> Dict[str, Any]:
+        """JSON-friendly view of one label set (default: the unlabeled
+        series): cumulative buckets, sum/count, and the captured exemplars
+        keyed by their bucket's ``le`` — the always-on exemplar surface in
+        ``/_mmlspark/stats``."""
+        key = self._key(labels) if labels or self.labelnames else ()
+        with self._lock:
+            state = self._values.get(key)
+            if state is None:
+                return {"count": 0, "sum": 0.0, "buckets": {},
+                        "exemplars": {}}
+            counts = list(state["counts"])
+            out = {"count": state["count"], "sum": round(state["sum"], 6),
+                   "exemplars": {}}
+            for i, ex in state.get("exemplars", {}).items():
+                le = _fmt_float(self.buckets[i]) \
+                    if i < len(self.buckets) else "+Inf"
+                out["exemplars"][le] = dict(ex["labels"],
+                                            value=round(ex["value"], 6),
+                                            ts=round(ex["ts"], 3))
+        cum = 0
+        buckets = {}
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            buckets[_fmt_float(b)] = cum
+        buckets["+Inf"] = out["count"]
+        out["buckets"] = buckets
+        return out
 
 
 def _fmt_float(v: float) -> str:
@@ -260,7 +327,17 @@ def _escape_label(s: str) -> str:
     return s.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
 
 
-def render_family(fam: MetricFamily) -> str:
+def _render_exemplar(ex: Dict[str, Any]) -> str:
+    """OpenMetrics exemplar suffix: `` # {labels} value timestamp``."""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"'
+                     for k, v in (ex.get("labels") or {}).items())
+    out = f" # {{{inner}}} {_fmt_float(ex['value'])}"
+    if ex.get("ts") is not None:
+        out += f" {_fmt_float(round(ex['ts'], 3))}"
+    return out
+
+
+def render_family(fam: MetricFamily, exemplars: bool = False) -> str:
     lines = []
     if fam.help:
         lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
@@ -269,9 +346,12 @@ def render_family(fam: MetricFamily) -> str:
         if s.labels:
             inner = ",".join(f'{k}="{_escape_label(str(v))}"'
                              for k, v in s.labels.items())
-            lines.append(f"{s.name}{{{inner}}} {_fmt_float(s.value)}")
+            line = f"{s.name}{{{inner}}} {_fmt_float(s.value)}"
         else:
-            lines.append(f"{s.name} {_fmt_float(s.value)}")
+            line = f"{s.name} {_fmt_float(s.value)}"
+        if exemplars and s.exemplar:
+            line += _render_exemplar(s.exemplar)
+        lines.append(line)
     return "\n".join(lines)
 
 
@@ -288,6 +368,10 @@ class MetricsRegistry:
 
     #: exposition Content-Type (Prometheus text format 0.0.4)
     CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+    #: Content-Type of the exemplar-carrying exposition (OpenMetrics-
+    #: flavored: 0.0.4 lines + exemplar suffixes + the ``# EOF`` trailer)
+    OPENMETRICS_CONTENT_TYPE = \
+        "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -305,6 +389,16 @@ class MetricsRegistry:
                     raise ValueError(
                         f"metric {name!r} already registered as "
                         f"{type(inst).__name__}{inst.labelnames}")
+                want = kw.get("buckets")
+                if want is not None and \
+                        tuple(sorted(float(b) for b in want)) != \
+                        inst.buckets:
+                    # bucket boundaries are part of the metric's meaning: a
+                    # second registrant asking for different ones would
+                    # silently get series it cannot interpret
+                    raise ValueError(
+                        f"metric {name!r} already registered with buckets "
+                        f"{inst.buckets}")
                 return inst
             inst = cls(name, help, labelnames, **kw)
             self._instruments[name] = inst
@@ -347,9 +441,17 @@ class MetricsRegistry:
                         1.0, {"error": type(e).__name__}))
         return sorted(fams, key=lambda f: f.name)
 
-    def exposition(self) -> str:
-        """The full scrape payload (text format 0.0.4, trailing newline)."""
-        return "\n".join(render_family(f) for f in self.collect()) + "\n"
+    def exposition(self, exemplars: bool = False) -> str:
+        """The full scrape payload (text format 0.0.4, trailing newline).
+        ``exemplars=True`` appends OpenMetrics exemplar suffixes to the
+        histogram bucket samples that captured one, plus the ``# EOF``
+        trailer (serve with OPENMETRICS_CONTENT_TYPE) — behind a flag
+        because classic 0.0.4 parsers reject exemplar syntax."""
+        body = "\n".join(render_family(f, exemplars=exemplars)
+                         for f in self.collect()) + "\n"
+        if exemplars:
+            body += "# EOF\n"
+        return body
 
     def sample_value(self, name: str,
                      labels: Optional[Dict[str, str]] = None

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import itertools
 import json
 import random
 import threading
@@ -148,14 +149,27 @@ class Tracer:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._spans: "deque[Span]" = deque(maxlen=cap)
+        # lock-free child-id stream for batch spans: itertools.count is
+        # atomic under the GIL, and the fixed-point multiply is a bijection
+        # on 64 bits, so every draw is unique within this tracer without
+        # touching the seeded RNG (whose lock the ingress path contends
+        # on). The random base drawn ONCE at construction keeps ids from
+        # different tracers writing into the same trace (front + worker
+        # across the hop) from colliding at equal sequence numbers.
+        self._seq = itertools.count(1)
+        self._seq_base = self._rng.getrandbits(64)
         self.started = 0   # traces originated here
         self.joined = 0    # traces continued from an incoming header
         self.dropped = 0   # unsampled ingress decisions
 
     # -- context construction -------------------------------------------
-    def _new_id(self, bits: int = 64) -> str:
-        with self._lock:
-            return f"{self._rng.getrandbits(bits):0{bits // 4}x}"
+    # (id generation inlines under an already-held lock where possible:
+    # the serving hot path at sample_rate=1.0 crosses this lock ~10x per
+    # request if every draw/push re-acquires, and on a contended host each
+    # handoff can cost a scheduler trip — so ingress/record_batch do ONE
+    # acquisition each)
+    def _id_locked(self, bits: int = 64) -> str:
+        return f"{self._rng.getrandbits(bits):0{bits // 4}x}"
 
     def _sample(self) -> bool:
         if self.sample_rate >= 1.0:
@@ -174,7 +188,8 @@ class Tracer:
         if parent is not None:
             with self._lock:
                 self.joined += 1
-            return SpanContext(parent.trace_id, self._new_id(),
+                span_id = self._id_locked()
+            return SpanContext(parent.trace_id, span_id,
                                parent_id=parent.span_id,
                                sampled=parent.sampled)
         sampled = self._sample()
@@ -183,18 +198,32 @@ class Tracer:
                 self.started += 1
             else:
                 self.dropped += 1
-        return SpanContext(self._new_id(128), self._new_id(),
-                           sampled=sampled)
+            trace_id = self._id_locked(128)
+            span_id = self._id_locked()
+        return SpanContext(trace_id, span_id, sampled=sampled)
 
     def child(self, ctx: SpanContext) -> SpanContext:
         """New span context under ``ctx`` (same trace, parent = ctx)."""
-        return SpanContext(ctx.trace_id, self._new_id(),
+        return SpanContext(ctx.trace_id, self._seq_id(),
                            parent_id=ctx.span_id, sampled=ctx.sampled)
 
+    def _seq_id(self) -> str:
+        """Unique 64-bit span id without taking the RNG lock: a
+        Fibonacci-hashed counter (bijective on 64 bits — no collisions
+        within a tracer) XOR a per-tracer random base (collision odds
+        across tracers match the old fully-random ids)."""
+        n = next(self._seq) * 0x9e3779b97f4a7c15 & (1 << 64) - 1
+        return f"{n ^ self._seq_base:016x}"
+
     # -- recording -------------------------------------------------------
+    # deque appends are atomic under the GIL, so the serving hot path
+    # records spans LOCK-FREE — the batcher thread's 3 batch-stage records
+    # per request no longer trade the tracer lock with the handler
+    # thread's ingress/finish (each contended handoff is a potential
+    # scheduler trip on a loaded host). Snapshot reads retry around a
+    # concurrent append instead (spans()).
     def _push(self, span: Span) -> None:
-        with self._lock:
-            self._spans.append(span)
+        self._spans.append(span)
 
     def record(self, name: str, ctx: Optional[SpanContext], t0: float,
                dur_s: float, **attrs: Any) -> None:
@@ -209,11 +238,14 @@ class Tracer:
         """One span per SAMPLED context — a batch-level stage (drain, H2D,
         dispatch, readback) seen from every traced request it carried. Each
         span gets its own span_id, parented to the request's ingress span."""
+        a = attrs or None
+        push = self._spans.append
         for ctx in ctxs:
             if ctx is None or not ctx.sampled:
                 continue
-            self._push(Span(name, self.child(ctx), t0, dur_s,
-                            attrs or None, self.service))
+            child = SpanContext(ctx.trace_id, self._seq_id(),
+                                parent_id=ctx.span_id, sampled=True)
+            push(Span(name, child, t0, dur_s, a, self.service))
 
     @contextlib.contextmanager
     def span(self, name: str, ctx: Optional[SpanContext],
@@ -245,15 +277,23 @@ class Tracer:
 
     # -- introspection / export -----------------------------------------
     def spans(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
-        with self._lock:
-            out = [s.to_dict() for s in self._spans]
+        # recorders append lock-free; a snapshot that races one retries
+        # (appends are sub-microsecond, so a second attempt always lands)
+        for _ in range(64):
+            try:
+                snap = list(self._spans)
+                break
+            except RuntimeError:  # deque mutated during iteration
+                continue
+        else:  # pragma: no cover - 64 consecutive races
+            snap = []
+        out = [s.to_dict() for s in snap]
         if trace_id is not None:
             out = [s for s in out if s["trace_id"] == trace_id]
         return out
 
     def clear(self) -> None:
-        with self._lock:
-            self._spans.clear()
+        self._spans.clear()
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

@@ -152,12 +152,37 @@ class IngestStats:
     def __init__(self):
         self.records: List[BatchTiming] = []
         self.wall_s: float = 0.0
+        # ring slot occupancy (dispatched-but-undrained steps): configured
+        # depth + running mean/max of observed fill, so "is the ring ever
+        # actually full?" is a scraped gauge instead of a rerun experiment
+        self.ring_depth: int = 0
+        self._occ_sum: int = 0
+        self._occ_n: int = 0
+        self._occ_max: int = 0
 
     def record(self, t: BatchTiming) -> None:
         self.records.append(t)
 
     def add_wall(self, seconds: float) -> None:
         self.wall_s += seconds
+
+    def note_ring(self, depth: int) -> None:
+        self.ring_depth = max(self.ring_depth, int(depth))
+
+    def note_occupancy(self, in_flight: int) -> None:
+        n = int(in_flight)
+        self._occ_sum += n
+        self._occ_n += 1
+        self._occ_max = max(self._occ_max, n)
+
+    def merge(self, other: "IngestStats") -> None:
+        """Fold another stats object in (segment aggregation)."""
+        self.records.extend(other.records)
+        self.wall_s += other.wall_s
+        self.ring_depth = max(self.ring_depth, other.ring_depth)
+        self._occ_sum += other._occ_sum
+        self._occ_n += other._occ_n
+        self._occ_max = max(self._occ_max, other._occ_max)
 
     @property
     def num_batches(self) -> int:
@@ -185,6 +210,12 @@ class IngestStats:
             "h2d_gbps": round(total_bytes / cols["h2d_s"] / 1e9, 4)
             if cols["h2d_s"] > 0 else None,
         }
+        if self.ring_depth > 0:
+            out["ring_depth"] = self.ring_depth
+            if self._occ_n > 0:
+                out["ring_occupancy_mean"] = round(
+                    self._occ_sum / self._occ_n, 4)
+                out["ring_occupancy_max"] = self._occ_max
         for f, v in cols.items():
             out[f] = round(v, 6)
             out[f"{f[:-2]}_ms_per_batch"] = round(v / n * 1e3, 4)
@@ -332,6 +363,8 @@ class TransferRing:
             raise ValueError("depth must be positive")
         self.depth = depth
         self.stats = stats if stats is not None else IngestStats()
+        if hasattr(self.stats, "note_ring"):
+            self.stats.note_ring(depth)
         self._step = step if step is not None else (lambda x: x)
         self._fetch = fetch if fetch is not None else _default_fetch
         self._user_put = put
@@ -365,6 +398,8 @@ class TransferRing:
                 handle = self._step(staged)
                 timing.dispatch_s = time.perf_counter() - td
                 inflight.append((handle, timing))
+                if hasattr(self.stats, "note_occupancy"):
+                    self.stats.note_occupancy(len(inflight))
                 if len(inflight) >= self.depth:
                     yield self._drain(inflight)
             while inflight:

@@ -485,11 +485,16 @@ class PipelinedExecutor:
             drain_s = self._busy["drain"]
             readback_s = self._busy["readback"]
             epochs = self.epochs
+            active = self._active
         compute_s = sum(r.busy_s for r in self.replicas.replicas)
         serial = drain_s + compute_s + readback_s
         return {
             "mode": "pipelined",
             "inflight": self.inflight,
+            # batches currently past drain and not yet fulfilled: the live
+            # slot occupancy (== inflight means the pipeline is saturated
+            # — the perf-attribution companion to the ring gauges)
+            "inflight_active": active,
             "epochs": epochs,
             "replicas": self.replicas.describe(wall),
             "controller": self.controller.state()

@@ -77,6 +77,9 @@ class RoutingFront:
     #: the front's own Prometheus exposition + liveness probe
     METRICS_PATH = "/_mmlspark/metrics"
     HEALTH_PATH = "/_mmlspark/healthz"
+    #: buffered spans as JSON (worker parity: cross-hop exemplar lookups
+    #: resolve from the front too, not just the worker that served them)
+    TRACE_PATH = "/_mmlspark/trace"
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  forward_timeout_s: float = 70.0, max_failures: int = 3,
@@ -86,7 +89,7 @@ class RoutingFront:
                  probe_policy: Optional[RetryPolicy] = None,
                  obs: bool = True, tracer: Optional[Tracer] = None,
                  trace_sample_rate: float = 1.0,
-                 http_mode: str = "thread"):
+                 http_mode: str = "thread", slo=None):
         self.host = host
         self.port = port
         self.forward_timeout_s = forward_timeout_s
@@ -128,6 +131,10 @@ class RoutingFront:
         self.registry: Optional[MetricsRegistry] = None
         self.tracer: Optional[Tracer] = None
         self._forwards = None
+        # front-side latency SLO (obs/perf.py): burn-rate gauges over the
+        # client-observed forward latency, so the autoscaling signal exists
+        # at the tier the HPA actually scales behind
+        self._slo = None
         if self.obs_enabled:
             self.registry = MetricsRegistry()
             self.tracer = tracer if tracer is not None else Tracer(
@@ -137,10 +144,22 @@ class RoutingFront:
             self._forwards = self.registry.counter(
                 "mmlspark_front_requests_total",
                 "public requests by routing outcome", ("outcome",))
+            from ..obs import perf as obs_perf
+
+            self._slo = obs_perf.make_slo(slo)
+            if self._slo is not None:
+                self.registry.register_collector(self._slo.families)
 
     def _count(self, outcome: str) -> None:
         if self._forwards is not None:
             self._forwards.labels(outcome=outcome).inc()
+
+    def _slo_record(self, t_p0: float, status: int) -> None:
+        """Feed one public-request outcome to the SLO tracker (shed/error
+        statuses burn budget regardless of how fast they were written)."""
+        if self._slo is not None:
+            self._slo.record(time.perf_counter() - t_p0,
+                             breach=True if status >= 500 else None)
 
     # -- worker management ------------------------------------------------
     def register(self, address: str, capacity: int = 1) -> None:
@@ -289,6 +308,16 @@ class RoutingFront:
                         b'{"error": "observability disabled"}')
             return (200, MetricsRegistry.CONTENT_TYPE,
                     self.registry.exposition().encode("utf-8"))
+        if path == RoutingFront.TRACE_PATH:
+            # worker parity (ServingServer.TRACE_PATH): a latency-bucket
+            # exemplar found in the front's exposition resolves HERE —
+            # front ingress/forward spans share the worker's trace_id
+            if self.tracer is None:
+                return (404, "application/json",
+                        b'{"error": "observability disabled"}')
+            return (200, "application/json", json.dumps(
+                {"stats": self.tracer.stats(),
+                 "spans": self.tracer.spans()}).encode("utf-8"))
         return None
 
     def _make_handler(self):
@@ -336,6 +365,7 @@ class RoutingFront:
                     self._respond(status, body, ctype, extra)
                     if outcome is not None:
                         front._count(outcome)
+                    front._slo_record(t_p0, int(status))
                     if tctx is not None and tctx.sampled:
                         front.tracer.record(
                             "ingress", tctx, t_w0,
@@ -468,6 +498,7 @@ class RoutingFront:
                     outcome=None):
             if outcome is not None:
                 self._count(outcome)
+            self._slo_record(t_p0, int(status))
             if tctx is not None and tctx.sampled:
                 self.tracer.record("ingress", tctx, t_w0,
                                    time.perf_counter() - t_p0,

@@ -62,8 +62,9 @@ from ..core.dataframe import DataFrame
 from ..core.faults import deadline_from_headers
 from ..io.binary import FRAME_CONTENT_TYPE, FrameError, frame_info
 from ..obs import bridge as obs_bridge
+from ..obs import perf as obs_perf
 from ..obs import trace as obs_trace
-from ..obs.metrics import MetricsRegistry
+from ..obs.metrics import SERVING_LATENCY_BUCKETS, MetricsRegistry
 from ..obs.trace import Tracer
 from .tenants import TenantAdmission
 
@@ -276,7 +277,8 @@ class ServingServer:
                  trace_sample_rate: float = 1.0,
                  http_mode: str = "thread",
                  wire_binary: bool = True,
-                 tenants=None):
+                 tenants=None, slo=None,
+                 metrics_exemplars: bool = False):
         self.transform = transform
         # optional provider of the device-ingest decomposition (queue/h2d/
         # compute/readback — parallel/ingest.IngestStats.summary) merged into
@@ -365,12 +367,30 @@ class ServingServer:
         self.registry: Optional[MetricsRegistry] = None
         self.tracer: Optional[Tracer] = None
         self._traces: Dict[int, obs_trace.SpanContext] = {}
+        # perf attribution layer (obs/perf.py): a latency HISTOGRAM whose
+        # buckets carry trace-id exemplars (the metrics->traces link), a
+        # declarative latency SLO with multi-window burn-rate gauges (the
+        # HPA signal), and the device-memory collector. ``slo`` accepts an
+        # SLOConfig/dict, False to disable, or None for the default
+        # objective; ``metrics_exemplars`` gates the OpenMetrics exemplar
+        # syntax on /_mmlspark/metrics (always present in /_mmlspark/stats).
+        self.metrics_exemplars = bool(metrics_exemplars)
+        self._slo: Optional[obs_perf.SLOTracker] = None
+        self._lat_hist = None
         if self.obs_enabled:
             self.registry = MetricsRegistry()
             self.tracer = tracer if tracer is not None else Tracer(
                 sample_rate=trace_sample_rate, service=name)
             obs_bridge.fold_server(self.registry, self)
             obs_bridge.fold_tracer(self.registry, self.tracer)
+            self._slo = obs_perf.make_slo(slo)
+            if self._slo is not None:
+                self.registry.register_collector(self._slo.families)
+            self._lat_hist = self.registry.histogram(
+                "mmlspark_request_duration_seconds",
+                "end-to-end request latency (ingress to reply write)",
+                buckets=SERVING_LATENCY_BUCKETS)
+            obs_perf.fold_device_memory(self.registry)
 
     # -- ingress (transport-agnostic request handling) -------------------
     #
@@ -433,6 +453,12 @@ class ServingServer:
                 summary["tenants"] = self._tenants.summary()
             if self._aio is not None:
                 summary["http"] = self._aio.stats()
+            if self._slo is not None:
+                summary["slo"] = self._slo.summary()
+            if self._lat_hist is not None:
+                # bucket counts + trace-id exemplars, ALWAYS here (the
+                # exposition carries them only behind metrics_exemplars)
+                summary["latency_histogram"] = self._lat_hist.snapshot()
             return (200, "application/json",
                     json.dumps(summary).encode("utf-8"), None)
         if path == ServingServer.HEALTH_PATH:
@@ -445,8 +471,12 @@ class ServingServer:
             if self.registry is None:
                 return (404, "application/json",
                         b'{"error": "observability disabled"}', None)
-            return (200, MetricsRegistry.CONTENT_TYPE,
-                    self.registry.exposition().encode("utf-8"), None)
+            ex = self.metrics_exemplars
+            ctype = MetricsRegistry.OPENMETRICS_CONTENT_TYPE if ex \
+                else MetricsRegistry.CONTENT_TYPE
+            return (200, ctype,
+                    self.registry.exposition(exemplars=ex).encode("utf-8"),
+                    None)
         if path == ServingServer.TRACE_PATH:
             if self.tracer is None:
                 return (404, "application/json",
@@ -573,6 +603,15 @@ class ServingServer:
         self._pop_slot(rid)
         if not ok:
             self.stats.record_shed(504, "slot_timeout", tenant=slot.tenant)
+            total_s = time.perf_counter() - slot.t_in
+            if self._slo is not None:
+                # a timed-out slot burns error budget regardless of how
+                # fast the 504 itself was written
+                self._slo.record(total_s, breach=True)
+            if self._lat_hist is not None:
+                self._lat_hist.observe(
+                    total_s, exemplar={"trace_id": tctx.trace_id}
+                    if tctx is not None else None)
             if tctx is not None:
                 self.tracer.record(
                     "ingress", tctx, t_wall_in,
@@ -584,11 +623,21 @@ class ServingServer:
             # stamp the total HERE (post wakeup + HTTP write) so
             # overhead = total - queue - compute measures the slot
             # wakeup and response write, not zero by construction
+            t_end = time.perf_counter()
+            total_s = t_end - slot.t_in
             if slot.t_in and slot.t_drain and slot.t_done:
-                t_end = time.perf_counter()
                 self.stats.record(slot.t_drain - slot.t_in,
                                   slot.t_done - slot.t_drain,
-                                  t_end - slot.t_in, slot.batch)
+                                  total_s, slot.batch)
+            if self._slo is not None:
+                self._slo.record(total_s)
+            if self._lat_hist is not None:
+                # the exemplar pins THIS request's trace_id to the latency
+                # bucket it landed in: a p99 spike in the scrape is one
+                # click from its Perfetto timeline
+                self._lat_hist.observe(
+                    total_s, exemplar={"trace_id": tctx.trace_id}
+                    if tctx is not None else None)
             if tctx is not None:
                 # the request's root span on this hop: covers queue wait,
                 # batch stages (its children), and the reply write
@@ -1149,7 +1198,8 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    obs: bool = True,
                    trace_sample_rate: float = 1.0,
                    http_mode: str = "thread", wire_binary: bool = True,
-                   tenants=None) -> ServingServer:
+                   tenants=None, slo=None,
+                   metrics_exemplars: bool = False) -> ServingServer:
     """Serve a fitted Transformer: request body -> ``input_col`` -> stage ->
     ``reply_col`` (IOImplicits fluent sugar parity, io/IOImplicits.scala:182-213).
 
@@ -1176,7 +1226,12 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
     (io/binary.py; ``parse_request`` decodes frame rows zero-copy whatever
     ``parse`` mode JSON clients use). ``tenants`` (weights dict or
     TenantAdmission) switches bounded admission to per-tenant weighted-fair
-    shedding on the ``X-MMLSpark-Tenant`` header.
+    shedding on the ``X-MMLSpark-Tenant`` header. ``slo`` declares the
+    latency objective behind the ``mmlspark_slo_burn_rate`` gauges
+    (SLOConfig/dict; None = the default 250ms @ p99; False = off), and
+    ``metrics_exemplars=True`` renders trace-id exemplars on
+    ``/_mmlspark/metrics`` in OpenMetrics syntax (obs/perf.py — always
+    present in ``/_mmlspark/stats`` regardless).
     """
     from ..core.pipeline import PipelineModel
     from .stages import parse_request
@@ -1227,4 +1282,5 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                          adaptive_batching=adaptive_batching, obs=obs,
                          trace_sample_rate=trace_sample_rate,
                          http_mode=http_mode, wire_binary=wire_binary,
-                         tenants=tenants)
+                         tenants=tenants, slo=slo,
+                         metrics_exemplars=metrics_exemplars)

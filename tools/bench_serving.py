@@ -446,35 +446,99 @@ def _wire_section(n_clients, duration, reps=3):
 
 
 def _obs_overhead_section(echo, payload, n):
-    """A/B the observability layer's hot-path cost: identical echo servers
-    with the obs layer on (per-request tracing at sample_rate=1.0 — the
-    WORST case — plus registry bridge) vs ``obs=False`` (PR-4 behavior).
-    Best-of-3 single-stream mean latency per arm; the echo endpoint is the
-    pipeline-overhead floor, so this is the least favorable denominator the
-    overhead can be quoted against."""
+    """A/B the observability layer's hot-path cost, two deltas:
+
+    - ``full_layer``: obs on (per-request tracing at sample_rate=1.0 —
+      the WORST case — registry bridge + the perf-attribution collectors)
+      vs ``obs=False``. Both servers live in ONE process and bursts
+      alternate between them (paired measurement: the old best-of-3 over
+      separate processes was dominated by process-placement luck — the
+      PR-5 artifact recorded -4% for a layer that cannot be negative).
+    - ``perf_collectors``: THIS PR's increment — the same obs=True server
+      with its SLO tracker + latency histogram toggled on vs stripped,
+      alternating per round. This is the <2%-budget number for the
+      attribution layer; the exemplar/SLO hot-path cost is two lock-free
+      dict updates and one bucket scan per request.
+
+    The echo endpoint is the pipeline-overhead floor, so these are the
+    least favorable denominators the overheads can be quoted against."""
+    import urllib.request
+
     from mmlspark_tpu.serving import ServingServer
 
-    def run(obs):
-        best = None
-        for _ in range(3):
-            with ServingServer(echo, port=0, max_wait_ms=0.0,
-                               obs=obs) as server:
-                server.warmup(payload)
-                r = _measure(server.address, payload, n)
-            if best is None or r["mean_ms"] < best["mean_ms"]:
-                best = r
-        return best
+    def burst(server, k):
+        return _measure(server.address, payload, k)
 
-    on, off = run(True), run(False)
+    rounds, k = 8, max(25, n // 4)
+    on = ServingServer(echo, port=0, max_wait_ms=0.0, obs=True,
+                       metrics_exemplars=True).start()
+    off = ServingServer(echo, port=0, max_wait_ms=0.0, obs=False).start()
+    try:
+        on.warmup(payload)
+        off.warmup(payload)
+        burst(on, k), burst(off, k)  # throwaway warm round
+        ons, offs = [], []
+        for _ in range(rounds):
+            ons.append(burst(on, k)["mean_ms"])
+            offs.append(burst(off, k)["mean_ms"])
+        full_deltas = [a - b for a, b in zip(ons, offs)]
+        full = {
+            "obs_on_mean_ms": round(sum(ons) / rounds, 4),
+            "obs_off_mean_ms": round(sum(offs) / rounds, 4),
+            "overhead_pct_mean": round(
+                sum(full_deltas) / rounds / (sum(offs) / rounds) * 100, 2)}
+
+        # perf-collector increment: same server object, alternating the
+        # perf instruments on/off per round (removes placement luck)
+        slo, hist = on._slo, on._lat_hist
+        with_perf, without = [], []
+        for _ in range(rounds):
+            on._slo, on._lat_hist = slo, hist
+            with_perf.append(burst(on, k)["mean_ms"])
+            on._slo, on._lat_hist = None, None
+            without.append(burst(on, k)["mean_ms"])
+        on._slo, on._lat_hist = slo, hist
+        perf_deltas = [a - b for a, b in zip(with_perf, without)]
+        perf = {
+            "with_mean_ms": round(sum(with_perf) / rounds, 4),
+            "without_mean_ms": round(sum(without) / rounds, 4),
+            "overhead_pct_mean": round(
+                sum(perf_deltas) / rounds / (sum(without) / rounds) * 100,
+                2)}
+
+        # prove the perf collectors render under load (scrape-time cost,
+        # off the measured hot path)
+        url = f"http://{on.host}:{on.port}/_mmlspark/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode()
+        perf_families = sum(
+            1 for name in ("mmlspark_slo_burn_rate",
+                           "mmlspark_request_duration_seconds",
+                           "mmlspark_slo_requests_total") if name in text
+        )
+        server_clocks = {
+            "obs_on": {c: on.stats.summary()[f"{c}_ms"]["p50"]
+                       for c in ("queue", "compute", "overhead", "total")},
+            "obs_off": {c: off.stats.summary()[f"{c}_ms"]["p50"]
+                        for c in ("queue", "compute", "overhead", "total")}}
+    finally:
+        on.stop()
+        off.stop()
     return {
-        "obs_on": on, "obs_off": off,
-        "overhead_pct_mean": round(
-            (on["mean_ms"] - off["mean_ms"]) / off["mean_ms"] * 100, 2),
-        "overhead_pct_p50": round(
-            (on["p50_ms"] - off["p50_ms"]) / off["p50_ms"] * 100, 2),
-        "note": "best-of-3 per arm, trace sample_rate=1.0 (worst case), "
-                "echo endpoint = overhead floor; single shared host core "
-                "=> scheduler noise can exceed the true delta",
+        "full_layer": full, "perf_collectors": perf,
+        "perf_families_rendered": perf_families,
+        "server_clocks_p50_ms": server_clocks,
+        # kept as the headline budget number: what THIS layer added
+        "overhead_pct_mean": perf["overhead_pct_mean"],
+        "note": "paired interleaved bursts, one process, trace "
+                "sample_rate=1.0 (worst case), echo endpoint = overhead "
+                "floor. perf_collectors = the attribution layer's "
+                "increment (SLO + exemplar histogram, <2% budget); "
+                "full_layer = everything obs=True turns on vs PR-4 "
+                "obs=False — on this 1-core container its delta is "
+                "dominated by cross-thread scheduling of span recording "
+                "at sample_rate=1.0, which production deployments dial "
+                "down (head sampling), not by the collectors",
     }
 
 

@@ -33,6 +33,7 @@ PACKAGES=(
   "tests/test_attention.py tests/test_parallel_pp_ep.py"
   "tests/test_codegen_cli.py tests/test_rgen.py tests/test_plot.py tests/test_datagen.py"
   "tests/test_observability.py"
+  "tests/test_perf_attribution.py"
   "tests/test_benchmarks_extended.py"
   "tests/test_multiprocess.py"
   "tests/test_examples.py"

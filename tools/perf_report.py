@@ -1,0 +1,228 @@
+"""Per-segment performance attribution report (obs/perf.py consumer).
+
+Renders the cost / achieved / bound / bottleneck table that closes the
+"~250x between roofline and e2e, but WHERE?" question from the ROADMAP:
+one row per fused segment with XLA's own cost numbers, the measured wall
+per batch, the roofline bound, their ratio, the dominant bottleneck label,
+and the exemplar trace ids that link a row back to concrete Perfetto
+timelines.
+
+Three sources:
+
+  python tools/perf_report.py --url http://worker:8899     # live server
+  python tools/perf_report.py --trace spans.jsonl          # JSONL dump
+  python tools/perf_report.py --demo                       # image chain
+
+``--url`` reads ``/_mmlspark/stats`` (fusion.roofline + segment_costs +
+latency_histogram exemplars + slo). ``--trace`` aggregates ``segment:*``
+spans from a ``Tracer.export_jsonl`` dump (cost attrs ride on the spans).
+``--demo`` builds the image chain the flagship bench measures
+(ImageTransformer -> ImageFeaturizer), runs it fused on this host, and
+prints its table — the zero-setup smoke path. ``--json`` emits the rows as
+one JSON object instead of the table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+# runnable as `python tools/perf_report.py` on an uninstalled checkout
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+COLUMNS = (("segment", "segment"), ("batches", "n_batches"),
+           ("rows", "rows"), ("ms/batch", "measured_ms_per_batch"),
+           ("bound ms", "bound_ms_per_batch"), ("roofline", "roofline_ratio"),
+           ("bottleneck", "bottleneck"), ("flops/batch", "flops_per_batch"),
+           ("bytes/batch", "bytes_per_batch"), ("exemplars", "exemplars"))
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v == 0:
+            return "0"
+        if abs(v) >= 1e6 or abs(v) < 1e-3:
+            return f"{v:.3g}"
+        return f"{v:.4g}"
+    if isinstance(v, (list, tuple)):
+        return ",".join(str(x) for x in v) or "-"
+    return str(v)
+
+
+def render_table(rows: List[Dict[str, Any]]) -> str:
+    """Aligned per-segment attribution table."""
+    if not rows:
+        return "(no fused segments with recorded batches)"
+    cells = [[h for h, _ in COLUMNS]]
+    for r in rows:
+        cells.append([_fmt(r.get(k)) for _, k in COLUMNS])
+    widths = [max(len(row[i]) for row in cells) for i in range(len(COLUMNS))]
+    lines = []
+    for j, row in enumerate(cells):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths))
+                     .rstrip())
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def rows_from_fusion(fusion: Dict[str, Any],
+                     exemplars: Optional[Dict[str, Any]] = None
+                     ) -> List[Dict[str, Any]]:
+    """fusion_stats() payload -> table rows (roofline section is the base;
+    cost columns fall back to segment_costs when roofline lacks them)."""
+    roofline = fusion.get("roofline") or {}
+    costs = fusion.get("segment_costs") or {}
+    ex_ids = sorted({v.get("trace_id") for v in (exemplars or {}).values()
+                     if v.get("trace_id")})
+    rows = []
+    for label in sorted(set(roofline) | set(costs)):
+        rec = dict(roofline.get(label) or {})
+        rec["segment"] = label
+        if "flops_per_batch" not in rec and costs.get(label):
+            shapes = costs[label]
+            for src, dst in (("flops", "flops_per_batch"),
+                             ("bytes_accessed", "bytes_per_batch")):
+                vals = [v[src] for v in shapes.values() if src in v]
+                if vals:
+                    rec[dst] = sum(vals) / len(vals)
+        rec["exemplars"] = ex_ids
+        rows.append(rec)
+    return rows
+
+
+def rows_from_stats(stats: Dict[str, Any]) -> List[Dict[str, Any]]:
+    fusion = stats.get("fusion") or {}
+    hist = stats.get("latency_histogram") or {}
+    return rows_from_fusion(fusion, hist.get("exemplars"))
+
+
+def rows_from_trace(path: str) -> List[Dict[str, Any]]:
+    """Aggregate ``segment:*`` spans from a JSONL trace dump: mean duration
+    per segment, the cost attrs the spans carry, and the trace ids seen
+    (every one of which IS an exemplar — it resolves in the same file)."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            s = json.loads(line)
+            name = s.get("name", "")
+            if not name.startswith("segment:"):
+                continue
+            label = name[len("segment:"):]
+            a = agg.setdefault(label, {"n": 0, "dur": 0.0, "tids": set(),
+                                       "attrs": {}})
+            a["n"] += 1
+            a["dur"] += float(s.get("dur_s") or 0.0)
+            if s.get("trace_id"):
+                a["tids"].add(s["trace_id"])
+            for k in ("flops", "bytes_accessed", "peak_memory_bytes"):
+                v = (s.get("attrs") or {}).get(k)
+                if isinstance(v, (int, float)):
+                    a["attrs"][k] = v
+    rows = []
+    for label, a in sorted(agg.items()):
+        rows.append({
+            "segment": label, "n_batches": a["n"],
+            "measured_ms_per_batch": round(a["dur"] / a["n"] * 1e3, 4)
+            if a["n"] else None,
+            "flops_per_batch": a["attrs"].get("flops"),
+            "bytes_per_batch": a["attrs"].get("bytes_accessed"),
+            "exemplars": sorted(a["tids"])[:4]})
+    return rows
+
+
+def demo_rows() -> List[Dict[str, Any]]:
+    """Build + fuse the flagship image chain (the pipeline
+    BENCH_image_e2e.json measures), run it on synthetic images, and
+    attribute it — the zero-setup path to a real table."""
+    import jax
+    import numpy as np
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.core.device_stage import CompileCache
+    from mmlspark_tpu.core.fusion import FusedPipelineModel
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    from mmlspark_tpu.core.schema import ImageSchema
+    from mmlspark_tpu.image.featurizer import ImageFeaturizer
+    from mmlspark_tpu.image.stages import ImageTransformer
+    from mmlspark_tpu.models.module import (BatchNorm, Conv2D, Dense,
+                                            FunctionModel, GlobalAvgPool,
+                                            Sequential, relu)
+
+    size = 24
+    mod = Sequential([("conv", Conv2D(8, (3, 3))), ("bn", BatchNorm()),
+                      ("act", relu()), ("pool", GlobalAvgPool()),
+                      ("head", Dense(4))], name="democnn")
+    params, _ = mod.init(jax.random.PRNGKey(0), (size, size, 3))
+    backbone = FunctionModel(mod, params, (size, size, 3),
+                             layer_names=["head", "pool"], name="democnn")
+
+    rng = np.random.default_rng(0)
+    n = 64
+    rows = np.empty(n, dtype=object)
+    for i in range(n):
+        rows[i] = ImageSchema.make(
+            rng.integers(0, 256, (32, 32, 3), dtype=np.uint8), f"img{i}")
+    df = DataFrame.from_dict({"image": rows}, num_partitions=2)
+    pm = PipelineModel([
+        ImageTransformer().resize(size, size).flip(1),
+        ImageFeaturizer(scaleFactor=1 / 255., batchSize=16)
+        .set_model(backbone)])
+    fused = FusedPipelineModel(pm.stages, cache=CompileCache())
+    fused.transform(df)       # cold: compiles + records costs
+    fused.transform(df)       # warm: the measured pass
+    return rows_from_fusion(fused.fusion_stats())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="server base URL (reads /_mmlspark/stats)")
+    src.add_argument("--trace", help="JSONL span dump (Tracer.export_jsonl)")
+    src.add_argument("--demo", action="store_true",
+                     help="run the fused image chain locally and report it")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit rows as JSON instead of the table")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    slo = None
+    if args.url:
+        url = args.url.rstrip("/") + "/_mmlspark/stats"
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            stats = json.loads(resp.read())
+        rows = rows_from_stats(stats)
+        slo = stats.get("slo")
+    elif args.trace:
+        rows = rows_from_trace(args.trace)
+    else:
+        rows = demo_rows()
+
+    if args.as_json:
+        print(json.dumps({"segments": rows, "slo": slo}))
+        return 0
+    print(render_table(rows))
+    if slo:
+        burns = ", ".join(f"{w}s={rec['burn_rate']}"
+                          for w, rec in sorted(
+                              slo.get("windows", {}).items(),
+                              key=lambda kv: int(kv[0])))
+        print(f"\nSLO {slo['name']}: objective {slo['objective_ms']}ms "
+              f"@ p{slo['target'] * 100:g}, burn rate {burns}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
