@@ -1,0 +1,30 @@
+"""AST-based, dependency-free static analysis with project-specific passes.
+
+Seven PRs of history show this codebase's worst shipped bugs were statically
+visible: reset()-vs-build races in CompileCache (PR 7), close-vs-producer
+races in the batcher (PR 1), a renderer emitting broken bootstrap args
+(PR 6), and two rounds of manual bare-assert audits (PRs 1, 3). This package
+derives those facts from the AST and fails CI on violations instead of
+re-auditing by hand every few PRs — Automap (PAPERS.md) applied defensively:
+program structure is analyzed mechanically, here for concurrency and
+device-purity properties rather than parallelism ones.
+
+Entry points:
+
+  - ``tools/analyze.py``            CLI (human + ``--json`` output)
+  - ``analysis.run_analysis(root)`` library API (the CLI and tests use this)
+  - ``analysis.analyze_source``     single-snippet API (fixture tests)
+
+Pass catalog and suppression syntax: docs/static_analysis.md.
+"""
+
+from .framework import (  # noqa: F401
+    Finding,
+    SourceFile,
+    AnalysisPass,
+    run_analysis,
+    analyze_source,
+    default_passes,
+    CHECKED_DIRS,
+    SUPPRESSION_FILE,
+)

@@ -1,0 +1,64 @@
+"""Small shared AST helpers for the analysis passes."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.pcast`` -> "jax.lax.pcast"; None for non-name chains
+    (calls, subscripts, literals anywhere in the chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """"X" for a ``self.X`` attribute node, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def assigned_attrs(stmt: ast.stmt) -> Iterator[Tuple[str, int]]:
+    """(attr, line) for every ``self.X`` stored to by an assignment
+    statement, including tuple unpacking and augmented assignment."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for node in ast.walk(t):
+            attr = self_attr(node)
+            if attr is not None and isinstance(node.ctx, ast.Store):
+                yield attr, node.lineno
+
+
+def call_keyword(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def walk_skipping_nested_functions(body) -> Iterator[ast.AST]:
+    """Walk statements of one function body without descending into nested
+    (a)sync function definitions — their bodies run in a different context
+    (e.g. an executor thread) and are analyzed on their own."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)

@@ -1,0 +1,307 @@
+"""C0xx — per-class lock model, lock-order graph, async blocking calls.
+
+The historical bug classes this pass re-detects mechanically:
+
+  C001  an attribute written both inside and outside ``with self._lock``
+        blocks of the same class — the CompileCache reset()-vs-build race
+        (PR 7's generation guard) and the batcher close-vs-producer race
+        (PR 1) were exactly this shape.
+  C002  cross-class lock-acquisition order inversion: while holding lock A
+        some method calls into a class that takes lock B, and elsewhere the
+        acquisition happens B-then-A — a deadlock candidate.
+  C003  blocking calls (``time.sleep``, socket/file I/O, ``.result()``,
+        bare ``lock.acquire()``, ``queue.get()`` without timeout) inside
+        ``async def`` bodies — each stalls the entire event loop
+        (``serving/aio.py`` runs every connection on one loop).
+
+Model notes (kept deliberately conservative to stay quiet on sound code):
+
+  - a "lock attribute" is any ``self.X = threading.Lock()/RLock()/
+    Condition()`` assignment in the class;
+  - writes in ``__init__``/``__post_init__``/``__setstate__``/``__del__``
+    never count as unlocked writes (the object is not shared yet/anymore);
+  - C002 resolves ``self.m()`` within the class; for ``other.m()`` the
+    callee is matched by method name only when exactly one lock-holding
+    class defines ``m`` (ambiguous names are skipped, not guessed).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .astutil import (assigned_attrs, dotted_name, self_attr,
+                      walk_skipping_nested_functions)
+from .framework import AnalysisPass, Finding, SourceFile
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_BIRTH_METHODS = {"__init__", "__post_init__", "__setstate__", "__del__",
+                  "__new__"}
+# receivers whose .get() looks like a queue, not a dict
+_QUEUEISH = ("queue", "_q")
+
+
+def _is_lock_factory(value: ast.expr) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    name = dotted_name(value.func)
+    if name is None:
+        return False
+    return name.rsplit(".", 1)[-1] in _LOCK_FACTORIES
+
+
+@dataclasses.dataclass
+class _Write:
+    attr: str
+    method: str
+    line: int
+    locked_by: Optional[str]  # lock attr held at the write site, else None
+
+
+@dataclasses.dataclass
+class _RegionCall:
+    lock: str          # lock attr held at the call site
+    receiver: str      # "self" | "other"
+    callee: str        # method/function name
+    line: int
+
+
+class _ClassModel:
+    """Lock facts for one class: lock attrs, attribute writes with their
+    lock context, and calls made while holding each lock."""
+
+    def __init__(self, rel: str, node: ast.ClassDef):
+        self.rel = rel
+        self.name = node.name
+        self.lock_attrs: Set[str] = set()
+        self.writes: List[_Write] = []
+        self.region_calls: List[_RegionCall] = []
+        self.method_locks: Dict[str, Set[str]] = {}
+        methods = [s for s in node.body
+                   if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in methods:
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign) and _is_lock_factory(n.value):
+                    for t in n.targets:
+                        attr = self_attr(t)
+                        if attr is not None:
+                            self.lock_attrs.add(attr)
+        for fn in methods:
+            self._scan_method(fn)
+
+    def _with_locks(self, node) -> List[str]:
+        locks = []
+        for item in node.items:
+            attr = self_attr(item.context_expr)
+            if attr in self.lock_attrs:
+                locks.append(attr)
+        return locks
+
+    def _scan_method(self, fn) -> None:
+        method = fn.name
+        acquired = self.method_locks.setdefault(method, set())
+
+        def visit(node: ast.AST, held: Optional[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # nested def: runs later, in a different context
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                locks = self._with_locks(node)
+                acquired.update(locks)
+                for item in node.items:  # headers evaluate pre-acquisition
+                    visit(item.context_expr, held)
+                inner = locks[0] if locks else held
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for attr, line in assigned_attrs(node):
+                    if attr not in self.lock_attrs:
+                        self.writes.append(_Write(attr, method, line, held))
+            if isinstance(node, ast.Call) and held is not None:
+                self._record_call(node, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, None)
+
+    def _record_call(self, node: ast.Call, held: str) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            is_self = (isinstance(func.value, ast.Name)
+                       and func.value.id == "self")
+            self.region_calls.append(_RegionCall(
+                held, "self" if is_self else "other", func.attr,
+                node.lineno))
+        elif isinstance(func, ast.Name):
+            self.region_calls.append(
+                _RegionCall(held, "other", func.id, node.lineno))
+
+
+def _unlocked_write_findings(model: _ClassModel) -> List[Finding]:
+    locked: Dict[str, Set[str]] = {}
+    for w in model.writes:
+        if w.locked_by is not None:
+            locked.setdefault(w.attr, set()).add(w.locked_by)
+    out = []
+    for w in model.writes:
+        if w.locked_by is not None or w.method in _BIRTH_METHODS:
+            continue
+        if w.attr in locked:
+            lock = sorted(locked[w.attr])[0]
+            out.append(Finding(
+                model.rel, w.line, "C001",
+                f"'{model.name}.{w.attr}' written in {w.method}() without "
+                f"'self.{lock}', but written under that lock elsewhere in "
+                f"the class — data-race candidate"))
+    return out
+
+
+_BLOCKING_ROOTS = ("time.sleep", "socket.", "subprocess.", "urllib.",
+                   "requests.")
+_BLOCKING_BUILTINS = {"open", "input", "sleep"}
+
+
+def _async_findings(sf: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    if sf.tree is None:
+        return out
+    awaited = {id(n.value) for n in ast.walk(sf.tree)
+               if isinstance(n, ast.Await)}
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in walk_skipping_nested_functions(fn.body):
+            if not isinstance(node, ast.Call) or id(node) in awaited:
+                continue
+            msg = _blocking_call_reason(node)
+            if msg:
+                out.append(Finding(
+                    sf.rel, node.lineno, "C003",
+                    f"{msg} inside 'async def {fn.name}' blocks the event "
+                    f"loop — await an async equivalent or move it to an "
+                    f"executor"))
+    return out
+
+
+def _blocking_call_reason(node: ast.Call) -> Optional[str]:
+    func = node.func
+    name = dotted_name(func)
+    if name is not None and "." not in name:
+        if name in _BLOCKING_BUILTINS:
+            return f"blocking call '{name}()'"
+    if name is not None:
+        for root in _BLOCKING_ROOTS:
+            if name == root or name.startswith(root):
+                return f"blocking call '{name}'"
+    if isinstance(func, ast.Attribute):
+        attr = func.attr
+        recv = dotted_name(func.value) or ""
+        recv_last = recv.rsplit(".", 1)[-1].lower()
+        if attr == "result":
+            return f"blocking Future '.result()' on '{recv or '<expr>'}'"
+        if attr == "acquire" and "lock" in recv_last:
+            return f"bare '{recv}.acquire()'"
+        if (attr == "get" and not node.args
+                and not any(kw.arg == "timeout" for kw in node.keywords)
+                and (recv_last == "q"
+                     or any(h in recv_last for h in _QUEUEISH))):
+            return f"'{recv}.get()' without timeout"
+    return None
+
+
+class ConcurrencyPass(AnalysisPass):
+    pass_ids = ("C001", "C002", "C003")
+    name = "concurrency"
+    description = ("per-class lock model (unlocked writes), cross-class "
+                   "lock-order cycles, blocking calls in async bodies")
+
+    def __init__(self):
+        self._models: List[_ClassModel] = []
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("mmlspark_tpu/") and \
+            not rel.startswith("mmlspark_tpu/testing/")
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        if sf.tree is None:
+            return findings
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                model = _ClassModel(sf.rel, node)
+                if model.lock_attrs:
+                    self._models.append(model)
+                    findings.extend(_unlocked_write_findings(model))
+        findings.extend(_async_findings(sf))
+        return findings
+
+    def finish(self) -> Iterable[Finding]:
+        return _lock_order_findings(self._models)
+
+
+# builtin-container method names: `self._d.clear()` is a dict call, not a
+# call into another lock-holding class that happens to define clear() —
+# never resolve these across classes
+_GENERIC_METHODS = {"clear", "get", "put", "pop", "update", "append", "add",
+                    "remove", "extend", "discard", "copy", "insert",
+                    "setdefault", "keys", "values", "items", "count",
+                    "index", "sort", "reverse", "join", "popleft"}
+
+
+def _lock_order_findings(models: List[_ClassModel]) -> List[Finding]:
+    """Build the cross-class lock graph, report one finding per cycle."""
+    by_method: Dict[str, List[_ClassModel]] = {}
+    for m in models:
+        for meth, locks in m.method_locks.items():
+            if locks:
+                by_method.setdefault(meth, []).append(m)
+    # edges: (class, lock) -> {(class, lock): (rel, line, callee)}
+    edges: Dict[Tuple[str, str],
+                Dict[Tuple[str, str], Tuple[str, int, str]]] = {}
+    for m in models:
+        for call in m.region_calls:
+            if call.receiver == "self":
+                callees = [m] if m.method_locks.get(call.callee) else []
+            elif call.callee in _GENERIC_METHODS:
+                callees = []  # almost certainly a dict/list/set/queue call
+            else:
+                cands = [c for c in by_method.get(call.callee, [])
+                         if c is not m]
+                callees = cands if len(cands) == 1 else []
+            src = (m.name, call.lock)
+            for callee_model in callees:
+                for lock in callee_model.method_locks.get(call.callee, ()):
+                    dst = (callee_model.name, lock)
+                    if dst == src:
+                        continue  # re-entrant same-lock: RLock territory
+                    edges.setdefault(src, {}).setdefault(
+                        dst, (m.rel, call.line, call.callee))
+    return _find_cycles(edges)
+
+
+def _find_cycles(edges) -> List[Finding]:
+    findings: List[Finding] = []
+    seen_cycles: Set[Tuple] = set()
+    for start in sorted(edges):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, {})):
+                if nxt == start:
+                    cyc = tuple(sorted(path))
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    rel, line, callee = edges[node][start]
+                    chain = " -> ".join(
+                        f"{c}.{lk}" for c, lk in path + [start])
+                    findings.append(Finding(
+                        rel, line, "C002",
+                        f"lock-order inversion: {chain} (via call to "
+                        f"'{callee}()' while holding "
+                        f"'{node[0]}.{node[1]}') — deadlock candidate"))
+                elif nxt not in path and len(path) < 6:
+                    stack.append((nxt, path + [nxt]))
+    return findings

@@ -1,0 +1,268 @@
+"""Analysis driver: one tree walk, one parse per file, N passes.
+
+The framework owns everything pass-independent: file discovery, parsing,
+the suppression mechanism, and finding aggregation. Passes see a parsed
+``SourceFile`` and yield ``Finding``s; cross-file passes (the lock-order
+graph) accumulate state per file and emit in ``finish()``.
+
+Suppression contract (every suppression carries a justification):
+
+  - inline:  ``# analysis: allow C001 -- <one-line justification>``
+    on the finding's line, or alone on the line directly above it.
+    Multiple ids: ``allow C001, J001 -- ...``.
+  - file-scope: a line in ``tools/ci/analysis_suppressions.txt``:
+    ``<repo-relative-path>: <PASS-ID>: <justification>``.
+
+A suppression with an empty justification does not suppress — it becomes a
+``SUP1`` finding itself, so CI rejects undocumented silencing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# same scope as the historical style gate (tools/ci/stylecheck.py)
+CHECKED_DIRS = ("mmlspark_tpu", "tests", "tools", "examples")
+SUPPRESSION_FILE = Path("tools") / "ci" / "analysis_suppressions.txt"
+
+_INLINE_RE = re.compile(
+    r"#\s*analysis:\s*allow\s+"
+    r"(?P<ids>[A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)"
+    r"(?:\s*--\s*(?P<why>.*?))?\s*$")
+_FILE_RULE_RE = re.compile(
+    r"^(?P<path>[^:#][^:]*?)\s*:\s*(?P<id>[A-Z]+\d+)\s*:\s*(?P<why>.*)$")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer result: ``path:line: pass_id message``."""
+
+    path: str          # repo-relative, posix separators
+    line: int
+    pass_id: str
+    message: str
+    suppressed: bool = False
+    justification: Optional[str] = None
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.pass_id} {self.message}"
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class _InlineRule:
+    ids: Tuple[str, ...]
+    justification: str  # "" = missing (invalid)
+    comment_only: bool  # alone on its line -> applies to the next line
+
+
+class SourceFile:
+    """One parsed file: text, line list, AST (None on syntax error), and
+    the inline suppression rules found in its comments."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel
+        self.text = text
+        self.lines = text.split("\n")
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as e:  # surfaced as an E001 finding by the driver
+            self.parse_error = f"line {e.lineno}: {e.msg}"
+        self.inline_rules: Dict[int, _InlineRule] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _INLINE_RE.search(line)
+            if not m:
+                continue
+            ids = tuple(s.strip() for s in m.group("ids").split(","))
+            why = (m.group("why") or "").strip()
+            comment_only = line.strip().startswith("#")
+            self.inline_rules[i] = _InlineRule(ids, why, comment_only)
+
+    def suppression_for(self, finding: Finding) -> Optional[_InlineRule]:
+        """Inline rule covering ``finding``, if any (same line, or a
+        comment-only rule on the line above)."""
+        rule = self.inline_rules.get(finding.line)
+        if rule and finding.pass_id in rule.ids:
+            return rule
+        above = self.inline_rules.get(finding.line - 1)
+        if above and above.comment_only and finding.pass_id in above.ids:
+            return above
+        return None
+
+
+class AnalysisPass:
+    """Base pass: subclasses set ``pass_ids``/``name`` and implement
+    ``check``; cross-file passes also implement ``finish``."""
+
+    pass_ids: Tuple[str, ...] = ()
+    name: str = ""
+    description: str = ""
+
+    def applies_to(self, rel: str) -> bool:
+        return True
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        return ()
+
+    def finish(self) -> Iterable[Finding]:
+        """Called once after every file was checked (cross-file findings)."""
+        return ()
+
+
+def default_passes() -> List[AnalysisPass]:
+    # local import: passes import framework types, avoid the cycle
+    from . import concurrency, hygiene, jaxcompat, purity, style
+
+    return [
+        style.StylePass(),
+        concurrency.ConcurrencyPass(),
+        jaxcompat.JaxCompatPass(),
+        purity.DevicePurityPass(),
+        hygiene.HygienePass(),
+    ]
+
+
+def iter_repo_files(root: Path,
+                    paths: Optional[Sequence[Path]] = None) -> List[Path]:
+    """The analyzed file set: ``*.py`` under CHECKED_DIRS (or under the
+    explicit ``paths``), __pycache__ excluded, sorted for determinism."""
+    files: List[Path] = []
+    if paths:
+        for p in paths:
+            p = p if p.is_absolute() else root / p
+            if p.is_dir():
+                files.extend(sorted(p.rglob("*.py")))
+            elif p.suffix == ".py":
+                files.append(p)
+    else:
+        for d in CHECKED_DIRS:
+            base = root / d
+            if base.is_dir():
+                files.extend(sorted(base.rglob("*.py")))
+    return [f for f in files if "__pycache__" not in f.parts]
+
+
+def _load_file_rules(root: Path) -> Tuple[Dict[Tuple[str, str], str],
+                                          List[Finding]]:
+    """Parse the file-scope suppression list. Returns
+    ({(rel_path, pass_id): justification}, findings-for-bad-rules)."""
+    rules: Dict[Tuple[str, str], str] = {}
+    findings: List[Finding] = []
+    sup_path = root / SUPPRESSION_FILE
+    if not sup_path.is_file():
+        return rules, findings
+    rel_sup = SUPPRESSION_FILE.as_posix()
+    for i, line in enumerate(sup_path.read_text().split("\n"), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _FILE_RULE_RE.match(line)
+        if not m or not m.group("why").strip():
+            findings.append(Finding(
+                rel_sup, i, "SUP1",
+                "suppression rule needs '<path>: <PASS-ID>: <justification>'"
+                f" (got: {line!r})"))
+            continue
+        rules[(m.group("path").strip(), m.group("id"))] = \
+            m.group("why").strip()
+    return rules, findings
+
+
+def _apply_suppressions(findings: List[Finding],
+                        sources: Dict[str, SourceFile],
+                        file_rules: Dict[Tuple[str, str], str]) -> List[Finding]:
+    out: List[Finding] = []
+    for f in findings:
+        if f.pass_id == "SUP1":     # a bad suppression can't suppress itself
+            out.append(f)
+            continue
+        why = file_rules.get((f.path, f.pass_id))
+        if why is not None:
+            f.suppressed, f.justification = True, why
+            out.append(f)
+            continue
+        sf = sources.get(f.path)
+        rule = sf.suppression_for(f) if sf else None
+        if rule is not None:
+            if rule.justification:
+                f.suppressed, f.justification = True, rule.justification
+            # else: the SUP1 emitted for that rule keeps CI red
+        out.append(f)
+    return out
+
+
+def _check_inline_rules(sf: SourceFile) -> List[Finding]:
+    return [
+        Finding(sf.rel, line, "SUP1",
+                "suppression missing justification "
+                "(use '# analysis: allow <ID> -- <why>')")
+        for line, rule in sf.inline_rules.items()
+        if not rule.justification
+    ]
+
+
+def run_analysis(root: Path,
+                 paths: Optional[Sequence[Path]] = None,
+                 passes: Optional[Sequence[AnalysisPass]] = None,
+                 ) -> Tuple[List[Finding], int]:
+    """Walk the tree once, dispatch every pass, apply suppressions.
+
+    Returns (findings, n_files); ``findings`` includes suppressed ones
+    (marked) so ``--json`` consumers can diff the full picture.
+    """
+    root = Path(root)
+    passes = list(passes) if passes is not None else default_passes()
+    file_rules, findings = _load_file_rules(root)
+    sources: Dict[str, SourceFile] = {}
+    n_files = 0
+    for path in iter_repo_files(root, paths):
+        n_files += 1
+        rel = path.relative_to(root).as_posix()
+        try:
+            text = path.read_text(encoding="utf-8")
+        except UnicodeDecodeError:
+            findings.append(Finding(rel, 1, "E001", "not valid utf-8"))
+            continue
+        sf = SourceFile(rel, text)
+        sources[rel] = sf
+        findings.extend(_check_inline_rules(sf))
+        if sf.parse_error is not None and rel.startswith("mmlspark_tpu/"):
+            findings.append(Finding(
+                rel, 1, "E001", f"syntax error: {sf.parse_error}"))
+        for p in passes:
+            if p.applies_to(rel):
+                findings.extend(p.check(sf))
+    for p in passes:
+        findings.extend(p.finish())
+    findings = _apply_suppressions(findings, sources, file_rules)
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    return findings, n_files
+
+
+def analyze_source(text: str, rel: str = "mmlspark_tpu/_snippet.py",
+                   passes: Optional[Sequence[AnalysisPass]] = None,
+                   ) -> List[Finding]:
+    """Analyze one in-memory snippet (fixture tests). ``rel`` picks which
+    passes apply (their ``applies_to`` sees it as the repo-relative path)."""
+    passes = list(passes) if passes is not None else default_passes()
+    sf = SourceFile(rel, text)
+    findings = _check_inline_rules(sf)
+    if sf.parse_error is not None:
+        findings.append(Finding(rel, 1, "E001",
+                                f"syntax error: {sf.parse_error}"))
+    for p in passes:
+        if p.applies_to(rel):
+            findings.extend(p.check(sf))
+    for p in passes:
+        findings.extend(p.finish())
+    findings = _apply_suppressions(findings, {rel: sf}, {})
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    return findings

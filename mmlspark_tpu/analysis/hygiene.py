@@ -1,0 +1,72 @@
+"""H0xx — API hygiene: bare asserts in runtime code, metric-name rules.
+
+  H001  ``assert`` in library runtime code. Asserts are stripped under
+        ``python -O``, so validation written as an assert silently stops
+        validating in optimized deployments — the PR-1/PR-3 audits converted
+        these by hand; this pass keeps them converted. ``mmlspark_tpu/
+        testing/`` is exempt by rule (test-support code, not runtime).
+
+  H002  metric names registered on the MetricsRegistry (``.counter()`` /
+        ``.gauge()`` / ``.histogram()`` with a literal name) must follow
+        docs/observability.md: prefix ``mmlspark_``, lowercase
+        ``[a-z0-9_]``, and monotonic counters end ``_total``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from .framework import AnalysisPass, Finding, SourceFile
+
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+_METRIC_NAME = re.compile(r"^mmlspark_[a-z0-9_]*[a-z0-9]$")
+
+
+class HygienePass(AnalysisPass):
+    pass_ids = ("H001", "H002")
+    name = "api-hygiene"
+    description = ("bare assert in runtime library code; mmlspark_* metric "
+                   "name conformance (docs/observability.md)")
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("mmlspark_tpu/") and \
+            not rel.startswith("mmlspark_tpu/testing/")
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        if sf.tree is None:
+            return findings
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assert):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "H001",
+                    "bare assert in runtime code (stripped under "
+                    "'python -O') — raise ValueError/RuntimeError instead"))
+            elif isinstance(node, ast.Call):
+                findings.extend(self._metric_findings(sf, node))
+        return findings
+
+    def _metric_findings(self, sf: SourceFile,
+                         node: ast.Call) -> List[Finding]:
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _METRIC_METHODS):
+            return []
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            return []
+        name = node.args[0].value
+        out: List[Finding] = []
+        if not _METRIC_NAME.match(name):
+            out.append(Finding(
+                sf.rel, node.lineno, "H002",
+                f"metric name '{name}' must match 'mmlspark_[a-z0-9_]+' "
+                f"(docs/observability.md naming conventions)"))
+        elif func.attr == "counter" and not name.endswith("_total"):
+            out.append(Finding(
+                sf.rel, node.lineno, "H002",
+                f"counter '{name}' must end '_total' (monotonic-count "
+                f"convention, docs/observability.md)"))
+        return out

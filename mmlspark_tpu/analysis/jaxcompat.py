@@ -1,0 +1,77 @@
+"""J001 — version-gated jax APIs must route through the compat shim.
+
+This container runs jax 0.4.37: ``jax.shard_map``, ``jax.sharding.AxisType``
+and ``jax.lax.pcast``/``pvary`` do not exist, and ``jax.experimental.
+shard_map`` moved in later versions. ``parallel/mesh.py`` is the one place
+allowed to touch these names (``shard_map_compat``, the getattr-gated
+AxisType handling); everywhere else a direct reference is a latent
+ImportError/AttributeError on exactly the hardware we target.
+
+What counts as a direct reference (AST-level, so comments/docstrings and
+``getattr(obj, "name", default)``/``hasattr(obj, "name")`` probes — which
+are themselves gates — never trigger):
+
+  - an attribute access ``X.shard_map`` / ``jax.lax.pcast`` / ...
+  - ``from jax.experimental.shard_map import shard_map`` (or importing any
+    gated name from a jax module)
+  - ``import jax.experimental.shard_map``
+
+A reference that is itself behind a ``hasattr`` check is still flagged —
+suppress it with a justification saying so (the suppression documents the
+gate for the next reader).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from .framework import AnalysisPass, Finding, SourceFile
+
+GATED_NAMES = ("shard_map", "AxisType", "pcast", "pvary")
+SHIM_MODULE = "mmlspark_tpu/parallel/mesh.py"
+_HINT = "route through parallel/mesh.py compat helpers (jax 0.4.37)"
+
+
+class JaxCompatPass(AnalysisPass):
+    pass_ids = ("J001",)
+    name = "jax-compat"
+    description = ("direct references to version-gated jax APIs "
+                   f"({', '.join(GATED_NAMES)}) outside the compat shim")
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("mmlspark_tpu/") and rel != SHIM_MODULE
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        if sf.tree is None:
+            return findings
+        seen: Set[Tuple[int, str]] = set()
+
+        def add(line: int, what: str, detail: str) -> None:
+            if (line, what) in seen:
+                return
+            seen.add((line, what))
+            findings.append(Finding(
+                sf.rel, line, "J001",
+                f"direct reference to version-gated jax API {detail} — "
+                f"{_HINT}"))
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Attribute) and node.attr in GATED_NAMES:
+                add(node.lineno, node.attr, f"'.{node.attr}'")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if "shard_map" in mod:
+                    add(node.lineno, mod, f"module '{mod}'")
+                elif mod == "jax" or mod.startswith("jax."):
+                    for alias in node.names:
+                        if alias.name in GATED_NAMES:
+                            add(node.lineno, alias.name,
+                                f"'{mod}.{alias.name}'")
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if "shard_map" in alias.name:
+                        add(node.lineno, alias.name,
+                            f"module '{alias.name}'")
+        return findings

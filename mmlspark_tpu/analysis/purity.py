@@ -1,0 +1,147 @@
+"""D001 — device purity: jitted bodies must not call host-only APIs.
+
+Functions that become XLA programs — passed to ``jax.jit``, decorated with
+``@jax.jit`` / ``@functools.partial(jax.jit, ...)``, or registered as the
+``fn`` of a ``DeviceFn`` — execute as traced computations. A host-only call
+inside one either breaks tracing or (worse) silently runs at trace time and
+bakes a constant into the compiled program; ``.item()``-style reads force a
+device sync inside what profiling assumes is a fused segment.
+
+Flagged inside a jittable body:
+
+  - ``time.*`` / stdlib ``random.*`` / ``np.random.*`` calls
+  - I/O: ``open()``, ``print()``, ``input()``, ``os.*``
+  - tracer escapes: ``.item()``, ``.tolist()``
+  - in-place mutation of a parameter: ``arg[...] = ...`` (jax arrays are
+    immutable; on a traced numpy input this mutates the host buffer)
+
+The pass resolves jittable functions **within one module**: the argument of
+a jit/DeviceFn call site must be a plain name bound by a ``def`` in the same
+file (the repo's universal idiom — closures jitted right where they are
+defined). ``prepare``/``finalize`` of DeviceFn are host shims and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .astutil import call_keyword, dotted_name
+from .framework import AnalysisPass, Finding, SourceFile
+
+_HOST_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.", "os.")
+_HOST_BUILTINS = {"open", "print", "input"}
+_TRACER_ESCAPES = {"item", "tolist"}
+# DeviceFn(key, in_cols, out_cols, fn, ...) — fn is the 4th positional
+_DEVICEFN_FN_POS = 3
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """True for ``jax.jit`` / ``jit`` and ``[functools.]partial(jax.jit,...)``."""
+    name = dotted_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def _jitted_names(tree: ast.AST) -> Dict[str, int]:
+    """{function name: reporting line} for every module-local name that is
+    jitted or registered as a DeviceFn body."""
+    jitted: Dict[str, int] = {}
+
+    def mark(arg: ast.expr) -> None:
+        if isinstance(arg, ast.Name):
+            jitted.setdefault(arg.id, arg.lineno)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            if _is_jit_expr(node.func) and node.args:
+                mark(node.args[0])
+            callee = dotted_name(node.func) or ""
+            if callee.rsplit(".", 1)[-1] == "DeviceFn":
+                kw = call_keyword(node, "fn")
+                if kw is not None:
+                    mark(kw)
+                elif len(node.args) > _DEVICEFN_FN_POS:
+                    mark(node.args[_DEVICEFN_FN_POS])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    jitted.setdefault(node.name, node.lineno)
+    return jitted
+
+
+def _host_call_reason(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    if name is not None:
+        if name in _HOST_BUILTINS:
+            return f"host I/O call '{name}()'"
+        for p in _HOST_PREFIXES:
+            if name.startswith(p):
+                return f"host-only call '{name}'"
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _TRACER_ESCAPES:
+        return (f"'.{node.func.attr}()' forces a host sync on a tracer")
+    return None
+
+
+class DevicePurityPass(AnalysisPass):
+    pass_ids = ("D001",)
+    name = "device-purity"
+    description = ("host-only APIs (time/random/IO/.item()) inside "
+                   "functions that are jitted or registered as DeviceFn "
+                   "bodies")
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("mmlspark_tpu/") and \
+            not rel.startswith("mmlspark_tpu/testing/")
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        if sf.tree is None:
+            return findings
+        jitted = _jitted_names(sf.tree)
+        if not jitted:
+            return findings
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in jitted:
+                continue
+            params: Set[str] = {a.arg for a in (
+                node.args.posonlyargs + node.args.args
+                + node.args.kwonlyargs)}
+            # a name rebound inside the body (`devd = dict(devd)`) is a
+            # local copy — mutating it is not mutating the traced input
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Assign):
+                    for t in inner.targets:
+                        for n2 in ast.walk(t):
+                            if isinstance(n2, ast.Name) \
+                                    and isinstance(n2.ctx, ast.Store):
+                                params.discard(n2.id)
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call):
+                    reason = _host_call_reason(inner)
+                    if reason:
+                        findings.append(Finding(
+                            sf.rel, inner.lineno, "D001",
+                            f"{reason} inside jittable '{node.name}' — "
+                            f"device functions must be trace-pure"))
+                elif isinstance(inner, (ast.Assign, ast.AugAssign)):
+                    targets = inner.targets if isinstance(
+                        inner, ast.Assign) else [inner.target]
+                    for t in targets:
+                        if (isinstance(t, ast.Subscript)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id in params):
+                            findings.append(Finding(
+                                sf.rel, t.lineno, "D001",
+                                f"in-place mutation of parameter "
+                                f"'{t.value.id}' inside jittable "
+                                f"'{node.name}' — use .at[].set()"))
+        return findings

@@ -1,0 +1,61 @@
+"""S0xx — the committed style rule set (scalastyle-config.xml equivalent).
+
+Folded in from ``tools/ci/stylecheck.py`` so one driver runs every gate;
+``tools/ci/stylecheck.py`` remains as a thin compatibility shim over this
+pass (same rules, same message text, same exit codes).
+
+  S001 line too long | S002 tab | S003 trailing whitespace
+  S004 merge-conflict marker | S005 mutable default argument
+  S006 star import in library code | S007 missing trailing newline
+  S008 multiple trailing newlines
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List
+
+from .framework import AnalysisPass, Finding, SourceFile
+
+MAX_LINE = 100
+_MUTABLE_DEFAULT = re.compile(r"def \w+\([^)]*=\s*(\[\]|\{\}|set\(\))")
+_CONFLICT = re.compile(r"^(<{7}|>{7}|={7})( |$)")
+
+
+def style_findings(sf: SourceFile) -> List[Finding]:
+    """The rule set, line-for-line the historical stylecheck semantics."""
+    out: List[Finding] = []
+
+    def add(line: int, pass_id: str, msg: str) -> None:
+        out.append(Finding(sf.rel, line, pass_id, msg))
+
+    for i, line in enumerate(sf.lines, 1):
+        if len(line) > MAX_LINE:
+            add(i, "S001", f"line too long ({len(line)} > {MAX_LINE})")
+        if "\t" in line:
+            add(i, "S002", "tab character")
+        if line != line.rstrip():
+            add(i, "S003", "trailing whitespace")
+        if _CONFLICT.match(line):
+            add(i, "S004", "merge conflict marker")
+        if _MUTABLE_DEFAULT.search(line):
+            add(i, "S005", "mutable default argument")
+        if ("import *" in line and line.strip().startswith("from")
+                and "mmlspark_tpu" in sf.rel):
+            add(i, "S006", "star import in library code")
+    if sf.text and not sf.text.endswith("\n"):
+        add(len(sf.lines), "S007", "missing trailing newline")
+    if sf.text.endswith("\n\n"):
+        add(len(sf.lines), "S008", "multiple trailing newlines")
+    return out
+
+
+class StylePass(AnalysisPass):
+    pass_ids = ("S001", "S002", "S003", "S004", "S005", "S006", "S007",
+                "S008")
+    name = "style"
+    description = ("committed style rules: line length, whitespace, conflict "
+                   "markers, mutable defaults, star imports, final newline")
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        return style_findings(sf)

@@ -38,6 +38,11 @@ _EXTRA_INDEX = [
     "[docs/serving.md](../serving.md)): `encode_frame`, `decode_frame`, "
     "`frame_info`, `FRAME_CONTENT_TYPE` — the zero-copy binary columnar "
     "request format",
+    "- static analysis (`mmlspark_tpu.analysis`, hand-maintained guide in "
+    "[docs/static_analysis.md](../static_analysis.md)): `run_analysis`, "
+    "`analyze_source`, `AnalysisPass`, `Finding` — the AST lint framework "
+    "behind `tools/analyze.py` (concurrency-lint, jax-compat-gate, "
+    "device-purity, API-hygiene, style)",
 ]
 
 

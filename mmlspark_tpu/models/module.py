@@ -75,12 +75,16 @@ class matmul_precision(_ContextVarScope):
     _var = _MATMUL_DTYPE
 
     def __init__(self, dtype: str):
-        assert dtype in ("bfloat16", "float32", "float64"), dtype
+        if dtype not in ("bfloat16", "float32", "float64"):
+            raise ValueError(
+                f"matmul_precision: unknown dtype {dtype!r} "
+                f"(expected bfloat16/float32/float64)")
         if dtype == "float64":
             import jax
-            assert jax.config.jax_enable_x64, \
-                "matmul_precision('float64') requires jax_enable_x64 " \
-                "(otherwise astype(float64) silently yields float32)"
+            if not jax.config.jax_enable_x64:
+                raise RuntimeError(
+                    "matmul_precision('float64') requires jax_enable_x64 "
+                    "(otherwise astype(float64) silently yields float32)")
         super().__init__(dtype)
 
 
@@ -551,8 +555,9 @@ class FunctionModel:
         if tap is None:
             return self.module.apply(self.params, x, train=train)
         taps_out: Dict[str, Any] = {}
-        assert getattr(self.module, "is_container", False), \
-            "taps need a container root (Sequential/GraphModule)"
+        if not getattr(self.module, "is_container", False):
+            raise ValueError(
+                "taps need a container root (Sequential/GraphModule)")
         self.module.apply(self.params, x, train=train, taps={tap}, taps_out=taps_out)
         if tap not in taps_out:
             raise KeyError(f"Tap {tap!r} not produced; known {self.module.layer_paths()[:20]}")
@@ -566,8 +571,9 @@ class FunctionModel:
         real = {t for t in taps if t is not None}
         taps_out: Dict[str, Any] = {}
         if real:
-            assert getattr(self.module, "is_container", False), \
-                "taps need a container root (Sequential/GraphModule)"
+            if not getattr(self.module, "is_container", False):
+                raise ValueError(
+                    "taps need a container root (Sequential/GraphModule)")
             out = self.module.apply(self.params, x, train=train, taps=real,
                                     taps_out=taps_out)
         else:

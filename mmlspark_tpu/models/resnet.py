@@ -130,7 +130,10 @@ def resnet(depth: int = 50, num_classes: int = 1000, image_size: int = 224,
     module = build_resnet(depth, num_classes, image_size, channels, width)
     rng = jax.random.PRNGKey(seed)
     params, out_shape = module.init(rng, (image_size, image_size, channels))
-    assert out_shape == (num_classes,), out_shape
+    if out_shape != (num_classes,):
+        raise RuntimeError(
+            f"resnet head produced shape {out_shape}, expected "
+            f"({num_classes},) — build_resnet/init disagree")
     layer_names = ["fc", "avgpool", "layer4", "layer3", "layer2", "layer1", "stem"]
     return FunctionModel(module=module, params=params,
                          input_shape=(image_size, image_size, channels),

@@ -68,8 +68,10 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params,
 
     # jax < 0.5 has neither pcast nor pvary (and no vma typing to satisfy)
     if hasattr(jax.lax, "pcast"):
+        # analysis: allow J001 -- hasattr-guarded on the line above: this IS the gate
         microbatches = jax.lax.pcast(microbatches, (axis_name,), to="varying")
     elif hasattr(jax.lax, "pvary"):
+        # analysis: allow J001 -- hasattr-guarded on the line above: this IS the gate
         microbatches = jax.lax.pvary(microbatches, (axis_name,))
     # derived arrays inherit the varying type from microbatches
     state = jnp.zeros_like(microbatches[0])

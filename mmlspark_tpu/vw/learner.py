@@ -308,9 +308,9 @@ def train_linear(config: LearnerConfig, dataset: SparseDataset,
     if n_shards > 1:
         from jax.sharding import PartitionSpec as P
 
-        shard_map = getattr(jax, "shard_map", None)
-        if shard_map is None:  # jax < 0.6 ships it under experimental
-            from jax.experimental.shard_map import shard_map
+        # version-gated API (moved modules, renamed kwargs): route through
+        # the compat shim instead of resolving jax.shard_map here
+        from ..parallel.mesh import shard_map_compat as shard_map
 
         pad = (-n) % n_shards
 

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Project static-analysis driver (style + semantic passes, one walk).
+
+  python tools/analyze.py                 # whole repo, human output
+  python tools/analyze.py mmlspark_tpu/serving
+  python tools/analyze.py --json          # machine-readable (CI diffing)
+  python tools/analyze.py --select C001,J001
+  python tools/analyze.py --list-passes
+
+Exit code 0 iff there are zero unsuppressed findings. Suppressed findings
+are listed only with --show-suppressed / --json. Pass catalog and
+suppression syntax: docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+from mmlspark_tpu import analysis  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: repo)")
+    ap.add_argument("--root", default=str(ROOT))
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit machine-readable findings")
+    ap.add_argument("--select", default="",
+                    help="comma-separated pass ids to keep (e.g. C001,J001)")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    passes = analysis.default_passes()
+    if args.list_passes:
+        for p in passes:
+            print(f"{'/'.join(p.pass_ids):28s} {p.name}: {p.description}")
+        return 0
+
+    root = Path(args.root)
+    paths = [Path(p) for p in args.paths] or None
+    findings, n_files = analysis.run_analysis(root, paths=paths,
+                                              passes=passes)
+    if args.select:
+        keep = {s.strip() for s in args.select.split(",") if s.strip()}
+        findings = [f for f in findings if f.pass_id in keep]
+    open_findings = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.as_json:
+        print(json.dumps({
+            "files": n_files,
+            "unsuppressed": len(open_findings),
+            "suppressed": len(suppressed),
+            "findings": [f.to_dict() for f in findings],
+        }, indent=2, ensure_ascii=False))
+        return 1 if open_findings else 0
+
+    for f in open_findings:
+        print(f.render())
+    if args.show_suppressed:
+        for f in suppressed:
+            print(f"[suppressed: {f.justification}] {f.render()}")
+    print(f"analyze: {n_files} files, {len(open_findings)} finding(s), "
+          f"{len(suppressed)} suppressed")
+    return 1 if open_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

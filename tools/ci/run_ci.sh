@@ -3,8 +3,9 @@
 # with one command on a dev checkout (reference analogue: the sbt tasks the
 # pipeline calls, runnable locally).
 #
-#   tools/ci/run_ci.sh            # style + full matrix + flaky lane + smoke
-#   tools/ci/run_ci.sh style      # style gate only
+#   tools/ci/run_ci.sh            # analysis + full matrix + flaky lane + smoke
+#   tools/ci/run_ci.sh analysis   # static-analysis gate only (style + semantic)
+#   tools/ci/run_ci.sh style      # alias for analysis (historical name)
 #   tools/ci/run_ci.sh tests      # per-package matrix only
 #   tools/ci/run_ci.sh flaky      # retried serving suites only
 set -u
@@ -13,10 +14,14 @@ cd "$(dirname "$0")/../.."
 stage="${1:-all}"
 rc=0
 
-if [ "$stage" = "style" ] || [ "$stage" = "all" ]; then
-  echo "=== style gate ==="
-  python tools/ci/stylecheck.py || exit 1  # style gates everything (pipeline.yaml:30-42)
-  [ "$stage" = "style" ] && exit 0
+if [ "$stage" = "style" ] || [ "$stage" = "analysis" ] || [ "$stage" = "all" ]; then
+  echo "=== static-analysis gate (S/C/J/D/H passes; docs/static_analysis.md) ==="
+  # one driver: style rules + concurrency-lint + jax-compat-gate +
+  # device-purity + API-hygiene; fails on any unsuppressed finding
+  python tools/analyze.py || exit 1
+  if [ "$stage" = "style" ] || [ "$stage" = "analysis" ]; then
+    exit 0
+  fi
 fi
 
 # per-package matrix — keep in sync with ci.yml's `suite:` list
@@ -32,6 +37,7 @@ PACKAGES=(
   "tests/test_fuzzing.py"
   "tests/test_attention.py tests/test_parallel_pp_ep.py"
   "tests/test_codegen_cli.py tests/test_rgen.py tests/test_plot.py tests/test_datagen.py"
+  "tests/test_analysis.py"
   "tests/test_observability.py"
   "tests/test_perf_attribution.py"
   "tests/test_benchmarks_extended.py"

@@ -1,11 +1,11 @@
-"""In-repo style gate (scalastyle-config.xml equivalent, self-contained).
+"""In-repo style gate (scalastyle-config.xml equivalent) — compat shim.
 
-The reference enforces committed style rules in CI before anything else
-(pipeline.yaml:30-42). This image ships no ruff/flake8, so the gate is a
-dependency-free checker enforcing the rule set below; `.github/workflows/
-ci.yml` maps the same rules onto ruff for environments that have it
-(E501/W191/W291/W292/F401-adjacent). Runs as part of the suite
-(tests/test_style.py) so a style break fails `pytest` locally, not just CI.
+The rule set lives in ``mmlspark_tpu/analysis/style.py`` since the style
+gate was folded into the project static-analysis framework (one driver:
+``tools/analyze.py`` runs these rules as the S0xx pass alongside the
+semantic passes). This shim keeps the historical entry point, message
+format, and exit codes, so `python tools/ci/stylecheck.py` and
+tests/test_style.py behave exactly as before.
 
 Rules (committed, like scalastyle-config.xml):
   max-line-length 100 | no tabs | no trailing whitespace | file ends with
@@ -15,42 +15,25 @@ Rules (committed, like scalastyle-config.xml):
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-MAX_LINE = 100
-CHECKED_DIRS = ("mmlspark_tpu", "tests", "tools", "examples")
-_MUTABLE_DEFAULT = re.compile(r"def \w+\([^)]*=\s*(\[\]|\{\}|set\(\))")
-_CONFLICT = re.compile(r"^(<{7}|>{7}|={7})( |$)")
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from mmlspark_tpu.analysis.framework import (  # noqa: E402
+    CHECKED_DIRS, SourceFile)
+from mmlspark_tpu.analysis.style import MAX_LINE, style_findings  # noqa: E402,F401
 
 
 def check_file(path: Path) -> list:
-    errors = []
     try:
         text = path.read_text(encoding="utf-8")
     except UnicodeDecodeError:
         return [f"{path}:1: not valid utf-8"]
-    lines = text.split("\n")
-    for i, line in enumerate(lines, 1):
-        if len(line) > MAX_LINE:
-            errors.append(f"{path}:{i}: line too long ({len(line)} > {MAX_LINE})")
-        if "\t" in line:
-            errors.append(f"{path}:{i}: tab character")
-        if line != line.rstrip():
-            errors.append(f"{path}:{i}: trailing whitespace")
-        if _CONFLICT.match(line):
-            errors.append(f"{path}:{i}: merge conflict marker")
-        if _MUTABLE_DEFAULT.search(line):
-            errors.append(f"{path}:{i}: mutable default argument")
-        if ("import *" in line and line.strip().startswith("from")
-                and "mmlspark_tpu" in str(path)):
-            errors.append(f"{path}:{i}: star import in library code")
-    if text and not text.endswith("\n"):
-        errors.append(f"{path}:{len(lines)}: missing trailing newline")
-    if text.endswith("\n\n"):
-        errors.append(f"{path}:{len(lines)}: multiple trailing newlines")
-    return errors
+    sf = SourceFile(str(path), text)
+    return [f"{path}:{f.line}: {f.message}" for f in style_findings(sf)]
 
 
 def run(root: Path) -> list:
@@ -60,6 +43,8 @@ def run(root: Path) -> list:
         if not base.is_dir():
             continue
         for path in sorted(base.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
             errors.extend(check_file(path))
     return errors
 
