@@ -43,6 +43,13 @@ _EXTRA_INDEX = [
     "`analyze_source`, `AnalysisPass`, `Finding` — the AST lint framework "
     "behind `tools/analyze.py` (concurrency-lint, jax-compat-gate, "
     "device-purity, API-hygiene, style)",
+    "- auto-tuning (`mmlspark_tpu.core.costmodel` / `.core.tune`, "
+    "hand-maintained guide in [docs/autotune.md](../autotune.md)): "
+    "`SegmentCostModel` (analytical-then-learned per-(segment, bucket) "
+    "batch cost, `predict_ms` + calibration confidence), `Tuner` / "
+    "`KnobSet` (measure→refit→apply loop, journaled knob decisions, "
+    "one-step rollback) — the cost-model-driven replacement for the "
+    "static bucket / fuse-vs-demote / batching-window / inflight knobs",
 ]
 
 

@@ -1,0 +1,531 @@
+"""Per-segment cost model: analytical roofline first, measured refinement on top.
+
+BENCH_mfu_roofline.json bounds the image chain at ~16,000 images/s while
+BENCH_image_e2e.json measures ~65 end-to-end — and every knob governing that
+gap (shape buckets, fuse-vs-demote, coalesce window, inflight/replica
+sizing) is a hand-tuned constant. PR 7 built the measurement substrate
+(per-(segment, shape-bucket) XLA cost harvest in the CompileCache +
+IngestStats queue/h2d/compute/readback decomposition); this module is the
+model those measurements train, in the shape of "A Learned Performance
+Model for TPUs" (arXiv:2008.01040): start from an ANALYTICAL prediction
+(roofline over harvested flops/bytes and ``device_peaks()``, plus
+compile-time amortization for buckets that would need a fresh executable),
+then REFINE online from what the rings actually measured (per-stage EWMAs
+keyed by ``(segment, bucket)``).
+
+The public surface the Tuner (core/tune.py) consumes:
+
+  - ``observe_batch(segment, timing)`` / ``observe_stats(segment, stats)``
+    fold measured ``BatchTiming`` rows in (bucket = the padded batch size).
+  - ``ingest_costs(cache.costs())`` folds the CompileCache's harvested
+    flops / bytes_accessed / compile_s records.
+  - ``observe_host(stage, seconds, rows)`` learns the HOST path's per-row
+    cost per stage class — the other side of the fuse-vs-demote comparison.
+  - ``predict_ms(segment, shape=None, batch=None)`` -> predicted wall ms
+    for one batch, or None when the model knows nothing; ``predict()``
+    returns the full record (per-stage parts, source, confidence).
+  - ``confidence(segment)`` in [0, 1]: 0 = nothing known, low = analytical
+    only, -> 1 as measured batches accumulate. ``calibrated(segment)`` is
+    the gate every knob decision sits behind: an UNCALIBRATED model must
+    change nothing (cold-start behavior stays bitwise-identical).
+  - ``choose_buckets(segment, max_bucket)`` -> a bucket set minimizing
+    predicted pad-waste + compile amortization over the OBSERVED batch-size
+    histogram (None until calibrated — callers keep the power-of-two
+    default, ``parallel/batching.py next_bucket``).
+  - ``fuse_decision(segment_label)`` -> True/False when both the device
+    prediction and the summed host-stage measurements are trustworthy,
+    None otherwise (the planner then falls back to the light-segment
+    heuristic, core/fusion.py plan()).
+
+Everything is host-side Python (no jax import), thread-safe under one lock,
+and serializable (``to_dict``/``from_dict``) so a tuned model survives a
+server restart or ships to a replica.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["SegmentCostModel", "bucket_of_shape"]
+
+#: measured-stage keys folded per (segment, bucket); queue_s is tracked but
+#: excluded from the predicted batch wall (it is producer wait the ring
+#: overlaps, not work the batch itself costs)
+_STAGES = ("queue_s", "h2d_s", "dispatch_s", "compute_s", "readback_s")
+_WALL_STAGES = ("h2d_s", "dispatch_s", "compute_s", "readback_s")
+
+
+def bucket_of_shape(shape_key: str) -> Optional[int]:
+    """Leading (batch) dim of a CompileCache shape key
+    (``"col=64x32x32x3:uint8;..."`` -> 64); None when unparseable."""
+    try:
+        first = shape_key.split(";", 1)[0]
+        dims = first.split("=", 1)[1].rsplit(":", 1)[0]
+        return int(dims.split("x", 1)[0])
+    except (IndexError, ValueError):
+        return None
+
+
+class _BucketRecord:
+    """Measured EWMAs + counters for one (segment, bucket)."""
+
+    __slots__ = ("n", "rows", "ewma") + _STAGES
+
+    def __init__(self):
+        self.n = 0
+        self.rows = 0
+        for k in _STAGES:
+            setattr(self, k, None)
+
+    def fold(self, timing, alpha: float) -> None:
+        self.n += 1
+        self.rows += int(getattr(timing, "rows", 0) or 0)
+        for k in _STAGES:
+            v = float(getattr(timing, k, 0.0) or 0.0) * 1e3  # -> ms
+            prev = getattr(self, k)
+            setattr(self, k, v if prev is None
+                    else (1 - alpha) * prev + alpha * v)
+
+    def wall_ms(self) -> Optional[float]:
+        vals = [getattr(self, k) for k in _WALL_STAGES]
+        if all(v is None for v in vals):
+            return None
+        return sum(v for v in vals if v is not None)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"n": self.n, "rows": self.rows}
+        for k in _STAGES:
+            v = getattr(self, k)
+            if v is not None:
+                out[k[:-2] + "_ms"] = round(v, 6)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "_BucketRecord":
+        rec = cls()
+        rec.n = int(d.get("n", 0))
+        rec.rows = int(d.get("rows", 0))
+        for k in _STAGES:
+            v = d.get(k[:-2] + "_ms")
+            if v is not None:
+                setattr(rec, k, float(v))
+        return rec
+
+
+class SegmentCostModel:
+    """Analytical-then-learned per-(segment, bucket) batch cost model."""
+
+    def __init__(self, peaks: Optional[Dict[str, Any]] = None,
+                 ewma: float = 0.3, min_obs: int = 4,
+                 compile_horizon: int = 200):
+        # peaks resolve lazily (device_peaks() may init a jax backend the
+        # caller hasn't touched yet); pass explicitly to pin them
+        self._peaks = peaks
+        self.ewma = float(ewma)
+        #: batches measured at a bucket before its EWMA is trusted
+        self.min_obs = int(min_obs)
+        #: batches a fresh compile is amortized over in bucket-set scoring
+        self.compile_horizon = int(compile_horizon)
+        self._lock = threading.Lock()
+        # (segment, bucket) -> measured record
+        self._measured: Dict[Tuple[str, int], _BucketRecord] = {}
+        # (segment, bucket) -> {flops, bytes_accessed, compile_s} (harvest)
+        self._analytic: Dict[Tuple[str, int], Dict[str, float]] = {}
+        # segment -> {real batch rows -> batches observed} (pad-waste term)
+        self._size_hist: Dict[str, Dict[int, int]] = {}
+        # host stage class -> (ewma ms-per-row, n) — the demote side
+        self._host: Dict[str, List[float]] = {}
+
+    # -- feeding ---------------------------------------------------------
+    def peaks(self) -> Dict[str, Any]:
+        if self._peaks is None:
+            from ..obs.perf import device_peaks
+
+            self._peaks = device_peaks()
+        return self._peaks
+
+    def observe_batch(self, segment: str, timing) -> None:
+        """Fold one measured ``BatchTiming`` (parallel/ingest.py). Bucket =
+        the padded batch size when recorded, else the valid row count."""
+        bucket = int(getattr(timing, "padded_rows", 0) or 0) or \
+            int(getattr(timing, "rows", 0) or 0)
+        if bucket <= 0:
+            return
+        rows = int(getattr(timing, "rows", 0) or 0)
+        with self._lock:
+            key = (str(segment), bucket)
+            rec = self._measured.get(key)
+            if rec is None:
+                rec = self._measured[key] = _BucketRecord()
+            rec.fold(timing, self.ewma)
+            if rows > 0:
+                hist = self._size_hist.setdefault(str(segment), {})
+                hist[rows] = hist.get(rows, 0) + 1
+
+    def observe_stats(self, segment: str, stats, start: int = 0) -> int:
+        """Fold ``stats.records[start:]`` of an IngestStats; returns the new
+        high-water index (incremental folding without double counting)."""
+        records = list(getattr(stats, "records", ()))[start:]
+        for t in records:
+            self.observe_batch(segment, t)
+        return start + len(records)
+
+    def ingest_costs(self, costs: Dict[str, Dict[str, Dict[str, Any]]]
+                     ) -> None:
+        """Fold a ``CompileCache.costs()`` payload: {segment: {shape key:
+        {flops, bytes_accessed, compile_s, ...}}} keyed down to buckets."""
+        with self._lock:
+            for label, shapes in (costs or {}).items():
+                for shape, rec in shapes.items():
+                    bucket = bucket_of_shape(shape)
+                    if bucket is None or bucket <= 0:
+                        continue
+                    dst = self._analytic.setdefault(
+                        (str(label), bucket), {})
+                    for k in ("flops", "bytes_accessed", "compile_s"):
+                        v = rec.get(k)
+                        if isinstance(v, (int, float)):
+                            dst[k] = float(v)
+
+    def observe_host(self, stage: str, seconds: float, rows: int) -> None:
+        """Fold one host-path stage execution (ms per row EWMA)."""
+        if rows <= 0 or seconds < 0:
+            return
+        per_row = seconds * 1e3 / rows
+        with self._lock:
+            cur = self._host.get(str(stage))
+            if cur is None:
+                self._host[str(stage)] = [per_row, 1]
+            else:
+                cur[0] = (1 - self.ewma) * cur[0] + self.ewma * per_row
+                cur[1] += 1
+
+    # -- prediction ------------------------------------------------------
+    def _analytic_ms(self, key: Tuple[str, int]) -> Optional[float]:
+        rec = self._analytic.get(key)
+        if not rec:
+            return None
+        peaks = self.peaks()
+        t_f = rec.get("flops", 0.0) / float(peaks["flops"])
+        t_b = rec.get("bytes_accessed", 0.0) / float(peaks["bytes_per_s"])
+        bound = max(t_f, t_b)
+        return bound * 1e3 if bound > 0 else None
+
+    def _buckets_of(self, segment: str) -> List[int]:
+        return sorted({b for (s, b) in self._measured if s == segment} |
+                      {b for (s, b) in self._analytic if s == segment})
+
+    def _ms_at_bucket(self, segment: str, bucket: int
+                      ) -> Tuple[Optional[float], str, float]:
+        """(predicted ms, source, confidence) at one exact bucket.
+
+        Measured EWMA when trusted; else analytical roofline, scaled by the
+        segment's measured/bound ratio when any bucket of the segment has
+        both (the "learned correction" on top of the analytical form)."""
+        key = (segment, bucket)
+        rec = self._measured.get(key)
+        if rec is not None and rec.n >= self.min_obs:
+            wall = rec.wall_ms()
+            if wall is not None:
+                return wall, "measured", rec.n / (rec.n + float(self.min_obs))
+        bound = self._analytic_ms(key)
+        if bound is None:
+            return None, "none", 0.0
+        # correction factor: mean measured/bound over calibrated buckets
+        ratios = []
+        for (s, b), m in self._measured.items():
+            if s != segment or m.n < self.min_obs:
+                continue
+            other = self._analytic_ms((segment, b))
+            wall = m.wall_ms()
+            if other and wall and other > 0:
+                ratios.append(wall / other)
+        if ratios:
+            return (bound * sum(ratios) / len(ratios), "analytic+corrected",
+                    0.3)
+        return bound, "analytic", 0.1
+
+    def _interp_ms(self, segment: str, bucket: int
+                   ) -> Tuple[Optional[float], str, float]:
+        """Prediction at an ARBITRARY bucket: exact record when present,
+        else linear interpolation/extrapolation over the known buckets
+        (batch cost is affine in rows to first order: fixed dispatch +
+        per-row compute)."""
+        exact = self._ms_at_bucket(segment, bucket)
+        if exact[0] is not None:
+            return exact
+        pts = []
+        for b in self._buckets_of(segment):
+            ms, _, conf = self._ms_at_bucket(segment, b)
+            if ms is not None:
+                pts.append((b, ms, conf))
+        if not pts:
+            return None, "none", 0.0
+        if len(pts) == 1:
+            b0, ms0, conf = pts[0]
+            # proportional with a fixed-cost floor: half the known point
+            return ms0 * max(0.5, bucket / b0), "scaled", conf * 0.5
+        pts.sort()
+        lo = max((p for p in pts if p[0] <= bucket), default=pts[0])
+        hi = min((p for p in pts if p[0] >= bucket), default=pts[-1])
+        if lo[0] == hi[0]:
+            lo, hi = pts[0], pts[-1]
+        slope = (hi[1] - lo[1]) / float(hi[0] - lo[0])
+        ms = lo[1] + slope * (bucket - lo[0])
+        conf = min(lo[2], hi[2]) * 0.8
+        return max(ms, 1e-6), "interpolated", conf
+
+    def predict(self, segment: str, batch: Optional[int] = None,
+                shape: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        """Full prediction record for one batch of ``batch`` rows (or the
+        bucket parsed from a CompileCache ``shape`` key): ``{ms, bucket,
+        source, confidence, parts}`` or None when the model knows nothing
+        about the segment."""
+        if batch is None and shape is not None:
+            batch = bucket_of_shape(shape)
+        if batch is None or batch <= 0:
+            return None
+        with self._lock:
+            ms, source, conf = self._interp_ms(str(segment), int(batch))
+            if ms is None:
+                return None
+            out: Dict[str, Any] = {"ms": round(ms, 6), "bucket": int(batch),
+                                   "source": source,
+                                   "confidence": round(conf, 4)}
+            rec = self._measured.get((str(segment), int(batch)))
+            if rec is not None and rec.n > 0:
+                out["parts"] = {k[:-2] + "_ms": round(getattr(rec, k), 6)
+                                for k in _STAGES
+                                if getattr(rec, k) is not None}
+                out["observed_batches"] = rec.n
+            return out
+
+    def predict_ms(self, segment: str, shape: Optional[str] = None,
+                   batch: Optional[int] = None) -> Optional[float]:
+        rec = self.predict(segment, batch=batch, shape=shape)
+        return None if rec is None else rec["ms"]
+
+    def confidence(self, segment: str) -> float:
+        """Calibration confidence for a segment: the best single-bucket
+        confidence (0.0 = unknown, >= 0.5 once min_obs batches measured)."""
+        with self._lock:
+            best = 0.0
+            for b in self._buckets_of(str(segment)):
+                _, _, conf = self._ms_at_bucket(str(segment), b)
+                best = max(best, conf)
+            return round(best, 4)
+
+    def calibrated(self, segment: Optional[str] = None) -> bool:
+        """True once MEASURED data (not just analytical bounds) backs the
+        segment — the gate in front of every knob change."""
+        with self._lock:
+            keys = [k for k in self._measured
+                    if segment is None or k[0] == str(segment)]
+            return any(self._measured[k].n >= self.min_obs for k in keys)
+
+    # -- knob decisions --------------------------------------------------
+    def choose_buckets(self, segment: str, max_bucket: int,
+                       max_buckets: int = 6,
+                       candidates: Optional[Sequence[int]] = None
+                       ) -> Optional[Tuple[int, ...]]:
+        """Bucket set minimizing predicted batch cost + compile
+        amortization over the segment's OBSERVED batch-size histogram.
+
+        Candidates default to the observed real sizes, their next multiples
+        of 8, and the power-of-two defaults (all capped at ``max_bucket``).
+        Every observed size must map to the smallest chosen bucket >= it;
+        each chosen bucket that has never compiled charges its predicted
+        compile time amortized over ``compile_horizon`` batches. Returns
+        None until the segment is calibrated — the caller then keeps the
+        power-of-two default, so an uncalibrated model changes nothing."""
+        seg = str(segment)
+        if not self.calibrated(seg):
+            return None
+        with self._lock:
+            hist = dict(self._size_hist.get(seg) or {})
+        hist = {n: c for n, c in hist.items() if 0 < n <= max_bucket}
+        if not hist:
+            return None
+        if candidates is None:
+            cand = set()
+            for n in hist:
+                cand.add(n)
+                cand.add(min(max_bucket, (n + 7) // 8 * 8))
+            b = 8
+            while b < max_bucket:
+                cand.add(b)
+                b <<= 1
+            cand.add(max_bucket)
+            candidates = sorted(c for c in cand if c >= 1)
+        else:
+            candidates = sorted({int(c) for c in candidates
+                                 if 0 < int(c) <= max_bucket})
+        if not candidates or candidates[-1] < max(hist):
+            return None
+        with self._lock:
+            compiled = {b for (s, b) in self._analytic if s == seg} | \
+                {b for (s, b) in self._measured if s == seg}
+            ms_at = {}
+            for c in candidates:
+                ms, _, _ = self._interp_ms(seg, c)
+                if ms is None:
+                    return None
+                ms_at[c] = ms
+            compile_ms = [rec.get("compile_s", 0.0) * 1e3
+                          for (s, _), rec in self._analytic.items()
+                          if s == seg and rec.get("compile_s")]
+        amort = (sum(compile_ms) / len(compile_ms) / self.compile_horizon
+                 if compile_ms else 0.0)
+
+        def score(chosen: Tuple[int, ...]) -> float:
+            total = 0.0
+            for n, count in hist.items():
+                b = next((c for c in chosen if c >= n), chosen[-1])
+                total += count * ms_at[b]
+            total += sum(amort for b in chosen if b not in compiled)
+            return total
+
+        # exact search over small candidate sets, greedy refinement above
+        best: Optional[Tuple[int, ...]] = None
+        best_score = float("inf")
+        top = candidates[-1]
+        rest = candidates[:-1]
+        if len(rest) <= 12:
+            for mask in range(1 << len(rest)):
+                chosen = tuple(c for i, c in enumerate(rest)
+                               if mask >> i & 1) + (top,)
+                if len(chosen) > max_buckets:
+                    continue
+                s = score(chosen)
+                if s < best_score - 1e-12:
+                    best, best_score = chosen, s
+        else:
+            chosen = (top,)
+            best, best_score = chosen, score(chosen)
+            improved = True
+            while improved and len(best) < max_buckets:
+                improved = False
+                for c in rest:
+                    if c in best:
+                        continue
+                    trial = tuple(sorted(best + (c,)))
+                    s = score(trial)
+                    if s < best_score - 1e-12:
+                        best, best_score = trial, s
+                        improved = True
+        return best
+
+    def fuse_decision(self, label: str) -> Optional[bool]:
+        """Predicted fuse-vs-host comparison for a segment label
+        (``"StageA+StageB"``): True when the predicted DEVICE per-row cost
+        undercuts the summed measured HOST per-row cost of its stages,
+        False when it doesn't, None when either side lacks trustworthy data
+        (the planner keeps the light-segment heuristic)."""
+        seg = str(label)
+        if not self.calibrated(seg):
+            return None
+        with self._lock:
+            host_total = 0.0
+            for stage in seg.split("+"):
+                rec = self._host.get(stage)
+                if rec is None or rec[1] < self.min_obs:
+                    return None
+                host_total += rec[0]
+            # device ms/row at the modal measured bucket
+            best_key, best_n = None, 0
+            for (s, b), rec in self._measured.items():
+                if s == seg and rec.n > best_n and rec.rows > 0:
+                    best_key, best_n = (s, b), rec.n
+            if best_key is None or best_n < self.min_obs:
+                return None
+            rec = self._measured[best_key]
+            wall = rec.wall_ms()
+            if wall is None:
+                return None
+            device_per_row = wall * rec.n / rec.rows
+        return device_per_row < host_total
+
+    # -- introspection / serialization -----------------------------------
+    def host_ms_per_row(self, stage: str) -> Optional[float]:
+        with self._lock:
+            rec = self._host.get(str(stage))
+            return None if rec is None else round(rec[0], 6)
+
+    def segments(self) -> List[str]:
+        with self._lock:
+            return sorted({s for (s, _) in self._measured} |
+                          {s for (s, _) in self._analytic})
+
+    def prediction_error(self) -> Dict[str, Dict[str, Any]]:
+        """Analytical-vs-measured error per (segment, bucket) that has
+        both: the perf_report "predicted vs measured" table, and the
+        honesty check on the analytical form itself."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for (seg, b), rec in sorted(self._measured.items()):
+                if rec.n < self.min_obs:
+                    continue
+                wall = rec.wall_ms()
+                bound = self._analytic_ms((seg, b))
+                if wall is None:
+                    continue
+                row: Dict[str, Any] = {"measured_ms": round(wall, 4),
+                                       "batches": rec.n}
+                if bound is not None and bound > 0:
+                    row["analytic_ms"] = round(bound, 6)
+                    row["error_ratio"] = round(wall / bound, 4)
+                out.setdefault(seg, {})[str(b)] = row
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            measured = {f"{s}:{b}": rec.to_dict()
+                        for (s, b), rec in sorted(self._measured.items())}
+            host = {k: {"ms_per_row": round(v[0], 6), "n": v[1]}
+                    for k, v in sorted(self._host.items())}
+            n_analytic = len(self._analytic)
+        segs = self.segments()
+        return {"segments": segs,
+                "calibrated": {s: self.calibrated(s) for s in segs},
+                "confidence": {s: self.confidence(s) for s in segs},
+                "measured": measured, "host_stages": host,
+                "analytic_records": n_analytic,
+                "peak_source": self.peaks().get("peak_source")}
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "version": 1,
+                "ewma": self.ewma, "min_obs": self.min_obs,
+                "compile_horizon": self.compile_horizon,
+                "measured": {f"{s}\x00{b}": rec.to_dict()
+                             for (s, b), rec in self._measured.items()},
+                "analytic": {f"{s}\x00{b}": dict(rec)
+                             for (s, b), rec in self._analytic.items()},
+                "size_hist": {s: {str(n): c for n, c in h.items()}
+                              for s, h in self._size_hist.items()},
+                "host": {k: list(v) for k, v in self._host.items()},
+            }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any],
+                  peaks: Optional[Dict[str, Any]] = None
+                  ) -> "SegmentCostModel":
+        m = cls(peaks=peaks, ewma=float(d.get("ewma", 0.3)),
+                min_obs=int(d.get("min_obs", 4)),
+                compile_horizon=int(d.get("compile_horizon", 200)))
+
+        def split(key: str) -> Tuple[str, int]:
+            seg, b = key.rsplit("\x00", 1)
+            return seg, int(b)
+
+        for key, rec in (d.get("measured") or {}).items():
+            m._measured[split(key)] = _BucketRecord.from_dict(rec)
+        for key, rec in (d.get("analytic") or {}).items():
+            m._analytic[split(key)] = {k: float(v) for k, v in rec.items()}
+        for seg, hist in (d.get("size_hist") or {}).items():
+            m._size_hist[seg] = {int(n): int(c) for n, c in hist.items()}
+        for k, v in (d.get("host") or {}).items():
+            m._host[k] = [float(v[0]), int(v[1])]
+        return m

@@ -127,17 +127,63 @@ class CompileCache:
     generation counter; a build that a reset raced still installs its
     (valid) executable but does NOT book its miss/compile-time/cost into
     the post-reset counters — cleared stats never mix epochs.
+
+    Eviction contract: the cache is a bounded LRU — a hit refreshes the
+    entry, an insert past ``capacity`` evicts the least-recently-used one
+    (a long-running server with many shape buckets previously grew compiled
+    executables forever under insertion-order eviction). Evicting an entry
+    also drops its harvested cost record, so ``costs()`` only ever
+    describes executables that are actually resident; ``evictions`` counts
+    drops (exposed as ``mmlspark_segment_cache_evictions_total``).
+    ``capacity`` defaults from ``MMLSPARK_SEGMENT_CACHE_CAP`` when unset.
     """
 
-    def __init__(self, capacity: int = 256):
-        self._capacity = capacity
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            import os
+
+            try:
+                capacity = int(os.environ.get(
+                    "MMLSPARK_SEGMENT_CACHE_CAP", "256"))
+            except ValueError:
+                capacity = 256
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self._capacity = int(capacity)
         self._entries: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
         self._gen = 0
         self._costs: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # entry key -> its cost-record key, so eviction can drop the record
+        self._cost_key: Dict[Tuple, Tuple[str, str]] = {}
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
         self.compile_time_s = 0.0
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def set_capacity(self, capacity: int) -> None:
+        """Re-bound the cache; shrinking evicts LRU entries immediately."""
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        with self._lock:
+            self._capacity = int(capacity)
+            while len(self._entries) > self._capacity:
+                self._evict_lru_locked()
+                self.evictions += 1
+
+    def _evict_lru_locked(self) -> None:
+        """Drop the least-recently-used entry (dict order = LRU order:
+        hits re-insert at the end) and its cost record. Lock held; the
+        caller books ``evictions`` under the same acquisition."""
+        key = next(iter(self._entries))
+        self._entries.pop(key)
+        ck = self._cost_key.pop(key, None)
+        if ck is not None:
+            self._costs.pop(ck, None)
 
     def get(self, key: Tuple, builder: Callable[[], Any],
             label: Optional[str] = None,
@@ -145,7 +191,10 @@ class CompileCache:
         with self._lock:
             if key in self._entries:
                 self.hits += 1
-                return self._entries[key]
+                # LRU refresh: move to the end of the dict's order
+                fn = self._entries.pop(key)
+                self._entries[key] = fn
+                return fn
             gen = self._gen
         # build OUTSIDE the lock: XLA compiles can take seconds and other
         # segments/threads must not serialize behind them
@@ -167,9 +216,12 @@ class CompileCache:
                     rec["compile_s"] = round(dt, 6)
                     self._costs[(str(label), str(shape))] = rec
             if key not in self._entries:
-                if len(self._entries) >= self._capacity:
-                    self._entries.pop(next(iter(self._entries)))
+                while len(self._entries) >= self._capacity:
+                    self._evict_lru_locked()
+                    self.evictions += 1
                 self._entries[key] = fn
+                if not stale and label is not None:
+                    self._cost_key[key] = (str(label), str(shape))
             return self._entries[key]
 
     def clear(self) -> None:
@@ -177,8 +229,10 @@ class CompileCache:
             self._gen += 1
             self._entries.clear()
             self._costs.clear()
+            self._cost_key.clear()
             self.hits = 0
             self.misses = 0
+            self.evictions = 0
             self.compile_time_s = 0.0
 
     #: reset() is clear() — the name the obs layer documents
@@ -218,8 +272,10 @@ class CompileCache:
             total = self.hits + self.misses
             return {
                 "entries": len(self._entries),
+                "capacity": self._capacity,
                 "hits": self.hits,
                 "misses": self.misses,
+                "evictions": self.evictions,
                 "hit_rate": round(self.hits / total, 4) if total else None,
                 "compile_time_s": round(self.compile_time_s, 6),
             }

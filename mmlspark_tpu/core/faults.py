@@ -188,9 +188,10 @@ INGEST_H2D = "ingest.h2d"          # parallel/ingest TransferRing staging
 JOURNAL_WRITE = "journal.write"    # serving/journal entry append
 JOURNAL_COMMIT = "journal.commit"  # serving/journal epoch commit
 TRAIN_STEP = "train.step"          # gbdt boosting iteration / DNN train step
+TUNER_MEASURE = "tuner.measure"    # core/tune Tuner's e2e measurement probe
 
 ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
-              JOURNAL_COMMIT, TRAIN_STEP)
+              JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE)
 
 
 class InjectedFault(OSError):

@@ -158,21 +158,46 @@ class Segment:
                 "batch_size": self.batch_size()}
 
 
-def plan(stages: Sequence[Transformer], schema: Schema) -> List[Any]:
+def plan(stages: Sequence[Transformer], schema: Schema,
+         cost_model=None,
+         fuse_overrides: Optional[Dict[str, bool]] = None) -> List[Any]:
     """Partition a fitted stage chain into HostStage / Segment plan nodes.
 
     Walks the chain threading the schema through ``transform_schema``; each
     stage offers a DeviceFn via ``device_fn(schema)`` (None = host-only).
     Segments that carry no heavy stage are demoted to host stages — a
     device round-trip for column plumbing alone is a loss.
+
+    ``cost_model`` (core/costmodel.py SegmentCostModel) upgrades that
+    demotion heuristic to a PREDICTED fuse-vs-host comparison:
+    ``fuse_decision(label)`` returning True keeps a light segment fused,
+    False demotes it, None (uncalibrated / no host measurements) falls back
+    to the heuristic — so plans from an uncalibrated model are
+    bitwise-identical to the default. ``fuse_overrides`` ({label: bool},
+    the Tuner's applied knob — also how its calibration probe force-fuses
+    a light candidate to measure its device cost) wins over both.
     """
     nodes: List[Any] = []
     cur: Optional[Segment] = None
 
+    def keep_fused(seg: Segment) -> bool:
+        if fuse_overrides is not None and seg.label in fuse_overrides:
+            return bool(fuse_overrides[seg.label])
+        if seg.heavy:
+            return True
+        if cost_model is not None:
+            try:
+                decision = cost_model.fuse_decision(seg.label)
+            except Exception:  # defensive: a model bug must not kill plan
+                decision = None
+            if decision is not None:
+                return decision
+        return False
+
     def close():
         nonlocal cur
         if cur is not None:
-            if cur.heavy:
+            if keep_fused(cur):
                 nodes.append(cur)
             else:
                 nodes.extend(HostStage(s) for s in cur.stages)
@@ -277,10 +302,17 @@ class SegmentExecutor:
     """Runs one Segment over a DataFrame, partition by partition, through
     the TransferRing with compile-cache-backed fused executables."""
 
-    def __init__(self, segment: Segment, cache: Optional[CompileCache] = None):
+    def __init__(self, segment: Segment, cache: Optional[CompileCache] = None,
+                 buckets: Optional[Tuple[int, ...]] = None,
+                 cost_model=None):
         self.segment = segment
         self.cache = cache if cache is not None else compile_cache()
         self.fallbacks: List[str] = []
+        # cost-aware bucket SET for short batches (auto-tuner knob; None =
+        # the power-of-two default — bitwise-identical cold start)
+        self.buckets = tuple(sorted(buckets)) if buckets else None
+        # cost model fed by host-fallback timings (the fuse-vs-host term)
+        self.cost_model = cost_model
 
     def _cost_attrs(self) -> Dict[str, Any]:
         """XLA cost attrs for this segment's trace spans (mean per-batch
@@ -299,8 +331,14 @@ class SegmentExecutor:
     def _host_partition(self, part: Dict[str, np.ndarray], schema: Schema
                         ) -> List[Dict[str, np.ndarray]]:
         sub = DataFrame([dict(part)], schema.copy())
+        n = len(next(iter(part.values()))) if part else 0
         for s in self.segment.stages:
+            t0 = time.perf_counter()
             sub = s.transform(sub)
+            if self.cost_model is not None and n > 0:
+                # the measured HOST side of the fuse-vs-host comparison
+                self.cost_model.observe_host(
+                    type(s).__name__, time.perf_counter() - t0, n)
         return sub.partitions
 
     # -- fused path ------------------------------------------------------
@@ -436,7 +474,7 @@ class SegmentExecutor:
             stop = min(start + batch_size, n_valid)
             m = stop - start
             target = batch_size if m == batch_size \
-                else min(next_bucket(m), batch_size)
+                else min(next_bucket(m, buckets=self.buckets), batch_size)
             arrays = {c: pad_batch(dense[c][start:stop], target)
                       for c in ext}
             mask = np.zeros(target, dtype=bool)
@@ -653,22 +691,74 @@ class FusedPipelineModel(PipelineModel):
 
     _abstract = True
 
-    def __init__(self, stages=None, cache: Optional[CompileCache] = None, **kwargs):
+    def __init__(self, stages=None, cache: Optional[CompileCache] = None,
+                 cost_model=None, **kwargs):
         super().__init__(stages, **kwargs)
         self._cache = cache if cache is not None else compile_cache()
         self._plans: Dict[Tuple, List[Any]] = {}
         self._seg_stats: Dict[str, Any] = {}
         self._last_fallbacks: List[str] = []
         self._last_plan: Optional[List[Any]] = None
+        # auto-tuning state (core/tune.py Tuner drives these): a cost model
+        # feeding plan()'s fuse-vs-host comparison + host-stage timings,
+        # per-segment bucket-set overrides, and fuse overrides. All default
+        # OFF: an untuned model plans and buckets bitwise-identically.
+        self._cost_model = cost_model
+        self._bucket_overrides: Dict[str, Tuple[int, ...]] = {}
+        self._fuse_overrides: Dict[str, bool] = {}
 
     def fuse(self) -> "FusedPipelineModel":
         return self
 
+    def set_tuning(self, buckets: Optional[Dict[str, Tuple[int, ...]]] = None,
+                   fuse: Optional[Dict[str, bool]] = None,
+                   cost_model=None) -> None:
+        """Apply tuned knobs (Tuner.apply): per-segment-label bucket sets,
+        fuse-vs-demote overrides, and/or the cost model itself. Passing None
+        leaves a knob unchanged; passing {} clears it. Cached plans are
+        invalidated (compiled executables survive in the CompileCache)."""
+        if buckets is not None:
+            self._bucket_overrides = {
+                str(k): tuple(sorted(int(b) for b in v))
+                for k, v in buckets.items()}
+        if fuse is not None:
+            self._fuse_overrides = {str(k): bool(v)
+                                    for k, v in fuse.items()}
+        if cost_model is not None:
+            self._cost_model = cost_model
+        self._plans.clear()
+
+    @property
+    def cost_model(self):
+        return self._cost_model
+
     def _plan_for(self, schema: Schema) -> List[Any]:
         key = tuple(schema.types.items())
         if key not in self._plans:
-            self._plans[key] = plan(self._stages, schema.copy())
+            self._plans[key] = plan(
+                self._stages, schema.copy(), cost_model=self._cost_model,
+                fuse_overrides=self._fuse_overrides or None)
         return self._plans[key]
+
+    def _make_executor(self, node: Segment) -> SegmentExecutor:
+        return SegmentExecutor(
+            node, self._cache,
+            buckets=self._bucket_overrides.get(node.label),
+            cost_model=self._cost_model)
+
+    def _host_node(self, node: HostStage, df: DataFrame) -> DataFrame:
+        """Run one host plan node, feeding its wall time to the cost model
+        (the measured host side of fuse-vs-demote) when tuning is on."""
+        if self._cost_model is None:
+            return node.stage.transform(df)
+        n = sum(len(next(iter(p.values()))) if p else 0
+                for p in df.partitions)
+        t0 = time.perf_counter()
+        out = node.stage.transform(df)
+        if n > 0:
+            self._cost_model.observe_host(
+                node.label, time.perf_counter() - t0, n)
+        return out
 
     def transform(self, df: DataFrame, fused: bool = True) -> DataFrame:
         if not fused:
@@ -683,11 +773,11 @@ class FusedPipelineModel(PipelineModel):
             if isinstance(node, Segment):
                 stats = IngestStats()
                 self._seg_stats[node.label] = stats
-                ex = SegmentExecutor(node, self._cache)
+                ex = self._make_executor(node)
                 df = ex.run(df, stats)
                 self._last_fallbacks.extend(ex.fallbacks)
             else:
-                df = node.stage.transform(df)
+                df = self._host_node(node, df)
         return df
 
     def transform_submit(self, df: DataFrame):
@@ -710,17 +800,17 @@ class FusedPipelineModel(PipelineModel):
             if isinstance(node, Segment):
                 stats = IngestStats()
                 self._seg_stats[node.label] = stats
-                ex = SegmentExecutor(node, self._cache)
+                ex = self._make_executor(node)
                 df = ex.run(df, stats)
                 self._last_fallbacks.extend(ex.fallbacks)
             else:
-                df = node.stage.transform(df)
+                df = self._host_node(node, df)
         if tail is None:
             out = df
             return lambda: out
         stats = IngestStats()
         self._seg_stats[tail.label] = stats
-        ex = SegmentExecutor(tail, self._cache)
+        ex = self._make_executor(tail)
         resolve = ex.submit_run(df, stats)
 
         def done() -> DataFrame:
@@ -760,7 +850,7 @@ class FusedPipelineModel(PipelineModel):
             roofline = attribute_segments(per_segment, costs)
         except Exception:  # noqa: BLE001 — attribution must not break stats
             roofline = {}
-        return {
+        out = {
             "segments": [n.describe() for n in nodes],
             "n_fused_segments": sum(isinstance(n, Segment) for n in nodes),
             "per_segment": per_segment,
@@ -769,6 +859,12 @@ class FusedPipelineModel(PipelineModel):
             "segment_costs": costs,
             "roofline": roofline,
         }
+        if self._bucket_overrides or self._fuse_overrides:
+            out["tuning"] = {
+                "buckets": {k: list(v)
+                            for k, v in self._bucket_overrides.items()},
+                "fuse": dict(self._fuse_overrides)}
+        return out
 
     @property
     def last_fusion_stats(self) -> Dict[str, Any]:

@@ -1,0 +1,446 @@
+"""Tuner: the bounded measure -> refit -> apply loop over the cost model.
+
+TVM's auto-tuning insight (arXiv:1802.04799) applied to this framework's
+knobs: don't hand-tune constants, MEASURE candidate settings, refit the cost
+model (core/costmodel.py), and keep what the measurements like. The knobs a
+``Tuner`` owns are exactly the static heuristics the ROADMAP names:
+
+  - shape-bucket sets per fused segment (``parallel/batching.py`` padded to
+    powers of two today) — chosen to minimize predicted pad-waste plus
+    recompile amortization over the observed batch-size histogram;
+  - fuse-vs-demote per light segment (``core/fusion.py plan()``) — the
+    predicted device-vs-host comparison, heuristic fallback when the model
+    is not calibrated;
+  - the adaptive batch controller's cold-start window (predicted compute ms
+    seeds the EWMA — ``AdaptiveBatchController.seed_compute_ms``);
+  - the serving executor's ``inflight`` depth and a ReplicaSet sizing
+    suggestion, derived from predicted compute-vs-transfer overlap.
+
+Every decision is journaled, every ``apply`` keeps the previous knob set,
+and a measured regression past ``tolerance`` rolls back ONE step — the tuner
+can never walk a server downhill. An UNCALIBRATED model proposes the empty
+knob set, so cold-start behavior is bitwise-identical to the static
+defaults. State (model + knobs + journal) serializes via ``to_dict``.
+
+Two drive modes:
+
+  - explicit: ``tuner.tune(measure)`` where ``measure() -> float`` is a
+    higher-is-better end-to-end metric (qps, images/s) — the bench and
+    offline calibration path;
+  - serving: ``every=N`` makes ``on_epoch()`` (called by both serving
+    loops after each batch) refit + apply every N batches and watch the
+    measured per-batch e2e EWMA for regressions, rolling back one step
+    when the tuned knobs made it worse.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import faults
+from .costmodel import SegmentCostModel
+
+__all__ = ["KnobSet", "Tuner"]
+
+
+@dataclasses.dataclass
+class KnobSet:
+    """One coherent setting of every tuned knob. The default-constructed
+    KnobSet IS the static-heuristic configuration (nothing overridden)."""
+
+    #: per-segment-label shape-bucket sets (None entries impossible; absent
+    #: label = keep the power-of-two default)
+    buckets: Dict[str, Tuple[int, ...]] = dataclasses.field(
+        default_factory=dict)
+    #: per-segment-label fuse-vs-demote overrides for LIGHT segments
+    fuse: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    #: predicted compute ms seeding the adaptive controller's EWMA
+    window_seed_ms: Optional[float] = None
+    #: executor in-flight slot depth
+    inflight: Optional[int] = None
+    #: ReplicaSet sizing suggestion (surfaced, not hot-applied: replica
+    #: placement happens at server start)
+    replicas: Optional[int] = None
+
+    def is_default(self) -> bool:
+        return not (self.buckets or self.fuse or
+                    self.window_seed_ms is not None or
+                    self.inflight is not None or self.replicas is not None)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.buckets:
+            out["buckets"] = {k: list(v) for k, v in self.buckets.items()}
+        if self.fuse:
+            out["fuse"] = dict(self.fuse)
+        for k in ("window_seed_ms", "inflight", "replicas"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "KnobSet":
+        return cls(
+            buckets={k: tuple(int(x) for x in v)
+                     for k, v in (d.get("buckets") or {}).items()},
+            fuse={k: bool(v) for k, v in (d.get("fuse") or {}).items()},
+            window_seed_ms=d.get("window_seed_ms"),
+            inflight=d.get("inflight"), replicas=d.get("replicas"))
+
+
+class Tuner:
+    """Cost-model-driven knob tuner over a FusedPipelineModel (and,
+    optionally, the serving executor/controller it runs under).
+
+    ``fused``: the FusedPipelineModel whose CompileCache costs and
+    per-segment IngestStats feed the model and whose ``set_tuning()``
+    receives bucket/fuse knobs. ``controller``/``executor`` (wired by
+    ``ServingServer.start()`` when the server owns a tuner): receive the
+    window seed / inflight knobs. All optional — a Tuner over just a fused
+    model tunes buckets and fuse decisions alone.
+    """
+
+    def __init__(self, fused=None, model: Optional[SegmentCostModel] = None,
+                 controller=None, executor=None,
+                 every: int = 0, tolerance: float = 0.05,
+                 max_inflight: int = 8, journal_cap: int = 256):
+        self.model = model if model is not None else SegmentCostModel()
+        self.fused = fused
+        self.controller = controller
+        self.executor = executor
+        #: serving mode: refit+apply every N batches (0 = explicit only)
+        self.every = int(every)
+        #: fractional e2e regression that triggers a one-step rollback
+        self.tolerance = float(tolerance)
+        self.max_inflight = int(max_inflight)
+        self._journal_cap = int(journal_cap)
+        self._lock = threading.Lock()
+        self.knobs = KnobSet()
+        self._prev: Optional[KnobSet] = None
+        self.journal: List[Dict[str, Any]] = []
+        self.applies = 0
+        self.rollbacks = 0
+        self.epochs = 0
+        # incremental IngestStats folding: label -> (stats object id, fold
+        # high-water mark) so re-reading a live stats object never double
+        # counts records
+        self._folded: Dict[str, Tuple[int, int]] = {}
+        # serving-mode regression watch: per-batch e2e ms EWMAs before and
+        # after the latest apply; the first post-apply batches are skipped
+        # (they carry any fresh bucket's ONE-TIME XLA compile, which must
+        # not read as a steady-state regression)
+        self._e2e_before: Optional[float] = None
+        self._e2e_after: Optional[float] = None
+        self._e2e_after_n = 0
+        self._e2e_skip = 0
+        # a rolled-back knob set is vetoed for a few boundaries so a noisy
+        # host doesn't flip-flop apply/rollback on the same proposal
+        self._vetoed: Optional[Dict[str, Any]] = None
+        self._veto_until = 0
+
+    # -- journal ---------------------------------------------------------
+    def _log(self, action: str, **fields: Any) -> None:
+        entry = {"action": action, "epoch": self.epochs, **fields}
+        with self._lock:
+            self.journal.append(entry)
+            if len(self.journal) > self._journal_cap:
+                del self.journal[: self._journal_cap // 4]
+
+    # -- refit -----------------------------------------------------------
+    def fold_measured(self) -> None:
+        """Fold the fused model's CURRENT per-segment IngestStats into the
+        cost model (incremental, double-count safe). Called per batch in
+        serving mode — the stats objects are replaced every transform, so
+        waiting for the every-N refit would drop most of the records."""
+        segs = getattr(self.fused, "_seg_stats", None) or {}
+        for label, st in list(segs.items()):
+            prev_id, mark = self._folded.get(label, (None, 0))
+            if prev_id != id(st):
+                mark = 0
+            try:
+                mark = self.model.observe_stats(label, st, start=mark)
+            except Exception:  # noqa: BLE001
+                continue
+            self._folded[label] = (id(st), mark)
+
+    def refit(self) -> None:
+        """Fold the fused model's latest CompileCache costs and per-segment
+        IngestStats into the cost model (incremental, double-count safe)."""
+        fused = self.fused
+        if fused is None:
+            return
+        cache = getattr(fused, "_cache", None)
+        if cache is not None:
+            try:
+                self.model.ingest_costs(cache.costs())
+            except Exception:  # noqa: BLE001 — refit must never kill serving
+                pass
+        self.fold_measured()
+
+    # -- propose ---------------------------------------------------------
+    def _segment_batch_caps(self) -> Dict[str, int]:
+        """{segment label: configured batch size} over the fused plan."""
+        out: Dict[str, int] = {}
+        plan = getattr(self.fused, "_last_plan", None) or []
+        for node in plan:
+            bs = getattr(node, "batch_size", None)
+            if callable(bs):
+                out[node.label] = int(bs())
+        return out
+
+    def propose(self) -> KnobSet:
+        """Derive a KnobSet from the current model. Uncalibrated segments
+        contribute nothing, so a cold model proposes the default set."""
+        knobs = KnobSet()
+        caps = self._segment_batch_caps()
+        trailing_ms: Optional[float] = None
+        parts: Optional[Dict[str, float]] = None
+        for label, cap in caps.items():
+            if not self.model.calibrated(label):
+                continue
+            chosen = self.model.choose_buckets(label, cap)
+            if chosen is not None:
+                knobs.buckets[label] = chosen
+            decision = self.model.fuse_decision(label)
+            if decision is not None:
+                knobs.fuse[label] = decision
+            pred = self.model.predict(label, batch=cap)
+            if pred is not None:
+                trailing_ms = pred["ms"]
+                parts = pred.get("parts")
+        if trailing_ms is not None:
+            compute = (parts or {}).get("compute_ms")
+            knobs.window_seed_ms = round(
+                compute if compute is not None else trailing_ms, 4)
+            transfer = sum((parts or {}).get(k, 0.0)
+                           for k in ("h2d_ms", "readback_ms"))
+            host = (parts or {}).get("dispatch_ms", 0.0)
+            if compute and compute > 0:
+                # slots needed so transfer+host hide behind compute
+                knobs.inflight = max(1, min(
+                    self.max_inflight,
+                    1 + round((transfer + host) / compute)))
+                knobs.replicas = self._replica_suggestion(compute, transfer)
+        return knobs
+
+    def _replica_suggestion(self, compute_ms: float,
+                            transfer_ms: float) -> Optional[int]:
+        """Compute-bound segments scale across local devices; transfer-bound
+        ones gain nothing from more replicas on one link."""
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return None
+        try:
+            n_dev = len(jax.local_devices())
+        except Exception:  # noqa: BLE001 — backend init failure
+            return None
+        return n_dev if compute_ms >= transfer_ms else 1
+
+    # -- apply / rollback ------------------------------------------------
+    def apply(self, knobs: KnobSet, reason: str = "apply") -> None:
+        """Push a KnobSet into the wired layers, remembering the previous
+        set for one-step rollback."""
+        with self._lock:
+            self._prev = self.knobs
+            self.knobs = knobs
+            self.applies += 1
+            # serving watch: ignore the next batches' e2e (fresh-bucket
+            # compile spike) before judging the new knobs
+            self._e2e_skip = 2
+        fused = self.fused
+        if fused is not None and hasattr(fused, "set_tuning"):
+            fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse)
+        if self.controller is not None and knobs.window_seed_ms is not None:
+            seed = getattr(self.controller, "seed_compute_ms", None)
+            if callable(seed):
+                seed(knobs.window_seed_ms)
+        if self.executor is not None and knobs.inflight is not None:
+            set_inflight = getattr(self.executor, "set_inflight", None)
+            if callable(set_inflight):
+                set_inflight(knobs.inflight)
+        self._log(reason, knobs=knobs.to_dict())
+
+    def rollback(self, reason: str = "regression") -> bool:
+        """Re-apply the PREVIOUS knob set (one step). Returns False when
+        there is nothing to roll back to."""
+        with self._lock:
+            prev = self._prev
+            if prev is None:
+                return False
+            self._prev = None
+        self.apply(prev, reason=f"rollback:{reason}")
+        with self._lock:
+            self.rollbacks += 1
+            self._prev = None  # a rollback is terminal for that step
+        return True
+
+    # -- explicit tuning loop --------------------------------------------
+    def _measure(self, measure: Callable[[], float]) -> float:
+        # chaos seam: an injected delay here slows THIS measurement (the
+        # deterministic way to fake a regression in tests); an injected
+        # exception surfaces to the caller like any measurement failure
+        t0 = time.perf_counter()
+        faults.fire(faults.TUNER_MEASURE)
+        penalty = time.perf_counter() - t0
+        value = float(measure())
+        if penalty > 0:
+            # an injected stall IS a slower system: scale the
+            # higher-is-better metric down by the stalled fraction
+            value = value / (1.0 + penalty)
+        return value
+
+    def tune(self, measure: Callable[[], float], steps: int = 1,
+             warmup: int = 1) -> Dict[str, Any]:
+        """Bounded measure -> refit -> apply loop. ``measure() -> float``
+        is higher-is-better end-to-end goodness (qps, images/s); it should
+        exercise the fused pipeline so refit() sees fresh stats. A step
+        whose measurement regresses past ``tolerance`` rolls back and the
+        loop stops (one-step rollback contract). ``warmup`` discarded
+        measure() calls follow each apply so a fresh bucket's ONE-TIME XLA
+        compile doesn't read as a steady-state regression (compile cost is
+        already charged in the model's bucket scoring, amortized over
+        ``compile_horizon``). Returns the decision summary (journaled)."""
+        baseline = self._measure(measure)
+        self._log("baseline", value=round(baseline, 6))
+        history = [{"step": 0, "value": round(baseline, 6),
+                    "knobs": self.knobs.to_dict(), "accepted": True}]
+        for step in range(1, max(1, int(steps)) + 1):
+            self.refit()
+            knobs = self.propose()
+            self.apply(knobs)
+            for _ in range(max(0, int(warmup))):
+                measure()  # discarded: compiles fresh-bucket executables
+            value = self._measure(measure)
+            accepted = value >= baseline * (1.0 - self.tolerance)
+            entry = {"step": step, "value": round(value, 6),
+                     "knobs": knobs.to_dict(), "accepted": accepted}
+            history.append(entry)
+            if not accepted:
+                self.rollback("tune_step_regressed")
+                self._log("tune_step", **entry)
+                break
+            self._log("tune_step", **entry)
+            baseline = max(baseline, value)
+        return {"baseline": history[0]["value"], "steps": history,
+                "final_knobs": self.knobs.to_dict(),
+                "rollbacks": self.rollbacks}
+
+    # -- serving integration ---------------------------------------------
+    def on_batch(self, e2e_s: float) -> None:
+        """Feed one served batch's end-to-end seconds (queue+compute+
+        readback) — the regression signal for serving-mode tuning. Batches
+        right after an apply are skipped: they carry any fresh bucket's
+        one-time compile, not steady state."""
+        ms = float(e2e_s) * 1e3
+        with self._lock:
+            if self._e2e_skip > 0:
+                self._e2e_skip -= 1
+                return
+            if self._e2e_after is None:
+                self._e2e_after = ms
+            else:
+                self._e2e_after = 0.75 * self._e2e_after + 0.25 * ms
+            self._e2e_after_n += 1
+
+    def on_epoch(self, e2e_s: Optional[float] = None) -> None:
+        """Per-batch tick from the serving loops. Every ``self.every``
+        batches: check the post-apply e2e EWMA against the pre-apply one
+        (rollback on regression), then refit and apply a fresh proposal."""
+        if e2e_s is not None:
+            self.on_batch(e2e_s)
+        self.fold_measured()
+        with self._lock:
+            self.epochs += 1
+            if self.every <= 0 or self.epochs % self.every != 0:
+                return
+            before, after = self._e2e_before, self._e2e_after
+            enough = self._e2e_after_n >= max(2, self.every // 2)
+        if (before is not None and after is not None and enough
+                and self._prev is not None
+                and after > before * (1.0 + self.tolerance)):
+            bad = self.knobs.to_dict()
+            self.rollback("serving_e2e_regressed")
+            with self._lock:
+                self._vetoed = bad
+                self._veto_until = self.epochs + 4 * max(1, self.every)
+                self._e2e_before = after
+                self._e2e_after = None
+                self._e2e_after_n = 0
+            return
+        self.refit()
+        knobs = self.propose()
+        kd = knobs.to_dict()
+        with self._lock:
+            vetoed = (self._vetoed is not None and kd == self._vetoed
+                      and self.epochs < self._veto_until)
+        if kd == self.knobs.to_dict():
+            self._log("steady", knobs=kd)
+        elif vetoed:
+            # the measured watch rejected exactly this set recently: hold
+            # the current knobs until the veto window passes
+            self._log("vetoed", knobs=kd)
+        else:
+            self.apply(knobs)
+        with self._lock:
+            self._e2e_before = after if after is not None else before
+            self._e2e_after = None
+            self._e2e_after_n = 0
+
+    # -- stats / serialization -------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """The ``tuner`` section of /_mmlspark/stats (and the source of the
+        mmlspark_tuner_* metric families, obs/bridge.py)."""
+        with self._lock:
+            journal = list(self.journal[-16:])
+            knob_ref = self.knobs
+            applies, rollbacks, epochs = \
+                self.applies, self.rollbacks, self.epochs
+            e2e = {"before_ms": self._e2e_before,
+                   "after_ms": self._e2e_after}
+        knobs = knob_ref.to_dict()
+        return {
+            "every": self.every, "tolerance": self.tolerance,
+            "epochs": epochs, "applies": applies, "rollbacks": rollbacks,
+            "calibrated": self.model.calibrated(),
+            "knobs": knobs, "default_knobs": KnobSet().to_dict(),
+            "knobs_active": not KnobSet.from_dict(knobs).is_default(),
+            "predicted_vs_measured": self.model.prediction_error(),
+            "model": self.model.stats(),
+            "e2e_ewma": e2e,
+            "journal": journal,
+        }
+
+    def to_dict(self) -> Dict[str, Any]:
+        # snapshot the model OUTSIDE our lock: it takes its own (single
+        # consistent lock order — model never calls back into the tuner)
+        model = self.model.to_dict()
+        with self._lock:
+            knob_ref = self.knobs
+            out = {"version": 1, "every": self.every,
+                   "tolerance": self.tolerance,
+                   "applies": self.applies, "rollbacks": self.rollbacks,
+                   "epochs": self.epochs,
+                   "journal": list(self.journal),
+                   "model": model}
+        out["knobs"] = knob_ref.to_dict()
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], fused=None, controller=None,
+                  executor=None) -> "Tuner":
+        t = cls(fused=fused, controller=controller, executor=executor,
+                model=SegmentCostModel.from_dict(d.get("model") or {}),
+                every=int(d.get("every", 0)),
+                tolerance=float(d.get("tolerance", 0.05)))
+        t.knobs = KnobSet.from_dict(d.get("knobs") or {})
+        t.applies = int(d.get("applies", 0))
+        t.rollbacks = int(d.get("rollbacks", 0))
+        t.epochs = int(d.get("epochs", 0))
+        t.journal = list(d.get("journal") or [])
+        return t

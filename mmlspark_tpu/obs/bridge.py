@@ -109,6 +109,26 @@ def _ingest_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
             occ.add(f, {"stat": stat})
     if occ.samples:
         yield occ
+    # per-bucket pad-waste (the measured term of the auto-tuner's bucket
+    # chooser): fraction of each static bucket's rows that were padding
+    padding = summary.get("padding") or {}
+    ratio = MetricFamily(
+        "mmlspark_batch_pad_ratio", "gauge",
+        "pad rows / bucket rows per static shape bucket (0 = no waste)")
+    padded = MetricFamily(
+        "mmlspark_batch_pad_rows_total", "counter",
+        "padded (static) rows shipped per shape bucket")
+    for bucket, rec in padding.items():
+        f = _num(rec.get("pad_ratio"))
+        if f is not None:
+            ratio.add(f, {"bucket": str(bucket)})
+        f = _num(rec.get("padded"))
+        if f is not None:
+            padded.add(f, {"bucket": str(bucket)})
+    if ratio.samples:
+        yield ratio
+    if padded.samples:
+        yield padded
 
 
 def _fusion_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
@@ -128,6 +148,17 @@ def _fusion_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
     if rate is not None:
         yield MetricFamily("mmlspark_compile_cache_hit_rate", "gauge",
                            "hits / (hits + misses)").add(rate)
+    ev = _num(cache.get("evictions"))
+    if ev is not None:
+        yield MetricFamily(
+            "mmlspark_segment_cache_evictions_total", "counter",
+            "fused executables dropped by the CompileCache's LRU bound"
+        ).add(ev)
+    cap = _num(cache.get("capacity"))
+    if cap is not None:
+        yield MetricFamily(
+            "mmlspark_segment_cache_capacity", "gauge",
+            "configured CompileCache entry cap").add(cap)
     nseg = _num(stats.get("n_fused_segments"))
     if nseg is not None:
         yield MetricFamily("mmlspark_fused_segments", "gauge",
@@ -231,6 +262,68 @@ def _tenant_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
     yield shed
 
 
+def _tuner_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
+    """Auto-tuner telemetry (core/tune.py Tuner.stats()): lifecycle
+    counters, calibration state, the numeric knobs in force, and the
+    per-(segment, bucket) predicted-vs-measured error the perf_report
+    renders (naming per docs/autotune.md / the H002 conventions)."""
+    for key, mtype, help in (
+            ("epochs", "counter", "batches the tuner has observed"),
+            ("applies", "counter", "knob sets applied"),
+            ("rollbacks", "counter",
+             "one-step rollbacks after a measured regression")):
+        f = _num(stats.get(key))
+        if f is not None:
+            yield MetricFamily(f"mmlspark_tuner_{key}_total", mtype,
+                               help).add(f)
+    yield MetricFamily(
+        "mmlspark_tuner_calibrated", "gauge",
+        "1 once measured data backs the cost model (knobs may move)").add(
+            1.0 if stats.get("calibrated") else 0.0)
+    yield MetricFamily(
+        "mmlspark_tuner_knobs_active", "gauge",
+        "1 while a non-default knob set is applied").add(
+            1.0 if stats.get("knobs_active") else 0.0)
+    knobs = stats.get("knobs") or {}
+    knob = MetricFamily("mmlspark_tuner_knob", "gauge",
+                        "numeric knob values currently applied")
+    for name in ("window_seed_ms", "inflight", "replicas"):
+        f = _num(knobs.get(name))
+        if f is not None:
+            knob.add(f, {"knob": name})
+    if knob.samples:
+        yield knob
+    conf = MetricFamily("mmlspark_tuner_confidence", "gauge",
+                        "cost-model calibration confidence per segment")
+    for seg, v in ((stats.get("model") or {}).get("confidence")
+                   or {}).items():
+        f = _num(v)
+        if f is not None:
+            conf.add(f, {"segment": seg})
+    if conf.samples:
+        yield conf
+    pred = MetricFamily(
+        "mmlspark_tuner_predicted_ms", "gauge",
+        "analytical cost-model batch prediction per (segment, bucket)")
+    meas = MetricFamily(
+        "mmlspark_tuner_measured_ms", "gauge",
+        "measured batch EWMA per (segment, bucket)")
+    err = MetricFamily(
+        "mmlspark_tuner_prediction_error_ratio", "gauge",
+        "measured / analytical-predicted batch time (1.0 = exact)")
+    for seg, buckets in (stats.get("predicted_vs_measured") or {}).items():
+        for bucket, rec in buckets.items():
+            labels = {"segment": seg, "bucket": str(bucket)}
+            for fam, key in ((pred, "analytic_ms"), (meas, "measured_ms"),
+                             (err, "error_ratio")):
+                f = _num(rec.get(key))
+                if f is not None:
+                    fam.add(f, labels)
+    for fam in (pred, meas, err):
+        if fam.samples:
+            yield fam
+
+
 def fold_server(registry: MetricsRegistry, server: Any) -> None:
     """Register collectors reading a ServingServer's live stats surfaces:
     LatencyStats window + shed counters, the admission queue, wire-format
@@ -260,6 +353,11 @@ def fold_server(registry: MetricsRegistry, server: Any) -> None:
             try:
                 fams.extend(_executor_families(server._executor.stats()))
             except Exception:  # noqa: BLE001 — executor mid-shutdown
+                pass
+        if getattr(server, "_tuner", None) is not None:
+            try:
+                fams.extend(_tuner_families(server._tuner.stats()))
+            except Exception:  # noqa: BLE001 — tuner mid-refit
                 pass
         if server.ingest_stats is not None:
             try:

@@ -278,7 +278,15 @@ class TimeIntervalBatcher:
 
 
 def next_bucket(n: int, buckets: Optional[Sequence[int]] = None, multiple: int = 8) -> int:
-    """Smallest allowed static size >= n. Default: next power of two >= max(n, multiple)."""
+    """Smallest allowed static size >= n. Default: next power of two >= max(n, multiple).
+
+    ``buckets`` (an ascending bucket SET) overrides the power-of-two policy:
+    this is the knob the cost-model auto-tuner turns (core/costmodel.py
+    ``choose_buckets`` picks a set minimizing measured pad-waste + compile
+    amortization; callers pass it through ``bucket_policy``/``buckets``
+    params). No ``buckets`` = the unchanged static default, so an
+    uncalibrated tuner leaves behavior bitwise-identical.
+    """
     if n <= 0:
         return multiple
     if buckets:
@@ -385,7 +393,9 @@ class Minibatcher:
 
     def __init__(self, batch_size: int = 32, bucket: bool = True,
                  dtype=np.float32, pad_value: float = 0.0,
-                 preserve_int: bool = False):
+                 preserve_int: bool = False,
+                 buckets: Optional[Sequence[int]] = None,
+                 stats=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         self.batch_size = batch_size
@@ -395,6 +405,10 @@ class Minibatcher:
         # preserve_int: integer columns keep their dtype instead of casting to
         # ``dtype`` — token-id inputs must reach embedding Gathers as ints
         self.preserve_int = preserve_int
+        # cost-aware bucket SET (auto-tuner override; None = power-of-two)
+        self.buckets = tuple(sorted(buckets)) if buckets else None
+        # optional IngestStats receiving per-bucket pad-waste accounting
+        self.stats = stats
 
     def _col_dtype(self, col):
         if not self.preserve_int:
@@ -415,12 +429,14 @@ class Minibatcher:
             stop = min(start + self.batch_size, n)
             m = stop - start
             target = self.batch_size if (m == self.batch_size or not self.bucket) \
-                else next_bucket(m)
+                else next_bucket(m, buckets=self.buckets)
             target = min(target, self.batch_size) if m < self.batch_size else target
             arrays = {c: pad_batch(dense[c][start:stop], target, self.pad_value)
                       for c in cols}
             mask = np.zeros(target, dtype=bool)
             mask[:m] = True
+            if self.stats is not None:
+                self.stats.note_padding(target, m)
             yield Batch(arrays, mask, m)
 
     def map_batches(self, part: Partition, cols: Sequence[str],

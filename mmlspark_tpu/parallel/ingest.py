@@ -132,6 +132,10 @@ class BatchTiming:
     ``readback_s`` — device->host fetch of the outputs.
     ``bytes_in`` — wire bytes shipped for this batch.
     ``rows``     — valid rows in the batch.
+    ``padded_rows`` — the static bucket size the batch was padded to (0 =
+                   unpadded/unknown); ``padded_rows - rows`` is pure
+                   pad-waste compute, the cost-model term the bucket
+                   auto-tuner (core/costmodel.py) minimizes.
     """
 
     queue_s: float = 0.0
@@ -141,6 +145,7 @@ class BatchTiming:
     readback_s: float = 0.0
     bytes_in: int = 0
     rows: int = 0
+    padded_rows: int = 0
 
 
 class IngestStats:
@@ -159,9 +164,22 @@ class IngestStats:
         self._occ_sum: int = 0
         self._occ_n: int = 0
         self._occ_max: int = 0
+        # pad-waste per bucket: {padded size: [batches, real rows]} — the
+        # measured term behind mmlspark_batch_pad_ratio{bucket=} and the
+        # cost model's bucket chooser (assumed-waste becomes measured-waste)
+        self._pad: Dict[int, List[int]] = {}
 
     def record(self, t: BatchTiming) -> None:
         self.records.append(t)
+        if t.padded_rows > 0:
+            self.note_padding(t.padded_rows, t.rows)
+
+    def note_padding(self, bucket: int, rows: int) -> None:
+        """Count one batch padded to ``bucket`` static rows with ``rows``
+        real ones (callable directly by batchers outside the ring)."""
+        acc = self._pad.setdefault(int(bucket), [0, 0])
+        acc[0] += 1
+        acc[1] += int(rows)
 
     def add_wall(self, seconds: float) -> None:
         self.wall_s += seconds
@@ -183,14 +201,40 @@ class IngestStats:
         self._occ_sum += other._occ_sum
         self._occ_n += other._occ_n
         self._occ_max = max(self._occ_max, other._occ_max)
+        for bucket, (batches, rows) in other._pad.items():
+            acc = self._pad.setdefault(bucket, [0, 0])
+            acc[0] += batches
+            acc[1] += rows
 
     @property
     def num_batches(self) -> int:
         return len(self.records)
 
+    def _pad_summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        padding: Dict[str, Any] = {}
+        tot_real = tot_padded = 0
+        for bucket in sorted(self._pad):
+            batches, real = self._pad[bucket]
+            padded = batches * bucket
+            tot_real += real
+            tot_padded += padded
+            padding[str(bucket)] = {
+                "batches": batches, "rows": real, "padded": padded,
+                # fraction of the bucket's compute spent on pad rows
+                "pad_ratio": round(1 - real / padded, 4) if padded
+                else None}
+        out["padding"] = padding
+        if tot_padded:
+            out["pad_ratio"] = round(1 - tot_real / tot_padded, 4)
+        return out
+
     def summary(self) -> Dict[str, Any]:
         if not self.records:
-            return {"n_batches": 0}
+            out = {"n_batches": 0}
+            if self._pad:
+                out.update(self._pad_summary())
+            return out
         cols = {f: float(sum(getattr(r, f) for r in self.records))
                 for f in ("queue_s", "h2d_s", "dispatch_s", "compute_s",
                           "readback_s")}
@@ -216,6 +260,8 @@ class IngestStats:
                 out["ring_occupancy_mean"] = round(
                     self._occ_sum / self._occ_n, 4)
                 out["ring_occupancy_max"] = self._occ_max
+        if self._pad:
+            out.update(self._pad_summary())
         for f, v in cols.items():
             out[f] = round(v, 6)
             out[f"{f[:-2]}_ms_per_batch"] = round(v / n * 1e3, 4)
@@ -277,6 +323,19 @@ def _tree_rows(item: Any) -> int:
     return 0
 
 
+def _tree_padded(item: Any) -> int:
+    """Padded (bucket) size of a batch: ``len(mask)`` of a
+    parallel.batching.Batch (mask length == static batch size), 0 when the
+    item carries no padding information (raw arrays are unpadded)."""
+    mask = getattr(item, "mask", None)
+    if mask is not None and getattr(item, "num_valid", None) is not None:
+        try:
+            return int(len(mask))
+        except TypeError:
+            return 0
+    return 0
+
+
 def _tree_nbytes(item: Any) -> int:
     """Total nbytes of arrays inside an arbitrary batch structure."""
     if hasattr(item, "nbytes"):
@@ -304,7 +363,8 @@ def timed_stage(put: Optional[Callable], item: Any,
     transform thread because this often runs on the ring's producer thread,
     which does not inherit the contextvar. When set, the H2D transfer is
     recorded as an ``h2d`` span on every traced request in the batch."""
-    timing = BatchTiming(bytes_in=_tree_nbytes(item), rows=_tree_rows(item))
+    timing = BatchTiming(bytes_in=_tree_nbytes(item), rows=_tree_rows(item),
+                         padded_rows=_tree_padded(item))
     t_wall = time.time()
     t0 = time.perf_counter()
     # chaos seam: an injected delay here shows up in h2d_s (slow link), an

@@ -98,11 +98,30 @@ class AdaptiveBatchController:
         self._rows: Optional[float] = None
         self._depth: float = 0.0
         self._updates = 0
+        self._seeded = False
         self._lock = threading.Lock()
 
     def window_ms(self) -> float:
         with self._lock:
             return self._wait
+
+    def seed_compute_ms(self, compute_ms: float) -> None:
+        """Model-informed cold start (core/tune.py Tuner): seed the compute
+        EWMA with the cost model's predicted per-batch compute so the first
+        windows are sized from a prediction instead of the ``init_wait_ms``
+        guess. A seed never overrides MEASURED state: once observe() has
+        run, it only re-anchors the EWMA blend."""
+        with self._lock:
+            self._seeded = True
+            if self._compute_ms is None:
+                self._compute_ms = float(compute_ms)
+                if self._rows is not None and self._rows > self.solo_rows:
+                    w = self.alpha * self._compute_ms - (self._queue_ms or 0.0)
+                    self._wait = min(self.max_wait_ms,
+                                     max(self.min_wait_ms, w))
+            else:
+                self._compute_ms = self._ewma(self._compute_ms,
+                                              float(compute_ms))
 
     def _ewma(self, prev: Optional[float], x: float) -> float:
         return x if prev is None else (1 - self.ewma) * prev + self.ewma * x
@@ -124,6 +143,9 @@ class AdaptiveBatchController:
             self._wait = min(self.max_wait_ms, max(self.min_wait_ms, w))
 
     def state(self) -> Dict[str, Any]:
+        """Live controller state for /_mmlspark/stats: the tuned window AND
+        the governing knobs (alpha/min/max), so a running server's batching
+        configuration is inspectable, not constructor-only."""
         with self._lock:
             rnd = lambda v: None if v is None else round(v, 4)  # noqa: E731
             return {"wait_ms": round(self._wait, 4),
@@ -134,7 +156,11 @@ class AdaptiveBatchController:
                         None if self._compute_ms is None
                         else self.alpha * self._compute_ms),
                     "depth_ewma": round(self._depth, 3),
-                    "alpha": self.alpha, "updates": self._updates}
+                    "alpha": self.alpha,
+                    "min_wait_ms": self.min_wait_ms,
+                    "max_wait_ms": self.max_wait_ms,
+                    "seeded": self._seeded,
+                    "updates": self._updates}
 
 
 # ---------------------------------------------------------------------------
@@ -265,6 +291,9 @@ class PipelinedExecutor:
         self._submit_q: "queue_mod.Queue" = queue_mod.Queue()
         self._ready_q: "queue_mod.Queue" = queue_mod.Queue()
         self._slots = threading.Semaphore(self.inflight)
+        # pending slot reductions (set_inflight shrink): consumed at release
+        # time instead of blocking the caller on a semaphore acquire
+        self._shrink = 0
         self._stop = server._stop
         self._lock = threading.Lock()
         self._seq = 0
@@ -310,6 +339,35 @@ class PipelinedExecutor:
         for t in self.threads:
             if t.name.endswith("-readback"):
                 t.join(timeout=timeout)
+
+    # -- live knobs ------------------------------------------------------
+    def set_inflight(self, n: int) -> None:
+        """Re-bound the in-flight depth live (the auto-tuner's knob,
+        core/tune.py). Growth releases permits immediately; shrink takes
+        effect as in-flight batches complete (their releases are consumed
+        instead of returned), so the hot path never blocks on a resize."""
+        n = max(1, int(n))
+        grow = 0
+        with self._lock:
+            delta = n - self.inflight
+            if delta == 0:
+                return
+            self.inflight = n
+            if delta > 0:
+                cancel = min(self._shrink, delta)
+                self._shrink -= cancel
+                grow = delta - cancel
+            else:
+                self._shrink += -delta
+        for _ in range(grow):
+            self._slots.release()
+
+    def _release_slot(self) -> None:
+        with self._lock:
+            if self._shrink > 0:
+                self._shrink -= 1
+                return
+        self._slots.release()
 
     # -- bookkeeping -----------------------------------------------------
     def _mark(self, stage: str, seq: int, t0: float, t1: float,
@@ -396,7 +454,7 @@ class PipelinedExecutor:
             prep = srv._prepare_batch(batch)
             t_p1 = time.perf_counter()
             if prep is None:  # every request expired while queued
-                self._slots.release()
+                self._release_slot()
                 self._exit_pipe()
                 continue
             with self._lock:
@@ -418,7 +476,7 @@ class PipelinedExecutor:
             # the batch sat staged gets its 504 NOW, pre-dispatch
             prep = srv._regate_inflight(prep)
             if prep is None:
-                self._slots.release()
+                self._release_slot()
                 self._exit_pipe()
                 continue
             t_w0 = time.time()
@@ -469,12 +527,13 @@ class PipelinedExecutor:
                 self.epochs += 1
             self._mark("readback", prep.seq, t0, t1)
             srv._trace_batch("readback", prep, t_w0, t1 - t0)
-            self._slots.release()
+            self._release_slot()
             self._exit_pipe()
             if self.controller is not None:
                 self.controller.observe(compute_s + (t1 - t0), prep.queue_s,
                                         prep.n, srv._queue.qsize())
             srv._maybe_commit_epochs()
+            srv._tuner_tick(prep.queue_s + compute_s + (t1 - t0))
 
     # -- stats surface (/_mmlspark/stats "async" section) ----------------
     def stats(self) -> Dict[str, Any]:

@@ -272,7 +272,10 @@ class ServingServer:
                  max_queue: int = 0, drain_timeout_s: float = 5.0,
                  async_exec: bool = False, inflight: int = 2,
                  replicas: int = 1, adaptive_batching: bool = True,
+                 batch_alpha: float = 0.5, batch_min_wait_ms: float = 0.0,
+                 batch_max_wait_ms: Optional[float] = None,
                  devices: Optional[list] = None, controller=None,
+                 tuner=None,
                  obs: bool = True, tracer: Optional[Tracer] = None,
                  trace_sample_rate: float = 1.0,
                  http_mode: str = "thread",
@@ -321,8 +324,21 @@ class ServingServer:
         self.inflight = max(1, int(inflight))
         self.replicas = max(1, int(replicas))
         self.adaptive_batching = bool(adaptive_batching)
+        # adaptive-controller knobs (previously constructor-only defaults on
+        # AdaptiveBatchController, invisible at runtime): target queue/
+        # compute ratio and the window clamp — live values surface in
+        # /_mmlspark/stats async.controller
+        self.batch_alpha = float(batch_alpha)
+        self.batch_min_wait_ms = float(batch_min_wait_ms)
+        self.batch_max_wait_ms = batch_max_wait_ms
         self._devices = devices
         self._controller = controller
+        # cost-model auto-tuner (core/tune.py): when set, both serving
+        # loops tick it per batch (refit/apply every tuner.every batches,
+        # one-step rollback on measured e2e regression); its state is the
+        # ``tuner`` section of /_mmlspark/stats and the mmlspark_tuner_*
+        # families. serve_pipeline(autotune=...) wires it for fused models.
+        self._tuner = tuner
         self._executor = None
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         # wake latch: set on every enqueue and on stop(), so the batcher's
@@ -451,6 +467,11 @@ class ServingServer:
                                    "bytes": dict(self.wire_bytes)}
             if self._tenants is not None:
                 summary["tenants"] = self._tenants.summary()
+            if self._tuner is not None:
+                try:
+                    summary["tuner"] = self._tuner.stats()
+                except Exception as e:  # noqa: BLE001
+                    summary["tuner"] = {"error": str(e)}
             if self._aio is not None:
                 summary["http"] = self._aio.stats()
             if self._slo is not None:
@@ -917,6 +938,7 @@ class ServingServer:
             if not batch:
                 continue
             tw, tp = time.time(), time.perf_counter()
+            t_b0 = tp
             prep = self._prepare_batch(batch)
             if prep is None:
                 continue
@@ -940,6 +962,18 @@ class ServingServer:
                 self._trace_batch("readback", prep, tw,
                                   time.perf_counter() - tp)
             self._maybe_commit_epochs()
+            self._tuner_tick(prep.queue_s + time.perf_counter() - t_b0)
+
+    def _tuner_tick(self, e2e_s: float) -> None:
+        """Per-batch auto-tuner heartbeat — shared by the sync loop and the
+        pipelined executor's readback thread. No-op without a tuner; a
+        tuner failure degrades to untuned serving, never a dead loop."""
+        if self._tuner is None:
+            return
+        try:
+            self._tuner.on_epoch(e2e_s)
+        except Exception:  # noqa: BLE001 — tuning must never kill serving
+            pass
 
     def _maybe_commit_epochs(self, force: bool = False) -> None:
         """Commit every epoch whose requests are all answered or abandoned
@@ -1078,9 +1112,15 @@ class ServingServer:
 
             ctrl = self._controller
             if ctrl is None and self.adaptive_batching:
+                max_wait = self.batch_max_wait_ms \
+                    if self.batch_max_wait_ms is not None \
+                    else max(self.max_wait_ms * 4, 50.0)
                 ctrl = AdaptiveBatchController(
+                    alpha=self.batch_alpha,
+                    min_wait_ms=self.batch_min_wait_ms,
                     init_wait_ms=self.max_wait_ms,
-                    max_wait_ms=max(self.max_wait_ms * 4, 50.0))
+                    max_wait_ms=max_wait)
+                self._controller = ctrl
             self._executor = PipelinedExecutor(
                 self, ReplicaSet(self.transform, n=self.replicas,
                                  devices=self._devices),
@@ -1092,6 +1132,13 @@ class ServingServer:
                                       name=f"{self.name}-batcher")
             t_loop.start()
             self._threads.append(t_loop)
+        if self._tuner is not None:
+            # late-bind the layers the tuner steers: the adaptive window
+            # seed and the live in-flight depth exist only after start()
+            if getattr(self._tuner, "controller", None) is None:
+                self._tuner.controller = self._controller
+            if getattr(self._tuner, "executor", None) is None:
+                self._tuner.executor = self._executor
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -1195,6 +1242,10 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    max_queue: int = 0, fused: bool = False,
                    async_exec: bool = False, inflight: int = 2,
                    replicas: int = 1, adaptive_batching: bool = True,
+                   batch_alpha: float = 0.5,
+                   batch_min_wait_ms: float = 0.0,
+                   batch_max_wait_ms: Optional[float] = None,
+                   autotune: bool = False, tune_every: int = 50,
                    obs: bool = True,
                    trace_sample_rate: float = 1.0,
                    http_mode: str = "thread", wire_binary: bool = True,
@@ -1218,6 +1269,18 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
     the coalescing window self-tunes (``adaptive_batching``). With
     ``fused=True`` the executor additionally splits dispatch from readback
     via the fused pipeline's non-blocking ``transform_submit``.
+
+    ``batch_alpha`` / ``batch_min_wait_ms`` / ``batch_max_wait_ms`` expose
+    the adaptive controller's target ratio and window clamp (previously
+    constructor-only defaults); the live tuned values read back through
+    ``/_mmlspark/stats`` ``async.controller``. ``autotune=True`` (fused
+    pipelines) attaches a cost-model ``Tuner`` (core/tune.py) that refits
+    from measured per-segment stats every ``tune_every`` batches and
+    applies bucket/fuse/window/inflight knobs with journaled decisions and
+    one-step rollback — the ``tuner`` section of ``/_mmlspark/stats`` and
+    the ``mmlspark_tuner_*`` metric families show its state. An
+    uncalibrated tuner changes nothing (cold-start replies are
+    bitwise-identical to static knobs).
 
     ``http_mode="async"`` swaps the thread-per-connection ingress for the
     event-loop transport (serving/aio.py: keep-alive pooling, pipelined
@@ -1272,6 +1335,17 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
     if hasattr(stage, "fusion_stats"):
         fusion = stage.fusion_stats
 
+    tuner = None
+    if autotune and hasattr(stage, "set_tuning"):
+        from ..core.costmodel import SegmentCostModel
+        from ..core.tune import Tuner
+
+        model = getattr(stage, "cost_model", None)
+        if model is None:
+            model = SegmentCostModel()
+            stage.set_tuning(cost_model=model)
+        tuner = Tuner(fused=stage, model=model, every=tune_every)
+
     return ServingServer(transform, host=host, port=port, api_path=api_path,
                          reply_col=reply_col, max_batch_size=max_batch_size,
                          max_wait_ms=max_wait_ms, token=token,
@@ -1279,7 +1353,11 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                          fusion_stats=fusion, max_queue=max_queue,
                          async_exec=async_exec, inflight=inflight,
                          replicas=replicas,
-                         adaptive_batching=adaptive_batching, obs=obs,
+                         adaptive_batching=adaptive_batching,
+                         batch_alpha=batch_alpha,
+                         batch_min_wait_ms=batch_min_wait_ms,
+                         batch_max_wait_ms=batch_max_wait_ms,
+                         tuner=tuner, obs=obs,
                          trace_sample_rate=trace_sample_rate,
                          http_mode=http_mode, wire_binary=wire_binary,
                          tenants=tenants, slo=slo,

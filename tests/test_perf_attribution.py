@@ -208,7 +208,8 @@ class TestCompileCacheCosts:
                   label="s", shape="b")
         cache.reset()
         assert cache.costs() == {}
-        assert cache.stats() == {"entries": 0, "hits": 0, "misses": 0,
+        assert cache.stats() == {"entries": 0, "capacity": 256, "hits": 0,
+                                 "misses": 0, "evictions": 0,
                                  "hit_rate": None, "compile_time_s": 0.0}
 
     def test_reset_racing_build_never_mixes_epochs(self):

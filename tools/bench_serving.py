@@ -542,6 +542,253 @@ def _obs_overhead_section(echo, payload, n):
     }
 
 
+def _make_autotune_chain(num_partitions=4, rows=44, seed=0):
+    """The flagship fused image chain (ImageTransformer -> CNN featurizer)
+    over a dataframe whose partitions form SHORT batches (11 rows against a
+    16-row batch size): the power-of-two policy pads every batch to 16
+    (31% pad-waste), which is exactly the measured term the bucket tuner
+    removes. Returns (fused model, cost model, DataFrame, reply column)."""
+    import jax
+
+    from mmlspark_tpu.core.costmodel import SegmentCostModel
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.core.device_stage import CompileCache
+    from mmlspark_tpu.core.fusion import FusedPipelineModel
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    from mmlspark_tpu.core.schema import ImageSchema
+    from mmlspark_tpu.image.featurizer import ImageFeaturizer
+    from mmlspark_tpu.image.stages import ImageTransformer
+    from mmlspark_tpu.models.module import (BatchNorm, Conv2D, Dense,
+                                            FunctionModel, GlobalAvgPool,
+                                            Sequential, relu)
+
+    size = 24
+    mod = Sequential([("conv", Conv2D(8, (3, 3))), ("bn", BatchNorm()),
+                      ("act", relu()), ("pool", GlobalAvgPool()),
+                      ("head", Dense(4))], name="abench")
+    params, _ = mod.init(jax.random.PRNGKey(seed), (size, size, 3))
+    backbone = FunctionModel(mod, params, (size, size, 3),
+                             layer_names=["head", "pool"], name="abench")
+    rng = np.random.default_rng(seed)
+    obj = np.empty(rows, dtype=object)
+    for i in range(rows):
+        obj[i] = ImageSchema.make(
+            rng.integers(0, 256, (32, 32, 3), dtype=np.uint8), f"img{i}")
+    df = DataFrame.from_dict({"image": obj}, num_partitions=num_partitions)
+    pm = PipelineModel([
+        ImageTransformer().resize(size, size).flip(1),
+        ImageFeaturizer(scaleFactor=1 / 255., batchSize=16)
+        .set_model(backbone)])
+    model = SegmentCostModel(min_obs=2)
+    fused = FusedPipelineModel(pm.stages, cache=CompileCache(),
+                               cost_model=model)
+    return fused, model, df, rows
+
+
+def _autotune_section(reps=6):
+    """Static-vs-tuned A/B (the cost-model auto-tuner, core/tune.py), two
+    layers, both PAIRED-interleaved per the PR 7 obs_overhead methodology
+    (alternating rounds in one process — placement luck cancels):
+
+    - ``transform``: the fused image chain end-to-end (images/s), 11-row
+      partitions against a 16-row batch size. Static knobs pad every batch
+      to 16 (pad_ratio 0.3125); the calibrated tuner's bucket set removes
+      the padding, so tuned images/s should beat static by roughly the
+      pad-waste share of compute. This is the deterministic e2e number.
+    - ``serving``: serve_pipeline(fused=True, autotune=True) vs static,
+      single-stream keep-alive bursts alternated between BOTH live servers;
+      the tuned server's every-N-batches loop calibrates from the first
+      bursts (batch-1 requests pad to the 8-row minimum bucket under the
+      static policy; the tuner's set drops them to exact batch-1
+      executables). Server stats prove the knobs engaged (tuner section,
+      controller seed, pad gauges).
+
+    Plus the tuner's own rollback check: an injected measurement regression
+    (FaultInjector seam) must roll knobs back one step.
+    """
+    import urllib.request as _ur
+
+    from mmlspark_tpu.core.tune import Tuner
+    from mmlspark_tpu.serving import serve_pipeline
+
+    out = {}
+
+    # -- transform-level paired A/B --------------------------------------
+    fused, model, df, n_rows = _make_autotune_chain()
+    fused.transform(df)  # compile both the 16-bucket executables
+    tuner = Tuner(fused=fused, model=model)
+
+    def run_once():
+        t0 = time.perf_counter()
+        fused.transform(df)
+        return n_rows / (time.perf_counter() - t0)
+
+    # calibrate: measured stats from warm passes -> refit -> apply
+    run_once()
+    tune_result = tuner.tune(lambda: run_once(), steps=2)
+    tuned_knobs = tuner.stats()["knobs"]
+    static_rates, tuned_rates = [], []
+    for _ in range(reps):
+        fused.set_tuning(buckets={}, fuse={})    # static knobs
+        static_rates.append(run_once())
+        fused.set_tuning(buckets=tuned_knobs.get("buckets") or {},
+                         fuse=tuned_knobs.get("fuse") or {})
+        tuned_rates.append(run_once())
+    pad_static = None
+    fused.set_tuning(buckets={}, fuse={})
+    fused.transform(df)
+    for s in fused._seg_stats.values():
+        pad_static = s.summary().get("pad_ratio")
+    fused.set_tuning(buckets=tuned_knobs.get("buckets") or {},
+                     fuse=tuned_knobs.get("fuse") or {})
+    fused.transform(df)
+    pad_tuned = None
+    for s in fused._seg_stats.values():
+        pad_tuned = s.summary().get("pad_ratio")
+    mean_static = sum(static_rates) / len(static_rates)
+    mean_tuned = sum(tuned_rates) / len(tuned_rates)
+    out["transform"] = {
+        "static_images_s": round(mean_static, 2),
+        "tuned_images_s": round(mean_tuned, 2),
+        "ratio": round(mean_tuned / mean_static, 4) if mean_static else None,
+        "pad_ratio_static": pad_static, "pad_ratio_tuned": pad_tuned,
+        "tuned_knobs": tuned_knobs,
+        "tune_steps": tune_result["steps"], "rounds": reps,
+        "prediction_error": tuner.stats()["predicted_vs_measured"]}
+
+    # -- serving-level paired A/B ----------------------------------------
+    # two live servers over the same fused chain, single-row requests:
+    # the static policy pads batch-1 to the 8-row minimum bucket, the
+    # auto-tuned server calibrates after ``tune_every`` batches and drops
+    # to exact batch-1 executables
+    srv_auto, srv_static, sections = None, None, {}
+    try:
+        srv_auto = _serve_image_chain(autotune=True, tune_every=12)
+        srv_static = _serve_image_chain(autotune=False)
+        img_req = _image_request_body()
+        for s in (srv_auto, srv_static):
+            s.warmup(img_req, sizes=[1, 8])
+        k = 30
+
+        def burst(server):
+            return _measure(f"http://{server.host}:{server.port}/",
+                            img_req, k, warmup=5)["mean_ms"]
+
+        burst(srv_auto), burst(srv_static)  # throwaway: calibrates tuner
+        autos, statics = [], []
+        for _ in range(4):
+            autos.append(burst(srv_auto))
+            statics.append(burst(srv_static))
+        with _ur.urlopen(f"http://{srv_auto.host}:{srv_auto.port}"
+                         f"/_mmlspark/stats", timeout=10) as resp:
+            stats_auto = json.loads(resp.read())
+        tstats = stats_auto.get("tuner") or {}
+        sections = {
+            "static_mean_ms": round(sum(statics) / len(statics), 4),
+            "tuned_mean_ms": round(sum(autos) / len(autos), 4),
+            "qps_ratio": round((sum(statics) / len(statics)) /
+                               (sum(autos) / len(autos)), 4),
+            "tuner_applies": tstats.get("applies"),
+            "tuner_rollbacks": tstats.get("rollbacks"),
+            "tuner_knobs": tstats.get("knobs"),
+            "tuner_calibrated": tstats.get("calibrated"),
+        }
+    finally:
+        for s in (srv_auto, srv_static):
+            if s is not None:
+                s.stop()
+    out["serving"] = sections
+
+    out["note"] = (
+        "paired interleaved rounds in one process (PR 7 obs_overhead "
+        "methodology). transform = the deterministic e2e number: 11-row "
+        "partitions vs batchSize 16, static pow2 buckets pad every batch "
+        "to 16 (pad_ratio 0.3125) and the calibrated bucket set removes "
+        "the padding entirely — on this 1-core CPU container compute "
+        "scales with padded rows, so the ratio is a genuine e2e win, not "
+        "an artifact. serving = single-row requests against live servers "
+        "(static pads batch-1 to the 8-row minimum bucket; the tuned "
+        "server drops to exact batch-1 executables after its every-N "
+        "calibration): HTTP + scheduling noise on a shared core dominates "
+        "the tail, so qps_ratio is reported with the tuner-engagement "
+        "evidence (applies/knobs) rather than as the headline; rtt90/"
+        "overlap behavior is unchanged by tuning (the executor knobs are "
+        "suggestions on a 1-device host).")
+    return out
+
+
+def _image_request_body():
+    """One 32x32x3 uint8 image as the JSON body the image-chain serving
+    transform parses."""
+    import base64
+
+    rng = np.random.default_rng(7)
+    img = rng.integers(0, 256, size=(32, 32, 3), dtype=np.uint8)
+    return json.dumps({"img_b64": base64.b64encode(img.tobytes())
+                       .decode("ascii")}).encode()
+
+
+def _serve_image_chain(autotune, tune_every=12):
+    """serve_pipeline over the fused image chain: JSON body -> image struct
+    -> fused transform -> feature reply. Returns a STARTED server."""
+    import base64
+
+    from mmlspark_tpu.core.schema import ImageSchema
+    from mmlspark_tpu.serving import serve_pipeline
+    from mmlspark_tpu.stages import UDFTransformer
+
+    fused, _, _, _ = _make_autotune_chain(seed=1)
+    in_cols = {"data", "image", "id", "value", "headers", "origin"}
+
+    def decode_rows(col):
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col):
+            raw = np.frombuffer(base64.b64decode(v["img_b64"]),
+                                dtype=np.uint8).reshape(32, 32, 3)
+            out[i] = ImageSchema.make(raw, f"req{i}")
+        return out
+
+    decode = UDFTransformer(inputCol="data", outputCol="image",
+                            vectorizedUdf=decode_rows)
+
+    class _Chain:
+        """decode UDF + fused chain behind one transform, forwarding the
+        fused model's tuning/stats surface so serve_pipeline's autotune
+        wiring (set_tuning / cost_model / _seg_stats / _cache) sees it."""
+
+        def transform(self, df):
+            out = fused.transform(decode.transform(df))
+            feat = next((c for c in out.schema.names
+                         if c not in in_cols), None)
+            if feat is not None and "reply" not in out.schema:
+                out = out.with_column(
+                    "reply",
+                    lambda p, _c=feat: [
+                        None if v is None else np.asarray(v).tolist()
+                        for v in p[_c]])
+            return out
+
+        def set_tuning(self, **kw):
+            fused.set_tuning(**kw)
+
+        cost_model = property(lambda self: fused.cost_model)
+        last_ingest_stats = property(lambda self: fused.last_ingest_stats)
+        _seg_stats = property(lambda self: fused._seg_stats)
+        _cache = property(lambda self: fused._cache)
+        _last_plan = property(lambda self: fused._last_plan)
+
+        def fusion_stats(self):
+            return fused.fusion_stats()
+
+        def has_param(self, name):
+            return False
+
+    srv = serve_pipeline(_Chain(), "data", parse="json", port=0,
+                         max_wait_ms=0.0, autotune=autotune,
+                         tune_every=tune_every)
+    return srv.start()
+
+
 def main():
     import argparse
 
@@ -555,18 +802,26 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
-                    choices=["all", "load_async", "obs_overhead", "wire"],
+                    choices=["all", "load_async", "obs_overhead", "wire",
+                             "autotune"],
                     default="all",
                     help="load_async: run just the overlapped-executor A/B "
                          "section; obs_overhead: just the observability "
                          "on/off A/B; wire: just the JSON-vs-binary frame "
-                         "A/B (merge into an existing artifact)")
+                         "A/B; autotune: just the static-vs-tuned knob A/B "
+                         "(merge into an existing artifact)")
     args = ap.parse_args()
 
     platform = jax.devices()[0].platform
     n = 200 if platform != "cpu" else 50
     n_clients = 16
     duration = 8.0 if platform != "cpu" else 3.0
+
+    if args.only == "autotune":
+        print(json.dumps({
+            "backend": platform,
+            "autotune": _autotune_section()}))
+        return
 
     if args.only == "wire":
         print(json.dumps({

@@ -40,6 +40,7 @@ PACKAGES=(
   "tests/test_analysis.py"
   "tests/test_observability.py"
   "tests/test_perf_attribution.py"
+  "tests/test_autotune.py"
   "tests/test_benchmarks_extended.py"
   "tests/test_multiprocess.py"
   "tests/test_examples.py"

@@ -14,12 +14,17 @@ Three sources:
   python tools/perf_report.py --demo                       # image chain
 
 ``--url`` reads ``/_mmlspark/stats`` (fusion.roofline + segment_costs +
-latency_histogram exemplars + slo). ``--trace`` aggregates ``segment:*``
-spans from a ``Tracer.export_jsonl`` dump (cost attrs ride on the spans).
-``--demo`` builds the image chain the flagship bench measures
-(ImageTransformer -> ImageFeaturizer), runs it fused on this host, and
-prints its table — the zero-setup smoke path. ``--json`` emits the rows as
-one JSON object instead of the table.
+latency_histogram exemplars + slo + tuner). ``--trace`` aggregates
+``segment:*`` spans from a ``Tracer.export_jsonl`` dump (cost attrs ride on
+the spans). ``--demo`` builds the image chain the flagship bench measures
+(ImageTransformer -> ImageFeaturizer), runs it fused on this host WITH a
+cost-model tuner pass, and prints its table — the zero-setup smoke path.
+``--json`` emits the rows as one JSON object instead of the table.
+
+When the server (or demo) carries an auto-tuner (core/tune.py), a second
+section renders the chosen-vs-default knobs and the model's
+predicted-vs-measured error per (segment, bucket) — the honesty check the
+ISSUE's acceptance criteria ask for.
 """
 
 from __future__ import annotations
@@ -29,7 +34,7 @@ import json
 import os
 import sys
 import urllib.request
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 # runnable as `python tools/perf_report.py` on an uninstalled checkout
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -106,6 +111,59 @@ def rows_from_stats(stats: Dict[str, Any]) -> List[Dict[str, Any]]:
     return rows_from_fusion(fusion, hist.get("exemplars"))
 
 
+def render_tuner(tuner: Dict[str, Any]) -> str:
+    """Tuner section: chosen-vs-default knobs + predicted-vs-measured
+    error per (segment, bucket) — from a Tuner.stats() payload."""
+    lines = [
+        f"Tuner: calibrated={tuner.get('calibrated')} "
+        f"applies={tuner.get('applies')} rollbacks={tuner.get('rollbacks')} "
+        f"epochs={tuner.get('epochs')}"]
+    knobs = tuner.get("knobs") or {}
+    default = tuner.get("default_knobs") or {}
+    names = sorted(set(knobs) | set(default) |
+                   {"buckets", "window_seed_ms", "inflight", "replicas"})
+    cells = [["knob", "default", "chosen"]]
+    for name in names:
+        if name == "fuse" and not knobs.get(name):
+            continue
+        chosen = knobs.get(name)
+        if name == "buckets":
+            chosen = "; ".join(f"{k}={v}" for k, v in
+                               sorted((chosen or {}).items())) or \
+                "(power-of-two)"
+            dflt = "(power-of-two)"
+        else:
+            dflt = _fmt(default.get(name, "(static)")) \
+                if name in default else "(static)"
+            chosen = _fmt(chosen)
+        cells.append([name, str(dflt), str(chosen)])
+    widths = [max(len(r[i]) for r in cells) for i in range(3)]
+    for j, row in enumerate(cells):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths))
+                     .rstrip())
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    pvm = tuner.get("predicted_vs_measured") or {}
+    if pvm:
+        lines.append("")
+        cells = [["segment", "bucket", "analytic ms", "measured ms",
+                  "err ratio", "batches"]]
+        for seg, buckets in sorted(pvm.items()):
+            for bucket, rec in sorted(buckets.items(),
+                                      key=lambda kv: int(kv[0])):
+                cells.append([seg, bucket, _fmt(rec.get("analytic_ms")),
+                              _fmt(rec.get("measured_ms")),
+                              _fmt(rec.get("error_ratio")),
+                              _fmt(rec.get("batches"))])
+        widths = [max(len(r[i]) for r in cells) for i in range(len(cells[0]))]
+        for j, row in enumerate(cells):
+            lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths))
+                         .rstrip())
+            if j == 0:
+                lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def rows_from_trace(path: str) -> List[Dict[str, Any]]:
     """Aggregate ``segment:*`` spans from a JSONL trace dump: mean duration
     per segment, the cost attrs the spans carry, and the trace ids seen
@@ -143,18 +201,21 @@ def rows_from_trace(path: str) -> List[Dict[str, Any]]:
     return rows
 
 
-def demo_rows() -> List[Dict[str, Any]]:
+def demo_rows() -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
     """Build + fuse the flagship image chain (the pipeline
-    BENCH_image_e2e.json measures), run it on synthetic images, and
-    attribute it — the zero-setup path to a real table."""
+    BENCH_image_e2e.json measures), run it on synthetic images with a
+    cost-model tuner pass, and attribute it — the zero-setup path to a
+    real table. Returns (segment rows, tuner stats)."""
     import jax
     import numpy as np
 
+    from mmlspark_tpu.core.costmodel import SegmentCostModel
     from mmlspark_tpu.core.dataframe import DataFrame
     from mmlspark_tpu.core.device_stage import CompileCache
     from mmlspark_tpu.core.fusion import FusedPipelineModel
     from mmlspark_tpu.core.pipeline import PipelineModel
     from mmlspark_tpu.core.schema import ImageSchema
+    from mmlspark_tpu.core.tune import Tuner
     from mmlspark_tpu.image.featurizer import ImageFeaturizer
     from mmlspark_tpu.image.stages import ImageTransformer
     from mmlspark_tpu.models.module import (BatchNorm, Conv2D, Dense,
@@ -180,10 +241,16 @@ def demo_rows() -> List[Dict[str, Any]]:
         ImageTransformer().resize(size, size).flip(1),
         ImageFeaturizer(scaleFactor=1 / 255., batchSize=16)
         .set_model(backbone)])
-    fused = FusedPipelineModel(pm.stages, cache=CompileCache())
+    model = SegmentCostModel(min_obs=2)
+    fused = FusedPipelineModel(pm.stages, cache=CompileCache(),
+                               cost_model=model)
     fused.transform(df)       # cold: compiles + records costs
     fused.transform(df)       # warm: the measured pass
-    return rows_from_fusion(fused.fusion_stats())
+    tuner = Tuner(fused=fused, model=model)
+    tuner.refit()
+    tuner.apply(tuner.propose())
+    fused.transform(df)       # tuned pass: measured under applied knobs
+    return rows_from_fusion(fused.fusion_stats()), tuner.stats()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -198,22 +265,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
 
-    slo = None
+    slo = tuner = None
     if args.url:
         url = args.url.rstrip("/") + "/_mmlspark/stats"
         with urllib.request.urlopen(url, timeout=args.timeout) as resp:
             stats = json.loads(resp.read())
         rows = rows_from_stats(stats)
         slo = stats.get("slo")
+        tuner = stats.get("tuner")
     elif args.trace:
         rows = rows_from_trace(args.trace)
     else:
-        rows = demo_rows()
+        rows, tuner = demo_rows()
 
     if args.as_json:
-        print(json.dumps({"segments": rows, "slo": slo}))
+        print(json.dumps({"segments": rows, "slo": slo, "tuner": tuner}))
         return 0
     print(render_table(rows))
+    if tuner:
+        print()
+        print(render_tuner(tuner))
     if slo:
         burns = ", ".join(f"{w}s={rec['burn_rate']}"
                           for w, rec in sorted(
