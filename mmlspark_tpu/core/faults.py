@@ -189,9 +189,19 @@ JOURNAL_WRITE = "journal.write"    # serving/journal entry append
 JOURNAL_COMMIT = "journal.commit"  # serving/journal epoch commit
 TRAIN_STEP = "train.step"          # gbdt boosting iteration / DNN train step
 TUNER_MEASURE = "tuner.measure"    # core/tune Tuner's e2e measurement probe
+# serving/executor replica compute loop, just before dispatch: plan with
+# delay_s + exc=None to wedge a dispatch (the watchdog's deterministic prey)
+WORKER_DISPATCH_HANG = "worker.dispatch_hang"
+# serving/executor replica compute loop: a raising plan simulates a replica
+# process crash mid-dispatch (feeds the supervisor's error scoring)
+WORKER_CRASH = "worker.crash"
+# serving/routing hedge launch (threaded + async fronts): a raising plan
+# suppresses that hedge; fired() observes exactly which requests hedged
+FRONT_HEDGE = "front.hedge"
 
 ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
-              JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE)
+              JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE,
+              WORKER_DISPATCH_HANG, WORKER_CRASH, FRONT_HEDGE)
 
 
 class InjectedFault(OSError):

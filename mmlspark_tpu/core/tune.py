@@ -227,6 +227,24 @@ class Tuner:
                 knobs.replicas = self._replica_suggestion(compute, transfer)
         return knobs
 
+    def predict_batch_ms(self, rows: int) -> Optional[float]:
+        """Predicted wall ms for one serving batch of ``rows`` — the sum of
+        the calibrated segments' batch predictions. None while uncalibrated
+        (the serving watchdog stays on its measured-EWMA fallback). This is
+        the cost-model side of the hung-dispatch budget
+        (serving/supervisor.py DispatchWatchdog)."""
+        total: Optional[float] = None
+        for label in self._segment_batch_caps():
+            if not self.model.calibrated(label):
+                continue
+            try:
+                pred = self.model.predict(label, batch=int(rows))
+            except Exception:  # noqa: BLE001 — prediction must never raise out
+                continue
+            if pred is not None and pred.get("ms") is not None:
+                total = (total or 0.0) + float(pred["ms"])
+        return total
+
     def _replica_suggestion(self, compute_ms: float,
                             transfer_ms: float) -> Optional[int]:
         """Compute-bound segments scale across local devices; transfer-bound

@@ -217,6 +217,82 @@ def _executor_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
     yield reps
     yield util
     yield rows
+    sup = stats.get("supervisor")
+    if sup:
+        for fam in _supervisor_families(sup):
+            yield fam
+    wd = stats.get("watchdog")
+    if wd:
+        for fam in _watchdog_families(wd):
+            yield fam
+
+
+def _supervisor_families(sup: Dict[str, Any]) -> Iterable[MetricFamily]:
+    """Replica supervision (serving/supervisor.py): one-hot health states,
+    decayed health scores, and the eject/readmit lifecycle counters —
+    naming per docs/observability.md (mmlspark_replica_* families)."""
+    state = MetricFamily(
+        "mmlspark_replica_state", "gauge",
+        "one-hot replica health state (healthy/quarantined/probing)")
+    score = MetricFamily("mmlspark_replica_health_score", "gauge",
+                         "decayed per-replica health score in [0, 1]")
+    timeouts = MetricFamily("mmlspark_replica_timeouts_total", "counter",
+                            "wedged dispatches (watchdog expiries) "
+                            "per replica")
+    errors = MetricFamily("mmlspark_replica_errors_total", "counter",
+                          "failed dispatches per replica")
+    outliers = MetricFamily("mmlspark_replica_outliers_total", "counter",
+                            "latency-outlier completions per replica")
+    ejections = MetricFamily("mmlspark_replica_ejections_total", "counter",
+                             "quarantine transitions per replica")
+    readmits = MetricFamily("mmlspark_replica_readmissions_total", "counter",
+                            "probe-success re-admissions per replica")
+    for r in (sup.get("replicas") or []):
+        labels = {"replica": str(r.get("replica"))}
+        for name in ("healthy", "quarantined", "probing"):
+            state.add(1.0 if r.get("state") == name else 0.0,
+                      {**labels, "state": name})
+        for fam, key in ((score, "score"), (timeouts, "timeouts"),
+                         (errors, "errors"), (outliers, "outliers"),
+                         (ejections, "ejections"),
+                         (readmits, "readmissions")):
+            f = _num(r.get(key))
+            if f is not None:
+                fam.add(f, labels)
+    yield state
+    yield score
+    yield timeouts
+    yield errors
+    yield outliers
+    yield ejections
+    yield readmits
+
+
+def _watchdog_families(wd: Dict[str, Any]) -> Iterable[MetricFamily]:
+    trips = MetricFamily(
+        "mmlspark_watchdog_trips_total", "counter",
+        "hung-dispatch watchdog expiries by action "
+        "(requeue = re-dispatched, extend = budget doubled in place, "
+        "abandon = accounted 504)")
+    for key in ("requeues", "abandons"):
+        f = _num(wd.get(key))
+        if f is not None:
+            trips.add(f, {"action": key[:-1]})
+    total = _num(wd.get("trips"))
+    if total is not None:
+        rq = _num(wd.get("requeues")) or 0.0
+        ab = _num(wd.get("abandons")) or 0.0
+        trips.add(max(0.0, total - rq - ab), {"action": "extend"})
+    yield trips
+    yield MetricFamily(
+        "mmlspark_watchdog_armed", "gauge",
+        "1 while the watchdog has a budget source (fixed / cost model / "
+        "measured EWMA)").add(1.0 if wd.get("armed") else 0.0)
+    ew = _num(wd.get("compute_ewma_ms"))
+    if ew is not None:
+        yield MetricFamily(
+            "mmlspark_watchdog_compute_ewma_ms", "gauge",
+            "measured dispatch EWMA feeding the wall budget").add(ew)
 
 
 def _wire_families(server: Any) -> Iterable[MetricFamily]:
@@ -324,6 +400,66 @@ def _tuner_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
             yield fam
 
 
+def _brownout_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
+    """Brownout controller state (serving/supervisor.py): the applied
+    degradation level, whether any step is active, and the transition
+    counters — mmlspark_brownout_* per docs/observability.md."""
+    yield MetricFamily(
+        "mmlspark_brownout_step", "gauge",
+        "applied degradation steps (0 = full service)").add(
+            summary.get("step", 0))
+    yield MetricFamily(
+        "mmlspark_brownout_max_steps", "gauge",
+        "declared degradation ladder depth").add(
+            summary.get("max_steps", 0))
+    yield MetricFamily(
+        "mmlspark_brownout_active", "gauge",
+        "1 while at least one degradation step is applied").add(
+            1.0 if summary.get("active") else 0.0)
+    trans = MetricFamily(
+        "mmlspark_brownout_transitions_total", "counter",
+        "brownout transitions by direction (degrade/restore/rollback)")
+    for direction, n in (summary.get("transitions") or {}).items():
+        f = _num(n)
+        if f is not None:
+            trans.add(f, {"direction": str(direction)})
+    yield trans
+
+
+def _hedge_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
+    """Hedged-request accounting (serving/supervisor.py HedgeTracker):
+    volume by outcome, win attribution, and the live quantile delay —
+    mmlspark_hedge_* per docs/observability.md."""
+    reqs = MetricFamily(
+        "mmlspark_hedge_requests_total", "counter",
+        "hedge-eligible public requests by outcome "
+        "(hedged / suppressed / both_failed)")
+    for key in ("hedged", "suppressed", "both_failed"):
+        f = _num(summary.get(key))
+        if f is not None:
+            reqs.add(f, {"outcome": key})
+    yield reqs
+    wins = MetricFamily(
+        "mmlspark_hedge_wins_total", "counter",
+        "first-response winners by role (primary / hedge)")
+    for role, key in (("primary", "wins_primary"), ("hedge", "wins_hedge")):
+        f = _num(summary.get(key))
+        if f is not None:
+            wins.add(f, {"role": role})
+    yield wins
+    f = _num(summary.get("delay_ms"))
+    if f is not None:
+        yield MetricFamily(
+            "mmlspark_hedge_delay_ms", "gauge",
+            "current hedge trigger delay (the configured quantile of "
+            "observed forward latency)").add(f)
+    f = _num(summary.get("hedge_fraction"))
+    if f is not None:
+        yield MetricFamily(
+            "mmlspark_hedge_fraction", "gauge",
+            "hedged / eligible requests (the duplicate-work bound)").add(f)
+
+
 def fold_server(registry: MetricsRegistry, server: Any) -> None:
     """Register collectors reading a ServingServer's live stats surfaces:
     LatencyStats window + shed counters, the admission queue, wire-format
@@ -358,6 +494,11 @@ def fold_server(registry: MetricsRegistry, server: Any) -> None:
             try:
                 fams.extend(_tuner_families(server._tuner.stats()))
             except Exception:  # noqa: BLE001 — tuner mid-refit
+                pass
+        if getattr(server, "_brownout", None) is not None:
+            try:
+                fams.extend(_brownout_families(server._brownout.summary()))
+            except Exception:  # noqa: BLE001 — brownout mid-transition
                 pass
         if server.ingest_stats is not None:
             try:
@@ -411,6 +552,11 @@ def fold_front(registry: MetricsRegistry, front: Any) -> None:
         for w, c in caps.items():
             cap.add(c, {"worker": w})
         fams.append(cap)
+        if getattr(front, "_hedge", None) is not None:
+            try:
+                fams.extend(_hedge_families(front._hedge.summary()))
+            except Exception:  # noqa: BLE001 — tracker mid-update
+                pass
         return fams
 
     registry.register_collector(collect)

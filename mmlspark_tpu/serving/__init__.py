@@ -17,11 +17,15 @@ from .executor import (AdaptiveBatchController, PipelinedExecutor, Replica,
                        ReplicaSet)
 from .aio import AsyncConnectionPool, AsyncHTTPServer
 from .tenants import TENANT_HEADER, TenantAdmission, tenants_from_spec
+from .supervisor import (BrownoutController, BrownoutStep, DispatchWatchdog,
+                         HedgeConfig, HedgeTracker, ReplicaSupervisor)
 
 __all__ = ["AdaptiveBatchController", "AsyncConnectionPool",
-           "AsyncHTTPServer", "PipelinedExecutor", "PortForwarder",
-           "Replica", "ReplicaSet", "RequestJournal", "RoutingFront",
-           "ServingServer", "TENANT_HEADER", "TenantAdmission",
-           "build_ssh_command", "make_reply", "parse_request",
-           "register_worker", "reply_to", "serve_pipeline",
+           "AsyncHTTPServer", "BrownoutController", "BrownoutStep",
+           "DispatchWatchdog", "HedgeConfig", "HedgeTracker",
+           "PipelinedExecutor", "PortForwarder",
+           "Replica", "ReplicaSet", "ReplicaSupervisor", "RequestJournal",
+           "RoutingFront", "ServingServer", "TENANT_HEADER",
+           "TenantAdmission", "build_ssh_command", "make_reply",
+           "parse_request", "register_worker", "reply_to", "serve_pipeline",
            "tenants_from_spec"]

@@ -358,8 +358,14 @@ class AsyncConnectionPool:
 
     async def request(self, method: str, url: str, body: bytes = b"",
                       headers: Optional[Dict[str, str]] = None,
-                      timeout: Optional[float] = None
-                      ) -> Tuple[int, Headers, bytes]:
+                      timeout: Optional[float] = None,
+                      deadline=None) -> Tuple[int, Headers, bytes]:
+        """``deadline`` (core/faults.Deadline, or any object exposing
+        ``remaining()``): gates the single stale-socket retry — a retry
+        that would start after the request's deadline already lapsed is an
+        answer nobody is waiting for (the caller's ``timeout`` bounds the
+        total wall time either way; the gate makes the expiry an immediate
+        error instead of a doomed second connection)."""
         parts = urlsplit(url)
         host = parts.hostname or "127.0.0.1"
         port = parts.port or (443 if parts.scheme == "https" else 80)
@@ -367,12 +373,13 @@ class AsyncConnectionPool:
         if parts.query:
             path += "?" + parts.query
         return await asyncio.wait_for(
-            self._request((host, port), method, path, body, headers),
+            self._request((host, port), method, path, body, headers,
+                          deadline),
             timeout)
 
     async def _request(self, key: Tuple[str, int], method: str, path: str,
-                       body: bytes, headers: Optional[Dict[str, str]]
-                       ) -> Tuple[int, Headers, bytes]:
+                       body: bytes, headers: Optional[Dict[str, str]],
+                       deadline=None) -> Tuple[int, Headers, bytes]:
         for attempt in (0, 1):
             fresh, (reader, writer) = await self._checkout(key, attempt == 1)
             try:
@@ -392,8 +399,15 @@ class AsyncConnectionPool:
                     _StaleConnection) as e:
                 self._discard(writer)
                 # a reused socket the peer closed while idle: one retry on a
-                # fresh connection; a fresh-connection failure is real
+                # fresh connection; a fresh-connection failure is real —
+                # and the retry must still be worth making: past the
+                # request's X-MMLSpark-Deadline it can only waste a socket
                 if not fresh and attempt == 0:
+                    if deadline is not None and deadline.remaining() <= 0:
+                        raise OSError(
+                            f"connection to {key[0]}:{key[1]} went stale "
+                            f"and the deadline expired before the retry"
+                        ) from e
                     continue
                 raise OSError(f"connection to {key[0]}:{key[1]} failed: {e}"
                               ) from e

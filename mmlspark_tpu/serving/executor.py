@@ -41,16 +41,20 @@ with load).
 from __future__ import annotations
 
 import contextlib
+import logging
 import threading
 import time
 import queue as queue_mod
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from ..core import faults
 from ..obs import trace as obs_trace
 
 __all__ = ["AdaptiveBatchController", "PipelinedExecutor", "Replica",
            "ReplicaSet"]
+
+_LOG = logging.getLogger("mmlspark_tpu.serving")
 
 
 # ---------------------------------------------------------------------------
@@ -104,6 +108,16 @@ class AdaptiveBatchController:
     def window_ms(self) -> float:
         with self._lock:
             return self._wait
+
+    def set_window_clamp(self, max_wait_ms: float) -> float:
+        """Re-bound the window's upper clamp live (the brownout
+        controller's knob): returns the PREVIOUS clamp so the caller can
+        restore it. The current wait is re-clamped immediately."""
+        with self._lock:
+            prev = self.max_wait_ms
+            self.max_wait_ms = max(float(max_wait_ms), self.min_wait_ms)
+            self._wait = min(self._wait, self.max_wait_ms)
+            return prev
 
     def seed_compute_ms(self, compute_ms: float) -> None:
         """Model-informed cold start (core/tune.py Tuner): seed the compute
@@ -205,11 +219,33 @@ class ReplicaSet:
         if not devices:
             devices = [None]
         self.replicas: List[Replica] = []
+        #: placements skipped because replica init raised: (index, device,
+        #: error string) — surfaced in describe()/stats so a degraded start
+        #: is visible, not silent
+        self.placement_failures: List[Dict[str, Any]] = []
         for i in range(max(1, int(n))):
             dev = devices[i % len(devices)]
-            t = transform_factory(i, dev) if transform_factory is not None \
-                else transform
+            # a device that raises at replica init (driver fault, OOM on one
+            # chip) must not fail the whole server start: log, skip it, and
+            # serve on the survivors; raise only when nothing survives
+            try:
+                t = transform_factory(i, dev) \
+                    if transform_factory is not None else transform
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                _LOG.warning(
+                    "replica %d init failed on device %s — placing the "
+                    "remaining replicas without it", i, dev, exc_info=True)
+                self.placement_failures.append(
+                    {"replica": i, "device": str(dev) if dev is not None
+                     else None, "error": str(e)})
+                continue
             self.replicas.append(Replica(i, dev, t))
+        if not self.replicas:
+            raise RuntimeError(
+                "every replica placement failed: "
+                + "; ".join(f"replica {f['replica']} on {f['device']}: "
+                            f"{f['error']}"
+                            for f in self.placement_failures))
 
     def __len__(self) -> int:
         return len(self.replicas)
@@ -283,11 +319,18 @@ class PipelinedExecutor:
 
     def __init__(self, server, replica_set: ReplicaSet,
                  controller: Optional[AdaptiveBatchController] = None,
-                 inflight: int = 2, timeline_cap: int = 512):
+                 inflight: int = 2, timeline_cap: int = 512,
+                 supervisor=None, watchdog=None):
         self.server = server
         self.replicas = replica_set
         self.controller = controller
         self.inflight = max(1, int(inflight))
+        # supervision layer (serving/supervisor.py): per-replica health
+        # scores + quarantine/probe/readmit, and the hung-dispatch watchdog
+        # budget policy. Both optional — absent, the executor behaves
+        # exactly like the unsupervised build.
+        self.supervisor = supervisor
+        self.watchdog = watchdog
         self._submit_q: "queue_mod.Queue" = queue_mod.Queue()
         self._ready_q: "queue_mod.Queue" = queue_mod.Queue()
         self._slots = threading.Semaphore(self.inflight)
@@ -300,6 +343,10 @@ class PipelinedExecutor:
         self.epochs = 0
         self._timeline: "deque" = deque(maxlen=timeline_cap)
         self._busy = {"drain": 0.0, "readback": 0.0}
+        # in-flight dispatch registry for the watchdog scan: replica index
+        # -> [prep, gen, t0, budget_s]; an entry doubles as the completion
+        # claim token — whoever removes it under the lock owns the outcome
+        self._dispatch: Dict[int, list] = {}
         # pipeline-active wall clock: accumulates only while >= 1 batch is in
         # flight, so overlap_ratio is not diluted by idle-server time
         self._active = 0
@@ -318,6 +365,10 @@ class PipelinedExecutor:
                 name=f"{name}-compute-{r.index}"))
         self.threads.append(threading.Thread(
             target=self._readback_loop, daemon=True, name=f"{name}-readback"))
+        if self.watchdog is not None:
+            self.threads.append(threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name=f"{name}-watchdog"))
         for t in self.threads:
             t.start()
         return self
@@ -337,7 +388,7 @@ class PipelinedExecutor:
                 t.join(timeout=timeout)
         self._ready_q.put(_SENTINEL)
         for t in self.threads:
-            if t.name.endswith("-readback"):
+            if t.name.endswith("-readback") or t.name.endswith("-watchdog"):
                 t.join(timeout=timeout)
 
     # -- live knobs ------------------------------------------------------
@@ -468,7 +519,19 @@ class PipelinedExecutor:
     # -- stage 2: compute (one worker per replica) -----------------------
     def _compute_loop(self, replica: Replica) -> None:
         srv = self.server
+        sup = self.supervisor
         while True:
+            if sup is not None and not sup.admitted(replica.index):
+                # quarantined: no submit-queue pulls until a probe succeeds
+                if self._stop.is_set():
+                    return
+                if sup.probe_due(replica.index):
+                    sup.begin_probe(replica.index)
+                    sup.note_probe(replica.index,
+                                   sup.run_probe(replica))
+                else:
+                    time.sleep(0.005)
+                continue
             prep = self._submit_q.get()
             if prep is _SENTINEL:
                 return
@@ -481,8 +544,21 @@ class PipelinedExecutor:
                 continue
             t_w0 = time.time()
             t0 = time.perf_counter()
+            budget = None
+            if self.watchdog is not None:
+                budget = self.watchdog.budget_s(prep.n)
+            with self._lock:
+                gen = prep.wd_gen
+                self._dispatch[replica.index] = [prep, gen, t0, budget]
             pending = out = err = None
             try:
+                # chaos seams: a delay plan on WORKER_DISPATCH_HANG wedges
+                # this dispatch (the watchdog's prey); a raising plan on
+                # WORKER_CRASH simulates the replica dying mid-dispatch
+                faults.fire(faults.WORKER_DISPATCH_HANG,
+                            replica=replica.index, seq=prep.seq)
+                faults.fire(faults.WORKER_CRASH,
+                            replica=replica.index, seq=prep.seq)
                 # batch_context: traced requests visible to the H2D staging
                 # and fused-segment layers under this dispatch
                 with obs_trace.batch_context(srv.tracer,
@@ -494,13 +570,109 @@ class PipelinedExecutor:
                 err = e
             t1 = time.perf_counter()
             with self._lock:
+                # completion claim: if the watchdog already expired this
+                # dispatch (gen bumped, registry entry gone), the result is
+                # STALE — the re-dispatched copy owns the slot and replies
+                live = prep.wd_gen == gen and \
+                    self._dispatch.pop(replica.index, [None, -1])[1] == gen
                 replica.busy_s += t1 - t0
-                replica.batches += 1
-                replica.rows += prep.n
+                if live:
+                    replica.batches += 1
+                    replica.rows += prep.n
+            if sup is not None:
+                if err is not None:
+                    sup.note_failure(replica.index)
+                else:
+                    sup.note_success(replica.index, t1 - t0)
+            if not live:
+                # late return of a wedged dispatch: discard the result; the
+                # supervisor's probe path decides re-admission from here
+                self._mark("stale", prep.seq, t0, t1, replica.index)
+                continue
+            if err is None and self.watchdog is not None:
+                self.watchdog.observe(t1 - t0)
             self._mark("compute", prep.seq, t0, t1, replica.index)
             srv._trace_batch("dispatch", prep, t_w0, t1 - t0,
                              replica=replica.index)
             self._ready_q.put((prep, pending, out, err, t1 - t0))
+
+    # -- hung-dispatch watchdog ------------------------------------------
+    def _watchdog_loop(self) -> None:
+        wd = self.watchdog
+        while not self._stop.wait(wd.poll_s):
+            self._watchdog_scan()
+
+    def _watchdog_scan(self, now: Optional[float] = None) -> None:
+        """One watchdog pass over the in-flight dispatch registry. A
+        dispatch past its wall budget is WEDGED: claim it (bump the prep's
+        generation so the stuck thread's eventual return is discarded),
+        quarantine the replica, and either re-dispatch the batch on a
+        healthy peer or — when none exists — double the budget in place a
+        few times before abandoning with an accounted 504. Exposed with a
+        ``now`` override so chaos tests can drive scans deterministically."""
+        wd = self.watchdog
+        if now is None:
+            now = time.perf_counter()
+        requeue, extend, abandon = [], [], []
+        with self._lock:
+            for idx, entry in list(self._dispatch.items()):
+                prep, gen, t0, budget = entry
+                if budget is None or now - t0 <= budget:
+                    continue
+                if prep.wd_gen != gen:
+                    continue
+                peers = len(self.replicas.replicas) - 1 \
+                    if self.supervisor is None \
+                    else self.supervisor.healthy_peers(idx)
+                if peers > 0 and prep.wd_tries < wd.max_redispatch:
+                    prep.wd_gen += 1
+                    prep.wd_tries += 1
+                    del self._dispatch[idx]
+                    requeue.append((idx, prep))
+                elif prep.wd_expiries + 1 < wd.abandon_after:
+                    # no healthy peer: keep waiting with a doubled budget —
+                    # a long first-compile must not become a false 504
+                    prep.wd_expiries += 1
+                    entry[3] = budget * 2.0
+                    entry[2] = now
+                    extend.append(idx)
+                else:
+                    prep.wd_gen += 1
+                    del self._dispatch[idx]
+                    abandon.append((idx, prep))
+        for idx, prep in requeue:
+            # supervisor/journal work OUTSIDE the executor lock
+            if self.supervisor is not None:
+                self.supervisor.note_wedged(idx)
+            wd.note_trip("requeue")
+            _LOG.warning("dispatch on replica %d wedged (seq %d): "
+                         "re-dispatching on a healthy replica", idx, prep.seq)
+            self._submit_q.put(prep)
+        for idx in extend:
+            wd.note_trip("extend")
+        for idx, prep in abandon:
+            if self.supervisor is not None:
+                self.supervisor.note_wedged(idx)
+            wd.note_trip("abandon")
+            _LOG.warning("dispatch on replica %d wedged (seq %d) with no "
+                         "healthy peer: abandoning batch with 504s",
+                         idx, prep.seq)
+            self._abandon(prep)
+
+    def _abandon(self, prep) -> None:
+        """Answer every request of a wedged batch 504 with an accounted
+        reason, release its slot, and sweep the journal — the batch's epoch
+        commits once the abandoned slots are popped (at-least-once: a crash
+        before this point replays the batch, which is the contract)."""
+        srv = self.server
+        for rid in prep.ids:
+            srv.stats.record_shed(504, "watchdog_abandoned")
+            srv._fulfill(int(rid), 504,
+                         b'{"error": "dispatch watchdog expired"}',
+                         content_type="application/json")
+        self._release_slot()
+        self._exit_pipe()
+        srv._maybe_commit_epochs()
 
     # -- stage 3: readback / fulfill -------------------------------------
     def _readback_loop(self) -> None:
@@ -547,9 +719,20 @@ class PipelinedExecutor:
             active = self._active
         compute_s = sum(r.busy_s for r in self.replicas.replicas)
         serial = drain_s + compute_s + readback_s
+        supervisor = None
+        if self.supervisor is not None:
+            supervisor = self.supervisor.summary()
+        watchdog = None
+        if self.watchdog is not None:
+            watchdog = self.watchdog.summary()
         return {
             "mode": "pipelined",
             "inflight": self.inflight,
+            # supervision layer (serving/supervisor.py): per-replica health
+            # states + watchdog trip counters; None when supervision is off
+            "supervisor": supervisor,
+            "watchdog": watchdog,
+            "placement_failures": self.replicas.placement_failures or None,
             # batches currently past drain and not yet fulfilled: the live
             # slot occupancy (== inflight means the pipeline is saturated
             # — the perf-attribution companion to the ring gauges)

@@ -24,10 +24,11 @@ from __future__ import annotations
 
 import itertools
 import json
+import queue as queue_mod
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.error import HTTPError, URLError
 from urllib.parse import urlsplit
 from urllib.request import Request, urlopen
@@ -89,7 +90,7 @@ class RoutingFront:
                  probe_policy: Optional[RetryPolicy] = None,
                  obs: bool = True, tracer: Optional[Tracer] = None,
                  trace_sample_rate: float = 1.0,
-                 http_mode: str = "thread", slo=None):
+                 http_mode: str = "thread", slo=None, hedge=None):
         self.host = host
         self.port = port
         self.forward_timeout_s = forward_timeout_s
@@ -109,6 +110,14 @@ class RoutingFront:
         self.http_mode = http_mode
         self._aio = None
         self._pool = None  # AsyncConnectionPool (async mode, loop thread)
+        # hedged requests ("The Tail at Scale"): after a quantile of the
+        # observed forward-latency distribution, re-issue the request to a
+        # second worker, first response wins (serving/supervisor.py
+        # HedgeTracker). None = off (the default — hedging deliberately
+        # double-dispatches, so it is opt-in for idempotent transforms).
+        from .supervisor import make_hedge
+
+        self._hedge = make_hedge(hedge)
         # probe backoff: open workers are re-probed on a jittered exponential
         # schedule (deterministic when the policy is seeded)
         self.probe_policy = probe_policy or RetryPolicy(
@@ -274,6 +283,146 @@ class RoutingFront:
                             self.probe_policy.next_wait(
                                 c.probe_attempt, self._probe_rng)
 
+    # -- forwarding helpers (threaded transport) -----------------------------
+    def _worker_url(self, addr: str, incoming, path: str) -> str:
+        """Resolve the worker-side URL for one forward: "/" routes to the
+        worker's registered api path; any other path+query forwards
+        verbatim (proxy semantics)."""
+        parts = urlsplit(addr)
+        wpath = parts.path if path in ("", "/") else incoming.path
+        query = f"?{incoming.query}" if incoming.query else ""
+        return f"{parts.scheme}://{parts.netloc}{wpath or '/'}{query}"
+
+    def _forward_once(self, addr: str, method: str, url: str, path: str,
+                      headers: Dict[str, str], body: bytes,
+                      timeout: float, tctx) -> Tuple[str, Any]:
+        """One forward attempt over urlopen, with circuit-breaker notes and
+        the per-attempt forward span. Returns ``(kind, payload)``:
+
+          - ``"response"`` — payload = (status, body, content_type): the
+            worker answered (any status — authoritative, never retried);
+          - ``"timeout"``  — payload = error string: the request may have
+            REACHED the worker (read timeout — replay only when safe);
+          - ``"error"``    — payload = error string: the request never
+            arrived (connect refused/reset — safe to replay elsewhere).
+        """
+        fwd = None
+        hdrs = dict(headers)
+        if tctx is not None:
+            if tctx.sampled:
+                fwd = self.tracer.child(tctx)
+            hdrs[TRACE_HEADER] = (fwd or tctx).to_header()
+        req = Request(url, data=body if body else None, method=method,
+                      headers=hdrs)
+        t_f0w, t_f0 = time.time(), time.perf_counter()
+
+        def fwd_span(**attrs):
+            if fwd is not None:
+                self.tracer.record("forward", fwd, t_f0w,
+                                   time.perf_counter() - t_f0,
+                                   worker=addr, **attrs)
+
+        try:
+            faults.fire(faults.WORKER_FORWARD, addr=addr, path=path)
+            with urlopen(req, timeout=timeout) as resp:
+                self._note_success(addr)
+                fwd_span(status=resp.status)
+                return ("response", (resp.status, resp.read(),
+                                     resp.headers.get("Content-Type",
+                                                      "application/json")))
+        except HTTPError as e:
+            # worker answered (e.g. 500 from the pipeline): authoritative
+            self._note_success(addr)
+            fwd_span(status=e.code)
+            return ("response", (e.code, e.read() or b"",
+                                 e.headers.get("Content-Type",
+                                               "text/plain")))
+        except (URLError, OSError) as e:
+            self._note_failure(addr)
+            reason = getattr(e, "reason", e)
+            fwd_span(error=str(reason))
+            timed_out = isinstance(reason, TimeoutError) or \
+                "timed out" in str(reason).lower()
+            return ("timeout" if timed_out else "error", str(reason))
+
+    def _hedged_forward(self, order: List[str], attempt: Callable,
+                        deadline) -> Optional[Tuple[str, Any, str]]:
+        """Primary + delayed hedge over the first two routable workers
+        (threaded transport): launch ``attempt(order[0])`` in a thread;
+        if no outcome lands within the tracker's quantile delay, launch
+        ``attempt(order[1])`` and take whichever responds FIRST (the
+        loser's reply is discarded when it eventually arrives — bounded
+        duplicate work, no cancellation needed). Returns ``(kind, payload,
+        addr)`` for the winning response / terminal failure, or None when
+        every launched attempt failed with a replay-safe transport error
+        (the caller walks the remaining workers)."""
+        tracker = self._hedge
+        tracker.note_request()
+        results: "queue_mod.Queue" = queue_mod.Queue()
+        t0 = time.perf_counter()
+
+        def run(addr: str, role: str) -> None:
+            try:
+                kind, payload = attempt(addr)
+            except Exception as e:  # noqa: BLE001 — a lost put would deadlock
+                kind, payload = "error", str(e)
+            if role == "primary" and kind == "response":
+                # quantile source: primary latencies only (hedge wins
+                # would bias the reservoir low)
+                tracker.observe(time.perf_counter() - t0)
+            results.put((role, addr, kind, payload))
+
+        threading.Thread(target=run, args=(order[0], "primary"),
+                         daemon=True).start()
+        delay = tracker.delay_s()
+        launched, hedge_done, did_hedge = 1, False, False
+        failures: List[Tuple[str, str, str, Any]] = []
+        while len(failures) < launched:
+            timeout = None
+            if not hedge_done:
+                timeout = max(0.0, t0 + delay - time.perf_counter())
+            try:
+                role, addr, kind, payload = results.get(timeout=timeout)
+            except queue_mod.Empty:
+                hedge_done = True
+                if deadline is not None and deadline.expired():
+                    continue  # nobody is waiting: don't spend a duplicate
+                try:
+                    # chaos seam: a raising FRONT_HEDGE plan suppresses
+                    # this hedge; fired() records which requests hedged
+                    faults.fire(faults.FRONT_HEDGE, addr=order[1])
+                except Exception:  # noqa: BLE001 — injected suppression
+                    tracker.note_suppressed()
+                    continue
+                tracker.note_hedged()
+                did_hedge = True
+                threading.Thread(target=run, args=(order[1], "hedge"),
+                                 daemon=True).start()
+                launched += 1
+                continue
+            if kind == "response":
+                tracker.note_win(role)
+                return (kind, payload, addr)
+            failures.append((role, addr, kind, payload))
+            if not hedge_done and kind == "error":
+                # the primary failed replay-safe BEFORE the hedge delay:
+                # try the second worker immediately — a sequential retry
+                # (the primary is gone, so this is not duplicate work and
+                # does not count as a hedge)
+                hedge_done = True
+                threading.Thread(target=run, args=(order[1], "retry"),
+                                 daemon=True).start()
+                launched += 1
+        if did_hedge:
+            tracker.note_both_failed()
+        # a read timeout is terminal for non-idempotent requests and an
+        # expired deadline is terminal outright (the caller applies the
+        # rules); prefer reporting those over a replay-safe error
+        for role, addr, kind, payload in failures:
+            if kind in ("timeout", "deadline"):
+                return (kind, payload, addr)
+        return None
+
     # -- HTTP ---------------------------------------------------------------
     def _control(self, path: str, body: bytes, headers
                  ) -> Optional[tuple]:
@@ -295,10 +444,12 @@ class RoutingFront:
                 return (400, "application/json",
                         json.dumps({"error": str(e)}).encode())
         if path == RoutingFront.WORKERS_PATH:
-            return (200, "application/json", json.dumps(
-                {"workers": self.workers,
-                 "states": self.worker_states,
-                 "capacity": self.worker_capacities}).encode())
+            payload = {"workers": self.workers,
+                       "states": self.worker_states,
+                       "capacity": self.worker_capacities}
+            if self._hedge is not None:
+                payload["hedge"] = self._hedge.summary()
+            return (200, "application/json", json.dumps(payload).encode())
         if path == RoutingFront.HEALTH_PATH:
             return (200, "application/json", json.dumps(
                 {"ok": True, "workers": len(self.workers)}).encode())
@@ -382,88 +533,74 @@ class RoutingFront:
                 # only REPLAYED on another worker when the failure shows it
                 # never reached the first one (connect refused/reset) or the
                 # method is idempotent — a read timeout on a POST may mean the
-                # worker is mid-compute, so replaying would double-process it
+                # worker is mid-compute, so replaying would double-process it.
+                # With hedging ON the first two workers instead race: the
+                # hedge launches after the tracker's quantile delay and the
+                # first response wins (opt-in: duplicates by design).
                 order = front._pick_order()
                 if not order:
                     respond(503, b'{"error": "no workers registered"}',
                             extra={"Retry-After": "1"}, outcome="no_workers")
                     return
                 idempotent = self.command in ("GET", "HEAD")
-                for addr in order:
-                    parts = urlsplit(addr)
-                    # "/" routes to the worker's registered api path; any
-                    # other path+query forwards verbatim (proxy semantics) so
-                    # the worker's own 404 behavior is preserved
-                    wpath = parts.path if path in ("", "/") else incoming.path
-                    query = f"?{incoming.query}" if incoming.query else ""
-                    url = f"{parts.scheme}://{parts.netloc}{wpath or '/'}{query}"
-                    drop = {"host", "content-length"}
-                    fwd = None
-                    if tctx is not None:
-                        # replace any incoming trace header with this
-                        # attempt's context: the child of the forward span
-                        # when sampled, or the flags=00 context when not —
-                        # the head decision made at ingress MUST propagate,
-                        # otherwise the worker would re-roll sampling
-                        drop.add(TRACE_HEADER.lower())
-                        if tctx.sampled:
-                            fwd = front.tracer.child(tctx)
-                    hdrs = {k: v for k, v in self.headers.items()
-                            if k.lower() not in drop}
-                    if tctx is not None:
-                        hdrs[TRACE_HEADER] = (fwd or tctx).to_header()
-                    req = Request(url, data=body if body else None,
-                                  method=self.command, headers=hdrs)
+                # replace any incoming trace header with the per-attempt
+                # context (built in _forward_once): the head decision made
+                # at ingress MUST propagate, otherwise the worker would
+                # re-roll sampling
+                drop = {"host", "content-length"}
+                if tctx is not None:
+                    drop.add(TRACE_HEADER.lower())
+                base_hdrs = {k: v for k, v in self.headers.items()
+                             if k.lower() not in drop}
+
+                def attempt(addr):
+                    if dl is not None and dl.expired():
+                        return ("deadline", None)
                     timeout = front.forward_timeout_s
                     if dl is not None:
-                        if dl.expired():
-                            respond(504, b'{"error": "deadline expired"}',
-                                    outcome="deadline_expired")
-                            return
                         timeout = max(dl.cap(timeout), 1e-3)
-                    t_f0w, t_f0 = time.time(), time.perf_counter()
+                    return front._forward_once(
+                        addr, self.command,
+                        front._worker_url(addr, incoming, path), path,
+                        base_hdrs, body, timeout, tctx)
 
-                    def fwd_span(**attrs):
-                        if fwd is not None:
-                            front.tracer.record(
-                                "forward", fwd, t_f0w,
-                                time.perf_counter() - t_f0,
-                                worker=addr, **attrs)
-
-                    try:
-                        faults.fire(faults.WORKER_FORWARD, addr=addr,
-                                    path=path)
-                        with urlopen(req, timeout=timeout) as resp:
-                            front._note_success(addr)
-                            fwd_span(status=resp.status)
-                            respond(
-                                resp.status, resp.read(),
-                                resp.headers.get("Content-Type",
-                                                 "application/json"),
-                                outcome="forwarded")
+                rest = order
+                if front._hedge is not None and len(order) >= 2:
+                    hedged = front._hedged_forward(order[:2], attempt, dl)
+                    if hedged is not None:
+                        kind, payload, addr = hedged
+                        if kind == "response":
+                            status, rbody, ctype = payload
+                            respond(status, rbody, ctype,
+                                    outcome="forwarded")
                             return
-                    except HTTPError as e:
-                        # worker answered (e.g. 500 from the pipeline):
-                        # authoritative, do not retry elsewhere
-                        front._note_success(addr)
-                        fwd_span(status=e.code)
-                        respond(e.code, e.read() or b"",
-                                e.headers.get("Content-Type", "text/plain"),
-                                outcome="forwarded")
-                        return
-                    except (URLError, OSError) as e:
-                        front._note_failure(addr)
-                        fwd_span(error=str(getattr(e, "reason", e)))
-                        reason = getattr(e, "reason", e)
-                        timed_out = isinstance(reason, TimeoutError) or \
-                            "timed out" in str(reason).lower()
-                        if timed_out and not idempotent:
+                        if kind == "timeout" and not idempotent:
                             respond(504, json.dumps(
                                 {"error": f"worker {addr} timed out; not "
                                           f"replayed (non-idempotent)"}
                             ).encode(), outcome="timeout_unreplayed")
                             return
-                        continue
+                        if kind == "deadline":
+                            respond(504, b'{"error": "deadline expired"}',
+                                    outcome="deadline_expired")
+                            return
+                    rest = order[2:]
+                for addr in rest:
+                    kind, payload = attempt(addr)
+                    if kind == "response":
+                        status, rbody, ctype = payload
+                        respond(status, rbody, ctype, outcome="forwarded")
+                        return
+                    if kind == "deadline":
+                        respond(504, b'{"error": "deadline expired"}',
+                                outcome="deadline_expired")
+                        return
+                    if kind == "timeout" and not idempotent:
+                        respond(504, json.dumps(
+                            {"error": f"worker {addr} timed out; not "
+                                      f"replayed (non-idempotent)"}
+                        ).encode(), outcome="timeout_unreplayed")
+                        return
                 respond(502, b'{"error": "all workers failed"}',
                         outcome="all_workers_failed")
 
@@ -514,29 +651,28 @@ class RoutingFront:
             return respond(503, b'{"error": "no workers registered"}',
                            extra={"Retry-After": "1"}, outcome="no_workers")
         idempotent = req.method in ("GET", "HEAD")
-        for addr in order:
-            parts = urlsplit(addr)
-            wpath = parts.path if path in ("", "/") else incoming.path
-            query = f"?{incoming.query}" if incoming.query else ""
-            url = f"{parts.scheme}://{parts.netloc}{wpath or '/'}{query}"
-            drop = {"host", "content-length", "connection"}
+        drop = {"host", "content-length", "connection"}
+        if tctx is not None:
+            # the head sampling decision made at ingress MUST propagate
+            # (same rule as the threaded handler)
+            drop.add(TRACE_HEADER.lower())
+        base_hdrs = {k: v for k, v in req.headers.items()
+                     if k.lower() not in drop}
+
+        async def attempt(addr):
+            """One pooled forward: same breaker/span/deadline taxonomy as
+            the threaded _forward_once, over the keep-alive pool."""
+            if dl is not None and dl.expired():
+                return ("deadline", None)
+            timeout = max(dl.cap(self.forward_timeout_s), 1e-3) \
+                if dl is not None else self.forward_timeout_s
+            url = self._worker_url(addr, incoming, path)
             fwd = None
+            hdrs = dict(base_hdrs)
             if tctx is not None:
-                # the head sampling decision made at ingress MUST propagate
-                # (same rule as the threaded handler)
-                drop.add(TRACE_HEADER.lower())
                 if tctx.sampled:
                     fwd = self.tracer.child(tctx)
-            hdrs = {k: v for k, v in req.headers.items()
-                    if k.lower() not in drop}
-            if tctx is not None:
                 hdrs[TRACE_HEADER] = (fwd or tctx).to_header()
-            timeout = self.forward_timeout_s
-            if dl is not None:
-                if dl.expired():
-                    return respond(504, b'{"error": "deadline expired"}',
-                                   outcome="deadline_expired")
-                timeout = max(dl.cap(timeout), 1e-3)
             t_f0w, t_f0 = time.time(), time.perf_counter()
 
             def fwd_span(**attrs):
@@ -549,7 +685,7 @@ class RoutingFront:
                 faults.fire(faults.WORKER_FORWARD, addr=addr, path=path)
                 status, rhdrs, rbody = await self._pool.request(
                     req.method, url, body=body, headers=hdrs,
-                    timeout=timeout)
+                    timeout=timeout, deadline=dl)
             except (asyncio.TimeoutError, OSError) as e:
                 # transport failure: same taxonomy as the urlopen path —
                 # note the breaker, replay only when safe
@@ -558,21 +694,116 @@ class RoutingFront:
                 timed_out = isinstance(e, asyncio.TimeoutError) or \
                     isinstance(e, TimeoutError) or \
                     "timed out" in str(e).lower()
-                if timed_out and not idempotent:
-                    return respond(504, json.dumps(
-                        {"error": f"worker {addr} timed out; not "
-                                  f"replayed (non-idempotent)"}
-                    ).encode(), outcome="timeout_unreplayed")
-                continue
+                return ("timeout" if timed_out else "error", str(e))
             # ANY worker answer — 2xx or an error status — is authoritative
             # (the threaded handler's urlopen/HTTPError split, merged)
             self._note_success(addr)
             fwd_span(status=status)
-            return respond(status, rbody,
-                           rhdrs.get("Content-Type", "application/json"),
-                           outcome="forwarded")
+            return ("response",
+                    (status, rbody,
+                     rhdrs.get("Content-Type", "application/json")))
+
+        rest = order
+        if self._hedge is not None and len(order) >= 2:
+            hedged = await self._hedged_forward_aio(order[:2], attempt, dl)
+            if hedged is not None:
+                kind, payload, addr = hedged
+                if kind == "response":
+                    status, rbody, ctype = payload
+                    return respond(status, rbody, ctype,
+                                   outcome="forwarded")
+                if kind == "timeout" and not idempotent:
+                    return respond(504, json.dumps(
+                        {"error": f"worker {addr} timed out; not "
+                                  f"replayed (non-idempotent)"}
+                    ).encode(), outcome="timeout_unreplayed")
+                if kind == "deadline":
+                    return respond(504, b'{"error": "deadline expired"}',
+                                   outcome="deadline_expired")
+            rest = order[2:]
+        for addr in rest:
+            kind, payload = await attempt(addr)
+            if kind == "response":
+                status, rbody, ctype = payload
+                return respond(status, rbody, ctype, outcome="forwarded")
+            if kind == "deadline":
+                return respond(504, b'{"error": "deadline expired"}',
+                               outcome="deadline_expired")
+            if kind == "timeout" and not idempotent:
+                return respond(504, json.dumps(
+                    {"error": f"worker {addr} timed out; not "
+                              f"replayed (non-idempotent)"}
+                ).encode(), outcome="timeout_unreplayed")
         return respond(502, b'{"error": "all workers failed"}',
                        outcome="all_workers_failed")
+
+    async def _hedged_forward_aio(self, order, attempt,
+                                  deadline) -> Optional[Tuple[str, Any, str]]:
+        """Async twin of ``_hedged_forward``: primary task + delayed hedge
+        task, first response wins, losers are CANCELLED (the pool discards
+        a cancelled connection rather than reusing it torn)."""
+        import asyncio
+
+        tracker = self._hedge
+        tracker.note_request()
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        tasks = {asyncio.ensure_future(attempt(order[0])):
+                 ("primary", order[0])}
+        delay = tracker.delay_s()
+        hedge_done = did_hedge = False
+        failures: List[Tuple[str, str, Any]] = []
+        result: Optional[Tuple[str, Any, str]] = None
+        while tasks:
+            timeout = None
+            if not hedge_done:
+                timeout = max(0.0, t0 + delay - loop.time())
+            done, _pending = await asyncio.wait(
+                set(tasks), timeout=timeout,
+                return_when=asyncio.FIRST_COMPLETED)
+            if not done:
+                hedge_done = True
+                if deadline is not None and deadline.expired():
+                    continue
+                try:
+                    faults.fire(faults.FRONT_HEDGE, addr=order[1])
+                except Exception:  # noqa: BLE001 — injected suppression
+                    tracker.note_suppressed()
+                    continue
+                tracker.note_hedged()
+                did_hedge = True
+                tasks[asyncio.ensure_future(attempt(order[1]))] = \
+                    ("hedge", order[1])
+                continue
+            for t in done:
+                role, addr = tasks.pop(t)
+                try:
+                    kind, payload = await t  # done: resolves immediately
+                except asyncio.CancelledError:
+                    continue
+                if kind == "response":
+                    if role == "primary":
+                        tracker.observe(loop.time() - t0)
+                    tracker.note_win(role)
+                    result = (kind, payload, addr)
+                else:
+                    failures.append((addr, kind, payload))
+                    if not hedge_done and kind == "error":
+                        # primary failed replay-safe before the delay:
+                        # sequential retry on the second worker, not a hedge
+                        hedge_done = True
+                        tasks[asyncio.ensure_future(attempt(order[1]))] = \
+                            ("retry", order[1])
+            if result is not None:
+                for t in tasks:
+                    t.cancel()
+                return result
+        if did_hedge:
+            tracker.note_both_failed()
+        for addr, kind, payload in failures:
+            if kind in ("timeout", "deadline"):
+                return (kind, payload, addr)
+        return None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "RoutingFront":

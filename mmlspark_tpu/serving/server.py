@@ -214,7 +214,8 @@ class _Prepared:
     """One drained batch, deadline-gated, stamped, and journaled — the unit
     that flows through the sync loop and the async executor's stages."""
 
-    __slots__ = ("rows", "ids", "df", "epoch", "queue_s", "n", "seq", "ctxs")
+    __slots__ = ("rows", "ids", "df", "epoch", "queue_s", "n", "seq", "ctxs",
+                 "wd_gen", "wd_tries", "wd_expiries")
 
     def __init__(self, rows, ids, df, epoch, queue_s, ctxs=None):
         self.rows = rows        # [(rid, body, headers), ...]
@@ -226,6 +227,12 @@ class _Prepared:
         self.seq = 0            # executor pipeline sequence number
         # rid -> sampled SpanContext for traced requests in this batch
         self.ctxs = ctxs if ctxs is not None else {}
+        # hung-dispatch watchdog bookkeeping (executor lock guards all
+        # three): generation claims stale-ify a wedged dispatch's late
+        # return, tries bound re-dispatches, expiries bound budget doubling
+        self.wd_gen = 0
+        self.wd_tries = 0
+        self.wd_expiries = 0
 
 
 class ServingServer:
@@ -281,7 +288,13 @@ class ServingServer:
                  http_mode: str = "thread",
                  wire_binary: bool = True,
                  tenants=None, slo=None,
-                 metrics_exemplars: bool = False):
+                 metrics_exemplars: bool = False,
+                 supervise: bool = True,
+                 watchdog_budget_s: Optional[float] = None,
+                 watchdog_k: float = 8.0,
+                 watchdog_min_budget_s: float = 1.0,
+                 probe_fn: Optional[Callable] = None,
+                 brownout=None, brownout_hooks=None):
         self.transform = transform
         # optional provider of the device-ingest decomposition (queue/h2d/
         # compute/readback — parallel/ingest.IngestStats.summary) merged into
@@ -339,6 +352,25 @@ class ServingServer:
         # ``tuner`` section of /_mmlspark/stats and the mmlspark_tuner_*
         # families. serve_pipeline(autotune=...) wires it for fused models.
         self._tuner = tuner
+        # supervision layer (serving/supervisor.py): with async_exec, a
+        # ReplicaSupervisor ejects/probes/readmits unhealthy replicas and a
+        # DispatchWatchdog re-dispatches wedged batches. Passive when
+        # healthy — replies are bitwise-identical to supervise=False.
+        self.supervise = bool(supervise)
+        self.watchdog_budget_s = watchdog_budget_s
+        self.watchdog_k = float(watchdog_k)
+        self.watchdog_min_budget_s = float(watchdog_min_budget_s)
+        self._probe_fn = probe_fn
+        # brownout controller (serving/supervisor.py BrownoutController):
+        # staged graceful degradation on SLO burn — None/False = off (the
+        # default; enabling requires the slo knob). Built in start() so the
+        # steps can capture the live controller/executor.
+        self._brownout_spec = brownout
+        # extra degradation hooks from serve_pipeline: {step name:
+        # (apply_fn, revert_fn)} — e.g. the fusion planner's host-fallback
+        # demotion for optional segments
+        self._brownout_hooks = dict(brownout_hooks or {})
+        self._brownout = None
         self._executor = None
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         # wake latch: set on every enqueue and on stop(), so the batcher's
@@ -476,6 +508,8 @@ class ServingServer:
                 summary["http"] = self._aio.stats()
             if self._slo is not None:
                 summary["slo"] = self._slo.summary()
+            if self._brownout is not None:
+                summary["brownout"] = self._brownout.summary()
             if self._lat_hist is not None:
                 # bucket counts + trace-id exemplars, ALWAYS here (the
                 # exposition carries them only behind metrics_exemplars)
@@ -887,6 +921,8 @@ class ServingServer:
         ctxs = {rid: c for rid, c in prep.ctxs.items() if rid in keep}
         out = _Prepared(live, ids, df, prep.epoch, prep.queue_s, ctxs=ctxs)
         out.seq = prep.seq
+        out.wd_tries = prep.wd_tries
+        out.wd_expiries = prep.wd_expiries
         return out
 
     def _trace_batch(self, name: str, prep: "_Prepared", t0_wall: float,
@@ -968,12 +1004,74 @@ class ServingServer:
         """Per-batch auto-tuner heartbeat — shared by the sync loop and the
         pipelined executor's readback thread. No-op without a tuner; a
         tuner failure degrades to untuned serving, never a dead loop."""
-        if self._tuner is None:
-            return
-        try:
-            self._tuner.on_epoch(e2e_s)
-        except Exception:  # noqa: BLE001 — tuning must never kill serving
-            pass
+        if self._tuner is not None:
+            try:
+                self._tuner.on_epoch(e2e_s)
+            except Exception:  # noqa: BLE001 — tuning must never kill serving
+                pass
+        if self._brownout is not None:
+            try:
+                self._brownout.check()
+            except Exception:  # noqa: BLE001 — brownout must never kill serving
+                pass
+
+    def _brownout_steps(self) -> list:
+        """Declared degradation ladder, in escalation order. Each step is a
+        reversible knob change; restoring walks back the stack:
+
+          1. ``batch_window`` — collapse the coalescing window (adaptive
+             clamp + the sync loop's ``max_wait_ms``): stop spending
+             latency budget on batching when the budget is already burning.
+          2. ``demote_segments`` (serve_pipeline hook, fused pipelines) —
+             demote optional light segments to the host path via the fusion
+             planner's host-fallback overrides, freeing device time for the
+             heavy segment.
+          3. ``tighten_admission`` — halve the bounded-admission queue and
+             scale per-tenant quotas by 0.5: shed earlier, shed fairly.
+        """
+        from .supervisor import BrownoutStep
+
+        steps = []
+        window_state: Dict[str, Any] = {}
+
+        def window_apply():
+            window_state["max_wait_ms"] = self.max_wait_ms
+            self.max_wait_ms = 0.0
+            if self._controller is not None:
+                clamp = getattr(self._controller, "set_window_clamp", None)
+                if callable(clamp):
+                    window_state["clamp"] = clamp(
+                        self._controller.min_wait_ms)
+
+        def window_revert():
+            self.max_wait_ms = window_state.pop("max_wait_ms",
+                                                self.max_wait_ms)
+            if self._controller is not None and "clamp" in window_state:
+                self._controller.set_window_clamp(window_state.pop("clamp"))
+
+        steps.append(BrownoutStep("batch_window", window_apply,
+                                  window_revert))
+        for name, (apply_fn, revert_fn) in self._brownout_hooks.items():
+            steps.append(BrownoutStep(name, apply_fn, revert_fn))
+        adm_state: Dict[str, Any] = {}
+
+        def adm_apply():
+            adm_state["max_queue"] = self.max_queue
+            if self.max_queue:
+                self.max_queue = max(1, self.max_queue // 2)
+            if self._tenants is not None:
+                pressure = getattr(self._tenants, "set_pressure", None)
+                if callable(pressure):
+                    adm_state["pressure"] = pressure(0.5)
+
+        def adm_revert():
+            self.max_queue = adm_state.pop("max_queue", self.max_queue)
+            if self._tenants is not None and "pressure" in adm_state:
+                self._tenants.set_pressure(adm_state.pop("pressure"))
+
+        steps.append(BrownoutStep("tighten_admission", adm_apply,
+                                  adm_revert))
+        return steps
 
     def _maybe_commit_epochs(self, force: bool = False) -> None:
         """Commit every epoch whose requests are all answered or abandoned
@@ -1121,10 +1219,28 @@ class ServingServer:
                     init_wait_ms=self.max_wait_ms,
                     max_wait_ms=max_wait)
                 self._controller = ctrl
+            rset = ReplicaSet(self.transform, n=self.replicas,
+                              devices=self._devices)
+            supervisor = watchdog = None
+            if self.supervise:
+                from .supervisor import DispatchWatchdog, ReplicaSupervisor
+
+                # supervisor records track the PLACED replica indices
+                # (placement skips can leave gaps)
+                supervisor = ReplicaSupervisor(
+                    [r.index for r in rset.replicas],
+                    probe_fn=self._probe_fn)
+                predict = None
+                if self._tuner is not None:
+                    predict = getattr(self._tuner, "predict_batch_ms", None)
+                watchdog = DispatchWatchdog(
+                    k=self.watchdog_k,
+                    min_budget_s=self.watchdog_min_budget_s,
+                    fixed_s=self.watchdog_budget_s,
+                    predict_ms_fn=predict)
             self._executor = PipelinedExecutor(
-                self, ReplicaSet(self.transform, n=self.replicas,
-                                 devices=self._devices),
-                controller=ctrl, inflight=self.inflight)
+                self, rset, controller=ctrl, inflight=self.inflight,
+                supervisor=supervisor, watchdog=watchdog)
             self._executor.start()
             self._threads.extend(self._executor.threads)
         else:
@@ -1132,6 +1248,11 @@ class ServingServer:
                                       name=f"{self.name}-batcher")
             t_loop.start()
             self._threads.append(t_loop)
+        if self._brownout_spec:
+            from .supervisor import make_brownout
+
+            self._brownout = make_brownout(
+                self._brownout_spec, self._slo, self._brownout_steps())
         if self._tuner is not None:
             # late-bind the layers the tuner steers: the adaptive window
             # seed and the live in-flight depth exist only after start()
@@ -1250,7 +1371,10 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    trace_sample_rate: float = 1.0,
                    http_mode: str = "thread", wire_binary: bool = True,
                    tenants=None, slo=None,
-                   metrics_exemplars: bool = False) -> ServingServer:
+                   metrics_exemplars: bool = False,
+                   supervise: bool = True,
+                   watchdog_budget_s: Optional[float] = None,
+                   brownout=None) -> ServingServer:
     """Serve a fitted Transformer: request body -> ``input_col`` -> stage ->
     ``reply_col`` (IOImplicits fluent sugar parity, io/IOImplicits.scala:182-213).
 
@@ -1295,6 +1419,17 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
     ``metrics_exemplars=True`` renders trace-id exemplars on
     ``/_mmlspark/metrics`` in OpenMetrics syntax (obs/perf.py — always
     present in ``/_mmlspark/stats`` regardless).
+
+    ``supervise`` (default on, async_exec only) runs the self-healing
+    layer (serving/supervisor.py): per-replica health scores with
+    quarantine/probe/readmit and a hung-dispatch watchdog that
+    re-dispatches wedged batches on a healthy replica
+    (``watchdog_budget_s`` pins a fixed wall budget; the default derives
+    one from the cost model / measured EWMA). ``brownout`` (off by
+    default; requires ``slo``) enables staged graceful degradation on SLO
+    burn — shrink the batch window, demote optional fused segments to
+    host, tighten admission — restored hysteretically; see
+    docs/serving.md.
     """
     from ..core.pipeline import PipelineModel
     from .stages import parse_request
@@ -1346,6 +1481,32 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
             stage.set_tuning(cost_model=model)
         tuner = Tuner(fused=stage, model=model, every=tune_every)
 
+    brownout_hooks = None
+    if brownout and hasattr(stage, "set_tuning"):
+        # brownout step 2, wired only for fused pipelines: demote the
+        # OPTIONAL (non-heavy) fused segments to the host path via the
+        # fusion planner's fuse-override hook — under overload the device
+        # serves the heavy segment only; restore puts the old overrides
+        # back verbatim
+        demote_state: Dict[str, Any] = {}
+
+        def demote_apply(_stage=stage, _st=demote_state):
+            plan_nodes = getattr(_stage, "_last_plan", None) or []
+            light = [n.label for n in plan_nodes
+                     if getattr(n, "label", None) is not None
+                     and not getattr(n, "heavy", True)]
+            _st["prev"] = dict(getattr(_stage, "_fuse_overrides", {}) or {})
+            if light:
+                overrides = dict(_st["prev"])
+                overrides.update({lab: False for lab in light})
+                _stage.set_tuning(fuse=overrides)
+
+        def demote_revert(_stage=stage, _st=demote_state):
+            if "prev" in _st:
+                _stage.set_tuning(fuse=_st.pop("prev"))
+
+        brownout_hooks = {"demote_segments": (demote_apply, demote_revert)}
+
     return ServingServer(transform, host=host, port=port, api_path=api_path,
                          reply_col=reply_col, max_batch_size=max_batch_size,
                          max_wait_ms=max_wait_ms, token=token,
@@ -1361,4 +1522,8 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                          trace_sample_rate=trace_sample_rate,
                          http_mode=http_mode, wire_binary=wire_binary,
                          tenants=tenants, slo=slo,
-                         metrics_exemplars=metrics_exemplars)
+                         metrics_exemplars=metrics_exemplars,
+                         supervise=supervise,
+                         watchdog_budget_s=watchdog_budget_s,
+                         brownout=brownout,
+                         brownout_hooks=brownout_hooks)

@@ -51,6 +51,20 @@ class TenantAdmission:
         self._inflight: Dict[str, int] = {}
         self._admitted: Dict[str, int] = {}
         self._shed: Dict[str, int] = {}
+        # brownout pressure: every quota is scaled by this factor while a
+        # degradation step has tightened admission (1.0 = full quotas)
+        self._pressure = 1.0
+
+    def set_pressure(self, factor: float) -> float:
+        """Scale every tenant quota by ``factor`` (the brownout
+        controller's tighten-admission knob); returns the previous factor
+        so the step can restore it."""
+        if factor <= 0:
+            raise ValueError("pressure factor must be positive")
+        with self._lock:
+            prev = self._pressure
+            self._pressure = float(factor)
+            return prev
 
     @staticmethod
     def tenant_of(headers: Optional[Mapping[str, str]]) -> str:
@@ -87,8 +101,9 @@ class TenantAdmission:
         active.add(tenant)
         total_w = sum(self.weight(t) for t in active)
         if total_w <= 0:
-            return max(1, int(max_queue))
-        return max(1, int(max_queue * self.weight(tenant) / total_w))
+            return max(1, int(max_queue * self._pressure))
+        return max(1, int(max_queue * self._pressure
+                          * self.weight(tenant) / total_w))
 
     def try_admit(self, tenant: str, queue_depth: int,
                   max_queue: int) -> bool:

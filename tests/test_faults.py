@@ -34,6 +34,12 @@ from mmlspark_tpu.core.faults import (
 
 pytestmark = pytest.mark.faults
 
+#: seed matrix knob for the CI chaos lane (tools/ci/run_ci.sh chaos stage):
+#: scenarios that draw randomness seed their injectors/policies from this,
+#: so `MMLSPARK_CHAOS_SEED=7 pytest -m faults` replays a DIFFERENT but
+#: still fully deterministic fault schedule
+CHAOS_SEED = int(os.environ.get("MMLSPARK_CHAOS_SEED", "0"))
+
 
 def _post(url, obj, timeout=15, headers=None):
     hdrs = {"Content-Type": "application/json"}
@@ -477,7 +483,20 @@ class _ToggleWorker:
 
             def _serve(self):
                 if not outer.alive:
-                    # simulate a killed worker: drop the connection
+                    # simulate a killed worker: RST the connection (a dead
+                    # process resets; a bare close() leaves keep-alive
+                    # clients hanging on a half-open socket, which is a
+                    # DIFFERENT failure — the watchdog/hedge tests cover it)
+                    import socket as socket_mod
+                    import struct
+
+                    try:
+                        self.connection.setsockopt(
+                            socket_mod.SOL_SOCKET, socket_mod.SO_LINGER,
+                            struct.pack("ii", 1, 0))
+                    except OSError:
+                        pass
+                    self.close_connection = True
                     self.connection.close()
                     return
                 n = int(self.headers.get("Content-Length", 0))
@@ -732,6 +751,750 @@ class TestServingHardening:
         assert res["status"] == 200 and res["body"]["sum"] == 6.0
         # a clean drain leaves nothing to replay
         assert RequestJournal.recover(jpath) == []
+
+
+# ---------------------------------------------------------------------------
+# Async-front chaos: the PR-2 scenarios rerun under http_mode="async"
+# ---------------------------------------------------------------------------
+
+
+class TestAsyncFrontChaos:
+    """Worker-kill / journal-crash / deadline cases over the event-loop
+    transports (serving/aio.py) — the threaded-path chaos suite above only
+    exercised ThreadingHTTPServer."""
+
+    def _front(self, **kw):
+        from mmlspark_tpu.serving import RoutingFront
+
+        kw.setdefault("probe_interval_s", 0.05)
+        kw.setdefault("probe_timeout_s", 1.0)
+        kw.setdefault("probe_policy", RetryPolicy(
+            max_retries=1 << 30, base_s=0.05, multiplier=1.0,
+            max_backoff_s=0.05, jitter=0.0, seed=CHAOS_SEED))
+        return RoutingFront(port=0, max_failures=2, http_mode="async", **kw)
+
+    def test_worker_kill_mid_stream_reroutes_async(self):
+        w1, w2 = _ToggleWorker(), _ToggleWorker()
+        try:
+            with self._front() as front:
+                front.register(w1.address)
+                front.register(w2.address)
+                w1.alive = False  # kill one mid-traffic
+                for i in range(6):
+                    status, body, _ = _post_status(front.address, {"i": i})
+                    assert status == 200 and body["worker"] == "toggle"
+                assert front.worker_states[w1.address] == "open"
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_health_probe_readmits_async(self):
+        w = _ToggleWorker()
+        try:
+            with self._front() as front:
+                front.register(w.address)
+                w.alive = False
+                for _ in range(3):
+                    _post_status(front.address, {"x": 1}, timeout=5)
+                assert front.worker_states[w.address] == "open"
+                w.alive = True
+                deadline = time.time() + 5
+                while (front.worker_states[w.address] == "open"
+                       and time.time() < deadline):
+                    time.sleep(0.02)
+                status, _, _ = _post_status(front.address, {"x": 2})
+                assert status == 200
+                assert front.worker_states[w.address] == "closed"
+        finally:
+            w.stop()
+
+    def test_expired_deadline_rejected_async_front_and_worker(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        with ServingServer(_echo_transform, port=0, max_wait_ms=2.0,
+                           http_mode="async") as srv:
+            # dead-on-arrival at the async worker ingress
+            expired = Deadline(time.time() - 5).to_header()
+            status, _, _ = _post_status(
+                srv.address, {"data": [1]},
+                headers={DEADLINE_HEADER: expired})
+            assert status == 504
+            with self._front() as front:
+                front.register(srv.address)
+                status, _, _ = _post_status(
+                    front.address, {"data": [1]},
+                    headers={DEADLINE_HEADER: expired})
+                assert status == 504  # gated at the async front, pre-forward
+                live = Deadline.from_timeout(30).to_header()
+                status, body, _ = _post_status(
+                    front.address, {"data": [2, 3]},
+                    headers={DEADLINE_HEADER: live})
+                assert status == 200 and body["sum"] == 5.0
+
+    def test_journal_crash_replays_async_http(self, tmp_path):
+        """The PR-2 at-least-once window under the async transport: commit
+        never lands, hard stop, recovery returns the uncommitted batch."""
+        from mmlspark_tpu.serving import RequestJournal, ServingServer
+
+        jpath = str(tmp_path / "wal.jsonl")
+        with FaultInjector(seed=CHAOS_SEED).plan(faults.JOURNAL_COMMIT,
+                                                 every=1):
+            srv = ServingServer(_echo_transform, port=0, max_wait_ms=2.0,
+                                journal_path=jpath, http_mode="async")
+            srv.start()
+            try:
+                status, body, _ = _post(srv.address, {"data": [1, 2]})
+                assert status == 200 and body["sum"] == 3.0
+            finally:
+                srv.stop(drain=False)  # hard stop: the crash
+        replay = RequestJournal.recover(jpath)
+        assert [json.loads(b)["data"] for _, b, _ in replay] == [[1, 2]]
+
+    def test_journal_write_failure_degrades_async_http(self, tmp_path):
+        from mmlspark_tpu.serving import ServingServer
+
+        jpath = str(tmp_path / "wal.jsonl")
+        with FaultInjector(seed=CHAOS_SEED).plan(faults.JOURNAL_WRITE,
+                                                 at=(1,)):
+            with ServingServer(_echo_transform, port=0, max_wait_ms=2.0,
+                               journal_path=jpath,
+                               http_mode="async") as srv:
+                status, body, _ = _post(srv.address, {"data": [4]})
+                assert status == 200 and body["sum"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# Hung-dispatch watchdog + replica supervision (serving/supervisor.py)
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchWatchdog:
+    def _server(self, **kw):
+        from mmlspark_tpu.serving import ServingServer
+
+        kw.setdefault("max_wait_ms", 1.0)
+        kw.setdefault("async_exec", True)
+        kw.setdefault("adaptive_batching", False)
+        return ServingServer(_echo_transform, port=0, **kw)
+
+    @staticmethod
+    def _supervisor(srv):
+        return srv._executor.supervisor
+
+    def _wait_for(self, pred, timeout=6.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return pred()
+
+    def test_wedged_dispatch_requeues_then_quarantine_and_readmit(self):
+        """The headline chaos proof: a dispatch wedged by an injected hang
+        is re-dispatched on a healthy replica (the request completes), the
+        wedged replica is quarantined, and — once its stuck thread returns
+        and the probe cooldown passes — re-admitted."""
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.WORKER_DISPATCH_HANG, at=(1,), delay_s=0.5,
+                exc=None) as inj:
+            with self._server(replicas=2, inflight=2,
+                              watchdog_budget_s=0.05) as srv:
+                # tight probe schedule so re-admission is fast in the test
+                self._supervisor(srv).quarantine_s = 0.05
+                t0 = time.perf_counter()
+                status, body, _ = _post(srv.address, {"data": [1, 2]})
+                took = time.perf_counter() - t0
+                assert status == 200 and body["sum"] == 3.0
+                # answered by the re-dispatch, not the 0.5s hang clearing
+                assert took < 0.45, f"no re-dispatch: took {took:.3f}s"
+                assert len(inj.fired(faults.WORKER_DISPATCH_HANG)) == 1
+                ex = srv._executor
+                assert ex.watchdog.requeues == 1
+                sup = self._supervisor(srv)
+                assert any(r["state"] != "healthy" or r["ejections"]
+                           for r in sup.describe())
+                # the stuck thread returns at ~0.5s; after the cooldown the
+                # replica is probed and re-admitted
+                assert self._wait_for(
+                    lambda: sup.summary()["readmissions"] >= 1)
+                assert self._wait_for(
+                    lambda: sup.summary()["healthy"] == 2)
+                # the recovered fleet still serves
+                status, body, _ = _post(srv.address, {"data": [5]})
+                assert status == 200 and body["sum"] == 5.0
+
+    def test_hang_under_load_no_request_lost(self):
+        """With a mid-load wedge on one replica, every request either
+        completes on a healthy replica or sheds with an accounted reason —
+        none hang to the slot timeout, none vanish."""
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.WORKER_DISPATCH_HANG, at=(3,), delay_s=0.5,
+                exc=None):
+            with self._server(replicas=2, inflight=2, max_batch_size=1,
+                              watchdog_budget_s=0.05,
+                              slot_timeout_s=15.0) as srv:
+                self._supervisor(srv).quarantine_s = 0.05
+                results = {}
+                lock = threading.Lock()
+
+                def client(i):
+                    status, body, _ = _post_status(
+                        srv.address, {"data": [i]}, timeout=20)
+                    with lock:
+                        results[i] = (status, body)
+
+                threads = [threading.Thread(target=client, args=(i,))
+                           for i in range(10)]
+                for t in threads:
+                    t.start()
+                    time.sleep(0.02)
+                for t in threads:
+                    t.join(timeout=30)
+                shed = srv.stats.shed_summary()
+                assert sorted(results) == list(range(10))  # none lost
+                answered = sum(1 for s, _ in results.values() if s == 200)
+                accounted = shed["total"]
+                assert answered + accounted >= 10
+                # correct replies for everything answered 200
+                for i, (s, body) in results.items():
+                    if s == 200:
+                        assert body["sum"] == float(i)
+                sup = self._supervisor(srv)
+                assert sup.summary()["ejections"] >= 1
+                assert self._wait_for(
+                    lambda: sup.summary()["healthy"] == 2)
+
+    def test_single_replica_wedge_abandons_with_accounted_504(self):
+        """No healthy peer: the watchdog extends the budget a bounded
+        number of times, then abandons the batch with an accounted 504 —
+        faster than the wedge itself, and attributed in the shed stats."""
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.WORKER_DISPATCH_HANG, at=(1,), delay_s=1.2,
+                exc=None):
+            with self._server(replicas=1, inflight=1,
+                              watchdog_budget_s=0.05) as srv:
+                self._supervisor(srv).quarantine_s = 0.05
+                t0 = time.perf_counter()
+                status, body, _ = _post_status(srv.address, {"data": [1]},
+                                               timeout=20)
+                took = time.perf_counter() - t0
+                assert status == 504
+                assert took < 1.1, f"abandon beat the wedge: {took:.3f}s"
+                shed = srv.stats.shed_summary()
+                assert shed["by_reason"].get("watchdog_abandoned", 0) >= 1
+                assert srv._executor.watchdog.abandons == 1
+                # once the hang clears, probe + readmit restore service
+                sup = self._supervisor(srv)
+                assert self._wait_for(
+                    lambda: sup.summary()["healthy"] == 1, timeout=8.0)
+                status, body, _ = _post(srv.address, {"data": [7]})
+                assert status == 200 and body["sum"] == 7.0
+
+    def test_replica_crash_scores_out_and_batch_gets_500(self):
+        """worker.crash: the dispatch raises like a dying replica process —
+        the batch fails 500 (current contract) and repeated crashes eject
+        the replica via the consecutive-failure score."""
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.WORKER_CRASH, every=1, times=3) as inj:
+            with self._server(replicas=2, inflight=1,
+                              max_batch_size=1) as srv:
+                codes = []
+                for i in range(5):
+                    status, _, _ = _post_status(srv.address, {"data": [i]},
+                                                timeout=15)
+                    codes.append(status)
+                assert codes[:3] == [500, 500, 500]
+                assert codes[3:] == [200, 200]  # fleet keeps serving
+                assert len(inj.fired(faults.WORKER_CRASH)) == 3
+                sup = self._supervisor(srv)
+                rows = {r["replica"]: r for r in sup.describe()}
+                assert sum(r["errors"] for r in rows.values()) == 3
+
+    def test_watchdog_unarmed_until_calibrated(self):
+        from mmlspark_tpu.serving.supervisor import DispatchWatchdog
+
+        wd = DispatchWatchdog(k=4.0, min_budget_s=0.5)
+        assert wd.budget_s(8) is None  # no estimate yet: never trips
+        wd.observe(0.01)
+        assert wd.budget_s(8) == 0.5  # floored
+        wd.observe(1.0)
+        assert wd.budget_s(8) > 0.5
+        fixed = DispatchWatchdog(fixed_s=0.25)
+        assert fixed.budget_s(1) == 0.25
+
+    def test_watchdog_budget_prefers_cost_model(self):
+        from mmlspark_tpu.serving.supervisor import DispatchWatchdog
+
+        wd = DispatchWatchdog(k=2.0, min_budget_s=0.01,
+                              predict_ms_fn=lambda rows: 100.0)
+        wd.observe(5.0)  # EWMA would give 10s; the model predicts 100ms
+        assert wd.budget_s(4) == pytest.approx(0.2)
+
+    def test_supervisor_outlier_and_score_decay(self):
+        from mmlspark_tpu.serving.supervisor import ReplicaSupervisor
+
+        sup = ReplicaSupervisor(2, outlier_k=4.0)
+        for _ in range(10):
+            sup.note_success(0, 0.01)
+        sup.note_success(0, 1.0)  # 100x the EWMA: an outlier
+        row = sup.describe()[0]
+        assert row["outliers"] == 1 and row["state"] == "healthy"
+        assert row["score"] < 1.0
+
+    def test_supervisor_consecutive_failures_eject_and_probe_backoff(self):
+        from mmlspark_tpu.serving.supervisor import ReplicaSupervisor
+
+        clock = [0.0]
+        sup = ReplicaSupervisor(2, max_failures=2, quarantine_s=1.0,
+                                clock=lambda: clock[0])
+        sup.note_failure(0)
+        assert sup.admitted(0)
+        sup.note_failure(0)
+        assert not sup.admitted(0)
+        assert sup.healthy_peers(0) == 1
+        assert not sup.probe_due(0)
+        clock[0] = 1.5
+        assert sup.probe_due(0)
+        sup.begin_probe(0)
+        sup.note_probe(0, False)  # failed probe: backoff doubles
+        clock[0] = 2.5
+        assert not sup.probe_due(0)  # needs 2s now
+        clock[0] = 3.6
+        assert sup.probe_due(0)
+        sup.begin_probe(0)
+        sup.note_probe(0, True)
+        assert sup.admitted(0)
+        assert sup.describe()[0]["readmissions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Hedged requests (RoutingFront + serving/supervisor.py HedgeTracker)
+# ---------------------------------------------------------------------------
+
+
+class _StallWorker:
+    """ServingServer wrapper whose transform stalls ``stall_s`` while
+    ``stalled`` is set — the deterministic slow replica."""
+
+    def __init__(self, stall_s=0.0):
+        from mmlspark_tpu.serving import ServingServer
+
+        self.stalled = stall_s > 0
+        self.stall_s = stall_s
+
+        def transform(df):
+            if self.stalled:
+                time.sleep(self.stall_s)
+            return _echo_transform(df)
+
+        self.server = ServingServer(transform, port=0, max_wait_ms=1.0)
+        self.server.start()
+        self.address = self.server.address
+
+    def stop(self):
+        self.server.stop(drain=False)
+
+
+class TestHedging:
+    def _front(self, http_mode="thread", **hedge_kw):
+        from mmlspark_tpu.serving import RoutingFront
+
+        hedge_kw.setdefault("init_delay_ms", 40.0)
+        hedge_kw.setdefault("min_samples", 1 << 30)  # pin the init delay
+        return RoutingFront(port=0, http_mode=http_mode, hedge=hedge_kw)
+
+    def test_hedge_under_stall_first_response_wins(self):
+        """A 300ms stall on the primary worker: the hedge fires at ~40ms
+        on the healthy peer and the client sees its reply — p99 under the
+        injected stall, duplicate work bounded to the stalled requests."""
+        fast, slow = _StallWorker(), _StallWorker(stall_s=0.3)
+        try:
+            with self._front() as front:
+                # round-robin alternates; half the primaries stall
+                front.register(slow.address)
+                front.register(fast.address)
+                lat = []
+                for i in range(8):
+                    t0 = time.perf_counter()
+                    status, body, _ = _post_status(front.address,
+                                                   {"data": [i]}, timeout=15)
+                    lat.append(time.perf_counter() - t0)
+                    assert status == 200 and body["sum"] == float(i)
+                # every request beat the stall (hedge or fast primary)
+                assert max(lat) < 0.28, [round(x, 3) for x in lat]
+                s = front._hedge.summary()
+                assert s["wins_hedge"] >= 1       # stalled primaries lost
+                assert s["wins_primary"] >= 1     # fast primaries won
+                assert s["hedged"] <= 5           # only the slow half hedged
+        finally:
+            fast.stop()
+            slow.stop()
+
+    def test_hedge_under_stall_async_front(self):
+        fast, slow = _StallWorker(), _StallWorker(stall_s=0.3)
+        try:
+            with self._front(http_mode="async") as front:
+                front.register(slow.address)
+                front.register(fast.address)
+                lat = []
+                for i in range(8):
+                    t0 = time.perf_counter()
+                    status, body, _ = _post_status(front.address,
+                                                   {"data": [i]}, timeout=15)
+                    lat.append(time.perf_counter() - t0)
+                    assert status == 200 and body["sum"] == float(i)
+                assert max(lat) < 0.28, [round(x, 3) for x in lat]
+                assert front._hedge.summary()["wins_hedge"] >= 1
+        finally:
+            fast.stop()
+            slow.stop()
+
+    def test_fast_fleet_never_hedges(self):
+        """Duplicate-work bound: against healthy sub-delay workers, zero
+        hedges launch."""
+        a, b = _StallWorker(), _StallWorker()
+        try:
+            with self._front(init_delay_ms=250.0) as front:
+                front.register(a.address)
+                front.register(b.address)
+                for i in range(10):
+                    status, _, _ = _post_status(front.address, {"data": [i]})
+                    assert status == 200
+                s = front._hedge.summary()
+                assert s["hedged"] == 0 and s["requests"] == 10
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_front_hedge_injection_suppresses_deterministically(self):
+        """A raising FRONT_HEDGE plan blocks the hedge launch: the stalled
+        primary answers after its full stall, and the suppression is
+        visible in both the injector log and the tracker."""
+        fast, slow = _StallWorker(), _StallWorker(stall_s=0.25)
+        try:
+            with self._front() as front:
+                front.register(slow.address)   # rotation starts here
+                front.register(fast.address)
+                with FaultInjector(seed=CHAOS_SEED).plan(
+                        faults.FRONT_HEDGE, every=1) as inj:
+                    t0 = time.perf_counter()
+                    status, body, _ = _post_status(front.address,
+                                                   {"data": [1]}, timeout=15)
+                    took = time.perf_counter() - t0
+                    assert status == 200 and body["sum"] == 1.0
+                    assert took >= 0.22  # paid the stall: hedge suppressed
+                    assert len(inj.fired(faults.FRONT_HEDGE)) == 1
+                assert front._hedge.summary()["suppressed"] == 1
+        finally:
+            fast.stop()
+            slow.stop()
+
+    def test_hedge_failed_primary_recovers_via_hedge(self):
+        """Primary connection-refused + hedge response: the hedge answer
+        wins even when the primary fails outright (not just slowly)."""
+        fast = _StallWorker()
+        try:
+            with self._front(init_delay_ms=20.0) as front:
+                front.register("http://127.0.0.1:9/")  # dead primary
+                front.register(fast.address)
+                status, body, _ = _post_status(front.address, {"data": [2]},
+                                               timeout=15)
+                assert status == 200 and body["sum"] == 2.0
+        finally:
+            fast.stop()
+
+    def test_quantile_delay_tracks_observed_latency(self):
+        from mmlspark_tpu.serving.supervisor import HedgeConfig, HedgeTracker
+
+        t = HedgeTracker(HedgeConfig(quantile=0.9, min_samples=10,
+                                     init_delay_ms=77.0, min_delay_ms=1.0))
+        assert t.delay_s() == pytest.approx(0.077)  # under min_samples
+        for ms in range(1, 101):  # 1..100ms uniform
+            t.observe(ms / 1e3)
+        assert t.delay_s() == pytest.approx(0.091, rel=0.02)  # ~p90
+
+    def test_hedge_config_validation(self):
+        from mmlspark_tpu.serving.supervisor import HedgeConfig, make_hedge
+
+        with pytest.raises(ValueError):
+            HedgeConfig(quantile=1.5)
+        with pytest.raises(ValueError):
+            HedgeConfig(min_delay_ms=10.0, max_delay_ms=1.0)
+        assert make_hedge(None) is None
+        assert make_hedge(False) is None
+        assert make_hedge(True) is not None
+        with pytest.raises(ValueError):
+            make_hedge(42)
+
+
+# ---------------------------------------------------------------------------
+# AsyncConnectionPool: stale-socket retry honors the request deadline
+# ---------------------------------------------------------------------------
+
+
+class TestPoolDeadlineGate:
+    class _DeadWriter:
+        def write(self, b):
+            pass
+
+        async def drain(self):
+            pass
+
+        def close(self):
+            pass
+
+        def is_closing(self):
+            return False
+
+    class _ClosedReader:
+        async def readline(self):
+            return b""  # peer closed before the status line
+
+    def _pool_with_stale_checkout(self):
+        import asyncio  # noqa: F401 — exercised via asyncio.run below
+
+        from mmlspark_tpu.serving.aio import AsyncConnectionPool
+
+        pool = AsyncConnectionPool()
+        calls = []
+
+        async def checkout(key, force_fresh):
+            calls.append(force_fresh)
+            return (False, (self._ClosedReader(), self._DeadWriter()))
+
+        pool._checkout = checkout
+        return pool, calls
+
+    def test_expired_deadline_blocks_stale_retry(self):
+        import asyncio
+
+        pool, calls = self._pool_with_stale_checkout()
+        dl = Deadline(time.time() - 1)
+        with pytest.raises(OSError, match="deadline expired"):
+            asyncio.run(pool._request(("h", 80), "POST", "/", b"", None,
+                                      deadline=dl))
+        # the single retry NEVER fired: one checkout, no fresh connection
+        assert calls == [False]
+
+    def test_live_deadline_allows_stale_retry(self):
+        import asyncio
+
+        pool, calls = self._pool_with_stale_checkout()
+        dl = Deadline.from_timeout(30)
+        with pytest.raises(OSError):
+            asyncio.run(pool._request(("h", 80), "POST", "/", b"", None,
+                                      deadline=dl))
+        assert calls == [False, True]  # retried once on a fresh connection
+
+    def test_no_deadline_keeps_legacy_single_retry(self):
+        import asyncio
+
+        pool, calls = self._pool_with_stale_checkout()
+        with pytest.raises(OSError):
+            asyncio.run(pool._request(("h", 80), "POST", "/", b"", None))
+        assert calls == [False, True]
+
+
+# ---------------------------------------------------------------------------
+# ReplicaSet placement: a raising device skips, not fails
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaPlacementSkip:
+    def test_failing_device_is_skipped_with_survivors(self):
+        from mmlspark_tpu.serving import ReplicaSet
+
+        def factory(i, dev):
+            if dev == "bad-dev":
+                raise RuntimeError(f"device {dev} driver init failed")
+            return lambda df: df
+
+        rs = ReplicaSet(transform_factory=factory, n=3,
+                        devices=["dev0", "bad-dev", "dev2"])
+        assert [r.index for r in rs.replicas] == [0, 2]
+        assert [r.device for r in rs.replicas] == ["dev0", "dev2"]
+        assert len(rs.placement_failures) == 1
+        f = rs.placement_failures[0]
+        assert f["replica"] == 1 and f["device"] == "bad-dev"
+        assert "driver init failed" in f["error"]
+
+    def test_zero_survivors_raises(self):
+        from mmlspark_tpu.serving import ReplicaSet
+
+        def factory(i, dev):
+            raise RuntimeError("no devices at all")
+
+        with pytest.raises(RuntimeError, match="every replica placement"):
+            ReplicaSet(transform_factory=factory, n=2,
+                       devices=["d0", "d1"])
+
+    def test_degraded_placement_surfaces_in_executor_stats(self):
+        """A degraded ReplicaSet rides into the executor's stats payload
+        (placement_failures) and the survivors still dispatch."""
+        from mmlspark_tpu.core.dataframe import DataFrame
+        from mmlspark_tpu.serving import ServingServer
+        from mmlspark_tpu.serving.executor import PipelinedExecutor, ReplicaSet
+
+        def factory(i, dev):
+            if i == 0:
+                raise RuntimeError("chip 0 wedged at init")
+            return _echo_transform
+
+        rs = ReplicaSet(transform_factory=factory, n=2, devices=[None, None])
+        assert rs.placement_failures and len(rs.replicas) == 1
+        srv = ServingServer(_echo_transform, port=0)  # not started: scaffold
+        ex = PipelinedExecutor(srv, rs)
+        stats = ex.stats()
+        assert stats["placement_failures"][0]["replica"] == 0
+        # the surviving replica still runs transforms
+        out = rs.run(rs.replicas[0], DataFrame.from_dict(
+            {"id": np.array([1], dtype=np.int64),
+             "value": np.array([b'{"data": [1, 2]}'], dtype=object),
+             "headers": np.array([{}], dtype=object),
+             "origin": np.array([""], dtype=object)}))
+        assert out.collect()["reply"][0]["sum"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# Brownout controller (serving/supervisor.py)
+# ---------------------------------------------------------------------------
+
+
+class _FakeSLO:
+    def __init__(self):
+        self.burn = 0.0
+
+    def burn_rates(self):
+        return {60: self.burn}
+
+
+class TestBrownout:
+    def _controller(self, slo, log, clock, **kw):
+        from mmlspark_tpu.serving.supervisor import (BrownoutController,
+                                                     BrownoutStep)
+
+        steps = [BrownoutStep(f"s{i}",
+                              lambda i=i: log.append(("apply", i)),
+                              lambda i=i: log.append(("revert", i)))
+                 for i in range(2)]
+        kw.setdefault("enter_burn", 2.0)
+        kw.setdefault("exit_burn", 0.5)
+        kw.setdefault("hold_s", 1.0)
+        kw.setdefault("check_interval_s", 0.0)
+        return BrownoutController(slo, steps, clock=lambda: clock[0], **kw)
+
+    def test_degrades_stepwise_and_restores_with_hysteresis(self):
+        slo, log, clock = _FakeSLO(), [], [10.0]
+        c = self._controller(slo, log, clock)
+        slo.burn = 5.0
+        assert c.check() == "degrade" and c.step == 1
+        clock[0] += 0.5
+        assert c.check() is None  # hold_s not elapsed: one step at a time
+        clock[0] += 0.6
+        assert c.check() == "degrade" and c.step == 2
+        clock[0] += 2.0
+        assert c.check() is None  # ladder exhausted, burn still high
+        # burn drops: restore needs 2*hold_s BELOW exit continuously
+        slo.burn = 0.1
+        assert c.check() is None          # starts the below-window
+        clock[0] += 1.0
+        assert c.check() is None          # 1.0 < 2*hold_s
+        clock[0] += 1.1
+        assert c.check() == "restore" and c.step == 1
+        # mid-band burn (between exit and enter): hold steady
+        slo.burn = 1.0
+        clock[0] += 5.0
+        assert c.check() is None and c.step == 1
+        assert log == [("apply", 0), ("apply", 1), ("revert", 1)]
+        tr = c.summary()["transitions"]
+        assert tr == {"degrade": 2, "restore": 1, "rollback": 0}
+
+    def test_journal_and_one_step_rollback(self):
+        slo, log, clock = _FakeSLO(), [], [10.0]
+        c = self._controller(slo, log, clock)
+        slo.burn = 9.0
+        c.check()
+        assert [e["action"] for e in c.summary()["journal"]] == ["degrade"]
+        assert c.rollback() is True and c.step == 0
+        assert log == [("apply", 0), ("revert", 0)]
+        assert c.rollback() is False  # nothing left to roll back
+        actions = [e["action"] for e in c.summary()["journal"]]
+        assert actions == ["degrade", "rollback"]
+
+    def test_a_failing_step_never_kills_the_tick(self):
+        from mmlspark_tpu.serving.supervisor import (BrownoutController,
+                                                     BrownoutStep)
+
+        slo, clock = _FakeSLO(), [10.0]
+
+        def boom():
+            raise RuntimeError("knob exploded")
+
+        c = BrownoutController(slo, [BrownoutStep("bad", boom, boom)],
+                               enter_burn=2.0, exit_burn=0.5, hold_s=0.0,
+                               check_interval_s=0.0,
+                               clock=lambda: clock[0])
+        slo.burn = 9.0
+        assert c.check() == "degrade"  # transition recorded, error eaten
+        assert c.step == 1
+
+    def test_requires_slo_and_hysteresis_band(self):
+        from mmlspark_tpu.serving.supervisor import BrownoutController
+
+        with pytest.raises(ValueError, match="requires an SLO"):
+            BrownoutController(None, [])
+        with pytest.raises(ValueError, match="hysteresis"):
+            BrownoutController(_FakeSLO(), [], enter_burn=1.0,
+                               exit_burn=1.0)
+
+    def test_server_brownout_engages_under_breach_and_surfaces(self):
+        """Integration: a server whose every request breaches a 1ms
+        objective degrades within a few batches — the batch window
+        collapses and /_mmlspark/stats + metrics expose the step."""
+        import urllib.request
+
+        from mmlspark_tpu.serving import ServingServer
+
+        def slowish(df):
+            time.sleep(0.02)
+            return _echo_transform(df)
+
+        with ServingServer(slowish, port=0, max_wait_ms=5.0,
+                           slo={"objective_ms": 1.0, "target": 0.99},
+                           brownout={"enter_burn": 1.5, "exit_burn": 0.2,
+                                     "hold_s": 0.0,
+                                     "check_interval_s": 0.0}) as srv:
+            for i in range(6):
+                status, _, _ = _post(srv.address, {"data": [i]})
+                assert status == 200
+            with urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}/_mmlspark/stats",
+                    timeout=10) as resp:
+                stats = json.loads(resp.read())
+            bo = stats["brownout"]
+            assert bo["active"] and bo["step"] >= 1
+            assert srv.max_wait_ms == 0.0  # step 1: window collapsed
+            assert bo["journal"][0]["action"] == "degrade"
+            with urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}/_mmlspark/metrics",
+                    timeout=10) as resp:
+                text = resp.read().decode()
+            assert "mmlspark_brownout_step" in text
+            assert 'mmlspark_brownout_transitions_total{direction="degrade"}' \
+                in text
+
+    def test_brownout_off_by_default_and_tenant_pressure(self):
+        from mmlspark_tpu.serving import ServingServer, TenantAdmission
+
+        with ServingServer(_echo_transform, port=0) as srv:
+            assert srv._brownout is None
+        t = TenantAdmission({"a": 1.0, "b": 1.0})
+        base = t.quota("a", 100)
+        prev = t.set_pressure(0.5)
+        assert prev == 1.0
+        assert t.quota("a", 100) == base // 2
+        t.set_pressure(prev)
+        assert t.quota("a", 100) == base
 
 
 # ---------------------------------------------------------------------------

@@ -168,6 +168,48 @@ class TestOptions:
                worker["spec"]["template"]["spec"]["containers"][0]["env"]}
         assert env["MMLSPARK_TENANTS"] == "true"
 
+    def test_supervision_env_plumbing(self):
+        _, docs = render_docs()
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        # supervision defaults ON (passive while healthy), brownout OFF
+        assert env["MMLSPARK_SUPERVISE"] == "true"
+        assert env["MMLSPARK_WATCHDOG_K"] == "8"
+        assert env["MMLSPARK_WATCHDOG_MIN_BUDGET_S"] == "1.0"
+        assert "MMLSPARK_BROWNOUT" not in env
+        _, docs = render_docs({"supervision": {"enabled": False}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MMLSPARK_SUPERVISE"] == "false"
+
+    def test_brownout_env_plumbing(self):
+        _, docs = render_docs({"brownout": {
+            "enabled": True, "enterBurn": 3.0, "windowS": 300}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MMLSPARK_BROWNOUT"] == "true"
+        assert env["MMLSPARK_BROWNOUT_ENTER"] == "3.0"
+        assert env["MMLSPARK_BROWNOUT_WINDOW_S"] == "300"
+        assert env["MMLSPARK_BROWNOUT_EXIT"] == "0.5"  # default survives
+
+    def test_hedge_env_plumbing(self):
+        _, docs = render_docs()
+        front = by_kind_name(docs, "Deployment", "-front")
+        fenv = {e["name"]: e.get("value") for e in
+                front["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert "MMLSPARK_HEDGE" not in fenv  # opt-in: duplicates by design
+        _, docs = render_docs({"hedge": {
+            "enabled": True, "quantile": 0.9, "initDelayMs": 25}})
+        front = by_kind_name(docs, "Deployment", "-front")
+        fenv = {e["name"]: e.get("value") for e in
+                front["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert fenv["MMLSPARK_HEDGE"] == "true"
+        assert fenv["MMLSPARK_HEDGE_QUANTILE"] == "0.9"
+        assert fenv["MMLSPARK_HEDGE_INIT_DELAY_MS"] == "25"
+
     def test_bootstrap_python_compiles(self):
         """The pod commands are Python source built by the templates; a
         template expression the renderer can't evaluate (the old

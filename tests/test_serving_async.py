@@ -107,6 +107,45 @@ class TestAsyncSyncParity:
             async_replies = collect(asy)
         assert sync_replies == async_replies  # status AND raw bytes
 
+    def test_supervised_idle_is_bitwise_identical(self):
+        """Supervisor-idle parity (the PR-10 acceptance gate): with no
+        faults injected and brownout disabled, the supervised default
+        server's replies are byte-identical to supervise=False AND to the
+        sync loop — supervision is detection-only until something wedges.
+        Same pattern as the uncalibrated-tuner parity tests."""
+        payloads = [{"data": [i, -i, i * 0.5]} for i in range(10)]
+        payloads.append({"data": []})
+
+        def collect(server):
+            return [post(server.address, p) for p in payloads]
+
+        with ServingServer(echo_transform, port=0, max_wait_ms=1.0) as sync:
+            sync_replies = collect(sync)
+        with ServingServer(echo_transform, port=0, max_wait_ms=1.0,
+                           async_exec=True, inflight=2,
+                           replicas=2) as supervised:
+            supervised_replies = collect(supervised)
+            ex = supervised._executor
+            assert ex.supervisor is not None  # the default IS supervised
+            assert ex.watchdog is not None
+            assert ex.watchdog.trips == 0     # and it stayed idle
+            sup = ex.supervisor.summary()
+            assert sup["ejections"] == 0 and sup["quarantined"] == 0
+        with ServingServer(echo_transform, port=0, max_wait_ms=1.0,
+                           async_exec=True, inflight=2, replicas=2,
+                           supervise=False) as bare:
+            bare_replies = collect(bare)
+            assert bare._executor.supervisor is None
+        assert supervised_replies == bare_replies == sync_replies
+
+    def test_brownout_disabled_default_leaves_knobs_alone(self):
+        with ServingServer(echo_transform, port=0, max_wait_ms=3.25,
+                           async_exec=True) as srv:
+            for i in range(3):
+                post(srv.address, {"data": [i]})
+            assert srv._brownout is None
+            assert srv.max_wait_ms == 3.25  # untouched
+
     def test_error_batches_return_500_like_sync(self):
         def explode(df):
             raise RuntimeError("model exploded")

@@ -3,10 +3,11 @@
 # with one command on a dev checkout (reference analogue: the sbt tasks the
 # pipeline calls, runnable locally).
 #
-#   tools/ci/run_ci.sh            # analysis + full matrix + flaky lane + smoke
+#   tools/ci/run_ci.sh            # analysis + full matrix + chaos + flaky + smoke
 #   tools/ci/run_ci.sh analysis   # static-analysis gate only (style + semantic)
 #   tools/ci/run_ci.sh style      # alias for analysis (historical name)
 #   tools/ci/run_ci.sh tests      # per-package matrix only
+#   tools/ci/run_ci.sh chaos      # seeded chaos lane only (-m faults matrix)
 #   tools/ci/run_ci.sh flaky      # retried serving suites only
 set -u
 cd "$(dirname "$0")/../.."
@@ -53,6 +54,18 @@ if [ "$stage" = "tests" ] || [ "$stage" = "all" ]; then
     python -m pytest $pkg -q || rc=1
   done
   [ "$stage" = "tests" ] && exit $rc
+fi
+
+if [ "$stage" = "chaos" ] || [ "$stage" = "all" ]; then
+  echo "=== seeded chaos lane (-m faults under the injector seed matrix) ==="
+  # every scenario is deterministic PER SEED; the matrix proves the
+  # recovery paths hold under different (still replayable) fault
+  # schedules, not just the default seed's (docs/faults.md)
+  for seed in 0 7 1337; do
+    echo "--- chaos seed $seed ---"
+    MMLSPARK_CHAOS_SEED=$seed python -m pytest tests/test_faults.py -q -m faults || rc=1
+  done
+  [ "$stage" = "chaos" ] && exit $rc
 fi
 
 if [ "$stage" = "flaky" ] || [ "$stage" = "all" ]; then
