@@ -19,12 +19,23 @@ The pass resolves jittable functions **within one module**: the argument of
 a jit/DeviceFn call site must be a plain name bound by a ``def`` in the same
 file (the repo's universal idiom — closures jitted right where they are
 defined). ``prepare``/``finalize`` of DeviceFn are host shims and exempt.
+
+D001 also covers **ring staging callbacks**: the batch source and ``put``
+arguments of ``TransferRing(...)`` / ``DevicePrefetcher(...)``. Those run
+on the ring's producer thread between socket and device — a host
+allocation there (``np.empty`` / ``np.zeros`` / ``np.stack``) reintroduces
+the per-batch copy the slot-staging path exists to eliminate, silently and
+off the transform thread where profilers point. Resolution is module-local
+(plain names, ``self.X`` methods, lambdas wrapping module-local calls,
+simple ``x = f(...)`` rebinds) plus a bounded transitive closure over
+module-local callees. The accounted fallback path (slot contention →
+allocate-and-count) carries justified inline suppressions.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .astutil import call_keyword, dotted_name
 from .framework import AnalysisPass, Finding, SourceFile
@@ -89,12 +100,119 @@ def _host_call_reason(node: ast.Call) -> Optional[str]:
     return None
 
 
+#: host allocators that negate zero-copy staging when run on a ring thread
+_STAGING_ALLOCS = {"np.empty", "np.zeros", "np.stack",
+                   "numpy.empty", "numpy.zeros", "numpy.stack"}
+_RING_CLASSES = {"TransferRing", "DevicePrefetcher"}
+#: module-local call-graph hops followed from a registered callback
+_STAGING_CLOSURE_DEPTH = 3
+
+
+def _local_defs(tree: ast.AST) -> Dict[str, ast.AST]:
+    """Every ``def`` in the module (any nesting), by name. Later defs win —
+    matching the runtime's last-binding-wins for module-level names and
+    good enough for the repo's no-shadowing idiom."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def _callee_name(expr: ast.expr) -> Optional[str]:
+    """Module-local function name a callback expression resolves to:
+    ``fn`` or ``self.fn`` (methods live in the same file)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id in ("self", "cls"):
+        return expr.attr
+    return None
+
+
+def _staging_callbacks(tree: ast.AST, defs: Dict[str, ast.AST]
+                       ) -> "Tuple[Dict[str, int], List[ast.Lambda]]":
+    """(callbacks, lambdas): {def name: registration line} for functions
+    registered as ring staging callbacks — plus a bounded closure over
+    their module-local callees (the allocation usually hides one call down,
+    the batch generator behind a fill-ahead wrapper) — and the lambda
+    callbacks, whose bodies are checked in place."""
+    # simple rebind map: `src = self._batches(...)` / `a, b = f(...)`
+    # lets a Name argument resolve through one local assignment
+    assigned: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fn = _callee_name(node.value.func)
+            if fn is None or fn not in defs:
+                continue
+            for t in node.targets:
+                for n2 in ast.walk(t):
+                    if isinstance(n2, ast.Name) \
+                            and isinstance(n2.ctx, ast.Store):
+                        assigned.setdefault(n2.id, fn)
+
+    seeds: Dict[str, int] = {}
+    lambdas: List[ast.Lambda] = []
+
+    def mark(arg: Optional[ast.expr], line: int) -> None:
+        if arg is None:
+            return
+        if isinstance(arg, ast.Lambda):
+            lambdas.append(arg)
+            # a lambda wrapping a module-local call stages through it
+            for inner in ast.walk(arg.body):
+                if isinstance(inner, ast.Call):
+                    fn = _callee_name(inner.func)
+                    if fn in defs:
+                        seeds.setdefault(fn, line)
+            return
+        fn = _callee_name(arg if not isinstance(arg, ast.Call)
+                          else arg.func)
+        if fn is not None and fn not in defs:
+            fn = assigned.get(fn) if isinstance(arg, ast.Name) else None
+        if fn in defs:
+            seeds.setdefault(fn, line)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        if callee.rsplit(".", 1)[-1] not in _RING_CLASSES:
+            continue
+        if node.args:
+            mark(node.args[0], node.lineno)       # the batch source
+        put = call_keyword(node, "put")
+        if put is None and len(node.args) > 1:
+            put = node.args[1]                    # TransferRing(it, put, ..)
+        mark(put, node.lineno)
+
+    # bounded module-local closure: callbacks delegating to helpers
+    frontier = list(seeds)
+    for _ in range(_STAGING_CLOSURE_DEPTH):
+        nxt: List[str] = []
+        for name in frontier:
+            body = defs.get(name)
+            if body is None:
+                continue
+            for inner in ast.walk(body):
+                if isinstance(inner, ast.Call):
+                    fn = _callee_name(inner.func)
+                    if fn in defs and fn not in seeds:
+                        seeds[fn] = seeds[name]
+                        nxt.append(fn)
+        if not nxt:
+            break
+        frontier = nxt
+    return seeds, lambdas
+
+
 class DevicePurityPass(AnalysisPass):
     pass_ids = ("D001",)
     name = "device-purity"
     description = ("host-only APIs (time/random/IO/.item()) inside "
                    "functions that are jitted or registered as DeviceFn "
-                   "bodies")
+                   "bodies; host allocations inside ring staging "
+                   "callbacks")
 
     def applies_to(self, rel: str) -> bool:
         return rel.startswith("mmlspark_tpu/") and \
@@ -104,6 +222,7 @@ class DevicePurityPass(AnalysisPass):
         findings: List[Finding] = []
         if sf.tree is None:
             return findings
+        findings.extend(self._check_staging(sf))
         jitted = _jitted_names(sf.tree)
         if not jitted:
             return findings
@@ -144,4 +263,35 @@ class DevicePurityPass(AnalysisPass):
                                 f"in-place mutation of parameter "
                                 f"'{t.value.id}' inside jittable "
                                 f"'{node.name}' — use .at[].set()"))
+        return findings
+
+    def _check_staging(self, sf: SourceFile) -> Iterable[Finding]:
+        """Host allocations inside ring staging callbacks (and the
+        module-local helpers they delegate to): each one is a per-batch
+        copy on the producer thread the slot-staging path was built to
+        remove."""
+        findings: List[Finding] = []
+        defs = _local_defs(sf.tree)
+        callbacks, lambdas = _staging_callbacks(sf.tree, defs)
+        if not callbacks and not lambdas:
+            return findings
+
+        def scan(body: ast.AST, where: str) -> None:
+            for inner in ast.walk(body):
+                if not isinstance(inner, ast.Call):
+                    continue
+                name = dotted_name(inner.func)
+                if name in _STAGING_ALLOCS:
+                    findings.append(Finding(
+                        sf.rel, inner.lineno, "D001",
+                        f"host allocation '{name}()' inside ring staging "
+                        f"callback '{where}' — staging must fill "
+                        f"pre-allocated slots, not allocate per batch"))
+
+        for name in callbacks:
+            body = defs.get(name)
+            if body is not None:
+                scan(body, name)
+        for lam in lambdas:
+            scan(lam.body, f"<lambda:{lam.lineno}>")
         return findings

@@ -44,6 +44,7 @@ server restart or ships to a replica.
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -445,6 +446,36 @@ class SegmentCostModel:
                 return None
             device_per_row = wall * rec.n / rec.rows
         return device_per_row < host_total
+
+    def choose_mega_k(self, segment: str, max_k: int = 8,
+                      amortize_to: float = 0.15) -> Optional[int]:
+        """Dispatch-amortization factor for a segment: the K micro-batches a
+        single Python-level mega-dispatch should cover so the measured fixed
+        dispatch cost falls to ``amortize_to`` of the per-batch device work
+        (H2D + compute + readback EWMAs at the modal measured bucket).
+        Returns None when uncalibrated or the modal bucket lacks a dispatch
+        measurement; 1 when dispatch is already cheap enough."""
+        seg = str(segment)
+        if not self.calibrated(seg):
+            return None
+        with self._lock:
+            best_rec, best_n = None, 0
+            for (s, _b), rec in self._measured.items():
+                if s == seg and rec.n > best_n:
+                    best_rec, best_n = rec, rec.n
+            if best_rec is None or best_n < self.min_obs:
+                return None
+            disp = best_rec.dispatch_s
+            if disp is None or disp <= 0.0:
+                return None
+            work = sum(v for v in (best_rec.h2d_s, best_rec.compute_s,
+                                   best_rec.readback_s) if v is not None)
+        if work <= 0.0:
+            return None
+        if disp <= amortize_to * work:
+            return 1
+        k = int(math.ceil(disp / (amortize_to * work)))
+        return max(1, min(int(max_k), k))
 
     # -- introspection / serialization -----------------------------------
     def host_ms_per_row(self, stage: str) -> Optional[float]:

@@ -304,7 +304,7 @@ class SegmentExecutor:
 
     def __init__(self, segment: Segment, cache: Optional[CompileCache] = None,
                  buckets: Optional[Tuple[int, ...]] = None,
-                 cost_model=None):
+                 cost_model=None, slot_pool=None, mega_k: int = 1):
         self.segment = segment
         self.cache = cache if cache is not None else compile_cache()
         self.fallbacks: List[str] = []
@@ -313,6 +313,12 @@ class SegmentExecutor:
         self.buckets = tuple(sorted(buckets)) if buckets else None
         # cost model fed by host-fallback timings (the fuse-vs-host term)
         self.cost_model = cost_model
+        # pre-allocated H2D staging slots (parallel/ingest.py SlotPool);
+        # None = the legacy allocating path, bitwise-identical
+        self.slot_pool = slot_pool
+        # K-step mega-dispatch factor for the submit path (auto-tuner knob,
+        # core/costmodel.py choose_mega_k); 1 = today's per-batch dispatch
+        self.mega_k = max(1, int(mega_k or 1))
 
     def _cost_attrs(self) -> Dict[str, Any]:
         """XLA cost attrs for this segment's trace spans (mean per-batch
@@ -459,16 +465,59 @@ class SegmentExecutor:
             "keys": [k for k, _ in readback]}
         if n_valid > 0:
             allow_sparse = all(not d.reject_sparse for d in seg.dfns)
-            state["dense"] = {c: _stack_col(sub[c], allow_sparse)
-                              for c in ext}
+            dense: Dict[str, np.ndarray] = {}
+            deposit: Dict[str, List[np.ndarray]] = {}
+            for c in ext:
+                rows = self._deposit_rows(sub[c]) \
+                    if self.slot_pool is not None else None
+                if rows is not None:
+                    # slot-eligible: the stack deferred to _batches, which
+                    # fills a pre-allocated SlotPool buffer directly (the
+                    # one host copy); everything else stacks here as before
+                    deposit[c] = rows
+                else:
+                    dense[c] = _stack_col(sub[c], allow_sparse)
+            state["dense"] = dense
+            state["deposit"] = deposit
         return state
 
-    def _batches(self, state: Dict[str, Any]):
-        """Padded/bucketed Batch stream over the partition's dense arrays."""
+    @staticmethod
+    def _deposit_rows(col: np.ndarray) -> Optional[List[np.ndarray]]:
+        """Rows eligible for slot deposit: an object column of uniform,
+        dense ndarray rows whose dtype ships as-is (no f64->f32 / i64->i32
+        narrowing and no sparse densify — those transforms need their own
+        allocation), so filling the staging slot IS the single host copy.
+        Every fallback decision is made HERE, before any generator runs on
+        a ring thread. None = take ``_stack_col`` (the copying path)."""
+        if col.dtype != object or len(col) == 0:
+            return None
+        rows = list(col)
+        first = rows[0]
+        if not isinstance(first, np.ndarray):
+            return None
+        shape, dt = first.shape, first.dtype
+        if dt == object or dt in (np.dtype(np.float64), np.dtype(np.int64)):
+            return None
+        for r in rows[1:]:
+            if not isinstance(r, np.ndarray) or r.shape != shape \
+                    or r.dtype != dt:
+                return None
+        return rows
+
+    def _batches(self, state: Dict[str, Any], stats=None):
+        """Padded/bucketed Batch stream over the partition's dense arrays.
+
+        Deposit-eligible columns (``state["deposit"]``) fill a pre-allocated
+        SlotPool buffer in place — stack + pad collapse into one copy into
+        the reusable H2D staging slot; slot contention (acquire timeout)
+        falls back to the allocating path with an accounted copy
+        (``IngestStats.note_copy``)."""
         from ..parallel.batching import Batch, next_bucket, pad_batch
+        from ..parallel.ingest import rows_to_batch
 
         batch_size = self.segment.batch_size()
         dense, ext = state["dense"], state["ext"]
+        deposit = state.get("deposit") or {}
         n_valid = state["n_valid"]
         for start in range(0, n_valid, batch_size):
             stop = min(start + batch_size, n_valid)
@@ -476,16 +525,53 @@ class SegmentExecutor:
             target = batch_size if m == batch_size \
                 else min(next_bucket(m, buckets=self.buckets), batch_size)
             arrays = {c: pad_batch(dense[c][start:stop], target)
-                      for c in ext}
+                      for c in dense}
+            lease = None
+            if deposit:
+                spec = {c: ((target,) + rows[0].shape, rows[0].dtype)
+                        for c, rows in deposit.items()}
+                lease = self.slot_pool.acquire(spec, stats=stats) \
+                    if self.slot_pool is not None else None
+                if lease is not None:
+                    lease.fill_begin()
+                    for c, rows in deposit.items():
+                        buf = lease.arrays[c]
+                        rows_to_batch(rows[start:stop], out=buf,
+                                      stats=stats)
+                        if m < target:
+                            buf[m:] = 0  # pad parity with pad_batch zeros
+                        arrays[c] = buf
+                    lease.fill_end()
+                    if stats is not None:
+                        stats.note_deposit()
+                else:
+                    for c, rows in deposit.items():
+                        arrays[c] = pad_batch(
+                            rows_to_batch(rows[start:stop], stats=stats),
+                            target)
+                    if stats is not None:
+                        stats.note_copy()
+            # analysis: allow D001 -- host-side validity mask, never shipped
             mask = np.zeros(target, dtype=bool)
             mask[:m] = True
-            yield Batch(arrays, mask, m)
+            yield Batch(arrays, mask, m, staging=lease)
 
     @staticmethod
     def _put(batch):
         import jax
 
         return jax.device_put(batch.arrays), batch.num_valid
+
+    @staticmethod
+    def _sig_of(x, ext) -> Tuple:
+        """Shape signature of one staged input dict (CompileCache key)."""
+        return tuple((c, tuple(np.shape(x[c])), str(x[c].dtype))
+                     for c in ext)
+
+    @staticmethod
+    def _shape_key_of(sig) -> str:
+        return ";".join(f"{c}={'x'.join(str(d) for d in shp)}:{dt}"
+                        for c, shp, dt in sig)
 
     def _make_step(self, params_dev, state: Dict[str, Any]):
         """Dispatch closure: staged batch -> (device outputs, num_valid).
@@ -495,23 +581,55 @@ class SegmentExecutor:
 
         def step(staged):
             x, m = staged
-            sig = tuple((c, tuple(np.shape(x[c])), str(x[c].dtype))
-                        for c in ext)
-            shape_key = ";".join(
-                f"{c}={'x'.join(str(d) for d in shp)}:{dt}"
-                for c, shp, dt in sig)
+            sig = self._sig_of(x, ext)
             compiled = self.cache.get(
                 (seg.key, sig), lambda: self._build(params_dev, x, keys),
-                label=seg.label, shape=shape_key)
+                label=seg.label, shape=self._shape_key_of(sig))
             with profiling.annotate(f"fused:{seg.label}"):
                 return compiled(params_dev, x), m
 
         return step
 
+    def _make_mega_step(self, params_dev, state: Dict[str, Any], k: int):
+        """K-step dispatch closure: a list of K same-signature staged
+        batches -> tuple of K output tuples, through ONE compiled call.
+        The shape key is prefixed so the cost model's bucket parser skips
+        mega records (their flops are K batches' worth — folding them into
+        a single-batch bucket would skew the analytic roofline)."""
+        seg, ext, keys = self.segment, state["ext"], state["keys"]
+
+        def mega(group):
+            xs = [x for (x, _m), _t in group]
+            sig = self._sig_of(xs[0], ext)
+            compiled = self.cache.get(
+                (seg.key, sig, ("mega", k)),
+                lambda: self._build_mega(params_dev, xs[0], keys, k),
+                label=seg.label,
+                shape=f"mega{k};{self._shape_key_of(sig)}")
+            cols_seq = tuple({c: x[c] for c in ext} for x in xs)
+            with profiling.annotate(f"fused:{seg.label}:mega{k}"):
+                return compiled(params_dev, cols_seq)
+
+        return mega
+
     @staticmethod
     def _fetch(handle):
         ys, m = handle
         return tuple(np.asarray(y)[:m] for y in ys)
+
+    def _fill_ahead(self, state: Dict[str, Any], stats):
+        """Batch source for one partition: the plain generator, wrapped in
+        a background fill thread when slot deposit is active — slot N+1
+        fills while slot N transfers (the paired-buffer overlap; the
+        SlotPool's two buffers per bucket pace the lookahead). Returns
+        (iterator, closer)."""
+        src = self._batches(state, stats)
+        if not state.get("deposit"):
+            return src, None
+        from ..parallel.batching import DevicePrefetcher
+
+        filler = DevicePrefetcher(src, depth=1)
+        return iter(filler), filler
 
     def _run_partition(self, part: Dict[str, np.ndarray], params_dev,
                        stats) -> Dict[str, np.ndarray]:
@@ -521,7 +639,8 @@ class SegmentExecutor:
         collected: Dict[str, List[np.ndarray]] = {k: []
                                                   for k in state["keys"]}
         if state["n_valid"] > 0:
-            ring = TransferRing(self._batches(state), put=self._put,
+            src, filler = self._fill_ahead(state, stats)
+            ring = TransferRing(src, put=self._put,
                                 step=self._make_step(params_dev, state),
                                 fetch=self._fetch,
                                 depth=self.segment.ring_depth(), stats=stats)
@@ -533,6 +652,8 @@ class SegmentExecutor:
                 raise _HostFallback(str(e))
             finally:
                 ring.close()
+                if filler is not None:
+                    filler.close()
         return self._emit_partition(state, collected)
 
     def submit_run(self, df: DataFrame, stats):
@@ -554,6 +675,7 @@ class SegmentExecutor:
         wall0 = time.perf_counter()
         t_wall = time.time()
         params_dev = jax.device_put(tuple(d.params for d in seg.dfns))
+        mega_k = max(1, int(self.mega_k or 1))
         pendings: List[Tuple[str, Any, Any]] = []
         for part in df.partitions:
             try:
@@ -561,13 +683,29 @@ class SegmentExecutor:
                 handles = []
                 if state["n_valid"] > 0:
                     step = self._make_step(params_dev, state)
-                    for batch in self._batches(state):
-                        staged, timing = timed_stage(self._put, batch,
-                                                     obs=obs)
-                        td = time.perf_counter()
-                        handle = step(staged)
-                        timing.dispatch_s = time.perf_counter() - td
-                        handles.append((handle, timing))
+                    src, filler = self._fill_ahead(state, stats)
+                    try:
+                        if mega_k <= 1:
+                            # K=1: today's stage-then-dispatch loop,
+                            # verbatim — bitwise-identical by construction
+                            for batch in src:
+                                staged, timing = timed_stage(
+                                    self._put, batch, obs=obs)
+                                td = time.perf_counter()
+                                handle = step(staged)
+                                timing.dispatch_s = \
+                                    time.perf_counter() - td
+                                handles.append((handle, timing))
+                        else:
+                            staged_all = [
+                                timed_stage(self._put, batch, obs=obs)
+                                for batch in src]
+                            self._dispatch_mega(staged_all, params_dev,
+                                                state, step, mega_k,
+                                                handles)
+                    finally:
+                        if filler is not None:
+                            filler.close()
                 pendings.append(("device", state, handles))
             except _HostFallback as e:
                 self.fallbacks.append(f"{seg.label}: {e}")
@@ -605,6 +743,40 @@ class SegmentExecutor:
             return self._overlay(df, out_parts)
 
         return resolve
+
+    def _dispatch_mega(self, staged_all, params_dev, state: Dict[str, Any],
+                       step, k: int, handles) -> None:
+        """Dispatch staged batches in K-step groups: consecutive
+        same-signature batches go through the compiled K-step program (one
+        Python-level dispatch for K micro-batches); leftover runs shorter
+        than K dispatch singly through the ordinary step — the SAME
+        per-batch executable as K=1, so outputs are identical either way.
+        The measured mega dispatch time is split evenly across the K
+        timings (the amortization the bottleneck attribution shows)."""
+        ext = state["ext"]
+        mega = self._make_mega_step(params_dev, state, k)
+        i = 0
+        while i < len(staged_all):
+            sig0 = self._sig_of(staged_all[i][0][0], ext)
+            group = [staged_all[i]]
+            while len(group) < k and i + len(group) < len(staged_all) and \
+                    self._sig_of(staged_all[i + len(group)][0][0],
+                                 ext) == sig0:
+                group.append(staged_all[i + len(group)])
+            i += len(group)
+            if len(group) == k:
+                td = time.perf_counter()
+                outs = mega(group)
+                share = (time.perf_counter() - td) / k
+                for (staged, timing), ys in zip(group, outs):
+                    timing.dispatch_s = share
+                    handles.append(((ys, staged[1]), timing))
+            else:
+                for staged, timing in group:
+                    td = time.perf_counter()
+                    handle = step(staged)
+                    timing.dispatch_s = time.perf_counter() - td
+                    handles.append((handle, timing))
 
     def _emit_partition(self, state: Dict[str, Any],
                         collected: Dict[str, List[np.ndarray]]
@@ -675,6 +847,41 @@ class SegmentExecutor:
             jax.eval_shape(jitted, params_dev, specs)  # trace gates fire NOW
             return jitted
 
+    def _build_mega(self, params_dev, x: Dict[str, Any], keys: List[str],
+                    k: int):
+        """AOT-compile the K-step mega program: K replicas of ``_build``'s
+        per-batch fused body, traced over a K-tuple of same-shape input
+        dicts in one callable — one Python dispatch executes K queued
+        micro-batches (the fixed dispatch cost amortizes K-fold). Each
+        replica's ops are exactly the per-batch program's, so per-batch
+        outputs match the K=1 path."""
+        import jax
+
+        seg = self.segment
+
+        def fused_k(params_tuple, cols_seq):
+            outs = []
+            for cols in cols_seq:
+                env = dict(cols)
+                for dfn, p in zip(seg.dfns, params_tuple):
+                    env.update(dfn.fn(p, env))
+                outs.append(tuple(env[kk] for kk in keys))
+            return tuple(outs)
+
+        jitted = jax.jit(fused_k)
+        spec = {c: jax.ShapeDtypeStruct(
+            tuple(np.shape(v)),
+            np.asarray(v).dtype if not hasattr(v, "dtype") else v.dtype)
+            for c, v in x.items()}
+        specs = tuple(dict(spec) for _ in range(k))
+        try:
+            return jitted.lower(params_dev, specs).compile()
+        except FusionUnsupported:
+            raise
+        except Exception:
+            jax.eval_shape(jitted, params_dev, specs)
+            return jitted
+
 
 # ---------------------------------------------------------------------------
 # FusedPipelineModel
@@ -692,7 +899,7 @@ class FusedPipelineModel(PipelineModel):
     _abstract = True
 
     def __init__(self, stages=None, cache: Optional[CompileCache] = None,
-                 cost_model=None, **kwargs):
+                 cost_model=None, slot_staging: bool = True, **kwargs):
         super().__init__(stages, **kwargs)
         self._cache = cache if cache is not None else compile_cache()
         self._plans: Dict[Tuple, List[Any]] = {}
@@ -701,22 +908,31 @@ class FusedPipelineModel(PipelineModel):
         self._last_plan: Optional[List[Any]] = None
         # auto-tuning state (core/tune.py Tuner drives these): a cost model
         # feeding plan()'s fuse-vs-host comparison + host-stage timings,
-        # per-segment bucket-set overrides, and fuse overrides. All default
-        # OFF: an untuned model plans and buckets bitwise-identically.
+        # per-segment bucket-set overrides, fuse overrides, and per-segment
+        # K-step mega-dispatch factors. All default OFF: an untuned model
+        # plans, buckets, and dispatches bitwise-identically.
         self._cost_model = cost_model
         self._bucket_overrides: Dict[str, Tuple[int, ...]] = {}
         self._fuse_overrides: Dict[str, bool] = {}
+        self._mega_k_overrides: Dict[str, int] = {}
+        # pre-allocated H2D staging (parallel/ingest.py SlotPool), shared
+        # across segments/executors; ``slot_staging=False`` pins the legacy
+        # allocating path (the bench A/B arm)
+        self.slot_staging = bool(slot_staging)
+        self._slot_pool = None
 
     def fuse(self) -> "FusedPipelineModel":
         return self
 
     def set_tuning(self, buckets: Optional[Dict[str, Tuple[int, ...]]] = None,
                    fuse: Optional[Dict[str, bool]] = None,
-                   cost_model=None) -> None:
+                   cost_model=None,
+                   mega_k: Optional[Dict[str, int]] = None) -> None:
         """Apply tuned knobs (Tuner.apply): per-segment-label bucket sets,
-        fuse-vs-demote overrides, and/or the cost model itself. Passing None
-        leaves a knob unchanged; passing {} clears it. Cached plans are
-        invalidated (compiled executables survive in the CompileCache)."""
+        fuse-vs-demote overrides, per-segment K-step mega-dispatch factors,
+        and/or the cost model itself. Passing None leaves a knob unchanged;
+        passing {} clears it. Cached plans are invalidated (compiled
+        executables survive in the CompileCache)."""
         if buckets is not None:
             self._bucket_overrides = {
                 str(k): tuple(sorted(int(b) for b in v))
@@ -724,6 +940,9 @@ class FusedPipelineModel(PipelineModel):
         if fuse is not None:
             self._fuse_overrides = {str(k): bool(v)
                                     for k, v in fuse.items()}
+        if mega_k is not None:
+            self._mega_k_overrides = {str(k): max(1, int(v))
+                                      for k, v in mega_k.items()}
         if cost_model is not None:
             self._cost_model = cost_model
         self._plans.clear()
@@ -731,6 +950,21 @@ class FusedPipelineModel(PipelineModel):
     @property
     def cost_model(self):
         return self._cost_model
+
+    @property
+    def mega_k_max(self) -> int:
+        """Largest active K-step dispatch factor (1 when untuned). Serving's
+        DispatchWatchdog scales its budget by this so a K-batch mega-dispatch
+        is not mistaken for a hang."""
+        return max(self._mega_k_overrides.values(), default=1)
+
+    def _get_slot_pool(self):
+        if not self.slot_staging:
+            return None
+        if self._slot_pool is None:
+            from ..parallel.ingest import SlotPool
+            self._slot_pool = SlotPool()
+        return self._slot_pool
 
     def _plan_for(self, schema: Schema) -> List[Any]:
         key = tuple(schema.types.items())
@@ -744,7 +978,9 @@ class FusedPipelineModel(PipelineModel):
         return SegmentExecutor(
             node, self._cache,
             buckets=self._bucket_overrides.get(node.label),
-            cost_model=self._cost_model)
+            cost_model=self._cost_model,
+            slot_pool=self._get_slot_pool(),
+            mega_k=self._mega_k_overrides.get(node.label, 1))
 
     def _host_node(self, node: HostStage, df: DataFrame) -> DataFrame:
         """Run one host plan node, feeding its wall time to the cost model
@@ -859,11 +1095,15 @@ class FusedPipelineModel(PipelineModel):
             "segment_costs": costs,
             "roofline": roofline,
         }
-        if self._bucket_overrides or self._fuse_overrides:
+        if (self._bucket_overrides or self._fuse_overrides
+                or self._mega_k_overrides):
             out["tuning"] = {
                 "buckets": {k: list(v)
                             for k, v in self._bucket_overrides.items()},
-                "fuse": dict(self._fuse_overrides)}
+                "fuse": dict(self._fuse_overrides),
+                "mega_k": dict(self._mega_k_overrides)}
+        if self._slot_pool is not None:
+            out["slot_pool"] = self._slot_pool.stats()
         return out
 
     @property

@@ -64,9 +64,12 @@ class KnobSet:
     #: ReplicaSet sizing suggestion (surfaced, not hot-applied: replica
     #: placement happens at server start)
     replicas: Optional[int] = None
+    #: per-segment-label K-step mega-dispatch factors (absent label = K=1,
+    #: the bitwise-identical single-step path)
+    mega_k: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     def is_default(self) -> bool:
-        return not (self.buckets or self.fuse or
+        return not (self.buckets or self.fuse or self.mega_k or
                     self.window_seed_ms is not None or
                     self.inflight is not None or self.replicas is not None)
 
@@ -76,6 +79,8 @@ class KnobSet:
             out["buckets"] = {k: list(v) for k, v in self.buckets.items()}
         if self.fuse:
             out["fuse"] = dict(self.fuse)
+        if self.mega_k:
+            out["mega_k"] = {k: int(v) for k, v in self.mega_k.items()}
         for k in ("window_seed_ms", "inflight", "replicas"):
             v = getattr(self, k)
             if v is not None:
@@ -88,6 +93,8 @@ class KnobSet:
             buckets={k: tuple(int(x) for x in v)
                      for k, v in (d.get("buckets") or {}).items()},
             fuse={k: bool(v) for k, v in (d.get("fuse") or {}).items()},
+            mega_k={k: int(v)
+                    for k, v in (d.get("mega_k") or {}).items()},
             window_seed_ms=d.get("window_seed_ms"),
             inflight=d.get("inflight"), replicas=d.get("replicas"))
 
@@ -208,6 +215,9 @@ class Tuner:
             decision = self.model.fuse_decision(label)
             if decision is not None:
                 knobs.fuse[label] = decision
+            k = self._mega_k_for(label)
+            if k is not None and k > 1:
+                knobs.mega_k[label] = k
             pred = self.model.predict(label, batch=cap)
             if pred is not None:
                 trailing_ms = pred["ms"]
@@ -226,6 +236,30 @@ class Tuner:
                     1 + round((transfer + host) / compute)))
                 knobs.replicas = self._replica_suggestion(compute, transfer)
         return knobs
+
+    def _mega_k_for(self, label: str) -> Optional[int]:
+        """Cost-model K for a segment, capped by observed queue depth (a K
+        deeper than the queue ever gets only adds latency: the mega program
+        would wait on batches that are not coming)."""
+        chooser = getattr(self.model, "choose_mega_k", None)
+        if not callable(chooser):
+            return None
+        try:
+            k = chooser(label)
+        except Exception:  # noqa: BLE001 — proposal must never raise out
+            return None
+        if k is None or k <= 1:
+            return k
+        depth = 0
+        stats = getattr(self.fused, "_seg_stats", None) or {}
+        st = stats.get(label)
+        if st is not None:
+            depth = int(getattr(st, "_occ_max", 0) or 0)
+        if depth <= 0 and self.executor is not None:
+            depth = int(getattr(self.executor, "inflight", 0) or 0)
+        if depth > 0:
+            k = min(k, depth)
+        return max(1, k)
 
     def predict_batch_ms(self, rows: int) -> Optional[float]:
         """Predicted wall ms for one serving batch of ``rows`` — the sum of
@@ -273,7 +307,11 @@ class Tuner:
             self._e2e_skip = 2
         fused = self.fused
         if fused is not None and hasattr(fused, "set_tuning"):
-            fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse)
+            try:
+                fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse,
+                                 mega_k=knobs.mega_k)
+            except TypeError:  # older fused models without the K knob
+                fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse)
         if self.controller is not None and knobs.window_seed_ms is not None:
             seed = getattr(self.controller, "seed_compute_ms", None)
             if callable(seed):

@@ -422,6 +422,7 @@ class DeviceEnsemble:
                     if m < row_chunk:  # pad: one compiled shape
                         xc = np.pad(xc, ((0, row_chunk - m), (0, 0)),
                                     constant_values=np.nan)
+                    # analysis: allow D001 -- host validity mask only
                     mask = np.zeros(row_chunk, dtype=bool)
                     mask[:m] = True
                     yield Batch({"x": xc}, mask, m)

@@ -10,9 +10,14 @@ request format, negotiated via Content-Type ``application/x-mmlspark-frame``.
 A frame is a length-prefixed header (magic + version + per-column
 name/dtype/shape table) followed by the columns' raw payload bytes — no JSON,
 no base64, so a uint8 image ships at 1x instead of the 4/3x base64-JSON tax,
-and ``decode_frame`` returns numpy VIEWS over the request buffer (zero-copy:
-the first copy on the ingest path is the batch stack that doubles as the H2D
-staging buffer, parallel/ingest.rows_to_batch).
+and ``decode_frame`` returns numpy VIEWS over the request buffer (zero-copy).
+The first copy on the ingest path is either the batch stack
+(parallel/ingest.rows_to_batch) or — on the slot-staging path — the direct
+deposit into a pre-allocated H2D staging slot:
+``decode_frame(buf, out=...)`` / ``deposit_frame`` validate the frame fully,
+check every destination (dtype/shape/contiguity/writeability), and only then
+write payload bytes straight into the slot, so a hostile frame raises
+``FrameError`` before any slot byte changes (all-or-nothing).
 
 Frame layout (all integers little-endian; docs/serving.md has the diagram):
 
@@ -281,20 +286,84 @@ def frame_info(buf: Union[bytes, bytearray, memoryview],
             "_spans": cols}
 
 
+def _payload_offset(info: Dict[str, object]) -> int:
+    """Byte offset of the first payload (fixed header + column table)."""
+    return _FIXED.size + sum(
+        1 + len(n.encode("utf-8")) + 2 + 4 * len(s) + 4
+        for n, _, s in info["columns"])
+
+
 def decode_frame(buf: Union[bytes, bytearray, memoryview],
-                 max_bytes: int = MAX_FRAME_BYTES) -> Dict[str, np.ndarray]:
+                 max_bytes: int = MAX_FRAME_BYTES,
+                 out: Optional[Dict[str, np.ndarray]] = None
+                 ) -> Dict[str, np.ndarray]:
     """Frame bytes -> {name: ndarray}. The arrays are read-only VIEWS over
     ``buf`` (np.frombuffer — zero-copy); they stay valid as long as the
     caller keeps ``buf`` alive (the serving path keeps the request body in
-    the batch rows, so views outlive the transform)."""
+    the batch rows, so views outlive the transform).
+
+    ``out``: the deposit path (``deposit_frame``) — payloads land directly
+    in the caller's pre-allocated staging arrays instead of views."""
+    if out is not None:
+        return deposit_frame(buf, out, max_bytes=max_bytes)
     info = frame_info(buf, max_bytes=max_bytes)
     mv = memoryview(buf)
-    out: Dict[str, np.ndarray] = {}
-    off = _FIXED.size + sum(
-        1 + len(n.encode("utf-8")) + 2 + 4 * len(s) + 4
-        for n, _, s in info["columns"])
+    res: Dict[str, np.ndarray] = {}
+    off = _payload_offset(info)
     for name, dt, shape, plen in info["_spans"]:
         arr = np.frombuffer(mv[off:off + plen], dtype=dt).reshape(shape)
-        out[name] = arr
+        res[name] = arr
         off += plen
-    return out
+    return res
+
+
+def deposit_frame(buf: Union[bytes, bytearray, memoryview],
+                  out: Dict[str, np.ndarray],
+                  max_bytes: int = MAX_FRAME_BYTES) -> Dict[str, np.ndarray]:
+    """Socket-to-slot decode: copy each column's payload bytes DIRECTLY
+    into a caller-owned staging destination (a pre-pinned TransferRing
+    slot, parallel/ingest.py ``SlotPool``) — one memcpy per column, no
+    intermediate views or allocations.
+
+    Deposit contract (docs/ingest.md): the ENTIRE frame is validated
+    (``frame_info``) and every destination checked — present, C-contiguous,
+    writeable, exact dtype and shape — BEFORE the first byte is written.
+    A hostile frame (bad magic/lengths, truncated or misaligned payloads)
+    or a mismatched destination raises ``FrameError`` with every slot
+    untouched; a half-deposited slot is impossible. Extra ``out`` entries
+    the frame doesn't name are left as-is. Returns {name: destination}
+    for the frame's columns."""
+    info = frame_info(buf, max_bytes=max_bytes)
+    mv = memoryview(buf)
+    spans = info["_spans"]
+    for name, dt, shape, _plen in spans:
+        dst = out.get(name)
+        if dst is None:
+            raise FrameError(f"no staging destination for column {name!r}")
+        if not isinstance(dst, np.ndarray):
+            raise FrameError(
+                f"staging destination for {name!r} is not an ndarray")
+        if not dst.flags["C_CONTIGUOUS"] or not dst.flags["WRITEABLE"]:
+            raise FrameError(
+                f"staging destination for {name!r} must be C-contiguous "
+                f"and writeable")
+        if dst.dtype != dt:
+            raise FrameError(
+                f"column {name!r}: frame dtype {dt} != slot dtype "
+                f"{dst.dtype}")
+        if tuple(dst.shape) != shape:
+            raise FrameError(
+                f"column {name!r}: frame shape {shape} != slot shape "
+                f"{tuple(dst.shape)}")
+    off = _payload_offset(info)
+    res: Dict[str, np.ndarray] = {}
+    for name, dt, shape, plen in spans:
+        dst = out[name]
+        # raw byte copy through the buffer protocol: the one host copy on
+        # the deposit path (socket buffer -> staging slot); 0-d slots go
+        # through a 1-element view (memoryview.cast needs ndim >= 1)
+        flat = dst if dst.ndim else dst.reshape(1)
+        memoryview(flat).cast("B")[:] = mv[off:off + plen]
+        res[name] = dst
+        off += plen
+    return res

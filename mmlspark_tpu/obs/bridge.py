@@ -93,7 +93,28 @@ def _ingest_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
                ("mmlspark_ingest_h2d_gbps", "gauge", "h2d_gbps",
                 "host->device transfer bandwidth"),
                ("mmlspark_transfer_ring_depth", "gauge", "ring_depth",
-                "configured in-flight slot depth of the transfer ring"))
+                "configured in-flight slot depth of the transfer ring"),
+               ("mmlspark_ingest_deposits_total", "counter",
+                "slot_deposits",
+                "batches deposited in place into pre-staged H2D slots"),
+               ("mmlspark_ingest_copies_total", "counter",
+                "fallback_copies",
+                "batches that fell back to the allocating copy path"),
+               ("mmlspark_ingest_zero_copy_batches_total", "counter",
+                "zero_copy_batches",
+                "batches assembled as strided views (no host copy)"),
+               ("mmlspark_ingest_copied_batches_total", "counter",
+                "copied_batches",
+                "batches assembled via a host copy (stack or slot fill)"),
+               ("mmlspark_ingest_slot_fill_seconds_total", "counter",
+                "slot_fill_s", "time spent filling staging slots"),
+               ("mmlspark_ingest_slot_transfer_seconds_total", "counter",
+                "slot_transfer_s",
+                "H2D transfer time out of staging slots"),
+               ("mmlspark_ingest_slot_overlap_ratio", "gauge",
+                "slot_overlap_ratio",
+                "fraction of slot H2D time overlapped with the next "
+                "slot's fill (double-buffered staging)"))
     for mname, mtype, key, help in scalars:
         f = _num(summary.get(key))
         if f is not None:

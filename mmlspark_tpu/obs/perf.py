@@ -18,9 +18,9 @@ flops/bytes/latency tuples, collected where they are cheapest to observe:
     with the IngestStats queue/h2d/compute/readback decomposition into a
     per-segment roofline report: the cost-model bound time per batch, the
     measured wall per batch, their ratio (1.0 = running at the hardware
-    bound), and a dominant-bottleneck label (``h2d``/``compute``/``host``/
-    ``queue``) — the e2e-vs-roofline gap as a first-class per-segment
-    number.
+    bound), and a dominant-bottleneck label (``queue``/``h2d``/``compute``/
+    ``dispatch``/``host``) — the e2e-vs-roofline gap as a first-class
+    per-segment number.
   - ``device_peaks()`` supplies the roofline ceilings: the public TPU chip
     specs (tools/mfu_roofline.py table), overridable via
     ``MMLSPARK_PEAK_FLOPS``/``MMLSPARK_PEAK_GBPS``; unknown devices (CPU
@@ -158,10 +158,12 @@ def device_peaks() -> Dict[str, Any]:
 # Per-segment roofline attribution
 # ---------------------------------------------------------------------------
 
-#: IngestStats summary key -> bottleneck label. dispatch + readback are the
-#: host's share of the batch loop (enqueue cost, D2H fetch + finalize wait).
+#: IngestStats summary key -> bottleneck label. dispatch gets its own label
+#: (the fixed Python submit cost K-step mega-dispatch amortizes — folding it
+#: into "host" would hide that win); readback stays the host's share of the
+#: batch loop (D2H fetch + finalize wait).
 _BOTTLENECK_OF = (("queue_s", "queue"), ("h2d_s", "h2d"),
-                  ("compute_s", "compute"), ("dispatch_s", "host"),
+                  ("compute_s", "compute"), ("dispatch_s", "dispatch"),
                   ("readback_s", "host"))
 
 
@@ -271,7 +273,7 @@ def segment_families(fusion: Dict[str, Any]) -> List[MetricFamily]:
     bneck = MetricFamily(
         "mmlspark_segment_bottleneck", "gauge",
         "one-hot dominant bottleneck per segment "
-        "(queue/h2d/compute/host)")
+        "(queue/h2d/compute/dispatch/host)")
     for label, rec in sorted(roofline.items()):
         for fam, key in ((ratio, "roofline_ratio"),
                          (bound, "bound_ms_per_batch"),
@@ -281,7 +283,7 @@ def segment_families(fusion: Dict[str, Any]) -> List[MetricFamily]:
                 fam.add(v, {"segment": label})
         dom = rec.get("bottleneck")
         if dom:
-            for name in ("queue", "h2d", "compute", "host"):
+            for name in ("queue", "h2d", "compute", "dispatch", "host"):
                 bneck.add(1.0 if name == dom else 0.0,
                           {"segment": label, "bottleneck": name})
     return fams + [f for f in (ratio, bound, measured, bneck) if f.samples]

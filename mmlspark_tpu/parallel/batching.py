@@ -373,11 +373,17 @@ def stack_rows(col: np.ndarray, dtype=np.float32) -> np.ndarray:
 
 @dataclasses.dataclass
 class Batch:
-    """One padded, static-shape batch: arrays + validity mask."""
+    """One padded, static-shape batch: arrays + validity mask.
+
+    ``staging``: the SlotPool lease (parallel/ingest.py SlotLease) when the
+    arrays live in a pre-allocated staging slot — ``timed_stage`` returns
+    the buffers to the pool once the batch is device-resident. None for
+    plainly-allocated batches (bitwise-identical legacy path)."""
 
     arrays: Dict[str, np.ndarray]
     mask: np.ndarray          # [B] bool, True = real row
     num_valid: int
+    staging: Any = None
 
     @property
     def size(self) -> int:

@@ -168,6 +168,21 @@ class IngestStats:
         # measured term behind mmlspark_batch_pad_ratio{bucket=} and the
         # cost model's bucket chooser (assumed-waste becomes measured-waste)
         self._pad: Dict[int, List[int]] = {}
+        # deposit accounting (docs/ingest.md): batches staged zero-alloc
+        # into SlotPool slots vs batches that took the accounted copying
+        # fallback (mmlspark_ingest_deposits_total / _copies_total)
+        self.deposits: int = 0
+        self.copies: int = 0
+        # rows_to_batch outcome split: spanning zero-copy views vs stacked
+        # copies (mmlspark_ingest_zero_copy_batches_total / _copied_...)
+        self.zero_copy_batches: int = 0
+        self.copied_batches: int = 0
+        # per-slot double-buffer decomposition: fill / transfer seconds and
+        # the measured fill<->transfer overlap between paired buffers
+        self.slot_fill_s: float = 0.0
+        self.slot_transfer_s: float = 0.0
+        self.slot_overlap_s: float = 0.0
+        self.slot_transfers: int = 0
 
     def record(self, t: BatchTiming) -> None:
         self.records.append(t)
@@ -193,6 +208,33 @@ class IngestStats:
         self._occ_n += 1
         self._occ_max = max(self._occ_max, n)
 
+    def note_deposit(self) -> None:
+        """One batch staged in place into a pre-allocated slot."""
+        self.deposits += 1
+
+    def note_copy(self) -> None:
+        """One batch that took the accounted copying fallback (deposit
+        ineligible: dtype narrowing, ragged rows, slot contention, or a
+        transfer fault) — the ``mmlspark_ingest_copies_total`` counter."""
+        self.copies += 1
+
+    def note_batch_copy(self, zero_copy: bool) -> None:
+        """rows_to_batch outcome: spanning zero-copy view vs stacked copy."""
+        if zero_copy:
+            self.zero_copy_batches += 1
+        else:
+            self.copied_batches += 1
+
+    def note_slot(self, fill_s: float, transfer_s: float,
+                  overlap_s: float) -> None:
+        """One slot cycle: host fill seconds, H2D transfer seconds, and the
+        measured overlap between this transfer and the paired buffer's
+        concurrent fill (double-buffering effectiveness, per slot)."""
+        self.slot_fill_s += float(fill_s)
+        self.slot_transfer_s += float(transfer_s)
+        self.slot_overlap_s += float(overlap_s)
+        self.slot_transfers += 1
+
     def merge(self, other: "IngestStats") -> None:
         """Fold another stats object in (segment aggregation)."""
         self.records.extend(other.records)
@@ -205,6 +247,14 @@ class IngestStats:
             acc = self._pad.setdefault(bucket, [0, 0])
             acc[0] += batches
             acc[1] += rows
+        self.deposits += other.deposits
+        self.copies += other.copies
+        self.zero_copy_batches += other.zero_copy_batches
+        self.copied_batches += other.copied_batches
+        self.slot_fill_s += other.slot_fill_s
+        self.slot_transfer_s += other.slot_transfer_s
+        self.slot_overlap_s += other.slot_overlap_s
+        self.slot_transfers += other.slot_transfers
 
     @property
     def num_batches(self) -> int:
@@ -229,11 +279,33 @@ class IngestStats:
             out["pad_ratio"] = round(1 - tot_real / tot_padded, 4)
         return out
 
+    def _staging_summary(self) -> Dict[str, Any]:
+        """Deposit / zero-copy / slot-overlap section (only populated
+        keys, so summaries without staging activity are unchanged)."""
+        out: Dict[str, Any] = {}
+        if self.deposits or self.copies:
+            out["slot_deposits"] = self.deposits
+            out["fallback_copies"] = self.copies
+        if self.zero_copy_batches or self.copied_batches:
+            out["zero_copy_batches"] = self.zero_copy_batches
+            out["copied_batches"] = self.copied_batches
+        if self.slot_transfers:
+            out["slot_fill_s"] = round(self.slot_fill_s, 6)
+            out["slot_transfer_s"] = round(self.slot_transfer_s, 6)
+            out["slot_overlap_s"] = round(self.slot_overlap_s, 6)
+            # fraction of transfer time hidden behind the paired buffer's
+            # fill (1.0 = every transfer fully overlapped a fill)
+            out["slot_overlap_ratio"] = round(
+                self.slot_overlap_s / self.slot_transfer_s, 4) \
+                if self.slot_transfer_s > 0 else None
+        return out
+
     def summary(self) -> Dict[str, Any]:
         if not self.records:
             out = {"n_batches": 0}
             if self._pad:
                 out.update(self._pad_summary())
+            out.update(self._staging_summary())
             return out
         cols = {f: float(sum(getattr(r, f) for r in self.records))
                 for f in ("queue_s", "h2d_s", "dispatch_s", "compute_s",
@@ -262,13 +334,68 @@ class IngestStats:
                 out["ring_occupancy_max"] = self._occ_max
         if self._pad:
             out.update(self._pad_summary())
+        out.update(self._staging_summary())
         for f, v in cols.items():
             out[f] = round(v, 6)
             out[f"{f[:-2]}_ms_per_batch"] = round(v / n * 1e3, 4)
         return out
 
 
-def rows_to_batch(rows) -> np.ndarray:
+def _root_exporter(a: np.ndarray):
+    """The object that OWNS an array view's memory: walk the ``.base``
+    chain to the final ndarray, and through a memoryview to its exporter
+    (``decode_frame`` views are frombuffer-over-memoryview-slice; the slice
+    keeps the WHOLE exporter alive, which is what makes a spanning strided
+    view over sibling slices memory-safe)."""
+    b = a
+    while isinstance(b, np.ndarray) and b.base is not None:
+        b = b.base
+    if isinstance(b, memoryview):
+        try:
+            return b.obj
+        except Exception:  # noqa: BLE001 — released/exotic memoryview
+            return b
+    return b
+
+
+def _spanning_view(arrs: List[np.ndarray], shape: Tuple[int, ...],
+                   ) -> Optional[np.ndarray]:
+    """Zero-copy [B, ...] view when the rows sit at a CONSTANT pointer
+    stride inside one live buffer; None otherwise.
+
+    Two layouts qualify: adjacent rows (stride == row nbytes — a whole
+    batch shipped in one frame column, or journal replay of a concatenated
+    region) and rows spanning multiple PIPELINED FRAMES of one connection
+    buffer (stride > row nbytes: equal-size frames back-to-back put each
+    frame's payload at payload+header intervals). The second layout is
+    only taken when every row resolves to the SAME root exporter object —
+    rows from unrelated buffers must never be bridged by pointer
+    arithmetic, no matter how adjacent they happen to land."""
+    nb = arrs[0].nbytes
+    if len(arrs) < 2 or not nb \
+            or not all(a.flags["C_CONTIGUOUS"] for a in arrs):
+        return None
+    try:
+        ptrs = [a.__array_interface__["data"][0] for a in arrs]
+    except (KeyError, TypeError):
+        return None
+    stride = ptrs[1] - ptrs[0]
+    if stride < nb or any(p != ptrs[0] + i * stride
+                          for i, p in enumerate(ptrs)):
+        return None
+    if stride > nb:
+        root = _root_exporter(arrs[0])
+        if any(_root_exporter(a) is not root for a in arrs[1:]):
+            return None
+    # one spanning view over the shared buffer; arrs[0] rides along as
+    # .base so the underlying memory stays alive
+    return np.lib.stride_tricks.as_strided(
+        arrs[0], shape=(len(arrs),) + shape,
+        strides=(stride,) + arrs[0].strides)
+
+
+def rows_to_batch(rows, out: Optional[np.ndarray] = None,
+                  stats: Optional["IngestStats"] = None) -> np.ndarray:
     """Per-row arrays -> one contiguous [B, ...] batch for H2D staging.
 
     The binary-wire ingest path: ``decode_frame`` hands each request's
@@ -277,11 +404,17 @@ def rows_to_batch(rows) -> np.ndarray:
     transfer ring's staging buffer (uint8 on the wire, cast/scale on
     device via PreprocessSpec).
 
-    Fast path: when the rows are adjacent views over ONE buffer (a client
-    shipped a whole batch in one frame column, or journal replay of a
-    concatenated region), the batch is a strided view — zero copies
-    end-to-end. Otherwise ``np.stack``. Rows must agree on shape and dtype
-    (ragged batches stay on the per-row host path)."""
+    Fast path: when the rows sit at one constant stride over ONE live
+    buffer (a client shipped a whole batch in one frame column, journal
+    replay of a concatenated region, or pipelined equal-size frames of one
+    connection), the batch is a strided view — zero copies end-to-end.
+    Otherwise ``np.stack``. Rows must agree on shape and dtype (ragged
+    batches stay on the per-row host path).
+
+    ``out``: slot-fill mode — a pre-allocated [cap, ...] staging slot
+    (SlotPool buffer) receiving the rows in place; returns ``out[:B]``.
+    ``stats``: optional IngestStats receiving the zero-copy vs copied
+    batch counters."""
     arrs = [np.asarray(r) for r in rows]
     if not arrs:
         raise ValueError("rows_to_batch needs at least one row")
@@ -290,25 +423,219 @@ def rows_to_batch(rows) -> np.ndarray:
         if a.shape != shape or a.dtype != dt:
             raise ValueError(
                 f"ragged batch: {a.shape}/{a.dtype} vs {shape}/{dt}")
+    if out is not None:
+        # slot-fill: rows land in the caller's slot — stack + pad collapse
+        # into this ONE copy (the H2D staging buffer is the destination)
+        if out.dtype != dt or tuple(out.shape[1:]) != shape \
+                or len(out) < len(arrs):
+            raise ValueError(
+                f"slot [{len(out)}]{out.shape[1:]}/{out.dtype} cannot "
+                f"receive batch [{len(arrs)}]{shape}/{dt}")
+        view = _spanning_view(arrs, shape) if len(arrs) > 1 else None
+        if view is not None:
+            out[:len(arrs)] = view  # one bulk memcpy
+        else:
+            for i, a in enumerate(arrs):
+                out[i] = a
+        if stats is not None:
+            stats.note_batch_copy(zero_copy=False)
+        return out[:len(arrs)]
     if len(arrs) == 1:
-        return arrs[0][None] if arrs[0].flags["C_CONTIGUOUS"] \
-            else np.ascontiguousarray(arrs[0])[None]
-    nb = arrs[0].nbytes
-    if nb and all(a.flags["C_CONTIGUOUS"] for a in arrs):
-        try:
-            ptr0 = arrs[0].__array_interface__["data"][0]
-            adjacent = all(
-                a.__array_interface__["data"][0] == ptr0 + i * nb
-                for i, a in enumerate(arrs))
-        except (KeyError, TypeError):
-            adjacent = False
-        if adjacent:
-            # one spanning view over the shared buffer; arrs[0] rides along
-            # as .base so the underlying memory stays alive
-            return np.lib.stride_tricks.as_strided(
-                arrs[0], shape=(len(arrs),) + shape,
-                strides=(nb,) + arrs[0].strides)
+        if arrs[0].flags["C_CONTIGUOUS"]:
+            if stats is not None:
+                stats.note_batch_copy(zero_copy=True)
+            return arrs[0][None]
+        if stats is not None:
+            stats.note_batch_copy(zero_copy=False)
+        return np.ascontiguousarray(arrs[0])[None]
+    view = _spanning_view(arrs, shape)
+    if view is not None:
+        if stats is not None:
+            stats.note_batch_copy(zero_copy=True)
+        return view
+    if stats is not None:
+        stats.note_batch_copy(zero_copy=False)
     return np.stack(arrs)
+
+
+# ---------------------------------------------------------------------------
+# SlotPool: pre-allocated, double-buffered H2D staging slots
+# ---------------------------------------------------------------------------
+
+
+class _SlotBucket:
+    """Paired pre-allocated buffers for one (column, batch shape, dtype)
+    bucket. Two buffers = double buffering: one fills while the sibling
+    transfers."""
+
+    __slots__ = ("bufs", "free")
+
+    def __init__(self, shape: Tuple[int, ...], dtype, n: int):
+        self.bufs = [np.zeros(shape, dtype=dtype) for _ in range(n)]
+        self.free = list(range(n))
+
+
+class SlotLease:
+    """One acquired staging slot: a pre-allocated ``[cap, ...]`` buffer per
+    deposit column of one batch. Lifecycle: ``fill_begin``/``fill_end``
+    around the host fill, then ``transfer_begin``/``transfer_end`` driven
+    by ``timed_stage`` around the H2D transfer — ``transfer_end`` records
+    the fill/transfer/overlap decomposition into IngestStats and returns
+    the buffers to the pool. ``release()`` is the idempotent abandon path
+    (a faulted transfer frees the buffers without recording a cycle; the
+    slot content is simply overwritten on reuse, never read)."""
+
+    __slots__ = ("arrays", "_pool", "_held", "_stats", "_fill", "_tx0",
+                 "_done")
+
+    def __init__(self, pool: "SlotPool", held: List[Tuple[Tuple, int]],
+                 arrays: Dict[str, np.ndarray], stats):
+        self.arrays = arrays
+        self._pool = pool
+        self._held = held
+        self._stats = stats
+        self._fill = (0.0, 0.0)
+        self._tx0: Optional[float] = None
+        self._done = False
+
+    def fill_begin(self) -> None:
+        self._fill = (time.perf_counter(), 0.0)
+
+    def fill_end(self) -> None:
+        self._fill = (self._fill[0], time.perf_counter())
+        self._pool._note_fill(self._fill)
+
+    def transfer_begin(self) -> None:
+        self._tx0 = time.perf_counter()
+
+    def transfer_end(self) -> None:
+        tx1 = time.perf_counter()
+        tx0 = self._tx0 if self._tx0 is not None else tx1
+        if self._stats is not None:
+            fill_s = max(0.0, self._fill[1] - self._fill[0])
+            self._stats.note_slot(fill_s, tx1 - tx0,
+                                  self._pool._overlap(tx0, tx1))
+        self.release()
+
+    def release(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._pool._release(self._held)
+
+
+class SlotPool:
+    """Pre-allocated, shape-bucket-keyed H2D staging slots with PAIRED
+    buffers per bucket (tentpole piece 2 of the single-copy ingress path,
+    docs/ingest.md).
+
+    ``acquire(spec)`` hands out a ``SlotLease`` over one buffer per
+    requested column, keyed by (column, full batch shape, dtype) — the
+    shape-bucket key, so every padded bucket size reuses its own slot
+    instead of allocating per batch. Each bucket holds
+    ``buffers_per_bucket`` (default 2) buffers: while buffer A is in H2D
+    transfer, buffer B fills — the per-slot overlap is MEASURED (lease
+    transfer intervals intersected with concurrent fill intervals) and
+    reported through ``IngestStats.note_slot``.
+
+    ``acquire`` is all-or-nothing under one condition variable (no partial
+    holds, no lock-order deadlocks) and returns None instead of blocking
+    past ``acquire_timeout_s`` — callers fall back to the accounted
+    copying path (``IngestStats.note_copy``), so slot contention degrades
+    to today's behavior instead of stalling the ring."""
+
+    def __init__(self, buffers_per_bucket: int = 2,
+                 max_slot_bytes: int = 1 << 28,
+                 acquire_timeout_s: float = 2.0):
+        import threading
+
+        self._nbuf = max(1, int(buffers_per_bucket))
+        self._max_bytes = int(max_slot_bytes)
+        self._timeout = float(acquire_timeout_s)
+        self._cv = threading.Condition()
+        self._buckets: Dict[Tuple, _SlotBucket] = {}
+        # recent completed fill intervals (any lease): a transfer's overlap
+        # is its intersection with these — a lease's OWN fill ends before
+        # its transfer begins, so it contributes zero by construction
+        self._fills: deque = deque(maxlen=16)
+
+    def _bucket_for(self, key: Tuple, shape: Tuple[int, ...],
+                    dtype) -> Optional[_SlotBucket]:
+        """Find-or-create under self._cv. None when the slot would exceed
+        the byte cap (callers fall back to the copying path)."""
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            if nbytes <= 0 or nbytes > self._max_bytes:
+                return None
+            bucket = self._buckets[key] = _SlotBucket(
+                shape, dtype, self._nbuf)
+        return bucket
+
+    def acquire(self, spec: Dict[str, Tuple[Tuple[int, ...], Any]],
+                stats=None,
+                timeout: Optional[float] = None) -> Optional[SlotLease]:
+        """``spec``: {column: (full batch shape INCLUDING the leading
+        padded cap, dtype)}. Returns a SlotLease, or None on timeout /
+        uncacheable shape (caller copies and accounts it)."""
+        if not spec:
+            return None
+        deadline = time.perf_counter() + (
+            self._timeout if timeout is None else float(timeout))
+        keys = {}
+        for col in sorted(spec):
+            shape, dtype = spec[col]
+            keys[col] = (col, tuple(int(d) for d in shape),
+                         np.dtype(dtype).str)
+        with self._cv:
+            while True:
+                buckets = {}
+                for col, key in keys.items():
+                    shape, dtype = spec[col]
+                    bucket = self._bucket_for(key, tuple(shape), dtype)
+                    if bucket is None:
+                        return None
+                    buckets[col] = bucket
+                if all(b.free for b in buckets.values()) and \
+                        len({id(b) for b in buckets.values()}) == \
+                        len(buckets):
+                    held = []
+                    arrays = {}
+                    for col, key in keys.items():
+                        idx = buckets[col].free.pop()
+                        held.append((key, idx))
+                        arrays[col] = buckets[col].bufs[idx]
+                    return SlotLease(self, held, arrays, stats)
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    return None
+
+    def _release(self, held: List[Tuple[Tuple, int]]) -> None:
+        with self._cv:
+            for key, idx in held:
+                bucket = self._buckets.get(key)
+                if bucket is not None and idx not in bucket.free:
+                    bucket.free.append(idx)
+            self._cv.notify_all()
+
+    def _note_fill(self, interval: Tuple[float, float]) -> None:
+        with self._cv:
+            self._fills.append(interval)
+
+    def _overlap(self, tx0: float, tx1: float) -> float:
+        """Seconds of [tx0, tx1] overlapped by any recorded fill."""
+        with self._cv:
+            fills = list(self._fills)
+        return sum(max(0.0, min(tx1, f1) - max(tx0, f0))
+                   for f0, f1 in fills)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            buckets = len(self._buckets)
+            buffers = sum(len(b.bufs) for b in self._buckets.values())
+            nbytes = sum(buf.nbytes for b in self._buckets.values()
+                         for buf in b.bufs)
+        return {"buckets": buckets, "buffers": buffers, "bytes": nbytes}
 
 
 def _tree_rows(item: Any) -> int:
@@ -365,14 +692,37 @@ def timed_stage(put: Optional[Callable], item: Any,
     recorded as an ``h2d`` span on every traced request in the batch."""
     timing = BatchTiming(bytes_in=_tree_nbytes(item), rows=_tree_rows(item),
                          padded_rows=_tree_padded(item))
+    # slot-staged batches (SlotPool) carry their lease: the transfer window
+    # is recorded for the per-slot overlap metric and the buffer returns to
+    # the pool the moment the staged arrays are device-resident
+    slot = getattr(item, "staging", None)
     t_wall = time.time()
     t0 = time.perf_counter()
-    # chaos seam: an injected delay here shows up in h2d_s (slow link), an
-    # injected exception surfaces at the consumer (transfer failure)
-    faults.fire(faults.INGEST_H2D, rows=timing.rows, nbytes=timing.bytes_in)
-    staged = put(item) if put is not None else item
-    _block_ready(staged)
+    if slot is not None:
+        slot.transfer_begin()
+    try:
+        # chaos seam: an injected delay here shows up in h2d_s (slow link),
+        # an injected exception surfaces at the consumer (transfer failure)
+        faults.fire(faults.INGEST_H2D, rows=timing.rows,
+                    nbytes=timing.bytes_in)
+        staged = put(item) if put is not None else item
+        if slot is not None and _h2d_aliases_host():
+            # CPU backends alias aligned host buffers on device_put: the
+            # "device" array IS the slot. Releasing the slot then would let
+            # the next fill corrupt a pending dispatch. A device-side copy
+            # (this backend's stand-in for the DMA real accelerators do)
+            # makes the staged value independent before the slot returns.
+            staged = _device_copy(staged)
+        _block_ready(staged)
+    except BaseException:
+        if slot is not None:
+            # abandon: free the buffers without recording a cycle — the
+            # slot is reused (overwritten) later, its content never read
+            slot.release()
+        raise
     timing.h2d_s = time.perf_counter() - t0
+    if slot is not None:
+        slot.transfer_end()
     if obs is not None:
         tracer, ctxs = obs
         tracer.record_batch("h2d", ctxs, t_wall, timing.h2d_s,
@@ -478,6 +828,55 @@ class TransferRing:
         timing.readback_s = time.perf_counter() - t1
         self.stats.record(timing)
         return out
+
+
+#: lazily probed: does this backend's device_put ALIAS aligned host numpy
+#: buffers instead of copying? (jax CPU does, real accelerators do not)
+_H2D_ALIASES: Optional[bool] = None
+
+
+def _h2d_aliases_host() -> bool:
+    """One-shot probe of the default backend: stage an aligned buffer,
+    mutate the host side, and see whether the device value changed. True
+    means slot buffers must be device-copied before reuse."""
+    global _H2D_ALIASES
+    if _H2D_ALIASES is None:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            _H2D_ALIASES = False
+        else:
+            try:
+                # analysis: allow D001 -- one-shot probe, not per batch
+                raw = np.zeros(1024 + 16, dtype=np.float32)
+                off = (-raw.ctypes.data // 4) % 16  # 64-byte-align the view
+                probe = raw[off:off + 512]
+                dev = jax.block_until_ready(jax.device_put(probe))
+                probe[0] = 1.0
+                _H2D_ALIASES = bool(np.asarray(dev)[0] == 1.0)
+            except Exception:  # noqa: BLE001 — assume the unsafe answer
+                _H2D_ALIASES = True
+    return _H2D_ALIASES
+
+
+def _device_copy(tree: Any) -> Any:
+    """Device-side copy of every jax array in ``tree`` (structure
+    preserved) — detaches staged values from the host slot they may
+    alias."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return tree
+
+    def one(v):
+        if isinstance(v, jax.Array):
+            return v.copy()
+        return v
+
+    return jax.tree_util.tree_map(
+        one, tree, is_leaf=lambda v: isinstance(v, jax.Array))
 
 
 def _block_ready(tree: Any) -> Any:

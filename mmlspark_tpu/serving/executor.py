@@ -546,7 +546,15 @@ class PipelinedExecutor:
             t0 = time.perf_counter()
             budget = None
             if self.watchdog is not None:
-                budget = self.watchdog.budget_s(prep.n)
+                # a tuned K-step mega-dispatch runs up to K micro-batches in
+                # one Python-level call; scale the budget so it isn't read
+                # as a hang (serve_pipeline attaches the hint)
+                hint = getattr(replica.transform, "mega_k", None)
+                try:
+                    batches = int(hint() if callable(hint) else hint or 1)
+                except Exception:  # noqa: BLE001 — hint must not kill loop
+                    batches = 1
+                budget = self.watchdog.budget_s(prep.n, batches=batches)
             with self._lock:
                 gen = prep.wd_gen
                 self._dispatch[replica.index] = [prep, gen, t0, budget]

@@ -1460,6 +1460,11 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
 
         transform.submit = _submit
 
+    if hasattr(stage, "mega_k_max"):
+        # watchdog hint: one Python-level dispatch may cover up to K queued
+        # micro-batches once the Tuner applies a mega-dispatch knob
+        transform.mega_k = lambda: stage.mega_k_max
+
     ingest = None
     if hasattr(stage, "last_ingest_stats"):
         def ingest():

@@ -293,8 +293,15 @@ class DispatchWatchdog:
             self._ewma = compute_s if self._ewma is None else \
                 0.75 * self._ewma + 0.25 * compute_s
 
-    def budget_s(self, rows: int) -> Optional[float]:
-        """Wall budget for a batch of ``rows``, or None while unarmed."""
+    def budget_s(self, rows: int, batches: int = 1) -> Optional[float]:
+        """Wall budget for a batch of ``rows``, or None while unarmed.
+
+        ``batches`` covers K-step mega-dispatch (core/fusion.py): one
+        Python-level dispatch may execute up to K queued micro-batches, so
+        the measured-EWMA fallback — calibrated on single dispatches —
+        scales by K. The cost-model prediction path already prices the
+        actual row count and needs no scaling."""
+        batches = max(1, int(batches or 1))
         if self.fixed_s is not None:
             return self.fixed_s
         pred_ms = None
@@ -305,7 +312,8 @@ class DispatchWatchdog:
                 pred_ms = None
         with self._lock:
             ewma = self._ewma
-        est = pred_ms / 1e3 if pred_ms is not None else ewma
+        est = pred_ms / 1e3 if pred_ms is not None else \
+            (ewma * batches if ewma is not None else None)
         if est is None:
             return None
         return max(self.min_budget_s, self.k * est)

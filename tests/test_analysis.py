@@ -338,6 +338,74 @@ def test_d001_devicefn_fn_bodies_are_checked():
     assert len(hits) == 1 and "time.sleep" in hits[0].message
 
 
+STAGING_ALLOC = """
+    import numpy as np
+    from ..parallel.ingest import TransferRing
+
+    class Runner:
+        def _batches(self, rows):
+            for r in rows:
+                yield np.stack(r)
+
+        def _put(self, b):
+            buf = np.empty(len(b))
+            return buf
+
+        def run(self, rows):
+            src = self._batches(rows)
+            ring = TransferRing(src, put=self._put, step=None, fetch=None)
+            return list(ring)
+"""
+
+STAGING_CLEAN = """
+    import numpy as np
+    from ..parallel.ingest import TransferRing
+
+    def _fill(rows, out):
+        for i, r in enumerate(rows):
+            out[i] = r
+
+    def helper(rows):
+        # np.stack OUTSIDE any staging callback: not a D001 concern
+        return np.stack(rows)
+
+    def run(src, put):
+        ring = TransferRing(src, put=put, step=None, fetch=None)
+        return list(ring)
+"""
+
+STAGING_LAMBDA = """
+    import numpy as np
+    from ..parallel.batching import DevicePrefetcher
+
+    def _stage(item):
+        return np.zeros(len(item))
+
+    def run(it):
+        pf = DevicePrefetcher(it, put=lambda x: _stage(x))
+        return list(pf)
+"""
+
+
+def test_d001_flags_allocs_in_ring_staging_callbacks():
+    hits = finds(STAGING_ALLOC, "D001")
+    joined = "\n".join(h.message for h in hits)
+    # the batch source (resolved through the local `src =` rebind) AND
+    # the put callback are both staging context
+    assert "np.stack" in joined and "_batches" in joined
+    assert "np.empty" in joined and "_put" in joined
+
+
+def test_d001_staging_scan_ignores_non_callback_allocs():
+    assert finds(STAGING_CLEAN, "D001") == []
+
+
+def test_d001_staging_resolves_lambda_wrapped_callback():
+    hits = finds(STAGING_LAMBDA, "D001")
+    assert len(hits) == 1 and "np.zeros" in hits[0].message \
+        and "_stage" in hits[0].message
+
+
 # ---------------------------------------------------------------- H001/H002
 
 def test_h001_flags_runtime_assert_and_exempts_testing():

@@ -518,7 +518,8 @@ class TestFusedServingAttribution:
         roofline = stats["fusion"]["roofline"]
         assert roofline, "no roofline attribution for the fused segment"
         rec = next(iter(roofline.values()))
-        assert rec["bottleneck"] in ("queue", "h2d", "compute", "host")
+        assert rec["bottleneck"] in (
+            "queue", "h2d", "compute", "dispatch", "host")
         assert stats["fusion"]["segment_costs"]
 
     def test_segment_spans_carry_cost_attrs(self, fused_server):

@@ -28,12 +28,13 @@ import urllib.request
 import numpy as np
 
 
-def _measure(url: str, payload: bytes, n: int, warmup: int = 20):
+def _measure(url: str, payload: bytes, n: int, warmup: int = 20,
+             content_type: str = "application/json"):
     lat = []
     for i in range(n + warmup):
         req = urllib.request.Request(
             url, data=payload, method="POST",
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": content_type})
         t0 = time.perf_counter()
         with urllib.request.urlopen(req, timeout=30) as resp:
             resp.read()
@@ -542,7 +543,8 @@ def _obs_overhead_section(echo, payload, n):
     }
 
 
-def _make_autotune_chain(num_partitions=4, rows=44, seed=0):
+def _make_autotune_chain(num_partitions=4, rows=44, seed=0,
+                         slot_staging=True):
     """The flagship fused image chain (ImageTransformer -> CNN featurizer)
     over a dataframe whose partitions form SHORT batches (11 rows against a
     16-row batch size): the power-of-two policy pads every batch to 16
@@ -581,7 +583,7 @@ def _make_autotune_chain(num_partitions=4, rows=44, seed=0):
         .set_model(backbone)])
     model = SegmentCostModel(min_obs=2)
     fused = FusedPipelineModel(pm.stages, cache=CompileCache(),
-                               cost_model=model)
+                               cost_model=model, slot_staging=slot_staging)
     return fused, model, df, rows
 
 
@@ -916,6 +918,242 @@ def _serve_image_chain(autotune, tune_every=12):
     return srv.start()
 
 
+def _frame_request_body(seed=7):
+    """One 32x32x3 uint8 image as a single-column BINARY frame — the body
+    the deposit path can land straight in a staging slot."""
+    from mmlspark_tpu.io.binary import encode_frame
+
+    rng = np.random.default_rng(seed)
+    return encode_frame({"img": rng.integers(0, 256, size=(32, 32, 3),
+                                             dtype=np.uint8)})
+
+
+def _serve_frame_chain(slot_staging, mega_k=None):
+    """serve_pipeline over the fused image chain fed by binary frames.
+    Returns (started server, fused model) so the caller can read the
+    ingest counters after load."""
+    from mmlspark_tpu.core.schema import ImageSchema
+    from mmlspark_tpu.serving import serve_pipeline
+    from mmlspark_tpu.stages import UDFTransformer
+
+    fused, _, df, _ = _make_autotune_chain(seed=1,
+                                           slot_staging=slot_staging)
+    if mega_k:
+        fused.transform(df)  # discover the segment label
+        label = next(iter(fused.fusion_stats()["per_segment"]))
+        fused.set_tuning(mega_k={label: int(mega_k)})
+    in_cols = {"data", "image", "id", "value", "headers", "origin"}
+
+    def decode_rows(col):
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col):
+            out[i] = ImageSchema.make(np.asarray(v, dtype=np.uint8)
+                                      .reshape(32, 32, 3), f"req{i}")
+        return out
+
+    decode = UDFTransformer(inputCol="data", outputCol="image",
+                            vectorizedUdf=decode_rows)
+
+    class _Chain:
+        def transform(self, df):
+            out = fused.transform(decode.transform(df))
+            feat = next((c for c in out.schema.names
+                         if c not in in_cols), None)
+            if feat is not None and "reply" not in out.schema:
+                out = out.with_column(
+                    "reply",
+                    lambda p, _c=feat: [
+                        None if v is None else np.asarray(v).tolist()
+                        for v in p[_c]])
+            return out
+
+        def set_tuning(self, **kw):
+            fused.set_tuning(**kw)
+
+        cost_model = property(lambda self: fused.cost_model)
+        last_ingest_stats = property(lambda self: fused.last_ingest_stats)
+        mega_k_max = property(lambda self: fused.mega_k_max)
+        _seg_stats = property(lambda self: fused._seg_stats)
+        _cache = property(lambda self: fused._cache)
+        _last_plan = property(lambda self: fused._last_plan)
+
+        def fusion_stats(self):
+            return fused.fusion_stats()
+
+        def has_param(self, name):
+            return False
+
+    srv = serve_pipeline(_Chain(), "data", parse="json", port=0,
+                         max_wait_ms=0.0)
+    return srv.start(), fused
+
+
+def _dominant_stage(summary):
+    """Which pipeline stage a segment spends the most wall time in —
+    same precedence/labels as obs/perf's bottleneck gauge."""
+    stages = (("queue_s", "queue"), ("h2d_s", "h2d"),
+              ("compute_s", "compute"), ("dispatch_s", "dispatch"),
+              ("readback_s", "host"))
+    best, best_v = None, 0.0
+    for key, label in stages:
+        v = summary.get(key)
+        if v is not None and v > best_v:
+            best, best_v = label, v
+    return best
+
+
+def _ingest_section(k=40, sat_clients=16, sat_duration_s=2.5):
+    """Single-copy ingress A/B (socket-to-slot staging + mega-dispatch):
+
+    - ``small_batch``: single-stream binary-frame requests against two
+      live servers over the same fused image chain — one with slot
+      staging OFF (batches stacked into fresh host arrays) and one ON
+      (frame payloads deposited into pre-pinned slots). Interleaved
+      bursts, per the obs_overhead methodology.
+    - ``saturated``: the same pair under ``sat_clients`` keep-alive
+      clients.
+    - ``mega_dispatch``: K=1 vs tuned-K transform-level A/B on a
+      multi-batch partition (6 batches of 16) — the regime where the
+      AOT K-step program actually groups batches; single-request
+      serving dispatches one batch per call, so K shows up here, not
+      in the HTTP numbers.
+    - ``counters``/``bottleneck``: the deposit server's own ingest
+      accounting (slot deposits vs accounted fallback copies, overlap
+      ratio) and the dominant per-segment stage before/after.
+    """
+    from mmlspark_tpu.io.binary import FRAME_CONTENT_TYPE
+
+    out = {}
+    body = _frame_request_body()
+    srv_copy = fused_copy = srv_dep = fused_dep = None
+    try:
+        srv_copy, fused_copy = _serve_frame_chain(slot_staging=False)
+        srv_dep, fused_dep = _serve_frame_chain(slot_staging=True)
+        hdrs = {"Content-Type": FRAME_CONTENT_TYPE}
+        for s in (srv_copy, srv_dep):
+            s.warmup(body, headers=hdrs, sizes=[1])
+
+        def burst(server):
+            return _measure(f"http://{server.host}:{server.port}/",
+                            body, k, warmup=5,
+                            content_type=FRAME_CONTENT_TYPE)["mean_ms"]
+
+        burst(srv_copy), burst(srv_dep)  # throwaway: warm both paths
+        copies, deps = [], []
+        for _ in range(4):
+            deps.append(burst(srv_dep))
+            copies.append(burst(srv_copy))
+        mean_copy = sum(copies) / len(copies)
+        mean_dep = sum(deps) / len(deps)
+        out["small_batch"] = {
+            "copy_mean_ms": round(mean_copy, 4),
+            "deposit_mean_ms": round(mean_dep, 4),
+            "speedup": round(mean_copy / mean_dep, 4) if mean_dep else None}
+
+        sat_copy = _load_keepalive(srv_copy.host, srv_copy.port, body,
+                                   sat_clients, sat_duration_s,
+                                   headers=hdrs)
+        sat_dep = _load_keepalive(srv_dep.host, srv_dep.port, body,
+                                  sat_clients, sat_duration_s,
+                                  headers=hdrs)
+        out["saturated"] = {
+            "copy": sat_copy, "deposit": sat_dep,
+            "qps_ratio": round(sat_dep["qps"] / sat_copy["qps"], 4)
+            if sat_copy.get("qps") else None}
+
+        dep_summary = {}
+        for s in fused_dep._seg_stats.values():
+            dep_summary = s.summary()
+        out["counters"] = {
+            key: dep_summary.get(key)
+            for key in ("slot_deposits", "fallback_copies",
+                        "zero_copy_batches", "copied_batches",
+                        "slot_overlap_ratio")}
+        out["bottleneck_deposit"] = _dominant_stage(dep_summary)
+        copy_summary = {}
+        for s in fused_copy._seg_stats.values():
+            copy_summary = s.summary()
+        out["bottleneck_copy"] = _dominant_stage(copy_summary)
+    finally:
+        for s in (srv_copy, srv_dep):
+            if s is not None:
+                s.stop()
+
+    # -- K=1 vs tuned-K: transform-level, multi-batch partitions ---------
+    fused, model, _, _ = _make_autotune_chain(num_partitions=1, rows=96)
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.core.schema import ImageSchema as _IS
+    rng = np.random.default_rng(3)
+    obj = np.empty(96, dtype=object)
+    for i in range(96):
+        obj[i] = _IS.make(rng.integers(0, 256, (32, 32, 3),
+                                       dtype=np.uint8), f"img{i}")
+    df = DataFrame.from_dict({"image": obj}, num_partitions=1)
+    fused.transform(df)  # compile
+    label = next(iter(fused.fusion_stats()["per_segment"]))
+    chosen = model.choose_mega_k(label) if hasattr(model, "choose_mega_k") \
+        else None
+    k_tuned = chosen if chosen and chosen > 1 else 2
+
+    def run_once():
+        t0 = time.perf_counter()
+        fused.transform(df)
+        return 96 / (time.perf_counter() - t0)
+
+    fused.set_tuning(mega_k={label: k_tuned})
+    run_once()  # compile the K-step program outside the timed rounds
+    k1_rates, kt_rates = [], []
+    for _ in range(6):
+        fused.set_tuning(mega_k={label: 1})
+        k1_rates.append(run_once())
+        fused.set_tuning(mega_k={label: k_tuned})
+        kt_rates.append(run_once())
+    mean_k1 = sum(k1_rates) / len(k1_rates)
+    mean_kt = sum(kt_rates) / len(kt_rates)
+
+    def seg_summary():
+        out = {}
+        for s in fused._seg_stats.values():
+            out = s.summary()
+        return out
+
+    # mechanism evidence: the dispatch component itself (per transform
+    # call), which is what the K-step program amortizes — visible even
+    # when the e2e wall delta is inside CPU scheduling noise
+    fused.set_tuning(mega_k={label: 1})
+    run_once()
+    disp_k1 = seg_summary().get("dispatch_s")
+    fused.set_tuning(mega_k={label: k_tuned})
+    run_once()
+    dsum = seg_summary()
+    out["mega_dispatch"] = {
+        "k": k_tuned, "cost_model_k": chosen,
+        "k1_images_s": round(mean_k1, 2),
+        "tuned_images_s": round(mean_kt, 2),
+        "ratio": round(mean_kt / mean_k1, 4) if mean_k1 else None,
+        "dispatch_s_k1": disp_k1,
+        "dispatch_s_tuned": dsum.get("dispatch_s"),
+        "bottleneck_tuned": _dominant_stage(dsum),
+        "rounds": 6, "batches_per_call": 6}
+
+    out["env_note"] = (
+        "1-core CPU container; the CPU backend's device_put is a host "
+        "copy (no DMA engine), so slot staging removes the row-stack "
+        "copy and the per-batch allocation, not a transfer. small_batch "
+        "is interleaved single-stream bursts; saturated is keep-alive "
+        "concurrent clients where HTTP scheduling noise on a shared core "
+        "dominates the tail — counters (slot_deposits vs "
+        "fallback_copies) are the engagement evidence. mega_dispatch is "
+        "the deterministic transform-level number: 6 batches per call so "
+        "the K-step program actually groups; single-request serving "
+        "dispatches one batch per call and cannot show K. On CPU a "
+        "dispatch is compute-synchronous (no async queue to a device), "
+        "so K's e2e effect is neutral-to-noise here — dispatch_s_k1 vs "
+        "dispatch_s_tuned is the mechanism evidence; the knob targets "
+        "links where a fixed per-dispatch cost dominates.")
+    return out
+
+
 def main():
     import argparse
 
@@ -930,14 +1168,15 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
                     choices=["all", "load_async", "obs_overhead", "wire",
-                             "autotune", "hedging"],
+                             "autotune", "hedging", "ingest"],
                     default="all",
                     help="load_async: run just the overlapped-executor A/B "
                          "section; obs_overhead: just the observability "
                          "on/off A/B; wire: just the JSON-vs-binary frame "
                          "A/B; autotune: just the static-vs-tuned knob A/B; "
-                         "hedging: just the hedged-request straggler A/B "
-                         "(merge into an existing artifact)")
+                         "hedging: just the hedged-request straggler A/B; "
+                         "ingest: just the copy-vs-deposit + mega-dispatch "
+                         "A/B (merge into an existing artifact)")
     args = ap.parse_args()
 
     platform = jax.devices()[0].platform
@@ -955,6 +1194,12 @@ def main():
         print(json.dumps({
             "backend": platform,
             "hedging": _hedging_section()}))
+        return
+
+    if args.only == "ingest":
+        print(json.dumps({
+            "backend": platform,
+            "ingest": _ingest_section()}))
         return
 
     if args.only == "wire":

@@ -45,7 +45,8 @@ if _REPO not in sys.path:
 COLUMNS = (("segment", "segment"), ("batches", "n_batches"),
            ("rows", "rows"), ("ms/batch", "measured_ms_per_batch"),
            ("bound ms", "bound_ms_per_batch"), ("roofline", "roofline_ratio"),
-           ("bottleneck", "bottleneck"), ("flops/batch", "flops_per_batch"),
+           ("bottleneck", "bottleneck"), ("disp%", "dispatch_share"),
+           ("flops/batch", "flops_per_batch"),
            ("bytes/batch", "bytes_per_batch"), ("exemplars", "exemplars"))
 
 
@@ -93,6 +94,10 @@ def rows_from_fusion(fusion: Dict[str, Any],
     for label in sorted(set(roofline) | set(costs)):
         rec = dict(roofline.get(label) or {})
         rec["segment"] = label
+        # the Python submit cost mega-dispatch amortizes, as its own column
+        share = (rec.get("stage_share") or {}).get("dispatch")
+        if share is not None:
+            rec["dispatch_share"] = share
         if "flops_per_batch" not in rec and costs.get(label):
             shapes = costs[label]
             for src, dst in (("flops", "flops_per_batch"),
