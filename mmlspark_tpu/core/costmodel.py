@@ -69,13 +69,23 @@ def bucket_of_shape(shape_key: str) -> Optional[int]:
 
 
 class _BucketRecord:
-    """Measured EWMAs + counters for one (segment, bucket)."""
+    """Measured EWMAs + counters for one (segment, bucket).
 
-    __slots__ = ("n", "rows", "ewma") + _STAGES
+    ``dispatch_call_s`` tracks the DE-AMORTIZED per-Python-call dispatch
+    cost: when a timing rode a K-step mega dispatch (``timing.mega_k`` >
+    1), its ``dispatch_s`` is the per-batch share (mega time / K), so the
+    call cost is ``dispatch_s * mega_k``. ``choose_mega_k`` reads this —
+    reading the amortized EWMA would make an active K>1 look like cheap
+    dispatch, propose K=1, and oscillate every tuning cycle. The amortized
+    ``dispatch_s`` EWMA stays as-is: it IS the per-batch wall
+    contribution the roofline/prediction side wants."""
+
+    __slots__ = ("n", "rows", "ewma", "dispatch_call_s") + _STAGES
 
     def __init__(self):
         self.n = 0
         self.rows = 0
+        self.dispatch_call_s = None
         for k in _STAGES:
             setattr(self, k, None)
 
@@ -87,6 +97,11 @@ class _BucketRecord:
             prev = getattr(self, k)
             setattr(self, k, v if prev is None
                     else (1 - alpha) * prev + alpha * v)
+        k_amort = max(1, int(getattr(timing, "mega_k", 1) or 1))
+        call = float(getattr(timing, "dispatch_s", 0.0) or 0.0) * \
+            k_amort * 1e3
+        self.dispatch_call_s = call if self.dispatch_call_s is None \
+            else (1 - alpha) * self.dispatch_call_s + alpha * call
 
     def wall_ms(self) -> Optional[float]:
         vals = [getattr(self, k) for k in _WALL_STAGES]
@@ -100,6 +115,8 @@ class _BucketRecord:
             v = getattr(self, k)
             if v is not None:
                 out[k[:-2] + "_ms"] = round(v, 6)
+        if self.dispatch_call_s is not None:
+            out["dispatch_call_ms"] = round(self.dispatch_call_s, 6)
         return out
 
     @classmethod
@@ -111,6 +128,9 @@ class _BucketRecord:
             v = d.get(k[:-2] + "_ms")
             if v is not None:
                 setattr(rec, k, float(v))
+        v = d.get("dispatch_call_ms")
+        if v is not None:
+            rec.dispatch_call_s = float(v)
         return rec
 
 
@@ -454,7 +474,10 @@ class SegmentCostModel:
         dispatch cost falls to ``amortize_to`` of the per-batch device work
         (H2D + compute + readback EWMAs at the modal measured bucket).
         Returns None when uncalibrated or the modal bucket lacks a dispatch
-        measurement; 1 when dispatch is already cheap enough."""
+        measurement; 1 when dispatch is already cheap enough. Reads the
+        DE-AMORTIZED per-call dispatch EWMA (``dispatch_call_s``), so the
+        chosen K stays stable while a K>1 mega dispatch is active instead
+        of oscillating back to 1 on its own amortized timings."""
         seg = str(segment)
         if not self.calibrated(seg):
             return None
@@ -465,7 +488,9 @@ class SegmentCostModel:
                     best_rec, best_n = rec, rec.n
             if best_rec is None or best_n < self.min_obs:
                 return None
-            disp = best_rec.dispatch_s
+            disp = best_rec.dispatch_call_s
+            if disp is None:
+                disp = best_rec.dispatch_s
             if disp is None or disp <= 0.0:
                 return None
             work = sum(v for v in (best_rec.h2d_s, best_rec.compute_s,

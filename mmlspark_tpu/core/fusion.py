@@ -697,10 +697,10 @@ class SegmentExecutor:
                                     time.perf_counter() - td
                                 handles.append((handle, timing))
                         else:
-                            staged_all = [
+                            staged_it = (
                                 timed_stage(self._put, batch, obs=obs)
-                                for batch in src]
-                            self._dispatch_mega(staged_all, params_dev,
+                                for batch in src)
+                            self._dispatch_mega(staged_it, params_dev,
                                                 state, step, mega_k,
                                                 handles)
                     finally:
@@ -744,32 +744,32 @@ class SegmentExecutor:
 
         return resolve
 
-    def _dispatch_mega(self, staged_all, params_dev, state: Dict[str, Any],
+    def _dispatch_mega(self, staged_it, params_dev, state: Dict[str, Any],
                        step, k: int, handles) -> None:
-        """Dispatch staged batches in K-step groups: consecutive
-        same-signature batches go through the compiled K-step program (one
-        Python-level dispatch for K micro-batches); leftover runs shorter
-        than K dispatch singly through the ordinary step — the SAME
-        per-batch executable as K=1, so outputs are identical either way.
-        The measured mega dispatch time is split evenly across the K
-        timings (the amortization the bottleneck attribution shows)."""
+        """Dispatch staged batches in SLIDING K-step groups: pull from the
+        (lazily staging) iterator, and the moment K consecutive
+        same-signature batches are staged, run them through the compiled
+        K-step program (one Python-level dispatch for K micro-batches) and
+        DROP the staged-input references — at most K staged inputs are
+        alive at once, matching the ring/K=1 paths' bounded in-flight
+        memory instead of staging a whole partition up front. Runs shorter
+        than K (signature change or end of stream) dispatch singly through
+        the ordinary step — the SAME per-batch executable as K=1, so
+        outputs are identical either way. The measured mega dispatch time
+        is split evenly across the K timings (the amortization the
+        bottleneck attribution shows), with ``timing.mega_k`` tagging the
+        share so the cost model can de-amortize it."""
         ext = state["ext"]
         mega = self._make_mega_step(params_dev, state, k)
-        i = 0
-        while i < len(staged_all):
-            sig0 = self._sig_of(staged_all[i][0][0], ext)
-            group = [staged_all[i]]
-            while len(group) < k and i + len(group) < len(staged_all) and \
-                    self._sig_of(staged_all[i + len(group)][0][0],
-                                 ext) == sig0:
-                group.append(staged_all[i + len(group)])
-            i += len(group)
+
+        def flush(group):
             if len(group) == k:
                 td = time.perf_counter()
                 outs = mega(group)
                 share = (time.perf_counter() - td) / k
                 for (staged, timing), ys in zip(group, outs):
                     timing.dispatch_s = share
+                    timing.mega_k = k
                     handles.append(((ys, staged[1]), timing))
             else:
                 for staged, timing in group:
@@ -777,6 +777,22 @@ class SegmentExecutor:
                     handle = step(staged)
                     timing.dispatch_s = time.perf_counter() - td
                     handles.append((handle, timing))
+
+        group: List[Any] = []
+        sig0 = None
+        for item in staged_it:
+            sig = self._sig_of(item[0][0], ext)
+            if group and sig != sig0:
+                flush(group)
+                group = []
+            if not group:
+                sig0 = sig
+            group.append(item)
+            if len(group) == k:
+                flush(group)
+                group = []
+        if group:
+            flush(group)
 
     def _emit_partition(self, state: Dict[str, Any],
                         collected: Dict[str, List[np.ndarray]]

@@ -25,6 +25,20 @@ import numpy as np
 Partition = Dict[str, np.ndarray]
 
 
+def _release_staging(item) -> None:
+    """Return a dropped batch's SlotPool lease (``Batch.staging``) to the
+    pool. Queued batches can carry leased staging buffers; dropping one on
+    close()/abort without releasing would permanently shrink the shared,
+    never-replenished pool. Idempotent (SlotLease.release guards), no-op
+    for lease-less items."""
+    lease = getattr(item, "staging", None)
+    if lease is not None:
+        try:
+            lease.release()
+        except Exception:  # noqa: BLE001 - cleanup must not mask the abort
+            pass
+
+
 class DevicePrefetcher:
     """Background-thread device prefetch: pull items from an iterator, ship
     them to the device (``put``), and hand over device-resident results
@@ -67,8 +81,11 @@ class DevicePrefetcher:
             try:
                 for item in it:
                     if self._stop.is_set():
+                        _release_staging(item)
                         return
-                    if not offer(put(item) if put is not None else item):
+                    staged = put(item) if put is not None else item
+                    if not offer(staged):
+                        _release_staging(staged)
                         return
             except BaseException as e:  # noqa: BLE001 - re-raised at consumer
                 self._err.append(e)
@@ -80,11 +97,13 @@ class DevicePrefetcher:
         self._thread.start()
 
     def close(self) -> None:
-        """Release the producer thread and any queued buffers (idempotent)."""
+        """Release the producer thread and any queued buffers (idempotent).
+        Dropped items hand their staging leases back to the SlotPool — an
+        early abort must not strand pre-allocated slot buffers."""
         self._stop.set()
         try:
             while True:
-                self._q.get_nowait()
+                _release_staging(self._q.get_nowait())
         except Exception:
             pass
 

@@ -136,6 +136,13 @@ class BatchTiming:
                    unpadded/unknown); ``padded_rows - rows`` is pure
                    pad-waste compute, the cost-model term the bucket
                    auto-tuner (core/costmodel.py) minimizes.
+    ``mega_k``   — dispatch-amortization group size this batch rode (1 =
+                   plain per-batch dispatch). When > 1, ``dispatch_s`` is
+                   the per-batch SHARE of one K-step mega dispatch;
+                   ``dispatch_s * mega_k`` recovers the per-Python-call
+                   fixed cost the cost model's ``choose_mega_k`` needs —
+                   without the tag, an active K>1 makes dispatch look
+                   cheap, the tuner proposes K=1, and K oscillates.
     """
 
     queue_s: float = 0.0
@@ -146,6 +153,7 @@ class BatchTiming:
     bytes_in: int = 0
     rows: int = 0
     padded_rows: int = 0
+    mega_k: int = 1
 
 
 class IngestStats:
@@ -466,13 +474,22 @@ def rows_to_batch(rows, out: Optional[np.ndarray] = None,
 class _SlotBucket:
     """Paired pre-allocated buffers for one (column, batch shape, dtype)
     bucket. Two buffers = double buffering: one fills while the sibling
-    transfers."""
+    transfers. ``fills`` holds this bucket's recent completed fill
+    intervals — a transfer's overlap is measured against its OWN bucket's
+    sibling fills only, never against unrelated leases elsewhere in the
+    shared pool. ``tick`` is the pool's LRU clock value at last use."""
 
-    __slots__ = ("bufs", "free")
+    __slots__ = ("bufs", "free", "fills", "tick")
 
     def __init__(self, shape: Tuple[int, ...], dtype, n: int):
         self.bufs = [np.zeros(shape, dtype=dtype) for _ in range(n)]
         self.free = list(range(n))
+        self.fills: deque = deque(maxlen=8)
+        self.tick = 0
+
+    @property
+    def nbytes(self) -> int:
+        return sum(b.nbytes for b in self.bufs)
 
 
 class SlotLease:
@@ -483,13 +500,18 @@ class SlotLease:
     the fill/transfer/overlap decomposition into IngestStats and returns
     the buffers to the pool. ``release()`` is the idempotent abandon path
     (a faulted transfer frees the buffers without recording a cycle; the
-    slot content is simply overwritten on reuse, never read)."""
+    slot content is simply overwritten on reuse, never read). A weakref
+    finalizer backstops release: a lease dropped on any abort path (queue
+    drain, injected fault, watchdog kill) still returns its buffers to the
+    shared, never-replenished pool instead of shrinking it forever."""
 
     __slots__ = ("arrays", "_pool", "_held", "_stats", "_fill", "_tx0",
-                 "_done")
+                 "_done", "_finalizer", "__weakref__")
 
     def __init__(self, pool: "SlotPool", held: List[Tuple[Tuple, int]],
                  arrays: Dict[str, np.ndarray], stats):
+        import weakref
+
         self.arrays = arrays
         self._pool = pool
         self._held = held
@@ -497,13 +519,14 @@ class SlotLease:
         self._fill = (0.0, 0.0)
         self._tx0: Optional[float] = None
         self._done = False
+        self._finalizer = weakref.finalize(self, pool._release, held)
 
     def fill_begin(self) -> None:
         self._fill = (time.perf_counter(), 0.0)
 
     def fill_end(self) -> None:
         self._fill = (self._fill[0], time.perf_counter())
-        self._pool._note_fill(self._fill)
+        self._pool._note_fill(self._held, self._fill)
 
     def transfer_begin(self) -> None:
         self._tx0 = time.perf_counter()
@@ -514,14 +537,16 @@ class SlotLease:
         if self._stats is not None:
             fill_s = max(0.0, self._fill[1] - self._fill[0])
             self._stats.note_slot(fill_s, tx1 - tx0,
-                                  self._pool._overlap(tx0, tx1))
+                                  self._pool._overlap(self._held, tx0, tx1))
         self.release()
 
     def release(self) -> None:
         if self._done:
             return
         self._done = True
-        self._pool._release(self._held)
+        # the finalizer IS the release (calling it runs pool._release once
+        # and detaches, so a later GC never double-frees)
+        self._finalizer()
 
 
 class SlotPool:
@@ -542,42 +567,76 @@ class SlotPool:
     holds, no lock-order deadlocks) and returns None instead of blocking
     past ``acquire_timeout_s`` — callers fall back to the accounted
     copying path (``IngestStats.note_copy``), so slot contention degrades
-    to today's behavior instead of stalling the ring."""
+    to today's behavior instead of stalling the ring.
+
+    Buffer ALLOCATION happens outside the lock (a 256MB ``np.zeros`` must
+    not stall every concurrent acquire/release), and total pool memory is
+    bounded by ``max_total_bytes``: inserting a new bucket first evicts
+    least-recently-used fully-free buckets, and when no room can be made
+    the acquire returns None (copy-path fallback) instead of growing
+    without limit across the distinct shapes a long-lived server sees."""
 
     def __init__(self, buffers_per_bucket: int = 2,
                  max_slot_bytes: int = 1 << 28,
+                 max_total_bytes: int = 1 << 31,
                  acquire_timeout_s: float = 2.0):
         import threading
 
         self._nbuf = max(1, int(buffers_per_bucket))
         self._max_bytes = int(max_slot_bytes)
+        self._max_total = int(max_total_bytes)
         self._timeout = float(acquire_timeout_s)
         self._cv = threading.Condition()
         self._buckets: Dict[Tuple, _SlotBucket] = {}
-        # recent completed fill intervals (any lease): a transfer's overlap
-        # is its intersection with these — a lease's OWN fill ends before
-        # its transfer begins, so it contributes zero by construction
-        self._fills: deque = deque(maxlen=16)
+        self._tick = 0          # LRU clock (monotonic acquire counter)
+        self._evictions = 0
 
-    def _bucket_for(self, key: Tuple, shape: Tuple[int, ...],
-                    dtype) -> Optional[_SlotBucket]:
-        """Find-or-create under self._cv. None when the slot would exceed
-        the byte cap (callers fall back to the copying path)."""
-        bucket = self._buckets.get(key)
-        if bucket is None:
+    def _missing_buckets(self, keys: Dict[str, Tuple],
+                         spec: Dict[str, Tuple[Tuple[int, ...], Any]]
+                         ) -> Optional[List[Tuple]]:
+        """Under self._cv: keys not yet backed by a bucket, as (key, shape,
+        dtype, nbytes) build specs. None when any slot exceeds the per-slot
+        byte cap (caller falls back to the copying path)."""
+        missing = []
+        for col, key in keys.items():
+            if key in self._buckets:
+                continue
+            shape, dtype = spec[col]
             nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
             if nbytes <= 0 or nbytes > self._max_bytes:
                 return None
-            bucket = self._buckets[key] = _SlotBucket(
-                shape, dtype, self._nbuf)
-        return bucket
+            missing.append((key, tuple(int(d) for d in shape), dtype,
+                            nbytes))
+        return missing
+
+    def _make_room(self, need: int, protect: frozenset) -> bool:
+        """Under self._cv: evict LRU fully-free buckets until ``need`` more
+        bytes fit under ``max_total_bytes``. False when in-use buckets pin
+        the pool above the cap (leased buffers are never evicted — a stale
+        release into a re-created bucket is guarded, but yanking live
+        buffers is not recoverable). ``protect``: keys the CURRENT acquire
+        needs — evicting a sibling bucket of the same spec would ping-pong
+        build/evict forever."""
+        total = sum(b.nbytes for b in self._buckets.values())
+        while total + need > self._max_total:
+            victim_key, victim = None, None
+            for key, b in self._buckets.items():
+                if key not in protect and len(b.free) == len(b.bufs) and \
+                        (victim is None or b.tick < victim.tick):
+                    victim_key, victim = key, b
+            if victim is None:
+                return False
+            del self._buckets[victim_key]
+            total -= victim.nbytes
+            self._evictions += 1
+        return True
 
     def acquire(self, spec: Dict[str, Tuple[Tuple[int, ...], Any]],
                 stats=None,
                 timeout: Optional[float] = None) -> Optional[SlotLease]:
         """``spec``: {column: (full batch shape INCLUDING the leading
         padded cap, dtype)}. Returns a SlotLease, or None on timeout /
-        uncacheable shape (caller copies and accounts it)."""
+        uncacheable shape / a full pool (caller copies and accounts it)."""
         if not spec:
             return None
         deadline = time.perf_counter() + (
@@ -587,28 +646,53 @@ class SlotPool:
             shape, dtype = spec[col]
             keys[col] = (col, tuple(int(d) for d in shape),
                          np.dtype(dtype).str)
-        with self._cv:
-            while True:
-                buckets = {}
-                for col, key in keys.items():
-                    shape, dtype = spec[col]
-                    bucket = self._bucket_for(key, tuple(shape), dtype)
-                    if bucket is None:
-                        return None
-                    buckets[col] = bucket
-                if all(b.free for b in buckets.values()) and \
-                        len({id(b) for b in buckets.values()}) == \
-                        len(buckets):
-                    held = []
-                    arrays = {}
-                    for col, key in keys.items():
-                        idx = buckets[col].free.pop()
-                        held.append((key, idx))
-                        arrays[col] = buckets[col].bufs[idx]
-                    return SlotLease(self, held, arrays, stats)
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0 or not self._cv.wait(remaining):
+        while True:
+            with self._cv:
+                missing = self._missing_buckets(keys, spec)
+                if missing is None:
                     return None
+                if not missing:
+                    lease = self._try_grab(keys, stats)
+                    if lease is not None:
+                        return lease
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0 or not self._cv.wait(remaining):
+                        return None
+                    continue
+            # allocate OUTSIDE the lock: np.zeros of a 256MB slot must not
+            # stall concurrent acquire/release on the shared pool
+            built = [(key, _SlotBucket(shape, dtype, self._nbuf))
+                     for key, shape, dtype, _ in missing]
+            protect = frozenset(keys.values())
+            with self._cv:
+                for key, bucket in built:
+                    if key in self._buckets:
+                        continue  # racing thread built it first; drop ours
+                    if not self._make_room(bucket.nbytes, protect):
+                        return None
+                    self._buckets[key] = bucket
+                self._cv.notify_all()
+            # loop: grab under the lock now that the buckets exist
+
+    def _try_grab(self, keys: Dict[str, Tuple],
+                  stats) -> Optional[SlotLease]:
+        """Under self._cv: all-or-nothing lease over one free buffer per
+        key. None when any bucket has no free buffer (or two columns
+        collapse onto one bucket)."""
+        buckets = {col: self._buckets[key] for col, key in keys.items()}
+        if not all(b.free for b in buckets.values()) or \
+                len({id(b) for b in buckets.values()}) != len(buckets):
+            return None
+        self._tick += 1
+        held = []
+        arrays = {}
+        for col, key in keys.items():
+            bucket = buckets[col]
+            bucket.tick = self._tick
+            idx = bucket.free.pop()
+            held.append((key, idx))
+            arrays[col] = bucket.bufs[idx]
+        return SlotLease(self, held, arrays, stats)
 
     def _release(self, held: List[Tuple[Tuple, int]]) -> None:
         with self._cv:
@@ -618,14 +702,26 @@ class SlotPool:
                     bucket.free.append(idx)
             self._cv.notify_all()
 
-    def _note_fill(self, interval: Tuple[float, float]) -> None:
+    def _note_fill(self, held: List[Tuple[Tuple, int]],
+                   interval: Tuple[float, float]) -> None:
+        """Record a completed fill on the lease's OWN buckets only: overlap
+        must measure this bucket-pair's double buffering, not unrelated
+        leases elsewhere in the shared pool."""
         with self._cv:
-            self._fills.append(interval)
+            for key, _idx in held:
+                bucket = self._buckets.get(key)
+                if bucket is not None:
+                    bucket.fills.append(interval)
 
-    def _overlap(self, tx0: float, tx1: float) -> float:
-        """Seconds of [tx0, tx1] overlapped by any recorded fill."""
+    def _overlap(self, held: List[Tuple[Tuple, int]],
+                 tx0: float, tx1: float) -> float:
+        """Seconds of [tx0, tx1] overlapped by sibling fills in the lease's
+        own buckets (a lease's own fill ends before its transfer begins, so
+        it contributes zero by construction). Multi-column leases record
+        one identical interval per bucket — deduped so it counts once."""
         with self._cv:
-            fills = list(self._fills)
+            fills = {f for key, _idx in held
+                     for f in getattr(self._buckets.get(key), "fills", ())}
         return sum(max(0.0, min(tx1, f1) - max(tx0, f0))
                    for f0, f1 in fills)
 
@@ -633,9 +729,11 @@ class SlotPool:
         with self._cv:
             buckets = len(self._buckets)
             buffers = sum(len(b.bufs) for b in self._buckets.values())
-            nbytes = sum(buf.nbytes for b in self._buckets.values()
-                         for buf in b.bufs)
-        return {"buckets": buckets, "buffers": buffers, "bytes": nbytes}
+            nbytes = sum(b.nbytes for b in self._buckets.values())
+            evictions = self._evictions
+        return {"buckets": buckets, "buffers": buffers, "bytes": nbytes,
+                "max_total_bytes": self._max_total,
+                "evictions": evictions}
 
 
 def _tree_rows(item: Any) -> int:

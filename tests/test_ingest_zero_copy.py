@@ -256,6 +256,105 @@ class TestSlotPool:
         assert s["slot_fill_s"] >= 0 and s["slot_transfer_s"] >= 0
         assert 0.0 <= s["slot_overlap_ratio"] <= 1.0
 
+    def test_overlap_counts_only_own_bucket_fills(self):
+        """A transfer's overlap is measured against ITS bucket's sibling
+        fills — fills from unrelated leases elsewhere in the shared pool
+        must not inflate slot_overlap_ratio."""
+        pool = SlotPool()
+        a = pool.acquire({"x": ((4, 4), np.float32)})
+        b = pool.acquire({"y": ((4, 4), np.float32)})
+        pool._note_fill(a._held, (1.0, 2.0))
+        assert pool._overlap(b._held, 0.0, 10.0) == 0.0
+        assert pool._overlap(a._held, 0.0, 10.0) == pytest.approx(1.0)
+        a.release()
+        b.release()
+
+    def test_abandoned_lease_is_finalized_back_to_pool(self):
+        """A lease dropped without release() (any abort path the explicit
+        cleanup misses) returns its buffers via the weakref finalizer —
+        the never-replenished pool must not shrink permanently."""
+        import gc
+
+        pool = SlotPool(buffers_per_bucket=1)
+        spec = {"x": ((4, 4), np.float32)}
+        lease = pool.acquire(spec)
+        assert lease is not None
+        del lease
+        gc.collect()
+        again = pool.acquire(spec, timeout=0.5)
+        assert again is not None
+        again.release()
+
+    def test_total_bytes_cap_evicts_lru_free_buckets(self):
+        # bucket A: 2 x 64B; bucket B: 2 x 128B — together over the cap,
+        # so inserting B evicts the fully-free A instead of growing
+        pool = SlotPool(buffers_per_bucket=2, max_total_bytes=300)
+        a = pool.acquire({"x": ((4, 4), np.float32)})
+        a.release()
+        b = pool.acquire({"x": ((8, 4), np.float32)})
+        assert b is not None
+        s = pool.stats()
+        assert s["buckets"] == 1 and s["bytes"] == 256
+        assert s["evictions"] == 1
+        b.release()
+
+    def test_leased_buckets_are_never_evicted(self):
+        """When in-use buckets pin the pool at the byte cap, a new shape
+        falls back to the copy path (None) instead of yanking live
+        buffers or growing without bound."""
+        pool = SlotPool(buffers_per_bucket=2, max_total_bytes=300)
+        a = pool.acquire({"x": ((4, 4), np.float32)})
+        assert pool.acquire({"x": ((8, 4), np.float32)},
+                            timeout=0.05) is None
+        s = pool.stats()
+        assert s["buckets"] == 1 and s["evictions"] == 0
+        a.release()
+
+    def test_multi_column_spec_over_cap_falls_back(self):
+        """A spec whose buckets jointly exceed the cap returns None (copy
+        fallback) instead of evicting its own sibling buckets in a
+        build/evict livelock."""
+        pool = SlotPool(buffers_per_bucket=2, max_total_bytes=300)
+        spec = {"x": ((4, 4), np.float32),   # 128B
+                "y": ((8, 4), np.float32)}   # 256B -> jointly over cap
+        assert pool.acquire(spec, timeout=0.2) is None
+
+
+class TestLeaseReleaseOnAbort:
+    def test_prefetcher_close_releases_queued_leases(self):
+        """DevicePrefetcher.close() must hand queued batches' SlotPool
+        leases back: an early abort (fault, fallback, watchdog kill) that
+        drops queued items otherwise removes buffers from the shared pool
+        forever, and every later acquire for that shape eats the full
+        acquire timeout before falling back."""
+        import time
+
+        from mmlspark_tpu.parallel.batching import Batch, DevicePrefetcher
+
+        pool = SlotPool(buffers_per_bucket=2)
+        spec = {"x": ((4, 4), np.float32)}
+
+        def batches():
+            while True:
+                lease = pool.acquire(spec, timeout=1.0)
+                if lease is None:
+                    return
+                yield Batch({"x": lease.arrays["x"]},
+                            np.ones(4, dtype=bool), 4, staging=lease)
+
+        pf = DevicePrefetcher(batches(), depth=2)
+        deadline = time.monotonic() + 2.0
+        while pf._q.qsize() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)  # let the producer queue both leased batches
+        pf.close()
+        pf._thread.join(timeout=5.0)
+        assert not pf._thread.is_alive()
+        a = pool.acquire(spec, timeout=1.0)
+        b = pool.acquire(spec, timeout=1.0)
+        assert a is not None and b is not None  # nothing leaked
+        a.release()
+        b.release()
+
 
 # ---------------------------------------------------------------------------
 # Deposit path through the fused executor: parity + counters
@@ -370,6 +469,36 @@ class TestMegaDispatch:
         got = _feature_matrix(mega.transform_submit(df)())
         np.testing.assert_array_equal(got, ref)
 
+    def test_mega_stages_in_sliding_groups_of_k(self):
+        """The K>1 submit path must NOT stage the whole partition before
+        dispatching (unbounded device memory): groups of K stage, dispatch,
+        and drop — at the first mega dispatch only K items may have been
+        pulled from the staging iterator."""
+        from mmlspark_tpu.core.fusion import SegmentExecutor
+        from mmlspark_tpu.parallel.ingest import BatchTiming
+
+        ex = object.__new__(SegmentExecutor)
+        pulled = [0]
+        dispatch_pulls = []
+
+        def staged_items():
+            for _ in range(6):
+                pulled[0] += 1
+                yield ({"x": np.zeros((4, 2), np.float32)}, 4), \
+                    BatchTiming(rows=4)
+
+        def mega(group):
+            dispatch_pulls.append(pulled[0])
+            return [(np.zeros(1),)] * len(group)
+
+        ex._make_mega_step = lambda params, state, k: mega
+        handles = []
+        ex._dispatch_mega(staged_items(), None, {"ext": ["x"]}, None, 2,
+                          handles)
+        assert dispatch_pulls == [2, 4, 6]  # eager staging would be [6,...]
+        assert len(handles) == 6
+        assert all(t.mega_k == 2 for _h, t in handles)
+
 
 class TestMegaKnob:
     def test_cost_model_chooses_k_from_dispatch_ratio(self):
@@ -395,6 +524,34 @@ class TestMegaKnob:
         assert cheap.choose_mega_k("seg") == 1
         # uncalibrated: None
         assert SegmentCostModel().choose_mega_k("other") is None
+
+    def test_choose_mega_k_stable_under_amortized_timings(self):
+        """Once K>1 is active, recorded dispatch_s is the per-batch SHARE
+        of one mega dispatch. choose_mega_k must de-amortize via the
+        mega_k tag — otherwise the tuner sees cheap dispatch, proposes
+        K=1, the cost reappears, and K oscillates every cycle."""
+        from mmlspark_tpu.core.costmodel import SegmentCostModel
+        from mmlspark_tpu.parallel.ingest import BatchTiming
+
+        model = SegmentCostModel(peaks={"flops": 1e9, "bytes_per_s": 1e9,
+                                        "peak_source": "test"}, min_obs=2)
+        for _ in range(4):
+            model.observe_batch("seg", BatchTiming(
+                h2d_s=0.0004, dispatch_s=0.005, compute_s=0.0005,
+                readback_s=0.0001, rows=16, padded_rows=16))
+        k = model.choose_mega_k("seg")
+        assert k is not None and k > 1
+        # mega active: per-batch dispatch share = fixed cost / K, tagged
+        for _ in range(16):
+            model.observe_batch("seg", BatchTiming(
+                h2d_s=0.0004, dispatch_s=0.005 / k, compute_s=0.0005,
+                readback_s=0.0001, rows=16, padded_rows=16, mega_k=k))
+        assert model.choose_mega_k("seg") == k
+        # the de-amortized EWMA survives serialization
+        restored = SegmentCostModel.from_dict(
+            model.to_dict(), peaks={"flops": 1e9, "bytes_per_s": 1e9,
+                                    "peak_source": "test"})
+        assert restored.choose_mega_k("seg") == k
 
     def test_knobset_round_trips_and_rollback(self):
         from mmlspark_tpu.core.tune import KnobSet
