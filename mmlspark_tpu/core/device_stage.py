@@ -136,6 +136,15 @@ class CompileCache:
     describes executables that are actually resident; ``evictions`` counts
     drops (exposed as ``mmlspark_segment_cache_evictions_total``).
     ``capacity`` defaults from ``MMLSPARK_SEGMENT_CACHE_CAP`` when unset.
+
+    Persistent tier (serving/fleet/cache.py): ``attach_persistent`` hangs a
+    second, cross-process tier under the miss path. A memory miss first
+    asks the tier for a deserialized executable (no compile, no
+    miss/compile-time accounting — the tier keeps its own hit/miss/error
+    counters); only a two-tier miss runs ``builder``, after which the
+    fresh executable is offered back to the tier best-effort. With no tier
+    attached (the default) every code path and counter is exactly the
+    pre-fleet behavior.
     """
 
     def __init__(self, capacity: Optional[int] = None):
@@ -160,10 +169,44 @@ class CompileCache:
         self.misses = 0
         self.evictions = 0
         self.compile_time_s = 0.0
+        # optional cross-process second tier (duck-typed: load/store/stats;
+        # serving/fleet/cache.py PersistentCompileCache). None = single-tier.
+        self._persistent: Optional[Any] = None
 
     @property
     def capacity(self) -> int:
         return self._capacity
+
+    def attach_persistent(self, tier: Optional[Any]) -> None:
+        """Hang a persistent tier under the miss path (None detaches). The
+        tier must be exception-free: ``load`` returns ``(fn, cost)`` or
+        ``None``; ``store`` is fire-and-forget."""
+        with self._lock:
+            self._persistent = tier
+
+    @property
+    def persistent(self) -> Optional[Any]:
+        with self._lock:
+            return self._persistent
+
+    def preload(self, key: Tuple, fn: Any, label: Optional[str] = None,
+                shape: Optional[str] = None,
+                cost: Optional[Dict[str, Any]] = None) -> bool:
+        """Install a deserialized executable WITHOUT miss/compile-time
+        accounting — the persistent tier's pod-start AOT warm path. Returns
+        False when the key is already resident (warm never clobbers a live
+        entry)."""
+        with self._lock:
+            if key in self._entries:
+                return False
+            while len(self._entries) >= self._capacity:
+                self._evict_lru_locked()
+                self.evictions += 1
+            self._entries[key] = fn
+            if label is not None:
+                self._costs[(str(label), str(shape))] = dict(cost or {})
+                self._cost_key[key] = (str(label), str(shape))
+            return True
 
     def set_capacity(self, capacity: int) -> None:
         """Re-bound the cache; shrinking evicts LRU entries immediately."""
@@ -196,6 +239,25 @@ class CompileCache:
                 self._entries[key] = fn
                 return fn
             gen = self._gen
+            tier = self._persistent
+        if tier is not None:
+            # second-tier probe OUTSIDE the lock (deserializing an AOT
+            # executable does real I/O). A tier hit installs with NO
+            # miss/compile accounting: nothing compiled.
+            loaded = tier.load(key, label=label, shape=shape)
+            if loaded is not None:
+                fn, pcost = loaded
+                with self._lock:
+                    if key not in self._entries:
+                        while len(self._entries) >= self._capacity:
+                            self._evict_lru_locked()
+                            self.evictions += 1
+                        self._entries[key] = fn
+                        if self._gen == gen and label is not None:
+                            self._costs[(str(label), str(shape))] = dict(
+                                pcost or {})
+                            self._cost_key[key] = (str(label), str(shape))
+                    return self._entries[key]
         # build OUTSIDE the lock: XLA compiles can take seconds and other
         # segments/threads must not serialize behind them
         t0 = time.perf_counter()
@@ -206,15 +268,15 @@ class CompileCache:
             from ..obs.perf import extract_cost
 
             cost = extract_cost(fn)
+        rec = dict(cost or {})
+        rec["compile_s"] = round(dt, 6)
         with self._lock:
             stale = self._gen != gen  # reset() raced the build
             if not stale:
                 self.misses += 1
                 self.compile_time_s += dt
                 if label is not None:
-                    rec = dict(cost or {})
-                    rec["compile_s"] = round(dt, 6)
-                    self._costs[(str(label), str(shape))] = rec
+                    self._costs[(str(label), str(shape))] = dict(rec)
             if key not in self._entries:
                 while len(self._entries) >= self._capacity:
                     self._evict_lru_locked()
@@ -222,7 +284,13 @@ class CompileCache:
                 self._entries[key] = fn
                 if not stale and label is not None:
                     self._cost_key[key] = (str(label), str(shape))
-            return self._entries[key]
+            out = self._entries[key]
+        if tier is not None and not stale and out is fn:
+            # offer the fresh executable to the persistent tier, outside
+            # every lock: store is best-effort and must never block or
+            # fail the serving path (the tier swallows its own errors)
+            tier.store(key, fn, cost=rec, label=label, shape=shape)
+        return out
 
     def clear(self) -> None:
         with self._lock:
@@ -270,7 +338,7 @@ class CompileCache:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             total = self.hits + self.misses
-            return {
+            out = {
                 "entries": len(self._entries),
                 "capacity": self._capacity,
                 "hits": self.hits,
@@ -279,6 +347,16 @@ class CompileCache:
                 "hit_rate": round(self.hits / total, 4) if total else None,
                 "compile_time_s": round(self.compile_time_s, 6),
             }
+            tier = self._persistent
+        if tier is not None:
+            # tier stats OUTSIDE the cache lock (the tier takes its own);
+            # the key is absent entirely when no tier is attached, so the
+            # fleet=False stats payload is byte-identical to pre-fleet
+            try:
+                out["persistent"] = tier.stats()
+            except Exception as e:  # noqa: BLE001 — stats must not raise
+                out["persistent"] = {"error": str(e)}
+        return out
 
 
 _GLOBAL_CACHE = CompileCache()

@@ -198,10 +198,17 @@ WORKER_CRASH = "worker.crash"
 # serving/routing hedge launch (threaded + async fronts): a raising plan
 # suppresses that hedge; fired() observes exactly which requests hedged
 FRONT_HEDGE = "front.hedge"
+# serving/fleet persistent compile-cache tier: load fires before an entry
+# is read/deserialized (a raising plan = corrupted/unreadable entry ->
+# accounted recompile); store fires before the atomic write (a raising
+# plan = full/readonly cache volume -> serving continues uncached)
+COMPILECACHE_LOAD = "compilecache.load"
+COMPILECACHE_STORE = "compilecache.store"
 
 ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
               JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE,
-              WORKER_DISPATCH_HANG, WORKER_CRASH, FRONT_HEDGE)
+              WORKER_DISPATCH_HANG, WORKER_CRASH, FRONT_HEDGE,
+              COMPILECACHE_LOAD, COMPILECACHE_STORE)
 
 
 class InjectedFault(OSError):

@@ -968,6 +968,33 @@ class FusedPipelineModel(PipelineModel):
         return self._cost_model
 
     @property
+    def compile_cache(self) -> CompileCache:
+        """The executable cache this model's segments compile into — the
+        attachment point for the fleet's persistent tier."""
+        return self._cache
+
+    def attach_persistent_cache(self, tier, warm: bool = True
+                                ) -> Dict[str, int]:
+        """Fleet hook (serving/fleet/cache.py): hang the persistent tier
+        under this model's CompileCache and (by default) AOT-warm —
+        preload every compatible persisted executable NOW, so the first
+        request for a previously-seen (segment, bucket) signature is a
+        memory hit with zero jit compiles. Harvested cost records from
+        the fleet's entries (including cost-only ones) feed the cost
+        model, so planning starts calibrated on a fresh pod."""
+        self._cache.attach_persistent(tier)
+        stats = tier.warm(self._cache) if warm else \
+            {"warmed": 0, "costs_only": 0, "skipped": 0, "errors": 0}
+        if self._cost_model is not None:
+            harvested = tier.harvested_costs()
+            if harvested:
+                try:
+                    self._cost_model.ingest_costs(harvested)
+                except Exception:  # noqa: BLE001 — warm costs best-effort
+                    pass
+        return stats
+
+    @property
     def mega_k_max(self) -> int:
         """Largest active K-step dispatch factor (1 when untuned). Serving's
         DispatchWatchdog scales its budget by this so a K-batch mega-dispatch

@@ -182,8 +182,10 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         node = self._output_node(model)
         in_col = self.get_or_throw("inputCol")
         out_col = self.get_or_throw("outputCol")
-        key = ("ImageFeaturizer", in_col, out_col, id(model), node, spec,
-               h, w, c)
+        # cache_token (not id): the shared CompileCache key must survive a
+        # process restart for the fleet's persistent tier to hit
+        key = ("ImageFeaturizer", in_col, out_col, model.cache_token(),
+               node, spec.cache_key(), h, w, c)
 
         def prepare(cols, ctx):
             # the unfused per-row prep (decode -> resize -> channel fix);

@@ -200,7 +200,10 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
         out_cols = tuple(out_map)
         taps = tuple(out_map[c] for c in out_cols)
         spec: Optional[PreprocessSpec] = self.get("preprocess")
-        key = ("DNNModel", id(model), in_col, out_cols, taps, spec)
+        # cache_token (not id): the shared CompileCache key must survive a
+        # process restart for the fleet's persistent tier to hit
+        key = ("DNNModel", model.cache_token(), in_col, out_cols, taps,
+               None if spec is None else spec.cache_key())
 
         def fn(params, env):
             import jax.numpy as jnp

@@ -509,6 +509,41 @@ class FunctionModel:
     # Consumers (ImageFeaturizer) read this to orient the pixel array.
     data_format: str = "NHWC"
 
+    def cache_token(self) -> str:
+        """Stable cross-process identity of the traced computation, for
+        compile-cache keys (DeviceFn.key). Params are ARGUMENTS to the
+        compiled forward, so the token binds the architecture (the pickled
+        module tree — the same structural-serialization contract
+        core/serialize.py relies on) plus the param tree's layout
+        (treedef, leaf shapes, dtypes) — NOT weight values. Two processes
+        loading the same model therefore agree on the token, which is what
+        lets the fleet's persistent compile cache (serving/fleet/cache.py)
+        hand a fresh replica an executable compiled elsewhere. Falls back
+        to the process-local ``id()`` when the module tree won't pickle
+        (opaque native handles) — correctness keeps, cross-process reuse
+        degrades."""
+        tok = getattr(self, "_cache_token", None)
+        if tok is None:
+            import hashlib
+            import pickle
+
+            import jax
+            try:
+                leaves, treedef = jax.tree.flatten(self.params)
+                spec = tuple(
+                    (tuple(int(d) for d in np.shape(leaf)),
+                     str(getattr(leaf, "dtype", type(leaf).__name__)))
+                    for leaf in leaves)
+                blob = pickle.dumps(
+                    (self.module, tuple(self.input_shape),
+                     tuple(self.layer_names), self.name, self.data_format,
+                     str(treedef), spec), protocol=4)
+                tok = "m:" + hashlib.sha256(blob).hexdigest()[:20]
+            except Exception:  # noqa: BLE001 — unpicklable module tree
+                tok = f"id:{id(self)}"
+            self._cache_token = tok
+        return tok
+
     def argument_names(self) -> List[str]:
         """Graph input names (multi-input GraphModules list all of them)."""
         names = getattr(self.module, "input_names", None)

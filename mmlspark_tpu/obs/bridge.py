@@ -180,6 +180,47 @@ def _fusion_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
         yield MetricFamily(
             "mmlspark_segment_cache_capacity", "gauge",
             "configured CompileCache entry cap").add(cap)
+    tier = cache.get("persistent")
+    if tier:
+        # two-tier view (serving/fleet/cache.py): the untierred families
+        # above keep their pre-fleet meaning (in-process builds); these
+        # label the same memory numbers tier="memory" next to the
+        # persistent tier's own counters. Absent when fleet is off, so
+        # the disabled exposition stays byte-identical.
+        hits = MetricFamily("mmlspark_compile_cache_tier_hits_total",
+                            "counter", "compile-cache hits per tier")
+        misses = MetricFamily("mmlspark_compile_cache_tier_misses_total",
+                              "counter", "compile-cache misses per tier")
+        for fam, key in ((hits, "hits"), (misses, "misses")):
+            f = _num(cache.get(key))
+            if f is not None:
+                fam.add(f, {"tier": "memory"})
+            f = _num(tier.get(key))
+            if f is not None:
+                fam.add(f, {"tier": "persistent"})
+            if fam.samples:
+                yield fam
+        for key, name, help in (
+                ("entries", "mmlspark_compile_cache_tier_entries",
+                 "entries resident per tier"),
+                ("load_s", "mmlspark_compile_cache_load_seconds_total",
+                 "seconds spent loading persisted executables"),
+                ("store_s", "mmlspark_compile_cache_store_seconds_total",
+                 "seconds spent serializing + writing executables")):
+            f = _num(tier.get(key))
+            if f is not None:
+                yield MetricFamily(
+                    name, "gauge" if key == "entries" else "counter",
+                    help).add(f, {"tier": "persistent"})
+        errs = MetricFamily("mmlspark_compile_cache_tier_errors_total",
+                            "counter", "persistent-tier entries that "
+                            "failed to load/store (degraded to recompile)")
+        for op, key in (("load", "load_errors"), ("store", "store_errors")):
+            f = _num(tier.get(key))
+            if f is not None:
+                errs.add(f, {"tier": "persistent", "op": op})
+        if errs.samples:
+            yield errs
     nseg = _num(stats.get("n_fused_segments"))
     if nseg is not None:
         yield MetricFamily("mmlspark_fused_segments", "gauge",
@@ -447,6 +488,34 @@ def _brownout_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
     yield trans
 
 
+def _fleet_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
+    """Fleet controller state (serving/fleet): the capacity
+    recommendation an external scaler keys on, the demand forecast
+    behind it, and the decision counters — mmlspark_capacity_* per
+    docs/observability.md."""
+    rec = summary.get("recommended_replicas")
+    f = _num(rec)
+    if f is not None:
+        yield MetricFamily(
+            "mmlspark_capacity_recommended_replicas", "gauge",
+            "planner-recommended replica count (the HPA signal)").add(f)
+    f = _num((summary.get("forecast") or {}).get("forecast_rps"))
+    if f is not None:
+        yield MetricFamily(
+            "mmlspark_capacity_forecast_rps", "gauge",
+            "forecast arrival rate (rows/s) at the planning horizon"
+        ).add(f)
+    dec = MetricFamily(
+        "mmlspark_capacity_decisions_total", "counter",
+        "fleet controller decisions by kind "
+        "(scale_out / scale_in / rollback / held_degraded)")
+    for kind, n in (summary.get("decisions") or {}).items():
+        f = _num(n)
+        if f is not None:
+            dec.add(f, {"decision": str(kind)})
+    yield dec
+
+
 def _hedge_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
     """Hedged-request accounting (serving/supervisor.py HedgeTracker):
     volume by outcome, win attribution, and the live quantile delay —
@@ -520,6 +589,11 @@ def fold_server(registry: MetricsRegistry, server: Any) -> None:
             try:
                 fams.extend(_brownout_families(server._brownout.summary()))
             except Exception:  # noqa: BLE001 — brownout mid-transition
+                pass
+        if getattr(server, "_fleet", None) is not None:
+            try:
+                fams.extend(_fleet_families(server._fleet.summary()))
+            except Exception:  # noqa: BLE001 — fleet mid-plan
                 pass
         if server.ingest_stats is not None:
             try:

@@ -413,6 +413,16 @@ class SLOTracker:
                     out[w] = (t + total, b + bad)
         return out
 
+    def arrival_buckets(self) -> Dict[str, Any]:
+        """Snapshot of the per-second ``(second, total, breaches)`` triples
+        plus this tracker's own clock reading — the fleet planner's
+        arrival-rate source (serving/fleet/planner.py forecast_rps). The
+        clock rides along because the buckets are stamped with THIS clock
+        (monotonic by default), which need not agree with wall time."""
+        with self._lock:
+            buckets = [tuple(b) for b in self._buckets]
+        return {"now": self._clock(), "buckets": buckets}
+
     def burn_rates(self) -> Dict[int, float]:
         """{window_s: burn rate}: violating fraction / error budget; 0.0
         with no traffic in the window (nothing burning)."""

@@ -72,6 +72,15 @@ class PreprocessSpec:
         return (self.scale == 1.0 and self.offset == 0.0
                 and self.transpose is None and self.dtype == "float32")
 
+    def cache_key(self) -> Tuple:
+        """Pure-literal tuple form for compile-cache keys. The persistent
+        fleet tier (serving/fleet/cache.py) round-trips keys through
+        ``repr``/``ast.literal_eval`` — a dataclass repr would survive
+        repr but not the (deliberately eval-free) parse, demoting warm-up
+        from AOT-by-name to lazy-at-first-request."""
+        return ("PreprocessSpec", float(self.scale), float(self.offset),
+                self.transpose, self.dtype)
+
     def _batch_axes(self, ndim: int) -> Tuple[int, ...]:
         perm = self.transpose
         if perm is None or len(perm) != ndim - 1:

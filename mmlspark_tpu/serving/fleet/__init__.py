@@ -1,0 +1,39 @@
+"""Fleet control plane: everything a POD FLEET needs that one process
+doesn't (ROADMAP "Planet-scale serving").
+
+Three cooperating parts, each usable alone:
+
+  - ``cache``      persistent, content-addressed compile cache: a second
+                   tier under the in-process ``CompileCache`` that ships
+                   serialized AOT executables between pods, so a fresh
+                   replica answers its first request with zero jit
+                   compiles for previously-seen (segment, bucket)
+                   signatures (the TVM move: ship the tuned artifact, do
+                   not re-learn it per worker).
+  - ``planner``    capacity planner: inverts the calibrated
+                   SegmentCostModel — arrival-rate forecast in, the
+                   (replicas, inflight, bucket, mega_k) config that meets
+                   the SLO at minimum capacity out. Pure and journaled.
+  - ``controller`` autoscale controller: one loop consuming the
+                   multi-window SLO burn rates; BrownoutController is the
+                   fast path (degrade in-place NOW), the planner's
+                   scale-out/in recommendation the slow path (hysteretic,
+                   journaled, one-step rollback like the Tuner). Publishes
+                   the cross-pod recommendation at ``/_mmlspark/capacity``
+                   for helm HPA / an external scaler.
+
+See docs/fleet.md for the cache key contract, the planner math, and the
+controller state machine.
+"""
+
+from .cache import PersistentCompileCache, content_key
+from .controller import FleetController, FleetSpec, make_fleet
+from .planner import (CapacityPlan, CapacityPlanner, PlannerConfig,
+                      forecast_rps, plan_capacity)
+
+__all__ = [
+    "PersistentCompileCache", "content_key",
+    "CapacityPlan", "CapacityPlanner", "PlannerConfig",
+    "forecast_rps", "plan_capacity",
+    "FleetController", "FleetSpec", "make_fleet",
+]

@@ -1,0 +1,409 @@
+"""Persistent, content-addressed compile cache — the cross-process tier
+under the in-process LRU ``CompileCache`` (core/device_stage.py).
+
+Why: every new replica/pod recompiles every (segment, bucket) signature
+from scratch, so at fleet scale every scale-out event is a self-inflicted
+compile-latency storm. TVM's answer (PAPERS.md) is to ship the tuned,
+compiled artifact to new workers instead of re-learning it per worker;
+this module is that answer for fused XLA executables.
+
+Entry format (one file per signature, ``<digest>.mmlc``)::
+
+    MAGIC (6 bytes) | header length (8 bytes, big-endian) | header JSON
+    | payload (pickled ``serialize_executable.serialize`` triple, or
+      empty for cost-only entries)
+
+The content key (``content_key``) is a sha256 over the canonical repr of
+the in-process cache key — (segment graph key, shape-bucket signature,
+dtypes) — joined with the environment fingerprint (jax version, backend,
+format version). Anything that changes what XLA would compile changes the
+digest, so a foreign-version entry is simply never looked up AND is
+rejected again at load time by the header fingerprint (defense in depth:
+a digest collision or a hand-copied file still can't smuggle a stale
+executable in).
+
+Degradation contract (chaos-tested, tests/test_faults.py):
+
+  - a truncated / corrupted / foreign-version / unpicklable entry
+    degrades to an accounted recompile (``load_errors`` counter, never a
+    crash);
+  - a store failure (full volume, readonly mount, injected fault) never
+    blocks or fails the serving path (``store_errors`` counter);
+  - an executable that this jax cannot serialize falls back to persisting
+    only the harvested cost record and the live tuner knobs
+    (``kind="costs"``), which still warm the cost model — the planner and
+    tuner start calibrated even when the executable itself can't travel.
+
+Fault points: ``compilecache.load`` / ``compilecache.store``
+(core/faults.py) fire before the read and the atomic write respectively.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import logging
+import os
+import pickle
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ...core import faults
+
+_LOG = logging.getLogger(__name__)
+
+#: on-disk format version — bump on any layout change; mismatched entries
+#: are skipped (never parsed further)
+FORMAT = 1
+MAGIC = b"MMLC1\n"
+_HEADER_LEN_BYTES = 8
+#: entry file suffix (mmlspark compiled)
+SUFFIX = ".mmlc"
+
+
+def _canon(obj: Any) -> str:
+    """Deterministic textual form of a cache key: primitives and (nested)
+    tuples/lists render via repr, anything else via its type+repr — stable
+    across processes for the primitive-only keys fusion actually builds."""
+    return repr(obj)
+
+
+def env_fingerprint() -> Dict[str, Any]:
+    """What must match for a persisted executable to be loadable here:
+    jax/jaxlib version and the default backend. Import-gated — without
+    jax the fingerprint still exists (cost-only entries remain usable)."""
+    fp: Dict[str, Any] = {"format": FORMAT}
+    try:
+        import jax
+
+        fp["jax"] = str(jax.__version__)
+        fp["backend"] = str(jax.default_backend())
+    except Exception:  # noqa: BLE001 — host-only installs still fingerprint
+        fp["jax"] = "none"
+        fp["backend"] = "none"
+    return fp
+
+
+def content_key(key: Any, fp: Optional[Dict[str, Any]] = None) -> str:
+    """sha256 content hash of (cache key, environment fingerprint) — the
+    entry's filename stem. The in-process key already encodes the segment
+    graph identity, the bucketed batch shape, and the dtypes (core/fusion
+    ``(seg.key, sig)``); the fingerprint folds in jax/backend/format."""
+    fp = fp if fp is not None else env_fingerprint()
+    h = hashlib.sha256()
+    h.update(_canon(key).encode("utf-8"))
+    h.update(json.dumps(fp, sort_keys=True).encode("utf-8"))
+    return h.hexdigest()
+
+
+def _serialize_executable(fn: Any) -> Optional[bytes]:
+    """Pickle the AOT executable's portable triple, or None when this jax
+    (or this executable — e.g. the lazy ``jitted`` fallback the builder
+    returns when ``lower().compile()`` is unavailable) can't serialize."""
+    try:
+        from jax.experimental import serialize_executable as se
+    except Exception:  # noqa: BLE001 — older/stripped jax: cost-only tier
+        return None
+    try:
+        triple = se.serialize(fn)
+        return pickle.dumps(triple)
+    except Exception:  # noqa: BLE001 — unserializable executable
+        return None
+
+
+def _deserialize_executable(payload: bytes) -> Any:
+    from jax.experimental import serialize_executable as se
+
+    serialized, in_tree, out_tree = pickle.loads(payload)
+    return se.deserialize_and_load(serialized, in_tree, out_tree)
+
+
+class PersistentCompileCache:
+    """Directory-backed second tier for ``CompileCache`` (one file per
+    signature; the directory is the shared volume / object-store mount).
+
+    ``write=False`` makes the tier read-only (consume a fleet-shared
+    cache without contributing — e.g. canary pods). ``knobs_provider``
+    (a zero-arg callable returning a dict) snapshots the live tuner knobs
+    into every stored entry, so a cost-only entry still carries the tuned
+    configuration to the next pod.
+
+    Thread contract: counters live under ``_lock``; file I/O and
+    (de)serialization always run OUTSIDE it.
+    """
+
+    def __init__(self, path: str, write: bool = True,
+                 knobs_provider: Optional[Callable[[], dict]] = None):
+        self.path = str(path)
+        self.write = bool(write)
+        self.knobs_provider = knobs_provider
+        self._fp = env_fingerprint()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.store_skips = 0      # already present / not serializable+empty
+        self.costs_only = 0       # entries persisted/loaded without payload
+        self.load_errors = 0
+        self.store_errors = 0
+        self.load_s = 0.0
+        self.store_s = 0.0
+        #: cost records recovered from cost-only entries at warm time:
+        #: {label: {shape: record}} — SegmentCostModel.ingest_costs shape
+        self._cost_records: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        #: last knobs dict seen in a warmed entry (newest mtime wins)
+        self.loaded_knobs: Optional[Dict[str, Any]] = None
+        if self.write:
+            try:
+                os.makedirs(self.path, exist_ok=True)
+            except OSError:
+                # unwritable mount: degrade to read-only, don't crash the
+                # server constructor
+                self.write = False
+
+    # -- entry I/O ---------------------------------------------------------
+
+    def _file_for(self, digest: str) -> str:
+        return os.path.join(self.path, digest + SUFFIX)
+
+    def _read_entry(self, path: str
+                    ) -> Tuple[Dict[str, Any], Optional[bytes]]:
+        """Parse one entry file -> (header, payload or None). Raises on any
+        corruption; callers account and degrade."""
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        buf = io.BytesIO(blob)
+        if buf.read(len(MAGIC)) != MAGIC:
+            raise ValueError("bad magic")
+        hlen = int.from_bytes(buf.read(_HEADER_LEN_BYTES), "big")
+        if hlen <= 0 or hlen > len(blob):
+            raise ValueError("bad header length")
+        header = json.loads(buf.read(hlen).decode("utf-8"))
+        payload = buf.read()
+        if header.get("kind") == "exec":
+            want = header.get("payload_sha256")
+            if want != hashlib.sha256(payload).hexdigest():
+                raise ValueError("payload digest mismatch (truncated?)")
+        else:
+            payload = None
+        for k, v in self._fp.items():
+            if header.get("env", {}).get(k) != v:
+                raise ValueError(
+                    f"environment mismatch on {k!r}: entry "
+                    f"{header.get('env', {}).get(k)!r} != local {v!r}")
+        return header, payload
+
+    def _write_entry(self, path: str, header: Dict[str, Any],
+                     payload: bytes) -> None:
+        hjson = json.dumps(header, sort_keys=True).encode("utf-8")
+        blob = MAGIC + len(hjson).to_bytes(_HEADER_LEN_BYTES, "big") \
+            + hjson + payload
+        faults.atomic_write_bytes(path, blob)
+
+    # -- the CompileCache tier protocol ------------------------------------
+
+    def load(self, key: Any, label: Optional[str] = None,
+             shape: Optional[str] = None
+             ) -> Optional[Tuple[Any, Optional[Dict[str, Any]]]]:
+        """Look the live key up in the persistent tier. Returns
+        ``(executable, cost_record)`` on a hit, None on miss OR any error
+        (corruption, version skew, injected fault) — the caller recompiles
+        and the failure is an accounted counter, never an exception."""
+        digest = content_key(key, self._fp)
+        path = self._file_for(digest)
+        t0 = time.perf_counter()
+        try:
+            faults.fire(faults.COMPILECACHE_LOAD, key=digest, label=label)
+            if not os.path.exists(path):
+                with self._lock:
+                    self.misses += 1
+                return None
+            header, payload = self._read_entry(path)
+            if header.get("kind") != "exec" or payload is None:
+                # cost-only entry: nothing to execute, but the harvested
+                # cost still warms the model
+                self._absorb_costs(header)
+                with self._lock:
+                    self.costs_only += 1
+                    self.misses += 1
+                return None
+            fn = _deserialize_executable(payload)
+        except Exception as e:  # noqa: BLE001 — degrade to recompile
+            _LOG.warning("persistent compile-cache load failed for %s: %s",
+                         digest[:12], e)
+            with self._lock:
+                self.load_errors += 1
+                self.misses += 1
+            return None
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.hits += 1
+            self.load_s += dt
+        return fn, header.get("cost")
+
+    def store(self, key: Any, fn: Any,
+              cost: Optional[Dict[str, Any]] = None,
+              label: Optional[str] = None,
+              shape: Optional[str] = None) -> bool:
+        """Persist one freshly-compiled executable (or, when it can't
+        serialize, its cost record + live knobs). Fire-and-forget: every
+        failure is a counter, never an exception into the serving path."""
+        if not self.write:
+            return False
+        digest = content_key(key, self._fp)
+        path = self._file_for(digest)
+        t0 = time.perf_counter()
+        try:
+            faults.fire(faults.COMPILECACHE_STORE, key=digest, label=label)
+            if os.path.exists(path):
+                with self._lock:
+                    self.store_skips += 1
+                return False
+            payload = _serialize_executable(fn)
+            kind = "exec" if payload is not None else "costs"
+            knobs = None
+            if self.knobs_provider is not None:
+                try:
+                    knobs = self.knobs_provider()
+                except Exception:  # noqa: BLE001 — knobs are best-effort
+                    knobs = None
+            header = {
+                "kind": kind,
+                "env": dict(self._fp),
+                "key_repr": _canon(key),
+                "label": label,
+                "shape": shape,
+                "cost": dict(cost or {}) or None,
+                "knobs": knobs,
+                "payload_sha256": hashlib.sha256(
+                    payload).hexdigest() if payload is not None else None,
+            }
+            self._write_entry(path, header, payload or b"")
+        except Exception as e:  # noqa: BLE001 — never block serving
+            _LOG.warning("persistent compile-cache store failed for %s: %s",
+                         digest[:12], e)
+            with self._lock:
+                self.store_errors += 1
+            return False
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.stores += 1
+            self.store_s += dt
+            if kind == "costs":
+                self.costs_only += 1
+        return True
+
+    # -- pod-start AOT warm -------------------------------------------------
+
+    def warm(self, cache: Any, limit: Optional[int] = None
+             ) -> Dict[str, int]:
+        """Preload every compatible persisted executable into the
+        in-process ``CompileCache`` (``cache.preload`` — no miss/compile
+        accounting), so a fresh replica's first request for a
+        previously-seen signature is a plain memory hit with zero jit
+        compiles. Cost-only entries warm ``harvested_costs()`` /
+        ``loaded_knobs`` instead. Every per-entry failure is counted and
+        skipped — a corrupted fleet cache can only make warm-up smaller,
+        never fail pod start."""
+        out = {"warmed": 0, "costs_only": 0, "skipped": 0, "errors": 0}
+        try:
+            names = sorted(n for n in os.listdir(self.path)
+                           if n.endswith(SUFFIX))
+        except OSError:
+            return out
+        for name in names:
+            if limit is not None and out["warmed"] >= limit:
+                break
+            path = os.path.join(self.path, name)
+            try:
+                faults.fire(faults.COMPILECACHE_LOAD, key=name)
+                header, payload = self._read_entry(path)
+                if header.get("kind") != "exec" or payload is None:
+                    self._absorb_costs(header)
+                    out["costs_only"] += 1
+                    continue
+                key = self._key_of(header)
+                if key is None:
+                    # non-literal key: not warmable by name, but still
+                    # lazily loadable at get() time (digest from live key)
+                    out["skipped"] += 1
+                    continue
+                fn = _deserialize_executable(payload)
+                if cache.preload(key, fn, label=header.get("label"),
+                                 shape=header.get("shape"),
+                                 cost=header.get("cost")):
+                    out["warmed"] += 1
+                else:
+                    out["skipped"] += 1
+                self._absorb_costs(header)
+            except Exception as e:  # noqa: BLE001 — warm must not fail start
+                _LOG.warning("skipping persisted entry %s: %s", name, e)
+                with self._lock:
+                    self.load_errors += 1
+                out["errors"] += 1
+        return out
+
+    @staticmethod
+    def _key_of(header: Dict[str, Any]) -> Optional[Any]:
+        """Reconstruct the in-process cache key from its stored canonical
+        repr. Only literal keys (tuples/strings/numbers — what fusion
+        builds) round-trip; anything else returns None."""
+        try:
+            key = ast.literal_eval(header.get("key_repr") or "")
+        except (ValueError, SyntaxError):
+            return None
+        return key
+
+    def _absorb_costs(self, header: Dict[str, Any]) -> None:
+        """Fold one entry's cost record / knobs into the warm-time side
+        channels the cost model and tuner consume."""
+        label, shape = header.get("label"), header.get("shape")
+        cost = header.get("cost")
+        with self._lock:
+            if label and shape and isinstance(cost, dict):
+                self._cost_records.setdefault(
+                    str(label), {})[str(shape)] = dict(cost)
+            knobs = header.get("knobs")
+            if isinstance(knobs, dict) and knobs:
+                self.loaded_knobs = dict(knobs)
+
+    def harvested_costs(self) -> Dict[str, Dict[str, Dict[str, Any]]]:
+        """{label: {shape: cost record}} recovered from persisted entries
+        — the ``SegmentCostModel.ingest_costs`` shape, so a fresh pod's
+        cost model starts calibrated from the fleet's measurements."""
+        with self._lock:
+            return {lab: {shp: dict(rec) for shp, rec in by.items()}
+                    for lab, by in self._cost_records.items()}
+
+    # -- introspection ------------------------------------------------------
+
+    def entry_count(self) -> int:
+        try:
+            return sum(1 for n in os.listdir(self.path)
+                       if n.endswith(SUFFIX))
+        except OSError:
+            return 0
+
+    def stats(self) -> Dict[str, Any]:
+        entries = self.entry_count()  # listdir outside the counter lock
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "path": self.path,
+                "write": self.write,
+                "entries": entries,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else None,
+                "stores": self.stores,
+                "store_skips": self.store_skips,
+                "costs_only": self.costs_only,
+                "load_errors": self.load_errors,
+                "store_errors": self.store_errors,
+                "load_s": round(self.load_s, 6),
+                "store_s": round(self.store_s, 6),
+                "env": dict(self._fp),
+            }

@@ -1,0 +1,404 @@
+"""Autoscale controller: one coordinated loop over burn rate and plan.
+
+The ROADMAP's complaint was that every layer reacts on its own — brownout
+degrades, the tuner retunes, and nothing SCALES. This controller is the
+coordination point:
+
+  fast path   the BrownoutController (serving/supervisor.py) keeps
+              degrading in-place the moment the short burn window fires —
+              this controller never blocks or duplicates it, it only
+              OBSERVES brownout state (scaling decisions freeze while a
+              brownout is active: capacity math measured during
+              degradation is polluted).
+  slow path   multi-window burn rates + the arrival forecast feed the
+              CapacityPlanner; the plan's in-process knobs (inflight,
+              mega_k) apply LIVE through hooks onto the executor / fused
+              model, and the cross-pod knob (replicas) publishes as a
+              recommendation at ``/_mmlspark/capacity`` for helm HPA /
+              an external scaler — this process cannot start pods.
+
+State machine (docs/fleet.md "Controller state machine")::
+
+    steady --plan wants more, N_out consecutive--> scale_out --apply-->
+        watch --regression--> rollback --> cooldown --> steady
+              --clean-------> steady
+    steady --plan wants less, N_in consecutive + hold--> scale_in (same
+        watch/rollback path; scale-in is deliberately slower than
+        scale-out: under-capacity burns SLO, over-capacity burns money)
+    any    --brownout active--> degraded (observe only) --> steady
+
+Apply semantics mirror the Tuner (core/tune.py): every apply journals
+{before, after, plan}, keeps exactly one ``_prev`` snapshot, and a
+measured e2e regression beyond ``regress_pct`` during the watch window
+rolls back one step and enters a veto cooldown.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .planner import CapacityPlanner, PlannerConfig, forecast_rps
+
+
+class FleetSpec:
+    """Controller knobs (coerced from the ``fleet=`` dict by
+    ``make_fleet``). Defaults are deliberately conservative: plan every
+    5s, two agreeing plans to scale out, five + a hold to scale in."""
+
+    def __init__(self, tick_s: float = 1.0, plan_every_s: float = 5.0,
+                 consecutive_out: int = 2, consecutive_in: int = 5,
+                 hold_s: float = 30.0, regress_pct: float = 0.15,
+                 watch_batches: int = 20, cooldown_s: float = 30.0,
+                 forecast_horizon_s: float = 60.0,
+                 journal_cap: int = 256):
+        self.tick_s = float(tick_s)
+        self.plan_every_s = float(plan_every_s)
+        self.consecutive_out = max(1, int(consecutive_out))
+        self.consecutive_in = max(1, int(consecutive_in))
+        self.hold_s = float(hold_s)
+        self.regress_pct = float(regress_pct)
+        self.watch_batches = max(1, int(watch_batches))
+        self.cooldown_s = float(cooldown_s)
+        self.forecast_horizon_s = float(forecast_horizon_s)
+        self.journal_cap = int(journal_cap)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+class FleetController:
+    """The coordinated autoscale loop. ``tick(e2e_s)`` is the per-batch
+    heartbeat (rate-limited internally, cheap no-op on the hot path);
+    ``summary()`` is the ``/_mmlspark/capacity`` payload.
+
+    ``hooks`` late-bind the live layers (set in server.start()):
+      - ``live_config()``      -> {replicas, inflight, mega_k, bucket}
+      - ``set_inflight(n)``    pipelined executor depth, applied live
+      - ``set_mega_k(k)``      fused model's K-step dispatch factor
+      - ``arrival_buckets()``  SLOTracker per-second (sec, total, bad)
+                               triples feeding the forecast
+
+    Lock contract: controller state under ``_lock``; hooks ALWAYS run
+    outside it (they take executor/model locks of their own — the same
+    C002 hygiene the brownout steps follow)."""
+
+    def __init__(self, planner: CapacityPlanner,
+                 spec: Optional[FleetSpec] = None,
+                 slo: Any = None, brownout: Any = None,
+                 hooks: Optional[Dict[str, Callable]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.planner = planner
+        self.spec = spec if spec is not None else FleetSpec()
+        self.slo = slo
+        self.brownout = brownout
+        self.hooks: Dict[str, Callable] = dict(hooks or {})
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = "steady"
+        self._last_tick = 0.0
+        self._last_plan = 0.0
+        self._last_apply = 0.0
+        self._cooldown_until = 0.0
+        self._agree_out = 0
+        self._agree_in = 0
+        self._e2e_ewma: Optional[float] = None
+        # regression watch (Tuner idiom): baseline EWMA at apply time,
+        # batches seen since; one _prev snapshot = one-step rollback
+        self._watch: Optional[Dict[str, Any]] = None
+        self._prev: Optional[Dict[str, Any]] = None
+        self._last_forecast: Dict[str, float] = {
+            "level_rps": 0.0, "trend_rps_s": 0.0, "forecast_rps": 0.0,
+            "seconds": 0}
+        self._recommended: Optional[Dict[str, Any]] = None
+        self.decisions = {"scale_out": 0, "scale_in": 0, "rollback": 0,
+                          "held_degraded": 0}
+        self.journal: List[Dict[str, Any]] = []
+
+    # -- journal ------------------------------------------------------------
+
+    def _log_locked(self, action: str, **fields: Any) -> None:
+        entry = {"action": action, "t": round(self._clock(), 3),
+                 "state": self.state}
+        entry.update(fields)
+        self.journal.append(entry)
+        if len(self.journal) > self.spec.journal_cap:
+            del self.journal[: self.spec.journal_cap // 4]
+
+    # -- live-layer access (hooks, outside the lock) ------------------------
+
+    def _live_config(self) -> Dict[str, Any]:
+        fn = self.hooks.get("live_config")
+        if fn is None:
+            return {}
+        try:
+            return dict(fn() or {})
+        except Exception:  # noqa: BLE001 — a broken hook reads as unknown
+            return {}
+
+    def _apply_knobs(self, inflight: Optional[int],
+                     mega_k: Optional[int]) -> Dict[str, Any]:
+        """Run the in-process apply hooks; returns what actually applied
+        (a hook that is missing or raises simply doesn't apply — the
+        journal records the delta honestly)."""
+        applied: Dict[str, Any] = {}
+        if inflight is not None:
+            fn = self.hooks.get("set_inflight")
+            if fn is not None:
+                try:
+                    fn(int(inflight))
+                    applied["inflight"] = int(inflight)
+                except Exception:  # noqa: BLE001 — apply is best-effort
+                    pass
+        if mega_k is not None:
+            fn = self.hooks.get("set_mega_k")
+            if fn is not None:
+                try:
+                    fn(int(mega_k))
+                    applied["mega_k"] = int(mega_k)
+                except Exception:  # noqa: BLE001 — apply is best-effort
+                    pass
+        return applied
+
+    def _forecast(self) -> Dict[str, float]:
+        fn = self.hooks.get("arrival_buckets")
+        buckets: List = []
+        now = None
+        if fn is not None:
+            try:
+                raw = fn() or []
+                if isinstance(raw, dict):
+                    # SLOTracker.arrival_buckets form: the tracker's own
+                    # clock rides along (its buckets are monotonic-stamped,
+                    # so wall-time "now" would misdate every second)
+                    now = raw.get("now")
+                    buckets = list(raw.get("buckets") or [])
+                else:
+                    buckets = list(raw)
+            except Exception:  # noqa: BLE001 — no buckets = zero forecast
+                buckets = []
+        return forecast_rps(buckets, now=now,
+                            horizon_s=self.spec.forecast_horizon_s)
+
+    def _brownout_active(self) -> bool:
+        b = self.brownout
+        if b is None:
+            return False
+        try:
+            return bool(getattr(b, "step", 0))
+        except Exception:  # noqa: BLE001 — unreadable = assume inactive
+            return False
+
+    # -- the loop -----------------------------------------------------------
+
+    def tick(self, e2e_s: Optional[float] = None) -> Optional[str]:
+        """One heartbeat (called per served batch alongside the tuner
+        tick). Returns the action taken ("scale_out"/"scale_in"/
+        "rollback") or None. Never raises."""
+        try:
+            return self._tick(e2e_s)
+        except Exception:  # noqa: BLE001 — fleet must never kill serving
+            return None
+
+    def _tick(self, e2e_s: Optional[float]) -> Optional[str]:
+        now = self._clock()
+        with self._lock:
+            if e2e_s is not None:
+                self._e2e_ewma = float(e2e_s) if self._e2e_ewma is None \
+                    else 0.8 * self._e2e_ewma + 0.2 * float(e2e_s)
+                if self._watch is not None:
+                    self._watch["batches"] += 1
+            if now - self._last_tick < self.spec.tick_s:
+                return None
+            self._last_tick = now
+            watch = dict(self._watch) if self._watch is not None else None
+            ewma = self._e2e_ewma
+        # regression watch resolves before anything else: a bad apply
+        # must unwind even while degraded or cooling down
+        if watch is not None and watch["batches"] >= \
+                self.spec.watch_batches and ewma is not None:
+            base = watch["baseline_e2e"]
+            if base and ewma > base * (1.0 + self.spec.regress_pct):
+                return self._rollback(ewma, base)
+            with self._lock:
+                if self._watch is not None:
+                    self._log_locked("watch_clear",
+                                     baseline_s=round(base or 0.0, 6),
+                                     e2e_s=round(ewma, 6))
+                    self._watch = None
+                    self.state = "steady"
+        if self._brownout_active():
+            # fast path owns the situation: hold every scaling decision,
+            # count the held tick once per plan interval for visibility
+            with self._lock:
+                if now - self._last_plan >= self.spec.plan_every_s:
+                    self._last_plan = now
+                    self.state = "degraded"
+                    self.decisions["held_degraded"] += 1
+                    self._log_locked("held_degraded")
+                self._agree_out = self._agree_in = 0
+            return None
+        with self._lock:
+            if now < self._cooldown_until:
+                return None
+            if self.state == "degraded":
+                self.state = "steady"
+            if now - self._last_plan < self.spec.plan_every_s:
+                return None
+            self._last_plan = now
+        return self._plan_and_maybe_apply(now)
+
+    def _plan_and_maybe_apply(self, now: float) -> Optional[str]:
+        forecast = self._forecast()
+        live = self._live_config()
+        plan = self.planner.plan(forecast["forecast_rps"],
+                                 live_replicas=live.get("replicas"))
+        rec = plan.to_dict()
+        with self._lock:
+            self._last_forecast = forecast
+            self._recommended = rec
+        if plan.meets_slo is None:
+            # uncalibrated: recommendation published, nothing applied
+            with self._lock:
+                self._agree_out = self._agree_in = 0
+            return None
+        live_replicas = int(live.get("replicas") or 1)
+        direction = None
+        if plan.replicas > live_replicas:
+            direction = "scale_out"
+        elif plan.replicas < live_replicas:
+            direction = "scale_in"
+        elif plan.inflight != live.get("inflight") \
+                or plan.mega_k != live.get("mega_k"):
+            # same replica count, different in-process knobs: treat as
+            # the (cheap) out direction so it applies on the fast quorum
+            direction = "scale_out"
+        with self._lock:
+            if direction == "scale_out":
+                self._agree_out += 1
+                self._agree_in = 0
+                ready = self._agree_out >= self.spec.consecutive_out
+            elif direction == "scale_in":
+                self._agree_in += 1
+                self._agree_out = 0
+                ready = self._agree_in >= self.spec.consecutive_in \
+                    and now - self._last_apply >= self.spec.hold_s
+            else:
+                self._agree_out = self._agree_in = 0
+                return None
+            if not ready or self._watch is not None:
+                return None
+        return self._apply(direction, plan, live, now)
+
+    def _apply(self, direction: str, plan, live: Dict[str, Any],
+               now: float) -> str:
+        applied = self._apply_knobs(plan.inflight, plan.mega_k)
+        with self._lock:
+            self._prev = {"live": dict(live), "applied_keys": list(applied)}
+            self._watch = {"baseline_e2e": self._e2e_ewma, "batches": 0,
+                           "direction": direction}
+            self._last_apply = now
+            self._agree_out = self._agree_in = 0
+            self.state = direction
+            self.decisions[direction] += 1
+            self._log_locked("apply", direction=direction,
+                             plan=plan.to_dict(), live=dict(live),
+                             applied=applied)
+        return direction
+
+    def _rollback(self, ewma: float, base: float) -> str:
+        """One-step rollback of the most recent apply (Tuner semantics):
+        restore the snapshotted in-process knobs, veto further scaling
+        for ``cooldown_s``."""
+        with self._lock:
+            prev = self._prev
+            self._prev = None
+            self._watch = None
+            self.state = "cooldown"
+            self._cooldown_until = self._clock() + self.spec.cooldown_s
+            # agreement restarts from zero: plans counted while the bad
+            # apply was live must not fast-track the next apply the
+            # moment the cooldown expires
+            self._agree_out = self._agree_in = 0
+            self.decisions["rollback"] += 1
+            self._log_locked("rollback",
+                             baseline_s=round(base, 6),
+                             e2e_s=round(ewma, 6),
+                             restored=dict(prev["live"]) if prev else None)
+        if prev is not None:
+            live = prev["live"]
+            self._apply_knobs(live.get("inflight"), live.get("mega_k"))
+        return "rollback"
+
+    def rollback(self) -> bool:
+        """Manual one-step rollback (ops hatch, Tuner parity). False when
+        there is nothing to roll back."""
+        with self._lock:
+            has_prev = self._prev is not None
+        if not has_prev:
+            return False
+        with self._lock:
+            ewma = self._e2e_ewma or 0.0
+        self._rollback(ewma, ewma)
+        return True
+
+    # -- the /_mmlspark/capacity payload ------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        live = self._live_config()
+        brown = None
+        if self.brownout is not None:
+            try:
+                brown = {"active": self._brownout_active(),
+                         "step": int(getattr(self.brownout, "step", 0))}
+            except Exception:  # noqa: BLE001 — summary must not raise
+                brown = {"active": False, "step": 0}
+        with self._lock:
+            rec = dict(self._recommended) if self._recommended else None
+            return {
+                "state": self.state,
+                "forecast": dict(self._last_forecast),
+                "recommended": rec,
+                "recommended_replicas": rec["replicas"] if rec else None,
+                "live": live,
+                "brownout": brown,
+                "decisions": dict(self.decisions),
+                "spec": self.spec.to_dict(),
+                "planner": self.planner.summary(),
+                "journal": list(self.journal[-16:]),
+            }
+
+
+def make_fleet(spec: Any, *, predict_ms: Callable[[int], Optional[float]],
+               slo: Any = None, brownout: Any = None,
+               hooks: Optional[Dict[str, Callable]] = None,
+               planner_cfg: Optional[PlannerConfig] = None
+               ) -> Optional[FleetController]:
+    """Coerce a server's ``fleet`` knob (the make_brownout idiom):
+    None/False -> off, True -> defaults, dict -> configured
+    (FleetSpec kwargs + optional ``planner`` sub-dict = PlannerConfig
+    kwargs), FleetController -> as-is."""
+    if spec is None or spec is False:
+        return None
+    if isinstance(spec, FleetController):
+        return spec
+    if spec is True:
+        fspec = FleetSpec()
+    elif isinstance(spec, FleetSpec):
+        fspec = spec
+    elif isinstance(spec, dict):
+        kw = dict(spec)
+        kw.pop("cache_path", None)  # consumed by serve_pipeline
+        kw.pop("cache_write", None)
+        pcfg = kw.pop("planner", None)
+        if pcfg is not None and planner_cfg is None:
+            planner_cfg = PlannerConfig(**pcfg)
+        fspec = FleetSpec(**kw)
+    else:
+        raise ValueError(
+            f"fleet must be None/bool/dict/FleetSpec/FleetController, "
+            f"got {spec!r}")
+    planner = CapacityPlanner(predict_ms, planner_cfg)
+    return FleetController(planner, spec=fspec, slo=slo,
+                           brownout=brownout, hooks=hooks)

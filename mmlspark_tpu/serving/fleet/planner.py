@@ -1,0 +1,276 @@
+"""Capacity planner: invert the calibrated cost model.
+
+PR 7 built the SLO burn-rate gauges ("the HPA signal") and PR 9 built the
+SegmentCostModel that predicts per-batch compute from batch size. This
+module closes the loop the ROADMAP asks for: instead of reacting to CPU
+pressure, PLAN capacity — given an arrival-rate forecast, emit the
+(replicas, inflight, bucket, mega_k) configuration that meets the latency
+objective at minimum capacity ("A Learned Performance Model for TPUs",
+PAPERS.md, used in reverse).
+
+The math (docs/fleet.md "Planner math"):
+
+  service_ms(B)   cost model's predicted per-batch wall for bucket B
+  mu(B)           per-replica service rate = B / service_ms(B) rows/ms
+  demand          forecast rows/s x ``headroom`` safety factor
+  rho             utilization = demand / (R x mu) — capped at
+                  ``utilization_cap`` so queueing delay stays bounded
+  latency(B, R)   wait + service x (1 + rho / (1 - rho)); wait is the
+                  adaptive window's steady state (~alpha x service); the
+                  M/M/1-flavored inflation term is deliberately
+                  pessimistic (real batching smooths arrivals)
+
+Feasible = rho <= cap AND latency <= objective. Among feasible configs
+the planner minimizes replicas first (capacity is the expensive axis),
+then maximizes bucket (bigger batches amortize dispatch better at equal
+replica count). ``inflight`` deepens with utilization (pipeline overlap
+only pays when there is queue to hide) and ``mega_k`` engages when the
+per-replica dispatch rate crosses ``dispatch_floor_hz`` — the PR 11
+mega-dispatch criterion, applied predictively.
+
+Everything here is pure (inputs in, plan out, no live objects), so the
+sweep tests in tests/test_fleet.py can prove "emitted config meets the
+SLO" across a simulated arrival sweep without a server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class PlannerConfig:
+    """Planning envelope. ``objective_ms``/``target`` mirror the serving
+    SLO (obs/perf.py SLOConfig); the rest bound the search space."""
+
+    objective_ms: float = 250.0
+    target: float = 0.99
+    utilization_cap: float = 0.7
+    headroom: float = 1.15
+    min_replicas: int = 1
+    max_replicas: int = 64
+    max_inflight: int = 8
+    bucket_candidates: Tuple[int, ...] = (8, 16, 32, 64, 128, 256)
+    mega_k_candidates: Tuple[int, ...] = (1, 2, 4)
+    #: per-replica dispatches/s above which K-step mega-dispatch engages
+    dispatch_floor_hz: float = 150.0
+    #: adaptive window steady state as a fraction of service time
+    window_alpha: float = 0.5
+
+    def __post_init__(self):
+        if self.objective_ms <= 0:
+            raise ValueError("objective_ms must be positive")
+        if not 0.0 < self.utilization_cap < 1.0:
+            raise ValueError(
+                f"utilization_cap must be in (0,1), got "
+                f"{self.utilization_cap}")
+        if self.headroom < 1.0:
+            raise ValueError("headroom must be >= 1.0")
+        if self.min_replicas < 1 or self.max_replicas < self.min_replicas:
+            raise ValueError("bad replica bounds")
+
+
+@dataclasses.dataclass(frozen=True)
+class CapacityPlan:
+    """One planning decision — the knob vector plus the evidence for it."""
+
+    replicas: int
+    inflight: int
+    bucket: int
+    mega_k: int
+    demand_rps: float
+    service_ms: Optional[float]
+    wait_ms: Optional[float]
+    predicted_latency_ms: Optional[float]
+    utilization: Optional[float]
+    capacity_rps: Optional[float]
+    meets_slo: Optional[bool]
+    reason: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        for k, v in d.items():
+            if isinstance(v, float):
+                d[k] = round(v, 4)
+        return d
+
+
+def forecast_rps(buckets: Iterable, now: Optional[float] = None,
+                 alpha: float = 0.35, trend_alpha: float = 0.15,
+                 horizon_s: float = 60.0,
+                 max_history_s: int = 600) -> Dict[str, float]:
+    """EWMA level + short-horizon trend (Holt's linear method) over the
+    SLOTracker's per-second ``(second, total, breaches)`` buckets.
+
+    Seconds with no bucket are zero-traffic seconds and count as 0 — an
+    idle gap must pull the forecast DOWN, not freeze it. The current
+    (partial) second is excluded. Returns level, trend, and the
+    ``horizon_s``-ahead forecast (floored at 0)."""
+    pts: Dict[int, float] = {}
+    for rec in buckets:
+        try:
+            sec, total = int(rec[0]), float(rec[1])
+        except (TypeError, ValueError, IndexError):
+            continue
+        pts[sec] = pts.get(sec, 0.0) + total
+    if not pts:
+        return {"level_rps": 0.0, "trend_rps_s": 0.0,
+                "forecast_rps": 0.0, "seconds": 0}
+    now_s = int(now if now is not None else time.time())
+    first = max(min(pts), now_s - int(max_history_s))
+    last = max(max(pts), now_s - 1)
+    level: Optional[float] = None
+    trend = 0.0
+    n = 0
+    for sec in range(first, last + 1):
+        if sec >= now_s:  # current second is partially filled — skip
+            continue
+        x = pts.get(sec, 0.0)
+        n += 1
+        if level is None:
+            level = x
+            continue
+        prev = level
+        level = alpha * x + (1.0 - alpha) * (level + trend)
+        trend = trend_alpha * (level - prev) + (1.0 - trend_alpha) * trend
+    level = level if level is not None else 0.0
+    return {"level_rps": round(level, 4),
+            "trend_rps_s": round(trend, 6),
+            "forecast_rps": round(max(0.0, level + trend * horizon_s), 4),
+            "seconds": n}
+
+
+def _latency_ms(service_ms: float, rho: float, cfg: PlannerConfig
+                ) -> Tuple[float, float]:
+    """(wait_ms, predicted latency) for one config: adaptive-window wait
+    plus service inflated by the queueing factor rho/(1-rho)."""
+    wait = cfg.window_alpha * service_ms
+    queue_factor = rho / max(1e-9, 1.0 - rho) if rho < 1.0 else math.inf
+    return wait, wait + service_ms * (1.0 + queue_factor)
+
+
+def plan_capacity(demand_rps: float,
+                  predict_ms: Callable[[int], Optional[float]],
+                  cfg: Optional[PlannerConfig] = None,
+                  live_replicas: Optional[int] = None) -> CapacityPlan:
+    """The pure planning function: forecast demand (rows/s) + the cost
+    model's ``predict_ms(bucket)`` in, minimum-capacity SLO-meeting plan
+    out.
+
+    An uncalibrated model (``predict_ms`` returns None for every bucket)
+    yields a hold-steady plan (``meets_slo=None``) — the planner NEVER
+    invents capacity numbers it has no evidence for, mirroring the
+    Tuner's "uncalibrated changes nothing" contract."""
+    cfg = cfg if cfg is not None else PlannerConfig()
+    demand = max(0.0, float(demand_rps)) * cfg.headroom
+
+    def rank(p: CapacityPlan) -> Tuple:
+        # preference order: feasible beats infeasible; then fewer
+        # replicas (capacity is the expensive axis); then bigger bucket
+        # (dispatch amortization); then lower predicted latency
+        return (0 if p.meets_slo else 1, p.replicas, -p.bucket,
+                p.predicted_latency_ms
+                if p.predicted_latency_ms is not None else math.inf)
+
+    best: Optional[CapacityPlan] = None
+    calibrated = False
+    for bucket in sorted(set(int(b) for b in cfg.bucket_candidates)):
+        if bucket <= 0:
+            continue
+        try:
+            service_ms = predict_ms(bucket)
+        except Exception:  # noqa: BLE001 — a model error is "no estimate"
+            service_ms = None
+        if service_ms is None or service_ms <= 0:
+            continue
+        calibrated = True
+        mu_rps = bucket * 1000.0 / service_ms  # rows/s per replica
+        if demand <= 0:
+            replicas = cfg.min_replicas
+        else:
+            replicas = max(cfg.min_replicas, int(math.ceil(
+                demand / (mu_rps * cfg.utilization_cap))))
+        if replicas > cfg.max_replicas:
+            # even the full fleet can't meet the cap with this bucket:
+            # record the saturated plan as a candidate of last resort
+            replicas = cfg.max_replicas
+        rho = demand / (replicas * mu_rps) if demand > 0 else 0.0
+        wait, latency = _latency_ms(service_ms, min(rho, 0.999), cfg)
+        feasible = rho <= cfg.utilization_cap \
+            and latency <= cfg.objective_ms
+        # inflight: overlap only pays once there is queue to hide; deepen
+        # with utilization, bounded by the envelope
+        inflight = 1 if rho < 0.25 else (2 if rho < 0.6 else 3)
+        inflight = min(cfg.max_inflight, inflight)
+        # mega_k: per-replica dispatch rate (batches/s) above the floor
+        # means fixed dispatch cost dominates -> amortize K-fold
+        dispatch_hz = demand / (replicas * bucket) if demand > 0 else 0.0
+        mega_k = 1
+        for k in sorted(set(int(k) for k in cfg.mega_k_candidates)):
+            if k >= 1 and dispatch_hz / k > cfg.dispatch_floor_hz:
+                continue
+            if k >= 1:
+                mega_k = k
+                break
+        cand = CapacityPlan(
+            replicas=replicas, inflight=inflight, bucket=bucket,
+            mega_k=mega_k, demand_rps=round(demand, 4),
+            service_ms=round(service_ms, 4), wait_ms=round(wait, 4),
+            predicted_latency_ms=round(latency, 4)
+            if math.isfinite(latency) else None,
+            utilization=round(rho, 4),
+            capacity_rps=round(replicas * mu_rps, 2),
+            meets_slo=feasible,
+            reason="planned")
+        if best is None or rank(cand) < rank(best):
+            best = cand
+    if not calibrated or best is None:
+        hold = max(cfg.min_replicas, int(live_replicas or cfg.min_replicas))
+        return CapacityPlan(
+            replicas=hold, inflight=2, bucket=0, mega_k=1,
+            demand_rps=round(demand, 4), service_ms=None, wait_ms=None,
+            predicted_latency_ms=None, utilization=None,
+            capacity_rps=None, meets_slo=None, reason="uncalibrated")
+    return best
+
+
+class CapacityPlanner:
+    """Journaled wrapper: every ``plan()`` call appends (demand, plan) to
+    a bounded decision journal, so ``/_mmlspark/capacity`` and the perf
+    report can show WHY the current recommendation is what it is."""
+
+    def __init__(self, predict_ms: Callable[[int], Optional[float]],
+                 cfg: Optional[PlannerConfig] = None,
+                 journal_cap: int = 256):
+        self.cfg = cfg if cfg is not None else PlannerConfig()
+        self._predict_ms = predict_ms
+        self._lock = threading.Lock()
+        self._journal: "deque[Dict[str, Any]]" = deque(maxlen=journal_cap)
+        self.plans_total = 0
+
+    def plan(self, demand_rps: float,
+             live_replicas: Optional[int] = None) -> CapacityPlan:
+        p = plan_capacity(demand_rps, self._predict_ms, self.cfg,
+                          live_replicas=live_replicas)
+        with self._lock:
+            self.plans_total += 1
+            self._journal.append({"t": round(time.time(), 3),
+                                  "demand_rps": round(demand_rps, 4),
+                                  "plan": p.to_dict()})
+        return p
+
+    def journal(self, last: int = 20) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._journal)[-int(last):]
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            latest = self._journal[-1] if self._journal else None
+            return {"plans_total": self.plans_total,
+                    "config": dataclasses.asdict(self.cfg),
+                    "latest": dict(latest) if latest else None}

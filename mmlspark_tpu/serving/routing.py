@@ -81,6 +81,10 @@ class RoutingFront:
     #: buffered spans as JSON (worker parity: cross-hop exemplar lookups
     #: resolve from the front too, not just the worker that served them)
     TRACE_PATH = "/_mmlspark/trace"
+    #: fleet capacity aggregation: polls every routable worker's
+    #: /_mmlspark/capacity and sums the recommendations — the single
+    #: endpoint a helm HPA / external scaler keys on
+    CAPACITY_PATH = "/_mmlspark/capacity"
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  forward_timeout_s: float = 70.0, max_failures: int = 3,
@@ -469,7 +473,66 @@ class RoutingFront:
             return (200, "application/json", json.dumps(
                 {"stats": self.tracer.stats(),
                  "spans": self.tracer.spans()}).encode("utf-8"))
+        if path == RoutingFront.CAPACITY_PATH:
+            return (200, "application/json",
+                    json.dumps(self._collect_capacity()).encode("utf-8"))
         return None
+
+    def _collect_capacity(self) -> Dict[str, Any]:
+        """Aggregate the workers' fleet recommendations on demand. Each
+        worker plans for ITS OWN arrival share, so the fleet-wide
+        recommendation is the SUM across responders (a balanced front
+        splits traffic, so per-worker demand is total/W). Workers with
+        fleet disabled (404) are counted but contribute nothing. Fetches
+        fan out on short-lived threads bounded by ``probe_timeout_s`` —
+        this also runs on the async transport's loop thread, so the stall
+        must stay bounded."""
+        addrs = list(self.workers)
+        results: Dict[str, Any] = {}
+
+        def fetch(addr: str) -> None:
+            parts = urlsplit(addr)
+            url = f"{parts.scheme}://{parts.netloc}{self.CAPACITY_PATH}"
+            try:
+                with urlopen(Request(url, method="GET"),
+                             timeout=self.probe_timeout_s) as resp:
+                    results[addr] = json.loads(resp.read().decode("utf-8"))
+            except HTTPError as e:
+                results[addr] = {"disabled": True} if e.code == 404 \
+                    else {"error": f"http {e.code}"}
+            except Exception as e:  # noqa: BLE001 — a dead worker is data
+                results[addr] = {"error": str(e)}
+
+        threads = [threading.Thread(target=fetch, args=(a,), daemon=True)
+                   for a in addrs]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.probe_timeout_s + 0.5
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        total_rec = 0
+        contributed = 0
+        total_forecast = 0.0
+        responding = 0
+        for addr in addrs:
+            r = results.get(addr)
+            if not isinstance(r, dict) or "state" not in r:
+                continue
+            responding += 1
+            rec = r.get("recommended_replicas")
+            if rec is not None:
+                total_rec += int(rec)
+                contributed += 1
+            fc = (r.get("forecast") or {}).get("forecast_rps")
+            if fc is not None:
+                total_forecast += float(fc)
+        return {"workers": len(addrs), "responding": responding,
+                # null (not 0) when no worker has published a plan yet —
+                # an HPA must never read "scale to zero" out of cold start
+                "recommended_replicas": total_rec if contributed else None,
+                "forecast_rps": round(total_forecast, 4),
+                "per_worker": {a: results.get(a, {"error": "no reply"})
+                               for a in addrs}}
 
     def _make_handler(self):
         front = self

@@ -266,6 +266,9 @@ class ServingServer:
     HEALTH_PATH = "/_mmlspark/healthz"
     #: buffered spans as JSON (debug surface; exporters write JSONL/Perfetto)
     TRACE_PATH = "/_mmlspark/trace"
+    #: fleet controller's capacity recommendation (serving/fleet): the
+    #: cross-pod scaling signal an external scaler / helm HPA consumes
+    CAPACITY_PATH = "/_mmlspark/capacity"
 
     def __init__(self, transform: Callable[[DataFrame], DataFrame],
                  host: str = "127.0.0.1", port: int = 8898,
@@ -294,7 +297,8 @@ class ServingServer:
                  watchdog_k: float = 8.0,
                  watchdog_min_budget_s: float = 1.0,
                  probe_fn: Optional[Callable] = None,
-                 brownout=None, brownout_hooks=None):
+                 brownout=None, brownout_hooks=None,
+                 fleet=None, fleet_hooks=None):
         self.transform = transform
         # optional provider of the device-ingest decomposition (queue/h2d/
         # compute/readback — parallel/ingest.IngestStats.summary) merged into
@@ -371,6 +375,14 @@ class ServingServer:
         # demotion for optional segments
         self._brownout_hooks = dict(brownout_hooks or {})
         self._brownout = None
+        # fleet control plane (serving/fleet): persistent-cache-aware
+        # capacity planner + autoscale controller. None/False = off (the
+        # default — fleet=False stays bitwise-identical). Built in start()
+        # so the hooks can capture the live executor/SLO tracker; extra
+        # hooks (set_mega_k, predict_ms) arrive from serve_pipeline.
+        self._fleet_spec = fleet
+        self._fleet_hooks = dict(fleet_hooks or {})
+        self._fleet = None
         self._executor = None
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         # wake latch: set on every enqueue and on stop(), so the batcher's
@@ -510,6 +522,11 @@ class ServingServer:
                 summary["slo"] = self._slo.summary()
             if self._brownout is not None:
                 summary["brownout"] = self._brownout.summary()
+            if self._fleet is not None:
+                try:
+                    summary["fleet"] = self._fleet.summary()
+                except Exception as e:  # noqa: BLE001
+                    summary["fleet"] = {"error": str(e)}
             if self._lat_hist is not None:
                 # bucket counts + trace-id exemplars, ALWAYS here (the
                 # exposition carries them only behind metrics_exemplars)
@@ -539,6 +556,18 @@ class ServingServer:
             return (200, "application/json", json.dumps(
                 {"stats": self.tracer.stats(),
                  "spans": self.tracer.spans()}).encode("utf-8"), None)
+        if path == ServingServer.CAPACITY_PATH:
+            # fleet capacity recommendation (serving/fleet): the external
+            # scaler / helm HPA polls this for recommended_replicas
+            if self._fleet is None:
+                return (404, "application/json",
+                        b'{"error": "fleet disabled"}', None)
+            try:
+                payload = json.dumps(self._fleet.summary()).encode("utf-8")
+            except Exception as e:  # noqa: BLE001
+                return (500, "application/json", json.dumps(
+                    {"error": str(e)}).encode("utf-8"), None)
+            return (200, "application/json", payload, None)
         if path != self.api_path:
             return (404, "application/json", b'{"error": "not found"}', None)
         return None
@@ -1014,6 +1043,28 @@ class ServingServer:
                 self._brownout.check()
             except Exception:  # noqa: BLE001 — brownout must never kill serving
                 pass
+        if self._fleet is not None:
+            try:
+                self._fleet.tick(e2e_s)
+            except Exception:  # noqa: BLE001 — scaling must never kill serving
+                pass
+
+    def _fleet_live_config(self) -> Dict[str, Any]:
+        """The fleet controller's view of the live knob vector (its
+        ``live_config`` hook): what is ACTUALLY running, against which a
+        plan's recommendation is diffed before any apply."""
+        cfg: Dict[str, Any] = {"replicas": self.capacity,
+                               "inflight": None, "mega_k": None}
+        ex = self._executor
+        if ex is not None:
+            cfg["inflight"] = int(ex.inflight)
+        mk = getattr(self.transform, "mega_k", None)
+        if mk is not None:
+            try:
+                cfg["mega_k"] = int(mk() or 1)
+            except Exception:  # noqa: BLE001 — unknown reads as None
+                cfg["mega_k"] = None
+        return cfg
 
     def _brownout_steps(self) -> list:
         """Declared degradation ladder, in escalation order. Each step is a
@@ -1260,6 +1311,27 @@ class ServingServer:
                 self._tuner.controller = self._controller
             if getattr(self._tuner, "executor", None) is None:
                 self._tuner.executor = self._executor
+        if self._fleet_spec:
+            from .fleet import make_fleet
+
+            hooks = dict(self._fleet_hooks)
+            predict = hooks.pop("predict_ms", None)
+            if predict is None and self._tuner is not None:
+                # the tuner's calibrated cost model doubles as the
+                # planner's service-time oracle
+                predict = getattr(self._tuner, "predict_batch_ms", None)
+            if predict is None:
+                def predict(_rows):
+                    return None  # uncalibrated: the planner holds steady
+            hooks.setdefault("live_config", self._fleet_live_config)
+            if self._executor is not None:
+                hooks.setdefault("set_inflight", self._executor.set_inflight)
+            if self._slo is not None:
+                hooks.setdefault("arrival_buckets",
+                                 self._slo.arrival_buckets)
+            self._fleet = make_fleet(
+                self._fleet_spec, predict_ms=predict, slo=self._slo,
+                brownout=self._brownout, hooks=hooks)
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -1374,7 +1446,7 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    metrics_exemplars: bool = False,
                    supervise: bool = True,
                    watchdog_budget_s: Optional[float] = None,
-                   brownout=None) -> ServingServer:
+                   brownout=None, fleet=False) -> ServingServer:
     """Serve a fitted Transformer: request body -> ``input_col`` -> stage ->
     ``reply_col`` (IOImplicits fluent sugar parity, io/IOImplicits.scala:182-213).
 
@@ -1430,6 +1502,17 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
     burn — shrink the batch window, demote optional fused segments to
     host, tighten admission — restored hysteretically; see
     docs/serving.md.
+
+    ``fleet`` (off by default — disabled serving stays bitwise-identical)
+    enables the fleet control plane (serving/fleet, docs/fleet.md):
+    ``True`` for defaults or a dict of FleetSpec kwargs, plus two
+    cache keys consumed here — ``cache_path`` mounts a persistent
+    compile-cache tier under the in-process CompileCache (fused pipelines:
+    serialized AOT executables shared across pods, warmed at start so a
+    fresh replica's first request pays zero jit compiles for
+    previously-seen signatures) and ``cache_write`` (default True) gates
+    the store path. The capacity planner + autoscale controller publish
+    at ``/_mmlspark/capacity`` and apply inflight/mega_k live.
     """
     from ..core.pipeline import PipelineModel
     from .stages import parse_request
@@ -1512,6 +1595,49 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
 
         brownout_hooks = {"demote_segments": (demote_apply, demote_revert)}
 
+    fleet_hooks = None
+    if fleet:
+        fleet_hooks = {}
+        cache_path = None
+        cache_write = True
+        if isinstance(fleet, dict):
+            cache_path = fleet.get("cache_path")
+            cache_write = bool(fleet.get("cache_write", True))
+        if cache_path and hasattr(stage, "attach_persistent_cache"):
+            from .fleet import PersistentCompileCache
+
+            def _knobs(_t=tuner):
+                # persisted alongside cost-only entries so a fresh pod can
+                # seed its knobs from the fleet's tuned state
+                if _t is not None:
+                    try:
+                        return _t.knobs.to_dict()
+                    except Exception:  # noqa: BLE001 — knobs best-effort
+                        return {}
+                return {}
+
+            tier = PersistentCompileCache(cache_path, write=cache_write,
+                                          knobs_provider=_knobs)
+            # attach + AOT-warm: deserialize previously-seen executables
+            # into the in-process cache BEFORE the first request arrives
+            stage.attach_persistent_cache(tier)
+        if hasattr(stage, "set_tuning"):
+            def _set_mega_k(k, _stage=stage):
+                # the controller's single K fans out to the heavy planned
+                # segments (mega-dispatch only pays where dispatch rate
+                # dominates — the PR 11 criterion)
+                nodes = getattr(_stage, "_last_plan", None) or []
+                labels = [n.label for n in nodes
+                          if getattr(n, "label", None) is not None
+                          and getattr(n, "heavy", False)]
+                if labels:
+                    _stage.set_tuning(
+                        mega_k={lab: int(k) for lab in labels})
+
+            fleet_hooks["set_mega_k"] = _set_mega_k
+        if tuner is not None:
+            fleet_hooks["predict_ms"] = tuner.predict_batch_ms
+
     return ServingServer(transform, host=host, port=port, api_path=api_path,
                          reply_col=reply_col, max_batch_size=max_batch_size,
                          max_wait_ms=max_wait_ms, token=token,
@@ -1531,4 +1657,5 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                          supervise=supervise,
                          watchdog_budget_s=watchdog_budget_s,
                          brownout=brownout,
-                         brownout_hooks=brownout_hooks)
+                         brownout_hooks=brownout_hooks,
+                         fleet=fleet, fleet_hooks=fleet_hooks)

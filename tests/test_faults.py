@@ -1775,3 +1775,136 @@ class TestDNNTrainLoop:
             assert guard.requested()
         # handler restored after exit
         assert S.getsignal(S.SIGUSR1) == prev
+
+
+class TestCompileCacheChaos:
+    """Persistent compile-cache degradation contract (serving/fleet/cache):
+    every load/store failure — injected or on-disk — is an accounted
+    counter and a recompile, never a crash or a blocked serving path."""
+
+    KEY = ("segF", (("col", (4,), "float32"),))
+
+    def _compiled(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones((4,), jnp.float32)
+        return jax.jit(lambda v: v * 3.0).lower(x).compile()
+
+    def _populated(self, tmp_path):
+        from mmlspark_tpu.core.device_stage import CompileCache
+        from mmlspark_tpu.serving.fleet import PersistentCompileCache
+
+        tier = PersistentCompileCache(str(tmp_path))
+        cache = CompileCache()
+        cache.attach_persistent(tier)
+        cache.get(self.KEY, self._compiled, label="segF", shape="b4")
+        assert tier.stats()["stores"] == 1
+        return tier
+
+    def test_load_fault_degrades_to_accounted_recompile(self, tmp_path):
+        pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.core.device_stage import CompileCache
+        from mmlspark_tpu.serving.fleet import PersistentCompileCache
+
+        self._populated(tmp_path)
+        cache = CompileCache()
+        tier = PersistentCompileCache(str(tmp_path))
+        cache.attach_persistent(tier)
+        built = []
+
+        def builder():
+            built.append(1)
+            return self._compiled()
+
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.COMPILECACHE_LOAD, every=1) as inj:
+            fn = cache.get(self.KEY, builder, label="segF", shape="b4")
+            assert len(inj.fired(faults.COMPILECACHE_LOAD)) == 1
+        # the populated entry was unreachable: serving recompiled and the
+        # failure is a counter, not an exception
+        assert built == [1]
+        assert tier.stats()["load_errors"] == 1
+        x = jnp.arange(4, dtype=jnp.float32)
+        assert np.allclose(np.asarray(fn(x)), np.asarray(x) * 3.0)
+        # honest memory-tier accounting: this WAS a compile
+        assert cache.stats()["misses"] == 1
+
+    def test_store_fault_never_blocks_serving(self, tmp_path):
+        pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.core.device_stage import CompileCache
+        from mmlspark_tpu.serving.fleet import PersistentCompileCache
+
+        tier = PersistentCompileCache(str(tmp_path))
+        cache = CompileCache()
+        cache.attach_persistent(tier)
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.COMPILECACHE_STORE, at=(1,)) as inj:
+            fn = cache.get(self.KEY, self._compiled,
+                           label="segF", shape="b4")
+            assert len(inj.fired(faults.COMPILECACHE_STORE)) == 1
+        x = jnp.arange(4, dtype=jnp.float32)
+        assert np.allclose(np.asarray(fn(x)), np.asarray(x) * 3.0)
+        s = tier.stats()
+        assert s["store_errors"] == 1 and s["stores"] == 0
+        assert tier.entry_count() == 0  # nothing half-written
+        # the in-process cache is intact: the next request is a memory hit
+        fn2 = cache.get(self.KEY, lambda: pytest.fail("must be resident"),
+                        label="segF", shape="b4")
+        assert fn2 is fn
+
+    def test_warm_fault_shrinks_but_never_fails_pod_start(self, tmp_path):
+        pytest.importorskip("jax")
+        from mmlspark_tpu.core.device_stage import CompileCache
+        from mmlspark_tpu.serving.fleet import PersistentCompileCache
+
+        self._populated(tmp_path)
+        tier = PersistentCompileCache(str(tmp_path))
+        cache = CompileCache()
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.COMPILECACHE_LOAD, every=1):
+            out = tier.warm(cache)
+        assert out["warmed"] == 0 and out["errors"] == 1
+        assert cache.stats()["entries"] == 0
+        # without injection the same directory warms fine
+        out2 = PersistentCompileCache(str(tmp_path)).warm(cache)
+        assert out2["warmed"] == 1
+
+    def test_on_disk_corruption_matrix(self, tmp_path):
+        """Truncated tail, foreign magic, garbage payload: each load
+        degrades to an accounted miss; the chaos seed picks the byte
+        ranges so the matrix varies across CI lanes."""
+        pytest.importorskip("jax")
+        from mmlspark_tpu.serving.fleet import PersistentCompileCache
+        from mmlspark_tpu.serving.fleet.cache import SUFFIX
+
+        rng = np.random.default_rng(CHAOS_SEED)
+        for mode in ("truncate", "magic", "garbage"):
+            sub = tmp_path / mode
+            sub.mkdir()
+            self._populated(sub)
+            (name,) = [n for n in os.listdir(sub) if n.endswith(SUFFIX)]
+            path = os.path.join(str(sub), name)
+            blob = open(path, "rb").read()
+            if mode == "truncate":
+                cut = int(rng.integers(1, len(blob)))
+                blob = blob[:cut]
+            elif mode == "magic":
+                blob = b"XXXXXX" + blob[6:]
+            else:
+                lo = int(rng.integers(0, max(1, len(blob) - 64)))
+                blob = blob[:lo] + bytes(rng.integers(
+                    0, 256, 64, dtype=np.uint8)) + blob[lo + 64:]
+            with open(path, "wb") as fh:
+                fh.write(blob)
+            tier = PersistentCompileCache(str(sub))
+            assert tier.load(self.KEY, label="segF", shape="b4") is None, \
+                mode
+            st = tier.stats()
+            # every outcome is accounted: either a parse failure or (for
+            # a garbage run that shredded the header length) a miss
+            assert st["load_errors"] + st["misses"] >= 1, mode

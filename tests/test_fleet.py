@@ -1,0 +1,591 @@
+"""Fleet control plane suite (serving/fleet, docs/fleet.md).
+
+Covers the three parts end to end: the persistent compile cache's
+round-trip / AOT warm / corruption degradation, the capacity planner's
+SLO-meeting sweep and uncalibrated hold, the autoscale controller's
+quorum + journal + one-step rollback, and the serving wiring
+(``/_mmlspark/capacity``, the stats section, front aggregation, and
+``fleet=False`` parity). The chaos-lane fault-injection cases live in
+tests/test_faults.py (TestCompileCacheChaos).
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from mmlspark_tpu.core.device_stage import CompileCache  # noqa: E402
+from mmlspark_tpu.serving.fleet import (  # noqa: E402
+    CapacityPlanner,
+    FleetController,
+    FleetSpec,
+    PersistentCompileCache,
+    PlannerConfig,
+    content_key,
+    forecast_rps,
+    make_fleet,
+    plan_capacity,
+)
+from mmlspark_tpu.serving.fleet import cache as fleet_cache  # noqa: E402
+
+
+def _compiled(mult=2.0, n=4):
+    """A tiny AOT-compiled executable (what fusion's builder returns)."""
+    x = jnp.ones((n,), jnp.float32)
+    return jax.jit(lambda v: v * mult).lower(x).compile()
+
+
+KEY = ("seg0", (("col", (4,), "float32"),))
+X = jnp.arange(4, dtype=jnp.float32)
+
+
+class TestPersistentCacheRoundTrip:
+    def test_cold_store_then_fresh_process_load(self, tmp_path):
+        """Process A compiles + stores; 'process B' (a fresh in-process
+        cache over the same directory) answers with ZERO compiles and a
+        bitwise-identical result."""
+        t1 = PersistentCompileCache(str(tmp_path))
+        c1 = CompileCache()
+        c1.attach_persistent(t1)
+        fn1 = c1.get(KEY, _compiled, label="seg0", shape="b4")
+        ref = np.asarray(fn1(X))
+        s1 = c1.stats()
+        assert s1["misses"] == 1 and s1["compile_time_s"] > 0
+        assert t1.stats()["stores"] == 1
+        assert t1.entry_count() == 1
+
+        t2 = PersistentCompileCache(str(tmp_path))
+        c2 = CompileCache()
+        c2.attach_persistent(t2)
+        built = []
+
+        def builder():
+            built.append(1)
+            return _compiled()
+
+        fn2 = c2.get(KEY, builder, label="seg0", shape="b4")
+        assert not built, "tier hit must not invoke the builder"
+        s2 = c2.stats()
+        # counter-verified zero compiles: the memory tier saw neither a
+        # miss nor a compile; the persistent tier accounts the hit
+        assert s2["misses"] == 0 and s2["compile_time_s"] == 0.0
+        assert t2.stats()["hits"] == 1
+        assert np.array_equal(np.asarray(fn2(X)), ref)
+
+    def test_warm_preloads_for_zero_compile_first_request(self, tmp_path):
+        t1 = PersistentCompileCache(str(tmp_path))
+        c1 = CompileCache()
+        c1.attach_persistent(t1)
+        ref = np.asarray(c1.get(KEY, _compiled, label="seg0",
+                                shape="b4")(X))
+
+        c2 = CompileCache()
+        t2 = PersistentCompileCache(str(tmp_path))
+        c2.attach_persistent(t2)
+        out = t2.warm(c2)
+        assert out["warmed"] == 1 and out["errors"] == 0
+        fn = c2.get(KEY, lambda: pytest.fail("must be resident"),
+                    label="seg0", shape="b4")
+        s = c2.stats()
+        assert s["hits"] == 1 and s["misses"] == 0
+        assert s["compile_time_s"] == 0.0
+        assert np.array_equal(np.asarray(fn(X)), ref)
+
+    def test_costs_only_fallback_warms_model_and_knobs(self, tmp_path):
+        t1 = PersistentCompileCache(
+            str(tmp_path), knobs_provider=lambda: {"inflight": 3})
+        # a plain lambda is not an AOT executable -> serialize fails ->
+        # the entry persists kind="costs" with the harvested record
+        assert t1.store(KEY, lambda v: v, cost={"compute_ms": 1.5},
+                        label="seg0", shape="b4")
+        assert t1.stats()["costs_only"] == 1
+
+        t2 = PersistentCompileCache(str(tmp_path))
+        assert t2.load(KEY, label="seg0", shape="b4") is None
+        assert t2.harvested_costs() == {
+            "seg0": {"b4": {"compute_ms": 1.5}}}
+        assert t2.loaded_knobs == {"inflight": 3}
+        # warm over cost-only entries touches the side channels only
+        c = CompileCache()
+        out = t2.warm(c)
+        assert out["costs_only"] == 1 and out["warmed"] == 0
+        assert c.stats()["entries"] == 0
+
+    def test_store_skips_existing_entry(self, tmp_path):
+        t = PersistentCompileCache(str(tmp_path))
+        fn = _compiled()
+        assert t.store(KEY, fn, label="seg0", shape="b4")
+        assert not t.store(KEY, fn, label="seg0", shape="b4")
+        assert t.stats()["store_skips"] == 1
+
+    def test_readonly_tier_never_writes(self, tmp_path):
+        t = PersistentCompileCache(str(tmp_path), write=False)
+        assert not t.store(KEY, _compiled(), label="seg0", shape="b4")
+        assert t.entry_count() == 0
+
+    def test_unwritable_path_degrades_to_readonly(self, tmp_path):
+        blocker = tmp_path / "file"
+        blocker.write_text("x")
+        t = PersistentCompileCache(str(blocker / "sub"))
+        assert t.write is False  # mkdir failed, constructor survived
+
+    def test_content_key_binds_environment(self):
+        fp = fleet_cache.env_fingerprint()
+        other = dict(fp, jax="0.0.0-other")
+        assert content_key(KEY, fp) != content_key(KEY, other)
+        assert content_key(KEY, fp) == content_key(KEY, dict(fp))
+
+
+class TestPersistentCacheCorruption:
+    """Truncated / corrupted / foreign-version entries degrade to an
+    accounted recompile — counters move, nothing raises."""
+
+    def _entry_path(self, tmp_path):
+        t = PersistentCompileCache(str(tmp_path))
+        c = CompileCache()
+        c.attach_persistent(t)
+        c.get(KEY, _compiled, label="seg0", shape="b4")
+        (name,) = [n for n in os.listdir(tmp_path)
+                   if n.endswith(fleet_cache.SUFFIX)]
+        return os.path.join(str(tmp_path), name)
+
+    def _assert_degrades(self, tmp_path):
+        t = PersistentCompileCache(str(tmp_path))
+        assert t.load(KEY, label="seg0", shape="b4") is None
+        assert t.stats()["load_errors"] == 1
+        # warm over the same broken entry: counted, start still succeeds
+        c = CompileCache()
+        out = t.warm(c)
+        assert out["errors"] == 1
+        # and the serving path recompiles through the in-process cache
+        c2 = CompileCache()
+        c2.attach_persistent(PersistentCompileCache(str(tmp_path),
+                                                    write=False))
+        fn = c2.get(KEY, _compiled, label="seg0", shape="b4")
+        assert np.array_equal(np.asarray(fn(X)), np.asarray(X) * 2.0)
+        assert c2.stats()["misses"] == 1  # honest accounting: it compiled
+
+    def test_truncated_entry(self, tmp_path):
+        path = self._entry_path(tmp_path)
+        blob = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(blob[: len(blob) - 7])
+        self._assert_degrades(tmp_path)
+
+    def test_bad_magic(self, tmp_path):
+        path = self._entry_path(tmp_path)
+        blob = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(b"NOTMML" + blob[6:])
+        self._assert_degrades(tmp_path)
+
+    def test_garbage_payload(self, tmp_path):
+        path = self._entry_path(tmp_path)
+        blob = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(blob[:200] + os.urandom(max(0, len(blob) - 200)))
+        self._assert_degrades(tmp_path)
+
+    def test_foreign_version_entry_rejected(self, tmp_path):
+        """An entry written by a different jax/backend never loads: the
+        digest differs (never looked up) AND a hand-copied file fails the
+        header fingerprint check."""
+        foreign_fp = dict(fleet_cache.env_fingerprint(), jax="9.9.9")
+        t = PersistentCompileCache(str(tmp_path))
+        header = {"kind": "exec", "env": foreign_fp,
+                  "key_repr": repr(KEY), "label": "seg0", "shape": "b4",
+                  "cost": None, "knobs": None,
+                  "payload_sha256": fleet_cache.hashlib.sha256(
+                      b"zz").hexdigest()}
+        # drop it under the LOCAL digest — simulating a hand-copied file
+        t._write_entry(t._file_for(content_key(KEY, t._fp)), header, b"zz")
+        assert t.load(KEY, label="seg0", shape="b4") is None
+        assert t.stats()["load_errors"] == 1
+
+
+class TestForecast:
+    def test_empty_is_zero(self):
+        f = forecast_rps([])
+        assert f["forecast_rps"] == 0.0 and f["seconds"] == 0
+
+    def test_constant_rate_converges(self):
+        now = 10_000
+        buckets = [(now - 40 + i, 50, 0) for i in range(40)]
+        f = forecast_rps(buckets, now=now)
+        assert abs(f["level_rps"] - 50.0) < 1.0
+        assert abs(f["forecast_rps"] - 50.0) < 5.0
+
+    def test_rising_trend_projects_up(self):
+        now = 10_000
+        buckets = [(now - 30 + i, 10 + 4 * i, 0) for i in range(30)]
+        f = forecast_rps(buckets, now=now)
+        assert f["trend_rps_s"] > 0
+        assert f["forecast_rps"] > f["level_rps"]
+
+    def test_idle_gap_pulls_forecast_down(self):
+        now = 10_000
+        busy = [(now - 60 + i, 100, 0) for i in range(30)]
+        # the 30 most recent seconds have NO buckets -> zero traffic
+        f = forecast_rps(busy, now=now)
+        assert f["level_rps"] < 30.0
+
+    def test_current_partial_second_excluded(self):
+        now = 10_000
+        buckets = [(now - 2, 10, 0), (now - 1, 10, 0), (now, 9_999, 0)]
+        f = forecast_rps(buckets, now=now)
+        assert f["level_rps"] < 20.0
+
+    def test_slo_tracker_bucket_form(self):
+        from mmlspark_tpu.obs.perf import SLOTracker
+
+        t = [100.0]
+        trk = SLOTracker(clock=lambda: t[0])
+        for _ in range(30):
+            trk.record(0.001)
+            t[0] += 1.0
+        snap = trk.arrival_buckets()
+        assert snap["now"] == t[0]
+        f = forecast_rps(snap["buckets"], now=snap["now"])
+        assert abs(f["level_rps"] - 1.0) < 0.5
+
+
+def _predict_ms(bucket):
+    """Synthetic calibrated cost model: 4ms fixed + 0.05ms/row."""
+    return 4.0 + 0.05 * bucket
+
+
+class TestPlanner:
+    def test_sweep_meets_slo(self):
+        """Across a simulated arrival sweep, every feasible plan's own
+        numbers satisfy the objective when recomputed independently."""
+        cfg = PlannerConfig(objective_ms=100.0, max_replicas=256)
+        for demand in (0, 5, 50, 200, 1_000, 5_000, 20_000):
+            p = plan_capacity(demand, _predict_ms, cfg)
+            assert p.meets_slo is True, (demand, p)
+            # independent re-check of the emitted config
+            service = _predict_ms(p.bucket)
+            mu = p.bucket * 1000.0 / service
+            rho = (demand * cfg.headroom) / (p.replicas * mu) \
+                if demand else 0.0
+            assert rho <= cfg.utilization_cap + 1e-9
+            wait = cfg.window_alpha * service
+            lat = wait + service * (1.0 + rho / (1.0 - rho))
+            assert lat <= cfg.objective_ms + 1e-6
+            assert p.capacity_rps >= demand * cfg.headroom or demand == 0
+
+    def test_more_demand_never_fewer_replicas(self):
+        cfg = PlannerConfig(objective_ms=100.0, max_replicas=256)
+        last = 0
+        for demand in (10, 100, 1_000, 10_000, 50_000):
+            p = plan_capacity(demand, _predict_ms, cfg)
+            assert p.replicas >= last
+            last = p.replicas
+
+    def test_saturation_reports_infeasible(self):
+        cfg = PlannerConfig(objective_ms=100.0, max_replicas=2)
+        p = plan_capacity(1_000_000, _predict_ms, cfg)
+        assert p.replicas == 2
+        assert p.meets_slo is False
+
+    def test_uncalibrated_holds_steady(self):
+        p = plan_capacity(500.0, lambda b: None, live_replicas=7)
+        assert p.meets_slo is None
+        assert p.replicas == 7
+        assert p.reason == "uncalibrated"
+        # and a raising model reads the same as an uncalibrated one
+        def boom(b):
+            raise RuntimeError("no data")
+        assert plan_capacity(500.0, boom).meets_slo is None
+
+    def test_mega_k_engages_on_dispatch_rate(self):
+        cfg = PlannerConfig(objective_ms=100.0, max_replicas=4,
+                            bucket_candidates=(8,),
+                            dispatch_floor_hz=50.0)
+        lazy = plan_capacity(10.0, _predict_ms, cfg)
+        assert lazy.mega_k == 1
+        busy = plan_capacity(3_000.0, _predict_ms, cfg)
+        assert busy.mega_k > 1
+
+    def test_inflight_deepens_with_utilization(self):
+        cfg = PlannerConfig(objective_ms=100.0, max_replicas=256)
+        assert plan_capacity(1.0, _predict_ms, cfg).inflight == 1
+        hot = plan_capacity(20_000.0, _predict_ms, cfg)
+        assert hot.inflight >= 2
+
+    def test_journal_and_summary(self):
+        pl = CapacityPlanner(_predict_ms)
+        pl.plan(100.0)
+        pl.plan(200.0, live_replicas=3)
+        assert pl.plans_total == 2
+        j = pl.journal()
+        assert len(j) == 2 and j[-1]["demand_rps"] == 200.0
+        s = pl.summary()
+        assert s["plans_total"] == 2
+        assert s["latest"]["plan"]["reason"] == "planned"
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            PlannerConfig(utilization_cap=1.5)
+        with pytest.raises(ValueError):
+            PlannerConfig(headroom=0.5)
+        with pytest.raises(ValueError):
+            PlannerConfig(objective_ms=0)
+
+
+class _FakeBrownout:
+    def __init__(self):
+        self.step = 0
+
+
+def _controller(demand_rps=200_000.0, live=None, spec=None, brownout=None):
+    """A controller over a fake clock with scripted hooks; returns
+    (controller, clock list, applied log, live dict)."""
+    clock = [1_000.0]
+    live = live if live is not None else {
+        "replicas": 1, "inflight": 1, "mega_k": 1}
+    applied = []
+    now_s = [50_000]
+
+    def buckets():
+        # steady synthetic arrivals at demand_rps for the past 60s
+        return {"now": now_s[0],
+                "buckets": [(now_s[0] - 60 + i, demand_rps, 0)
+                            for i in range(60)]}
+
+    hooks = {
+        "live_config": lambda: dict(live),
+        "set_inflight": lambda n: applied.append(("inflight", n)),
+        "set_mega_k": lambda k: applied.append(("mega_k", k)),
+        "arrival_buckets": buckets,
+    }
+    ctl = FleetController(
+        CapacityPlanner(_predict_ms,
+                        PlannerConfig(objective_ms=100.0,
+                                      max_replicas=256)),
+        spec=spec or FleetSpec(tick_s=0.0, plan_every_s=1.0,
+                               consecutive_out=2, consecutive_in=3,
+                               hold_s=0.0, watch_batches=5,
+                               regress_pct=0.15, cooldown_s=30.0),
+        brownout=brownout, hooks=hooks,
+        clock=lambda: clock[0])
+    return ctl, clock, applied, live
+
+
+class TestController:
+    def test_scale_out_needs_quorum_then_applies(self):
+        ctl, clock, applied, _live = _controller()
+        assert ctl.tick(0.01) is None  # plan 1: agreement only
+        assert ctl._recommended is not None
+        assert not applied
+        clock[0] += 1.1
+        assert ctl.tick(0.01) == "scale_out"  # plan 2: quorum reached
+        assert any(k == "inflight" for k, _v in applied)
+        assert ctl.decisions["scale_out"] == 1
+        assert ctl.state == "scale_out"
+        actions = [e["action"] for e in ctl.journal]
+        assert "apply" in actions
+
+    def test_regression_rolls_back_and_cools_down(self):
+        ctl, clock, applied, _live = _controller()
+        ctl.tick(0.01)
+        clock[0] += 1.1
+        assert ctl.tick(0.01) == "scale_out"
+        applied.clear()
+        # the watch window sees a >15% e2e regression
+        clock[0] += 1.1
+        for _ in range(6):
+            out = ctl.tick(0.05)
+            if out == "rollback":
+                break
+        assert ctl.decisions["rollback"] == 1
+        assert ctl.state == "cooldown"
+        # the snapshotted pre-apply knobs were restored through the hooks
+        assert ("inflight", 1) in applied
+        assert [e for e in ctl.journal if e["action"] == "rollback"]
+        # cooldown vetoes further planning until it expires
+        clock[0] += 1.1
+        assert ctl.tick(0.01) is None
+        clock[0] += 60.0
+        assert ctl.tick(0.01) is None  # agreement restarts from zero
+
+    def test_clean_watch_returns_to_steady(self):
+        ctl, clock, _applied, _live = _controller()
+        ctl.tick(0.01)
+        clock[0] += 1.1
+        ctl.tick(0.01)
+        clock[0] += 1.1
+        for _ in range(6):
+            ctl.tick(0.0101)  # same latency: no regression
+        assert ctl.state == "steady"
+        assert ctl.decisions["rollback"] == 0
+        assert [e for e in ctl.journal if e["action"] == "watch_clear"]
+
+    def test_brownout_freezes_scaling(self):
+        brown = _FakeBrownout()
+        brown.step = 1
+        ctl, clock, applied, _live = _controller(brownout=brown)
+        for _ in range(4):
+            ctl.tick(0.01)
+            clock[0] += 1.1
+        assert ctl.state == "degraded"
+        assert ctl.decisions["held_degraded"] >= 1
+        assert not applied
+        # brownout clears -> planning resumes and can apply
+        brown.step = 0
+        ctl.tick(0.01)
+        clock[0] += 1.1
+        ctl.tick(0.01)
+        clock[0] += 1.1
+        ctl.tick(0.01)
+        assert applied
+
+    def test_uncalibrated_never_applies(self):
+        ctl, clock, applied, _live = _controller()
+        ctl.planner._predict_ms = lambda b: None
+        for _ in range(5):
+            ctl.tick(0.01)
+            clock[0] += 1.1
+        assert not applied
+        assert ctl.summary()["recommended"]["reason"] == "uncalibrated"
+
+    def test_manual_rollback_without_apply_is_false(self):
+        ctl, _clock, _applied, _live = _controller()
+        assert ctl.rollback() is False
+
+    def test_summary_shape(self):
+        ctl, _clock, _applied, _live = _controller()
+        ctl.tick(0.01)
+        s = ctl.summary()
+        assert set(s) >= {"state", "forecast", "recommended", "live",
+                          "decisions", "spec", "planner", "journal"}
+        json.dumps(s)  # the /_mmlspark/capacity payload must serialize
+
+    def test_make_fleet_coercions(self):
+        assert make_fleet(None, predict_ms=_predict_ms) is None
+        assert make_fleet(False, predict_ms=_predict_ms) is None
+        ctl = make_fleet(True, predict_ms=_predict_ms)
+        assert isinstance(ctl, FleetController)
+        ctl2 = make_fleet({"plan_every_s": 2.5, "cache_path": "/x",
+                           "cache_write": False,
+                           "planner": {"objective_ms": 50.0}},
+                          predict_ms=_predict_ms)
+        assert ctl2.spec.plan_every_s == 2.5
+        assert ctl2.planner.cfg.objective_ms == 50.0
+        assert make_fleet(ctl, predict_ms=_predict_ms) is ctl
+        with pytest.raises(ValueError):
+            make_fleet(3, predict_ms=_predict_ms)
+
+
+def _echo_transform(df):
+    return df.with_column("reply", lambda p: p["value"])
+
+
+def _serve_requests(server, bodies):
+    replies = []
+    with server:
+        for b in bodies:
+            req = urllib.request.Request(server.address, data=b,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                replies.append(resp.read())
+    return replies
+
+
+class TestServingIntegration:
+    def test_capacity_endpoint_and_stats_section(self):
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo_transform, port=0, fleet=True,
+                            max_wait_ms=1.0)
+        with srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            req = urllib.request.Request(srv.address, data=b'{"x":1}',
+                                         method="POST")
+            urllib.request.urlopen(req, timeout=15).read()
+            cap = json.loads(urllib.request.urlopen(
+                base + "/_mmlspark/capacity", timeout=15).read())
+            stats = json.loads(urllib.request.urlopen(
+                base + "/_mmlspark/stats", timeout=15).read())
+            metrics = urllib.request.urlopen(
+                base + "/_mmlspark/metrics", timeout=15).read().decode()
+        assert cap["state"] in ("steady", "scale_out", "scale_in")
+        assert cap["recommended"] is not None
+        assert "fleet" in stats
+        assert "mmlspark_capacity_recommended_replicas" in metrics
+        assert "mmlspark_capacity_decisions_total" in metrics
+
+    def test_capacity_404_when_disabled(self):
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo_transform, port=0, max_wait_ms=1.0)
+        with srv:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/_mmlspark/capacity",
+                    timeout=15)
+            assert e.value.code == 404
+
+    def test_fleet_false_is_bitwise_identical(self):
+        """fleet=False (the default) serves byte-identical replies and an
+        identical stats surface to a server built without the knob."""
+        from mmlspark_tpu.serving.server import ServingServer
+
+        bodies = [json.dumps({"i": i}).encode() for i in range(4)]
+        off = ServingServer(_echo_transform, port=0, max_wait_ms=1.0,
+                            fleet=False)
+        plain = ServingServer(_echo_transform, port=0, max_wait_ms=1.0)
+        r_off = _serve_requests(off, bodies)
+        r_plain = _serve_requests(plain, bodies)
+        assert r_off == r_plain
+        assert off._fleet is None
+
+    def test_front_aggregates_worker_capacity(self):
+        from mmlspark_tpu.serving.routing import (RoutingFront,
+                                                  register_worker)
+        from mmlspark_tpu.serving.server import ServingServer
+
+        w1 = ServingServer(_echo_transform, port=0, fleet=True,
+                           max_wait_ms=1.0).start()
+        w2 = ServingServer(_echo_transform, port=0,
+                           max_wait_ms=1.0).start()
+        front = RoutingFront(port=0).start()
+        try:
+            for w in (w1, w2):
+                register_worker(f"http://127.0.0.1:{front.port}",
+                                w.address)
+            cap = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{front.port}/_mmlspark/capacity",
+                timeout=15).read())
+        finally:
+            front.stop()
+            w1.stop()
+            w2.stop()
+        assert cap["workers"] == 2
+        assert cap["responding"] == 1  # only the fleet-enabled worker
+        per = list(cap["per_worker"].values())
+        assert any("state" in v for v in per)
+        assert any(v.get("disabled") for v in per)
+
+
+class TestCompileCacheTierGlue:
+    """CompileCache <-> persistent tier protocol surface (the glue the
+    fused serving path rides via attach_persistent_cache)."""
+
+    def test_attach_and_warm_round_trip(self, tmp_path):
+        tier = PersistentCompileCache(str(tmp_path))
+        c = CompileCache()
+        c.attach_persistent(tier)
+        assert c.persistent is tier
+        c.get(KEY, _compiled, label="seg0", shape="b4")
+        assert c.stats()["persistent"]["stores"] == 1
+
+        c2 = CompileCache()
+        t2 = PersistentCompileCache(str(tmp_path))
+        c2.attach_persistent(t2)
+        assert t2.warm(c2)["warmed"] == 1

@@ -210,6 +210,52 @@ class TestOptions:
         assert fenv["MMLSPARK_HEDGE_QUANTILE"] == "0.9"
         assert fenv["MMLSPARK_HEDGE_INIT_DELAY_MS"] == "25"
 
+    def test_fleet_defaults_off(self):
+        # defaults: no fleet env, no cache volume, no HPA — and the
+        # bootstrap passes fleet=None (bitwise-identical serving)
+        text, docs = render_docs()
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        wc = worker["spec"]["template"]["spec"]["containers"][0]
+        env = [e["name"] for e in wc["env"]]
+        assert "MMLSPARK_FLEET" not in env
+        assert "MMLSPARK_CACHE_PATH" not in env
+        mounts = [m["name"] for m in wc["volumeMounts"]]
+        assert "compile-cache" not in mounts
+        assert not any(d["kind"] == "HorizontalPodAutoscaler" for d in docs)
+
+    def test_persistent_cache_mounts_volume(self):
+        _, docs = render_docs({"persistentCache": {
+            "enabled": True, "path": "/cache/compile"}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        spec = worker["spec"]["template"]["spec"]
+        wc = spec["containers"][0]
+        env = {e["name"]: e.get("value") for e in wc["env"]}
+        assert env["MMLSPARK_CACHE_PATH"] == "/cache/compile"
+        mount = [m for m in wc["volumeMounts"]
+                 if m["name"] == "compile-cache"][0]
+        assert mount["mountPath"] == "/cache/compile"
+        vol = [v for v in spec["volumes"] if v["name"] == "compile-cache"][0]
+        assert vol["persistentVolumeClaim"]["claimName"] == \
+            "mmlspark-compile-cache"
+        # a cache path alone turns the fleet knob on in the bootstrap
+        assert 'fleet = {"cache_path": cache_path} if cache_path else True' \
+            in wc["args"][0]
+
+    def test_autoscaler_renders_hpa_and_fleet_env(self):
+        _, docs = render_docs({"autoscaler": {
+            "enabled": True, "targetBurnRate": 2.0, "maxReplicas": 32}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MMLSPARK_FLEET"] == "true"
+        hpa = by_kind_name(docs, "HorizontalPodAutoscaler", "-worker")
+        assert hpa["spec"]["scaleTargetRef"]["name"] == "mmlspark-worker"
+        assert hpa["spec"]["minReplicas"] == 2
+        assert hpa["spec"]["maxReplicas"] == 32
+        metric = hpa["spec"]["metrics"][0]["pods"]
+        assert metric["metric"]["name"] == "mmlspark_slo_burn_rate"
+        assert metric["target"]["averageValue"] == "2.0"
+
     def test_bootstrap_python_compiles(self):
         """The pod commands are Python source built by the templates; a
         template expression the renderer can't evaluate (the old

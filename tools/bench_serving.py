@@ -1154,6 +1154,81 @@ def _ingest_section(k=40, sat_clients=16, sat_duration_s=2.5):
     return out
 
 
+def _coldstart_child(cache_dir):
+    """One fresh-process start over a shared persistent compile cache
+    (serving/fleet/cache.py): build the fused image chain, attach + AOT-warm
+    the tier, answer one full dataframe pass; print the evidence JSON
+    (counters + reply digest) on stdout for the parent to pair."""
+    import hashlib
+
+    from mmlspark_tpu.serving.fleet import PersistentCompileCache
+
+    t0 = time.perf_counter()
+    fused, _model, df, _rows = _make_autotune_chain()
+    tier = PersistentCompileCache(cache_dir)
+    warm = fused.attach_persistent_cache(tier)
+    t_setup = time.perf_counter() - t0
+    out = fused.transform(df)
+    t_first = time.perf_counter() - t0
+    h = hashlib.sha256()
+    for v in out.column(out.columns[-1]):
+        h.update(np.ascontiguousarray(np.asarray(v)).tobytes())
+    cs = fused.compile_cache.stats()
+    print(json.dumps({
+        "t_setup_s": round(t_setup, 4),
+        "t_first_reply_s": round(t_first, 4),
+        "memory": {k: cs.get(k) for k in
+                   ("hits", "misses", "compile_time_s", "entries")},
+        "tier": cs.get("persistent"),
+        "warm": warm,
+        "reply_sha256": h.hexdigest()}))
+
+
+def _coldstart_section():
+    """Fresh-process cold start vs AOT-warmed start (serving/fleet): a
+    paired subprocess A/B over ONE shared cache directory. Process 1 runs
+    against an empty directory (every signature jit-compiles and persists);
+    process 2 runs the identical workload against the now-populated
+    directory (attach_persistent_cache warms the in-process CompileCache
+    before the first request). The claim is counter-verified: the warmed
+    process must show memory misses == 0 and compile_time_s == 0 for the
+    previously-seen (segment, bucket) signatures, with a bitwise-identical
+    reply digest."""
+    import subprocess
+    import sys
+    import tempfile
+
+    def run(d):
+        r = subprocess.run(
+            [sys.executable, __file__, "--coldstart-child", d],
+            capture_output=True, text=True, timeout=600, check=True)
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    with tempfile.TemporaryDirectory() as d:
+        cold = run(d)
+        warmed = run(d)
+    warm_mem = warmed["memory"]
+    return {
+        "cold": cold,
+        "warmed": warmed,
+        "compile_s_eliminated": round(
+            (cold["memory"]["compile_time_s"] or 0.0)
+            - (warm_mem["compile_time_s"] or 0.0), 4),
+        "warm_zero_compiles": warm_mem["misses"] == 0
+        and warm_mem["compile_time_s"] == 0,
+        "bitwise_identical_reply":
+            cold["reply_sha256"] == warmed["reply_sha256"],
+        "t_first_reply_speedup": round(
+            cold["t_first_reply_s"] / warmed["t_first_reply_s"], 3)
+        if warmed["t_first_reply_s"] else None,
+        "note": "paired fresh-process A/B, one shared cache dir; CPU "
+                "backend — XLA CPU compiles of this small chain are "
+                "tens-of-ms, real TPU fleet compiles are minutes, so "
+                "compile_s_eliminated understates the production win; "
+                "timers start after imports (interpreter/jax import cost "
+                "is identical in both arms and excluded)"}
+
+
 def main():
     import argparse
 
@@ -1168,7 +1243,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
                     choices=["all", "load_async", "obs_overhead", "wire",
-                             "autotune", "hedging", "ingest"],
+                             "autotune", "hedging", "ingest", "coldstart"],
                     default="all",
                     help="load_async: run just the overlapped-executor A/B "
                          "section; obs_overhead: just the observability "
@@ -1176,10 +1251,24 @@ def main():
                          "A/B; autotune: just the static-vs-tuned knob A/B; "
                          "hedging: just the hedged-request straggler A/B; "
                          "ingest: just the copy-vs-deposit + mega-dispatch "
-                         "A/B (merge into an existing artifact)")
+                         "A/B; coldstart: just the fresh-process cold vs "
+                         "AOT-warmed start A/B (merge into an existing "
+                         "artifact)")
+    ap.add_argument("--coldstart-child", metavar="CACHE_DIR",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
 
+    if args.coldstart_child:
+        _coldstart_child(args.coldstart_child)
+        return
+
     platform = jax.devices()[0].platform
+
+    if args.only == "coldstart":
+        print(json.dumps({
+            "backend": platform,
+            "coldstart": _coldstart_section()}))
+        return
     n = 200 if platform != "cpu" else 50
     n_clients = 16
     duration = 8.0 if platform != "cpu" else 3.0

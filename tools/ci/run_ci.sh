@@ -43,6 +43,7 @@ PACKAGES=(
   "tests/test_perf_attribution.py"
   "tests/test_autotune.py"
   "tests/test_ingest_zero_copy.py"
+  "tests/test_fleet.py"
   "tests/test_benchmarks_extended.py"
   "tests/test_multiprocess.py"
   "tests/test_examples.py"

@@ -169,6 +169,59 @@ def render_tuner(tuner: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(fleet: Optional[Dict[str, Any]],
+                 cache: Optional[Dict[str, Any]]) -> str:
+    """Fleet section: planner recommendation vs live config (from the
+    controller's /_mmlspark/capacity summary) plus the two-tier compile
+    cache's cold-start story — persistent hit rate and the compile
+    seconds the warm path eliminated (serving/fleet/)."""
+    lines: List[str] = []
+    if fleet:
+        dec = fleet.get("decisions") or {}
+        lines.append(
+            f"Fleet: state={fleet.get('state')} "
+            f"forecast="
+            f"{_fmt((fleet.get('forecast') or {}).get('forecast_rps'))}rps "
+            + " ".join(f"{k}={v}" for k, v in sorted(dec.items())))
+        rec = fleet.get("recommended") or {}
+        live = fleet.get("live") or {}
+        if rec or live:
+            cells = [["knob", "live", "recommended"]]
+            for name in ("replicas", "inflight", "bucket", "mega_k"):
+                cells.append([name, _fmt(live.get(name)),
+                              _fmt(rec.get(name))])
+            widths = [max(len(r[i]) for r in cells) for i in range(3)]
+            for j, row in enumerate(cells):
+                lines.append("  ".join(c.ljust(w)
+                                       for c, w in zip(row, widths))
+                             .rstrip())
+                if j == 0:
+                    lines.append("  ".join("-" * w for w in widths))
+        if rec:
+            lines.append(
+                f"plan: meets_slo={rec.get('meets_slo')} "
+                f"predicted={_fmt(rec.get('predicted_latency_ms'))}ms "
+                f"utilization={_fmt(rec.get('utilization'))} "
+                f"({rec.get('reason')})")
+    if cache:
+        tier = cache.get("persistent")
+        lines.append(
+            f"compile cache [memory]: hits={cache.get('hits')} "
+            f"misses={cache.get('misses')} "
+            f"compile_s={_fmt(cache.get('compile_time_s'))}")
+        if tier:
+            lines.append(
+                f"compile cache [persistent]: entries={tier.get('entries')} "
+                f"hit_rate={_fmt(tier.get('hit_rate'))} "
+                f"stores={tier.get('stores')} "
+                f"load_errors={tier.get('load_errors')}")
+            if cache.get("misses") == 0 and cache.get("hits", 0) > 0:
+                lines.append(
+                    "cold start: AOT-warmed — every served signature was a "
+                    "memory hit (zero jit compiles this process)")
+    return "\n".join(lines)
+
+
 def rows_from_trace(path: str) -> List[Dict[str, Any]]:
     """Aggregate ``segment:*`` spans from a JSONL trace dump: mean duration
     per segment, the cost attrs the spans carry, and the trace ids seen
@@ -270,7 +323,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
 
-    slo = tuner = None
+    slo = tuner = fleet = cache = None
     if args.url:
         url = args.url.rstrip("/") + "/_mmlspark/stats"
         with urllib.request.urlopen(url, timeout=args.timeout) as resp:
@@ -278,18 +331,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         rows = rows_from_stats(stats)
         slo = stats.get("slo")
         tuner = stats.get("tuner")
+        fleet = stats.get("fleet")
+        cache = (stats.get("fusion") or {}).get("compile_cache")
     elif args.trace:
         rows = rows_from_trace(args.trace)
     else:
         rows, tuner = demo_rows()
 
     if args.as_json:
-        print(json.dumps({"segments": rows, "slo": slo, "tuner": tuner}))
+        print(json.dumps({"segments": rows, "slo": slo, "tuner": tuner,
+                          "fleet": fleet, "compile_cache": cache}))
         return 0
     print(render_table(rows))
     if tuner:
         print()
         print(render_tuner(tuner))
+    if fleet or (cache or {}).get("persistent"):
+        print()
+        print(render_fleet(fleet, cache))
     if slo:
         burns = ", ".join(f"{w}s={rec['burn_rate']}"
                           for w, rec in sorted(
