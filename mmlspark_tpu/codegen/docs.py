@@ -50,6 +50,13 @@ _EXTRA_INDEX = [
     "`KnobSet` (measure→refit→apply loop, journaled knob decisions, "
     "one-step rollback) — the cost-model-driven replacement for the "
     "static bucket / fuse-vs-demote / batching-window / inflight knobs",
+    "- sharded execution (`mmlspark_tpu.parallel.shardplan`, "
+    "hand-maintained guide in [docs/sharding.md](../sharding.md)): "
+    "`candidates` / `sharding_for` (per-segment partition-spec planning), "
+    "`SegmentSharding` (pjit shardings, cache keys, donation gating), "
+    "`measure_collectives` (all-reduce/all-gather probe calibration), "
+    "`shard_groups` / `submesh_excluding` / `MeshSupervision` "
+    "(shard-group quarantine + submesh re-planning)",
 ]
 
 

@@ -36,6 +36,14 @@ The public surface the Tuner (core/tune.py) consumes:
     prediction and the summed host-stage measurements are trustworthy,
     None otherwise (the planner then falls back to the light-segment
     heuristic, core/fusion.py plan()).
+  - ``observe_collective(op, nbytes, seconds)`` folds measured
+    all-reduce / all-gather probe times (parallel/shardplan.py
+    ``measure_collectives``); ``collective_ms(op, nbytes)`` is the fitted
+    α·bytes + latency term, and ``choose_sharding(segment, batch,
+    candidates)`` prices each candidate partitioning as the per-shard
+    batch prediction plus its collective term — returning the winning
+    spec name, or None (= stay unsharded, the bitwise default) until BOTH
+    the segment and the collectives are calibrated.
 
 Everything is host-side Python (no jax import), thread-safe under one lock,
 and serializable (``to_dict``/``from_dict``) so a tuned model survives a
@@ -157,6 +165,9 @@ class SegmentCostModel:
         self._size_hist: Dict[str, Dict[int, int]] = {}
         # host stage class -> (ewma ms-per-row, n) — the demote side
         self._host: Dict[str, List[float]] = {}
+        # collective op ("all_reduce"/"all_gather") -> [(bytes, ms), ...]
+        # measured probe points (bounded), the α·bytes sharding term
+        self._collective: Dict[str, List[Tuple[float, float]]] = {}
 
     # -- feeding ---------------------------------------------------------
     def peaks(self) -> Dict[str, Any]:
@@ -204,7 +215,8 @@ class SegmentCostModel:
                         continue
                     dst = self._analytic.setdefault(
                         (str(label), bucket), {})
-                    for k in ("flops", "bytes_accessed", "compile_s"):
+                    for k in ("flops", "bytes_accessed", "compile_s",
+                              "output_bytes", "argument_bytes"):
                         v = rec.get(k)
                         if isinstance(v, (int, float)):
                             dst[k] = float(v)
@@ -221,6 +233,67 @@ class SegmentCostModel:
             else:
                 cur[0] = (1 - self.ewma) * cur[0] + self.ewma * per_row
                 cur[1] += 1
+
+    def observe_collective(self, op: str, nbytes: float, seconds: float
+                           ) -> None:
+        """Fold one measured collective probe (parallel/shardplan.py
+        ``measure_collectives``): op is ``"all_reduce"``/``"all_gather"``,
+        ``nbytes`` the payload size, ``seconds`` the measured wall time."""
+        if nbytes <= 0 or seconds < 0:
+            return
+        with self._lock:
+            pts = self._collective.setdefault(str(op), [])
+            pts.append((float(nbytes), float(seconds) * 1e3))
+            if len(pts) > 64:  # bound: keep the freshest calibration
+                del pts[:-64]
+
+    def _collective_fit(self, op: str) -> Optional[Tuple[float, float]]:
+        """(latency_ms, ms_per_byte) least-squares fit over the probe
+        points for one op; None when no points exist."""
+        pts = self._collective.get(str(op))
+        if not pts:
+            return None
+        if len(pts) == 1 or len({b for b, _ in pts}) == 1:
+            b0, ms0 = pts[-1]
+            return 0.0, ms0 / b0  # proportional through the origin
+        n = float(len(pts))
+        sx = sum(b for b, _ in pts)
+        sy = sum(m for _, m in pts)
+        sxx = sum(b * b for b, _ in pts)
+        sxy = sum(b * m for b, m in pts)
+        denom = n * sxx - sx * sx
+        slope = (n * sxy - sx * sy) / denom
+        alpha = (sy - slope * sx) / n
+        return max(0.0, alpha), max(0.0, slope)
+
+    def collective_ms(self, op: str, nbytes: float) -> Optional[float]:
+        """Predicted wall ms of one ``op`` collective moving ``nbytes``
+        (fitted latency + α·bytes); None until a probe has been folded."""
+        with self._lock:
+            fit = self._collective_fit(op)
+        if fit is None or nbytes < 0:
+            return None
+        alpha, per_byte = fit
+        return alpha + per_byte * float(nbytes)
+
+    def collective_calibrated(self, op: Optional[str] = None) -> bool:
+        """True once measured probes back the op's collective term (any op
+        when None) — the second gate in front of ``choose_sharding``."""
+        with self._lock:
+            ops = [str(op)] if op else list(self._collective)
+            return any(len(self._collective.get(o) or ()) >= 2
+                       for o in ops)
+
+    def segment_bytes(self, segment: str, key: str = "output_bytes"
+                      ) -> Optional[float]:
+        """Mean harvested byte count over the segment's analytic records
+        (``output_bytes``/``argument_bytes``/``bytes_accessed``) — the
+        collective payload estimate ``choose_sharding`` candidates carry."""
+        with self._lock:
+            vals = [rec[key] for (s, _), rec in self._analytic.items()
+                    if s == str(segment) and isinstance(
+                        rec.get(key), (int, float))]
+        return sum(vals) / len(vals) if vals else None
 
     # -- prediction ------------------------------------------------------
     def _analytic_ms(self, key: Tuple[str, int]) -> Optional[float]:
@@ -502,6 +575,62 @@ class SegmentCostModel:
         k = int(math.ceil(disp / (amortize_to * work)))
         return max(1, min(int(max_k), k))
 
+    def predict_sharded_ms(self, segment: str, batch: int, shards: int,
+                           collective_bytes: float = 0.0,
+                           op: str = "all_gather") -> Optional[float]:
+        """Predicted wall ms for one ``batch``-row dispatch sharded
+        ``shards`` ways: the single-device prediction at the PER-SHARD
+        batch (ceil(batch/shards) — compute and memory traffic divide
+        across chips) plus the measured collective term for moving
+        ``collective_bytes`` through ``op``. None when the segment
+        prediction is unknown, or when a nonzero collective payload has no
+        calibrated term (an unpriced collective must not look free)."""
+        shards = max(1, int(shards))
+        per_shard = (int(batch) + shards - 1) // shards
+        base = self.predict_ms(segment, batch=per_shard)
+        if base is None:
+            return None
+        coll = 0.0
+        if collective_bytes > 0:
+            fitted = self.collective_ms(op, collective_bytes)
+            if fitted is None:
+                return None
+            coll = fitted
+        return base + coll
+
+    def choose_sharding(self, segment: str, batch: int,
+                        candidates: Sequence[Dict[str, Any]],
+                        margin: float = 0.95) -> Optional[str]:
+        """Pick the candidate partitioning (``{name, shards, op,
+        collective_bytes}`` descriptions from ``shardplan.
+        tuner_candidates``) whose predicted sharded wall undercuts the
+        unsharded prediction by at least ``1 - margin``; None keeps the
+        segment unsharded. Gated on BOTH ``calibrated(segment)`` and
+        ``collective_calibrated()``: an uncalibrated model must change
+        nothing, so cold-start stays bitwise-identical to the single-device
+        path."""
+        seg = str(segment)
+        if not self.calibrated(seg) or not self.collective_calibrated():
+            return None
+        base = self.predict_ms(seg, batch=int(batch))
+        if base is None:
+            return None
+        best_name: Optional[str] = None
+        best_ms = base * float(margin)
+        for cand in candidates or ():
+            shards = int(cand.get("shards", 1) or 1)
+            if shards <= 1:
+                continue
+            ms = self.predict_sharded_ms(
+                seg, int(batch), shards,
+                collective_bytes=float(cand.get("collective_bytes", 0.0)
+                                       or 0.0),
+                op=str(cand.get("op", "all_gather")))
+            if ms is not None and ms < best_ms:
+                best_ms = ms
+                best_name = str(cand.get("name"))
+        return best_name
+
     # -- introspection / serialization -----------------------------------
     def host_ms_per_row(self, stage: str) -> Optional[float]:
         with self._lock:
@@ -562,6 +691,8 @@ class SegmentCostModel:
                 "size_hist": {s: {str(n): c for n, c in h.items()}
                               for s, h in self._size_hist.items()},
                 "host": {k: list(v) for k, v in self._host.items()},
+                "collectives": {op: [list(p) for p in pts]
+                                for op, pts in self._collective.items()},
             }
 
     @classmethod
@@ -584,4 +715,6 @@ class SegmentCostModel:
             m._size_hist[seg] = {int(n): int(c) for n, c in hist.items()}
         for k, v in (d.get("host") or {}).items():
             m._host[k] = [float(v[0]), int(v[1])]
+        for op, pts in (d.get("collectives") or {}).items():
+            m._collective[op] = [(float(p[0]), float(p[1])) for p in pts]
         return m

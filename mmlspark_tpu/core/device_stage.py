@@ -97,6 +97,13 @@ class DeviceFn:
     # heavy = worth a device round-trip on its own (NN forward, forest
     # kernel); a segment of only light stages executes on the host path
     heavy: bool = False
+    # Optional model/feature-dim sharding declaration for the pod-scale
+    # planner (parallel/shardplan.py): {input col: array dim (batch = 0)
+    # that may shard over the mesh's tensor axis}. Batch-dim data
+    # parallelism needs no declaration (always legal — fn is row
+    # independent by contract); a feature-dim candidate is only DERIVED
+    # for a segment when every stage declares one for its external inputs.
+    shard_dims: Optional[Dict[str, int]] = None
 
     def __post_init__(self):
         self.in_cols = tuple(self.in_cols)

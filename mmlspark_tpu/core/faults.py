@@ -204,11 +204,19 @@ FRONT_HEDGE = "front.hedge"
 # plan = full/readonly cache volume -> serving continues uncached)
 COMPILECACHE_LOAD = "compilecache.load"
 COMPILECACHE_STORE = "compilecache.store"
+# parallel/shardplan SegmentSharding.device_put, before a host batch is
+# staged across the mesh: a raising plan simulates a chip dropping out of
+# its shard group mid-stage (the executor degrades that dispatch to the
+# host fallback; MeshSupervision quarantines the GROUP and re-plans onto
+# the surviving submesh); delay_s wedges the sharded dispatch for the
+# mesh-aware watchdog. Fires on the SHARDED path only — unsharded
+# bitwise-parity is never perturbed by an armed plan.
+MESH_CHIP_WEDGE = "mesh.chip_wedge"
 
 ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
               JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE,
               WORKER_DISPATCH_HANG, WORKER_CRASH, FRONT_HEDGE,
-              COMPILECACHE_LOAD, COMPILECACHE_STORE)
+              COMPILECACHE_LOAD, COMPILECACHE_STORE, MESH_CHIP_WEDGE)
 
 
 class InjectedFault(OSError):

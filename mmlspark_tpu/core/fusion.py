@@ -304,7 +304,8 @@ class SegmentExecutor:
 
     def __init__(self, segment: Segment, cache: Optional[CompileCache] = None,
                  buckets: Optional[Tuple[int, ...]] = None,
-                 cost_model=None, slot_pool=None, mega_k: int = 1):
+                 cost_model=None, slot_pool=None, mega_k: int = 1,
+                 sharding=None):
         self.segment = segment
         self.cache = cache if cache is not None else compile_cache()
         self.fallbacks: List[str] = []
@@ -319,6 +320,10 @@ class SegmentExecutor:
         # K-step mega-dispatch factor for the submit path (auto-tuner knob,
         # core/costmodel.py choose_mega_k); 1 = today's per-batch dispatch
         self.mega_k = max(1, int(mega_k or 1))
+        # mesh sharding (parallel/shardplan.py SegmentSharding, auto-tuner
+        # knob via costmodel.choose_sharding); None = the single-device
+        # path, byte-for-byte today's code
+        self.sharding = sharding
 
     def _cost_attrs(self) -> Dict[str, Any]:
         """XLA cost attrs for this segment's trace spans (mean per-batch
@@ -347,6 +352,14 @@ class SegmentExecutor:
                     type(s).__name__, time.perf_counter() - t0, n)
         return sub.partitions
 
+    def _put_params(self, jax):
+        """Stage-params placement: replicated over the mesh when sharded,
+        the plain single-device put (today's code, verbatim) otherwise."""
+        params = tuple(d.params for d in self.segment.dfns)
+        if self.sharding is None:
+            return jax.device_put(params)
+        return self.sharding.put_params(params)
+
     # -- fused path ------------------------------------------------------
     def run(self, df: DataFrame, stats) -> DataFrame:
         import jax
@@ -354,7 +367,7 @@ class SegmentExecutor:
         from ..obs.trace import current_batch
 
         seg = self.segment
-        params_dev = jax.device_put(tuple(d.params for d in seg.dfns))
+        params_dev = self._put_params(jax)
         obs = current_batch()  # serving batch's trace binding (or None)
         t_wall, t0 = time.time(), time.perf_counter()
         out_parts: List[Dict[str, np.ndarray]] = []
@@ -519,11 +532,17 @@ class SegmentExecutor:
         dense, ext = state["dense"], state["ext"]
         deposit = state.get("deposit") or {}
         n_valid = state["n_valid"]
+        # sharded over the mesh's data axis: every padded batch must split
+        # evenly across the shards, so targets round UP to a shard multiple
+        # (the pad rows are masked out at readback exactly like bucket pad)
+        shards = self.sharding.shards if self.sharding is not None else 1
         for start in range(0, n_valid, batch_size):
             stop = min(start + batch_size, n_valid)
             m = stop - start
             target = batch_size if m == batch_size \
                 else min(next_bucket(m, buckets=self.buckets), batch_size)
+            if shards > 1:
+                target = -(-target // shards) * shards
             arrays = {c: pad_batch(dense[c][start:stop], target)
                       for c in dense}
             lease = None
@@ -556,11 +575,19 @@ class SegmentExecutor:
             mask[:m] = True
             yield Batch(arrays, mask, m, staging=lease)
 
-    @staticmethod
-    def _put(batch):
+    def _put(self, batch):
         import jax
 
-        return jax.device_put(batch.arrays), batch.num_valid
+        if self.sharding is None:
+            return jax.device_put(batch.arrays), batch.num_valid
+        # sharded staging: each column lands pre-split across the mesh's
+        # candidate axis. A failure here (a chip dropping out mid-stage —
+        # the mesh.chip_wedge chaos seam) degrades this PARTITION to the
+        # host fallback: slower, never wrong.
+        try:
+            return self.sharding.device_put(batch.arrays), batch.num_valid
+        except Exception as e:  # noqa: BLE001 — any stage fault demotes
+            raise FusionUnsupported(f"mesh stage failure: {e}")
 
     @staticmethod
     def _sig_of(x, ext) -> Tuple:
@@ -578,13 +605,22 @@ class SegmentExecutor:
         Non-blocking (jax dispatch is async); executables come from the
         shared CompileCache keyed by (segment, shape signature)."""
         seg, ext, keys = self.segment, state["ext"], state["keys"]
+        sh = self.sharding
+        # a sharded executable is a DIFFERENT program (GSPMD-partitioned,
+        # collectives inserted): key it apart from the single-device one,
+        # and prefix the shape key so the cost model's bucket parser skips
+        # sharded records (their per-chip flops would skew the
+        # single-device analytic table)
+        key_tail = (sh.cache_key(),) if sh is not None else ()
+        shape_pre = sh.shape_prefix() if sh is not None else ""
 
         def step(staged):
             x, m = staged
             sig = self._sig_of(x, ext)
             compiled = self.cache.get(
-                (seg.key, sig), lambda: self._build(params_dev, x, keys),
-                label=seg.label, shape=self._shape_key_of(sig))
+                (seg.key, sig) + key_tail,
+                lambda: self._build(params_dev, x, keys),
+                label=seg.label, shape=shape_pre + self._shape_key_of(sig))
             with profiling.annotate(f"fused:{seg.label}"):
                 return compiled(params_dev, x), m
 
@@ -597,15 +633,18 @@ class SegmentExecutor:
         mega records (their flops are K batches' worth — folding them into
         a single-batch bucket would skew the analytic roofline)."""
         seg, ext, keys = self.segment, state["ext"], state["keys"]
+        sh = self.sharding
+        key_tail = (sh.cache_key(),) if sh is not None else ()
+        shape_pre = sh.shape_prefix() if sh is not None else ""
 
         def mega(group):
             xs = [x for (x, _m), _t in group]
             sig = self._sig_of(xs[0], ext)
             compiled = self.cache.get(
-                (seg.key, sig, ("mega", k)),
+                (seg.key, sig, ("mega", k)) + key_tail,
                 lambda: self._build_mega(params_dev, xs[0], keys, k),
                 label=seg.label,
-                shape=f"mega{k};{self._shape_key_of(sig)}")
+                shape=f"{shape_pre}mega{k};{self._shape_key_of(sig)}")
             cols_seq = tuple({c: x[c] for c in ext} for x in xs)
             with profiling.annotate(f"fused:{seg.label}:mega{k}"):
                 return compiled(params_dev, cols_seq)
@@ -674,7 +713,7 @@ class SegmentExecutor:
         obs = current_batch()  # serving batch's trace binding (or None)
         wall0 = time.perf_counter()
         t_wall = time.time()
-        params_dev = jax.device_put(tuple(d.params for d in seg.dfns))
+        params_dev = self._put_params(jax)
         mega_k = max(1, int(self.mega_k or 1))
         pendings: List[Tuple[str, Any, Any]] = []
         for part in df.partitions:
@@ -707,7 +746,7 @@ class SegmentExecutor:
                         if filler is not None:
                             filler.close()
                 pendings.append(("device", state, handles))
-            except _HostFallback as e:
+            except (_HostFallback, FusionUnsupported) as e:
                 self.fallbacks.append(f"{seg.label}: {e}")
                 pendings.append(
                     ("host", self._host_partition(part, df.schema), None))
@@ -848,7 +887,12 @@ class SegmentExecutor:
                 env.update(dfn.fn(p, env))
             return tuple(env[k] for k in keys)
 
-        jitted = jax.jit(fused)
+        # sharded: pjit with the planner's NamedShardings (replicated
+        # params, per-column input specs, donated ring-staged inputs) —
+        # GSPMD partitions the program and inserts the collectives
+        jit_kwargs = self.sharding.jit_kwargs() \
+            if self.sharding is not None else {}
+        jitted = jax.jit(fused, **jit_kwargs)
         specs = {c: jax.ShapeDtypeStruct(tuple(np.shape(v)),
                                          np.asarray(v).dtype
                                          if not hasattr(v, "dtype") else v.dtype)
@@ -884,7 +928,9 @@ class SegmentExecutor:
                 outs.append(tuple(env[kk] for kk in keys))
             return tuple(outs)
 
-        jitted = jax.jit(fused_k)
+        jit_kwargs = self.sharding.jit_kwargs(mega_k=k) \
+            if self.sharding is not None else {}
+        jitted = jax.jit(fused_k, **jit_kwargs)
         spec = {c: jax.ShapeDtypeStruct(
             tuple(np.shape(v)),
             np.asarray(v).dtype if not hasattr(v, "dtype") else v.dtype)
@@ -931,6 +977,13 @@ class FusedPipelineModel(PipelineModel):
         self._bucket_overrides: Dict[str, Tuple[int, ...]] = {}
         self._fuse_overrides: Dict[str, bool] = {}
         self._mega_k_overrides: Dict[str, int] = {}
+        # pod-scale sharding (parallel/shardplan.py): the mesh segments may
+        # shard over (set_mesh / MeshSupervision) and the per-segment spec
+        # overrides (tuner knob via costmodel.choose_sharding). Both
+        # default OFF — no mesh or no override = the single-device path.
+        self._shard_mesh = None
+        self._sharding_overrides: Dict[str, str] = {}
+        self._seg_sharding: Dict[str, Any] = {}
         # pre-allocated H2D staging (parallel/ingest.py SlotPool), shared
         # across segments/executors; ``slot_staging=False`` pins the legacy
         # allocating path (the bench A/B arm)
@@ -943,12 +996,14 @@ class FusedPipelineModel(PipelineModel):
     def set_tuning(self, buckets: Optional[Dict[str, Tuple[int, ...]]] = None,
                    fuse: Optional[Dict[str, bool]] = None,
                    cost_model=None,
-                   mega_k: Optional[Dict[str, int]] = None) -> None:
+                   mega_k: Optional[Dict[str, int]] = None,
+                   sharding: Optional[Dict[str, str]] = None) -> None:
         """Apply tuned knobs (Tuner.apply): per-segment-label bucket sets,
         fuse-vs-demote overrides, per-segment K-step mega-dispatch factors,
-        and/or the cost model itself. Passing None leaves a knob unchanged;
-        passing {} clears it. Cached plans are invalidated (compiled
-        executables survive in the CompileCache)."""
+        per-segment partition-spec names (sharding over the ``set_mesh``
+        mesh), and/or the cost model itself. Passing None leaves a knob
+        unchanged; passing {} clears it. Cached plans are invalidated
+        (compiled executables survive in the CompileCache)."""
         if buckets is not None:
             self._bucket_overrides = {
                 str(k): tuple(sorted(int(b) for b in v))
@@ -959,9 +1014,26 @@ class FusedPipelineModel(PipelineModel):
         if mega_k is not None:
             self._mega_k_overrides = {str(k): max(1, int(v))
                                       for k, v in mega_k.items()}
+        if sharding is not None:
+            self._sharding_overrides = {str(k): str(v)
+                                        for k, v in sharding.items() if v}
         if cost_model is not None:
             self._cost_model = cost_model
         self._plans.clear()
+
+    def set_mesh(self, mesh) -> None:
+        """Attach (or, with None, detach) the device mesh segments may
+        shard over. The mesh alone changes nothing — a segment shards only
+        when a ``sharding`` override names a spec for its label (the
+        tuner's journaled, rollback-able decision). MeshSupervision calls
+        this with the surviving submesh after a shard-group quarantine."""
+        self._shard_mesh = mesh
+        self._seg_sharding.clear()
+        self._plans.clear()
+
+    @property
+    def shard_mesh(self):
+        return self._shard_mesh
 
     @property
     def cost_model(self):
@@ -1017,13 +1089,34 @@ class FusedPipelineModel(PipelineModel):
                 fuse_overrides=self._fuse_overrides or None)
         return self._plans[key]
 
+    def _sharding_for(self, node: Segment):
+        """Resolve the segment's tuned spec name into a SegmentSharding
+        (None = unsharded: no mesh, no override, 1-shard axis, or any
+        resolution failure — wrong sharding must never fail a transform)."""
+        name = self._sharding_overrides.get(node.label)
+        if self._shard_mesh is None or not name:
+            self._seg_sharding.pop(node.label, None)
+            return None
+        try:
+            from ..parallel.shardplan import sharding_for
+
+            sh = sharding_for(node, self._shard_mesh, name)
+        except Exception:  # noqa: BLE001 — degrade to single-device
+            sh = None
+        if sh is None:
+            self._seg_sharding.pop(node.label, None)
+        else:
+            self._seg_sharding[node.label] = sh.describe()
+        return sh
+
     def _make_executor(self, node: Segment) -> SegmentExecutor:
         return SegmentExecutor(
             node, self._cache,
             buckets=self._bucket_overrides.get(node.label),
             cost_model=self._cost_model,
             slot_pool=self._get_slot_pool(),
-            mega_k=self._mega_k_overrides.get(node.label, 1))
+            mega_k=self._mega_k_overrides.get(node.label, 1),
+            sharding=self._sharding_for(node))
 
     def _host_node(self, node: HostStage, df: DataFrame) -> DataFrame:
         """Run one host plan node, feeding its wall time to the cost model
@@ -1126,7 +1219,10 @@ class FusedPipelineModel(PipelineModel):
         try:
             from ..obs.perf import attribute_segments
 
-            roofline = attribute_segments(per_segment, costs)
+            roofline = attribute_segments(
+                per_segment, costs,
+                sharding=self._seg_sharding or None,
+                cost_model=self._cost_model)
         except Exception:  # noqa: BLE001 — attribution must not break stats
             roofline = {}
         out = {
@@ -1139,12 +1235,20 @@ class FusedPipelineModel(PipelineModel):
             "roofline": roofline,
         }
         if (self._bucket_overrides or self._fuse_overrides
-                or self._mega_k_overrides):
+                or self._mega_k_overrides or self._sharding_overrides):
             out["tuning"] = {
                 "buckets": {k: list(v)
                             for k, v in self._bucket_overrides.items()},
                 "fuse": dict(self._fuse_overrides),
-                "mega_k": dict(self._mega_k_overrides)}
+                "mega_k": dict(self._mega_k_overrides),
+                "sharding": dict(self._sharding_overrides)}
+        if self._seg_sharding:
+            from ..parallel.shardplan import mesh_topology
+
+            out["sharding"] = {
+                "mesh": mesh_topology(self._shard_mesh),
+                "segments": {k: dict(v)
+                             for k, v in self._seg_sharding.items()}}
         if self._slot_pool is not None:
             out["slot_pool"] = self._slot_pool.stats()
         return out

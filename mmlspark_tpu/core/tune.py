@@ -67,9 +67,13 @@ class KnobSet:
     #: per-segment-label K-step mega-dispatch factors (absent label = K=1,
     #: the bitwise-identical single-step path)
     mega_k: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: per-segment-label partition-spec names over the fused model's mesh
+    #: (parallel/shardplan.py; absent label = the single-device path)
+    sharding: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def is_default(self) -> bool:
         return not (self.buckets or self.fuse or self.mega_k or
+                    self.sharding or
                     self.window_seed_ms is not None or
                     self.inflight is not None or self.replicas is not None)
 
@@ -81,6 +85,9 @@ class KnobSet:
             out["fuse"] = dict(self.fuse)
         if self.mega_k:
             out["mega_k"] = {k: int(v) for k, v in self.mega_k.items()}
+        if self.sharding:
+            out["sharding"] = {k: str(v)
+                               for k, v in self.sharding.items()}
         for k in ("window_seed_ms", "inflight", "replicas"):
             v = getattr(self, k)
             if v is not None:
@@ -95,6 +102,8 @@ class KnobSet:
             fuse={k: bool(v) for k, v in (d.get("fuse") or {}).items()},
             mega_k={k: int(v)
                     for k, v in (d.get("mega_k") or {}).items()},
+            sharding={k: str(v)
+                      for k, v in (d.get("sharding") or {}).items()},
             window_seed_ms=d.get("window_seed_ms"),
             inflight=d.get("inflight"), replicas=d.get("replicas"))
 
@@ -218,6 +227,9 @@ class Tuner:
             k = self._mega_k_for(label)
             if k is not None and k > 1:
                 knobs.mega_k[label] = k
+            spec = self._sharding_for(label, cap)
+            if spec is not None:
+                knobs.sharding[label] = spec
             pred = self.model.predict(label, batch=cap)
             if pred is not None:
                 trailing_ms = pred["ms"]
@@ -260,6 +272,36 @@ class Tuner:
         if depth > 0:
             k = min(k, depth)
         return max(1, k)
+
+    def _sharding_for(self, label: str, cap: int) -> Optional[str]:
+        """Cost-model partition-spec choice for one segment: enumerate the
+        candidates the plan's stage graph admits over the fused model's
+        mesh (parallel/shardplan.py), price each as flops/shards + the
+        calibrated α·bytes collective term, and return the winner (None =
+        stay unsharded — the default that keeps cold-start bitwise
+        identical)."""
+        mesh = getattr(self.fused, "shard_mesh", None)
+        chooser = getattr(self.model, "choose_sharding", None)
+        if mesh is None or not callable(chooser):
+            return None
+        seg = None
+        for node in getattr(self.fused, "_last_plan", None) or []:
+            if getattr(node, "label", None) == label and \
+                    hasattr(node, "dfns"):
+                seg = node
+                break
+        if seg is None:
+            return None
+        try:
+            from ..parallel.shardplan import tuner_candidates
+
+            cands = tuner_candidates(seg, mesh, model=self.model,
+                                     batch=cap)
+            if not cands:
+                return None
+            return chooser(label, cap, cands)
+        except Exception:  # noqa: BLE001 — proposal must never raise out
+            return None
 
     def predict_batch_ms(self, rows: int) -> Optional[float]:
         """Predicted wall ms for one serving batch of ``rows`` — the sum of
@@ -309,9 +351,15 @@ class Tuner:
         if fused is not None and hasattr(fused, "set_tuning"):
             try:
                 fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse,
-                                 mega_k=knobs.mega_k)
-            except TypeError:  # older fused models without the K knob
-                fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse)
+                                 mega_k=knobs.mega_k,
+                                 sharding=knobs.sharding)
+            except TypeError:
+                try:  # older fused models without the sharding knob
+                    fused.set_tuning(buckets=knobs.buckets,
+                                     fuse=knobs.fuse, mega_k=knobs.mega_k)
+                except TypeError:  # ... or without the K knob either
+                    fused.set_tuning(buckets=knobs.buckets,
+                                     fuse=knobs.fuse)
         if self.controller is not None and knobs.window_seed_ms is not None:
             seed = getattr(self.controller, "seed_compute_ms", None)
             if callable(seed):

@@ -513,7 +513,12 @@ class _LightGBMModelBase(Model, HasFeaturesCol):
             # nulls/sparse rows take the unfused path (CSR predict / the
             # host error), identically to the per-stage chain
             null_policy="fallback", reject_sparse=True,
-            terminal=True, heavy=True)
+            terminal=True, heavy=True,
+            # pod-scale planner declaration (parallel/shardplan.py): the
+            # [N, F] features matrix may shard its feature dim over the
+            # mesh's tensor axis (the forest kernel gathers full rows —
+            # GSPMD inserts that collective; the cost model prices it)
+            shard_dims={feats: 1})
 
 
 # ---------------------------------------------------------------------------

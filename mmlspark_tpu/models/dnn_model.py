@@ -227,7 +227,11 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
         return DeviceFn(
             key=key, in_cols=(in_col,), out_cols=out_cols, fn=fn,
             params=model.params, accepts=accepts, reject_sparse=False,
-            heavy=True)
+            heavy=True,
+            # pod-scale planner declaration (parallel/shardplan.py): flat
+            # [N, F] feature inputs may shard their feature dim over the
+            # mesh's tensor axis (GSPMD inserts the activation collectives)
+            shard_dims={in_col: 1})
 
     def transform_schema(self, schema: Schema) -> Schema:
         if self.get("model") is None:

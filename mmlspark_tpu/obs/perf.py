@@ -131,14 +131,20 @@ PEAKS = {
 NOMINAL_PEAKS = {"flops": 1e11, "bytes_per_s": 2e10}
 
 
-def device_peaks() -> Dict[str, Any]:
+def device_peaks(data_shards: int = 1) -> Dict[str, Any]:
     """Roofline ceilings for the current device: env override >
-    chip-spec table > nominal stand-in. ``peak_source`` records which."""
+    chip-spec table > nominal stand-in. ``peak_source`` records which.
+
+    ``data_shards`` > 1 aggregates over a mesh: a segment sharded N ways
+    has N chips' worth of flops and bandwidth as its bound (the
+    ``peak_source`` gains an ``xN`` suffix so a mesh-scaled bound is never
+    mistaken for a single-chip one)."""
     env_f = _num_or_none(os.environ.get("MMLSPARK_PEAK_FLOPS"))
     env_b = _num_or_none(os.environ.get("MMLSPARK_PEAK_GBPS"))
     if env_f and env_b:
-        return {"flops": env_f, "bytes_per_s": env_b * 1e9,
-                "peak_source": "env"}
+        out = {"flops": env_f, "bytes_per_s": env_b * 1e9,
+               "peak_source": "env"}
+        return _scale_peaks(out, data_shards)
     kind = None
     jax = sys.modules.get("jax")  # never import (and init a backend) here
     if jax is not None:
@@ -150,8 +156,20 @@ def device_peaks() -> Dict[str, Any]:
     if kind is not None:
         for prefix, peak in PEAKS.items():
             if str(kind).startswith(prefix):
-                return {**peak, "peak_source": "table", "device_kind": kind}
-    return {**NOMINAL_PEAKS, "peak_source": "nominal", "device_kind": kind}
+                return _scale_peaks({**peak, "peak_source": "table",
+                                     "device_kind": kind}, data_shards)
+    return _scale_peaks({**NOMINAL_PEAKS, "peak_source": "nominal",
+                         "device_kind": kind}, data_shards)
+
+
+def _scale_peaks(peaks: Dict[str, Any], data_shards: int) -> Dict[str, Any]:
+    n = max(1, int(data_shards or 1))
+    if n == 1:
+        return peaks
+    return {**peaks, "flops": peaks["flops"] * n,
+            "bytes_per_s": peaks["bytes_per_s"] * n,
+            "peak_source": f"{peaks['peak_source']}x{n}",
+            "data_shards": n}
 
 
 # ---------------------------------------------------------------------------
@@ -175,8 +193,9 @@ def _mean_cost(shapes: Dict[str, Dict[str, Any]], key: str
 
 def attribute_segments(per_segment: Dict[str, Dict[str, Any]],
                        costs: Dict[str, Dict[str, Dict[str, Any]]],
-                       peaks: Optional[Dict[str, Any]] = None
-                       ) -> Dict[str, Dict[str, Any]]:
+                       peaks: Optional[Dict[str, Any]] = None,
+                       sharding: Optional[Dict[str, Dict[str, Any]]] = None,
+                       cost_model=None) -> Dict[str, Dict[str, Any]]:
     """Join per-segment ingest decompositions with per-(segment, shape)
     XLA costs into the roofline report.
 
@@ -186,15 +205,30 @@ def attribute_segments(per_segment: Dict[str, Dict[str, Any]],
     bound_ms_per_batch, measured_ms_per_batch, roofline_ratio, bottleneck,
     stage_share, peak_source}} — cost fields absent when the backend
     reported none (the report never fails for lack of them).
-    """
+
+    ``sharding`` ({label: SegmentSharding.describe()}, core/fusion.py) marks
+    segments executing sharded: their bound aggregates over the mesh
+    (per-chip peak × shards), the record carries ``spec``/``shards``, and —
+    when ``cost_model`` has calibrated collective probes — the measured
+    per-batch collective time is attributed (``collective_ms_per_batch``).
+    With ``sharding=None`` the report is byte-identical to the unsharded
+    one."""
     peaks = peaks if peaks is not None else device_peaks()
+    sharding = sharding or {}
     out: Dict[str, Dict[str, Any]] = {}
     for label, s in per_segment.items():
         n = int(s.get("n_batches") or 0)
         if n <= 0:
             continue
+        shard = sharding.get(label)
+        seg_peaks = peaks
+        if shard and int(shard.get("shards", 1) or 1) > 1:
+            seg_peaks = _scale_peaks(peaks, int(shard["shards"]))
         rec: Dict[str, Any] = {"n_batches": n, "rows": s.get("rows"),
-                               "peak_source": peaks.get("peak_source")}
+                               "peak_source": seg_peaks.get("peak_source")}
+        if shard:
+            rec["spec"] = shard.get("spec")
+            rec["shards"] = int(shard.get("shards", 1) or 1)
         # dominant bottleneck from the measured stage decomposition alone
         shares: Dict[str, float] = {}
         for key, bn in _BOTTLENECK_OF:
@@ -225,12 +259,22 @@ def attribute_segments(per_segment: Dict[str, Dict[str, Any]],
         # batch; ratio = bound / measured (1.0 = running at the bound, the
         # ~250x image-chain gap shows up as ~0.004 here)
         if (flops or nbytes) and wall and wall > 0:
-            t_flops = (flops or 0.0) / peaks["flops"]
-            t_mem = (nbytes or 0.0) / peaks["bytes_per_s"]
+            t_flops = (flops or 0.0) / seg_peaks["flops"]
+            t_mem = (nbytes or 0.0) / seg_peaks["bytes_per_s"]
             bound_s = max(t_flops, t_mem)
             if bound_s > 0:
                 rec["bound_ms_per_batch"] = round(bound_s * 1e3, 6)
                 rec["roofline_ratio"] = round(bound_s / (wall / n), 6)
+        # measured collective time one sharded batch pays (the fitted
+        # α·bytes term over the harvested output payload)
+        if shard and cost_model is not None:
+            coll_fn = getattr(cost_model, "collective_ms", None)
+            out_bytes = _mean_cost(shapes, "output_bytes")
+            if callable(coll_fn) and out_bytes:
+                ms = coll_fn(str(shard.get("collective", "all_gather")),
+                             out_bytes)
+                if ms is not None:
+                    rec["collective_ms_per_batch"] = round(ms, 6)
         out[label] = rec
     return out
 
@@ -274,19 +318,34 @@ def segment_families(fusion: Dict[str, Any]) -> List[MetricFamily]:
         "mmlspark_segment_bottleneck", "gauge",
         "one-hot dominant bottleneck per segment "
         "(queue/h2d/compute/dispatch/host)")
+    collective = MetricFamily(
+        "mmlspark_segment_collective_ms_per_batch", "gauge",
+        "fitted collective (all-reduce/all-gather) time one sharded batch "
+        "pays, from measured mesh probes")
     for label, rec in sorted(roofline.items()):
+        # sharded segments carry spec labels so a mesh-scaled bound/ratio
+        # series never aliases the single-device one; unsharded samples
+        # keep exactly the historical label set
+        extra = {}
+        if rec.get("spec"):
+            extra = {"sharded": "1", "spec": str(rec["spec"])}
         for fam, key in ((ratio, "roofline_ratio"),
                          (bound, "bound_ms_per_batch"),
                          (measured, "measured_ms_per_batch")):
             v = _num_or_none(rec.get(key))
             if v is not None:
-                fam.add(v, {"segment": label})
+                fam.add(v, {"segment": label, **extra})
+        v = _num_or_none(rec.get("collective_ms_per_batch"))
+        if v is not None:
+            fam_labels = {"segment": label, **extra}
+            collective.add(v, fam_labels)
         dom = rec.get("bottleneck")
         if dom:
             for name in ("queue", "h2d", "compute", "dispatch", "host"):
                 bneck.add(1.0 if name == dom else 0.0,
-                          {"segment": label, "bottleneck": name})
-    return fams + [f for f in (ratio, bound, measured, bneck) if f.samples]
+                          {"segment": label, "bottleneck": name, **extra})
+    return fams + [f for f in (ratio, bound, measured, bneck, collective)
+                   if f.samples]
 
 
 # ---------------------------------------------------------------------------

@@ -71,19 +71,41 @@ def _canon(obj: Any) -> str:
     return repr(obj)
 
 
-def env_fingerprint() -> Dict[str, Any]:
+def env_fingerprint(mesh: Any = None) -> Dict[str, Any]:
     """What must match for a persisted executable to be loadable here:
-    jax/jaxlib version and the default backend. Import-gated — without
-    jax the fingerprint still exists (cost-only entries remain usable)."""
+    jax/jaxlib version, the default backend, the device count, and the
+    MESH TOPOLOGY (axis names + sizes + device kind) executables shard
+    over. A GSPMD-partitioned executable hard-codes its mesh shape — warm
+    loading one onto a different mesh would dispatch garbage, so the
+    topology is part of the content address: a mismatched entry is simply
+    never found (clean miss -> recompile), not detected after the fact.
+    Import-gated — without jax the fingerprint still exists (cost-only
+    entries remain usable).
+
+    ``mesh``: the mesh the owning model shards over; when None the ambient
+    ``MeshContext`` (parallel/mesh.py) is consulted, falling back to
+    ``"none"`` (the single-device fingerprint, unchanged semantics)."""
     fp: Dict[str, Any] = {"format": FORMAT}
     try:
         import jax
 
         fp["jax"] = str(jax.__version__)
         fp["backend"] = str(jax.default_backend())
+        fp["devices"] = int(jax.device_count())
     except Exception:  # noqa: BLE001 — host-only installs still fingerprint
         fp["jax"] = "none"
         fp["backend"] = "none"
+        fp["devices"] = 0
+    try:
+        if mesh is None:
+            from ...parallel.mesh import MeshContext
+
+            mesh = MeshContext.current()
+        from ...parallel.shardplan import mesh_topology
+
+        fp["mesh"] = mesh_topology(mesh)
+    except Exception:  # noqa: BLE001 — no mesh machinery: single-device
+        fp["mesh"] = "none"
     return fp
 
 
@@ -136,11 +158,14 @@ class PersistentCompileCache:
     """
 
     def __init__(self, path: str, write: bool = True,
-                 knobs_provider: Optional[Callable[[], dict]] = None):
+                 knobs_provider: Optional[Callable[[], dict]] = None,
+                 mesh: Any = None):
         self.path = str(path)
         self.write = bool(write)
         self.knobs_provider = knobs_provider
-        self._fp = env_fingerprint()
+        # ``mesh`` pins the topology the fingerprint carries (the owning
+        # model's shard mesh); default resolves the ambient MeshContext
+        self._fp = env_fingerprint(mesh=mesh)
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0

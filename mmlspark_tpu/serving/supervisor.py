@@ -116,6 +116,37 @@ class ReplicaSupervisor:
             indices = [int(i) for i in replicas]
         self._replicas: Dict[int, _ReplicaHealth] = {
             int(i): _ReplicaHealth() for i in indices}
+        # mesh awareness (parallel/shardplan.py shard_groups): index ->
+        # the frozen group of indices that fail TOGETHER (one mesh slice).
+        # Empty = every replica is its own group (the pre-mesh behavior).
+        self._groups: Dict[int, Tuple[int, ...]] = {}
+
+    def set_shard_groups(self, groups) -> None:
+        """Register the mesh's shard groups (a list of index lists): when a
+        member wedges or ejects, its WHOLE group quarantines — partial
+        results from a broken mesh slice are lost regardless of which chip
+        in the slice failed. Call with () to clear (back to per-replica)."""
+        with self._lock:
+            self._groups = {}
+            for grp in groups or ():
+                members = tuple(int(i) for i in grp)
+                for i in members:
+                    self._groups[i] = members
+
+    def shard_group(self, index: int) -> Tuple[int, ...]:
+        with self._lock:
+            return self._groups.get(int(index), (int(index),))
+
+    def _eject_peers(self, index: int, reason: str) -> None:
+        """Quarantine the healthy remainder of ``index``'s shard group
+        (already under self._lock). Peers carry a ``shard_group:`` reason
+        so the stats surface shows WHY a chip that never failed is out."""
+        for peer in self._groups.get(int(index), ()):
+            if peer == int(index):
+                continue
+            ph = self._get(peer)
+            if ph.state == HEALTHY:
+                self._eject(ph, f"shard_group:{reason}")
 
     def _get(self, index: int) -> _ReplicaHealth:
         return self._replicas.setdefault(int(index), _ReplicaHealth())
@@ -148,6 +179,7 @@ class ReplicaSupervisor:
             self._score(h, 0.0)
             if h.state == HEALTHY and h.consecutive >= self.max_failures:
                 self._eject(h, reason)
+                self._eject_peers(index, reason)
 
     def note_wedged(self, index: int) -> None:
         """A watchdog-expired dispatch: immediate quarantine — a wedged
@@ -159,6 +191,9 @@ class ReplicaSupervisor:
             self._score(h, 0.0)
             if h.state == HEALTHY:
                 self._eject(h, "wedged")
+            # a wedged chip invalidates its whole mesh slice even when the
+            # record was already quarantined (late watchdog expiry)
+            self._eject_peers(index, "wedged")
 
     def _eject(self, h: _ReplicaHealth, reason: str) -> None:
         h.state = QUARANTINED

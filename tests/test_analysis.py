@@ -273,6 +273,28 @@ def test_j001_shim_module_is_exempt():
                  rel="mmlspark_tpu/parallel/mesh.py") == []
 
 
+SHARDPLAN_IDIOM = """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.mesh import (data_sharding, replicated_sharding,
+                                 shard_map_compat)
+
+    def probes(mesh, axis, body):
+        fn = shard_map_compat(body, mesh=mesh,
+                              in_specs=PartitionSpec(axis),
+                              out_specs=PartitionSpec(),
+                              check_vma=False)
+        return fn, data_sharding(mesh, axis), replicated_sharding(mesh)
+"""
+
+
+def test_j001_shardplan_idiom_is_clean():
+    # the sharding planner's surface (parallel/shardplan.py): everything
+    # version-gated routes through the mesh.py shim, the rest
+    # (NamedSharding/PartitionSpec) is stable jax API J001 never gates
+    assert finds(SHARDPLAN_IDIOM, "J001") == []
+
+
 # ---------------------------------------------------------------- D001
 
 IMPURE_JIT = """

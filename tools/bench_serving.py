@@ -22,10 +22,18 @@ Prints one JSON line with latencies in milliseconds.
 """
 
 import json
+import os
+import sys
 import time
 import urllib.request
 
 import numpy as np
+
+# runnable as `python tools/bench_serving.py` on an uninstalled checkout
+# (the coldstart/sharding sections also re-launch this file as a child)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 
 def _measure(url: str, payload: bytes, n: int, warmup: int = 20,
@@ -1229,6 +1237,145 @@ def _coldstart_section():
                 "is identical in both arms and excluded)"}
 
 
+def _sharding_child():
+    """Paired 1-shard vs N-shard A/B inside a forced multi-device CPU
+    backend (the parent sets XLA_FLAGS=--xla_force_host_platform_device_count
+    before this process imports jax). Two workloads, both interleaved:
+
+    - image chain: the flagship fused segment, unsharded vs data-sharded
+      over the mesh's data axis via the shardplan knob (set_tuning), with a
+      tolerance-checked output parity gate (GSPMD reductions reorder float
+      sums, so parity is allclose, not bitwise).
+    - GBDT histogram/boost loop: train() single-device vs mesh= (row-sharded
+      histograms + psum under the fused tree grower), raw-margin parity.
+
+    Prints the evidence JSON on stdout for the parent to merge."""
+    import os
+
+    import jax
+
+    from mmlspark_tpu.core.costmodel import SegmentCostModel
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mmlspark_tpu.parallel.shardplan import measure_collectives
+
+    n_dev = jax.device_count()
+    mesh = make_mesh(MeshSpec(data=n_dev))
+    out = {"n_devices": n_dev, "platform": jax.devices()[0].platform}
+
+    # collective calibration: the α·bytes term choose_sharding prices with
+    model = SegmentCostModel(min_obs=2)
+    probes = measure_collectives(mesh, model=model)
+    out["collective_probes"] = [
+        {"op": p["op"], "bytes": p["bytes"],
+         "ms": round(p["seconds"] * 1e3, 4)} for p in probes]
+
+    # -- image chain: unsharded vs data-sharded, interleaved rounds ------
+    fused, _model, df, rows = _make_autotune_chain(num_partitions=2,
+                                                   rows=48)
+    fused.transform(df)  # compile the unsharded executables
+    label = next(n.label for n in fused._last_plan if hasattr(n, "dfns"))
+    ref = np.stack([np.asarray(v) for v in
+                    fused.transform(df).column("features")])
+
+    def run_once():
+        t0 = time.perf_counter()
+        got = fused.transform(df)
+        dt = time.perf_counter() - t0
+        return rows / dt, got
+
+    fused.set_mesh(mesh)
+    fused.set_tuning(sharding={label: "data"})
+    run_once()  # compile the sharded executables outside the timed rounds
+    one, many = [], []
+    sharded_out = None
+    for _ in range(4):
+        fused.set_tuning(sharding={label: ""})
+        one.append(run_once()[0])
+        fused.set_tuning(sharding={label: "data"})
+        rate, sharded_out = run_once()
+        many.append(rate)
+    got = np.stack([np.asarray(v) for v in
+                    sharded_out.column("features")])
+    err = float(np.max(np.abs(got - ref)))
+    stats = fused.fusion_stats()
+    mean_1 = sum(one) / len(one)
+    mean_n = sum(many) / len(many)
+    out["image_chain"] = {
+        "segment": label,
+        "images_s_1shard": round(mean_1, 2),
+        "images_s_nshard": round(mean_n, 2),
+        "ratio": round(mean_n / mean_1, 4) if mean_1 else None,
+        "max_abs_err": err,
+        "parity_ok": bool(err < 1e-4),
+        "fallbacks": stats.get("fallbacks"),
+        "sharding": stats.get("sharding")}
+
+    # -- GBDT histogram/boost loop: single-device vs row-sharded ---------
+    from mmlspark_tpu.gbdt.booster import TrainParams, train
+
+    os.environ["MMLSPARK_TPU_FUSED_TREE"] = "1"  # sharded grower path
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(2000, 8))
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float64)
+    params = TrainParams(objective="binary", num_iterations=4,
+                         num_leaves=15, min_data_in_leaf=5)
+    train(params, X, y)               # compile both arms outside timing
+    train(params, X, y, mesh=mesh)
+    t1, tn = [], []
+    b_single = b_mesh = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        b_single = train(params, X, y)
+        t1.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        b_mesh = train(params, X, y, mesh=mesh)
+        tn.append(time.perf_counter() - t0)
+    gerr = float(np.max(np.abs(b_single.raw_predict(X)
+                               - b_mesh.raw_predict(X))))
+    out["gbdt_hist"] = {
+        "rows": int(X.shape[0]), "features": int(X.shape[1]),
+        "train_s_1shard": round(min(t1), 4),
+        "train_s_nshard": round(min(tn), 4),
+        "ratio": round(min(t1) / min(tn), 4) if min(tn) else None,
+        "max_abs_err": gerr,
+        "parity_ok": bool(gerr < 1e-3)}
+
+    out["env_note"] = (
+        "forced-host-device CPU mesh (XLA_FLAGS="
+        "--xla_force_host_platform_device_count): every 'chip' is a "
+        "slice of the same host CPU, so N-shard wall time measures the "
+        "sharded program's overheads (collective inserts, per-shard "
+        "dispatch), NOT a speedup — shards contend for the same core. "
+        "The honest CPU claims are parity (sharded == unsharded within "
+        "float-reduction tolerance) and the measured collective probe "
+        "costs the planner prices; the throughput ratio only becomes a "
+        "speedup on real multi-chip hardware.")
+    print(json.dumps(out))
+
+
+def _sharding_section(n_devices=4):
+    """Run the sharding A/B in a child process whose backend is forced to
+    n_devices virtual CPU devices BEFORE jax imports (this process's
+    backend is already initialized with its own device count, so the
+    multi-device mesh must come from a fresh interpreter)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    r = subprocess.run(
+        [sys.executable, __file__, "--sharding-child"],
+        capture_output=True, text=True, timeout=900, env=env)
+    if r.returncode != 0:
+        return {"error": (r.stderr or r.stdout).strip()[-2000:],
+                "rc": r.returncode}
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def main():
     import argparse
 
@@ -1243,7 +1390,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
                     choices=["all", "load_async", "obs_overhead", "wire",
-                             "autotune", "hedging", "ingest", "coldstart"],
+                             "autotune", "hedging", "ingest", "coldstart",
+                             "sharding"],
                     default="all",
                     help="load_async: run just the overlapped-executor A/B "
                          "section; obs_overhead: just the observability "
@@ -1252,14 +1400,21 @@ def main():
                          "hedging: just the hedged-request straggler A/B; "
                          "ingest: just the copy-vs-deposit + mega-dispatch "
                          "A/B; coldstart: just the fresh-process cold vs "
-                         "AOT-warmed start A/B (merge into an existing "
-                         "artifact)")
+                         "AOT-warmed start A/B; sharding: just the 1-shard "
+                         "vs N-shard mesh A/B in a forced-4-device child "
+                         "(merge into an existing artifact)")
     ap.add_argument("--coldstart-child", metavar="CACHE_DIR",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--sharding-child", action="store_true",
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.coldstart_child:
         _coldstart_child(args.coldstart_child)
+        return
+
+    if args.sharding_child:
+        _sharding_child()
         return
 
     platform = jax.devices()[0].platform
@@ -1268,6 +1423,12 @@ def main():
         print(json.dumps({
             "backend": platform,
             "coldstart": _coldstart_section()}))
+        return
+
+    if args.only == "sharding":
+        print(json.dumps({
+            "backend": platform,
+            "sharding": _sharding_section()}))
         return
     n = 200 if platform != "cpu" else 50
     n_clients = 16

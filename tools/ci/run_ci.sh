@@ -9,6 +9,7 @@
 #   tools/ci/run_ci.sh tests      # per-package matrix only
 #   tools/ci/run_ci.sh chaos      # seeded chaos lane only (-m faults matrix)
 #   tools/ci/run_ci.sh flaky      # retried serving suites only
+#   tools/ci/run_ci.sh multichip  # multichip dryrun gates + sharding bench only
 set -u
 cd "$(dirname "$0")/../.."
 
@@ -45,6 +46,7 @@ PACKAGES=(
   "tests/test_ingest_zero_copy.py"
   "tests/test_fleet.py"
   "tests/test_benchmarks_extended.py"
+  "tests/test_sharding.py"
   "tests/test_multiprocess.py"
   "tests/test_examples.py"
 )
@@ -80,9 +82,16 @@ if [ "$stage" = "flaky" ] || [ "$stage" = "all" ]; then
   [ $ok -ne 0 ] && rc=1
 fi
 
-if [ "$stage" = "all" ]; then
-  echo "=== entry-point smoke (driver contract) ==="
+if [ "$stage" = "multichip" ] || [ "$stage" = "all" ]; then
+  echo "=== entry-point smoke (driver contract: multichip dryrun gates) ==="
+  # the full dryrun battery (DP/FSDP/TP train step, seq/pipe/expert
+  # parallel, GBDT data+sparse parallel, sharded fusion) on 8 and 4
+  # forced virtual CPU devices — keep in sync with ci.yml multichip-smoke
   python __graft_entry__.py || rc=1
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(4)" || rc=1
+  echo "=== sharded-execution bench (1-shard vs N-shard A/B) ==="
+  python tools/bench_serving.py --only sharding || rc=1
+  [ "$stage" = "multichip" ] && exit $rc
 fi
 
 exit $rc

@@ -46,6 +46,8 @@ COLUMNS = (("segment", "segment"), ("batches", "n_batches"),
            ("rows", "rows"), ("ms/batch", "measured_ms_per_batch"),
            ("bound ms", "bound_ms_per_batch"), ("roofline", "roofline_ratio"),
            ("bottleneck", "bottleneck"), ("disp%", "dispatch_share"),
+           ("spec", "partition_spec"),
+           ("coll ms", "collective_ms_per_batch"),
            ("flops/batch", "flops_per_batch"),
            ("bytes/batch", "bytes_per_batch"), ("exemplars", "exemplars"))
 
@@ -98,6 +100,10 @@ def rows_from_fusion(fusion: Dict[str, Any],
         share = (rec.get("stage_share") or {}).get("dispatch")
         if share is not None:
             rec["dispatch_share"] = share
+        if rec.get("spec"):
+            rec["partition_spec"] = (
+                f"{rec['spec']}x{rec['shards']}" if rec.get("shards")
+                else str(rec["spec"]))
         if "flops_per_batch" not in rec and costs.get(label):
             shapes = costs[label]
             for src, dst in (("flops", "flops_per_batch"),
