@@ -57,6 +57,21 @@ _EXTRA_INDEX = [
     "`measure_collectives` (all-reduce/all-gather probe calibration), "
     "`shard_groups` / `submesh_excluding` / `MeshSupervision` "
     "(shard-group quarantine + submesh re-planning)",
+    "- ONNX interchange (`mmlspark_tpu.onnx`, dependency-free protobuf "
+    "subset in `onnx/proto.py`): `import_onnx` (ONNX graph → "
+    "`FunctionModel` with a structural `cache_token()`), `export_onnx` "
+    "(module + params → ONNX bytes), `proto` (eval-free model "
+    "reader/writer: `load_model`, `make_model`, `make_node`, "
+    "`make_tensor`)",
+    "- model lifecycle (`mmlspark_tpu.serving.lifecycle`, hand-maintained "
+    "guide in [docs/lifecycle.md](../lifecycle.md)): `ModelRegistry` / "
+    "`ModelVersion` (versioned states, journaled transitions, two-phase "
+    "`swap_live`), `CanaryController` / `CanaryConfig` (shadow-scored "
+    "ramped rollout gated on SLO burn + divergence, one-step rollback), "
+    "`LifecyclePlane` / `make_lifecycle` (the served data path; "
+    "`serve_pipeline(..., lifecycle=...)`), `OnlineTrainer` / "
+    "`FeedbackJournal` / `VWOnlineAdapter` / `GBDTRefitAdapter` "
+    "(journaled train-on-serve with bitwise-replayable checkpoints)",
 ]
 
 

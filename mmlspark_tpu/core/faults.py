@@ -212,11 +212,20 @@ COMPILECACHE_STORE = "compilecache.store"
 # mesh-aware watchdog. Fires on the SHARDED path only — unsharded
 # bitwise-parity is never perturbed by an armed plan.
 MESH_CHIP_WEDGE = "mesh.chip_wedge"
+# serving/lifecycle registry swap_live, fired BEFORE any registry or
+# executor state mutates: a raising plan is a crash mid-swap and must
+# leave the incumbent serving with the candidate un-promoted
+LIFECYCLE_SWAP = "lifecycle.swap"
+# serving/lifecycle OnlineTrainer, fired before the atomic checkpoint
+# write: a raising plan crashes training at checkpoint k; resume() +
+# journal replay must reproduce the uninterrupted state bitwise
+LIFECYCLE_CHECKPOINT = "lifecycle.checkpoint"
 
 ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
               JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE,
               WORKER_DISPATCH_HANG, WORKER_CRASH, FRONT_HEDGE,
-              COMPILECACHE_LOAD, COMPILECACHE_STORE, MESH_CHIP_WEDGE)
+              COMPILECACHE_LOAD, COMPILECACHE_STORE, MESH_CHIP_WEDGE,
+              LIFECYCLE_SWAP, LIFECYCLE_CHECKPOINT)
 
 
 class InjectedFault(OSError):

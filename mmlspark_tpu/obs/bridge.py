@@ -550,6 +550,78 @@ def _hedge_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
             "hedged / eligible requests (the duplicate-work bound)").add(f)
 
 
+def _lifecycle_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
+    """Model lifecycle state (serving/lifecycle): one series per
+    registered version — state, traffic share, served batches, shadow
+    scoring, burn — mmlspark_model_* per docs/lifecycle.md."""
+    reg = summary.get("registry") or {}
+    versions = reg.get("versions") or []
+    info = MetricFamily(
+        "mmlspark_model_info", "gauge",
+        "registered model versions (1 per version; state as a label)")
+    share = MetricFamily(
+        "mmlspark_model_traffic_share", "gauge",
+        "fraction of real traffic routed to the version")
+    reqs = MetricFamily(
+        "mmlspark_model_requests_total", "counter",
+        "batches served per version by role (live / canary)")
+    scored = MetricFamily(
+        "mmlspark_model_shadow_scored_total", "counter",
+        "shadow rows compared against the incumbent")
+    diverged = MetricFamily(
+        "mmlspark_model_divergence_total", "counter",
+        "shadow rows outside the per-dtype tolerance")
+    burn = MetricFamily(
+        "mmlspark_model_burn_rate", "gauge",
+        "per-version SLO burn rate by window")
+    for v in versions:
+        vid = str(v.get("version"))
+        info.add(1.0, {"version": vid, "state": str(v.get("state")),
+                       "digest": str(v.get("digest"))})
+        f = _num(v.get("traffic_share"))
+        if f is not None:
+            share.add(f, {"version": vid})
+        for role, n in (v.get("requests") or {}).items():
+            f = _num(n)
+            if f is not None:
+                reqs.add(f, {"version": vid, "role": str(role)})
+        shadow = v.get("shadow") or {}
+        f = _num(shadow.get("scored"))
+        if f is not None:
+            scored.add(f, {"version": vid})
+        f = _num(shadow.get("divergent"))
+        if f is not None:
+            diverged.add(f, {"version": vid})
+        for window, rate in (v.get("burn") or {}).items():
+            f = _num(rate)
+            if f is not None:
+                burn.add(f, {"version": vid, "window": str(window)})
+    yield info
+    yield share
+    yield reqs
+    yield scored
+    yield diverged
+    yield burn
+    trans = MetricFamily(
+        "mmlspark_model_transitions_total", "counter",
+        "registry lifecycle actions (register / transition / promote)")
+    for action, n in (reg.get("transitions") or {}).items():
+        f = _num(n)
+        if f is not None:
+            trans.add(f, {"action": str(action)})
+    yield trans
+    canary = summary.get("canary") or {}
+    rolls = MetricFamily(
+        "mmlspark_model_rollouts_total", "counter",
+        "rollout outcomes (started / promoted / rolled_back)")
+    for key, outcome in (("rollouts", "started"), ("promotions", "promoted"),
+                         ("rollbacks", "rolled_back")):
+        f = _num(canary.get(key))
+        if f is not None:
+            rolls.add(f, {"outcome": outcome})
+    yield rolls
+
+
 def fold_server(registry: MetricsRegistry, server: Any) -> None:
     """Register collectors reading a ServingServer's live stats surfaces:
     LatencyStats window + shed counters, the admission queue, wire-format
@@ -594,6 +666,11 @@ def fold_server(registry: MetricsRegistry, server: Any) -> None:
             try:
                 fams.extend(_fleet_families(server._fleet.summary()))
             except Exception:  # noqa: BLE001 — fleet mid-plan
+                pass
+        if getattr(server, "_lifecycle", None) is not None:
+            try:
+                fams.extend(_lifecycle_families(server._lifecycle.summary()))
+            except Exception:  # noqa: BLE001 — rollout mid-transition
                 pass
         if server.ingest_stats is not None:
             try:

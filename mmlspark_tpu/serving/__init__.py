@@ -19,13 +19,18 @@ from .aio import AsyncConnectionPool, AsyncHTTPServer
 from .tenants import TENANT_HEADER, TenantAdmission, tenants_from_spec
 from .supervisor import (BrownoutController, BrownoutStep, DispatchWatchdog,
                          HedgeConfig, HedgeTracker, ReplicaSupervisor)
+from .lifecycle import (CanaryConfig, CanaryController, LifecyclePlane,
+                        ModelRegistry, ModelVersion, OnlineTrainer,
+                        make_lifecycle)
 
 __all__ = ["AdaptiveBatchController", "AsyncConnectionPool",
            "AsyncHTTPServer", "BrownoutController", "BrownoutStep",
-           "DispatchWatchdog", "HedgeConfig", "HedgeTracker",
+           "CanaryConfig", "CanaryController", "DispatchWatchdog",
+           "HedgeConfig", "HedgeTracker", "LifecyclePlane", "ModelRegistry",
+           "ModelVersion", "OnlineTrainer",
            "PipelinedExecutor", "PortForwarder",
            "Replica", "ReplicaSet", "ReplicaSupervisor", "RequestJournal",
            "RoutingFront", "ServingServer", "TENANT_HEADER",
-           "TenantAdmission", "build_ssh_command", "make_reply",
-           "parse_request", "register_worker", "reply_to", "serve_pipeline",
-           "tenants_from_spec"]
+           "TenantAdmission", "build_ssh_command", "make_lifecycle",
+           "make_reply", "parse_request", "register_worker", "reply_to",
+           "serve_pipeline", "tenants_from_spec"]

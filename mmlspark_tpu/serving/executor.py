@@ -285,6 +285,16 @@ class ReplicaSet:
         with self._device_ctx(replica.device):
             return sub(df)
 
+    def swap_transform(self, transform: Callable) -> None:
+        """Install a new transform on every replica. Each batch reads its
+        replica's transform exactly once at dispatch, so a swap changes
+        versions only BETWEEN batches (in-flight work completes on the
+        closure it captured). The lifecycle plane routes through the
+        executor's ``swap_transform`` instead, which takes the dispatch
+        lock first."""
+        for r in self.replicas:
+            r.transform = transform
+
     def describe(self, wall_s: float) -> List[Dict[str, Any]]:
         out = []
         for r in self.replicas:
@@ -419,6 +429,18 @@ class PipelinedExecutor:
                 self._shrink -= 1
                 return
         self._slots.release()
+
+    def swap_transform(self, transform: Callable) -> None:
+        """Atomically install a new served transform (the model-lifecycle
+        promotion swap): the flip happens under the dispatch lock — the
+        same lock the prep-generation registry (``_dispatch``) is guarded
+        by — so it lands between batch registrations, never inside one.
+        Batches already dispatched complete (and are claimed by the
+        readback loop against their registered generation) on the
+        transform they captured; batches registered after the swap run
+        the new one. In-flight work never mixes versions."""
+        with self._lock:
+            self.replicas.swap_transform(transform)
 
     # -- bookkeeping -----------------------------------------------------
     def _mark(self, stage: str, seq: int, t0: float, t1: float,

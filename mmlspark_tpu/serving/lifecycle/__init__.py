@@ -1,0 +1,23 @@
+"""Model lifecycle plane: versioned registry, shadow-scored canary
+rollout with SLO-burn auto-rollback, and journaled train-on-serve.
+
+See ``docs/lifecycle.md`` for the state machine, promotion gates, and
+the feedback wire contract.
+"""
+
+from .canary import (CanaryConfig, CanaryController, LifecyclePlane,
+                     make_lifecycle, score_outputs)
+from .online import (CKPT_FORMAT, LABEL_HEADER, FeedbackJournal,
+                     GBDTRefitAdapter, OnlineTrainer, VWOnlineAdapter)
+from .registry import (CANARY, CANDIDATE, LIVE, RETIRED, ROLLED_BACK,
+                       SHADOWING, STATES, ModelRegistry, ModelVersion,
+                       structural_digest)
+
+__all__ = [
+    "CANARY", "CANDIDATE", "CKPT_FORMAT", "CanaryConfig",
+    "CanaryController", "FeedbackJournal", "GBDTRefitAdapter",
+    "LABEL_HEADER", "LIVE", "LifecyclePlane", "ModelRegistry",
+    "ModelVersion", "OnlineTrainer", "RETIRED", "ROLLED_BACK",
+    "SHADOWING", "STATES", "VWOnlineAdapter", "make_lifecycle",
+    "score_outputs", "structural_digest",
+]

@@ -1,0 +1,642 @@
+"""Shadow-scored canary rollout with SLO-burn auto-rollback.
+
+Two cooperating pieces turn the registry's state machine into a rollout
+engine:
+
+``CanaryController``
+    The decision loop (ticked from the server's tuner heartbeat, the
+    brownout/fleet idiom): a candidate moves ``shadowing -> canary ->
+    live`` with every step gated on evidence — shadow divergence counters
+    first, then per-version SLO burn-rate buckets at each traffic step of
+    the ramp (1 -> 5 -> 25 -> 100% by default). Any breach triggers a
+    one-step rollback to the incumbent; every decision lands in a bounded
+    journal like the tuner's and the fleet's.
+
+``LifecyclePlane``
+    The data path: the plane *is* the served transform (installed in
+    front of the replica set), so routing is a per-batch decision made
+    exactly once — a batch resolves its version at dispatch and never
+    mixes versions mid-flight. During the shadow phase a sampled fraction
+    of real traffic is duplicated to the candidate on a bounded queue
+    drained by a background worker (the hedged-issue discipline: the
+    incumbent's reply always wins, the shadow reply is scored against it
+    — bitwise for integer/bytes payloads, per-dtype tolerance for floats
+    — and discarded, never fulfilled to a client). Unknown attribute
+    reads forward to the live version's transform, so fleet/tuner
+    introspection (``mega_k`` and friends) sees the incumbent unchanged.
+
+Promotion is zero-compile by construction: the controller runs the warm
+hook (fleet persistent-cache warm of the candidate's executables) BEFORE
+``ModelRegistry.swap_live`` flips traffic.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...obs import perf as obs_perf
+from ...obs import trace as obs_trace
+from .online import LABEL_HEADER
+from .registry import (CANARY, LIVE, ROLLED_BACK, SHADOWING,
+                       ModelRegistry, ModelVersion)
+
+__all__ = ["CanaryConfig", "CanaryController", "LifecyclePlane",
+           "make_lifecycle", "score_outputs"]
+
+
+@dataclass(frozen=True)
+class CanaryConfig:
+    """Rollout policy knobs (all gates are per-version evidence)."""
+
+    #: fraction of real batches duplicated to a shadowing candidate
+    shadow_fraction: float = 0.1
+    #: rows the shadow scorer must compare before the canary phase opens
+    shadow_min_scored: int = 32
+    #: ramped traffic shares; each step holds until its gate passes
+    steps: Tuple[float, ...] = (0.01, 0.05, 0.25, 1.0)
+    #: minimum wall-clock residence at a step before it can advance
+    hold_s: float = 30.0
+    #: minimum canary batches served at a step before it can advance
+    min_step_requests: int = 8
+    #: max tolerated SLO burn rate (any window) for the candidate
+    burn_gate: float = 1.0
+    #: max tolerated shadow divergence rate (0.0 = bitwise-or-tolerance)
+    divergence_gate: float = 0.0
+    #: controller tick rate limit (the tuner heartbeat is per-batch)
+    check_interval_s: float = 1.0
+    #: float-dtype shadow comparison tolerance (non-floats are bitwise)
+    float_rtol: float = 1e-5
+    float_atol: float = 1e-6
+    #: per-version SLO buckets (the burn gate's denominator)
+    objective_ms: float = 250.0
+    slo_target: float = 0.99
+    slo_windows_s: Tuple[float, ...] = (60.0, 300.0, 3600.0)
+    #: routing RNG seed — rollouts are replayable decisions
+    seed: int = 0
+    journal_cap: int = 256
+
+
+# ---------------------------------------------------------------------------
+# Shadow scoring
+# ---------------------------------------------------------------------------
+
+def _rows_equal(a: Any, b: Any, rtol: float, atol: float) -> bool:
+    """Bitwise for integer/bytes/object payloads, tolerance for floats."""
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        aa, ba = np.asarray(a), np.asarray(b)
+        if aa.shape != ba.shape or aa.dtype != ba.dtype:
+            return False
+        if np.issubdtype(aa.dtype, np.inexact):
+            return bool(np.allclose(aa, ba, rtol=rtol, atol=atol,
+                                    equal_nan=True))
+        return bool(np.array_equal(aa, ba))
+    if isinstance(a, float) and isinstance(b, float):
+        return bool(np.isclose(a, b, rtol=rtol, atol=atol, equal_nan=True))
+    try:
+        return bool(a == b)
+    except Exception:  # noqa: BLE001 — incomparable payloads diverge
+        return False
+
+
+def _reply_rows(out: Any, reply_col: str):
+    """(ids, replies) from a transform output — the _apply_output
+    contract (id + reply columns); positional ids when absent."""
+    coll = getattr(out, "collect", None)
+    data = coll() if callable(coll) else None
+    if data is None:
+        if isinstance(out, dict):
+            data = out
+        else:
+            arr = np.asarray(out)
+            return list(range(len(arr))), list(arr)
+    if reply_col not in data:
+        return [], []
+    replies = list(data[reply_col])
+    ids = list(data["id"]) if "id" in data else list(range(len(replies)))
+    return ids, replies
+
+
+def score_outputs(expected: Any, actual: Any, *, reply_col: str = "reply",
+                  rtol: float = 1e-5, atol: float = 1e-6
+                  ) -> Tuple[int, int]:
+    """Compare a candidate's output against the incumbent's for the same
+    batch; returns ``(scored, divergent)`` row counts. Rows pair by the
+    ``id`` column when both outputs carry one (positionally otherwise);
+    rows present on one side only count as divergent."""
+    try:
+        e_ids, e_rows = _reply_rows(expected, reply_col)
+        a_ids, a_rows = _reply_rows(actual, reply_col)
+    except Exception:  # noqa: BLE001 — unreadable output scores nothing
+        return 0, 0
+    amap = {int(i): r for i, r in zip(a_ids, a_rows)}
+    scored = divergent = 0
+    for i, row in zip(e_ids, e_rows):
+        scored += 1
+        other = amap.pop(int(i), None)
+        if other is None or not _rows_equal(row, other, rtol, atol):
+            divergent += 1
+    # candidate rows with no incumbent counterpart are divergence too
+    scored += len(amap)
+    divergent += len(amap)
+    return scored, divergent
+
+
+# ---------------------------------------------------------------------------
+# Controller
+# ---------------------------------------------------------------------------
+
+class CanaryController:
+    """Gated rollout decision loop over one active candidate at a time.
+
+    ``rollout(version)`` arms a candidate; ``check()`` (rate-limited,
+    called from the tuner heartbeat) walks it through the state machine:
+
+      shadowing  gate: >= shadow_min_scored rows compared, zero shadow
+                 errors, divergence rate within ``divergence_gate``
+      canary[i]  gate: >= hold_s at the step AND >= min_step_requests
+                 canary batches AND max burn rate <= burn_gate
+      promote    warm hook first (zero-compile), then the registry's
+                 two-phase ``swap_live``
+
+    Any breach rolls the candidate back in ONE step — traffic share to
+    zero, state ``rolled_back`` — with the evidence journaled. A swap
+    failure (crash seam) journals and leaves the incumbent serving; the
+    promotion retries on the next tick.
+    """
+
+    def __init__(self, registry: ModelRegistry, config: CanaryConfig, *,
+                 apply_swap: Optional[Callable[[ModelVersion,
+                                                Optional[ModelVersion]],
+                                               None]] = None,
+                 warm: Optional[Callable[[ModelVersion], Any]] = None,
+                 clock=time.monotonic):
+        self.registry = registry
+        self.config = config
+        self._apply_swap = apply_swap
+        self._warm = warm
+        self._clock = clock
+        self._active: Optional[str] = None
+        self._step = -1            # -1 = shadowing
+        self._step_t0 = 0.0
+        self._step_req0 = 0
+        self._last_check = 0.0
+        self.rollouts = 0
+        self.promotions = 0
+        self.rollbacks = 0
+        #: bounded decision journal (brownout/fleet idiom)
+        self.journal: List[Dict[str, Any]] = []
+        self._journal_cap = max(8, int(config.journal_cap))
+
+    def _log(self, action: str, **info: Any) -> None:
+        if len(self.journal) >= self._journal_cap:
+            del self.journal[: self._journal_cap // 4]
+        self.journal.append({"action": action,
+                             "t": round(self._clock(), 3), **info})
+
+    # -- introspection ---------------------------------------------------
+    def active_version(self) -> Optional[ModelVersion]:
+        vid = self._active
+        if vid is None:
+            return None
+        try:
+            return self.registry.get(vid)
+        except KeyError:
+            return None
+
+    def shadow_target(self) -> Optional[ModelVersion]:
+        ver = self.active_version()
+        return ver if ver is not None and ver.state == SHADOWING else None
+
+    # -- rollout entry ---------------------------------------------------
+    def rollout(self, version: str) -> ModelVersion:
+        """Arm ``version`` (a registered candidate) for rollout. Only one
+        rollout runs at a time; shadow is skipped when the config disables
+        it (shadow_fraction or shadow_min_scored <= 0)."""
+        if self._active is not None:
+            raise ValueError(
+                f"rollout already active for {self._active!r}")
+        shadow = (self.config.shadow_fraction > 0.0
+                  and self.config.shadow_min_scored > 0)
+        ver = self.registry.transition(
+            version, SHADOWING if shadow else CANARY)
+        self._active = version
+        self.rollouts += 1
+        if shadow:
+            self._step = -1
+            self._log("shadow_start", version=version,
+                      fraction=self.config.shadow_fraction)
+        else:
+            self._enter_step(ver, 0)
+        return ver
+
+    def _enter_step(self, ver: ModelVersion, step: int) -> None:
+        share = float(self.config.steps[step])
+        self._step = step
+        self._step_t0 = self._clock()
+        self._step_req0 = ver.requests["canary"]
+        ver.traffic_share = share
+        self._log("canary_step", version=ver.version, step=step,
+                  share=share)
+
+    # -- the gated walk --------------------------------------------------
+    def check(self) -> None:
+        """Rate-limited gate evaluation; never raises (a failed swap is
+        journaled and retried, everything else is state inspection)."""
+        now = self._clock()
+        if now - self._last_check < self.config.check_interval_s:
+            return
+        self._last_check = now
+        ver = self.active_version()
+        if ver is None:
+            self._active = None
+            return
+        if ver.state == SHADOWING:
+            self._check_shadow(ver)
+        elif ver.state == CANARY:
+            self._check_canary(ver, now)
+        else:
+            # promoted or externally transitioned — rollout is over
+            self._active = None
+
+    def _check_shadow(self, ver: ModelVersion) -> None:
+        if ver.shadow_errors > 0:
+            self.rollback(ver, "shadow_errors",
+                          errors=ver.shadow_errors)
+            return
+        if ver.shadow_scored < self.config.shadow_min_scored:
+            return
+        div = ver.divergence_rate()
+        if div > self.config.divergence_gate:
+            self.rollback(ver, "divergence", divergence=round(div, 6),
+                          scored=ver.shadow_scored)
+            return
+        self.registry.transition(ver.version, CANARY,
+                                 scored=ver.shadow_scored,
+                                 divergence=round(div, 6))
+        self._enter_step(ver, 0)
+
+    def _check_canary(self, ver: ModelVersion, now: float) -> None:
+        served = ver.requests["canary"] - self._step_req0
+        burn = ver.max_burn()
+        # breach check runs every tick — rollback must not wait for hold_s
+        if served >= self.config.min_step_requests \
+                and burn > self.config.burn_gate:
+            self.rollback(ver, "slo_burn", burn=round(burn, 4),
+                          step=self._step, served=served)
+            return
+        div = ver.divergence_rate()
+        if div > self.config.divergence_gate:
+            self.rollback(ver, "divergence", divergence=round(div, 6),
+                          step=self._step)
+            return
+        if now - self._step_t0 < self.config.hold_s \
+                or served < self.config.min_step_requests:
+            return
+        if self._step + 1 < len(self.config.steps):
+            self._enter_step(ver, self._step + 1)
+        else:
+            self._promote(ver, burn)
+
+    def _promote(self, ver: ModelVersion, burn: float) -> None:
+        # warm BEFORE traffic: the fleet hook stages the candidate's
+        # executables into the persistent compile cache so the swap costs
+        # zero jit compiles. Best-effort — a cold promotion is journaled,
+        # not blocked.
+        warmed: Any = None
+        try:
+            if self._warm is not None:
+                warmed = self._warm(ver)
+            elif callable(ver.warm):
+                warmed = ver.warm()
+        except Exception as e:  # noqa: BLE001 — warm is an optimization
+            warmed = f"error: {e}"
+        self._log("warm", version=ver.version, result=str(warmed))
+        try:
+            self.registry.swap_live(ver.version, apply=self._apply_swap,
+                                    burn=round(burn, 4))
+        except Exception as e:  # noqa: BLE001 — crash seam: incumbent
+            # keeps serving, the promotion retries on the next tick
+            self._log("swap_failed", version=ver.version, error=str(e))
+            return
+        self.promotions += 1
+        self._log("promote", version=ver.version)
+        self._active = None
+
+    def rollback(self, ver: ModelVersion, reason: str, **info: Any) -> None:
+        """One-step rollback: the candidate stops taking traffic and the
+        incumbent (which never stopped serving) carries 100% again."""
+        ver.traffic_share = 0.0
+        self.registry.transition(ver.version, ROLLED_BACK, reason=reason,
+                                 **info)
+        self.rollbacks += 1
+        self._log("rollback", version=ver.version, reason=reason, **info)
+        self._active = None
+
+    def summary(self) -> Dict[str, Any]:
+        ver = self.active_version()
+        return {"active": self._active, "step": self._step,
+                "state": ver.state if ver is not None else None,
+                "rollouts": self.rollouts, "promotions": self.promotions,
+                "rollbacks": self.rollbacks,
+                "journal": list(self.journal[-16:])}
+
+
+# ---------------------------------------------------------------------------
+# The plane: lifecycle-aware served transform
+# ---------------------------------------------------------------------------
+
+class LifecyclePlane:
+    """The lifecycle data path, installed AS the server's transform.
+
+    Each batch resolves its version exactly once (at dispatch), so a
+    promotion swap changes versions only between batches — the executor's
+    prep-generation guard then guarantees completions claim against the
+    dispatch that issued them. Real traffic is accounted per version
+    (batch counters + SLO burn buckets); shadow duplicates ride a bounded
+    queue to a background worker and are scored, never fulfilled.
+    """
+
+    def __init__(self, config: Optional[CanaryConfig] = None, *,
+                 hooks: Optional[Dict[str, Any]] = None,
+                 clock=time.monotonic):
+        cfg = config if config is not None else CanaryConfig()
+        self.config = cfg
+        self._hooks = dict(hooks or {})
+        self._clock = clock
+        slo_cfg = obs_perf.SLOConfig(
+            name="lifecycle", objective_ms=cfg.objective_ms,
+            target=cfg.slo_target, windows_s=tuple(cfg.slo_windows_s))
+        self.registry = ModelRegistry(slo_config=slo_cfg,
+                                      journal_cap=cfg.journal_cap,
+                                      clock=clock)
+        self.controller = CanaryController(
+            self.registry, cfg, apply_swap=self._apply_swap,
+            warm=self._hooks.get("warm"), clock=clock)
+        self._server: Any = None
+        self._reply_col = "reply"
+        self._rng = random.Random(cfg.seed)
+        self._rng_lock = threading.Lock()
+        # bounded shadow queue: duplicates ride idle capacity or drop
+        self._shadow_q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._shadow_stop = threading.Event()
+        self._shadow_thread: Optional[threading.Thread] = None
+        self.shadow_skipped = 0
+        self._online: Any = None
+
+    # -- attribute forwarding: fleet/tuner introspection (mega_k, ...)
+    # sees the live version's transform through the plane
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        reg = self.__dict__.get("registry")
+        live = reg.live if reg is not None else None
+        if live is None:
+            raise AttributeError(name)
+        return getattr(live.transform, name)
+
+    # -- wiring ----------------------------------------------------------
+    def bind(self, server: Any) -> "LifecyclePlane":
+        """Adopt ``server.transform`` as the live incumbent and return the
+        plane (the server installs the return value as its transform)."""
+        self._server = server
+        self._reply_col = getattr(server, "reply_col", "reply")
+        if self.registry.live is None:
+            self.registry.adopt_live(
+                server.transform,
+                version=self._hooks.get("live_version"),
+                stage=self._hooks.get("live_stage"),
+                cost=self._hooks.get("live_cost"))
+        return self
+
+    def start(self) -> None:
+        if self._shadow_thread is None:
+            self._shadow_stop.clear()
+            self._shadow_thread = threading.Thread(
+                target=self._shadow_loop, name="mmlspark-lifecycle-shadow",
+                daemon=True)
+            self._shadow_thread.start()
+
+    def stop(self) -> None:
+        self._shadow_stop.set()
+        t = self._shadow_thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._shadow_thread = None
+        ot = self._online
+        if ot is not None:
+            try:
+                ot.stop()
+            except Exception:  # noqa: BLE001 — shutdown stays best-effort
+                pass
+
+    def tick(self, e2e_s: float) -> None:  # noqa: ARG002 — heartbeat shape
+        self.controller.check()
+        ot = self._online
+        if ot is not None:
+            ot.tick()
+
+    # -- model management ------------------------------------------------
+    def register(self, transform: Callable, **kwargs: Any) -> ModelVersion:
+        return self.registry.register(transform, **kwargs)
+
+    def rollout(self, version: str) -> ModelVersion:
+        return self.controller.rollout(version)
+
+    def deploy(self, transform: Callable, **kwargs: Any) -> ModelVersion:
+        """register + rollout in one move (the online trainer's handoff)."""
+        ver = self.register(transform, **kwargs)
+        return self.rollout(ver.version)
+
+    def attach_online(self, trainer: Any) -> None:
+        self._online = trainer
+
+    def feed_feedback(self, rows, labels) -> int:
+        """Forward labeled feedback rows to the online trainer (0 when
+        train-on-serve is not attached)."""
+        ot = self._online
+        if ot is None:
+            return 0
+        return int(ot.feed(rows, labels))
+
+    # -- swap apply: the executor-guarded flip ---------------------------
+    def _apply_swap(self, new: ModelVersion,
+                    old: Optional[ModelVersion]) -> None:
+        """Serialize the live flip with batch dispatch: re-install the
+        plane on every replica under the executor's dispatch lock (the
+        same lock the prep-generation registry uses), so the swap lands
+        between batch registrations, never inside one."""
+        srv = self._server
+        ex = getattr(srv, "_executor", None) if srv is not None else None
+        if ex is not None:
+            ex.swap_transform(self)
+
+    # -- routing ---------------------------------------------------------
+    def _route(self) -> Tuple[ModelVersion, str]:
+        cand = self.controller.active_version()
+        if cand is not None and cand.state == CANARY:
+            share = cand.traffic_share
+            if share > 0.0:
+                with self._rng_lock:
+                    r = self._rng.random()
+                if r < share:
+                    return cand, "canary"
+        live = self.registry.live
+        if live is None:
+            raise RuntimeError("lifecycle plane has no live version")
+        return live, "live"
+
+    def _account(self, ver: ModelVersion, role: str, dur_s: float,
+                 t0_wall: float, cb) -> None:
+        ver.requests[role] += 1
+        if ver.slo is not None:
+            try:
+                ver.slo.record(dur_s)
+            except Exception:  # noqa: BLE001 — accounting never kills serving
+                pass
+        if role == "canary" and cb is not None:
+            tracer, ctxs = cb
+            tracer.record_batch("lifecycle.canary", ctxs, t0_wall, dur_s,
+                                version=ver.version)
+
+    # -- data path -------------------------------------------------------
+    def __call__(self, df: Any) -> Any:
+        ver, role = self._route()
+        self._maybe_feedback(df)
+        cb = obs_trace.current_batch()
+        t0w, t0p = time.time(), time.perf_counter()
+        out = ver.transform(df)
+        self._account(ver, role, time.perf_counter() - t0p, t0w, cb)
+        self._maybe_shadow(df, out, cb)
+        return out
+
+    def submit(self, df: Any):
+        """Async-dispatch face (the ReplicaSet contract): returns a
+        zero-arg resolve, or None to make the caller fall back to the
+        synchronous ``run`` path (which re-routes in ``__call__`` — the
+        declined draw is never accounted)."""
+        ver, role = self._route()
+        sub = getattr(ver.transform, "submit", None)
+        pending = sub(df) if sub is not None else None
+        if pending is None:
+            return None
+        self._maybe_feedback(df)
+        cb = obs_trace.current_batch()
+        t0w, t0p = time.time(), time.perf_counter()
+
+        def _resolve():
+            out = pending()
+            self._account(ver, role, time.perf_counter() - t0p, t0w, cb)
+            self._maybe_shadow(df, out, cb)
+            return out
+
+        return _resolve
+
+    # -- feedback extraction (X-MMLSpark-Label wire contract) ------------
+    def _maybe_feedback(self, df: Any) -> None:
+        if self._online is None:
+            return
+        try:
+            if "headers" not in getattr(df, "columns", ()):
+                return
+            hs = df.column("headers")
+            vs = df.column("value")
+        except Exception:  # noqa: BLE001 — non-ingress frame shapes
+            return
+        rows, labels = [], []
+        for h, v in zip(hs, vs):
+            if not isinstance(h, dict):
+                continue
+            lab = next((val for k, val in h.items()
+                        if k.lower() == LABEL_HEADER.lower()), None)
+            if lab is None:
+                continue
+            try:
+                body = v if isinstance(v, str) \
+                    else bytes(v).decode("utf-8")
+                rows.append(json.loads(body))
+                labels.append(float(lab))
+            except Exception:  # noqa: BLE001 — malformed feedback skipped
+                continue
+        if rows:
+            self.feed_feedback(rows, labels)
+
+    # -- shadow ----------------------------------------------------------
+    def _maybe_shadow(self, df: Any, live_out: Any, cb) -> None:
+        cand = self.controller.shadow_target()
+        if cand is None:
+            return
+        with self._rng_lock:
+            r = self._rng.random()
+        if r >= self.config.shadow_fraction:
+            return
+        try:
+            self._shadow_q.put_nowait((cand, df, live_out, cb))
+            cand.shadow_issued += 1
+        except queue.Full:
+            # no idle capacity — drop the duplicate, never block serving
+            self.shadow_skipped += 1
+
+    def _shadow_loop(self) -> None:
+        while not self._shadow_stop.is_set():
+            try:
+                cand, df, live_out, cb = self._shadow_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            t0w, t0p = time.time(), time.perf_counter()
+            try:
+                out = cand.transform(df)
+            except Exception:  # noqa: BLE001 — candidate failures are gate
+                # evidence, not serving failures
+                cand.shadow_errors += 1
+                continue
+            dur = time.perf_counter() - t0p
+            scored, divergent = score_outputs(
+                live_out, out, reply_col=self._reply_col,
+                rtol=self.config.float_rtol, atol=self.config.float_atol)
+            cand.shadow_scored += scored
+            cand.shadow_divergent += divergent
+            if cb is not None:
+                tracer, ctxs = cb
+                tracer.record_batch("lifecycle.shadow", ctxs, t0w, dur,
+                                    version=cand.version, scored=scored,
+                                    divergent=divergent)
+
+    # -- introspection ---------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        out = {"registry": self.registry.summary(),
+               "canary": self.controller.summary(),
+               "shadow_skipped": self.shadow_skipped}
+        ot = self._online
+        if ot is not None:
+            try:
+                out["online"] = ot.summary()
+            except Exception:  # noqa: BLE001 — introspection best-effort
+                pass
+        return out
+
+
+def make_lifecycle(spec: Any, hooks: Optional[Dict[str, Any]] = None,
+                   clock=time.monotonic) -> Optional[LifecyclePlane]:
+    """Coerce the server's ``lifecycle=`` knob: None/False -> off, True ->
+    defaults, dict -> CanaryConfig kwargs, CanaryConfig -> as-is, a
+    LifecyclePlane passes through (pre-wired planes keep their hooks)."""
+    if spec is None or spec is False:
+        return None
+    if isinstance(spec, LifecyclePlane):
+        return spec
+    if spec is True:
+        return LifecyclePlane(CanaryConfig(), hooks=hooks, clock=clock)
+    if isinstance(spec, CanaryConfig):
+        return LifecyclePlane(spec, hooks=hooks, clock=clock)
+    if isinstance(spec, dict):
+        return LifecyclePlane(CanaryConfig(**spec), hooks=hooks,
+                              clock=clock)
+    raise TypeError(f"lifecycle: cannot coerce {type(spec).__name__}")

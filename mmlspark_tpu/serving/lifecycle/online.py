@@ -1,0 +1,461 @@
+"""Train-on-serve: journal-backed online updates feeding the canary plane.
+
+Labeled feedback arrives two ways — in-band on a prediction request (the
+``X-MMLSpark-Label`` header: the body is the example, the header its
+label) or batched through a ``POST /_mmlspark/feedback`` of
+``{"rows": [...], "labels": [...]}``. Either path lands every example in
+an append-only fsynced JSONL journal BEFORE any training sees it, so the
+training fold is a pure replay of the journal:
+
+  - ``OnlineTrainer`` consumes the journal in fixed-size batches grouped
+    by ABSOLUTE example index (step k always covers rows
+    ``[k*batch_rows, (k+1)*batch_rows)``), folding each batch into
+    adapter-owned state on a background thread (or driven explicitly via
+    ``train_pending`` — the tests' deterministic mode).
+  - Every ``checkpoint_every`` steps the adapter state is serialized
+    through the PR 2 atomic-checkpoint machinery (tmp + fsync +
+    ``os.replace``), with the ``lifecycle.checkpoint`` chaos seam fired
+    first: a crash mid-checkpoint leaves the previous checkpoint intact,
+    and ``resume()`` + journal replay reproduces the uninterrupted run's
+    state bitwise.
+  - Finished candidates hand off to the canary pipeline
+    (``plane.deploy`` = register + rollout) once ``publish_after`` new
+    examples have been folded.
+
+First adapters: the VW linear learner (``vw/learner.LinearLearner``
+incremental scan steps) and GBDT refit (state = the bounded labeled-row
+buffer; the model is a pure function of it).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...core import faults
+from ...core.faults import atomic_write_text
+
+__all__ = ["LABEL_HEADER", "CKPT_FORMAT", "FeedbackJournal",
+           "VWOnlineAdapter", "GBDTRefitAdapter", "OnlineTrainer"]
+
+#: in-band feedback: a prediction request carrying this header is ALSO a
+#: labeled training example (body = features, header value = label)
+LABEL_HEADER = "X-MMLSpark-Label"
+
+CKPT_FORMAT = "mmlspark_tpu.lifecycle.ckpt.v1"
+
+
+def _arr_to_json(a: np.ndarray) -> Dict[str, Any]:
+    a = np.ascontiguousarray(a)
+    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def _arr_from_json(d: Dict[str, Any]) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(d["b64"]),
+                         dtype=d["dtype"]).reshape(d["shape"]).copy()
+
+
+class FeedbackJournal:
+    """Append-only JSONL of labeled examples, one ``{"row","label"}``
+    object per line, fsynced per append call (the write-ahead contract:
+    an example is journaled before any trainer state reflects it)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._count = 0
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as fh:
+                self._count = sum(1 for line in fh if line.strip())
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def append(self, rows, labels) -> int:
+        if len(rows) != len(labels):
+            raise ValueError(
+                f"rows/labels length mismatch: {len(rows)} vs {len(labels)}")
+        lines = [json.dumps({"row": r, "label": float(lab)})
+                 for r, lab in zip(rows, labels)]
+        if not lines:
+            return 0
+        with self._lock:
+            self._fh.write("\n".join(lines) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._count += len(lines)
+        return len(lines)
+
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def read(self, start: int, limit: int) -> List[Tuple[Any, float]]:
+        """Examples ``[start, start+limit)`` in append order (a replay
+        read — opens its own handle, never moves the append position)."""
+        out: List[Tuple[Any, float]] = []
+        with open(self.path, encoding="utf-8") as fh:
+            for i, line in enumerate(ln for ln in fh if ln.strip()):
+                if i < start:
+                    continue
+                if len(out) >= limit:
+                    break
+                d = json.loads(line)
+                out.append((d["row"], float(d["label"])))
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            fh, self._fh = self._fh, None
+        if fh is not None:
+            try:
+                fh.close()
+            except Exception:  # noqa: BLE001 — close is best-effort
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Adapters — the online contract:
+#   fresh() -> state
+#   step(state, rows, labels) -> state     (deterministic fold)
+#   to_json(state) -> dict / from_json(dict) -> state   (bitwise round-trip)
+#   make_transform(state, reply_col) -> served transform (optional)
+# ---------------------------------------------------------------------------
+
+class VWOnlineAdapter:
+    """The VW linear learner as an online adapter: rows are sparse dicts
+    ``{"indices": [...], "values": [...]}``, state is the learner's
+    (weights + optimizer accumulators + lr clock) tuple — incremental
+    scan steps via ``LinearLearner.partial_fit``, always the jax scan
+    path (the native engine keeps state in C++ and cannot round-trip
+    bitwise through a checkpoint)."""
+
+    name = "vw"
+
+    def __init__(self, config=None):
+        from ...vw.learner import LearnerConfig
+
+        self.config = config if config is not None else LearnerConfig()
+
+    def fresh(self):
+        from ...vw.learner import LinearLearner
+
+        return LinearLearner(self.config)
+
+    def step(self, learner, rows, labels):
+        learner.partial_fit(rows, labels)
+        return learner
+
+    def to_json(self, learner) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, v in learner.state_dict().items():
+            out[k] = _arr_to_json(v) if isinstance(v, np.ndarray) else v
+        return out
+
+    def from_json(self, d: Dict[str, Any]):
+        from ...vw.learner import LinearLearner
+
+        sd = {k: (_arr_from_json(v)
+                  if isinstance(v, dict) and "b64" in v else v)
+              for k, v in d.items()}
+        return LinearLearner(self.config).load_state_dict(sd)
+
+    def make_transform(self, learner, reply_col: str = "reply"):
+        """Freeze the current weights into a served transform: each
+        request body is a sparse-row JSON, the reply its linear score."""
+        w = np.array(learner.weights)  # snapshot — the version is immutable
+        num_bits = self.config.num_bits
+
+        def transform(df):
+            from ...core.dataframe import DataFrame
+            from ...vw.learner import SparseDataset, predict_linear
+
+            data = df.collect()
+            bodies = data["value"]
+            rows = []
+            for b in bodies:
+                body = b if isinstance(b, str) else bytes(b).decode("utf-8")
+                rows.append(json.loads(body))
+            ds = SparseDataset.from_rows(
+                rows, np.zeros(len(rows)), num_bits=num_bits)
+            preds = predict_linear(w, ds)
+            return DataFrame.from_dict(
+                {"id": np.asarray(data["id"]),
+                 reply_col: [float(p) for p in preds]})
+
+        return transform
+
+
+class GBDTRefitAdapter:
+    """GBDT as an online adapter by bounded-buffer refit: state is the
+    labeled row buffer itself (rows are dense feature lists, or sparse
+    dicts whose ``values`` are taken dense), and the model is a pure
+    function of the buffer — refit at publish time. Resume is trivially
+    bitwise: replaying the journal rebuilds the identical buffer."""
+
+    name = "gbdt"
+
+    def __init__(self, params=None, max_rows: int = 4096):
+        self.params = params
+        self.max_rows = max(1, int(max_rows))
+
+    @staticmethod
+    def _dense(row) -> List[float]:
+        vals = row.get("values", row) if isinstance(row, dict) else row
+        if isinstance(vals, (int, float)):
+            return [float(vals)]  # scalar feature (header-labeled requests)
+        return [float(x) for x in vals]
+
+    def fresh(self) -> Dict[str, list]:
+        return {"X": [], "y": []}
+
+    def step(self, state, rows, labels):
+        for r, lab in zip(rows, labels):
+            state["X"].append(self._dense(r))
+            state["y"].append(float(lab))
+        overflow = len(state["y"]) - self.max_rows
+        if overflow > 0:
+            del state["X"][:overflow]
+            del state["y"][:overflow]
+        return state
+
+    def to_json(self, state) -> Dict[str, Any]:
+        return {"X": state["X"], "y": state["y"]}
+
+    def from_json(self, d: Dict[str, Any]):
+        return {"X": [[float(x) for x in r] for r in d["X"]],
+                "y": [float(v) for v in d["y"]]}
+
+    def fit(self, state):
+        """Refit a Booster on the current buffer (the publish step)."""
+        from ...gbdt.booster import TrainParams, train
+
+        params = self.params if self.params is not None else TrainParams(
+            num_iterations=20, num_leaves=15, min_data_in_leaf=1)
+        X = np.asarray(state["X"], dtype=np.float64)
+        y = np.asarray(state["y"], dtype=np.float64)
+        return train(params, X, y)
+
+    def make_transform(self, state, reply_col: str = "reply"):
+        if not state["y"]:
+            return None
+        booster = self.fit(state)
+
+        def transform(df):
+            from ...core.dataframe import DataFrame
+
+            data = df.collect()
+            bodies = data["value"]
+            rows = []
+            for b in bodies:
+                body = b if isinstance(b, str) else bytes(b).decode("utf-8")
+                rows.append(GBDTRefitAdapter._dense(json.loads(body)))
+            preds = booster.raw_predict(np.asarray(rows, dtype=np.float64))
+            return DataFrame.from_dict(
+                {"id": np.asarray(data["id"]),
+                 reply_col: [float(p) for p in np.asarray(preds).ravel()]})
+
+        return transform
+
+
+# ---------------------------------------------------------------------------
+# The trainer
+# ---------------------------------------------------------------------------
+
+class OnlineTrainer:
+    """Journal-replay trainer: deterministic fold, atomic checkpoints,
+    canary handoff. See the module docstring for the replay contract."""
+
+    def __init__(self, adapter, journal_path: str,
+                 checkpoint_path: Optional[str] = None, *,
+                 batch_rows: int = 32, checkpoint_every: int = 1,
+                 publish_after: int = 0, version_prefix: str = "online",
+                 reply_col: str = "reply", poll_s: float = 0.25,
+                 auto: bool = False, clock=time.monotonic):
+        self.adapter = adapter
+        self.journal = FeedbackJournal(journal_path)
+        self.checkpoint_path = checkpoint_path \
+            if checkpoint_path is not None else journal_path + ".ckpt"
+        self.batch_rows = max(1, int(batch_rows))
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        #: publish a candidate to the plane every this-many folded
+        #: examples (0 = never publish automatically)
+        self.publish_after = int(publish_after)
+        self.version_prefix = version_prefix
+        self.reply_col = reply_col
+        self._clock = clock
+        self._plane: Any = None
+        # serializes the fold/checkpoint/publish path; feed() only touches
+        # the journal's own lock, so ingestion never waits on training
+        # re-entrant: publish() serializes against training but is also
+        # called from _maybe_publish inside the train_pending fold
+        self._train_lock = threading.RLock()
+        self.state = adapter.fresh()
+        self.step = 0
+        self.consumed = 0
+        self.published = 0
+        self.publish_failed = 0
+        self._published_at = 0
+        self._poll_s = float(poll_s)
+        self._auto = bool(auto)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wiring ----------------------------------------------------------
+    def attach_plane(self, plane) -> "OnlineTrainer":
+        self._plane = plane
+        plane.attach_online(self)
+        return self
+
+    # -- ingestion -------------------------------------------------------
+    def feed(self, rows, labels) -> int:
+        """Journal labeled examples (write-ahead: returns once fsynced)."""
+        return self.journal.append(rows, labels)
+
+    def pending(self) -> int:
+        return self.journal.count() - self.consumed
+
+    # -- training --------------------------------------------------------
+    def train_pending(self, max_steps: Optional[int] = None,
+                      flush: bool = False) -> int:
+        """Fold journaled examples in absolute-index batches; returns the
+        number of steps taken. Only full batches fold (``flush=True``
+        takes the partial tail too — NOT bitwise-stable across resumes,
+        since a later run may see the tail as part of a full batch)."""
+        done = 0
+        with self._train_lock:
+            while max_steps is None or done < max_steps:
+                avail = self.journal.count() - self.consumed
+                take = self.batch_rows if avail >= self.batch_rows \
+                    else (avail if flush and avail > 0 else 0)
+                if take == 0:
+                    break
+                recs = self.journal.read(self.consumed, take)
+                self.state = self.adapter.step(
+                    self.state, [r for r, _ in recs],
+                    [lab for _, lab in recs])
+                self.consumed += len(recs)
+                self.step += 1
+                done += 1
+                if self.step % self.checkpoint_every == 0:
+                    self._checkpoint()
+            if done:
+                self._maybe_publish()
+        return done
+
+    def _checkpoint(self) -> None:
+        # chaos seam BEFORE the write: a crash here leaves the previous
+        # checkpoint intact and resume() replays forward bitwise
+        faults.fire(faults.LIFECYCLE_CHECKPOINT, step=self.step,
+                    consumed=self.consumed)
+        payload = json.dumps({
+            "format": CKPT_FORMAT,
+            "adapter": type(self.adapter).__name__,
+            "step": self.step,
+            "consumed": self.consumed,
+            "state": self.adapter.to_json(self.state),
+        })
+        atomic_write_text(self.checkpoint_path, payload)
+
+    def resume(self) -> bool:
+        """Load the checkpoint (when present) and position the replay
+        cursor; the next ``train_pending`` replays the journal tail. A
+        missing checkpoint resumes from scratch (full replay)."""
+        if not os.path.exists(self.checkpoint_path):
+            return False
+        with open(self.checkpoint_path, encoding="utf-8") as fh:
+            d = json.load(fh)
+        if d.get("format") != CKPT_FORMAT:
+            raise ValueError(f"bad checkpoint format {d.get('format')!r} "
+                             f"in {self.checkpoint_path!r}")
+        with self._train_lock:
+            self.state = self.adapter.from_json(d["state"])
+            self.step = int(d["step"])
+            self.consumed = int(d["consumed"])
+            self._published_at = self.consumed
+        return True
+
+    # -- canary handoff --------------------------------------------------
+    def _state_digest(self) -> str:
+        blob = json.dumps(self.adapter.to_json(self.state), sort_keys=True)
+        return "o:" + hashlib.sha256(blob.encode("utf-8")).hexdigest()[:20]
+
+    def _maybe_publish(self) -> None:
+        if self.publish_after <= 0 or self._plane is None:
+            return
+        if self.consumed - self._published_at < self.publish_after:
+            return
+        self.publish()
+
+    def publish(self) -> Optional[str]:
+        """Build a transform from the current state and hand it to the
+        canary pipeline (register + rollout). Returns the version id, or
+        None when the adapter has nothing to serve or a rollout is
+        already in flight (journaled as a failed publish, not retried
+        until the next publish_after threshold). Serializes against
+        training so the published state is a consistent snapshot."""
+        with self._train_lock:
+            self._published_at = self.consumed
+            make = getattr(self.adapter, "make_transform", None)
+            if make is None or self._plane is None:
+                return None
+            try:
+                transform = make(self.state, self.reply_col)
+                if transform is None:
+                    return None
+                vid = f"{self.version_prefix}-{self.step}"
+                self._plane.deploy(transform, version=vid,
+                                   digest=self._state_digest(),
+                                   cost={"examples": self.consumed})
+            except Exception:  # noqa: BLE001 — an active rollout or a
+                # refit failure must not kill the training loop
+                self.publish_failed += 1
+                return None
+            self.published += 1
+            return vid
+
+    # -- background loop -------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="mmlspark-lifecycle-online", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            try:
+                self.train_pending()
+            except Exception:  # noqa: BLE001 — training must never die
+                # silently; the journal keeps the examples for a retry
+                continue
+
+    def tick(self) -> None:
+        """The plane's heartbeat hook: in ``auto`` mode without a
+        background thread, fold at most one step inline per tick."""
+        if self._auto and self._thread is None:
+            self.train_pending(max_steps=1)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        self.journal.close()
+
+    def summary(self) -> Dict[str, Any]:
+        return {"adapter": getattr(self.adapter, "name",
+                                   type(self.adapter).__name__),
+                "step": self.step, "consumed": self.consumed,
+                "pending": self.pending(), "published": self.published,
+                "publish_failed": self.publish_failed,
+                "journal_path": self.journal.path,
+                "checkpoint_path": self.checkpoint_path}

@@ -1,0 +1,319 @@
+"""Versioned in-process model registry: the serving tier's model lifecycle.
+
+The reference serves exactly one immutable model per query
+(HTTPSourceV2.scala binds the transform at stream start); every rollout is
+a redeploy. Here models are first-class *versions* — TVM's framing of
+imported checkpoints as interchangeable artifacts — moving through an
+explicit state machine::
+
+    candidate -> shadowing -> canary -> live -> retired
+         \\            \\          \\
+          \\            v          v
+           +------> rolled_back  rolled_back
+
+  - ``candidate``   registered, taking no traffic
+  - ``shadowing``   scored against the incumbent on duplicated traffic
+  - ``canary``      serving a ramped share of real traffic
+  - ``live``        the incumbent (exactly one at a time)
+  - ``retired``     a former incumbent after a successful promotion
+  - ``rolled_back`` a candidate the gates rejected (terminal)
+
+State transitions are journaled like every tuner/fleet decision (bounded
+in-memory journal, surfaced at ``/_mmlspark/models``), and the live-pointer
+swap is a two-phase operation with a chaos seam (``faults.LIFECYCLE_SWAP``)
+fired BEFORE any state mutates: a crash mid-swap leaves the incumbent
+serving, never a half-promoted registry.
+
+Identity is structural: ``ModelVersion.digest`` prefers the model's own
+``cache_token()`` (models/module.FunctionModel — the same cross-process
+token the fleet's persistent compile cache keys on), falling back to a
+sha256 of the pickled transform, then to a process-local id.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ...core import faults
+from ...obs import perf as obs_perf
+
+# lifecycle states (the docstring's state machine)
+CANDIDATE = "candidate"
+SHADOWING = "shadowing"
+CANARY = "canary"
+LIVE = "live"
+RETIRED = "retired"
+ROLLED_BACK = "rolled_back"
+
+STATES = (CANDIDATE, SHADOWING, CANARY, LIVE, RETIRED, ROLLED_BACK)
+
+#: legal transitions; candidate -> canary skips the shadow phase (an
+#: operator's prerogative for pre-validated models)
+_ALLOWED: Dict[str, Tuple[str, ...]] = {
+    CANDIDATE: (SHADOWING, CANARY, RETIRED),
+    SHADOWING: (CANARY, ROLLED_BACK, RETIRED),
+    CANARY: (LIVE, ROLLED_BACK),
+    LIVE: (RETIRED,),
+    RETIRED: (),
+    ROLLED_BACK: (),
+}
+
+
+def structural_digest(obj: Any) -> str:
+    """Cross-process identity of a model/transform: ``cache_token()`` when
+    the object carries one (FunctionModel and anything adopting its
+    contract), else sha256 of its pickle, else a process-local id (opaque
+    closures — correctness keeps, cross-process comparison degrades)."""
+    tok = getattr(obj, "cache_token", None)
+    if callable(tok):
+        try:
+            return str(tok())
+        except Exception:  # noqa: BLE001 — fall through to pickle
+            pass
+    import hashlib
+    import pickle
+
+    try:
+        return "p:" + hashlib.sha256(
+            pickle.dumps(obj, protocol=4)).hexdigest()[:20]
+    except Exception:  # noqa: BLE001 — unpicklable closure
+        return f"id:{id(obj)}"
+
+
+class ModelVersion:
+    """One registered model: transform + structural digest + cost snapshot +
+    lifecycle state + per-version traffic/divergence/SLO accounting."""
+
+    __slots__ = ("version", "transform", "stage", "digest", "cost", "state",
+                 "created_s", "warm", "slo", "requests", "shadow_issued",
+                 "shadow_scored", "shadow_divergent", "shadow_errors",
+                 "traffic_share")
+
+    def __init__(self, version: str, transform: Callable, *,
+                 stage: Any = None, digest: Optional[str] = None,
+                 cost: Optional[dict] = None,
+                 warm: Optional[Callable[[], Any]] = None,
+                 slo: Optional[obs_perf.SLOTracker] = None,
+                 created_s: float = 0.0):
+        self.version = version
+        self.transform = transform
+        # the underlying pipeline/stage object (serve_pipeline's fused
+        # model), kept so the warm hook can reach attach_persistent_cache
+        self.stage = stage
+        self.digest = digest if digest is not None \
+            else structural_digest(stage if stage is not None else transform)
+        # cost-model snapshot at registration (predicted ms / knobs): the
+        # measured-vs-predicted promotion evidence rides the journal
+        self.cost = dict(cost) if cost else None
+        self.state = CANDIDATE
+        self.created_s = created_s
+        # zero-compile promotion hook: called by the controller BEFORE the
+        # swap so the candidate's executables are warm when traffic lands
+        self.warm = warm
+        # per-version burn-rate buckets: the canary step gates read these
+        self.slo = slo
+        # batches served for real, by role (live/canary routing decisions)
+        self.requests: Dict[str, int] = {"live": 0, "canary": 0}
+        # shadow-phase accounting: issued = duplicated batches, scored =
+        # rows compared against the incumbent, divergent = rows outside
+        # the per-dtype tolerance, errors = candidate transform failures
+        self.shadow_issued = 0
+        self.shadow_scored = 0
+        self.shadow_divergent = 0
+        self.shadow_errors = 0
+        # current share of real traffic routed here (0.0 outside canary)
+        self.traffic_share = 0.0
+
+    def divergence_rate(self) -> float:
+        return (self.shadow_divergent / self.shadow_scored
+                if self.shadow_scored else 0.0)
+
+    def max_burn(self) -> float:
+        if self.slo is None:
+            return 0.0
+        rates = self.slo.burn_rates()
+        return max(rates.values()) if rates else 0.0
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "version": self.version,
+            "state": self.state,
+            "digest": self.digest,
+            "traffic_share": round(self.traffic_share, 4),
+            "requests": dict(self.requests),
+            "shadow": {"issued": self.shadow_issued,
+                       "scored": self.shadow_scored,
+                       "divergent": self.shadow_divergent,
+                       "errors": self.shadow_errors},
+            "divergence_rate": round(self.divergence_rate(), 6),
+        }
+        if self.cost is not None:
+            out["cost"] = self.cost
+        if self.slo is not None:
+            out["burn"] = {str(w): r
+                           for w, r in self.slo.burn_rates().items()}
+        return out
+
+
+class ModelRegistry:
+    """Thread-safe registry of ModelVersions with journaled transitions.
+
+    One version is ``live`` at a time; ``swap_live`` is the two-phase
+    promotion primitive — chaos seam first, then the caller's ``apply``
+    (the executor-guarded transform flip), then the journaled state
+    transitions. A crash or an apply failure before the flip leaves the
+    registry (and the serving path) exactly as it was.
+    """
+
+    def __init__(self, slo_config: Optional[obs_perf.SLOConfig] = None,
+                 journal_cap: int = 256, clock=time.monotonic):
+        self._slo_config = slo_config
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._versions: Dict[str, ModelVersion] = {}
+        self._order: List[str] = []
+        self._live: Optional[str] = None
+        self._seq = 0
+        #: bounded decision journal (the tuner/fleet idiom): dicts of
+        #: {action, version, from, to, t, ...}
+        self.journal: List[Dict[str, Any]] = []
+        self._journal_cap = max(8, int(journal_cap))
+        self.transitions: Dict[str, int] = {}
+
+    # -- journal ---------------------------------------------------------
+    def _log(self, action: str, **info: Any) -> None:
+        entry = {"action": action, "t": round(self._clock(), 3), **info}
+        if len(self.journal) >= self._journal_cap:
+            del self.journal[: self._journal_cap // 4]
+        self.journal.append(entry)
+        self.transitions[action] = self.transitions.get(action, 0) + 1
+
+    # -- registration ----------------------------------------------------
+    def _new_version(self, transform: Callable, *, version: Optional[str],
+                     stage: Any, digest: Optional[str],
+                     cost: Optional[dict],
+                     warm: Optional[Callable]) -> ModelVersion:
+        self._seq += 1
+        vid = version if version is not None else f"v{self._seq}"
+        if vid in self._versions:
+            raise ValueError(f"version {vid!r} already registered")
+        slo = obs_perf.SLOTracker(self._slo_config, clock=self._clock) \
+            if self._slo_config is not None \
+            else obs_perf.SLOTracker(clock=self._clock)
+        ver = ModelVersion(vid, transform, stage=stage, digest=digest,
+                           cost=cost, warm=warm, slo=slo,
+                           created_s=self._clock())
+        self._versions[vid] = ver
+        self._order.append(vid)
+        return ver
+
+    def register(self, transform: Callable, *, version: Optional[str] = None,
+                 stage: Any = None, digest: Optional[str] = None,
+                 cost: Optional[dict] = None,
+                 warm: Optional[Callable[[], Any]] = None) -> ModelVersion:
+        """Register a fitted transform as a ``candidate`` version."""
+        with self._lock:
+            ver = self._new_version(transform, version=version, stage=stage,
+                                    digest=digest, cost=cost, warm=warm)
+            self._log("register", version=ver.version, digest=ver.digest)
+        return ver
+
+    def adopt_live(self, transform: Callable, *,
+                   version: Optional[str] = None, stage: Any = None,
+                   digest: Optional[str] = None,
+                   cost: Optional[dict] = None) -> ModelVersion:
+        """Register the bootstrap incumbent directly as ``live`` (the
+        transform the server was constructed with)."""
+        with self._lock:
+            if self._live is not None:
+                raise ValueError(f"live version already set: {self._live}")
+            ver = self._new_version(transform, version=version, stage=stage,
+                                    digest=digest, cost=cost, warm=None)
+            ver.state = LIVE
+            ver.traffic_share = 1.0
+            self._live = ver.version
+            self._log("adopt", version=ver.version, digest=ver.digest)
+        return ver
+
+    # -- lookup ----------------------------------------------------------
+    def get(self, version: str) -> ModelVersion:
+        with self._lock:
+            return self._versions[version]
+
+    @property
+    def live(self) -> Optional[ModelVersion]:
+        with self._lock:
+            return self._versions.get(self._live) \
+                if self._live is not None else None
+
+    def versions(self) -> List[ModelVersion]:
+        with self._lock:
+            return [self._versions[v] for v in self._order]
+
+    # -- state machine ---------------------------------------------------
+    def transition(self, version: str, new_state: str, **info: Any
+                   ) -> ModelVersion:
+        """Move a version to ``new_state``, validating against the state
+        machine; the change is journaled with the caller's context."""
+        if new_state not in STATES:
+            raise ValueError(f"unknown state {new_state!r}")
+        with self._lock:
+            ver = self._versions[version]
+            if new_state not in _ALLOWED[ver.state]:
+                raise ValueError(
+                    f"illegal transition {ver.state} -> {new_state} "
+                    f"for {version!r}")
+            old = ver.state
+            ver.state = new_state
+            self._log("transition", version=version, **{"from": old},
+                      to=new_state, **info)
+        return ver
+
+    def swap_live(self, version: str,
+                  apply: Optional[Callable[[ModelVersion,
+                                            Optional[ModelVersion]],
+                                           None]] = None,
+                  **info: Any) -> ModelVersion:
+        """Atomically promote ``version`` to live.
+
+        Two-phase: (1) fire the ``lifecycle.swap`` chaos seam — a raising
+        plan simulates a crash mid-swap and must leave the incumbent
+        serving; (2) run ``apply(new, old)`` OUTSIDE the registry lock (the
+        caller's executor-guarded transform flip — an apply failure aborts
+        with no state change); (3) flip the live pointer and journal the
+        transitions. In-flight batches dispatched before (2) complete on
+        the incumbent's closure — versions never mix within a batch."""
+        with self._lock:
+            ver = self._versions[version]
+            if LIVE not in _ALLOWED[ver.state]:
+                raise ValueError(
+                    f"cannot promote {version!r} from state {ver.state}")
+            old = self._versions.get(self._live) \
+                if self._live is not None else None
+        faults.fire(faults.LIFECYCLE_SWAP, version=version,
+                    incumbent=old.version if old is not None else None)
+        if apply is not None:
+            apply(ver, old)
+        with self._lock:
+            prev_state = ver.state
+            ver.state = LIVE
+            ver.traffic_share = 1.0
+            self._live = version
+            if old is not None:
+                old.state = RETIRED
+                old.traffic_share = 0.0
+            self._log("promote", version=version, **{"from": prev_state},
+                      incumbent=old.version if old is not None else None,
+                      **info)
+        return ver
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            live = self._live
+            versions = [self._versions[v].summary() for v in self._order]
+            journal = list(self.journal[-16:])
+            transitions = dict(self.transitions)
+        return {"live": live, "versions": versions,
+                "transitions": transitions, "journal": journal}

@@ -269,6 +269,12 @@ class ServingServer:
     #: fleet controller's capacity recommendation (serving/fleet): the
     #: cross-pod scaling signal an external scaler / helm HPA consumes
     CAPACITY_PATH = "/_mmlspark/capacity"
+    #: model-lifecycle registry view (serving/lifecycle): versions, states,
+    #: rollout journal — 404 when the lifecycle plane is off
+    MODELS_PATH = "/_mmlspark/models"
+    #: batched labeled-feedback ingress for train-on-serve (POST
+    #: {"rows": [...], "labels": [...]}) — 404 when the plane is off
+    FEEDBACK_PATH = "/_mmlspark/feedback"
 
     def __init__(self, transform: Callable[[DataFrame], DataFrame],
                  host: str = "127.0.0.1", port: int = 8898,
@@ -298,7 +304,8 @@ class ServingServer:
                  watchdog_min_budget_s: float = 1.0,
                  probe_fn: Optional[Callable] = None,
                  brownout=None, brownout_hooks=None,
-                 fleet=None, fleet_hooks=None):
+                 fleet=None, fleet_hooks=None,
+                 lifecycle=None, lifecycle_hooks=None):
         self.transform = transform
         # optional provider of the device-ingest decomposition (queue/h2d/
         # compute/readback — parallel/ingest.IngestStats.summary) merged into
@@ -383,6 +390,15 @@ class ServingServer:
         self._fleet_spec = fleet
         self._fleet_hooks = dict(fleet_hooks or {})
         self._fleet = None
+        # model lifecycle plane (serving/lifecycle): versioned registry +
+        # shadow-scored canary rollout + train-on-serve. None/False = off
+        # (the default — lifecycle=False stays bitwise-identical). Built in
+        # start() BEFORE the replica set, so replicas capture the plane as
+        # their transform; hooks (warm, live_stage, ...) arrive from
+        # serve_pipeline.
+        self._lifecycle_spec = lifecycle
+        self._lifecycle_hooks = dict(lifecycle_hooks or {})
+        self._lifecycle = None
         self._executor = None
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         # wake latch: set on every enqueue and on stop(), so the batcher's
@@ -527,6 +543,11 @@ class ServingServer:
                     summary["fleet"] = self._fleet.summary()
                 except Exception as e:  # noqa: BLE001
                     summary["fleet"] = {"error": str(e)}
+            if self._lifecycle is not None:
+                try:
+                    summary["lifecycle"] = self._lifecycle.summary()
+                except Exception as e:  # noqa: BLE001
+                    summary["lifecycle"] = {"error": str(e)}
             if self._lat_hist is not None:
                 # bucket counts + trace-id exemplars, ALWAYS here (the
                 # exposition carries them only behind metrics_exemplars)
@@ -568,6 +589,34 @@ class ServingServer:
                 return (500, "application/json", json.dumps(
                     {"error": str(e)}).encode("utf-8"), None)
             return (200, "application/json", payload, None)
+        if path == ServingServer.MODELS_PATH:
+            # model-lifecycle registry view (serving/lifecycle): versions,
+            # states, traffic shares, and the rollout decision journal
+            if self._lifecycle is None:
+                return (404, "application/json",
+                        b'{"error": "lifecycle disabled"}', None)
+            try:
+                payload = json.dumps(
+                    self._lifecycle.summary()).encode("utf-8")
+            except Exception as e:  # noqa: BLE001
+                return (500, "application/json", json.dumps(
+                    {"error": str(e)}).encode("utf-8"), None)
+            return (200, "application/json", payload, None)
+        if path == ServingServer.FEEDBACK_PATH:
+            # batched labeled feedback for train-on-serve: journaled
+            # write-ahead, so a 200 means the examples will survive a crash
+            if self._lifecycle is None:
+                return (404, "application/json",
+                        b'{"error": "lifecycle disabled"}', None)
+            try:
+                msg = json.loads(body.decode("utf-8"))
+                n = self._lifecycle.feed_feedback(
+                    msg["rows"], msg["labels"])
+                return (200, "application/json", json.dumps(
+                    {"journaled": n}).encode("utf-8"), None)
+            except Exception as e:  # noqa: BLE001
+                return (400, "application/json", json.dumps(
+                    {"error": str(e)}).encode("utf-8"), None)
         if path != self.api_path:
             return (404, "application/json", b'{"error": "not found"}', None)
         return None
@@ -1048,6 +1097,11 @@ class ServingServer:
                 self._fleet.tick(e2e_s)
             except Exception:  # noqa: BLE001 — scaling must never kill serving
                 pass
+        if self._lifecycle is not None:
+            try:
+                self._lifecycle.tick(e2e_s)
+            except Exception:  # noqa: BLE001 — rollout control must never
+                pass           # kill serving
 
     def _fleet_live_config(self) -> Dict[str, Any]:
         """The fleet controller's view of the live knob vector (its
@@ -1237,6 +1291,19 @@ class ServingServer:
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingServer":
+        if self._lifecycle_spec and self._lifecycle is None:
+            from .lifecycle import make_lifecycle
+
+            # built FIRST: the plane adopts the configured transform as the
+            # live version and replaces it, so the replica set below (and
+            # the sync loop) capture the plane — every batch then routes
+            # through the version registry
+            plane = make_lifecycle(self._lifecycle_spec,
+                                   hooks=self._lifecycle_hooks)
+            if plane is not None:
+                self.transform = plane.bind(self)
+                plane.start()
+                self._lifecycle = plane
         if self.http_mode == "async":
             from .aio import AsyncHTTPServer
 
@@ -1360,6 +1427,11 @@ class ServingServer:
         # batch must finish its append/commit on an open file
         if self._executor is not None:
             self._executor.stop()
+        if self._lifecycle is not None:
+            try:
+                self._lifecycle.stop()
+            except Exception:  # noqa: BLE001 — shutdown stays best-effort
+                pass
         for t in self._threads:
             if t.name.endswith("-batcher"):
                 t.join(timeout=5)
@@ -1446,7 +1518,8 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    metrics_exemplars: bool = False,
                    supervise: bool = True,
                    watchdog_budget_s: Optional[float] = None,
-                   brownout=None, fleet=False) -> ServingServer:
+                   brownout=None, fleet=False,
+                   lifecycle=False) -> ServingServer:
     """Serve a fitted Transformer: request body -> ``input_col`` -> stage ->
     ``reply_col`` (IOImplicits fluent sugar parity, io/IOImplicits.scala:182-213).
 
@@ -1513,6 +1586,16 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
     previously-seen signatures) and ``cache_write`` (default True) gates
     the store path. The capacity planner + autoscale controller publish
     at ``/_mmlspark/capacity`` and apply inflight/mega_k live.
+
+    ``lifecycle`` (off by default — disabled serving stays
+    bitwise-identical) enables the model lifecycle plane
+    (serving/lifecycle, docs/lifecycle.md): ``True`` for defaults or a
+    dict of CanaryConfig kwargs. The configured stage becomes the live
+    version; candidates registered at runtime roll out shadow-scored and
+    burn-gated (``/_mmlspark/models``), and with a fleet ``cache_path``
+    mounted the promotion warm hook stages a candidate's executables into
+    the persistent compile cache BEFORE it takes traffic (zero-compile
+    promotion).
     """
     from ..core.pipeline import PipelineModel
     from .stages import parse_request
@@ -1596,6 +1679,7 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
         brownout_hooks = {"demote_segments": (demote_apply, demote_revert)}
 
     fleet_hooks = None
+    tier = None
     if fleet:
         fleet_hooks = {}
         cache_path = None
@@ -1638,6 +1722,26 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
         if tuner is not None:
             fleet_hooks["predict_ms"] = tuner.predict_batch_ms
 
+    lifecycle_hooks = None
+    if lifecycle:
+        # the plane adopts the configured stage as the live version; the
+        # warm hook runs at promotion time, BEFORE the candidate takes
+        # traffic: with a persistent compile-cache tier mounted (fleet
+        # cache_path), attaching it AOT-warms the candidate's previously
+        # serialized executables — the zero-compile promotion criterion
+        lifecycle_hooks = {"live_stage": stage}
+
+        def _warm(ver, _tier=tier):
+            st = ver.stage
+            if st is None or not hasattr(st, "attach_persistent_cache"):
+                return "no stage cache"
+            if _tier is None:
+                return "no persistent tier"
+            st.attach_persistent_cache(_tier)
+            return "warmed"
+
+        lifecycle_hooks["warm"] = _warm
+
     return ServingServer(transform, host=host, port=port, api_path=api_path,
                          reply_col=reply_col, max_batch_size=max_batch_size,
                          max_wait_ms=max_wait_ms, token=token,
@@ -1658,4 +1762,6 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                          watchdog_budget_s=watchdog_budget_s,
                          brownout=brownout,
                          brownout_hooks=brownout_hooks,
-                         fleet=fleet, fleet_hooks=fleet_hooks)
+                         fleet=fleet, fleet_hooks=fleet_hooks,
+                         lifecycle=lifecycle,
+                         lifecycle_hooks=lifecycle_hooks)

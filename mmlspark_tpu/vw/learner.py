@@ -405,3 +405,99 @@ def predict_linear(w: np.ndarray, dataset: SparseDataset) -> np.ndarray:
 
     return np.asarray(fwd(jnp.asarray(w), jnp.asarray(dataset.indices),
                           jnp.asarray(dataset.values)))
+
+
+class LinearLearner:
+    """Incremental face of the scan pass: ``partial_fit(rows, labels)``
+    folds one mini-batch into persistent optimizer state (the serving
+    lifecycle's online-adapter contract; ``train_linear`` keeps its
+    whole-pass semantics and native fast path untouched).
+
+    Always the jax scan path, never the native engine — the C++ loop
+    keeps its learning-rate clock internal, so its state cannot round-trip
+    through a checkpoint bitwise. State (weights + AdaGrad/FTRL
+    accumulators + lr clock) carries across calls: replaying the same
+    example slices in the same order reproduces the state bitwise, which
+    is exactly the online trainer's journal-resume contract.
+    """
+
+    def __init__(self, config: Optional[LearnerConfig] = None):
+        self.config = config if config is not None else LearnerConfig()
+        self._pass = None     # jitted scan, built on first partial_fit
+        self._state = None    # (w, g2, t) adaptive/sgd or (z, n) FTRL
+        self.examples_seen = 0
+
+    def _ensure_state(self) -> None:
+        if self._state is not None:
+            return
+        import jax.numpy as jnp
+
+        dim = 1 << self.config.num_bits
+        if self.config.ftrl:
+            self._state = (jnp.zeros(dim, dtype=jnp.float32),
+                           jnp.zeros(dim, dtype=jnp.float32))
+        else:
+            self._state = (jnp.zeros(dim, dtype=jnp.float32),
+                           jnp.zeros(dim, dtype=jnp.float32),
+                           jnp.float32(0.0))
+
+    def partial_fit(self, rows, labels, weights=None) -> float:
+        """One incremental step over ``rows`` (sparse dicts, the
+        ``SparseDataset.from_rows`` shape); returns the summed weighted
+        example loss of the batch."""
+        import jax.numpy as jnp
+
+        self._ensure_state()
+        if self._pass is None:
+            self._pass = make_scan_pass(self.config)
+        ds = SparseDataset.from_rows(rows, labels, weights,
+                                     num_bits=self.config.num_bits)
+        batch = {"indices": jnp.asarray(ds.indices),
+                 "values": jnp.asarray(ds.values),
+                 "labels": jnp.asarray(ds.labels),
+                 "weights": jnp.asarray(ds.weights)}
+        self._state, losses = self._pass(self._state, batch)
+        self.examples_seen += int(len(ds.labels))
+        return float(jnp.sum(losses))
+
+    @property
+    def weights(self) -> np.ndarray:
+        """Dense weight vector reconstructed from the current state."""
+        self._ensure_state()
+        if self.config.ftrl:
+            return np.asarray(_ftrl_weights(self.config, self._state[0],
+                                            self._state[1]))
+        return np.asarray(self._state[0])
+
+    def predict(self, rows) -> np.ndarray:
+        ds = SparseDataset.from_rows(rows, np.zeros(len(rows)),
+                                     num_bits=self.config.num_bits)
+        return predict_linear(self.weights, ds)
+
+    def state_dict(self) -> Dict[str, object]:
+        """Exact numpy snapshot of the optimizer state (float32 arrays —
+        a serialize/load round-trip continues training bitwise)."""
+        self._ensure_state()
+        arrs = [np.asarray(s) for s in self._state]
+        if self.config.ftrl:
+            return {"kind": "ftrl", "z": arrs[0], "n": arrs[1],
+                    "examples_seen": self.examples_seen}
+        return {"kind": "adaptive", "w": arrs[0], "g2": arrs[1],
+                "t": float(arrs[2]), "examples_seen": self.examples_seen}
+
+    def load_state_dict(self, d: Dict[str, object]) -> "LinearLearner":
+        import jax.numpy as jnp
+
+        expected = "ftrl" if self.config.ftrl else "adaptive"
+        if d.get("kind") != expected:
+            raise ValueError(f"state kind {d.get('kind')!r} does not match "
+                             f"config ({expected})")
+        if self.config.ftrl:
+            self._state = (jnp.asarray(d["z"], dtype=jnp.float32),
+                           jnp.asarray(d["n"], dtype=jnp.float32))
+        else:
+            self._state = (jnp.asarray(d["w"], dtype=jnp.float32),
+                           jnp.asarray(d["g2"], dtype=jnp.float32),
+                           jnp.float32(d["t"]))
+        self.examples_seen = int(d.get("examples_seen", 0))
+        return self

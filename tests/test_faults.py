@@ -1908,3 +1908,171 @@ class TestCompileCacheChaos:
             # every outcome is accounted: either a parse failure or (for
             # a garbage run that shredded the header length) a miss
             assert st["load_errors"] + st["misses"] >= 1, mode
+
+
+# ---------------------------------------------------------------------------
+# Model lifecycle: crash mid-swap / mid-checkpoint (serving/lifecycle)
+# ---------------------------------------------------------------------------
+
+
+def _lc_sparse_rows(n, seed=0, nnz=3):
+    rng = np.random.default_rng(seed)
+    rows, labels = [], []
+    for _ in range(n):
+        idx = rng.choice(64, size=nnz, replace=False)
+        rows.append({"indices": [int(i) for i in idx],
+                     "values": [float(v) for v in
+                                rng.normal(size=nnz).round(3)]})
+        labels.append(float(rng.integers(0, 2)))
+    return rows, labels
+
+
+class TestLifecycleChaos:
+    """The two lifecycle chaos seams: ``lifecycle.swap`` fires BEFORE any
+    registry/executor state mutates (a crash mid-swap must leave the
+    incumbent serving), ``lifecycle.checkpoint`` fires before the atomic
+    checkpoint write (resume + journal replay must be bitwise)."""
+
+    def _plane(self, candidate, steps=(0.0,)):
+        pytest.importorskip("jax")
+        from mmlspark_tpu.serving.lifecycle import (CanaryConfig,
+                                                    LifecyclePlane)
+
+        clock = [1_000.0]
+        plane = LifecyclePlane(
+            CanaryConfig(shadow_fraction=0.0, steps=steps, hold_s=0.0,
+                         min_step_requests=0, check_interval_s=0.0,
+                         objective_ms=60_000.0),
+            clock=lambda: clock[0])
+        plane.registry.adopt_live(
+            lambda df: df.with_column("reply", lambda p: p["value"]),
+            version="base")
+        plane.deploy(candidate, version="cand")
+        return plane, clock
+
+    def test_crash_mid_swap_keeps_registry_intact(self):
+        """An injected crash inside swap_live (fired before any mutation)
+        leaves the incumbent live and the candidate retriable; the next
+        tick completes the promotion."""
+        from mmlspark_tpu.serving.lifecycle import CANARY
+
+        plane, clock = self._plane(
+            lambda df: df.with_column("reply", lambda p: p["value"]))
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.LIFECYCLE_SWAP, at=(1,)):
+            clock[0] += 1.0
+            plane.tick(0.01)  # promotion attempt 1: seam raises mid-swap
+            assert any(e["action"] == "swap_failed"
+                       for e in plane.controller.journal)
+            assert plane.registry.live.version == "base"
+            assert plane.registry.get("cand").state == CANARY
+            # traffic still resolves through the incumbent
+            out = plane(_lc_df([b"hello"]))
+            assert list(out.collect()["reply"]) == [b"hello"]
+            clock[0] += 1.0
+            plane.tick(0.01)  # seam passes -> promotion completes
+        assert plane.registry.live.version == "cand"
+
+    def test_crash_mid_swap_e2e_incumbent_replies_bitwise(self):
+        """Through a live server with a DIVERGING candidate and the swap
+        seam raising on every attempt: clients only ever see the
+        incumbent's bytes (the candidate never takes traffic at share 0,
+        and the repeated failed promotions never half-install it)."""
+        pytest.importorskip("jax")
+        from mmlspark_tpu.serving.server import ServingServer
+
+        def echo(df):
+            return df.with_column("reply", lambda p: p["value"])
+
+        def diverging(df):
+            return df.with_column("reply",
+                                  lambda p: [b"WRONG" for _ in p["id"]])
+
+        srv = ServingServer(echo, port=0, max_wait_ms=1.0,
+                            lifecycle={"shadow_fraction": 0.0,
+                                       "steps": (0.0,), "hold_s": 0.0,
+                                       "min_step_requests": 0,
+                                       "check_interval_s": 0.0,
+                                       "objective_ms": 60_000.0})
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.LIFECYCLE_SWAP, every=1, times=-1):
+            with srv:
+                plane = srv._lifecycle
+                plane.deploy(diverging, version="bad")
+                deadline = time.monotonic() + 20.0
+                failed = 0
+                i = 0
+                while time.monotonic() < deadline:
+                    body = json.dumps({"i": i}).encode()
+                    req = urllib.request.Request(srv.address, data=body,
+                                                 method="POST")
+                    with urllib.request.urlopen(req, timeout=15) as resp:
+                        assert resp.read() == body  # incumbent, bitwise
+                    i += 1
+                    failed = sum(1 for e in plane.controller.journal
+                                 if e["action"] == "swap_failed")
+                    if failed >= 2:
+                        break
+                assert failed >= 2
+                assert plane.registry.live.version != "bad"
+                assert plane.controller.promotions == 0
+
+    def test_checkpoint_crash_resume_is_bitwise(self):
+        """Crash before checkpoint k's write: the on-disk checkpoint stays
+        at k-1, and a fresh trainer's resume + journal replay reproduces
+        the uninterrupted run's state bitwise. The chaos seed picks k."""
+        pytest.importorskip("jax")
+        import tempfile
+
+        from mmlspark_tpu.serving.lifecycle import (OnlineTrainer,
+                                                    VWOnlineAdapter)
+        from mmlspark_tpu.vw.learner import LearnerConfig
+
+        cfg = LearnerConfig(num_bits=8)
+        rows, labels = _lc_sparse_rows(24, seed=CHAOS_SEED)
+        crash_at = 2 + CHAOS_SEED % 3
+
+        with tempfile.TemporaryDirectory() as td:
+            ref = OnlineTrainer(VWOnlineAdapter(cfg),
+                                os.path.join(td, "ref.jsonl"),
+                                os.path.join(td, "ref.ck"), batch_rows=4)
+            ref.feed(rows, labels)
+            ref.train_pending()
+            ref_state = ref.adapter.to_json(ref.state)
+            ref.stop()
+
+            t1 = OnlineTrainer(VWOnlineAdapter(cfg),
+                               os.path.join(td, "fb.jsonl"),
+                               os.path.join(td, "ck.json"), batch_rows=4)
+            t1.feed(rows, labels)
+            with FaultInjector(seed=CHAOS_SEED).plan(
+                    faults.LIFECYCLE_CHECKPOINT, at=(crash_at,)):
+                with pytest.raises(InjectedFault):
+                    t1.train_pending()
+            t1.journal.close()  # crash: no stop(), no further writes
+            with open(os.path.join(td, "ck.json"),
+                      encoding="utf-8") as fh:
+                assert json.load(fh)["step"] == crash_at - 1
+
+            t2 = OnlineTrainer(VWOnlineAdapter(cfg),
+                               os.path.join(td, "fb.jsonl"),
+                               os.path.join(td, "ck.json"), batch_rows=4)
+            assert t2.resume() is True
+            assert t2.step == crash_at - 1
+            t2.train_pending()
+            assert t2.consumed == 24
+            assert t2.adapter.to_json(t2.state) == ref_state
+            t2.stop()
+
+
+def _lc_df(values):
+    from mmlspark_tpu.core.dataframe import DataFrame
+
+    h = np.empty(len(values), dtype=object)
+    for i in range(len(values)):
+        h[i] = {}
+    return DataFrame.from_dict({
+        "id": np.arange(len(values), dtype=np.int64),
+        "value": np.asarray(values, dtype=object),
+        "headers": h,
+    })

@@ -256,6 +256,35 @@ class TestOptions:
         assert metric["metric"]["name"] == "mmlspark_slo_burn_rate"
         assert metric["target"]["averageValue"] == "2.0"
 
+    def test_lifecycle_defaults_off(self):
+        # defaults: no lifecycle env, and the bootstrap passes
+        # lifecycle=None (bitwise-identical serving)
+        _, docs = render_docs()
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        wc = worker["spec"]["template"]["spec"]["containers"][0]
+        env = [e["name"] for e in wc["env"]]
+        assert "MMLSPARK_LIFECYCLE" not in env
+        assert "lifecycle=lifecycle" in wc["args"][0]
+
+    def test_lifecycle_env_plumbing(self):
+        _, docs = render_docs({"lifecycle": {
+            "enabled": True, "shadowFraction": 0.25,
+            "canarySteps": "0.1,1.0", "burnRateGate": 2.0}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MMLSPARK_LIFECYCLE"] == "true"
+        assert env["MMLSPARK_LIFECYCLE_SHADOW"] == "0.25"
+        assert env["MMLSPARK_LIFECYCLE_STEPS"] == "0.1,1.0"
+        assert env["MMLSPARK_LIFECYCLE_BURN_GATE"] == "2.0"
+        # defaults survive a bare enabled=true
+        _, docs = render_docs({"lifecycle": {"enabled": True}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MMLSPARK_LIFECYCLE_STEPS"] == "0.01,0.05,0.25,1.0"
+        assert env["MMLSPARK_LIFECYCLE_BURN_GATE"] == "1.0"
+
     def test_bootstrap_python_compiles(self):
         """The pod commands are Python source built by the templates; a
         template expression the renderer can't evaluate (the old

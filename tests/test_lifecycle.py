@@ -1,0 +1,1051 @@
+"""Model lifecycle plane suite (serving/lifecycle, docs/lifecycle.md).
+
+Covers the registry state machine + two-phase swap, shadow scoring,
+the canary controller's gated walk over a fake clock (divergence /
+SLO-burn rollback, warm-before-swap promotion), the train-on-serve
+journal-replay contract (bitwise checkpoint resume for the VW and GBDT
+adapters), and the serving wiring: ``/_mmlspark/models``,
+``/_mmlspark/feedback``, the stats section, per-version metric
+families, an end-to-end shadow -> canary -> promote rollout through a
+live server, and ``lifecycle=False`` bitwise parity. The chaos-lane
+fault-injection cases (crash mid-swap / mid-checkpoint) live in
+tests/test_faults.py (TestLifecycleChaos).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from mmlspark_tpu.core.dataframe import DataFrame  # noqa: E402
+from mmlspark_tpu.serving.lifecycle import (  # noqa: E402
+    CANARY,
+    CANDIDATE,
+    LIVE,
+    RETIRED,
+    ROLLED_BACK,
+    SHADOWING,
+    CanaryConfig,
+    CanaryController,
+    FeedbackJournal,
+    GBDTRefitAdapter,
+    LifecyclePlane,
+    ModelRegistry,
+    OnlineTrainer,
+    VWOnlineAdapter,
+    make_lifecycle,
+    score_outputs,
+    structural_digest,
+)
+from mmlspark_tpu.vw.learner import LearnerConfig, LinearLearner  # noqa: E402
+
+
+def _echo(df):
+    return df.with_column("reply", lambda p: p["value"])
+
+
+def _echo_twin(df):
+    """A distinct callable with byte-identical behavior (a candidate
+    that must pass the bitwise shadow gate)."""
+    return df.with_column("reply", lambda p: p["value"])
+
+
+def _diverging(df):
+    return df.with_column("reply", lambda p: [b"WRONG" for _ in p["id"]])
+
+
+def _df(ids, values, headers=None):
+    n = len(ids)
+    h = np.empty(n, dtype=object)
+    for i in range(n):
+        h[i] = (headers[i] if headers is not None else {})
+    return DataFrame.from_dict({
+        "id": np.asarray(ids, dtype=np.int64),
+        "value": np.asarray(values, dtype=object),
+        "headers": h,
+    })
+
+
+def _out(ids, replies, reply_col="reply"):
+    return DataFrame.from_dict({
+        "id": np.asarray(ids, dtype=np.int64),
+        reply_col: np.asarray(replies, dtype=object),
+    })
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_register_and_adopt(self):
+        reg = ModelRegistry()
+        live = reg.adopt_live(_echo, version="base")
+        cand = reg.register(_echo_twin)
+        assert live.state == LIVE and live.traffic_share == 1.0
+        assert cand.state == CANDIDATE and cand.version == "v2"
+        assert reg.live is live
+        assert [v.version for v in reg.versions()] == ["base", "v2"]
+        with pytest.raises(ValueError):
+            reg.adopt_live(_echo)  # live already set
+        with pytest.raises(ValueError):
+            reg.register(_echo, version="base")  # duplicate id
+
+    def test_state_machine_validation(self):
+        reg = ModelRegistry()
+        cand = reg.register(_echo_twin, version="c")
+        reg.transition("c", SHADOWING)
+        reg.transition("c", CANARY)
+        with pytest.raises(ValueError):
+            reg.transition("c", SHADOWING)  # no going back
+        with pytest.raises(ValueError):
+            reg.transition("c", "no_such_state")
+        reg.transition("c", ROLLED_BACK)
+        with pytest.raises(ValueError):
+            reg.transition("c", CANARY)  # terminal
+        assert cand.state == ROLLED_BACK
+
+    def test_swap_live_two_phase(self):
+        reg = ModelRegistry()
+        reg.adopt_live(_echo, version="base")
+        reg.register(_echo_twin, version="c")
+        reg.transition("c", CANARY)
+        applied = []
+
+        def apply(new, old):
+            # at apply time NOTHING has flipped yet: the incumbent is
+            # still live (the crash-window contract)
+            assert reg.live.version == "base"
+            applied.append((new.version, old.version))
+
+        reg.swap_live("c", apply=apply)
+        assert applied == [("c", "base")]
+        assert reg.live.version == "c"
+        assert reg.get("base").state == RETIRED
+        assert reg.get("base").traffic_share == 0.0
+        assert reg.get("c").traffic_share == 1.0
+        assert any(e["action"] == "promote" for e in reg.journal)
+
+    def test_swap_apply_failure_aborts_cleanly(self):
+        reg = ModelRegistry()
+        reg.adopt_live(_echo, version="base")
+        reg.register(_echo_twin, version="c")
+        reg.transition("c", CANARY)
+
+        def boom(new, old):
+            raise RuntimeError("executor wedged")
+
+        with pytest.raises(RuntimeError):
+            reg.swap_live("c", apply=boom)
+        assert reg.live.version == "base"
+        assert reg.get("c").state == CANARY  # retriable, not corrupted
+
+    def test_swap_from_illegal_state_refused(self):
+        reg = ModelRegistry()
+        reg.adopt_live(_echo, version="base")
+        reg.register(_echo_twin, version="c")  # still candidate
+        with pytest.raises(ValueError):
+            reg.swap_live("c")
+
+    def test_journal_bounded(self):
+        reg = ModelRegistry(journal_cap=16)
+        for i in range(200):
+            reg.register(_echo_twin, version=f"v{i}x")
+        assert len(reg.journal) <= 16
+        assert reg.transitions["register"] == 200
+
+    def test_summary_serializes(self):
+        reg = ModelRegistry()
+        reg.adopt_live(_echo, version="base", cost={"predict_ms": 3.0})
+        s = reg.summary()
+        assert s["live"] == "base"
+        assert s["versions"][0]["state"] == LIVE
+        assert s["versions"][0]["cost"] == {"predict_ms": 3.0}
+        json.dumps(s)  # the /_mmlspark/models payload must serialize
+
+    def test_structural_digest_fallbacks(self):
+        class Tok:
+            def cache_token(self):
+                return "m:abc"
+
+        assert structural_digest(Tok()) == "m:abc"
+        assert structural_digest((1, 2, 3)).startswith("p:")
+        # equal pickles -> equal digests; different -> different
+        assert structural_digest((1, 2)) == structural_digest((1, 2))
+        assert structural_digest((1, 2)) != structural_digest((1, 3))
+        # unpicklable falls back to a process-local id
+        assert structural_digest(lambda x: x).startswith("id:")
+
+
+# ---------------------------------------------------------------------------
+# Shadow scoring
+# ---------------------------------------------------------------------------
+
+class TestScoring:
+    def test_bitwise_match(self):
+        a = _out([1, 2, 3], [b"x", b"y", b"z"])
+        b = _out([1, 2, 3], [b"x", b"y", b"z"])
+        assert score_outputs(a, b) == (3, 0)
+
+    def test_bytes_divergence(self):
+        a = _out([1, 2], [b"x", b"y"])
+        b = _out([1, 2], [b"x", b"NOPE"])
+        assert score_outputs(a, b) == (2, 1)
+
+    def test_float_tolerance(self):
+        a = _out([1, 2], [1.0, 2.0])
+        b = _out([1, 2], [1.0 + 1e-9, 2.0])
+        assert score_outputs(a, b) == (2, 0)
+        c = _out([1, 2], [1.5, 2.0])
+        assert score_outputs(a, c) == (2, 1)
+
+    def test_pairs_by_id_not_position(self):
+        a = _out([1, 2], [b"x", b"y"])
+        b = _out([2, 1], [b"y", b"x"])  # reordered, same payloads
+        assert score_outputs(a, b) == (2, 0)
+
+    def test_unmatched_rows_are_divergent(self):
+        a = _out([1, 2], [b"x", b"y"])
+        b = _out([1, 3], [b"x", b"z"])  # id 2 missing, id 3 extra
+        scored, divergent = score_outputs(a, b)
+        assert scored == 3 and divergent == 2
+
+    def test_unreadable_output_scores_nothing(self):
+        assert score_outputs(object(), object()) == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# Controller (fake clock)
+# ---------------------------------------------------------------------------
+
+def _controller(cfg=None, warm=None, apply_swap=None):
+    clock = [1_000.0]
+    cfg = cfg or CanaryConfig(shadow_min_scored=4, steps=(0.05, 1.0),
+                              hold_s=5.0, min_step_requests=2,
+                              check_interval_s=0.0, burn_gate=1.0)
+    reg = ModelRegistry(clock=lambda: clock[0])
+    reg.adopt_live(_echo, version="base")
+    ctl = CanaryController(reg, cfg, warm=warm, apply_swap=apply_swap,
+                           clock=lambda: clock[0])
+    return ctl, reg, clock
+
+
+class TestController:
+    def test_shadow_gate_holds_until_scored(self):
+        ctl, reg, clock = _controller()
+        reg.register(_echo_twin, version="c")
+        ctl.rollout("c")
+        ver = reg.get("c")
+        assert ver.state == SHADOWING
+        clock[0] += 1.0
+        ctl.check()
+        assert ver.state == SHADOWING  # 0 scored < 4
+        ver.shadow_scored = 4
+        clock[0] += 1.0
+        ctl.check()
+        assert ver.state == CANARY
+        assert ver.traffic_share == 0.05
+
+    def test_shadow_divergence_rolls_back(self):
+        ctl, reg, clock = _controller()
+        reg.register(_diverging, version="c")
+        ctl.rollout("c")
+        ver = reg.get("c")
+        ver.shadow_scored = 8
+        ver.shadow_divergent = 2
+        clock[0] += 1.0
+        ctl.check()
+        assert ver.state == ROLLED_BACK
+        assert ver.traffic_share == 0.0
+        assert ctl.rollbacks == 1
+        assert any(e["action"] == "rollback"
+                   and e["reason"] == "divergence" for e in ctl.journal)
+
+    def test_shadow_errors_roll_back(self):
+        ctl, reg, clock = _controller()
+        reg.register(_echo_twin, version="c")
+        ctl.rollout("c")
+        reg.get("c").shadow_errors = 1
+        clock[0] += 1.0
+        ctl.check()
+        assert reg.get("c").state == ROLLED_BACK
+
+    def test_ramp_holds_then_advances_then_promotes(self):
+        order = []
+        ctl, reg, clock = _controller(
+            warm=lambda ver: order.append("warm") or "warmed",
+            apply_swap=lambda new, old: order.append("swap"))
+        reg.register(_echo_twin, version="c")
+        ctl.rollout("c")
+        ver = reg.get("c")
+        ver.shadow_scored = 4
+        clock[0] += 1.0
+        ctl.check()
+        assert ver.traffic_share == 0.05  # step 0
+        # hold_s not elapsed: no advance even with requests
+        ver.requests["canary"] += 2
+        clock[0] += 1.0
+        ctl.check()
+        assert ver.traffic_share == 0.05
+        # hold elapsed -> step 1 (100%)
+        clock[0] += 6.0
+        ctl.check()
+        assert ver.traffic_share == 1.0
+        # final step held -> promote, warm strictly before swap
+        ver.requests["canary"] += 2
+        clock[0] += 6.0
+        ctl.check()
+        assert ver.state == LIVE
+        assert reg.live is ver
+        assert order == ["warm", "swap"]
+        assert ctl.promotions == 1
+        assert ctl.active_version() is None
+
+    def test_burn_breach_rolls_back_without_hold(self):
+        ctl, reg, clock = _controller()
+        reg.register(_echo_twin, version="c")
+        ctl.rollout("c")
+        ver = reg.get("c")
+        ver.shadow_scored = 4
+        clock[0] += 1.0
+        ctl.check()
+        assert ver.state == CANARY
+        # every canary batch breaches the 250ms objective
+        ver.requests["canary"] += 4
+        for _ in range(4):
+            ver.slo.record(10.0)
+        clock[0] += 1.0  # < hold_s: the breach must NOT wait for the hold
+        ctl.check()
+        assert ver.state == ROLLED_BACK
+        assert any(e["reason"] == "slo_burn" for e in ctl.journal
+                   if e["action"] == "rollback")
+
+    def test_swap_failure_journaled_and_retried(self):
+        calls = []
+
+        def apply(new, old):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("transient")
+
+        ctl, reg, clock = _controller(apply_swap=apply)
+        reg.register(_echo_twin, version="c")
+        ctl.rollout("c")
+        ver = reg.get("c")
+        ver.shadow_scored = 4
+        clock[0] += 1.0
+        ctl.check()
+        ver.requests["canary"] += 2
+        clock[0] += 6.0
+        ctl.check()
+        ver.requests["canary"] += 2
+        clock[0] += 6.0
+        ctl.check()  # promote attempt 1: swap raises
+        assert reg.live.version == "base"  # incumbent keeps serving
+        assert any(e["action"] == "swap_failed" for e in ctl.journal)
+        clock[0] += 1.0
+        ctl.check()  # retried on the next tick
+        assert reg.live.version == "c"
+
+    def test_one_rollout_at_a_time(self):
+        ctl, reg, _clock = _controller()
+        reg.register(_echo_twin, version="c1")
+        reg.register(_echo_twin, version="c2")
+        ctl.rollout("c1")
+        with pytest.raises(ValueError):
+            ctl.rollout("c2")
+
+    def test_shadow_disabled_goes_straight_to_canary(self):
+        cfg = CanaryConfig(shadow_fraction=0.0, steps=(1.0,), hold_s=0.0,
+                           min_step_requests=0, check_interval_s=0.0)
+        ctl, reg, clock = _controller(cfg=cfg)
+        reg.register(_echo_twin, version="c")
+        ctl.rollout("c")
+        assert reg.get("c").state == CANARY
+        assert reg.get("c").traffic_share == 1.0
+
+    def test_summary_serializes(self):
+        ctl, reg, _clock = _controller()
+        reg.register(_echo_twin, version="c")
+        ctl.rollout("c")
+        s = ctl.summary()
+        assert s["active"] == "c" and s["state"] == SHADOWING
+        json.dumps(s)
+
+
+# ---------------------------------------------------------------------------
+# Plane (routing + shadow data path)
+# ---------------------------------------------------------------------------
+
+def _plane(**over):
+    kw = dict(shadow_fraction=1.0, shadow_min_scored=2, steps=(1.0,),
+              hold_s=0.0, min_step_requests=1, check_interval_s=0.0,
+              objective_ms=60_000.0)
+    kw.update(over)
+    clock = [1_000.0]
+    plane = LifecyclePlane(CanaryConfig(**kw), clock=lambda: clock[0])
+    plane.registry.adopt_live(_echo, version="base")
+    return plane, clock
+
+
+class TestPlane:
+    def test_routes_live_by_default(self):
+        plane, _clock = _plane()
+        out = plane(_df([1], [b"hello"]))
+        assert list(out.collect()["reply"]) == [b"hello"]
+        assert plane.registry.get("base").requests["live"] == 1
+
+    def test_attr_forwarding_sees_live_transform(self):
+        class T:
+            mega_k = 7
+
+            def __call__(self, df):
+                return _echo(df)
+
+        plane = LifecyclePlane(CanaryConfig())
+        plane.registry.adopt_live(T(), version="base")
+        assert plane.mega_k == 7
+        with pytest.raises(AttributeError):
+            plane.no_such_attr
+        with pytest.raises(AttributeError):
+            plane._private_probe
+
+    def test_canary_share_routes_deterministically(self):
+        plane, clock = _plane(shadow_fraction=0.0, steps=(1.0,),
+                              min_step_requests=0)
+        plane.deploy(_echo_twin, version="c")
+        assert plane.registry.get("c").state == CANARY
+        plane(_df([1], [b"x"]))
+        # share 1.0: every draw routes to the canary
+        assert plane.registry.get("c").requests["canary"] == 1
+        assert plane.registry.get("base").requests["live"] == 0
+
+    def test_shadow_duplicates_scored_not_fulfilled(self):
+        plane, _clock = _plane()
+        plane.deploy(_echo_twin, version="c")
+        cand = plane.registry.get("c")
+        assert cand.state == SHADOWING
+        plane.start()
+        try:
+            for i in range(6):
+                out = plane(_df([i], [b"payload%d" % i]))
+                # the client reply is ALWAYS the incumbent's
+                assert list(out.collect()["reply"]) == [b"payload%d" % i]
+            deadline = time.monotonic() + 10.0
+            while cand.shadow_scored < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            plane.stop()
+        assert cand.shadow_issued >= cand.shadow_scored > 0
+        assert cand.shadow_divergent == 0
+        assert cand.requests["canary"] == 0  # shadow took no real traffic
+
+    def test_shadow_divergence_counted(self):
+        plane, _clock = _plane()
+        plane.deploy(_diverging, version="c")
+        cand = plane.registry.get("c")
+        plane.start()
+        try:
+            for i in range(6):
+                plane(_df([i], [b"x"]))
+            deadline = time.monotonic() + 10.0
+            while cand.shadow_scored < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            plane.stop()
+        assert cand.shadow_divergent > 0
+
+    def test_shadow_candidate_errors_counted(self):
+        def broken(df):
+            raise RuntimeError("bad model")
+
+        plane, _clock = _plane()
+        plane.deploy(broken, version="c")
+        cand = plane.registry.get("c")
+        plane.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while cand.shadow_errors < 1 and time.monotonic() < deadline:
+                plane(_df([1], [b"x"]))
+                time.sleep(0.01)
+        finally:
+            plane.stop()
+        assert cand.shadow_errors >= 1
+
+    def test_full_promotion_via_ticks(self):
+        plane, clock = _plane(shadow_fraction=0.0)
+        plane.deploy(_echo_twin, version="c")
+        clock[0] += 1.0
+        plane(_df([1], [b"x"]))  # canary batch (share 1.0)
+        clock[0] += 1.0
+        plane.tick(0.01)
+        assert plane.registry.live.version == "c"
+        assert plane.registry.get("base").state == RETIRED
+        # traffic keeps flowing through the new live
+        out = plane(_df([2], [b"y"]))
+        assert list(out.collect()["reply"]) == [b"y"]
+
+    def test_make_lifecycle_coercions(self):
+        assert make_lifecycle(None) is None
+        assert make_lifecycle(False) is None
+        p = make_lifecycle(True)
+        assert isinstance(p, LifecyclePlane)
+        assert make_lifecycle(p) is p
+        p2 = make_lifecycle({"shadow_fraction": 0.5})
+        assert p2.config.shadow_fraction == 0.5
+        p3 = make_lifecycle(CanaryConfig(seed=3))
+        assert p3.config.seed == 3
+        with pytest.raises(TypeError):
+            make_lifecycle(3)
+
+    def test_summary_serializes(self):
+        plane, _clock = _plane()
+        json.dumps(plane.summary())
+
+
+# ---------------------------------------------------------------------------
+# Train-on-serve: journal, adapters, bitwise resume
+# ---------------------------------------------------------------------------
+
+def _sparse_rows(n, seed=0, nnz=3):
+    rng = np.random.default_rng(seed)
+    rows, labels = [], []
+    for _ in range(n):
+        idx = rng.choice(64, size=nnz, replace=False)
+        rows.append({"indices": [int(i) for i in idx],
+                     "values": [float(v) for v in
+                                rng.normal(size=nnz).round(3)]})
+        labels.append(float(rng.integers(0, 2)))
+    return rows, labels
+
+
+class TestFeedbackJournal:
+    def test_append_read_count(self, tmp_path):
+        j = FeedbackJournal(str(tmp_path / "fb.jsonl"))
+        rows, labels = _sparse_rows(5)
+        assert j.append(rows, labels) == 5
+        assert j.count() == 5
+        back = j.read(1, 3)
+        assert len(back) == 3
+        assert back[0] == (rows[1], labels[1])
+        with pytest.raises(ValueError):
+            j.append(rows, labels[:-1])
+        j.close()
+
+    def test_reopen_counts_existing(self, tmp_path):
+        path = str(tmp_path / "fb.jsonl")
+        j = FeedbackJournal(path)
+        rows, labels = _sparse_rows(4)
+        j.append(rows, labels)
+        j.close()
+        j2 = FeedbackJournal(path)
+        assert j2.count() == 4
+        j2.append(rows[:1], labels[:1])
+        assert j2.count() == 5
+        j2.close()
+
+
+def _vw_cfg():
+    return LearnerConfig(num_bits=8)
+
+
+class TestLinearLearner:
+    def test_chunked_equals_single_batch_bitwise(self):
+        rows, labels = _sparse_rows(16, nnz=3)  # equal nnz: equal padding
+        a = LinearLearner(_vw_cfg())
+        a.partial_fit(rows, labels)
+        b = LinearLearner(_vw_cfg())
+        for k in range(0, 16, 4):
+            b.partial_fit(rows[k:k + 4], labels[k:k + 4])
+        sa, sb = a.state_dict(), b.state_dict()
+        assert sa["t"] == sb["t"]
+        np.testing.assert_array_equal(sa["w"], sb["w"])
+        np.testing.assert_array_equal(sa["g2"], sb["g2"])
+
+    def test_state_dict_round_trip_continues_bitwise(self):
+        rows, labels = _sparse_rows(12)
+        a = LinearLearner(_vw_cfg())
+        a.partial_fit(rows[:8], labels[:8])
+        b = LinearLearner(_vw_cfg()).load_state_dict(a.state_dict())
+        a.partial_fit(rows[8:], labels[8:])
+        b.partial_fit(rows[8:], labels[8:])
+        np.testing.assert_array_equal(a.state_dict()["w"],
+                                      b.state_dict()["w"])
+        np.testing.assert_array_equal(a.state_dict()["g2"],
+                                      b.state_dict()["g2"])
+
+    def test_ftrl_state_round_trip(self):
+        cfg = LearnerConfig(num_bits=8, ftrl=True,
+                            loss_function="logistic")
+        rows, labels = _sparse_rows(8)
+        a = LinearLearner(cfg)
+        a.partial_fit(rows, labels)
+        sd = a.state_dict()
+        assert sd["kind"] == "ftrl"
+        b = LinearLearner(cfg).load_state_dict(sd)
+        np.testing.assert_array_equal(a.weights, b.weights)
+        with pytest.raises(ValueError):
+            LinearLearner(_vw_cfg()).load_state_dict(sd)  # kind mismatch
+
+    def test_predict_shape(self):
+        rows, labels = _sparse_rows(6)
+        lr = LinearLearner(_vw_cfg())
+        lr.partial_fit(rows, labels)
+        assert lr.predict(rows).shape == (6,)
+        assert lr.examples_seen == 6
+
+
+class _FakePlane:
+    def __init__(self):
+        self.deployed = []
+
+    def attach_online(self, trainer):
+        pass
+
+    def deploy(self, transform, **kw):
+        self.deployed.append((transform, kw))
+
+
+class TestOnlineTrainer:
+    def _trainer(self, tmp_path, adapter=None, **kw):
+        adapter = adapter or VWOnlineAdapter(_vw_cfg())
+        kw.setdefault("batch_rows", 4)
+        return OnlineTrainer(adapter, str(tmp_path / "fb.jsonl"),
+                             str(tmp_path / "ck.json"), **kw)
+
+    def test_feed_then_train_full_batches_only(self, tmp_path):
+        t = self._trainer(tmp_path)
+        rows, labels = _sparse_rows(10)
+        t.feed(rows, labels)
+        assert t.pending() == 10
+        steps = t.train_pending()
+        assert steps == 2  # two full batches of 4; 2 rows remain
+        assert t.consumed == 8 and t.pending() == 2
+        assert t.train_pending(flush=True) == 1
+        assert t.consumed == 10
+        t.stop()
+
+    def test_checkpoint_resume_is_bitwise(self, tmp_path):
+        """Kill at checkpoint k, resume, replay -> state bitwise-equal to
+        the uninterrupted run (the acceptance contract)."""
+        rows, labels = _sparse_rows(16)
+
+        # uninterrupted reference
+        ref = OnlineTrainer(VWOnlineAdapter(_vw_cfg()),
+                            str(tmp_path / "ref.jsonl"),
+                            str(tmp_path / "ref.ck"), batch_rows=4)
+        ref.feed(rows, labels)
+        ref.train_pending()
+        ref_state = ref.adapter.to_json(ref.state)
+        ref.stop()
+
+        # interrupted run: fold 2 steps (checkpointing each), then "crash"
+        t1 = OnlineTrainer(VWOnlineAdapter(_vw_cfg()),
+                           str(tmp_path / "fb.jsonl"),
+                           str(tmp_path / "ck.json"), batch_rows=4)
+        t1.feed(rows, labels)
+        t1.train_pending(max_steps=2)
+        assert t1.step == 2
+        t1.journal.close()  # crash: no stop(), state object dropped
+
+        # a fresh process resumes from the checkpoint and replays the tail
+        t2 = OnlineTrainer(VWOnlineAdapter(_vw_cfg()),
+                           str(tmp_path / "fb.jsonl"),
+                           str(tmp_path / "ck.json"), batch_rows=4)
+        assert t2.resume() is True
+        assert t2.step == 2 and t2.consumed == 8
+        t2.train_pending()
+        assert t2.consumed == 16
+        assert t2.adapter.to_json(t2.state) == ref_state  # bitwise
+        t2.stop()
+
+    def test_resume_without_checkpoint_replays_from_scratch(self, tmp_path):
+        t = self._trainer(tmp_path, checkpoint_every=100)  # never ckpts
+        rows, labels = _sparse_rows(8)
+        t.feed(rows, labels)
+        t.train_pending()
+        state = t.adapter.to_json(t.state)
+        t.journal.close()
+        t2 = self._trainer(tmp_path, checkpoint_every=100)
+        assert t2.resume() is False
+        t2.train_pending()
+        assert t2.adapter.to_json(t2.state) == state
+        t2.stop()
+
+    def test_bad_checkpoint_format_rejected(self, tmp_path):
+        t = self._trainer(tmp_path)
+        with open(t.checkpoint_path, "w", encoding="utf-8") as fh:
+            json.dump({"format": "something_else"}, fh)
+        with pytest.raises(ValueError):
+            t.resume()
+        t.stop()
+
+    def test_publish_hands_off_to_plane(self, tmp_path):
+        plane = _FakePlane()
+        t = self._trainer(tmp_path, publish_after=8)
+        t.attach_plane(plane)
+        rows, labels = _sparse_rows(8)
+        t.feed(rows, labels)
+        t.train_pending()
+        assert t.published == 1
+        (transform, kw), = plane.deployed
+        assert kw["version"] == "online-2"
+        assert kw["digest"].startswith("o:")
+        assert kw["cost"] == {"examples": 8}
+        # the published transform serves sparse-row bodies
+        bodies = np.asarray([json.dumps(r).encode() for r in rows[:2]],
+                            dtype=object)
+        out = transform(DataFrame.from_dict(
+            {"id": np.asarray([0, 1]), "value": bodies}))
+        assert len(out.collect()["reply"]) == 2
+        t.stop()
+
+    def test_publish_failure_counted_not_fatal(self, tmp_path):
+        class Boom(_FakePlane):
+            def deploy(self, transform, **kw):
+                raise ValueError("rollout already active")
+
+        t = self._trainer(tmp_path, publish_after=4)
+        t.attach_plane(Boom())
+        rows, labels = _sparse_rows(4)
+        t.feed(rows, labels)
+        assert t.train_pending() == 1
+        assert t.publish_failed == 1 and t.published == 0
+        t.stop()
+
+    def test_gbdt_adapter_refit_and_resume(self, tmp_path):
+        adapter = GBDTRefitAdapter(max_rows=64)
+        rng = np.random.default_rng(3)
+        rows = [[float(v) for v in rng.normal(size=3)] for _ in range(24)]
+        labels = [float(r[0] > 0) for r in rows]
+        t = OnlineTrainer(adapter, str(tmp_path / "fb.jsonl"),
+                          str(tmp_path / "ck.json"), batch_rows=8)
+        t.feed(rows, labels)
+        t.train_pending(max_steps=1)
+        t.journal.close()
+        t2 = OnlineTrainer(GBDTRefitAdapter(max_rows=64),
+                           str(tmp_path / "fb.jsonl"),
+                           str(tmp_path / "ck.json"), batch_rows=8)
+        assert t2.resume() is True
+        t2.train_pending()
+        assert t2.state["y"] == labels  # the buffer IS the state
+        transform = t2.adapter.make_transform(t2.state)
+        bodies = np.asarray([json.dumps(r).encode() for r in rows[:4]],
+                            dtype=object)
+        out = transform(DataFrame.from_dict(
+            {"id": np.asarray([0, 1, 2, 3]), "value": bodies}))
+        assert len(out.collect()["reply"]) == 4
+        t2.stop()
+
+    def test_gbdt_buffer_bounded(self):
+        adapter = GBDTRefitAdapter(max_rows=4)
+        state = adapter.fresh()
+        for i in range(10):
+            adapter.step(state, [[float(i)]], [float(i)])
+        assert state["y"] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_gbdt_adapter_accepts_scalar_rows(self):
+        # the header-labeled feedback path journals whatever the request
+        # column held — a scalar feature must fold, not crash training
+        adapter = GBDTRefitAdapter()
+        state = adapter.step(adapter.fresh(), [3.0, {"values": 4.0}, [5.0]],
+                             [1.0, 2.0, 3.0])
+        assert state["X"] == [[3.0], [4.0], [5.0]]
+
+    def test_summary_serializes(self, tmp_path):
+        t = self._trainer(tmp_path)
+        json.dumps(t.summary())
+        t.stop()
+
+
+# ---------------------------------------------------------------------------
+# ONNX identity: cross-process digest stability (satellite)
+# ---------------------------------------------------------------------------
+
+def _tiny_onnx_blob():
+    import mmlspark_tpu.onnx.proto as proto
+
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    return proto.make_model(
+        [proto.make_node("Gemm", ["input", "w"], ["out"], name="g",
+                         transB=1)],
+        [proto.make_tensor("w", w)],
+        [proto.make_value_info("input", [None, 3])],
+        [proto.make_value_info("out", [None, 4])])
+
+
+_DIGEST_SNIPPET = """
+import sys
+sys.path.insert(0, {repo!r})
+from mmlspark_tpu.onnx import import_onnx
+fm = import_onnx({path!r})
+print(fm.cache_token())
+"""
+
+
+class TestOnnxDigest:
+    def test_cache_token_stable_across_processes(self, tmp_path):
+        """Two fresh interpreters (fresh PYTHONHASHSEED) agree on the
+        imported model's cache_token — the digest the registry and the
+        fleet's persistent compile cache both key on."""
+        path = str(tmp_path / "m.onnx")
+        with open(path, "wb") as fh:
+            fh.write(_tiny_onnx_blob())
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        code = _DIGEST_SNIPPET.format(repo=repo, path=path)
+        tokens = []
+        for seed in ("1", "2"):
+            env = dict(os.environ, PYTHONHASHSEED=seed,
+                       JAX_PLATFORMS="cpu")
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=300, env=env)
+            assert proc.returncode == 0, proc.stderr
+            tokens.append(proc.stdout.strip().splitlines()[-1])
+        assert tokens[0] == tokens[1]
+        assert tokens[0].startswith("m:")
+
+    def test_imported_model_registers_as_candidate(self, tmp_path):
+        from mmlspark_tpu.onnx import import_onnx
+
+        path = str(tmp_path / "m.onnx")
+        with open(path, "wb") as fh:
+            fh.write(_tiny_onnx_blob())
+        fm = import_onnx(path)
+        reg = ModelRegistry()
+        ver = reg.register(fm, stage=fm)
+        assert ver.state == CANDIDATE
+        assert ver.digest == fm.cache_token()
+        # re-importing the same bytes yields the same structural digest
+        fm2 = import_onnx(path)
+        assert structural_digest(fm2) == ver.digest
+
+
+# ---------------------------------------------------------------------------
+# Serving integration
+# ---------------------------------------------------------------------------
+
+def _post(address, body, headers=None):
+    req = urllib.request.Request(address, data=body, method="POST",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return resp.status, resp.read()
+
+
+_E2E_CFG = {"shadow_fraction": 1.0, "shadow_min_scored": 3,
+            "steps": (1.0,), "hold_s": 0.0, "min_step_requests": 1,
+            "check_interval_s": 0.0, "objective_ms": 60_000.0}
+
+
+class TestServingIntegration:
+    def test_models_endpoint_and_stats_and_metrics(self):
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=1.0,
+                            lifecycle=True)
+        with srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            _post(srv.address, b'{"x":1}')
+            models = json.loads(urllib.request.urlopen(
+                base + "/_mmlspark/models", timeout=15).read())
+            stats = json.loads(urllib.request.urlopen(
+                base + "/_mmlspark/stats", timeout=15).read())
+            metrics = urllib.request.urlopen(
+                base + "/_mmlspark/metrics", timeout=15).read().decode()
+        assert models["registry"]["live"] is not None
+        assert models["registry"]["versions"][0]["state"] == LIVE
+        assert "lifecycle" in stats
+        assert "mmlspark_model_info" in metrics
+        assert "mmlspark_model_requests_total" in metrics
+        assert "mmlspark_model_transitions_total" in metrics
+
+    def test_models_404_when_disabled(self):
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=1.0)
+        with srv:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/_mmlspark/models",
+                    timeout=15)
+            assert e.value.code == 404
+
+    def test_lifecycle_false_is_bitwise_identical(self):
+        """lifecycle=False (the default) serves byte-identical replies and
+        an identical stats/metrics surface to a server built without the
+        knob — the conditional-emission parity contract."""
+        from mmlspark_tpu.serving.server import ServingServer
+
+        bodies = [json.dumps({"i": i}).encode() for i in range(4)]
+
+        def collect(srv):
+            replies = []
+            with srv:
+                for b in bodies:
+                    replies.append(_post(srv.address, b)[1])
+                base = f"http://127.0.0.1:{srv.port}"
+                stats = json.loads(urllib.request.urlopen(
+                    base + "/_mmlspark/stats", timeout=15).read())
+                metrics = urllib.request.urlopen(
+                    base + "/_mmlspark/metrics",
+                    timeout=15).read().decode()
+            return replies, stats, metrics
+
+        off = ServingServer(_echo, port=0, max_wait_ms=1.0,
+                            lifecycle=False)
+        plain = ServingServer(_echo, port=0, max_wait_ms=1.0)
+        r_off, s_off, m_off = collect(off)
+        r_plain, _s_plain, m_plain = collect(plain)
+        assert r_off == r_plain
+        assert off._lifecycle is None
+        assert "lifecycle" not in s_off
+        assert "mmlspark_model_" not in m_off
+        def names(exposition):
+            return sorted(ln.split("{")[0].split(" ")[0]
+                          for ln in exposition.splitlines()
+                          if ln and not ln.startswith("#"))
+
+        assert names(m_off) == names(m_plain)
+
+    def test_e2e_shadow_canary_promote(self):
+        """The acceptance rollout: a byte-identical candidate moves
+        shadow -> canary -> live through a LIVE server while every client
+        reply stays exactly the incumbent's bytes; shadow counters prove
+        traffic was duplicated with zero client effect."""
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=1.0,
+                            lifecycle=dict(_E2E_CFG))
+        with srv:
+            plane = srv._lifecycle
+            assert isinstance(plane, LifecyclePlane)
+            assert srv.transform is plane
+            plane.deploy(_echo_twin, version="cand")
+            cand = plane.registry.get("cand")
+            deadline = time.monotonic() + 30.0
+            i = 0
+            while time.monotonic() < deadline:
+                body = b"payload-%d" % i
+                status, reply = _post(srv.address, body)
+                assert (status, reply) == (200, body)
+                i += 1
+                if plane.registry.live.version == "cand":
+                    break
+                time.sleep(0.01)
+            assert plane.registry.live.version == "cand"
+            assert plane.registry.versions()[0].state == RETIRED
+            assert cand.shadow_issued > 0 and cand.shadow_scored > 0
+            assert cand.shadow_divergent == 0
+            assert plane.controller.promotions == 1
+            # and the promoted model keeps serving bitwise
+            status, reply = _post(srv.address, b"after-promote")
+            assert (status, reply) == (200, b"after-promote")
+
+    def test_e2e_divergent_candidate_rolled_back(self):
+        """The inverse: a diverging candidate is auto-rolled-back and the
+        incumbent's replies never change."""
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=1.0,
+                            lifecycle=dict(_E2E_CFG))
+        with srv:
+            plane = srv._lifecycle
+            plane.deploy(_diverging, version="bad")
+            cand = plane.registry.get("bad")
+            deadline = time.monotonic() + 30.0
+            i = 0
+            while time.monotonic() < deadline:
+                body = b"p-%d" % i
+                status, reply = _post(srv.address, body)
+                assert (status, reply) == (200, body)  # incumbent bytes
+                i += 1
+                if cand.state == ROLLED_BACK:
+                    break
+                time.sleep(0.01)
+            assert cand.state == ROLLED_BACK
+            assert plane.registry.live.version != "bad"
+            assert plane.controller.rollbacks == 1
+            status, reply = _post(srv.address, b"still-fine")
+            assert (status, reply) == (200, b"still-fine")
+
+    def test_feedback_endpoint_and_label_header(self, tmp_path):
+        from mmlspark_tpu.serving.server import ServingServer
+        from mmlspark_tpu.serving.lifecycle import LABEL_HEADER
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=1.0,
+                            lifecycle=True)
+        with srv:
+            trainer = OnlineTrainer(VWOnlineAdapter(_vw_cfg()),
+                                    str(tmp_path / "fb.jsonl"),
+                                    batch_rows=4)
+            trainer.attach_plane(srv._lifecycle)
+            rows, labels = _sparse_rows(3)
+            status, body = _post(
+                f"http://127.0.0.1:{srv.port}/_mmlspark/feedback",
+                json.dumps({"rows": rows, "labels": labels}).encode())
+            assert status == 200
+            assert json.loads(body)["journaled"] == 3
+            assert trainer.pending() == 3
+            # in-band: a labeled prediction request is ALSO an example
+            status, reply = _post(
+                srv.address, json.dumps(rows[0]).encode(),
+                {LABEL_HEADER: "1.0"})
+            assert status == 200
+            deadline = time.monotonic() + 10.0
+            while trainer.pending() < 4 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert trainer.pending() == 4
+            trainer.stop()
+
+    def test_feedback_404_when_disabled(self):
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=1.0)
+        with srv:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"http://127.0.0.1:{srv.port}/_mmlspark/feedback",
+                      b'{"rows": [], "labels": []}')
+            assert e.value.code == 404
+
+    def test_serve_pipeline_wires_hooks(self):
+        from mmlspark_tpu.serving.server import serve_pipeline
+        from mmlspark_tpu.stages.basic import UDFTransformer
+
+        stage = UDFTransformer(
+            inputCol="data", outputCol="out",
+            udf=lambda v: float(np.asarray(v).sum()))
+        srv = serve_pipeline(stage, input_col="data", port=0,
+                             max_wait_ms=0.0, lifecycle=True)
+        try:
+            assert srv._lifecycle_spec is True
+            assert srv._lifecycle_hooks["live_stage"] is stage
+            assert callable(srv._lifecycle_hooks["warm"])
+        finally:
+            srv.stop()
+
+    def test_serve_pipeline_end_to_end(self):
+        from mmlspark_tpu.serving.server import serve_pipeline
+        from mmlspark_tpu.stages.basic import UDFTransformer
+
+        stage = UDFTransformer(
+            inputCol="data", outputCol="out",
+            udf=lambda v: float(np.asarray(v).sum()) * 2)
+        srv = serve_pipeline(stage, input_col="data", port=0,
+                             max_wait_ms=0.0, lifecycle=True)
+        with srv:
+            assert isinstance(srv.transform, LifecyclePlane)
+            status, reply = _post(srv.address,
+                                  json.dumps({"data": [1.0, 2.0]}).encode())
+            assert (status, reply) == (200, b"6.0")
+            models = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/_mmlspark/models",
+                timeout=15).read())
+            assert models["registry"]["versions"][0]["requests"]["live"] >= 1

@@ -661,3 +661,30 @@ class TestPerfReport:
     def test_empty_table(self):
         tool = self._tool()
         assert "no fused segments" in tool.render_table([])
+
+    def test_render_lifecycle_section(self):
+        tool = self._tool()
+        lc = {"registry": {
+            "live": "v2",
+            "versions": [
+                {"version": "v1", "state": "retired", "traffic_share": 0.0,
+                 "requests": {"live": 40, "canary": 0},
+                 "shadow": {"issued": 0, "scored": 0, "divergent": 0,
+                            "errors": 0},
+                 "divergence_rate": 0.0},
+                {"version": "v2", "state": "live", "traffic_share": 1.0,
+                 "requests": {"live": 7, "canary": 5},
+                 "shadow": {"issued": 12, "scored": 10, "divergent": 1,
+                            "errors": 0},
+                 "divergence_rate": 0.1,
+                 "burn": {"60": 0.5, "300": 2.0}}],
+            "transitions": {"promote": 1}},
+            "canary": {"active": None, "rollouts": 1, "promotions": 1,
+                       "rollbacks": 0},
+            "online": {"adapter": "vw", "step": 3, "consumed": 24,
+                       "pending": 2, "published": 1, "publish_failed": 0}}
+        text = tool.render_lifecycle(lc)
+        assert "live=v2" in text and "promotions=1" in text
+        assert "retired" in text and "10/12" in text
+        assert "2" in text  # worst burn window surfaces
+        assert "online trainer [vw]: step=3" in text

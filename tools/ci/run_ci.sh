@@ -45,6 +45,7 @@ PACKAGES=(
   "tests/test_autotune.py"
   "tests/test_ingest_zero_copy.py"
   "tests/test_fleet.py"
+  "tests/test_lifecycle.py"
   "tests/test_benchmarks_extended.py"
   "tests/test_sharding.py"
   "tests/test_multiprocess.py"

@@ -25,6 +25,12 @@ When the server (or demo) carries an auto-tuner (core/tune.py), a second
 section renders the chosen-vs-default knobs and the model's
 predicted-vs-measured error per (segment, bucket) — the honesty check the
 ISSUE's acceptance criteria ask for.
+
+When the server runs the model lifecycle plane (serving/lifecycle), a
+per-version section renders from the ``lifecycle`` stats key: state,
+traffic share, request/shadow counters, divergence rate, and worst SLO
+burn for every registered version, plus the canary controller's rollout
+counters and the online trainer's progress.
 """
 
 from __future__ import annotations
@@ -228,6 +234,51 @@ def render_fleet(fleet: Optional[Dict[str, Any]],
     return "\n".join(lines)
 
 
+def render_lifecycle(lc: Dict[str, Any]) -> str:
+    """Lifecycle section: one row per model version (state, traffic share,
+    request/shadow counters, divergence, worst SLO burn) plus the canary
+    controller's rollout counters — from the server's ``lifecycle`` stats
+    key (serving/lifecycle/, docs/lifecycle.md)."""
+    reg = lc.get("registry") or {}
+    canary = lc.get("canary") or {}
+    lines = [
+        f"Lifecycle: live={reg.get('live')} "
+        f"active={canary.get('active') or '-'} "
+        f"rollouts={canary.get('rollouts', 0)} "
+        f"promotions={canary.get('promotions', 0)} "
+        f"rollbacks={canary.get('rollbacks', 0)}"]
+    versions = reg.get("versions") or []
+    if versions:
+        cells = [["version", "state", "share", "live req", "canary req",
+                  "shadow", "div rate", "max burn"]]
+        for v in versions:
+            reqs = v.get("requests") or {}
+            shadow = v.get("shadow") or {}
+            burn = v.get("burn") or {}
+            cells.append([
+                str(v.get("version")), str(v.get("state")),
+                _fmt(v.get("traffic_share")),
+                _fmt(reqs.get("live", 0)), _fmt(reqs.get("canary", 0)),
+                f"{shadow.get('scored', 0)}/{shadow.get('issued', 0)}",
+                _fmt(v.get("divergence_rate")),
+                _fmt(max(burn.values()) if burn else None)])
+        widths = [max(len(r[i]) for r in cells) for i in range(len(cells[0]))]
+        for j, row in enumerate(cells):
+            lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths))
+                         .rstrip())
+            if j == 0:
+                lines.append("  ".join("-" * w for w in widths))
+    online = lc.get("online")
+    if online:
+        lines.append(
+            f"online trainer [{online.get('adapter')}]: "
+            f"step={online.get('step')} consumed={online.get('consumed')} "
+            f"pending={online.get('pending')} "
+            f"published={online.get('published')} "
+            f"publish_failed={online.get('publish_failed')}")
+    return "\n".join(lines)
+
+
 def rows_from_trace(path: str) -> List[Dict[str, Any]]:
     """Aggregate ``segment:*`` spans from a JSONL trace dump: mean duration
     per segment, the cost attrs the spans carry, and the trace ids seen
@@ -329,7 +380,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
 
-    slo = tuner = fleet = cache = None
+    slo = tuner = fleet = cache = lifecycle = None
     if args.url:
         url = args.url.rstrip("/") + "/_mmlspark/stats"
         with urllib.request.urlopen(url, timeout=args.timeout) as resp:
@@ -339,6 +390,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         tuner = stats.get("tuner")
         fleet = stats.get("fleet")
         cache = (stats.get("fusion") or {}).get("compile_cache")
+        lifecycle = stats.get("lifecycle")
     elif args.trace:
         rows = rows_from_trace(args.trace)
     else:
@@ -346,7 +398,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.as_json:
         print(json.dumps({"segments": rows, "slo": slo, "tuner": tuner,
-                          "fleet": fleet, "compile_cache": cache}))
+                          "fleet": fleet, "compile_cache": cache,
+                          "lifecycle": lifecycle}))
         return 0
     print(render_table(rows))
     if tuner:
@@ -355,6 +408,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if fleet or (cache or {}).get("persistent"):
         print()
         print(render_fleet(fleet, cache))
+    if lifecycle and not lifecycle.get("error"):
+        print()
+        print(render_lifecycle(lifecycle))
     if slo:
         burns = ", ".join(f"{w}s={rec['burn_rate']}"
                           for w, rec in sorted(
