@@ -18,7 +18,12 @@ Flagged inside a jittable body:
 The pass resolves jittable functions **within one module**: the argument of
 a jit/DeviceFn call site must be a plain name bound by a ``def`` in the same
 file (the repo's universal idiom — closures jitted right where they are
-defined). ``prepare``/``finalize`` of DeviceFn are host shims and exempt.
+defined). ``prepare``/``finalize`` of DeviceFn are host shims and exempt —
+but a ``device_finalize=`` argument is a TRANSPILED host shim (a finalizer
+ported into the fused jit for cross-segment stitching, core/fusion.py) and
+is held to a STRICTER bar: besides every jittable-body rule, any bare
+``np.*`` / ``numpy.*`` call is a finding, because inside the fused trace it
+silently constant-folds the finalizer's math at trace time.
 
 D001 also covers **ring staging callbacks**: the batch source and ``put``
 arguments of ``TransferRing(...)`` / ``DevicePrefetcher(...)``. Those run
@@ -84,6 +89,22 @@ def _jitted_names(tree: ast.AST) -> Dict[str, int]:
                 if _is_jit_expr(dec):
                     jitted.setdefault(node.name, node.lineno)
     return jitted
+
+
+def _transpiled_names(tree: ast.AST) -> Dict[str, int]:
+    """{function name: reporting line} for every module-local name passed
+    as a ``device_finalize=`` keyword — a host finalizer TRANSPILED into
+    the fused jit (the cross-segment stitch shim). Matched on ANY call,
+    not just a literal ``DeviceFn(...)``: stages route the shim through
+    builder helpers (``self._score_device_fn(..., device_finalize=f)``)."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kw = call_keyword(node, "device_finalize")
+        if isinstance(kw, ast.Name):
+            out.setdefault(kw.id, kw.lineno)
+    return out
 
 
 def _host_call_reason(node: ast.Call) -> Optional[str]:
@@ -224,6 +245,9 @@ class DevicePurityPass(AnalysisPass):
             return findings
         findings.extend(self._check_staging(sf))
         jitted = _jitted_names(sf.tree)
+        transpiled = _transpiled_names(sf.tree)
+        for name, line in transpiled.items():
+            jitted.setdefault(name, line)
         if not jitted:
             return findings
         for node in ast.walk(sf.tree):
@@ -246,6 +270,16 @@ class DevicePurityPass(AnalysisPass):
             for inner in ast.walk(node):
                 if isinstance(inner, ast.Call):
                     reason = _host_call_reason(inner)
+                    if reason is None and node.name in transpiled:
+                        # transpiled finalizers run INSIDE the fused trace:
+                        # bare numpy there constant-folds at trace time
+                        cname = dotted_name(inner.func)
+                        if cname is not None and (
+                                cname.startswith("np.")
+                                or cname.startswith("numpy.")):
+                            reason = (f"host numpy call '{cname}' — "
+                                      f"transpiled finalizers must use "
+                                      f"jnp only")
                     if reason:
                         findings.append(Finding(
                             sf.rel, inner.lineno, "D001",

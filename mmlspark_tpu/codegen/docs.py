@@ -63,6 +63,16 @@ _EXTRA_INDEX = [
     "(module + params → ONNX bytes), `proto` (eval-free model "
     "reader/writer: `load_model`, `make_model`, `make_node`, "
     "`make_tensor`)",
+    "- compiler search (`mmlspark_tpu.core.kernels` + the fusion stitch, "
+    "hand-maintained guide in "
+    "[docs/compiler_search.md](../compiler_search.md)): `KernelVariant` / "
+    "`register` / `activate` / `variants_for` (autotuned Pallas / "
+    "forest-traversal kernel variants; exact variants enforced bitwise, "
+    "reduction-order-sensitive ones behind a declared tolerance), "
+    "cross-segment stitching through transpiled `device_finalize` shims "
+    "(`Segment.mark_stitched`, `SegmentCostModel.stitch_decision`), and "
+    "the journaled `kernel_variants` / `stitch` knobs with one-step "
+    "bitwise rollback (the `tuner.kernel_apply` chaos seam)",
     "- model lifecycle (`mmlspark_tpu.serving.lifecycle`, hand-maintained "
     "guide in [docs/lifecycle.md](../lifecycle.md)): `ModelRegistry` / "
     "`ModelVersion` (versioned states, journaled transitions, two-phase "

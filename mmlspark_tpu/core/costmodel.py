@@ -36,6 +36,12 @@ The public surface the Tuner (core/tune.py) consumes:
     prediction and the summed host-stage measurements are trustworthy,
     None otherwise (the planner then falls back to the light-segment
     heuristic, core/fusion.py plan()).
+  - ``observe_variant(segment, bucket, variant, seconds)`` folds measured
+    kernel-variant trials; ``choose_variant(segment, bucket)`` returns the
+    per-(segment, bucket) winner (None keeps the built-in default);
+    ``stitch_decision(upstream, downstream)`` prices a cross-segment
+    stitch against the measured readback + H2D round-trip it removes —
+    both gated on calibration so cold start stays bitwise-identical.
   - ``observe_collective(op, nbytes, seconds)`` folds measured
     all-reduce / all-gather probe times (parallel/shardplan.py
     ``measure_collectives``); ``collective_ms(op, nbytes)`` is the fitted
@@ -67,11 +73,27 @@ _WALL_STAGES = ("h2d_s", "dispatch_s", "compute_s", "readback_s")
 
 def bucket_of_shape(shape_key: str) -> Optional[int]:
     """Leading (batch) dim of a CompileCache shape key
-    (``"col=64x32x32x3:uint8;..."`` -> 64); None when unparseable."""
+    (``"col=64x32x32x3:uint8;..."`` -> 64); None when unparseable.
+
+    The first token must be a structurally valid SHAPE entry —
+    ``<col>=<d1>x...x<dn>:<dtype>`` with every dim an integer — so ANY
+    decorated prefix (``mega{k};``, ``spec=...;``, ``variant=<id>;``,
+    ``stitch=...;`` or future ones) is rejected generically rather than by
+    per-prefix special cases. Decorated keys carry executor state, not a
+    batch shape; parsing one here would leak a bogus bucket into the
+    analytic cost tables."""
     try:
         first = shape_key.split(";", 1)[0]
-        dims = first.split("=", 1)[1].rsplit(":", 1)[0]
-        return int(dims.split("x", 1)[0])
+        name, eq, value = first.partition("=")
+        if not eq or not name or "{" in name or "}" in name:
+            return None
+        dims, colon, dtype = value.rpartition(":")
+        if not colon or not dtype or not dims:
+            return None
+        parts = dims.split("x")
+        if not all(p.isdigit() for p in parts):
+            return None
+        return int(parts[0])
     except (IndexError, ValueError):
         return None
 
@@ -168,6 +190,9 @@ class SegmentCostModel:
         # collective op ("all_reduce"/"all_gather") -> [(bytes, ms), ...]
         # measured probe points (bounded), the α·bytes sharding term
         self._collective: Dict[str, List[Tuple[float, float]]] = {}
+        # (segment, bucket, variant id) -> [ewma wall ms, n] — measured
+        # kernel-variant trials ("default" tracks the incumbent baseline)
+        self._variant: Dict[Tuple[str, int, str], List[float]] = {}
 
     # -- feeding ---------------------------------------------------------
     def peaks(self) -> Dict[str, Any]:
@@ -631,6 +656,84 @@ class SegmentCostModel:
                 best_name = str(cand.get("name"))
         return best_name
 
+    def _modal_record(self, segment: str) -> Optional[_BucketRecord]:
+        """Most-observed measured record of a segment when it clears
+        ``min_obs``; caller holds the lock."""
+        best, best_n = None, 0
+        for (s, _b), rec in self._measured.items():
+            if s == segment and rec.n > best_n:
+                best, best_n = rec, rec.n
+        return best if best is not None and best_n >= self.min_obs else None
+
+    def stitch_decision(self, upstream: str, downstream: str,
+                        margin: float = 0.95) -> Optional[bool]:
+        """Should the planner stitch ``downstream`` into ``upstream``'s
+        segment across a transpiled host shim? True when the measured
+        round-trip the merge removes — upstream readback + downstream H2D +
+        downstream dispatch EWMAs at the modal buckets — is worth at least
+        ``1 - margin`` of the combined measured wall (``predict_ms`` backs
+        the walls). None until BOTH sides are calibrated: an uncalibrated
+        model must change nothing, so cold-start plans stay
+        bitwise-identical."""
+        up, down = str(upstream), str(downstream)
+        if not self.calibrated(up) or not self.calibrated(down):
+            return None
+        with self._lock:
+            up_rec = self._modal_record(up)
+            down_rec = self._modal_record(down)
+            if up_rec is None or down_rec is None:
+                return None
+            saved = sum(v for v in (up_rec.readback_s, down_rec.h2d_s,
+                                    down_rec.dispatch_s) if v is not None)
+            walls = [r.wall_ms() for r in (up_rec, down_rec)]
+        if saved <= 0.0 or any(w is None for w in walls):
+            return None
+        return saved > (1.0 - float(margin)) * sum(walls)
+
+    def observe_variant(self, segment: str, bucket: int, variant: str,
+                        seconds: float) -> None:
+        """Fold one measured kernel-variant trial at (segment, bucket);
+        variant ``"default"`` tracks the incumbent baseline the candidates
+        must beat."""
+        if seconds < 0 or bucket <= 0:
+            return
+        ms = float(seconds) * 1e3
+        with self._lock:
+            key = (str(segment), int(bucket), str(variant))
+            cur = self._variant.get(key)
+            if cur is None:
+                self._variant[key] = [ms, 1]
+            else:
+                cur[0] = (1 - self.ewma) * cur[0] + self.ewma * ms
+                cur[1] += 1
+
+    def variant_buckets(self, segment: str) -> List[int]:
+        """Buckets of a segment that have any kernel-variant trial data."""
+        with self._lock:
+            return sorted({b for (s, b, _v) in self._variant
+                           if s == str(segment)})
+
+    def choose_variant(self, segment: str, bucket: int,
+                       margin: float = 0.95) -> Optional[str]:
+        """Winning kernel variant at one (segment, bucket): the candidate
+        whose trial EWMA undercuts the measured ``"default"`` baseline by
+        at least ``1 - margin``, both sides backed by ``min_obs`` trials.
+        None keeps the built-in default — so with no trials folded (cold
+        start) nothing changes."""
+        seg, b = str(segment), int(bucket)
+        with self._lock:
+            base = self._variant.get((seg, b, "default"))
+            if base is None or base[1] < self.min_obs:
+                return None
+            best_id: Optional[str] = None
+            best_ms = base[0] * float(margin)
+            for (s, bb, vid), rec in sorted(self._variant.items()):
+                if s != seg or bb != b or vid == "default":
+                    continue
+                if rec[1] >= self.min_obs and rec[0] < best_ms:
+                    best_id, best_ms = vid, rec[0]
+        return best_id
+
     # -- introspection / serialization -----------------------------------
     def host_ms_per_row(self, stage: str) -> Optional[float]:
         with self._lock:
@@ -670,17 +773,22 @@ class SegmentCostModel:
             host = {k: {"ms_per_row": round(v[0], 6), "n": v[1]}
                     for k, v in sorted(self._host.items())}
             n_analytic = len(self._analytic)
+            variants = {f"{s}:{b}:{v}": {"ms": round(rec[0], 6), "n": rec[1]}
+                        for (s, b, v), rec in sorted(self._variant.items())}
         segs = self.segments()
-        return {"segments": segs,
-                "calibrated": {s: self.calibrated(s) for s in segs},
-                "confidence": {s: self.confidence(s) for s in segs},
-                "measured": measured, "host_stages": host,
-                "analytic_records": n_analytic,
-                "peak_source": self.peaks().get("peak_source")}
+        out = {"segments": segs,
+               "calibrated": {s: self.calibrated(s) for s in segs},
+               "confidence": {s: self.confidence(s) for s in segs},
+               "measured": measured, "host_stages": host,
+               "analytic_records": n_analytic,
+               "peak_source": self.peaks().get("peak_source")}
+        if variants:  # key absent when unused: stats payload parity
+            out["variant_trials"] = variants
+        return out
 
     def to_dict(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "version": 1,
                 "ewma": self.ewma, "min_obs": self.min_obs,
                 "compile_horizon": self.compile_horizon,
@@ -694,6 +802,11 @@ class SegmentCostModel:
                 "collectives": {op: [list(p) for p in pts]
                                 for op, pts in self._collective.items()},
             }
+            if self._variant:  # key absent when unused: payload parity
+                out["variants"] = {f"{s}\x00{b}\x00{v}": list(rec)
+                                   for (s, b, v), rec in
+                                   self._variant.items()}
+            return out
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any],
@@ -717,4 +830,7 @@ class SegmentCostModel:
             m._host[k] = [float(v[0]), int(v[1])]
         for op, pts in (d.get("collectives") or {}).items():
             m._collective[op] = [(float(p[0]), float(p[1])) for p in pts]
+        for key, rec in (d.get("variants") or {}).items():
+            seg, b, vid = key.rsplit("\x00", 2)
+            m._variant[(seg, int(b), vid)] = [float(rec[0]), int(rec[1])]
         return m

@@ -104,6 +104,27 @@ class DeviceFn:
     # independent by contract); a feature-dim candidate is only DERIVED
     # for a segment when every stage declares one for its external inputs.
     shard_dims: Optional[Dict[str, int]] = None
+    # --- compiler-search capability flags (docs/compiler_search.md) ------
+    # stitchable: this TERMINAL stage's host finalize shim is transpiled
+    # (device_finalize below), so the planner may keep the segment OPEN
+    # across it — downstream device stages keep consuming the segment's
+    # device-resident columns instead of paying the readback +
+    # `rows_to_batch` re-batch + H2D round-trip a terminal close costs —
+    # when the stitch knob + calibrated cost model approve. The stage's own
+    # finalized columns stay host-only; a later reader of those splits.
+    stitchable: bool = False
+    # device_finalize: jittable replacement for the numeric part of
+    # `finalize` — (params, env) -> extra device outputs (named by
+    # `device_finalize_outputs`) traced into the SAME fused program when
+    # the stitch knob enables it; `finalize_stitched(outs, ctx)` is the
+    # host shim that builds the final columns from those readbacks.
+    # `finalize_tolerance` DECLARES the allowed numeric deviation vs the
+    # host `finalize` path (None would claim bitwise — the transpiled f64
+    # reductions run in f32 on device, so they must declare a tolerance).
+    device_finalize: Optional[Callable] = None
+    device_finalize_outputs: Tuple[str, ...] = ()
+    finalize_stitched: Optional[Callable] = None
+    finalize_tolerance: Optional[float] = None
 
     def __post_init__(self):
         self.in_cols = tuple(self.in_cols)
@@ -112,6 +133,7 @@ class DeviceFn:
             self.device_outputs = self.out_cols
         else:
             self.device_outputs = tuple(self.device_outputs)
+        self.device_finalize_outputs = tuple(self.device_finalize_outputs)
 
 
 class CompileCache:

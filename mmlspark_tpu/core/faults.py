@@ -220,12 +220,16 @@ LIFECYCLE_SWAP = "lifecycle.swap"
 # write: a raising plan crashes training at checkpoint k; resume() +
 # journal replay must reproduce the uninterrupted state bitwise
 LIFECYCLE_CHECKPOINT = "lifecycle.checkpoint"
+# core/tune Tuner.apply, fired MID-SWAP of a kernel-variant/stitch knob
+# change (tuner state updated, fused model not yet pushed): a raising plan
+# must leave the incumbent variant serving bitwise-identical replies
+TUNER_KERNEL_APPLY = "tuner.kernel_apply"
 
 ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
               JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE,
               WORKER_DISPATCH_HANG, WORKER_CRASH, FRONT_HEDGE,
               COMPILECACHE_LOAD, COMPILECACHE_STORE, MESH_CHIP_WEDGE,
-              LIFECYCLE_SWAP, LIFECYCLE_CHECKPOINT)
+              LIFECYCLE_SWAP, LIFECYCLE_CHECKPOINT, TUNER_KERNEL_APPLY)
 
 
 class InjectedFault(OSError):

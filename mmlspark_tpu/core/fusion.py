@@ -76,23 +76,47 @@ class Segment:
     def __init__(self):
         self.stages: List[Transformer] = []
         self.dfns: List[DeviceFn] = []
+        # stage names the plan kept a segment OPEN across: their terminal
+        # host finalize is transpiled (DeviceFn.device_finalize) so the
+        # boundary they would force disappears (the compiler-search stitch,
+        # docs/compiler_search.md); empty for every plan produced without
+        # the stitch knob
+        self.stitched: List[str] = []
+        # out_cols of stitched stages: they materialize only on HOST at
+        # finalize time, so no later in-segment stage may consume them
+        self.host_cols: set = set()
 
     # -- construction ----------------------------------------------------
     def add(self, stage: Transformer, dfn: DeviceFn) -> None:
         self.stages.append(stage)
         self.dfns.append(dfn)
 
+    def mark_stitched(self, stage: Transformer, dfn: DeviceFn) -> None:
+        """Record that the segment continues PAST this terminal stage: its
+        f64 host-finalize reductions are transpiled to a device shim
+        (``device_finalize``), so downstream device stages keep consuming
+        the segment's device-resident columns instead of paying the
+        readback + ``rows_to_batch`` re-batch + H2D round-trip a segment
+        break costs. The stage's finalized output columns stay host-only
+        (``host_cols``) — a later stage reading them still splits."""
+        self.stitched.append(type(stage).__name__)
+        self.host_cols |= set(dfn.out_cols)
+
     def can_accept(self, dfn: DeviceFn) -> bool:
         if not self.dfns:
             return True
-        written = self.written_cols
+        # a stitched terminal stage's columns exist only on host: a reader
+        # cannot join the device program
+        if set(dfn.in_cols) & self.host_cols:
+            return False
+        written = self.written_cols - self.host_cols
         internal_in = set(dfn.in_cols) & written
         if internal_in and not dfn.internal_ok:
             return False
         # a prepare hook may only own external inputs no earlier stage reads
         if dfn.prepare is not None:
             earlier_ext = {c for d in self.dfns for c in d.in_cols
-                           if c not in written}
+                           if c not in written and c not in self.host_cols}
             if set(dfn.in_cols) & earlier_ext:
                 return False
         return True
@@ -125,9 +149,12 @@ class Segment:
     def heavy(self) -> bool:
         return any(d.heavy for d in self.dfns)
 
-    def readback_plan(self) -> List[Tuple[str, int]]:
+    def readback_plan(self, transpiled: Tuple[int, ...] = ()
+                      ) -> List[Tuple[str, int]]:
         """(env key, writer dfn index) pairs the executor reads back: each
-        column at its FINAL value plus every internal ``__`` key."""
+        column at its FINAL value plus every internal ``__`` key — plus,
+        for dfn indices in ``transpiled``, the extra outputs their
+        ``device_finalize`` computes on device."""
         final_writer: Dict[str, int] = {}
         for i, d in enumerate(self.dfns):
             for c in d.out_cols:
@@ -137,6 +164,8 @@ class Segment:
             for k in d.device_outputs:
                 if k.startswith("__") or final_writer.get(k) == i:
                     out.append((k, i))
+            if i in transpiled:
+                out.extend((k, i) for k in d.device_finalize_outputs)
         return out
 
     def batch_size(self) -> int:
@@ -152,15 +181,20 @@ class Segment:
         return 2
 
     def describe(self) -> Dict[str, Any]:
-        return {"kind": "fused", "stages": [type(s).__name__ for s in self.stages],
-                "in_cols": self.external_in_cols,
-                "out_cols": sorted(self.written_cols),
-                "batch_size": self.batch_size()}
+        out = {"kind": "fused",
+               "stages": [type(s).__name__ for s in self.stages],
+               "in_cols": self.external_in_cols,
+               "out_cols": sorted(self.written_cols),
+               "batch_size": self.batch_size()}
+        if self.stitched:  # key absent on unstitched plans: describe parity
+            out["stitched"] = list(self.stitched)
+        return out
 
 
 def plan(stages: Sequence[Transformer], schema: Schema,
          cost_model=None,
-         fuse_overrides: Optional[Dict[str, bool]] = None) -> List[Any]:
+         fuse_overrides: Optional[Dict[str, bool]] = None,
+         stitch_overrides: Optional[Dict[str, bool]] = None) -> List[Any]:
     """Partition a fitted stage chain into HostStage / Segment plan nodes.
 
     Walks the chain threading the schema through ``transform_schema``; each
@@ -176,9 +210,41 @@ def plan(stages: Sequence[Transformer], schema: Schema,
     bitwise-identical to the default. ``fuse_overrides`` ({label: bool},
     the Tuner's applied knob — also how its calibration probe force-fuses
     a light candidate to measure its device cost) wins over both.
+
+    ``stitch_overrides`` ({terminal stage class name: bool}) is the
+    compiler-search stitch knob: a ``terminal`` stage normally CLOSES its
+    segment — its finalize runs f64 host reductions whose outputs nothing
+    downstream can consume on device, so the next device stage pays a
+    readback + ``rows_to_batch`` host re-batch + H2D round-trip. When the
+    stage declares the transpiled shim (``stitchable`` +
+    ``device_finalize``/``finalize_stitched``) and its override is True —
+    or, with no override, ``cost_model.stitch_decision(segment label,
+    stage name)`` prices the merge as beating the measured round-trip it
+    removes (None while uncalibrated: cold-start plans stay
+    bitwise-identical) — the segment stays OPEN across the shim:
+    downstream device stages keep consuming the segment's device-resident
+    columns, while the stage's own finalized columns stay host-only (a
+    later reader of those still splits). Every per-partition host
+    fallback gate is unchanged either way.
     """
     nodes: List[Any] = []
     cur: Optional[Segment] = None
+
+    def stitch_across(seg: Segment, stage: Transformer,
+                      dfn: DeviceFn) -> bool:
+        if not (dfn.stitchable and dfn.device_finalize is not None
+                and dfn.finalize_stitched is not None):
+            return False
+        name = type(stage).__name__
+        if stitch_overrides is not None and name in stitch_overrides:
+            return bool(stitch_overrides[name])
+        if cost_model is not None:
+            try:
+                decision = cost_model.stitch_decision(seg.label, name)
+            except Exception:  # defensive: a model bug must not kill plan
+                decision = None
+            return bool(decision)
+        return False
 
     def keep_fused(seg: Segment) -> bool:
         if fuse_overrides is not None and seg.label in fuse_overrides:
@@ -221,7 +287,12 @@ def plan(stages: Sequence[Transformer], schema: Schema,
                 cur = Segment()
             cur.add(stage, dfn)
             if dfn.terminal:
-                close()
+                if stitch_across(cur, stage, dfn):
+                    # transpiled shim: the segment stays open — downstream
+                    # device stages keep riding this device program
+                    cur.mark_stitched(stage, dfn)
+                else:
+                    close()
         try:
             schema = stage.transform_schema(schema.copy())
         except Exception:
@@ -305,7 +376,7 @@ class SegmentExecutor:
     def __init__(self, segment: Segment, cache: Optional[CompileCache] = None,
                  buckets: Optional[Tuple[int, ...]] = None,
                  cost_model=None, slot_pool=None, mega_k: int = 1,
-                 sharding=None):
+                 sharding=None, kernel_variants=None, stitch=None):
         self.segment = segment
         self.cache = cache if cache is not None else compile_cache()
         self.fallbacks: List[str] = []
@@ -324,6 +395,40 @@ class SegmentExecutor:
         # knob via costmodel.choose_sharding); None = the single-device
         # path, byte-for-byte today's code
         self.sharding = sharding
+        # compiler-search knobs (docs/compiler_search.md), both default OFF:
+        # kernel_variants maps this segment's shape bucket (or "*") to a
+        # core/kernels.py variant id activated around the trace, and stitch
+        # ({stage class name: bool}) enables each stage's transpiled
+        # `device_finalize` in place of the host `finalize` numeric path
+        kv: Dict[Any, str] = {}
+        for k, v in (kernel_variants or {}).items():
+            if not v:
+                continue
+            try:
+                kv[int(k)] = str(v)
+            except (TypeError, ValueError):
+                kv["*"] = str(v)
+        self.kernel_variants = kv
+        self.stitch = {str(k): bool(v) for k, v in (stitch or {}).items()}
+        # transpiled finalizers: every stage the PLAN stitched the segment
+        # across, plus any stage the stitch knob names directly (a terminal
+        # segment tail with no downstream to merge — the transpile alone
+        # still moves its f64 reductions onto the device program)
+        self._transpiled: Tuple[int, ...] = tuple(
+            i for i, (s, d) in enumerate(zip(segment.stages, segment.dfns))
+            if d.device_finalize is not None
+            and d.finalize_stitched is not None
+            and (type(s).__name__ in segment.stitched
+                 or self.stitch.get(type(s).__name__)))
+        # `stitch=` shape prefix: transpiled-shim programs decorate their
+        # cost records so bucket_of_shape skips them (costmodel.py), like
+        # mega{k};/spec=
+        names = tuple(dict.fromkeys(
+            type(segment.stages[i]).__name__ for i in self._transpiled))
+        self._stitch_pre = f"stitch={','.join(names)};" if names else ""
+        # the transpiled program differs under the SAME seg.key: key apart
+        self._stitch_tail: Tuple = \
+            (("stitch", self._transpiled),) if self._transpiled else ()
 
     def _cost_attrs(self) -> Dict[str, Any]:
         """XLA cost attrs for this segment's trace spans (mean per-batch
@@ -471,7 +576,7 @@ class SegmentExecutor:
             if mine and dfn.accepts is not None and not dfn.accepts(mine):
                 raise _HostFallback(f"{type(stage).__name__} dtype gate")
 
-        readback = seg.readback_plan()
+        readback = seg.readback_plan(self._transpiled)
         state: Dict[str, Any] = {
             "part": part, "sub": sub, "ctx": ctx, "valid": valid, "n": n,
             "n_valid": n_valid, "ext": ext, "readback": readback,
@@ -600,6 +705,20 @@ class SegmentExecutor:
         return ";".join(f"{c}={'x'.join(str(d) for d in shp)}:{dt}"
                         for c, shp, dt in sig)
 
+    def _variant_for(self, sig) -> Optional[str]:
+        """Kernel-variant id active for one shape signature: the tuned
+        per-bucket entry (bucket = leading dim of the first staged input),
+        falling back to the ``"*"`` wildcard; None = built-in default."""
+        kv = self.kernel_variants
+        if not kv:
+            return None
+        vid = None
+        if sig and sig[0][1]:
+            vid = kv.get(int(sig[0][1][0]))
+        if vid is None:
+            vid = kv.get("*")
+        return vid
+
     def _make_step(self, params_dev, state: Dict[str, Any]):
         """Dispatch closure: staged batch -> (device outputs, num_valid).
         Non-blocking (jax dispatch is async); executables come from the
@@ -612,15 +731,23 @@ class SegmentExecutor:
         # sharded records (their per-chip flops would skew the
         # single-device analytic table)
         key_tail = (sh.cache_key(),) if sh is not None else ()
-        shape_pre = sh.shape_prefix() if sh is not None else ""
+        key_tail = key_tail + self._stitch_tail
+        shape_pre = (sh.shape_prefix() if sh is not None else "") + \
+            self._stitch_pre
 
         def step(staged):
             x, m = staged
             sig = self._sig_of(x, ext)
+            # a kernel variant is a DIFFERENT compiled program for the same
+            # (segment, signature): key it apart, and decorate the shape
+            # key (variant=<id>;) so bucket_of_shape skips its cost record
+            vid = self._variant_for(sig)
+            tail = key_tail + ((("variant", vid),) if vid else ())
+            pre = (f"variant={vid};" if vid else "") + shape_pre
             compiled = self.cache.get(
-                (seg.key, sig) + key_tail,
-                lambda: self._build(params_dev, x, keys),
-                label=seg.label, shape=shape_pre + self._shape_key_of(sig))
+                (seg.key, sig) + tail,
+                lambda: self._build(params_dev, x, keys, variant=vid),
+                label=seg.label, shape=pre + self._shape_key_of(sig))
             with profiling.annotate(f"fused:{seg.label}"):
                 return compiled(params_dev, x), m
 
@@ -635,16 +762,22 @@ class SegmentExecutor:
         seg, ext, keys = self.segment, state["ext"], state["keys"]
         sh = self.sharding
         key_tail = (sh.cache_key(),) if sh is not None else ()
-        shape_pre = sh.shape_prefix() if sh is not None else ""
+        key_tail = key_tail + self._stitch_tail
+        shape_pre = (sh.shape_prefix() if sh is not None else "") + \
+            self._stitch_pre
 
         def mega(group):
             xs = [x for (x, _m), _t in group]
             sig = self._sig_of(xs[0], ext)
+            vid = self._variant_for(sig)
+            tail = key_tail + ((("variant", vid),) if vid else ())
+            pre = (f"variant={vid};" if vid else "") + shape_pre
             compiled = self.cache.get(
-                (seg.key, sig, ("mega", k)) + key_tail,
-                lambda: self._build_mega(params_dev, xs[0], keys, k),
+                (seg.key, sig, ("mega", k)) + tail,
+                lambda: self._build_mega(params_dev, xs[0], keys, k,
+                                         variant=vid),
                 label=seg.label,
-                shape=f"{shape_pre}mega{k};{self._shape_key_of(sig)}")
+                shape=f"{pre}mega{k};{self._shape_key_of(sig)}")
             cols_seq = tuple({c: x[c] for c in ext} for x in xs)
             with profiling.annotate(f"fused:{seg.label}:mega{k}"):
                 return compiled(params_dev, cols_seq)
@@ -851,12 +984,18 @@ class SegmentExecutor:
         for k, i in readback:
             by_writer.setdefault(i, {})[k] = full[k]
         out_part = dict(part)
+        transpiled = set(self._transpiled)
         for i, dfn in enumerate(seg.dfns):
             outs = by_writer.get(i)
             if outs is None:
                 continue
             if n_valid == 0:
                 cols = {c: np.empty(0, dtype=object) for c in dfn.out_cols}
+            elif i in transpiled:
+                # transpiled finalize: the numeric reductions already ran
+                # on device (device_finalize); this host shim only shapes
+                # the readbacks into columns
+                cols = dfn.finalize_stitched(outs, ctx)
             elif dfn.finalize is not None:
                 cols = dfn.finalize(outs, ctx)
             else:
@@ -875,16 +1014,24 @@ class SegmentExecutor:
             out_part = {k: v[valid] for k, v in out_part.items()}
         return out_part
 
-    def _build(self, params_dev, x: Dict[str, Any], keys: List[str]):
-        """AOT-compile the fused program for one shape signature."""
+    def _build(self, params_dev, x: Dict[str, Any], keys: List[str],
+               variant: Optional[str] = None):
+        """AOT-compile the fused program for one shape signature. A kernel
+        ``variant`` id is activated around the trace (core/kernels.py) so
+        variant-aware call sites resolve it as a static parameter."""
         import jax
 
+        from . import kernels as _kernels
+
         seg = self.segment
+        transpiled = set(self._transpiled)
 
         def fused(params_tuple, cols):
             env = dict(cols)
-            for dfn, p in zip(seg.dfns, params_tuple):
+            for i, (dfn, p) in enumerate(zip(seg.dfns, params_tuple)):
                 env.update(dfn.fn(p, env))
+                if i in transpiled:
+                    env.update(dfn.device_finalize(p, env))
             return tuple(env[k] for k in keys)
 
         # sharded: pjit with the planner's NamedShardings (replicated
@@ -897,18 +1044,27 @@ class SegmentExecutor:
                                          np.asarray(v).dtype
                                          if not hasattr(v, "dtype") else v.dtype)
                  for c, v in x.items()}
-        try:
-            return jitted.lower(params_dev, specs).compile()
-        except FusionUnsupported:
-            raise
-        except Exception:
-            # AOT path unavailable on this jax: the jitted callable still
-            # compiles (and caches) per shape on first dispatch
-            jax.eval_shape(jitted, params_dev, specs)  # trace gates fire NOW
-            return jitted
+        with _kernels.activate(variant):
+            try:
+                return jitted.lower(params_dev, specs).compile()
+            except FusionUnsupported:
+                raise
+            except Exception:
+                # AOT path unavailable on this jax: the jitted callable
+                # still compiles (and caches) per shape on first dispatch
+                jax.eval_shape(jitted, params_dev, specs)  # gates fire NOW
+                if variant is None:
+                    return jitted
+
+                def call(p, c, _jitted=jitted, _vid=variant):
+                    # first real dispatch re-traces: keep the variant live
+                    with _kernels.activate(_vid):
+                        return _jitted(p, c)
+
+                return call
 
     def _build_mega(self, params_dev, x: Dict[str, Any], keys: List[str],
-                    k: int):
+                    k: int, variant: Optional[str] = None):
         """AOT-compile the K-step mega program: K replicas of ``_build``'s
         per-batch fused body, traced over a K-tuple of same-shape input
         dicts in one callable — one Python dispatch executes K queued
@@ -917,14 +1073,19 @@ class SegmentExecutor:
         outputs match the K=1 path."""
         import jax
 
+        from . import kernels as _kernels
+
         seg = self.segment
+        transpiled = set(self._transpiled)
 
         def fused_k(params_tuple, cols_seq):
             outs = []
             for cols in cols_seq:
                 env = dict(cols)
-                for dfn, p in zip(seg.dfns, params_tuple):
+                for i, (dfn, p) in enumerate(zip(seg.dfns, params_tuple)):
                     env.update(dfn.fn(p, env))
+                    if i in transpiled:
+                        env.update(dfn.device_finalize(p, env))
                 outs.append(tuple(env[kk] for kk in keys))
             return tuple(outs)
 
@@ -936,13 +1097,21 @@ class SegmentExecutor:
             np.asarray(v).dtype if not hasattr(v, "dtype") else v.dtype)
             for c, v in x.items()}
         specs = tuple(dict(spec) for _ in range(k))
-        try:
-            return jitted.lower(params_dev, specs).compile()
-        except FusionUnsupported:
-            raise
-        except Exception:
-            jax.eval_shape(jitted, params_dev, specs)
-            return jitted
+        with _kernels.activate(variant):
+            try:
+                return jitted.lower(params_dev, specs).compile()
+            except FusionUnsupported:
+                raise
+            except Exception:
+                jax.eval_shape(jitted, params_dev, specs)
+                if variant is None:
+                    return jitted
+
+                def call(p, c, _jitted=jitted, _vid=variant):
+                    with _kernels.activate(_vid):
+                        return _jitted(p, c)
+
+                return call
 
 
 # ---------------------------------------------------------------------------
@@ -977,6 +1146,11 @@ class FusedPipelineModel(PipelineModel):
         self._bucket_overrides: Dict[str, Tuple[int, ...]] = {}
         self._fuse_overrides: Dict[str, bool] = {}
         self._mega_k_overrides: Dict[str, int] = {}
+        # compiler-search knobs (docs/compiler_search.md): per-segment
+        # {bucket: kernel variant id} and per-stage-name stitch flags.
+        # Both default OFF — cold start is bitwise-identical.
+        self._variant_overrides: Dict[str, Dict[Any, str]] = {}
+        self._stitch_overrides: Dict[str, bool] = {}
         # pod-scale sharding (parallel/shardplan.py): the mesh segments may
         # shard over (set_mesh / MeshSupervision) and the per-segment spec
         # overrides (tuner knob via costmodel.choose_sharding). Both
@@ -997,13 +1171,23 @@ class FusedPipelineModel(PipelineModel):
                    fuse: Optional[Dict[str, bool]] = None,
                    cost_model=None,
                    mega_k: Optional[Dict[str, int]] = None,
-                   sharding: Optional[Dict[str, str]] = None) -> None:
+                   sharding: Optional[Dict[str, str]] = None,
+                   kernel_variants: Optional[Dict[str, Dict[Any, str]]] = None,
+                   stitch: Optional[Dict[str, bool]] = None) -> None:
         """Apply tuned knobs (Tuner.apply): per-segment-label bucket sets,
         fuse-vs-demote overrides, per-segment K-step mega-dispatch factors,
         per-segment partition-spec names (sharding over the ``set_mesh``
-        mesh), and/or the cost model itself. Passing None leaves a knob
-        unchanged; passing {} clears it. Cached plans are invalidated
-        (compiled executables survive in the CompileCache)."""
+        mesh), per-segment kernel-variant maps ({label: {bucket|"*":
+        variant id}}), per-stage-name stitch flags, and/or the cost model
+        itself. Passing None leaves a knob unchanged; passing {} clears it.
+        Cached plans are invalidated (compiled executables survive in the
+        CompileCache)."""
+        if kernel_variants is not None:
+            self._variant_overrides = {
+                str(k): dict(v) for k, v in kernel_variants.items() if v}
+        if stitch is not None:
+            self._stitch_overrides = {str(k): bool(v)
+                                      for k, v in stitch.items()}
         if buckets is not None:
             self._bucket_overrides = {
                 str(k): tuple(sorted(int(b) for b in v))
@@ -1086,7 +1270,8 @@ class FusedPipelineModel(PipelineModel):
         if key not in self._plans:
             self._plans[key] = plan(
                 self._stages, schema.copy(), cost_model=self._cost_model,
-                fuse_overrides=self._fuse_overrides or None)
+                fuse_overrides=self._fuse_overrides or None,
+                stitch_overrides=self._stitch_overrides or None)
         return self._plans[key]
 
     def _sharding_for(self, node: Segment):
@@ -1116,7 +1301,9 @@ class FusedPipelineModel(PipelineModel):
             cost_model=self._cost_model,
             slot_pool=self._get_slot_pool(),
             mega_k=self._mega_k_overrides.get(node.label, 1),
-            sharding=self._sharding_for(node))
+            sharding=self._sharding_for(node),
+            kernel_variants=self._variant_overrides.get(node.label),
+            stitch=self._stitch_overrides or None)
 
     def _host_node(self, node: HostStage, df: DataFrame) -> DataFrame:
         """Run one host plan node, feeding its wall time to the cost model
@@ -1235,13 +1422,36 @@ class FusedPipelineModel(PipelineModel):
             "roofline": roofline,
         }
         if (self._bucket_overrides or self._fuse_overrides
-                or self._mega_k_overrides or self._sharding_overrides):
+                or self._mega_k_overrides or self._sharding_overrides
+                or self._variant_overrides or self._stitch_overrides):
             out["tuning"] = {
                 "buckets": {k: list(v)
                             for k, v in self._bucket_overrides.items()},
                 "fuse": dict(self._fuse_overrides),
                 "mega_k": dict(self._mega_k_overrides),
                 "sharding": dict(self._sharding_overrides)}
+            # new knobs appear only when set: stats payload parity with
+            # plans tuned before the compiler-search knobs existed
+            if self._variant_overrides:
+                out["tuning"]["kernel_variants"] = {
+                    label: {str(b): v for b, v in kv.items()}
+                    for label, kv in self._variant_overrides.items()}
+            if self._stitch_overrides:
+                out["tuning"]["stitch"] = dict(self._stitch_overrides)
+        stitched: Dict[str, List[str]] = {}
+        for n in nodes:
+            if not isinstance(n, Segment):
+                continue
+            names = list(n.stitched)
+            names += [type(s).__name__
+                      for s, d in zip(n.stages, n.dfns)
+                      if d.device_finalize is not None
+                      and d.finalize_stitched is not None
+                      and self._stitch_overrides.get(type(s).__name__)]
+            if names:
+                stitched[n.label] = list(dict.fromkeys(names))
+        if stitched:  # key absent when nothing stitched: payload parity
+            out["stitched"] = stitched
         if self._seg_sharding:
             from ..parallel.shardplan import mesh_topology
 

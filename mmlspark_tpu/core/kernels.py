@@ -1,0 +1,179 @@
+"""Kernel-variant registry for the whole-pipeline compiler search.
+
+The Pallas kernels (GBDT histogram / row-select) and the forest-traversal
+kernel each expose a small variant space (tile sizes, grid shapes, loop
+order).  Variants are declared here as :class:`KernelVariant` records and
+picked per-(segment, bucket) by the Tuner's measure→refit→apply loop; the
+fused executor activates the chosen variant around trace time so the kernel
+call sites resolve it without threading parameters through every layer.
+
+Two invariants matter:
+
+* **Tolerance declaration.**  ``tolerance is None`` means the variant is
+  exact-compute: it must produce bitwise-identical results to the default
+  and the Tuner enforces ``array_equal`` during the trial step.  A float
+  tolerance marks a reduction-order-sensitive variant (e.g. the histogram
+  chunk size changes f32/bf16 accumulation splits) and the trial gates on
+  ``allclose(rtol=tol, atol=tol)`` instead.
+* **Cold-start identity.**  With no variant active every kernel resolves
+  its built-in default; ``active()`` returns ``None`` and no behaviour
+  changes.  Variant ids never contain ``:`` or ``;`` so the
+  ``variant=<id>;`` CompileCache shape prefix stays unparseable by
+  ``bucket_of_shape`` (see core/costmodel.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Mapping, Optional, Tuple
+
+__all__ = [
+    "KernelVariant",
+    "register",
+    "get",
+    "variants_for",
+    "all_variant_ids",
+    "activate",
+    "active",
+    "active_param",
+    "DEFAULT_VARIANT",
+]
+
+#: Sentinel id for "use the kernel's built-in default" (never registered).
+DEFAULT_VARIANT = "default"
+
+_ID_RE = re.compile(r"^[a-z0-9][a-z0-9._-]*$")
+
+
+@dataclass(frozen=True)
+class KernelVariant:
+    """One point in a kernel's variant space.
+
+    ``kernel`` names the call-site family ("hist", "select", "forest");
+    ``params`` are the knob values the call site consumes at trace time;
+    ``tolerance`` is the declared numeric tolerance versus the default
+    variant (``None`` = exact-compute, enforced bitwise).
+    """
+
+    id: str
+    kernel: str
+    params: Mapping[str, object] = field(default_factory=dict)
+    tolerance: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if not _ID_RE.match(self.id) or ":" in self.id or ";" in self.id:
+            raise ValueError(f"invalid kernel variant id: {self.id!r}")
+
+
+_REGISTRY: Dict[str, KernelVariant] = {}
+_LOCK = threading.Lock()
+
+
+def register(variant: KernelVariant) -> KernelVariant:
+    """Register (or idempotently re-register) a variant."""
+    with _LOCK:
+        prev = _REGISTRY.get(variant.id)
+        if prev is not None and prev != variant:
+            raise ValueError(f"conflicting redefinition of variant {variant.id!r}")
+        _REGISTRY[variant.id] = variant
+    return variant
+
+
+def get(variant_id: str) -> Optional[KernelVariant]:
+    """Look up a variant by id; ``None`` for unknown ids / the default."""
+    if not variant_id or variant_id == DEFAULT_VARIANT:
+        return None
+    return _REGISTRY.get(variant_id)
+
+
+def variants_for(kernel: str) -> Tuple[KernelVariant, ...]:
+    with _LOCK:
+        return tuple(v for v in _REGISTRY.values() if v.kernel == kernel)
+
+
+def all_variant_ids() -> Tuple[str, ...]:
+    with _LOCK:
+        return tuple(sorted(_REGISTRY))
+
+
+# ---------------------------------------------------------------------------
+# Trace-time activation.  The executor enters ``activate(vid)`` around the
+# jit trace of a segment build; kernel call sites consult ``active()`` /
+# ``active_param()`` *outside* their jit boundary (same pattern as the hist
+# kernel's hilo resolution) so the choice becomes a static argument.
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def _stack(create: bool = False):
+    stack = getattr(_tls, "stack", None)
+    if stack is None and create:
+        stack = _tls.stack = []
+    return stack
+
+
+@contextlib.contextmanager
+def activate(variant_id: Optional[str]) -> Iterator[Optional[KernelVariant]]:
+    """Make ``variant_id`` the active variant for its kernel family within
+    the ``with`` body (thread-local; nestable, innermost wins per family)."""
+    var = get(variant_id) if variant_id else None
+    if var is None:
+        yield None
+        return
+    stack = _stack(create=True)
+    stack.append(var)
+    try:
+        yield var
+    finally:
+        stack.pop()
+
+
+def active(kernel: str) -> Optional[KernelVariant]:
+    """The innermost active variant for ``kernel``, or ``None``."""
+    stack = _stack()
+    if not stack:
+        return None
+    for var in reversed(stack):
+        if var.kernel == kernel:
+            return var
+    return None
+
+
+def active_param(kernel: str, name: str, default):
+    """Convenience: the active variant's ``params[name]``, else ``default``."""
+    var = active(kernel)
+    if var is None:
+        return default
+    return var.params.get(name, default)
+
+
+# ---------------------------------------------------------------------------
+# Built-in variant space.  Kept deliberately small: the Tuner measures each
+# candidate, so the space must be affordable to sweep per (segment, bucket).
+# ---------------------------------------------------------------------------
+
+# Histogram chunk size changes how rows are split across grid cells and how
+# the bf16 hi/lo (or 3-pass f32) partial sums accumulate -> reduction-order
+# sensitive, gated behind an allclose tolerance.
+_HIST_TOL = 2e-3
+for _c in (256, 1024):
+    register(KernelVariant(id=f"hist.c{_c}", kernel="hist",
+                           params={"chunk": _c}, tolerance=_HIST_TOL))
+
+# Row-select writes each surviving row exactly once via pass-through one-hot
+# products; chunking only re-tiles the scan, so variants are exact-compute.
+for _c in (512, 2048):
+    register(KernelVariant(id=f"select.c{_c}", kernel="select",
+                           params={"chunk": _c}, tolerance=None))
+
+# Forest traversal: the path-matrix GEMM and the fori_loop gather traversal
+# land on the same leaf values (one-hot reach x leaf value, zeros added
+# exactly), so switching loop order is exact-compute.
+register(KernelVariant(id="forest.gather", kernel="forest",
+                       params={"impl": "gather"}, tolerance=None))
+register(KernelVariant(id="forest.gemm", kernel="forest",
+                       params={"impl": "gemm"}, tolerance=None))

@@ -70,10 +70,17 @@ class KnobSet:
     #: per-segment-label partition-spec names over the fused model's mesh
     #: (parallel/shardplan.py; absent label = the single-device path)
     sharding: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: per-segment-label {bucket: kernel variant id} maps (core/kernels.py;
+    #: absent label/bucket = the built-in default kernel)
+    kernel_variants: Dict[str, Dict[str, str]] = dataclasses.field(
+        default_factory=dict)
+    #: per-stage-class-name cross-segment stitch flags (core/fusion.py
+    #: plan(); absent name = never merge across that boundary)
+    stitch: Dict[str, bool] = dataclasses.field(default_factory=dict)
 
     def is_default(self) -> bool:
         return not (self.buckets or self.fuse or self.mega_k or
-                    self.sharding or
+                    self.sharding or self.kernel_variants or self.stitch or
                     self.window_seed_ms is not None or
                     self.inflight is not None or self.replicas is not None)
 
@@ -88,6 +95,12 @@ class KnobSet:
         if self.sharding:
             out["sharding"] = {k: str(v)
                                for k, v in self.sharding.items()}
+        if self.kernel_variants:
+            out["kernel_variants"] = {
+                label: {str(b): str(v) for b, v in kv.items()}
+                for label, kv in self.kernel_variants.items()}
+        if self.stitch:
+            out["stitch"] = {k: bool(v) for k, v in self.stitch.items()}
         for k in ("window_seed_ms", "inflight", "replicas"):
             v = getattr(self, k)
             if v is not None:
@@ -104,6 +117,11 @@ class KnobSet:
                     for k, v in (d.get("mega_k") or {}).items()},
             sharding={k: str(v)
                       for k, v in (d.get("sharding") or {}).items()},
+            kernel_variants={
+                label: {str(b): str(v) for b, v in (kv or {}).items()}
+                for label, kv in (d.get("kernel_variants") or {}).items()},
+            stitch={k: bool(v)
+                    for k, v in (d.get("stitch") or {}).items()},
             window_seed_ms=d.get("window_seed_ms"),
             inflight=d.get("inflight"), replicas=d.get("replicas"))
 
@@ -141,6 +159,9 @@ class Tuner:
         self.applies = 0
         self.rollbacks = 0
         self.epochs = 0
+        #: applies that changed the kernel_variants knob (the
+        #: mmlspark_kernel_variant_switches_total counter)
+        self.variant_switches = 0
         # incremental IngestStats folding: label -> (stats object id, fold
         # high-water mark) so re-reading a live stats object never double
         # counts records
@@ -230,10 +251,16 @@ class Tuner:
             spec = self._sharding_for(label, cap)
             if spec is not None:
                 knobs.sharding[label] = spec
+            variants = self._variants_for(label)
+            if variants:
+                knobs.kernel_variants[label] = variants
             pred = self.model.predict(label, batch=cap)
             if pred is not None:
                 trailing_ms = pred["ms"]
                 parts = pred.get("parts")
+        stitch = self._stitch_proposals()
+        if stitch:
+            knobs.stitch = stitch
         if trailing_ms is not None:
             compute = (parts or {}).get("compute_ms")
             knobs.window_seed_ms = round(
@@ -303,6 +330,58 @@ class Tuner:
         except Exception:  # noqa: BLE001 — proposal must never raise out
             return None
 
+    def _variants_for(self, label: str) -> Dict[str, str]:
+        """Measured per-bucket kernel-variant winners for one segment
+        (``costmodel.choose_variant`` over the buckets that hold trial
+        data). {} proposes nothing — the built-in default kernels — which
+        is also what a model without variant support yields."""
+        chooser = getattr(self.model, "choose_variant", None)
+        buckets = getattr(self.model, "variant_buckets", None)
+        if not callable(chooser) or not callable(buckets):
+            return {}
+        out: Dict[str, str] = {}
+        try:
+            for b in buckets(label):
+                vid = chooser(label, b)
+                if vid:
+                    out[str(b)] = str(vid)
+        except Exception:  # noqa: BLE001 — proposal must never raise out
+            return {}
+        return out
+
+    def _stitch_proposals(self) -> Dict[str, bool]:
+        """Stitch flags for the plan's adjacent (Segment, Segment)
+        boundaries split by a TERMINAL tail stage that carries a transpiled
+        finalize shim (``stitchable`` + ``device_finalize`` +
+        ``finalize_stitched``) and whose measured readback + H2D round-trip
+        the cost model prices as worth removing (``stitch_decision``,
+        calibration-gated — a cold model proposes nothing). Keys are the
+        tail stage's class name — the same key ``plan()``'s
+        ``stitch_overrides`` consumes."""
+        decider = getattr(self.model, "stitch_decision", None)
+        if not callable(decider):
+            return {}
+        out: Dict[str, bool] = {}
+        nodes = getattr(self.fused, "_last_plan", None) or []
+        for up, down in zip(nodes, nodes[1:]):
+            if not (hasattr(up, "dfns") and hasattr(down, "dfns")):
+                continue
+            if not up.dfns or not down.dfns:
+                continue
+            tail = up.dfns[-1]
+            if not (getattr(tail, "stitchable", False)
+                    and getattr(tail, "device_finalize", None) is not None
+                    and getattr(tail, "finalize_stitched", None)
+                    is not None):
+                continue
+            try:
+                decision = decider(up.label, down.label)
+            except Exception:  # noqa: BLE001 — proposal must never raise
+                continue
+            if decision:
+                out[type(up.stages[-1]).__name__] = True
+        return out
+
     def predict_batch_ms(self, rows: int) -> Optional[float]:
         """Predicted wall ms for one serving batch of ``rows`` — the sum of
         the calibrated segments' batch predictions. None while uncalibrated
@@ -337,29 +416,70 @@ class Tuner:
         return n_dev if compute_ms >= transfer_ms else 1
 
     # -- apply / rollback ------------------------------------------------
-    def apply(self, knobs: KnobSet, reason: str = "apply") -> None:
-        """Push a KnobSet into the wired layers, remembering the previous
-        set for one-step rollback."""
-        with self._lock:
-            self._prev = self.knobs
-            self.knobs = knobs
-            self.applies += 1
-            # serving watch: ignore the next batches' e2e (fresh-bucket
-            # compile spike) before judging the new knobs
-            self._e2e_skip = 2
-        fused = self.fused
-        if fused is not None and hasattr(fused, "set_tuning"):
-            try:
+    @staticmethod
+    def _push(fused, knobs: KnobSet) -> None:
+        """set_tuning with the full knob surface, degrading for older
+        fused models (newest kwargs dropped first)."""
+        try:
+            fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse,
+                             mega_k=knobs.mega_k, sharding=knobs.sharding,
+                             kernel_variants=knobs.kernel_variants,
+                             stitch=knobs.stitch)
+        except TypeError:
+            try:  # older fused models without the compiler-search knobs
                 fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse,
                                  mega_k=knobs.mega_k,
                                  sharding=knobs.sharding)
             except TypeError:
-                try:  # older fused models without the sharding knob
+                try:  # ... without the sharding knob
                     fused.set_tuning(buckets=knobs.buckets,
                                      fuse=knobs.fuse, mega_k=knobs.mega_k)
                 except TypeError:  # ... or without the K knob either
                     fused.set_tuning(buckets=knobs.buckets,
                                      fuse=knobs.fuse)
+
+    def apply(self, knobs: KnobSet, reason: str = "apply") -> None:
+        """Push a KnobSet into the wired layers, remembering the previous
+        set for one-step rollback. A kernel-variant/stitch swap that fails
+        MID-SWAP (the ``tuner.kernel_apply`` chaos seam, or any push
+        failure) restores the incumbent knob set — replies stay bitwise
+        those of the incumbent variant."""
+        with self._lock:
+            prev = self.knobs
+            self._prev = prev
+            self.knobs = knobs
+            self.applies += 1
+            # serving watch: ignore the next batches' e2e (fresh-bucket
+            # compile spike) before judging the new knobs
+            self._e2e_skip = 2
+        variant_change = knobs.kernel_variants != prev.kernel_variants
+        swap_change = variant_change or knobs.stitch != prev.stitch
+        fused = self.fused
+        try:
+            if swap_change:
+                # chaos seam: a raise here lands MID-SWAP — tuner state
+                # already points at the new knobs, the fused model still
+                # runs the incumbent — the exact window the rollback
+                # handler below must make safe
+                faults.fire(faults.TUNER_KERNEL_APPLY)
+            if fused is not None and hasattr(fused, "set_tuning"):
+                self._push(fused, knobs)
+        except Exception as e:  # noqa: BLE001 — a failed swap never serves
+            with self._lock:
+                self.knobs = prev
+                self._prev = None  # the failed swap is not a step to redo
+                self.rollbacks += 1
+            if fused is not None and hasattr(fused, "set_tuning"):
+                try:
+                    self._push(fused, prev)  # re-pin the incumbent
+                except Exception:  # noqa: BLE001
+                    pass
+            self._log("kernel_apply_rollback", error=str(e),
+                      knobs=prev.to_dict())
+            return
+        if variant_change:
+            with self._lock:
+                self.variant_switches += 1
         if self.controller is not None and knobs.window_seed_ms is not None:
             seed = getattr(self.controller, "seed_compute_ms", None)
             if callable(seed):
@@ -505,10 +625,11 @@ class Tuner:
             knob_ref = self.knobs
             applies, rollbacks, epochs = \
                 self.applies, self.rollbacks, self.epochs
+            switches = self.variant_switches
             e2e = {"before_ms": self._e2e_before,
                    "after_ms": self._e2e_after}
         knobs = knob_ref.to_dict()
-        return {
+        out = {
             "every": self.every, "tolerance": self.tolerance,
             "epochs": epochs, "applies": applies, "rollbacks": rollbacks,
             "calibrated": self.model.calibrated(),
@@ -519,6 +640,9 @@ class Tuner:
             "e2e_ewma": e2e,
             "journal": journal,
         }
+        if switches:  # key absent until a variant ever switched: parity
+            out["variant_switches"] = switches
+        return out
 
     def to_dict(self) -> Dict[str, Any]:
         # snapshot the model OUTSIDE our lock: it takes its own (single
@@ -532,6 +656,8 @@ class Tuner:
                    "epochs": self.epochs,
                    "journal": list(self.journal),
                    "model": model}
+            if self.variant_switches:
+                out["variant_switches"] = self.variant_switches
         out["knobs"] = knob_ref.to_dict()
         return out
 
@@ -546,5 +672,6 @@ class Tuner:
         t.applies = int(d.get("applies", 0))
         t.rollbacks = int(d.get("rollbacks", 0))
         t.epochs = int(d.get("epochs", 0))
+        t.variant_switches = int(d.get("variant_switches", 0))
         t.journal = list(d.get("journal") or [])
         return t

@@ -115,18 +115,19 @@ def _hist_kernel(bins_ref, vals_ref, out_ref, *, nf: int, b_pad: int,
         out_ref[:, f * b_pad : (f + 1) * b_pad] += acc
 
 
-def _hist_slab(bins_slab, vals, b_pad: int, interpret: bool, hilo: bool):
+def _hist_slab(bins_slab, vals, b_pad: int, interpret: bool, hilo: bool,
+               chunk: int):
     """[Fs, N_pad] bins + [3|5, N_pad] masked vals -> [3, Fs*b_pad] sums."""
     fs, n_pad = bins_slab.shape
-    n_chunks = n_pad // CHUNK
+    n_chunks = n_pad // chunk
     nch = vals.shape[0]
     return pl.pallas_call(
         functools.partial(_hist_kernel, nf=fs, b_pad=b_pad, hilo=hilo),
         grid=(n_chunks,),
         in_specs=[
-            pl.BlockSpec((fs, CHUNK), lambda j: (0, j),
+            pl.BlockSpec((fs, chunk), lambda j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((nch, CHUNK), lambda j: (0, j),
+            pl.BlockSpec((nch, chunk), lambda j: (0, j),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((3, fs * b_pad), lambda j: (0, 0),
@@ -166,7 +167,8 @@ def hist_hilo() -> bool:
 
 def compute_histogram_mxu(bins_fm, grad, hess, row_mask, num_bins: int,
                           interpret: bool = False,
-                          hilo: Optional[bool] = None):
+                          hilo: Optional[bool] = None,
+                          chunk: Optional[int] = None):
     """[F,N] feature-major int bins + per-row grad/hess + row mask ->
     [F, num_bins, 3] sums.
 
@@ -178,21 +180,27 @@ def compute_histogram_mxu(bins_fm, grad, hess, row_mask, num_bins: int,
     MMLSPARK_TPU_HIST_EXACT between calls takes effect (it is a static jit
     arg below — resolving it inside would freeze the first call's value
     into the cache). Jitted callers (the fused tree/scan bodies) resolve it
-    at their own trace time.
+    at their own trace time. ``chunk`` (row-chunk size — the Tuner's
+    ``hist.c*`` kernel variants) resolves from the variant registry the
+    same way, falling back to the env-tuned module default.
     """
     if hilo is None:
         hilo = hist_hilo()
+    if chunk is None:
+        from ..core import kernels as _kernels
+
+        chunk = int(_kernels.active_param("hist", "chunk", CHUNK))
     return _compute_histogram_mxu(bins_fm, grad, hess, row_mask, num_bins,
-                                  interpret, hilo)
+                                  interpret, hilo, chunk)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_bins", "interpret", "hilo"))
+                   static_argnames=("num_bins", "interpret", "hilo", "chunk"))
 def _compute_histogram_mxu(bins_fm, grad, hess, row_mask, num_bins: int,
-                           interpret: bool, hilo: bool):
+                           interpret: bool, hilo: bool, chunk: int = CHUNK):
     f, n = bins_fm.shape
     b_pad = max(128, _round_up(num_bins, 128))
-    n_pad = _round_up(max(n, 1), CHUNK)
+    n_pad = _round_up(max(n, 1), chunk)
 
     m = row_mask.astype(jnp.float32)
     g = (grad * m).astype(jnp.float32)
@@ -217,7 +225,7 @@ def _compute_histogram_mxu(bins_fm, grad, hess, row_mask, num_bins: int,
     for f0 in range(0, f, FMAX):
         fs = min(FMAX, f - f0)
         out = _hist_slab(bins_p[f0 : f0 + fs, :], vals, b_pad, interpret,
-                         hilo)
+                         hilo, chunk)
         slabs.append(out.reshape(3, fs, b_pad))
     hist = jnp.concatenate(slabs, axis=1)        # [3, F, b_pad]
     return hist.transpose(1, 2, 0)[:, :num_bins, :]

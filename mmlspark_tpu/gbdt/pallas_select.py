@@ -107,7 +107,6 @@ def _select_kernel(offs_ref, bins_ref, g_ref, h_ref, m_ref,
     cp.wait()
 
 
-@functools.partial(jax.jit, static_argnames=("cap", "interpret"))
 def select_rows(bins_fm, grad, hess, mask, cap: int, interpret: bool = False):
     """Compact the masked rows of feature-major data to the buffer front.
 
@@ -116,11 +115,27 @@ def select_rows(bins_fm, grad, hess, mask, cap: int, interpret: bool = False):
     cap: static output width (caller guarantees mask.sum() <= cap; rows
     beyond the count are zero).
     Returns (bins_c [F, cap] int32, grad_c [cap] f32, hess_c [cap] f32).
+
+    The row-tile width (the Tuner's ``select.c*`` kernel variants) resolves
+    from the variant registry OUTSIDE the jit boundary — it is a static arg
+    of the jitted body, so resolving inside would freeze the first call's
+    value into the cache. Compaction is exact at every tile width: each
+    selected row is written exactly once by pass-through one-hot products.
     """
+    from ..core import kernels as _kernels
+
+    chunk = int(_kernels.active_param("select", "chunk", CHUNK))
+    return _select_rows(bins_fm, grad, hess, mask, cap, interpret, chunk)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cap", "interpret", "chunk"))
+def _select_rows(bins_fm, grad, hess, mask, cap: int, interpret: bool = False,
+                 chunk: int = CHUNK):
     f, n = bins_fm.shape
-    n_pad = _round_up(max(n, 1), CHUNK)
-    n_tiles = n_pad // CHUNK
-    cap_pad = _round_up(cap, CHUNK) + CHUNK  # slack: every tile writes CHUNK
+    n_pad = _round_up(max(n, 1), chunk)
+    n_tiles = n_pad // chunk
+    cap_pad = _round_up(cap, chunk) + chunk  # slack: every tile writes chunk
     c_pad = _round_up(f + 2, 128)            # HBM minor-dim (1,128) tiling
 
     m2 = jnp.pad(mask, (0, n_pad - n)).astype(jnp.float32).reshape(1, n_pad)
@@ -128,7 +143,7 @@ def select_rows(bins_fm, grad, hess, mask, cap: int, interpret: bool = False):
     g2 = jnp.pad(grad.astype(jnp.float32), (0, n_pad - n)).reshape(1, n_pad)
     h2 = jnp.pad(hess.astype(jnp.float32), (0, n_pad - n)).reshape(1, n_pad)
 
-    counts = m2.reshape(n_tiles, CHUNK).sum(axis=1).astype(jnp.int32)
+    counts = m2.reshape(n_tiles, chunk).sum(axis=1).astype(jnp.int32)
     offs = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
 
@@ -136,10 +151,10 @@ def select_rows(bins_fm, grad, hess, mask, cap: int, interpret: bool = False):
         num_scalar_prefetch=1,
         grid=(n_tiles,),
         in_specs=[
-            pl.BlockSpec((f, CHUNK), lambda j, offs: (0, j)),
-            pl.BlockSpec((1, CHUNK), lambda j, offs: (0, j)),
-            pl.BlockSpec((1, CHUNK), lambda j, offs: (0, j)),
-            pl.BlockSpec((1, CHUNK), lambda j, offs: (0, j)),
+            pl.BlockSpec((f, chunk), lambda j, offs: (0, j)),
+            pl.BlockSpec((1, chunk), lambda j, offs: (0, j)),
+            pl.BlockSpec((1, chunk), lambda j, offs: (0, j)),
+            pl.BlockSpec((1, chunk), lambda j, offs: (0, j)),
         ],
         out_specs=[
             # HBM explicitly: ANY may place small tiers in VMEM, where
@@ -148,19 +163,19 @@ def select_rows(bins_fm, grad, hess, mask, cap: int, interpret: bool = False):
             pl.BlockSpec(memory_space=pltpu.HBM),
         ],
         scratch_shapes=[
-            pltpu.VMEM((CHUNK, c_pad), jnp.float32),
+            pltpu.VMEM((chunk, c_pad), jnp.float32),
             pltpu.SemaphoreType.DMA,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_select_kernel, nf=f, chunk=CHUNK, c_pad=c_pad),
+        functools.partial(_select_kernel, nf=f, chunk=chunk, c_pad=c_pad),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((cap_pad, c_pad), jnp.float32),
         ],
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
-            flops=2 * n_pad * CHUNK * (f + 3),
+            flops=2 * n_pad * chunk * (f + 3),
             bytes_accessed=bins_p.size * bins_p.dtype.itemsize
             + (f + 8) * n_pad * 4,
             transcendentals=0,

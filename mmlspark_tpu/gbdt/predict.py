@@ -219,6 +219,7 @@ class DeviceEnsemble:
         for t in trees:
             self.max_depth = max(self.max_depth, _tree_depth(t))
         self._jitted = None
+        self._jitted_gather = None
         self._gemm = None
         if self.cat_vals is None and not self.cat_host_fallback:
             self._build_gemm(trees)
@@ -378,15 +379,28 @@ class DeviceEnsemble:
     GEMM_ROW_CHUNK = 1 << 16
     _gemm_row_chunk = GEMM_ROW_CHUNK
 
-    def device_forward(self):
+    def device_forward(self, params=None):
         """The traced forest kernel X[f32] -> [N, num_class] f32 raw scores
         for pipeline fusion, or None when only the host traversal is valid
         (empty/categorical-fallback forests). Returns the SAME jitted
         callable predict_raw dispatches — calling it inside an enclosing
         jit inlines the identical jaxpr, so a fused segment's forest
-        arithmetic is bitwise-equal to the standalone path."""
+        arithmetic is bitwise-equal to the standalone path.
+
+        ``params`` (a kernel-variant params dict, see core.kernels) selects
+        the traversal implementation: ``{"impl": "gather"}`` forces the
+        fori_loop gather kernel even when the GEMM path matrix is built;
+        ``{"impl": "gemm"}`` (and None/default) keeps the default routing.
+        Both implementations are exact — leaf values reach the output as
+        one-hot products with exact-zero padding — so every variant is
+        bitwise-equal; the variants differ only in compiled-program cost.
+        """
         if self.num_trees == 0 or self.cat_host_fallback:
             return None
+        if params and params.get("impl") == "gather":
+            if self._jitted_gather is None:
+                self._jitted_gather = self._compile()
+            return self._jitted_gather
         if self._jitted is None:
             self._jitted = (self._compile_gemm() if self._gemm is not None
                             else self._compile())

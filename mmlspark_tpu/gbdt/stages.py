@@ -477,10 +477,18 @@ class _LightGBMModelBase(Model, HasFeaturesCol):
     def _device_scores(self):
         """(featuresCol, raw env key, traceable fn) for fusion, or None when
         the forest only has a host path (empty / categorical fallback).
-        The fn inlines the SAME jitted forest kernel predict_raw uses."""
+        The fn inlines the SAME jitted forest kernel predict_raw uses.
+
+        The forest traversal implementation (``forest.gemm`` vs
+        ``forest.gather`` kernel variants — both exact, see
+        EnsemblePredictor.device_forward) resolves from the variant registry
+        at TRACE time: the executor activates the chosen variant around
+        lower/compile, so each variant's program lands under its own
+        ``variant=<id>;``-prefixed CompileCache key."""
         from ..core.device_stage import FusionUnsupported
 
-        fwd = self._ensemble().device_forward()
+        ens = self._ensemble()
+        fwd = ens.device_forward()
         if fwd is None:
             return None
         feats = self.get_or_throw("featuresCol")
@@ -489,17 +497,24 @@ class _LightGBMModelBase(Model, HasFeaturesCol):
         def fn(params, env):
             import jax.numpy as jnp
 
+            from ..core import kernels as _kernels
+
+            var = _kernels.active("forest")
+            f = (ens.device_forward(var.params) if var is not None
+                 else fwd) or fwd
             X = env[feats]
             if X.ndim != 2:
                 raise FusionUnsupported(f"features must be [N, F], got {X.shape}")
-            return {raw_key: fwd(X.astype(jnp.float32))}
+            return {raw_key: f(X.astype(jnp.float32))}
 
         return feats, raw_key, fn
 
-    def _score_device_fn(self, finalize, extra_out_cols):
+    def _score_device_fn(self, finalize, extra_out_cols, **stitch_caps):
         """Build the terminal DeviceFn shared by the model subclasses:
         forest scores on device, f64 base-score/objective math in the
-        host finalize (bitwise-identical to the unfused score())."""
+        host finalize (bitwise-identical to the unfused score()).
+        ``stitch_caps`` passes through the optional transpiled-finalizer
+        capability fields (device_finalize & co — see DeviceFn)."""
         from ..core.device_stage import DeviceFn
 
         base = self._device_scores()
@@ -510,6 +525,7 @@ class _LightGBMModelBase(Model, HasFeaturesCol):
             key=(type(self).__name__, self.uid, feats),
             in_cols=(feats,), out_cols=tuple(extra_out_cols), fn=fn,
             device_outputs=(raw_key,), finalize=finalize,
+            **stitch_caps,
             # nulls/sparse rows take the unfused path (CSR predict / the
             # host error), identically to the per-stage chain
             null_policy="fallback", reject_sparse=True,
@@ -594,15 +610,64 @@ class LightGBMClassificationModel(_LightGBMModelBase):
         return df.map_partitions(score)
 
     def device_fn(self, schema: Schema):
+        raw_key = f"__gbdt_raw__{self.uid}"
+        proba_key = f"__gbdt_proba__{self.uid}"
+        pred_key = f"__gbdt_pred__{self.uid}"
+        binary = self.booster.params.objective == "binary"
+        base = self.booster.base_score
+
         def finalize(outs, ctx):
-            raw_key = next(iter(outs))
             raw = np.asarray(outs[raw_key], dtype=np.float64) \
-                + self.booster.base_score[None, :]
+                + base[None, :]
             return self._score_columns(raw)
+
+        def device_finalize(params, env):
+            # transpiled finalizer (docs/compiler_search.md): the host f64
+            # objective math re-expressed as a jittable f32 shim so the
+            # probability/prediction reductions ride the fused program
+            # instead of a second host pass — numeric deviation vs the f64
+            # path is DECLARED via finalize_tolerance below
+            import jax.numpy as jnp
+
+            raw32 = env[raw_key] + jnp.asarray(base,
+                                               dtype=jnp.float32)[None, :]
+            if binary:
+                p1 = 1.0 / (1.0 + jnp.exp(-raw32[:, 0]))
+                proba = jnp.stack([1.0 - p1, p1], axis=1)
+            else:
+                e = jnp.exp(raw32 - raw32.max(axis=1, keepdims=True))
+                proba = e / e.sum(axis=1, keepdims=True)
+            pred = jnp.argmax(proba, axis=1).astype(jnp.float32)
+            return {proba_key: proba, pred_key: pred}
+
+        def finalize_stitched(outs, ctx):
+            # rawPrediction stays BITWISE: rebuilt from the same f64 raw
+            # readback the host finalize uses; only proba/pred come from
+            # the device f32 shim
+            raw = np.asarray(outs[raw_key], dtype=np.float64) \
+                + base[None, :]
+            rawcol = (np.stack([-raw[:, 0], raw[:, 0]], axis=1)
+                      if binary else raw)
+            proba = np.asarray(outs[proba_key], dtype=np.float64)
+            pred = np.asarray(outs[pred_key], dtype=np.float64)
+            n = len(pred)
+            raw_obj = np.empty(n, dtype=object)
+            proba_obj = np.empty(n, dtype=object)
+            for i in range(n):
+                raw_obj[i] = rawcol[i]
+                proba_obj[i] = proba[i]
+            return {self.get("rawPredictionCol"): raw_obj,
+                    self.get("probabilityCol"): proba_obj,
+                    self.get("predictionCol"): pred}
 
         return self._score_device_fn(
             finalize, (self.get("rawPredictionCol"),
-                       self.get("probabilityCol"), self.get("predictionCol")))
+                       self.get("probabilityCol"), self.get("predictionCol")),
+            stitchable=True,
+            device_finalize=device_finalize,
+            device_finalize_outputs=(proba_key, pred_key),
+            finalize_stitched=finalize_stitched,
+            finalize_tolerance=1e-5)
 
     def transform_schema(self, schema: Schema) -> Schema:
         out = schema.copy()

@@ -231,6 +231,18 @@ def _fusion_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
         yield MetricFamily("mmlspark_fusion_fallbacks", "gauge",
                            "partitions that fell back to the host path "
                            "on the last transform").add(len(fallbacks))
+    # cross-segment stitches in force (core/fusion.py plan()): one sample
+    # per merged segment, valued at the number of transpiled shims it
+    # carries. The stats key — and hence this family — is absent while no
+    # stitch is active, keeping the default exposition byte-identical.
+    stitched = stats.get("stitched")
+    if stitched:
+        fam = MetricFamily(
+            "mmlspark_segment_stitched", "gauge",
+            "transpiled host shims stitched through per fused segment")
+        for seg, names in stitched.items():
+            fam.add(float(len(names or ())), {"segment": str(seg)})
+        yield fam
     # per-(segment, shape-bucket) XLA costs + roofline attribution
     # (obs/perf.py; families absent when the backend reports no cost data)
     from .perf import segment_families
@@ -431,6 +443,25 @@ def _tuner_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
             knob.add(f, {"knob": name})
     if knob.samples:
         yield knob
+    # compiler-search knobs: the per-(segment, bucket) kernel variant in
+    # force (info-style gauge, value 1) and the switch counter. Both are
+    # absent until the knob first moves, so the exposition of a server
+    # that never tuned variants stays byte-identical to pre-search builds.
+    variant = MetricFamily(
+        "mmlspark_kernel_variant", "gauge",
+        "applied Pallas kernel variant per (segment, bucket) — info "
+        "gauge, value is always 1")
+    for seg, buckets in (knobs.get("kernel_variants") or {}).items():
+        for bucket, vid in (buckets or {}).items():
+            variant.add(1.0, {"segment": seg, "bucket": str(bucket),
+                              "variant": str(vid)})
+    if variant.samples:
+        yield variant
+    f = _num(stats.get("variant_switches"))
+    if f is not None and f > 0:
+        yield MetricFamily(
+            "mmlspark_kernel_variant_switches_total", "counter",
+            "tuner applies that changed the kernel-variant knob").add(f)
     conf = MetricFamily("mmlspark_tuner_confidence", "gauge",
                         "cost-model calibration confidence per segment")
     for seg, v in ((stats.get("model") or {}).get("confidence")

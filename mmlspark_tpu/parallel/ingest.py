@@ -431,7 +431,14 @@ def rows_to_batch(rows, out: Optional[np.ndarray] = None,
     ``out``: slot-fill mode — a pre-allocated [cap, ...] staging slot
     (SlotPool buffer) receiving the rows in place; returns ``out[:B]``.
     ``stats``: optional IngestStats receiving the zero-copy vs copied
-    batch counters."""
+    batch counters.
+
+    A fused segment that re-enters the device after a terminal host
+    finalize pays this re-batch per boundary crossing; the cross-segment
+    stitch (docs/compiler_search.md) removes that call entirely for
+    stitched plans — downstream stages ride the segment's device-resident
+    columns, so this path only runs where a genuine host boundary
+    remains."""
     arrs = [np.asarray(r) for r in rows]
     if not arrs:
         raise ValueError("rows_to_batch needs at least one row")

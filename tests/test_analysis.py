@@ -360,6 +360,55 @@ def test_d001_devicefn_fn_bodies_are_checked():
     assert len(hits) == 1 and "time.sleep" in hits[0].message
 
 
+TRANSPILED_IMPURE = """
+    import time
+    import numpy as np
+
+    def build(self):
+        def finalize(outs, ctx):
+            # host finalizer: free to use numpy — NOT transpiled
+            return {"p": np.stack([o for o in outs])}
+
+        def device_finalize(params, env):
+            t0 = time.perf_counter()
+            return {"p": np.stack([env["raw"], env["raw"]])}
+
+        return self._score_device_fn(
+            finalize, device_finalize=device_finalize)
+"""
+
+TRANSPILED_CLEAN = """
+    import numpy as np
+
+    def build(self):
+        def finalize(outs, ctx):
+            return {"p": np.stack([o for o in outs])}
+
+        def device_finalize(params, env):
+            import jax.numpy as jnp
+            raw = env["raw"]
+            return {"p": jnp.stack([raw, raw], axis=1)}
+
+        return self._score_device_fn(
+            finalize, device_finalize=device_finalize)
+"""
+
+
+def test_d001_transpiled_finalizer_flags_np_and_time():
+    # a device_finalize= shim runs INSIDE the fused trace: bare numpy
+    # and time.* there are findings; the plain host finalize is exempt
+    hits = finds(TRANSPILED_IMPURE, "D001")
+    joined = "\n".join(h.message for h in hits)
+    assert len(hits) == 2, joined
+    assert "time.perf_counter" in joined
+    assert "np.stack" in joined and "jnp only" in joined
+    assert all("device_finalize" in h.message for h in hits)
+
+
+def test_d001_transpiled_finalizer_jnp_is_clean():
+    assert finds(TRANSPILED_CLEAN, "D001") == []
+
+
 STAGING_ALLOC = """
     import numpy as np
     from ..parallel.ingest import TransferRing

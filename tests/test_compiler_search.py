@@ -1,0 +1,490 @@
+"""Whole-pipeline compiler search (cross-segment stitching + kernel
+variants).
+
+The load-bearing contract has two halves:
+
+- **Cold-start identity**: with tuning disabled (or the cost model
+  uncalibrated) plans, CompileCache keys, replies, and the metrics
+  exposition are BITWISE identical to the pre-search build — every knob
+  defaults off, every new stats/metric key is absent until it moves.
+- **Opt-in wins stay honest**: a stitch keeps the terminal GBDT segment
+  open (rawPrediction bitwise from the f64 readback, proba/pred within
+  the declared finalize tolerance), exact-compute kernel variants are
+  enforced bitwise, reduction-order-sensitive ones gate on their declared
+  allclose tolerance, and a variant apply that dies MID-SWAP rolls back
+  to the incumbent with bitwise-identical replies (the
+  ``tuner.kernel_apply`` chaos seam).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.core import faults, kernels
+from mmlspark_tpu.core.costmodel import SegmentCostModel, bucket_of_shape
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.device_stage import CompileCache
+from mmlspark_tpu.core.faults import FaultInjector
+from mmlspark_tpu.core.fusion import FusedPipelineModel, Segment, plan
+from mmlspark_tpu.core.pipeline import PipelineModel
+from mmlspark_tpu.core.tune import KnobSet, Tuner
+from mmlspark_tpu.featurize.assemble import FastVectorAssembler
+from mmlspark_tpu.gbdt.stages import LightGBMClassifier
+from mmlspark_tpu.models.dnn_model import DNNModel
+from mmlspark_tpu.models.module import Dense, FunctionModel, Sequential, relu
+
+CHAOS_SEED = int(os.environ.get("MMLSPARK_CHAOS_SEED", "0"))
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+
+def toy_mlp(d_in=4):
+    mod = Sequential([("d1", Dense(8)), ("act", relu()), ("d2", Dense(3))],
+                     name="toymlp")
+    params, _ = mod.init(jax.random.PRNGKey(1), (d_in,))
+    return FunctionModel(mod, params, (d_in,), layer_names=["d2", "d1"],
+                         name="toymlp")
+
+
+def tabular_df(n=120, seed=5, parts=3):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (a + b[:, 0] > 0).astype(np.float64)
+    return DataFrame.from_dict(
+        {"a": a, "b": [b[i] for i in range(n)], "label": y},
+        num_partitions=parts)
+
+
+def gbdt_chain(df, dnn_in="features"):
+    """FastVectorAssembler -> LightGBMClassificationModel (terminal f64
+    finalize) -> DNNModel riding the in-segment 'features' column."""
+    asm = FastVectorAssembler(inputCols=["a", "b"])
+    clf = LightGBMClassifier(labelCol="label", numIterations=4,
+                             numLeaves=7).fit(asm.transform(df))
+    dnn = DNNModel(inputCol=dnn_in, outputCol="emb", batchSize=16)
+    dnn.set_model(toy_mlp())
+    return [asm, clf, dnn]
+
+
+def collect_cols(df):
+    return df.collect()
+
+
+def seg_label(fused):
+    """Label of the first fused Segment in the active plan."""
+    return [n.label for n in fused._last_plan if hasattr(n, "dfns")][0]
+
+
+def assert_replies_bitwise(ref, got, cols):
+    for name in cols:
+        a, b = ref[name], got[name]
+        assert len(a) == len(b), name
+        for i, (x, y) in enumerate(zip(a, b)):
+            if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+                x2, y2 = np.asarray(x), np.asarray(y)
+                assert x2.dtype == y2.dtype, (name, i)
+                np.testing.assert_array_equal(x2, y2,
+                                              err_msg=f"{name}[{i}]")
+            else:
+                assert (x == y) or (x is None and y is None), (name, i)
+
+
+STITCH_ON = {"LightGBMClassificationModel": True}
+
+
+# --------------------------------------------------------------------------
+# cold-start identity: everything off == pre-search build, bitwise
+# --------------------------------------------------------------------------
+
+
+class TestColdStartParity:
+    def test_default_plan_has_no_stitch(self):
+        df = tabular_df(seed=14)
+        stages = gbdt_chain(df)
+        nodes = plan(stages, df.schema.copy())
+        segs = [n for n in nodes if isinstance(n, Segment)]
+        assert [s.describe()["stages"] for s in segs] == \
+            [["FastVectorAssembler", "LightGBMClassificationModel"],
+             ["DNNModel"]]
+        assert all(s.stitched == [] for s in segs)
+        assert all(s.host_cols == set() for s in segs)
+
+    def test_default_stats_and_cache_keys_undecorated(self):
+        df = tabular_df(seed=14)
+        fused = FusedPipelineModel(gbdt_chain(df), cache=CompileCache())
+        fused.transform(df)
+        stats = fused.fusion_stats()
+        assert "stitched" not in stats
+        assert "tuning" not in stats or \
+            not (stats["tuning"].get("kernel_variants")
+                 or stats["tuning"].get("stitch"))
+        for shapes in fused._cache.costs().values():
+            for shape in shapes:
+                assert not shape.startswith("variant=")
+                assert not shape.startswith("stitch=")
+                assert bucket_of_shape(shape) is not None, shape
+
+    def test_uncalibrated_tuner_proposes_nothing(self):
+        df = tabular_df(seed=14)
+        fused = FusedPipelineModel(gbdt_chain(df), cache=CompileCache())
+        fused.transform(df)
+        tuner = Tuner(fused=fused, model=SegmentCostModel())
+        knobs = tuner.propose()
+        assert knobs.is_default()
+        assert knobs.kernel_variants == {} and knobs.stitch == {}
+
+    def test_default_exposition_has_no_search_families(self):
+        from mmlspark_tpu.obs.bridge import (_fusion_families,
+                                             _tuner_families)
+        from mmlspark_tpu.obs.metrics import render_family
+
+        df = tabular_df(seed=14)
+        fused = FusedPipelineModel(gbdt_chain(df), cache=CompileCache())
+        fused.transform(df)
+        tuner = Tuner(fused=fused, model=SegmentCostModel())
+        text = "\n".join(
+            render_family(f)
+            for f in (list(_fusion_families(fused.fusion_stats()))
+                      + list(_tuner_families(tuner.stats()))))
+        assert "mmlspark_kernel_variant" not in text
+        assert "mmlspark_segment_stitched" not in text
+
+    def test_active_knobs_do_surface_in_exposition(self):
+        from mmlspark_tpu.obs.bridge import (_fusion_families,
+                                             _tuner_families)
+        from mmlspark_tpu.obs.metrics import render_family
+
+        df = tabular_df(seed=14)
+        fused = FusedPipelineModel(gbdt_chain(df), cache=CompileCache())
+        fused.set_tuning(stitch=STITCH_ON)
+        fused.transform(df)
+        tuner = Tuner(fused=fused, model=SegmentCostModel())
+        tuner.apply(KnobSet(
+            kernel_variants={"seg": {"64": "forest.gather"}},
+            stitch=dict(STITCH_ON)))
+        text = "\n".join(
+            render_family(f)
+            for f in (list(_fusion_families(fused.fusion_stats()))
+                      + list(_tuner_families(tuner.stats()))))
+        assert 'mmlspark_kernel_variant{bucket="64",segment="seg",' \
+            'variant="forest.gather"} 1' in text \
+            or "mmlspark_kernel_variant{" in text
+        assert "mmlspark_kernel_variant_switches_total 1" in text
+        assert "mmlspark_segment_stitched{" in text
+
+
+# --------------------------------------------------------------------------
+# bucket_of_shape: generic decorated-prefix rejection
+# --------------------------------------------------------------------------
+
+
+class TestBucketOfShape:
+    def test_plain_shape_keys_parse(self):
+        assert bucket_of_shape("col=64x32x32x3:uint8;") == 64
+        assert bucket_of_shape("features=16x4:float32;") == 16
+
+    def test_existing_decorated_prefixes_rejected(self):
+        # pins the three prefixes older PRs special-cased: mega-dispatch,
+        # sharding spec, and the base shape must still parse behind them
+        assert bucket_of_shape("mega4;x=8x4:float32;") is None
+        assert bucket_of_shape("spec=data;x=8x4:float32;") is None
+        assert bucket_of_shape("mega2;spec=data;x=8x4:float32;") is None
+
+    def test_new_prefixes_rejected_without_special_cases(self):
+        assert bucket_of_shape("variant=hist.c256;x=8x4:float32;") is None
+        assert bucket_of_shape("stitch=LightGBMClassificationModel;"
+                               "x=8x4:float32;") is None
+        # and any FUTURE decorated prefix fails structurally too
+        assert bucket_of_shape("zstd{9};x=8x4:float32;") is None
+        assert bucket_of_shape("nonsense;x=8x4:float32;") is None
+
+
+# --------------------------------------------------------------------------
+# cross-segment stitching
+# --------------------------------------------------------------------------
+
+
+class TestStitch:
+    def test_stitch_override_merges_terminal_boundary(self):
+        df = tabular_df(seed=14)
+        stages = gbdt_chain(df)
+        nodes = plan(stages, df.schema.copy(), stitch_overrides=STITCH_ON)
+        segs = [n for n in nodes if isinstance(n, Segment)]
+        assert len(segs) == 1
+        assert segs[0].describe()["stages"] == \
+            ["FastVectorAssembler", "LightGBMClassificationModel",
+             "DNNModel"]
+        assert segs[0].stitched == ["LightGBMClassificationModel"]
+
+    def test_stitched_transform_matches_within_tolerance(self):
+        df = tabular_df(seed=14)
+        stages = gbdt_chain(df)
+        ref = collect_cols(PipelineModel(stages).transform(df))
+        fused = FusedPipelineModel(stages, cache=CompileCache())
+        fused.set_tuning(stitch=STITCH_ON)
+        got = collect_cols(fused.transform(df))
+        stats = fused.fusion_stats()
+        assert stats["n_fused_segments"] == 1
+        assert stats["fallbacks"] == []
+        assert list(stats["stitched"].values()) == \
+            [["LightGBMClassificationModel"]]
+        # rawPrediction stays BITWISE: it reads back from the same f64
+        # finalize math, only later stages ride the stitched residency
+        assert_replies_bitwise(ref, got, ["a", "b", "label", "features",
+                                          "rawPrediction"])
+        # proba/pred come from the transpiled f32 shim: the declared
+        # finalize tolerance (1e-5) bounds the drift
+        for name in ("probability", "prediction"):
+            for i, (x, y) in enumerate(zip(ref[name], got[name])):
+                np.testing.assert_allclose(
+                    np.asarray(x, dtype=np.float64),
+                    np.asarray(y, dtype=np.float64),
+                    rtol=1e-5, atol=1e-5, err_msg=f"{name}[{i}]")
+
+    def test_stitched_cache_key_carries_stitch_prefix(self):
+        df = tabular_df(seed=14)
+        fused = FusedPipelineModel(gbdt_chain(df), cache=CompileCache())
+        fused.set_tuning(stitch=STITCH_ON)
+        fused.transform(df)
+        shapes = [shape for shapes in fused._cache.costs().values()
+                  for shape in shapes]
+        stitched = [s for s in shapes if s.startswith("stitch=")]
+        assert stitched, shapes
+        assert all(bucket_of_shape(s) is None for s in stitched)
+
+    def test_host_col_reader_still_splits(self):
+        # the stitched stage's own outputs (proba/pred/raw) are HOST-only
+        # columns: a later device stage reading one must split, not read
+        # the f32 shim outputs as if they were the finalized values
+        df = tabular_df(seed=14)
+        stages = gbdt_chain(df, dnn_in="probability")
+        nodes = plan(stages, df.schema.copy(), stitch_overrides=STITCH_ON)
+        segs = [n for n in nodes if isinstance(n, Segment)]
+        assert all("DNNModel" not in s.describe()["stages"]
+                   or "LightGBMClassificationModel"
+                   not in s.describe()["stages"] for s in segs)
+
+    def test_cold_cost_model_never_stitches(self):
+        df = tabular_df(seed=14)
+        model = SegmentCostModel()
+        nodes = plan(gbdt_chain(df), df.schema.copy(), cost_model=model)
+        segs = [n for n in nodes if isinstance(n, Segment)]
+        assert len(segs) == 2
+        assert model.stitch_decision("up", "down") is None
+
+    def test_tuner_stitch_proposal_keyed_by_terminal_stage(self):
+        df = tabular_df(seed=14)
+        fused = FusedPipelineModel(gbdt_chain(df), cache=CompileCache())
+        fused.transform(df)
+
+        class AlwaysStitch(SegmentCostModel):
+            def stitch_decision(self, upstream, downstream, margin=0.95):
+                return True
+
+        tuner = Tuner(fused=fused, model=AlwaysStitch())
+        assert tuner._stitch_proposals() == STITCH_ON
+
+
+# --------------------------------------------------------------------------
+# kernel variants
+# --------------------------------------------------------------------------
+
+
+class TestKernelVariants:
+    def test_registry_defaults_inactive(self):
+        assert kernels.active("hist") is None
+        assert kernels.active_param("hist", "chunk", 512) == 512
+        with kernels.activate("hist.c256"):
+            assert kernels.active("hist").id == "hist.c256"
+            assert kernels.active_param("hist", "chunk", 512) == 256
+        assert kernels.active("hist") is None
+
+    def test_forest_variants_exact(self):
+        # both traversals land leaf values via one-hot reach x value:
+        # exact-compute, enforced bitwise
+        df = tabular_df(seed=14)
+        asm = FastVectorAssembler(inputCols=["a", "b"])
+        clf = LightGBMClassifier(labelCol="label", numIterations=4,
+                                 numLeaves=7).fit(asm.transform(df))
+        ens = clf._ensemble()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(32, 4)).astype(np.float32)
+        default = np.asarray(ens.device_forward()(X))
+        gather = np.asarray(ens.device_forward({"impl": "gather"})(X))
+        gemm = np.asarray(ens.device_forward({"impl": "gemm"})(X))
+        np.testing.assert_array_equal(default, gather)
+        np.testing.assert_array_equal(default, gemm)
+
+    def test_hist_variants_within_declared_tolerance(self):
+        from mmlspark_tpu.gbdt.pallas_hist import compute_histogram_mxu
+
+        rng = np.random.default_rng(3)
+        n, f, nb = 700, 5, 16
+        bins = rng.integers(0, nb, size=(f, n)).astype(np.int32)
+        grad = rng.normal(size=n).astype(np.float32)
+        hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+        mask = rng.uniform(size=n) < 0.8
+        base = np.asarray(compute_histogram_mxu(
+            bins, grad, hess, mask, nb, interpret=True))
+        tol = kernels.get("hist.c256").tolerance
+        assert tol is not None  # reduction-order-sensitive: declared
+        for vid in ("hist.c256", "hist.c1024"):
+            with kernels.activate(vid):
+                got = np.asarray(compute_histogram_mxu(
+                    bins, grad, hess, mask, nb, interpret=True))
+            np.testing.assert_allclose(got, base, rtol=tol, atol=tol)
+
+    def test_select_variants_exact(self):
+        from mmlspark_tpu.gbdt import pallas_select
+
+        rng = np.random.default_rng(4)
+        n, f = 600, 3
+        bins = rng.integers(0, 16, size=(f, n)).astype(np.int32)
+        grad = rng.normal(size=n).astype(np.float32)
+        hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+        mask = rng.uniform(size=n) < 0.5
+        cap = int(mask.sum()) + 8
+        try:
+            base = [np.asarray(x) for x in pallas_select.select_rows(
+                bins, grad, hess, mask, cap, interpret=True)]
+        except AttributeError as e:  # pre-existing env gap (pltpu.HBM)
+            pytest.skip(f"pallas select unavailable here: {e}")
+        assert kernels.get("select.c512").tolerance is None  # exact
+        for vid in ("select.c512", "select.c2048"):
+            with kernels.activate(vid):
+                got = [np.asarray(x) for x in pallas_select.select_rows(
+                    bins, grad, hess, mask, cap, interpret=True)]
+            for b, g in zip(base, got):
+                np.testing.assert_array_equal(b, g)
+
+    def test_variant_knob_decorates_cache_keys(self):
+        df = tabular_df(seed=14)
+        stages = gbdt_chain(df)[:2]  # asm + classifier: one segment
+        ref = collect_cols(PipelineModel(stages).transform(df))
+        fused = FusedPipelineModel(stages, cache=CompileCache())
+        fused.transform(df)
+        label = seg_label(fused)
+        fused.set_tuning(
+            kernel_variants={label: {"*": "forest.gather"}})
+        got = collect_cols(fused.transform(df))
+        # exact-compute variant: replies stay bitwise
+        assert_replies_bitwise(ref, got, list(ref.keys()))
+        shapes = [shape for shapes in fused._cache.costs().values()
+                  for shape in shapes]
+        decorated = [s for s in shapes
+                     if s.startswith("variant=forest.gather;")]
+        assert decorated, shapes
+        assert all(bucket_of_shape(s) is None for s in decorated)
+        stats = fused.fusion_stats()
+        assert stats["tuning"]["kernel_variants"] == \
+            {label: {"*": "forest.gather"}}
+
+    def test_cost_model_variant_selection_flow(self):
+        model = SegmentCostModel(min_obs=2)
+        seg, b = "seg", 64
+        for _ in range(3):
+            model.observe_variant(seg, b, "default", 0.010)
+            model.observe_variant(seg, b, "hist.c256", 0.004)
+            model.observe_variant(seg, b, "hist.c1024", 0.011)
+        assert model.variant_buckets(seg) == [b]
+        assert model.choose_variant(seg, b) == "hist.c256"
+        # round-trips through serialization
+        again = SegmentCostModel.from_dict(model.to_dict())
+        assert again.choose_variant(seg, b) == "hist.c256"
+        # no-trial (cold) segments choose nothing
+        assert model.choose_variant("other", b) is None
+
+
+# --------------------------------------------------------------------------
+# KnobSet / Tuner plumbing
+# --------------------------------------------------------------------------
+
+
+class TestKnobPlumbing:
+    def test_knobset_round_trip_and_default(self):
+        k = KnobSet(kernel_variants={"seg": {"64": "hist.c256"}},
+                    stitch={"LightGBMClassificationModel": True})
+        assert not k.is_default()
+        d = k.to_dict()
+        assert d["kernel_variants"] == {"seg": {"64": "hist.c256"}}
+        assert d["stitch"] == {"LightGBMClassificationModel": True}
+        assert KnobSet.from_dict(d).to_dict() == d
+        # defaults serialize EMPTY: payload parity with pre-search builds
+        assert KnobSet().to_dict() == {}
+
+    def test_push_degrades_to_older_set_tuning_signatures(self):
+        calls = []
+
+        class OldFused:
+            def set_tuning(self, buckets=None, fuse=None, mega_k=None,
+                           sharding=None):
+                calls.append(("old", buckets, fuse, mega_k, sharding))
+
+        class OlderFused:
+            def set_tuning(self, buckets=None, fuse=None):
+                calls.append(("older", buckets, fuse))
+
+        knobs = KnobSet(buckets={"s": (8,)}, stitch={"X": True})
+        Tuner._push(OldFused(), knobs)
+        Tuner._push(OlderFused(), knobs)
+        assert [c[0] for c in calls] == ["old", "older"]
+
+    def test_variant_switch_counter_gated(self):
+        tuner = Tuner(model=SegmentCostModel())
+        assert "variant_switches" not in tuner.stats()
+        tuner.apply(KnobSet(kernel_variants={"s": {"*": "forest.gather"}}))
+        assert tuner.stats()["variant_switches"] == 1
+        assert tuner.variant_switches == 1
+
+
+# --------------------------------------------------------------------------
+# chaos: the tuner.kernel_apply seam (CI chaos lane, -m faults)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+class TestKernelApplyChaos:
+    @pytest.mark.parametrize("seed", [0, 7, 1337])
+    def test_mid_swap_failure_rolls_back_bitwise(self, seed):
+        df = tabular_df(seed=14)
+        stages = gbdt_chain(df)[:2]
+        fused = FusedPipelineModel(stages, cache=CompileCache())
+        before = collect_cols(fused.transform(df))
+        label = seg_label(fused)
+        tuner = Tuner(fused=fused, model=SegmentCostModel())
+        incumbent = tuner.knobs
+        bad = KnobSet(kernel_variants={label: {"*": "forest.gather"}})
+        with FaultInjector(seed=seed or CHAOS_SEED).plan(
+                faults.TUNER_KERNEL_APPLY, at=(1,)) as inj:
+            tuner.apply(bad)
+            assert len(inj.fired(faults.TUNER_KERNEL_APPLY)) == 1
+        # one-step rollback: incumbent restored, journaled, counted
+        assert tuner.knobs is incumbent
+        assert tuner.rollbacks == 1
+        assert tuner.variant_switches == 0
+        entry = [e for e in tuner.journal
+                 if e["action"] == "kernel_apply_rollback"]
+        assert len(entry) == 1 and entry[0]["knobs"] == {}
+        # replies stay bitwise those of the incumbent variant
+        after = collect_cols(fused.transform(df))
+        assert_replies_bitwise(before, after, list(before.keys()))
+
+    def test_swap_succeeds_without_injection(self):
+        df = tabular_df(seed=14)
+        stages = gbdt_chain(df)[:2]
+        fused = FusedPipelineModel(stages, cache=CompileCache())
+        fused.transform(df)
+        label = seg_label(fused)
+        tuner = Tuner(fused=fused, model=SegmentCostModel())
+        tuner.apply(KnobSet(kernel_variants={label: {"*": "forest.gemm"}}))
+        assert tuner.rollbacks == 0
+        assert tuner.variant_switches == 1
+        assert fused.fusion_stats()["tuning"]["kernel_variants"] == \
+            {label: {"*": "forest.gemm"}}

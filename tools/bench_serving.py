@@ -727,6 +727,184 @@ def _autotune_section(reps=6):
     return out
 
 
+def _compiler_search_section(reps=6, rows=480, parts=4):
+    """Whole-pipeline compiler search A/B (stitch + kernel variants), all
+    three layers PAIRED-interleaved per the PR 7 obs_overhead methodology
+    (alternating rounds in one process — placement luck cancels):
+
+    - ``stitch``: the GBDT chain (FastVectorAssembler ->
+      LightGBMClassificationModel -> DNNModel riding the device-resident
+      'features' column). The split plan closes the segment at the
+      terminal classifier and pays the f64 readback + ``rows_to_batch``
+      re-batch + H2D round-trip before the DNN; the stitched plan keeps
+      the segment open through the transpiled ``device_finalize`` shim.
+      Rows/s both ways plus the parity evidence (rawPrediction bitwise
+      from the same f64 readback; probability within the declared
+      finalize tolerance).
+    - ``forest_variant``: forest-traversal gather vs gemm on the trained
+      ensemble — exact compute, so the A/B doubles as the bitwise check.
+    - ``hist_variant``: Pallas histogram chunk-variant trials fed through
+      the cost model (``observe_variant`` -> ``choose_variant``) and, if
+      a winner clears the margin, applied via the Tuner so the decision
+      is journaled and one-step rollback-able.
+    """
+    import jax
+
+    from mmlspark_tpu.core.costmodel import SegmentCostModel
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.core.device_stage import CompileCache
+    from mmlspark_tpu.core.fusion import FusedPipelineModel
+    from mmlspark_tpu.core.tune import KnobSet, Tuner
+    from mmlspark_tpu.featurize.assemble import FastVectorAssembler
+    from mmlspark_tpu.gbdt.pallas_hist import compute_histogram_mxu
+    from mmlspark_tpu.gbdt.stages import LightGBMClassifier
+    from mmlspark_tpu.models import DNNModel
+    from mmlspark_tpu.models.module import (Dense, FunctionModel,
+                                            Sequential, relu)
+
+    out = {}
+    stitch_on = {"LightGBMClassificationModel": True}
+
+    # -- the GBDT chain whose terminal finalize the stitch transpiles ----
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=rows).astype(np.float32)
+    b = rng.normal(size=(rows, 3)).astype(np.float32)
+    y = (a + b[:, 0] > 0).astype(np.float64)
+    df = DataFrame.from_dict(
+        {"a": a, "b": [b[i] for i in range(rows)], "label": y},
+        num_partitions=parts)
+    asm = FastVectorAssembler(inputCols=["a", "b"])
+    clf = LightGBMClassifier(labelCol="label", numIterations=16,
+                             numLeaves=15).fit(asm.transform(df))
+    mod = Sequential([("d1", Dense(64)), ("act", relu()),
+                      ("d2", Dense(16))], name="csbench")
+    params, _ = mod.init(jax.random.PRNGKey(1), (4,))
+    dnn = DNNModel(inputCol="features", outputCol="emb", batchSize=64)
+    dnn.set_model(FunctionModel(mod, params, (4,),
+                                layer_names=["d2", "d1"], name="csbench"))
+    fused = FusedPipelineModel([asm, clf, dnn], cache=CompileCache())
+
+    # warm + compile both plans, then check parity once up front
+    fused.set_tuning(stitch={})
+    ref = fused.transform(df).collect()
+    fused.set_tuning(stitch=dict(stitch_on))
+    got = fused.transform(df).collect()
+    stitched_stats = fused.fusion_stats().get("stitched")
+    rp_ref = np.stack([np.asarray(v) for v in ref["rawPrediction"]])
+    rp_got = np.stack([np.asarray(v) for v in got["rawPrediction"]])
+    pr_ref = np.stack([np.asarray(v) for v in ref["probability"]])
+    pr_got = np.stack([np.asarray(v) for v in got["probability"]])
+    pred_mismatch = int(sum(
+        x != z for x, z in zip(ref["prediction"], got["prediction"])))
+
+    def run_once():
+        t0 = time.perf_counter()
+        fused.transform(df)
+        return rows / (time.perf_counter() - t0)
+
+    split_rates, stitched_rates = [], []
+    for _ in range(reps):
+        fused.set_tuning(stitch={})
+        split_rates.append(run_once())
+        fused.set_tuning(stitch=dict(stitch_on))
+        stitched_rates.append(run_once())
+    mean_split = sum(split_rates) / len(split_rates)
+    mean_stitched = sum(stitched_rates) / len(stitched_rates)
+    out["stitch"] = {
+        "split_rows_s": round(mean_split, 2),
+        "stitched_rows_s": round(mean_stitched, 2),
+        "ratio": round(mean_stitched / mean_split, 4) if mean_split
+        else None,
+        "rounds": reps,
+        "stitched_segments": stitched_stats,
+        "rawprediction_bitwise": bool(np.array_equal(rp_ref, rp_got)),
+        "probability_max_abs_err": float(np.max(np.abs(pr_ref - pr_got))),
+        "finalize_tolerance": 1e-5,
+        "prediction_mismatches": pred_mismatch}
+
+    # -- forest traversal variants: exact compute, bitwise-gated ---------
+    X = rng.normal(size=(256, 4)).astype(np.float32)
+    ens = clf._ensemble()
+    fns = {"default": ens.device_forward(),
+           "forest.gather": ens.device_forward({"impl": "gather"}),
+           "forest.gemm": ens.device_forward({"impl": "gemm"})}
+    outs = {name: np.asarray(fn(X)) for name, fn in fns.items()}  # compile
+    forest_ms = {name: [] for name in fns}
+    for _ in range(reps):
+        for name, fn in fns.items():
+            t0 = time.perf_counter()
+            for _ in range(10):
+                np.asarray(fn(X))
+            forest_ms[name].append((time.perf_counter() - t0) / 10 * 1e3)
+    out["forest_variant"] = {
+        "ms_per_call": {name: round(sum(v) / len(v), 4)
+                        for name, v in forest_ms.items()},
+        "bitwise_equal": bool(
+            np.array_equal(outs["default"], outs["forest.gather"])
+            and np.array_equal(outs["default"], outs["forest.gemm"])),
+        "rounds": reps, "batch": int(X.shape[0])}
+
+    # -- hist chunk variants: trials -> cost model -> journaled apply ----
+    n_h, f_h, nb = 4096, 16, 64
+    hrng = np.random.default_rng(3)
+    bins = hrng.integers(0, nb, size=(f_h, n_h)).astype(np.int32)
+    grad = hrng.normal(size=n_h).astype(np.float32)
+    hess = hrng.uniform(0.1, 1.0, size=n_h).astype(np.float32)
+    mask = hrng.uniform(size=n_h) < 0.8
+    model = SegmentCostModel(min_obs=3)
+    seg = "gbdt_hist"
+    variants = {"default": None, "hist.c256": 256, "hist.c1024": 1024}
+
+    def hist_once(chunk):
+        t0 = time.perf_counter()
+        np.asarray(compute_histogram_mxu(bins, grad, hess, mask, nb,
+                                         interpret=True, chunk=chunk))
+        return time.perf_counter() - t0
+
+    for chunk in variants.values():
+        hist_once(chunk)  # compile outside the trials
+    trial_ms = {name: [] for name in variants}
+    for _ in range(4):
+        for name, chunk in variants.items():
+            dt = hist_once(chunk)
+            model.observe_variant(seg, n_h, name, dt)
+            trial_ms[name].append(dt * 1e3)
+    chosen = model.choose_variant(seg, n_h)
+    tuner = Tuner(fused=fused, model=model)
+    applied = False
+    if chosen is not None and chosen != "default":
+        tuner.apply(KnobSet(kernel_variants={seg: {str(n_h): chosen}},
+                            stitch=dict(stitch_on)))
+        applied = tuner.rollbacks == 0
+    out["hist_variant"] = {
+        "trial_ms": {name: round(sum(v) / len(v), 4)
+                     for name, v in trial_ms.items()},
+        "rows": n_h, "features": f_h, "num_bins": nb,
+        "trials_per_variant": 4, "min_obs": 3, "margin": 0.95,
+        "chosen": chosen, "tuner_applied": applied,
+        "variant_switches": tuner.variant_switches,
+        "journal_actions": [e["action"] for e in tuner.journal],
+        "declared_tolerance": 2e-3}
+
+    out["note"] = (
+        "paired interleaved rounds in one process (PR 7 obs_overhead "
+        "methodology) on a 1-core CPU container. stitch = the e2e number: "
+        "the split plan's readback + re-batch + H2D at the terminal GBDT "
+        "boundary is host work, so removing it shows up even on CPU, but "
+        "the ratio UNDERSTATES the device win (no PCIe transfer is "
+        "actually paid here and the f64 finalize math costs the same "
+        "either way); parity evidence (rawPrediction bitwise, probability "
+        "within the declared 1e-5 finalize tolerance) is the honest "
+        "headline. forest_variant timings compare jitted XLA lowerings on "
+        "CPU — gather vs gemm relative cost inverts on a real MXU, so "
+        "bitwise_equal is the claim, not the ms. hist_variant runs the "
+        "Pallas kernel in interpret mode (no TPU): trial timings drive "
+        "the observe->choose->journaled-apply flow end to end, and "
+        "'chosen' is whatever the cost model honestly picked on this "
+        "host, possibly null.")
+    return out
+
+
 def _hedging_section(n: int = 240, stall_s: float = 0.2,
                      stall_every: int = 20):
     """Hedged-request A/B under an injected straggler ("The Tail at Scale"):
@@ -1489,7 +1667,7 @@ def main():
     ap.add_argument("--only",
                     choices=["all", "load_async", "obs_overhead", "wire",
                              "autotune", "hedging", "ingest", "coldstart",
-                             "sharding", "canary"],
+                             "sharding", "canary", "compiler_search"],
                     default="all",
                     help="load_async: run just the overlapped-executor A/B "
                          "section; obs_overhead: just the observability "
@@ -1501,7 +1679,10 @@ def main():
                          "AOT-warmed start A/B; sharding: just the 1-shard "
                          "vs N-shard mesh A/B in a forced-4-device child; "
                          "canary: just the slow-candidate rollback + p99 "
-                         "recovery A/B (merge into an existing artifact)")
+                         "recovery A/B (merge into an existing artifact); "
+                         "compiler_search: just the stitch + kernel-variant "
+                         "A/B (split-vs-stitched GBDT chain, forest "
+                         "gather/gemm, hist chunk trials)")
     ap.add_argument("--coldstart-child", metavar="CACHE_DIR",
                     help=argparse.SUPPRESS)
     ap.add_argument("--sharding-child", action="store_true",
@@ -1537,6 +1718,12 @@ def main():
         print(json.dumps({
             "backend": platform,
             "autotune": _autotune_section()}))
+        return
+
+    if args.only == "compiler_search":
+        print(json.dumps({
+            "backend": platform,
+            "compiler_search": _compiler_search_section()}))
         return
 
     if args.only == "hedging":

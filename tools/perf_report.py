@@ -53,6 +53,7 @@ COLUMNS = (("segment", "segment"), ("batches", "n_batches"),
            ("bound ms", "bound_ms_per_batch"), ("roofline", "roofline_ratio"),
            ("bottleneck", "bottleneck"), ("disp%", "dispatch_share"),
            ("spec", "partition_spec"),
+           ("variant", "variant"), ("stitched", "stitched"),
            ("coll ms", "collective_ms_per_batch"),
            ("flops/batch", "flops_per_batch"),
            ("bytes/batch", "bytes_per_batch"), ("exemplars", "exemplars"))
@@ -96,12 +97,22 @@ def rows_from_fusion(fusion: Dict[str, Any],
     cost columns fall back to segment_costs when roofline lacks them)."""
     roofline = fusion.get("roofline") or {}
     costs = fusion.get("segment_costs") or {}
+    # compiler-search columns: the per-bucket kernel variants in force and
+    # the transpiled shims stitched through (both absent — rendered "-" —
+    # until the tuner moves those knobs)
+    variants = (fusion.get("tuning") or {}).get("kernel_variants") or {}
+    stitched = fusion.get("stitched") or {}
     ex_ids = sorted({v.get("trace_id") for v in (exemplars or {}).values()
                      if v.get("trace_id")})
     rows = []
-    for label in sorted(set(roofline) | set(costs)):
+    for label in sorted(set(roofline) | set(costs) | set(stitched)):
         rec = dict(roofline.get(label) or {})
         rec["segment"] = label
+        if variants.get(label):
+            rec["variant"] = ";".join(
+                f"{b}={v}" for b, v in sorted(variants[label].items()))
+        if stitched.get(label):
+            rec["stitched"] = ",".join(stitched[label])
         # the Python submit cost mega-dispatch amortizes, as its own column
         share = (rec.get("stage_share") or {}).get("dispatch")
         if share is not None:
@@ -141,7 +152,8 @@ def render_tuner(tuner: Dict[str, Any]) -> str:
                    {"buckets", "window_seed_ms", "inflight", "replicas"})
     cells = [["knob", "default", "chosen"]]
     for name in names:
-        if name == "fuse" and not knobs.get(name):
+        if name in ("fuse", "kernel_variants", "stitch") \
+                and not knobs.get(name):
             continue
         chosen = knobs.get(name)
         if name == "buckets":
@@ -149,6 +161,14 @@ def render_tuner(tuner: Dict[str, Any]) -> str:
                                sorted((chosen or {}).items())) or \
                 "(power-of-two)"
             dflt = "(power-of-two)"
+        elif name == "kernel_variants":
+            chosen = "; ".join(
+                f"{seg}:{b}={v}" for seg, kv in sorted(chosen.items())
+                for b, v in sorted(kv.items()))
+            dflt = "(built-in)"
+        elif name == "stitch":
+            chosen = "; ".join(sorted(k for k, v in chosen.items() if v))
+            dflt = "(split)"
         else:
             dflt = _fmt(default.get(name, "(static)")) \
                 if name in default else "(static)"
