@@ -224,18 +224,46 @@ LIFECYCLE_CHECKPOINT = "lifecycle.checkpoint"
 # change (tuner state updated, fused model not yet pushed): a raising plan
 # must leave the incumbent variant serving bitwise-identical replies
 TUNER_KERNEL_APPLY = "tuner.kernel_apply"
+# serving/fabric L1 front forwarding to an L2 cell (fires only when the
+# fabric is enabled, just before the forward): a raising plan is a cell
+# dying mid-request — InjectedFault reads as a connection-class "error"
+# (replay-safe), so the L1 re-hashes the tenant onto the survivor and the
+# reply must be bitwise-identical to a single-front retry
+FRONT_L2_CRASH = "front.l2_crash"
+# serving/fabric ring membership change, fired BEFORE the epoch mutates:
+# a raising plan is a crash mid-rebalance and must leave the journaled
+# previous epoch serving (membership, points and epoch all unchanged)
+RING_REBALANCE = "ring.rebalance"
+# serving/fleet object store put/get, fired before the backend I/O: a
+# raising put is a full/unreachable store (tier degrades to accounted
+# read-only, serving continues uncached); a raising get is a corrupted /
+# unavailable object (accounted recompile, exactly like PR 13)
+STORE_PUT = "store.put"
+STORE_GET = "store.get"
 
 ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
               JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE,
               WORKER_DISPATCH_HANG, WORKER_CRASH, FRONT_HEDGE,
               COMPILECACHE_LOAD, COMPILECACHE_STORE, MESH_CHIP_WEDGE,
-              LIFECYCLE_SWAP, LIFECYCLE_CHECKPOINT, TUNER_KERNEL_APPLY)
+              LIFECYCLE_SWAP, LIFECYCLE_CHECKPOINT, TUNER_KERNEL_APPLY,
+              FRONT_L2_CRASH, RING_REBALANCE, STORE_PUT, STORE_GET)
 
 
 class InjectedFault(OSError):
     """Raised by an armed injection point. Subclasses OSError so transport-
     level seams (worker forward, HTTP send) treat it as a connection-class
     failure and exercise their real retry/eviction paths."""
+
+
+class InjectedDiskFull(InjectedFault):
+    """Injected fault carrying ``errno.ENOSPC``: plan with ``exc=
+    InjectedDiskFull`` at a write seam (``store.put``, ``journal.write``)
+    to drive the disk-full degrade path — the consumer must flip to
+    accounted read-only mode, never crash the serving loop."""
+
+    def __init__(self, *args: Any):
+        super().__init__(*args)
+        self.errno = errno.ENOSPC
 
 
 @dataclasses.dataclass

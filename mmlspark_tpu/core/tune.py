@@ -490,6 +490,22 @@ class Tuner:
                 set_inflight(knobs.inflight)
         self._log(reason, knobs=knobs.to_dict())
 
+    def warm_start(self, knobs: Dict[str, Any]) -> bool:
+        """Apply a SHIPPED knob snapshot (fleet/objstore.py knob shipping)
+        at serve start: the fresh pod begins on the fleet's tuned
+        buckets/mega-K/sharding/variants with no relearning window.
+        Journaled as ``warm_start`` with one-step rollback to the defaults
+        this pod would otherwise have started on. False (and untouched
+        state) on an empty, default, or malformed snapshot."""
+        try:
+            ks = KnobSet.from_dict(dict(knobs or {}))
+        except Exception:  # noqa: BLE001 — a bad snapshot just relearns
+            return False
+        if ks.is_default():
+            return False
+        self.apply(ks, reason="warm_start")
+        return True
+
     def rollback(self, reason: str = "regression") -> bool:
         """Re-apply the PREVIOUS knob set (one step). Returns False when
         there is nothing to roll back to."""

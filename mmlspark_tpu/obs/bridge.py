@@ -221,6 +221,49 @@ def _fusion_families(stats: Dict[str, Any]) -> Iterable[MetricFamily]:
                 errs.add(f, {"tier": "persistent", "op": op})
         if errs.samples:
             yield errs
+        f = _num(tier.get("write_degrades"))
+        if f is not None and f > 0:
+            yield MetricFamily(
+                "mmlspark_compile_cache_write_degraded", "gauge",
+                "1 after the persistent tier dropped to read-only "
+                "(ENOSPC) — reads and recompiles continue").add(1.0)
+        store = tier.get("store")
+        if store:
+            # object-store backend (fleet/objstore.py) under the
+            # persistent tier; absent when the tier is local-disk only,
+            # so the storeless exposition stays byte-identical
+            backend = str(store.get("store", "objstore"))
+            ops = MetricFamily(
+                "mmlspark_store_ops_total", "counter",
+                "object-store operations by op (put / get)")
+            errf = MetricFamily(
+                "mmlspark_store_errors_total", "counter",
+                "failed object-store operations by op (the tier "
+                "degrades to recompile / read-only, never crashes)")
+            byt = MetricFamily(
+                "mmlspark_store_bytes_total", "counter",
+                "object-store payload bytes by direction (put / get)")
+            for op, okey, ekey, bkey in (
+                    ("put", "puts", "put_errors", "bytes_put"),
+                    ("get", "gets", "get_errors", "bytes_got")):
+                f = _num(store.get(okey))
+                if f is not None:
+                    ops.add(f, {"backend": backend, "op": op})
+                f = _num(store.get(ekey))
+                if f is not None:
+                    errf.add(f, {"backend": backend, "op": op})
+                f = _num(store.get(bkey))
+                if f is not None:
+                    byt.add(f, {"backend": backend, "direction": op})
+            for fam in (ops, errf, byt):
+                if fam.samples:
+                    yield fam
+        f = _num(tier.get("snapshots"))
+        if f is not None and f > 0:
+            yield MetricFamily(
+                "mmlspark_store_snapshots_total", "counter",
+                "knob-shipping snapshots published (KnobSet + capacity "
+                "plan, deduplicated byte-identically)").add(f)
     nseg = _num(stats.get("n_fused_segments"))
     if nseg is not None:
         yield MetricFamily("mmlspark_fused_segments", "gauge",
@@ -727,6 +770,72 @@ def fold_server(registry: MetricsRegistry, server: Any) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _fabric_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
+    """Front-fabric state (serving/fabric): the consistent-hash ring's
+    epoch and membership, per-cell affinity accounting, and the drain /
+    re-hash counters — mmlspark_ring_* / mmlspark_cell_* per
+    docs/observability.md. Absent entirely when the fabric is off, so
+    the single-front exposition stays byte-identical."""
+    ring = summary.get("ring") or {}
+    f = _num(ring.get("epoch"))
+    if f is not None:
+        yield MetricFamily(
+            "mmlspark_ring_epoch", "gauge",
+            "consistent-hash ring epoch (bumps once per journaled "
+            "membership transition)").add(f)
+    cells = ring.get("cells") or {}
+    byst = MetricFamily(
+        "mmlspark_ring_cells", "gauge",
+        "ring members by state (up / draining)")
+    for state in ("up", "draining"):
+        byst.add(float(sum(1 for s in cells.values() if s == state)),
+                 {"state": state})
+    yield byst
+    trans = MetricFamily(
+        "mmlspark_ring_transitions_total", "counter",
+        "ring membership transitions by kind (rebalance / rollback / "
+        "failed / journal_error)")
+    for kind, key in (("rebalance", "rebalances"), ("rollback", "rollbacks"),
+                      ("failed", "rebalance_failures"),
+                      ("journal_error", "journal_errors")):
+        f = _num(ring.get(key))
+        if f is not None:
+            trans.add(f, {"kind": kind})
+    yield trans
+    st = MetricFamily(
+        "mmlspark_cell_state", "gauge",
+        "one-hot ring state per L2 cell (up / draining)")
+    for cell, s in cells.items():
+        for name in ("up", "draining"):
+            st.add(1.0 if s == name else 0.0,
+                   {"cell": str(cell), "state": name})
+    yield st
+    infl = MetricFamily(
+        "mmlspark_cell_inflight", "gauge",
+        "requests in flight to each L2 cell (the drain flush gate)")
+    for cell, n in (summary.get("inflight") or {}).items():
+        f = _num(n)
+        if f is not None:
+            infl.add(f, {"cell": str(cell)})
+    yield infl
+    f = _num(summary.get("assignments"))
+    if f is not None:
+        yield MetricFamily(
+            "mmlspark_cell_assignments_total", "counter",
+            "affinity-key routing decisions made by the ring").add(f)
+    f = _num(summary.get("rehashes"))
+    if f is not None:
+        yield MetricFamily(
+            "mmlspark_cell_rehashes_total", "counter",
+            "assignments whose ring-preferred cell was unroutable and "
+            "re-hashed to a survivor").add(f)
+    f = _num(summary.get("drains"))
+    if f is not None:
+        yield MetricFamily(
+            "mmlspark_cell_drains_total", "counter",
+            "planned drain-and-shift cycles completed").add(f)
+
+
 def fold_front(registry: MetricsRegistry, front: Any) -> None:
     """Register collectors for a RoutingFront: registered-worker count,
     one-hot circuit-breaker states, and capacity weights."""
@@ -759,6 +868,11 @@ def fold_front(registry: MetricsRegistry, front: Any) -> None:
             try:
                 fams.extend(_hedge_families(front._hedge.summary()))
             except Exception:  # noqa: BLE001 — tracker mid-update
+                pass
+        if getattr(front, "_fabric", None) is not None:
+            try:
+                fams.extend(_fabric_families(front._fabric.summary()))
+            except Exception:  # noqa: BLE001 — ring mid-transition
                 pass
         return fams
 

@@ -1,0 +1,22 @@
+"""Federated front fabric: two-level fronts with consistent-hash affinity.
+
+The reference exposes one Spark Serving front per streaming query — a
+single point of failure that also owns all per-tenant admission, hedge
+and SLO-burn state. The fabric splits that into an L1 front that hashes
+``X-MMLSpark-Tenant`` onto L2 cells (ordinary RoutingFronts) over a
+journaled consistent-hash ring, so per-tenant state stays pinned to one
+cell across resizes and a cell death is a bounded re-hash, not a reset.
+
+  - ``ring.HashRing``    — journaled consistent-hash ring (virtual nodes,
+    bounded-movement rebalance, epochs with one-step rollback).
+  - ``front.FrontFabric`` — the L1 routing policy plugged into
+    RoutingFront via its ``fabric=`` knob (default off: the single-front
+    path is byte-identical).
+
+See docs/front_fabric.md for the fabric contract.
+"""
+
+from .ring import HashRing, RingEpochError
+from .front import FrontFabric, make_fabric
+
+__all__ = ["HashRing", "RingEpochError", "FrontFabric", "make_fabric"]

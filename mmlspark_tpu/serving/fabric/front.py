@@ -1,0 +1,188 @@
+"""L1 routing policy: consistent-hash tenant affinity onto L2 cells.
+
+``FrontFabric`` is what a RoutingFront consults instead of its
+capacity-weighted round-robin when constructed with ``fabric=``. Each
+registered "worker" of an L1 front is an L2 front (a cell); the fabric
+maps every request's affinity key (``X-MMLSpark-Tenant``, falling back
+to the session/trace id) onto the ring and returns the cells in ring-walk
+order — affinity cell first, then the survivors its arc would re-hash
+onto. Everything else (circuit breakers, health probes, opaque body
+forwarding with deadline/trace headers, hedging, the retry walk) is the
+front's existing machinery, unchanged.
+
+Planned maintenance uses :meth:`drain_cell`: the cell stops receiving new
+assignments (a journaled ring epoch), in-flight forwards flush, and the
+handoff is journaled. A crash of the ``ring.rebalance`` seam during any
+membership change is absorbed and accounted — the previous epoch serves.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Mapping, Optional
+
+from ...core import faults
+from ..tenants import TenantAdmission, DEFAULT_TENANT
+from .ring import HashRing, RingEpochError
+
+#: fallback affinity headers when no tenant header is present (session or
+#: trace id — keeps an anonymous session pinned to one cell)
+SESSION_HEADERS = ("x-mmlspark-session", "x-mmlspark-trace")
+
+
+def affinity_key_of(headers: Optional[Mapping[str, str]]) -> str:
+    """The ring key for a request: tenant header first, then session/trace
+    id, then the default tenant (all anonymous traffic shares one cell)."""
+    tenant = TenantAdmission.tenant_of(headers)
+    if tenant != DEFAULT_TENANT:
+        return tenant
+    if headers:
+        lowered = {str(k).lower(): v for k, v in headers.items()}
+        for h in SESSION_HEADERS:
+            v = lowered.get(h)
+            if v:
+                return str(v)
+    return DEFAULT_TENANT
+
+
+class FrontFabric:
+    """The L1 side of the fabric: a journaled ring plus per-cell in-flight
+    accounting (what :meth:`drain_cell` waits on) and re-hash counters."""
+
+    def __init__(self, vnodes: int = 64,
+                 journal_path: Optional[str] = None,
+                 drain_timeout_s: float = 30.0):
+        self.ring = HashRing(vnodes=vnodes, journal_path=journal_path)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.assignments = 0   # requests routed with an affinity cell
+        self.rehashes = 0      # requests that landed off their affinity cell
+        self.drains = 0        # completed drain handoffs
+        self._inflight: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- membership (driven by the front's register/deregister) -----------
+
+    def note_register(self, cell: str) -> bool:
+        """Add a cell on registration. A ``ring.rebalance`` crash is
+        absorbed: the previous epoch keeps serving, accounted."""
+        try:
+            self.ring.add_cell(cell)
+            return True
+        except RingEpochError:
+            return False  # duplicate registration refresh — not an epoch
+        except Exception:
+            self.ring.rebalance_failures += 1
+            return False
+
+    def note_deregister(self, cell: str) -> bool:
+        try:
+            self.ring.remove_cell(cell)
+            return True
+        except RingEpochError:
+            return False
+        except Exception:
+            self.ring.rebalance_failures += 1
+            return False
+
+    # -- routing -----------------------------------------------------------
+
+    def order_for(self, headers: Optional[Mapping[str, str]],
+                  routable: List[str]) -> List[str]:
+        """Cells to try, in order: the affinity cell first, then the ring-walk
+        survivors — filtered to ``routable`` (circuit-breaker OPEN cells are
+        the front's concern and arrive already excluded)."""
+        key = affinity_key_of(headers)
+        walk = self.ring.order_for(key)
+        allowed = set(routable)
+        order = [c for c in walk if c in allowed]
+        with self._lock:
+            if order:
+                self.assignments += 1
+                if walk and order[0] != walk[0]:
+                    self.rehashes += 1  # affinity cell dead/drained/open
+        return order
+
+    # -- in-flight accounting (the drain barrier) --------------------------
+
+    def begin(self, cell: str) -> None:
+        with self._lock:
+            self._inflight[cell] = self._inflight.get(cell, 0) + 1
+
+    def end(self, cell: str) -> None:
+        with self._lock:
+            n = self._inflight.get(cell, 0) - 1
+            if n <= 0:
+                self._inflight.pop(cell, None)
+            else:
+                self._inflight[cell] = n
+
+    def inflight(self, cell: str) -> int:
+        with self._lock:
+            return self._inflight.get(cell, 0)
+
+    # -- planned maintenance ------------------------------------------------
+
+    def drain_cell(self, cell: str,
+                   timeout_s: Optional[float] = None) -> Dict[str, object]:
+        """Drain-and-shift: journal a ``drain`` epoch (new assignments stop,
+        the cell's arc re-hashes onto survivors), wait for the L1's in-flight
+        forwards to that cell to flush, then journal the handoff."""
+        timeout = self.drain_timeout_s if timeout_s is None else float(timeout_s)
+        try:
+            self.ring.drain_cell(cell)
+        except RingEpochError as e:
+            return {"cell": cell, "ok": False, "error": str(e)}
+        except Exception:
+            self.ring.rebalance_failures += 1
+            return {"cell": cell, "ok": False, "error": "rebalance_crash"}
+        deadline = time.monotonic() + timeout
+        flushed = True
+        while self.inflight(cell) > 0:
+            if time.monotonic() >= deadline:
+                flushed = False
+                break
+            time.sleep(0.01)
+        # the handoff epoch: the drained cell leaves the ring entirely —
+        # journaled, so the shift survives an L1 restart
+        try:
+            self.ring.remove_cell(cell)
+        except Exception:
+            self.ring.rebalance_failures += 1
+        with self._lock:
+            self.drains += 1
+            residual = self._inflight.get(cell, 0)
+        return {"cell": cell, "ok": True, "flushed": flushed,
+                "residual_inflight": residual, "epoch": self.ring.epoch}
+
+    # -- introspection ------------------------------------------------------
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            inflight = dict(self._inflight)
+            out = {
+                "assignments": self.assignments,
+                "rehashes": self.rehashes,
+                "drains": self.drains,
+                "inflight": inflight,
+            }
+        out["ring"] = self.ring.summary()
+        return out
+
+    def close(self) -> None:
+        self.ring.close()
+
+
+def make_fabric(fabric) -> Optional[FrontFabric]:
+    """Coerce a RoutingFront's ``fabric=`` argument: ``None``/``False`` off,
+    ``True`` defaults, a dict as kwargs, or a ready ``FrontFabric``."""
+    if fabric is None or fabric is False:
+        return None
+    if fabric is True:
+        return FrontFabric()
+    if isinstance(fabric, FrontFabric):
+        return fabric
+    if isinstance(fabric, Mapping):
+        return FrontFabric(**dict(fabric))
+    raise TypeError("fabric must be None/bool/dict/FrontFabric, got %r"
+                    % (fabric,))

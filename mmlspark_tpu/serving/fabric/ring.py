@@ -1,0 +1,274 @@
+"""Journaled consistent-hash ring with virtual nodes and bounded movement.
+
+The L1 front assigns tenants to L2 cells by hashing the tenant key onto
+this ring. Consistent hashing gives the bounded-movement rebalance the
+fabric contract requires: adding or removing a cell re-assigns only the
+keys inside that cell's own hash share — every other tenant stays pinned
+to its incumbent cell, so per-tenant admission quotas, hedge reservoirs
+and SLO-burn buckets survive a resize untouched.
+
+Ring membership is a knob like any other in this codebase: every epoch
+transition (add / remove / drain / restore) is journaled with the full
+post-state and supports one-step ``rollback()``. The ``ring.rebalance``
+fault point fires BEFORE anything mutates, so an injected crash leaves
+the journaled previous epoch serving. An optional durable journal file
+(JSONL, fsynced per entry, torn-tail tolerant on replay) lets a restarted
+L1 come back on the epoch it last served.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import logging
+import os
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ...core import faults
+
+logger = logging.getLogger(__name__)
+
+#: cell states: ``up`` takes new assignments; ``draining`` serves what it
+#: has but is skipped by ``cell_for`` / ``order_for`` (maintenance handoff)
+UP = "up"
+DRAINING = "draining"
+
+_JOURNAL_CAP = 256
+
+
+class RingEpochError(RuntimeError):
+    """An invalid epoch transition (unknown cell, duplicate add, ...)."""
+
+
+def _hash64(s: str) -> int:
+    """Stable 64-bit ring position (sha256 prefix — no PYTHONHASHSEED)."""
+    return int.from_bytes(hashlib.sha256(s.encode("utf-8")).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring over named cells with ``vnodes`` virtual nodes
+    per cell. Thread-safe; all epoch transitions are journaled (in-memory
+    ring buffer plus the optional durable ``journal_path``) and one-step
+    reversible via :meth:`rollback`."""
+
+    def __init__(self, vnodes: int = 64,
+                 journal_path: Optional[str] = None,
+                 journal_cap: int = _JOURNAL_CAP):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self.vnodes = int(vnodes)
+        self.epoch = 0
+        self.rebalances = 0
+        self.rollbacks = 0
+        self.rebalance_failures = 0   # ring.rebalance crashes absorbed
+        self.journal_errors = 0       # durable-append failures (accounted)
+        self._cells: Dict[str, str] = {}          # name -> UP | DRAINING
+        self._points: List[Tuple[int, str]] = []  # sorted (hash, cell)
+        self._keys: List[int] = []                # hash column for bisect
+        self._prev: Optional[Tuple[int, Dict[str, str]]] = None
+        self._journal: List[Dict[str, object]] = []
+        self._journal_cap = int(journal_cap)
+        self._journal_degraded = False
+        self._lock = threading.Lock()
+        self._fh = None
+        self._path = journal_path
+        if journal_path:
+            self._replay(journal_path)
+            self._fh = open(journal_path, "a", encoding="utf-8")
+
+    # -- hashing ----------------------------------------------------------
+
+    def _rebuild(self) -> None:
+        pts = []
+        for cell in self._cells:
+            for i in range(self.vnodes):
+                pts.append((_hash64("%s#%d" % (cell, i)), cell))
+        pts.sort()
+        self._points = pts
+        self._keys = [h for h, _ in pts]
+
+    def cell_for(self, key: str,
+                 exclude: Iterable[str] = ()) -> Optional[str]:
+        """The cell owning ``key``: first assignable cell clockwise from
+        the key's ring position (draining and ``exclude``-ed cells are
+        skipped — their arcs re-hash onto the survivors)."""
+        order = self.order_for(key, exclude=exclude)
+        return order[0] if order else None
+
+    def order_for(self, key: str,
+                  exclude: Iterable[str] = ()) -> List[str]:
+        """All assignable cells in ring-walk order from ``key``'s position:
+        the affinity cell first, then the survivors a dead affinity cell's
+        arc would re-hash onto, in order."""
+        skip = set(exclude)
+        with self._lock:
+            if not self._points:
+                return []
+            live = {c for c, st in self._cells.items()
+                    if st == UP and c not in skip}
+            if not live:
+                return []
+            i = bisect.bisect_right(self._keys, _hash64(key))
+            n = len(self._points)
+            order: List[str] = []
+            for step in range(n):
+                cell = self._points[(i + step) % n][1]
+                if cell in live and cell not in order:
+                    order.append(cell)
+                    if len(order) == len(live):
+                        break
+            return order
+
+    def share(self, cell: str) -> float:
+        """``cell``'s fraction of the hash space (its rebalance bound)."""
+        with self._lock:
+            if not self._points or cell not in self._cells:
+                return 0.0
+            span = 0
+            full = 1 << 64
+            for j, (h, c) in enumerate(self._points):
+                if c != cell:
+                    continue
+                prev = self._points[j - 1][0] if j else self._points[-1][0] - full
+                span += h - prev
+            return span / full
+
+    # -- epoch transitions ------------------------------------------------
+
+    def _transition(self, action: str, cell: str, new_state: Optional[str],
+                    *, expect: Optional[Tuple[str, ...]] = None) -> None:
+        with self._lock:
+            have = self._cells.get(cell)
+            if expect is not None and have not in expect:
+                raise RingEpochError(
+                    "%s %r: state is %r" % (action, cell, have))
+            # the crash seam: an armed plan raising here must leave the
+            # journaled previous epoch serving — nothing has mutated yet
+            faults.fire(faults.RING_REBALANCE, action=action, cell=cell,
+                        epoch=self.epoch)
+            self._prev = (self.epoch, dict(self._cells))
+            if new_state is None:
+                self._cells.pop(cell, None)
+            else:
+                self._cells[cell] = new_state
+            self.epoch += 1
+            self.rebalances += 1
+            self._rebuild()
+            self._log(action, cell)
+
+    def add_cell(self, cell: str) -> None:
+        self._transition("add", cell, UP, expect=(None,))
+
+    def remove_cell(self, cell: str) -> None:
+        self._transition("remove", cell, None, expect=(UP, DRAINING))
+
+    def drain_cell(self, cell: str) -> None:
+        """Stop new assignments to ``cell`` (its arc re-hashes onto the
+        survivors); the cell itself keeps serving what is in flight."""
+        self._transition("drain", cell, DRAINING, expect=(UP,))
+
+    def restore_cell(self, cell: str) -> None:
+        self._transition("restore", cell, UP, expect=(DRAINING,))
+
+    def rollback(self, reason: str = "rollback") -> bool:
+        """One-step rollback to the previous journaled epoch (same contract
+        as every other knob: a rollback is itself a journaled epoch)."""
+        with self._lock:
+            if self._prev is None:
+                return False
+            _, members = self._prev
+            faults.fire(faults.RING_REBALANCE, action="rollback", cell=None,
+                        epoch=self.epoch)
+            self._prev = None
+            self._cells = dict(members)
+            self.epoch += 1
+            self.rollbacks += 1
+            self._rebuild()
+            self._log(reason, None)
+            return True
+
+    # -- journal ----------------------------------------------------------
+
+    def _log(self, action: str, cell: Optional[str]) -> None:
+        entry = {"epoch": self.epoch, "action": action, "cell": cell,
+                 "members": dict(self._cells)}
+        self._journal.append(entry)
+        if len(self._journal) > self._journal_cap:
+            # analysis: allow C001 -- _log's callers (_transition/rollback) hold self._lock
+            self._journal = self._journal[-self._journal_cap:]
+        if self._fh is None or self._journal_degraded:
+            return
+        try:
+            self._fh.write(json.dumps(entry, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except OSError as e:
+            # a full/unwritable journal volume must not take the ring down:
+            # accounted degrade, in-memory journal keeps the epoch history
+            self.journal_errors += 1
+            self._journal_degraded = True
+            logger.warning("ring journal degraded (%s); epochs stay "
+                           "in-memory only", e)
+
+    def _replay(self, path: str) -> None:
+        """Adopt the last intact journaled epoch (torn tails skipped)."""
+        if not os.path.exists(path):
+            return
+        last = None
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail from a crashed writer
+                    if isinstance(rec, dict) and "members" in rec:
+                        last = rec
+                        self._journal.append(rec)
+        except OSError:
+            return
+        # pre-publication (__init__-only), but locked anyway: the C001
+        # lock contract is per-field, not per-phase
+        with self._lock:
+            if last is not None:
+                self._cells = {str(k): str(v)
+                               for k, v in dict(last["members"]).items()}
+                self.epoch = int(last.get("epoch", 0))
+                self._rebuild()
+            if len(self._journal) > self._journal_cap:
+                self._journal = self._journal[-self._journal_cap:]
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            finally:
+                self._fh = None
+
+    # -- introspection ----------------------------------------------------
+
+    def members(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._cells)
+
+    def journal(self) -> List[Dict[str, object]]:
+        with self._lock:
+            return list(self._journal)
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "epoch": self.epoch,
+                "vnodes": self.vnodes,
+                "cells": dict(self._cells),
+                "rebalances": self.rebalances,
+                "rollbacks": self.rollbacks,
+                "rebalance_failures": self.rebalance_failures,
+                "journal_errors": self.journal_errors,
+                "journal": list(self._journal[-16:]),
+            }

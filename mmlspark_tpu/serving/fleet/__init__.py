@@ -21,13 +21,22 @@ Three cooperating parts, each usable alone:
                    journaled, one-step rollback like the Tuner). Publishes
                    the cross-pod recommendation at ``/_mmlspark/capacity``
                    for helm HPA / an external scaler.
+  - ``objstore``   object-store artifact tier under the persistent cache:
+                   put/get backends (local-dir reference impl + injectable
+                   remote stub) that detach executable survival from the
+                   pod-local disk, and the knob-shipping snapshot format
+                   (KnobSet + capacity plan) that lets a fresh pod start
+                   tuned with zero relearning.
 
 See docs/fleet.md for the cache key contract, the planner math, and the
-controller state machine.
+controller state machine; docs/front_fabric.md for the object-store
+interface and the knob-shipping format.
 """
 
 from .cache import PersistentCompileCache, content_key
 from .controller import FleetController, FleetSpec, make_fleet
+from .objstore import (CallbackStore, LocalDirStore, ObjectStore,
+                       make_store)
 from .planner import (CapacityPlan, CapacityPlanner, PlannerConfig,
                       forecast_rps, plan_capacity)
 
@@ -36,4 +45,5 @@ __all__ = [
     "CapacityPlan", "CapacityPlanner", "PlannerConfig",
     "forecast_rps", "plan_capacity",
     "FleetController", "FleetSpec", "make_fleet",
+    "ObjectStore", "LocalDirStore", "CallbackStore", "make_store",
 ]

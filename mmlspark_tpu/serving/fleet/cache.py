@@ -41,6 +41,7 @@ Fault points: ``compilecache.load`` / ``compilecache.store``
 from __future__ import annotations
 
 import ast
+import errno
 import hashlib
 import io
 import json
@@ -49,9 +50,10 @@ import os
 import pickle
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ...core import faults
+from . import objstore as _objstore
 
 _LOG = logging.getLogger(__name__)
 
@@ -159,10 +161,14 @@ class PersistentCompileCache:
 
     def __init__(self, path: str, write: bool = True,
                  knobs_provider: Optional[Callable[[], dict]] = None,
-                 mesh: Any = None):
+                 mesh: Any = None, store: Any = None):
         self.path = str(path)
         self.write = bool(write)
         self.knobs_provider = knobs_provider
+        #: optional object-store backend (fleet/objstore.py): entry and
+        #: snapshot I/O route through ``store.put``/``store.get`` instead
+        #: of the local directory — same format, same degrade contract
+        self._store = _objstore.make_store(store)
         # ``mesh`` pins the topology the fingerprint carries (the owning
         # model's shard mesh); default resolves the ambient MeshContext
         self._fp = env_fingerprint(mesh=mesh)
@@ -174,6 +180,10 @@ class PersistentCompileCache:
         self.costs_only = 0       # entries persisted/loaded without payload
         self.load_errors = 0
         self.store_errors = 0
+        self.write_degrades = 0   # ENOSPC flips to accounted read-only
+        self.snapshots = 0        # knob-shipping snapshots written
+        self._enospc_logged = False
+        self._last_snapshot_blob: Optional[bytes] = None
         self.load_s = 0.0
         self.store_s = 0.0
         #: cost records recovered from cost-only entries at warm time:
@@ -181,7 +191,7 @@ class PersistentCompileCache:
         self._cost_records: Dict[str, Dict[str, Dict[str, Any]]] = {}
         #: last knobs dict seen in a warmed entry (newest mtime wins)
         self.loaded_knobs: Optional[Dict[str, Any]] = None
-        if self.write:
+        if self.write and self._store is None:
             try:
                 os.makedirs(self.path, exist_ok=True)
             except OSError:
@@ -194,12 +204,53 @@ class PersistentCompileCache:
     def _file_for(self, digest: str) -> str:
         return os.path.join(self.path, digest + SUFFIX)
 
+    def _load_blob(self, name: str) -> Optional[bytes]:
+        """One object's raw bytes by flat name (``<digest>.mmlc`` or the
+        snapshot key) — via the object store when attached, else the local
+        directory. ``None`` when absent; backend errors raise (accounted
+        by the caller, degrading to recompile)."""
+        if self._store is not None:
+            return self._store.get(name)
+        try:
+            with open(os.path.join(self.path, name), "rb") as fh:
+                return fh.read()
+        except FileNotFoundError:
+            return None
+
+    def _write_blob(self, name: str, blob: bytes) -> None:
+        if self._store is not None:
+            self._store.put(name, blob)
+        else:
+            faults.atomic_write_bytes(os.path.join(self.path, name), blob)
+
+    def _has_entry(self, name: str) -> bool:
+        if self._store is not None:
+            return self._store.has(name)
+        return os.path.exists(os.path.join(self.path, name))
+
+    def _entry_names(self) -> List[str]:
+        if self._store is not None:
+            try:
+                return sorted(self._store.list(SUFFIX))
+            except Exception:  # noqa: BLE001 — unlistable remote tier
+                return []
+        try:
+            return sorted(n for n in os.listdir(self.path)
+                          if n.endswith(SUFFIX))
+        except OSError:
+            return []
+
     def _read_entry(self, path: str
                     ) -> Tuple[Dict[str, Any], Optional[bytes]]:
-        """Parse one entry file -> (header, payload or None). Raises on any
-        corruption; callers account and degrade."""
-        with open(path, "rb") as fh:
-            blob = fh.read()
+        """Parse one entry by path -> (header, payload or None). Raises on
+        any corruption; callers account and degrade."""
+        blob = self._load_blob(os.path.basename(path))
+        if blob is None:
+            raise FileNotFoundError(path)
+        return self._parse_entry(blob)
+
+    def _parse_entry(self, blob: bytes
+                     ) -> Tuple[Dict[str, Any], Optional[bytes]]:
         buf = io.BytesIO(blob)
         if buf.read(len(MAGIC)) != MAGIC:
             raise ValueError("bad magic")
@@ -226,7 +277,23 @@ class PersistentCompileCache:
         hjson = json.dumps(header, sort_keys=True).encode("utf-8")
         blob = MAGIC + len(hjson).to_bytes(_HEADER_LEN_BYTES, "big") \
             + hjson + payload
-        faults.atomic_write_bytes(path, blob)
+        self._write_blob(os.path.basename(path), blob)
+
+    def _note_write_failure(self, e: BaseException) -> None:
+        """Account one failed write; ENOSPC additionally flips the tier to
+        read-only (logged once) — a full cache volume must never crash or
+        spam the serving loop (docs/faults.md disk-full contract)."""
+        with self._lock:
+            self.store_errors += 1
+            if getattr(e, "errno", None) != errno.ENOSPC:
+                return
+            self.write = False
+            self.write_degrades += 1
+            logged = self._enospc_logged
+            self._enospc_logged = True
+        if not logged:
+            _LOG.warning("persistent compile-cache volume full (ENOSPC): "
+                         "degrading to read-only mode")
 
     # -- the CompileCache tier protocol ------------------------------------
 
@@ -238,15 +305,16 @@ class PersistentCompileCache:
         (corruption, version skew, injected fault) — the caller recompiles
         and the failure is an accounted counter, never an exception."""
         digest = content_key(key, self._fp)
-        path = self._file_for(digest)
+        name = digest + SUFFIX
         t0 = time.perf_counter()
         try:
             faults.fire(faults.COMPILECACHE_LOAD, key=digest, label=label)
-            if not os.path.exists(path):
+            blob = self._load_blob(name)
+            if blob is None:
                 with self._lock:
                     self.misses += 1
                 return None
-            header, payload = self._read_entry(path)
+            header, payload = self._parse_entry(blob)
             if header.get("kind") != "exec" or payload is None:
                 # cost-only entry: nothing to execute, but the harvested
                 # cost still warms the model
@@ -279,11 +347,11 @@ class PersistentCompileCache:
         if not self.write:
             return False
         digest = content_key(key, self._fp)
-        path = self._file_for(digest)
+        name = digest + SUFFIX
         t0 = time.perf_counter()
         try:
             faults.fire(faults.COMPILECACHE_STORE, key=digest, label=label)
-            if os.path.exists(path):
+            if self._has_entry(name):
                 with self._lock:
                     self.store_skips += 1
                 return False
@@ -306,12 +374,11 @@ class PersistentCompileCache:
                 "payload_sha256": hashlib.sha256(
                     payload).hexdigest() if payload is not None else None,
             }
-            self._write_entry(path, header, payload or b"")
+            self._write_entry(self._file_for(digest), header, payload or b"")
         except Exception as e:  # noqa: BLE001 — never block serving
             _LOG.warning("persistent compile-cache store failed for %s: %s",
                          digest[:12], e)
-            with self._lock:
-                self.store_errors += 1
+            self._note_write_failure(e)
             return False
         dt = time.perf_counter() - t0
         with self._lock:
@@ -334,18 +401,17 @@ class PersistentCompileCache:
         skipped — a corrupted fleet cache can only make warm-up smaller,
         never fail pod start."""
         out = {"warmed": 0, "costs_only": 0, "skipped": 0, "errors": 0}
-        try:
-            names = sorted(n for n in os.listdir(self.path)
-                           if n.endswith(SUFFIX))
-        except OSError:
-            return out
+        names = self._entry_names()
         for name in names:
             if limit is not None and out["warmed"] >= limit:
                 break
-            path = os.path.join(self.path, name)
             try:
                 faults.fire(faults.COMPILECACHE_LOAD, key=name)
-                header, payload = self._read_entry(path)
+                blob = self._load_blob(name)
+                if blob is None:
+                    out["skipped"] += 1
+                    continue
+                header, payload = self._parse_entry(blob)
                 if header.get("kind") != "exec" or payload is None:
                     self._absorb_costs(header)
                     out["costs_only"] += 1
@@ -403,14 +469,55 @@ class PersistentCompileCache:
             return {lab: {shp: dict(rec) for shp, rec in by.items()}
                     for lab, by in self._cost_records.items()}
 
+    # -- knob shipping (fleet/objstore.py snapshot format) ------------------
+
+    def put_snapshot(self, knobs: Optional[Dict[str, Any]] = None,
+                     capacity_plan: Optional[Dict[str, Any]] = None) -> bool:
+        """Ship the live tuning state: one canonical-JSON snapshot of the
+        tuner's ``KnobSet`` and the controller's capacity plan, stored
+        alongside the executables. Byte-identical snapshots are skipped
+        (safe to call on every plan tick); failures degrade exactly like
+        entry stores — accounted, ENOSPC flips read-only, never a raise."""
+        if not self.write:
+            return False
+        blob = _objstore.snapshot_blob(knobs=knobs,
+                                       capacity_plan=capacity_plan,
+                                       env=dict(self._fp))
+        with self._lock:
+            if blob == self._last_snapshot_blob:
+                return False
+        try:
+            self._write_blob(_objstore.SNAPSHOT_KEY, blob)
+        except Exception as e:  # noqa: BLE001 — never block serving
+            _LOG.warning("knob-snapshot store failed: %s", e)
+            self._note_write_failure(e)
+            return False
+        with self._lock:
+            self._last_snapshot_blob = blob
+            self.snapshots += 1
+        return True
+
+    def load_snapshot(self) -> Optional[Dict[str, Any]]:
+        """The shipped tuning snapshot (``{"knobs": ..., "capacity_plan":
+        ..., "env": ...}``), or None when absent/corrupt/foreign-format —
+        the pod then simply relearns, the PR 13 degrade contract."""
+        try:
+            blob = self._load_blob(_objstore.SNAPSHOT_KEY)
+        except Exception as e:  # noqa: BLE001 — degrade to relearning
+            _LOG.warning("knob-snapshot load failed: %s", e)
+            with self._lock:
+                self.load_errors += 1
+            return None
+        snap = _objstore.parse_snapshot(blob)
+        if blob is not None and snap is None:
+            with self._lock:
+                self.load_errors += 1
+        return snap
+
     # -- introspection ------------------------------------------------------
 
     def entry_count(self) -> int:
-        try:
-            return sum(1 for n in os.listdir(self.path)
-                       if n.endswith(SUFFIX))
-        except OSError:
-            return 0
+        return len(self._entry_names())
 
     def stats(self) -> Dict[str, Any]:
         entries = self.entry_count()  # listdir outside the counter lock
@@ -428,7 +535,11 @@ class PersistentCompileCache:
                 "costs_only": self.costs_only,
                 "load_errors": self.load_errors,
                 "store_errors": self.store_errors,
+                "write_degrades": self.write_degrades,
+                "snapshots": self.snapshots,
                 "load_s": round(self.load_s, 6),
                 "store_s": round(self.store_s, 6),
                 "env": dict(self._fp),
+                "store": (self._store.stats()
+                          if self._store is not None else None),
             }

@@ -112,8 +112,9 @@ class FleetController:
             "level_rps": 0.0, "trend_rps_s": 0.0, "forecast_rps": 0.0,
             "seconds": 0}
         self._recommended: Optional[Dict[str, Any]] = None
+        self._recommended_t: Optional[float] = None
         self.decisions = {"scale_out": 0, "scale_in": 0, "rollback": 0,
-                          "held_degraded": 0}
+                          "held_degraded": 0, "warm_start": 0}
         self.journal: List[Dict[str, Any]] = []
 
     # -- journal ------------------------------------------------------------
@@ -258,6 +259,16 @@ class FleetController:
         with self._lock:
             self._last_forecast = forecast
             self._recommended = rec
+            self._recommended_t = now
+        # knob shipping (fleet/objstore.py): refresh the shipped snapshot
+        # on every plan — the hook reads the live tuner knobs at call time
+        # and the tier dedups byte-identical snapshots, so this is cheap
+        snap = self.hooks.get("snapshot")
+        if snap is not None:
+            try:
+                snap(rec)
+            except Exception:  # noqa: BLE001 — shipping is best-effort
+                pass
         if plan.meets_slo is None:
             # uncalibrated: recommendation published, nothing applied
             with self._lock:
@@ -331,6 +342,24 @@ class FleetController:
             self._apply_knobs(live.get("inflight"), live.get("mega_k"))
         return "rollback"
 
+    def warm_start(self, plan: Dict[str, Any]) -> bool:
+        """Adopt a shipped capacity plan (fleet/objstore.py knob shipping)
+        as the published recommendation before the first local plan runs:
+        a fresh pod's ``/_mmlspark/capacity`` answers calibrated from tick
+        zero instead of opening a relearning window. Journaled; the first
+        LOCAL plan replaces it (nothing is applied to live knobs here —
+        the tuner's own warm start owns that)."""
+        if not isinstance(plan, dict) or not plan:
+            return False
+        with self._lock:
+            if self._recommended is not None:
+                return False  # a live plan always outranks a shipped one
+            self._recommended = dict(plan)
+            self._recommended_t = self._clock()
+            self.decisions["warm_start"] += 1
+            self._log_locked("warm_start", plan=dict(plan))
+        return True
+
     def rollback(self) -> bool:
         """Manual one-step rollback (ops hatch, Tuner parity). False when
         there is nothing to roll back."""
@@ -356,11 +385,18 @@ class FleetController:
                 brown = {"active": False, "step": 0}
         with self._lock:
             rec = dict(self._recommended) if self._recommended else None
+            age = None
+            if rec is not None and self._recommended_t is not None:
+                age = round(max(0.0, self._clock() - self._recommended_t), 3)
             return {
                 "state": self.state,
                 "forecast": dict(self._last_forecast),
                 "recommended": rec,
                 "recommended_replicas": rec["replicas"] if rec else None,
+                # self-reported plan freshness: the front's capacity
+                # aggregation drops plans older than its TTL (a stalled
+                # planning loop must not steer the HPA forever)
+                "plan_age_s": age,
                 "live": live,
                 "brownout": brown,
                 "decisions": dict(self.decisions),
@@ -391,6 +427,7 @@ def make_fleet(spec: Any, *, predict_ms: Callable[[int], Optional[float]],
         kw = dict(spec)
         kw.pop("cache_path", None)  # consumed by serve_pipeline
         kw.pop("cache_write", None)
+        kw.pop("cache_store", None)  # object-store backend (objstore.py)
         pcfg = kw.pop("planner", None)
         if pcfg is not None and planner_cfg is None:
             planner_cfg = PlannerConfig(**pcfg)

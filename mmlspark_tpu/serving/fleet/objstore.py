@@ -1,0 +1,256 @@
+"""Object-store artifact tier: put/get backends under the persistent cache.
+
+PR 13's ``PersistentCompileCache`` ties compiled-executable survival to the
+pod-local disk; this module detaches it. The cache's entries are already
+content-addressed (``<sha256>.mmlc``), which is exactly an object-store
+key space, so the tier is a minimal put/get interface:
+
+  - ``LocalDirStore``  — the reference implementation (atomic writes into
+    one directory); doubles as the test double for remote stores.
+  - ``CallbackStore``  — the injectable remote stub: wrap any client's
+    callables (GCS/S3/...) without this framework importing their SDKs.
+
+Both fire the ``store.put`` / ``store.get`` fault points before touching
+the backend, so chaos plans exercise the real degrade paths: a failing put
+flips the cache to accounted read-only mode; a failing or corrupted get is
+an accounted recompile — serving never stops for the artifact tier.
+
+The tier also ships tuning state: :func:`put_snapshot` / :func:`get_snapshot`
+store a JSON snapshot of the live ``KnobSet`` and capacity plan alongside
+the executables, so a fresh pod warm-starts on the tuned buckets / mega-K /
+sharding / kernel variants with zero relearning (docs/front_fabric.md,
+"Knob shipping").
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ...core import faults
+
+logger = logging.getLogger(__name__)
+
+#: the well-known key carrying the shipped KnobSet + capacity plan
+SNAPSHOT_KEY = "knobs-snapshot.json"
+#: snapshot wire format version (bump on incompatible change)
+SNAPSHOT_FORMAT = 1
+
+
+class ObjectStore:
+    """Minimal put/get artifact store. Subclasses implement ``_do_*``; the
+    public methods fire the fault points and keep op/error/byte counters
+    (the ``mmlspark_store_*`` metric families). Errors re-raise so the
+    caller (the persistent cache) applies its own degrade accounting."""
+
+    name = "objstore"
+
+    def __init__(self) -> None:
+        self.puts = 0
+        self.gets = 0
+        self.put_errors = 0
+        self.get_errors = 0
+        self.bytes_put = 0
+        self.bytes_got = 0
+        self._lock = threading.Lock()
+
+    # -- public API ---------------------------------------------------------
+
+    def put(self, key: str, data: bytes) -> None:
+        try:
+            faults.fire(faults.STORE_PUT, key=key, store=self.name,
+                        n_bytes=len(data))
+            self._do_put(key, bytes(data))
+        except Exception:
+            with self._lock:
+                self.put_errors += 1
+            raise
+        with self._lock:
+            self.puts += 1
+            self.bytes_put += len(data)
+
+    def get(self, key: str) -> Optional[bytes]:
+        """The object's bytes, or ``None`` when absent. Backend errors and
+        injected ``store.get`` faults raise (accounted, then degraded to
+        recompile by the cache)."""
+        try:
+            faults.fire(faults.STORE_GET, key=key, store=self.name)
+            blob = self._do_get(key)
+        except Exception:
+            with self._lock:
+                self.get_errors += 1
+            raise
+        if blob is not None:
+            with self._lock:
+                self.gets += 1
+                self.bytes_got += len(blob)
+        return blob
+
+    def has(self, key: str) -> bool:
+        return self._do_has(key)
+
+    def list(self, suffix: str = "") -> List[str]:
+        return self._do_list(suffix)
+
+    def delete(self, key: str) -> None:
+        self._do_delete(key)
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {"store": self.name, "puts": self.puts, "gets": self.gets,
+                    "put_errors": self.put_errors,
+                    "get_errors": self.get_errors,
+                    "bytes_put": self.bytes_put,
+                    "bytes_got": self.bytes_got}
+
+    # -- backend seams ------------------------------------------------------
+
+    def _do_put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def _do_get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def _do_has(self, key: str) -> bool:
+        return self._do_get(key) is not None
+
+    def _do_list(self, suffix: str) -> List[str]:
+        raise NotImplementedError
+
+    def _do_delete(self, key: str) -> None:
+        raise NotImplementedError
+
+
+def _safe_key(key: str) -> str:
+    if not key or os.sep in key or key.startswith("."):
+        raise ValueError("object keys are flat names, got %r" % (key,))
+    return key
+
+
+class LocalDirStore(ObjectStore):
+    """Reference backend: one flat directory, atomic durable writes (tmp +
+    fsync + rename, the journal compactor's idiom) so a crashed put never
+    leaves a torn object for a later get to trip on."""
+
+    name = "localdir"
+
+    def __init__(self, root: str):
+        super().__init__()
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, _safe_key(key))
+
+    def _do_put(self, key: str, data: bytes) -> None:
+        faults.atomic_write_bytes(self._path(key), data)
+
+    def _do_get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as fh:
+                return fh.read()
+        except FileNotFoundError:
+            return None
+
+    def _do_has(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def _do_list(self, suffix: str) -> List[str]:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return sorted(n for n in names if n.endswith(suffix))
+
+    def _do_delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+class CallbackStore(ObjectStore):
+    """The injectable remote stub: adapt any object-store client by passing
+    its callables. ``get_fn`` must return ``None`` for a missing key;
+    ``has_fn``/``list_fn``/``delete_fn`` are optional (``has`` falls back
+    to a get, ``list`` to empty — a remote tier that cannot enumerate still
+    serves point lookups, and ``warm()`` simply finds nothing to preload)."""
+
+    name = "callback"
+
+    def __init__(self, put_fn: Callable[[str, bytes], None],
+                 get_fn: Callable[[str], Optional[bytes]],
+                 list_fn: Optional[Callable[[str], List[str]]] = None,
+                 delete_fn: Optional[Callable[[str], None]] = None,
+                 has_fn: Optional[Callable[[str], bool]] = None):
+        super().__init__()
+        self._put_fn = put_fn
+        self._get_fn = get_fn
+        self._list_fn = list_fn
+        self._delete_fn = delete_fn
+        self._has_fn = has_fn
+
+    def _do_put(self, key: str, data: bytes) -> None:
+        self._put_fn(key, data)
+
+    def _do_get(self, key: str) -> Optional[bytes]:
+        return self._get_fn(key)
+
+    def _do_has(self, key: str) -> bool:
+        if self._has_fn is not None:
+            return bool(self._has_fn(key))
+        return self._do_get(key) is not None
+
+    def _do_list(self, suffix: str) -> List[str]:
+        if self._list_fn is None:
+            return []
+        return [n for n in self._list_fn(suffix) if n.endswith(suffix)]
+
+    def _do_delete(self, key: str) -> None:
+        if self._delete_fn is not None:
+            self._delete_fn(key)
+
+
+def make_store(store) -> Optional[ObjectStore]:
+    """Coerce a ``store=`` argument: ``None`` off, a path string becomes a
+    ``LocalDirStore``, a ready ``ObjectStore`` passes through."""
+    if store is None:
+        return None
+    if isinstance(store, ObjectStore):
+        return store
+    if isinstance(store, str):
+        return LocalDirStore(store)
+    raise TypeError("store must be None/path/ObjectStore, got %r" % (store,))
+
+
+# ---------------------------------------------------------------------------
+# Knob shipping: KnobSet + capacity plan snapshots
+# ---------------------------------------------------------------------------
+
+def snapshot_blob(knobs: Optional[Dict[str, object]] = None,
+                  capacity_plan: Optional[Dict[str, object]] = None,
+                  env: Optional[Dict[str, object]] = None) -> bytes:
+    """Serialize a knob-shipping snapshot (canonical JSON: byte-stable for
+    the change-detection skip in ``PersistentCompileCache.put_snapshot``)."""
+    payload = {"format": SNAPSHOT_FORMAT, "knobs": knobs or None,
+               "capacity_plan": capacity_plan or None, "env": env or None}
+    return json.dumps(payload, sort_keys=True).encode("utf-8")
+
+
+def parse_snapshot(blob: Optional[bytes]) -> Optional[Dict[str, object]]:
+    """Decode a snapshot blob; ``None`` on absence, corruption or a foreign
+    format version (degrade to relearning, never raise)."""
+    if blob is None:
+        return None
+    try:
+        payload = json.loads(blob.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if payload.get("format") != SNAPSHOT_FORMAT:
+        return None
+    return payload

@@ -99,6 +99,21 @@ class CapacityPlan:
                 d[k] = round(v, 4)
         return d
 
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CapacityPlan":
+        """Rehydrate a shipped plan (fleet/objstore.py knob shipping).
+        Unknown keys are dropped, missing ones defaulted — a snapshot from
+        a slightly older build still warm-starts the controller."""
+        names = {f.name for f in dataclasses.fields(cls)}
+        kw: Dict[str, Any] = {"replicas": 1, "inflight": 1, "bucket": 1,
+                              "mega_k": 1, "demand_rps": 0.0,
+                              "service_ms": None, "wait_ms": None,
+                              "predicted_latency_ms": None,
+                              "utilization": None, "capacity_rps": None,
+                              "meets_slo": None, "reason": "shipped"}
+        kw.update({k: v for k, v in dict(d).items() if k in names})
+        return cls(**kw)
+
 
 def forecast_rps(buckets: Iterable, now: Optional[float] = None,
                  alpha: float = 0.35, trend_alpha: float = 0.15,

@@ -27,7 +27,9 @@ dropping committed epochs, preserving each entry's record variant.
 from __future__ import annotations
 
 import base64
+import errno
 import json
+import logging
 import os
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -36,13 +38,44 @@ from ..core import faults
 from ..core.faults import fsync_dir
 from ..io.binary import is_frame
 
+_LOG = logging.getLogger(__name__)
+
 
 class RequestJournal:
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
+        #: disk-full degrade (docs/faults.md): ENOSPC on an append flips
+        #: the journal to accounted read-only mode — durability is lost
+        #: (logged once, counted) but the serving loop never crashes
+        self.degraded = False
+        self.write_errors = 0
+        self.skipped_writes = 0
+        self._enospc_logged = False
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._fh = open(path, "ab")
+
+    def _note_write_error(self, e: OSError) -> None:
+        """ENOSPC degrades (read-only, log once); anything else re-raises —
+        an unexpected I/O failure should surface, a full volume should not
+        take serving down."""
+        if getattr(e, "errno", None) != errno.ENOSPC:
+            raise e
+        with self._lock:
+            self.write_errors += 1
+            self.degraded = True
+            logged = self._enospc_logged
+            self._enospc_logged = True
+        if not logged:
+            _LOG.warning("request journal volume full (ENOSPC): degrading "
+                         "to read-only mode — epochs are no longer durable")
+
+    def _skip_write(self) -> bool:
+        if not self.degraded:
+            return False
+        with self._lock:
+            self.skipped_writes += 1
+        return True
 
     # -- write side (server) ----------------------------------------------
     @staticmethod
@@ -64,11 +97,17 @@ class RequestJournal:
 
     def append(self, epoch: int, rid: int, body: bytes,
                headers: Optional[Dict[str, str]] = None) -> None:
-        faults.fire(faults.JOURNAL_WRITE, epoch=epoch, n=1)
-        with self._lock:
-            self._fh.write(self._record(epoch, rid, body, headers))
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
+        if self._skip_write():
+            return
+        rec = self._record(epoch, rid, body, headers)
+        try:
+            faults.fire(faults.JOURNAL_WRITE, epoch=epoch, n=1)
+            with self._lock:
+                self._fh.write(rec)
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+        except OSError as e:
+            self._note_write_error(e)
 
     def append_many(self, epoch: int, entries) -> None:
         """Journal a whole epoch with ONE flush+fsync (the hot batch path:
@@ -76,20 +115,36 @@ class RequestJournal:
         ``entries``: iterable of (rid, body, headers)."""
         recs = [self._record(epoch, rid, body, headers)
                 for rid, body, headers in entries]
-        faults.fire(faults.JOURNAL_WRITE, epoch=epoch, n=len(recs))
-        with self._lock:
-            self._fh.write(b"".join(recs))
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
+        if self._skip_write():
+            return
+        try:
+            faults.fire(faults.JOURNAL_WRITE, epoch=epoch, n=len(recs))
+            with self._lock:
+                self._fh.write(b"".join(recs))
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+        except OSError as e:
+            self._note_write_error(e)
 
     def commit(self, epoch: int) -> None:
-        faults.fire(faults.JOURNAL_COMMIT, epoch=epoch)
+        if self._skip_write():
+            return
+        try:
+            faults.fire(faults.JOURNAL_COMMIT, epoch=epoch)
+            with self._lock:
+                self._fh.write((json.dumps({"op": "commit",
+                                            "epoch": int(epoch)}) +
+                                "\n").encode("utf-8"))
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+        except OSError as e:
+            self._note_write_error(e)
+
+    def stats(self) -> Dict[str, int]:
         with self._lock:
-            self._fh.write((json.dumps({"op": "commit",
-                                        "epoch": int(epoch)}) +
-                            "\n").encode("utf-8"))
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
+            return {"degraded": int(self.degraded),
+                    "write_errors": self.write_errors,
+                    "skipped_writes": self.skipped_writes}
 
     def close(self) -> None:
         with self._lock:

@@ -85,6 +85,10 @@ class RoutingFront:
     #: /_mmlspark/capacity and sums the recommendations — the single
     #: endpoint a helm HPA / external scaler keys on
     CAPACITY_PATH = "/_mmlspark/capacity"
+    #: fabric mode only (404-equivalent pass-through otherwise): the L1's
+    #: ring summary (epoch, cells, journal tail) and the drain control
+    RING_PATH = "/_mmlspark/ring"
+    DRAIN_PATH = "/_mmlspark/drain"
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  forward_timeout_s: float = 70.0, max_failures: int = 3,
@@ -94,7 +98,8 @@ class RoutingFront:
                  probe_policy: Optional[RetryPolicy] = None,
                  obs: bool = True, tracer: Optional[Tracer] = None,
                  trace_sample_rate: float = 1.0,
-                 http_mode: str = "thread", slo=None, hedge=None):
+                 http_mode: str = "thread", slo=None, hedge=None,
+                 fabric=None, capacity_ttl_s: Optional[float] = 45.0):
         self.host = host
         self.port = port
         self.forward_timeout_s = forward_timeout_s
@@ -102,6 +107,12 @@ class RoutingFront:
         self.token = token  # when set, /register requires X-MMLSpark-Token
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
+        #: capacity-aggregate staleness bound: a worker plan older than
+        #: this (its self-reported ``plan_age_s``) is dropped from the
+        #: fleet sums and listed under ``stale_workers`` — a worker whose
+        #: planning loop stalled must not steer the HPA forever. None
+        #: disables the check.
+        self.capacity_ttl_s = capacity_ttl_s
         # HTTP transport: "thread" = ThreadingHTTPServer + one urlopen
         # socket per forward; "async" = event-loop ingress (serving/aio.py)
         # + pooled keep-alive worker connections — the hop stops paying a
@@ -122,6 +133,14 @@ class RoutingFront:
         from .supervisor import make_hedge
 
         self._hedge = make_hedge(hedge)
+        # federated front fabric (serving/fabric): when set, this front is
+        # an L1 — its registered "workers" are L2 fronts (cells) and route
+        # order comes from consistent-hash tenant affinity instead of the
+        # round-robin. None (the default) leaves the single-front path
+        # byte-identical.
+        from .fabric import make_fabric
+
+        self._fabric = make_fabric(fabric)
         # probe backoff: open workers are re-probed on a jittered exponential
         # schedule (deterministic when the policy is seeded)
         self.probe_policy = probe_policy or RetryPolicy(
@@ -184,6 +203,11 @@ class RoutingFront:
                 self._workers.append(address)
             self._circuits[address] = _WorkerCircuit()
             self._capacity[address] = max(1, int(capacity))
+        if self._fabric is not None:
+            # a journaled ring epoch (re-registration refreshes are not
+            # epochs; a ring.rebalance crash is absorbed — previous epoch
+            # keeps serving)
+            self._fabric.note_register(address)
 
     def deregister(self, address: str) -> None:
         with self._lock:
@@ -191,6 +215,8 @@ class RoutingFront:
                 self._workers.remove(address)
             self._circuits.pop(address, None)
             self._capacity.pop(address, None)
+        if self._fabric is not None:
+            self._fabric.note_deregister(address)
 
     @property
     def workers(self) -> List[str]:
@@ -230,6 +256,34 @@ class RoutingFront:
                 seen.add(w)
                 order.append(w)
         return order
+
+    def _route_order(self, headers) -> List[str]:
+        """Worker order for one public request: with the fabric on, the
+        tenant's affinity cell first and the ring-walk survivors after it
+        (bounded movement: only a dead/drained cell's arc re-hashes);
+        otherwise the capacity-weighted round-robin, unchanged."""
+        if self._fabric is None:
+            return self._pick_order()
+        with self._lock:
+            routable = [w for w in self._workers
+                        if self._circuits[w].state != OPEN]
+        return self._fabric.order_for(headers, routable)
+
+    def drain_cell(self, address: str,
+                   timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Planned maintenance (fabric mode): journal a ``drain`` epoch —
+        new assignments stop and the cell's arc re-hashes onto survivors —
+        wait for this front's in-flight forwards to the cell to flush,
+        journal the handoff epoch, then deregister the cell. Blocking
+        (bounded by the fabric's drain timeout): call it from the threaded
+        transport or out-of-band, not on the async loop."""
+        if self._fabric is None:
+            raise RuntimeError("drain_cell requires fabric mode "
+                               "(RoutingFront(fabric=...))")
+        result = self._fabric.drain_cell(address, timeout_s=timeout_s)
+        if result.get("ok"):
+            self.deregister(address)
+        return result
 
     def _note_failure(self, address: str) -> None:
         with self._lock:
@@ -326,8 +380,17 @@ class RoutingFront:
                                    time.perf_counter() - t_f0,
                                    worker=addr, **attrs)
 
+        if self._fabric is not None:
+            # per-cell in-flight accounting: what drain_cell waits on
+            self._fabric.begin(addr)
         try:
             faults.fire(faults.WORKER_FORWARD, addr=addr, path=path)
+            if self._fabric is not None:
+                # cell-crash chaos seam (fabric mode only): InjectedFault
+                # is an OSError, so it lands in the transport-error branch
+                # below as a replay-safe "error" — the retry walk re-hashes
+                # the tenant onto the next ring survivor
+                faults.fire(faults.FRONT_L2_CRASH, cell=addr, path=path)
             with urlopen(req, timeout=timeout) as resp:
                 self._note_success(addr)
                 fwd_span(status=resp.status)
@@ -348,6 +411,9 @@ class RoutingFront:
             timed_out = isinstance(reason, TimeoutError) or \
                 "timed out" in str(reason).lower()
             return ("timeout" if timed_out else "error", str(reason))
+        finally:
+            if self._fabric is not None:
+                self._fabric.end(addr)
 
     def _hedged_forward(self, order: List[str], attempt: Callable,
                         deadline) -> Optional[Tuple[str, Any, str]]:
@@ -453,6 +519,8 @@ class RoutingFront:
                        "capacity": self.worker_capacities}
             if self._hedge is not None:
                 payload["hedge"] = self._hedge.summary()
+            if self._fabric is not None:
+                payload["fabric"] = self._fabric.summary()
             return (200, "application/json", json.dumps(payload).encode())
         if path == RoutingFront.HEALTH_PATH:
             return (200, "application/json", json.dumps(
@@ -476,6 +544,26 @@ class RoutingFront:
         if path == RoutingFront.CAPACITY_PATH:
             return (200, "application/json",
                     json.dumps(self._collect_capacity()).encode("utf-8"))
+        if path == RoutingFront.RING_PATH and self._fabric is not None:
+            # fabric off: fall through to the forward path (byte-identical
+            # single-front behavior — the worker answers or 404s)
+            return (200, "application/json",
+                    json.dumps(self._fabric.summary()).encode("utf-8"))
+        if path == RoutingFront.DRAIN_PATH and self._fabric is not None:
+            from .server import TOKEN_HEADER
+            if self.token is not None and \
+                    headers.get(TOKEN_HEADER) != self.token:
+                return (403, "application/json",
+                        b'{"error": "bad cluster token"}')
+            try:
+                msg = json.loads(body.decode())
+                result = self.drain_cell(
+                    msg["cell"], timeout_s=msg.get("timeout_s"))
+                return (200, "application/json",
+                        json.dumps(result).encode("utf-8"))
+            except Exception as e:  # noqa: BLE001
+                return (400, "application/json",
+                        json.dumps({"error": str(e)}).encode())
         return None
 
     def _collect_capacity(self) -> Dict[str, Any]:
@@ -514,23 +602,49 @@ class RoutingFront:
         contributed = 0
         total_forecast = 0.0
         responding = 0
+        stale: List[str] = []
+        ttl = self.capacity_ttl_s
         for addr in addrs:
             r = results.get(addr)
-            if not isinstance(r, dict) or "state" not in r:
+            if not isinstance(r, dict):
                 continue
-            responding += 1
-            rec = r.get("recommended_replicas")
-            if rec is not None:
-                total_rec += int(rec)
-                contributed += 1
-            fc = (r.get("forecast") or {}).get("forecast_rps")
-            if fc is not None:
-                total_forecast += float(fc)
+            if "state" in r:
+                # a worker's own fleet summary
+                responding += 1
+                age = r.get("plan_age_s")
+                if ttl is not None and age is not None and age > ttl:
+                    # staleness fix: a worker whose planning loop stalled
+                    # keeps republishing its last plan forever — drop it
+                    # from the sums instead of steering the HPA with it
+                    stale.append(addr)
+                    continue
+                rec = r.get("recommended_replicas")
+                if rec is not None:
+                    total_rec += int(rec)
+                    contributed += 1
+                fc = (r.get("forecast") or {}).get("forecast_rps")
+                if fc is not None:
+                    total_forecast += float(fc)
+            elif "workers" in r and "recommended_replicas" in r:
+                # an L2 front's aggregate (fabric mode: this front's
+                # "workers" are themselves fronts): fold the cell's sums —
+                # the cell applied the same TTL to its own workers, so its
+                # stale list propagates up
+                responding += 1
+                rec = r.get("recommended_replicas")
+                if rec is not None:
+                    total_rec += int(rec)
+                    contributed += 1
+                fc = r.get("forecast_rps")
+                if fc is not None:
+                    total_forecast += float(fc)
+                stale.extend(r.get("stale_workers") or [])
         return {"workers": len(addrs), "responding": responding,
                 # null (not 0) when no worker has published a plan yet —
                 # an HPA must never read "scale to zero" out of cold start
                 "recommended_replicas": total_rec if contributed else None,
                 "forecast_rps": round(total_forecast, 4),
+                "stale_workers": stale,
                 "per_worker": {a: results.get(a, {"error": "no reply"})
                                for a in addrs}}
 
@@ -600,7 +714,7 @@ class RoutingFront:
                 # With hedging ON the first two workers instead race: the
                 # hedge launches after the tracker's quantile delay and the
                 # first response wins (opt-in: duplicates by design).
-                order = front._pick_order()
+                order = front._route_order(self.headers)
                 if not order:
                     respond(503, b'{"error": "no workers registered"}',
                             extra={"Retry-After": "1"}, outcome="no_workers")
@@ -709,7 +823,7 @@ class RoutingFront:
         if dl is not None and dl.expired():
             return respond(504, b'{"error": "deadline expired"}',
                            outcome="deadline_expired")
-        order = self._pick_order()
+        order = self._route_order(req.headers)
         if not order:
             return respond(503, b'{"error": "no workers registered"}',
                            extra={"Retry-After": "1"}, outcome="no_workers")
@@ -744,8 +858,15 @@ class RoutingFront:
                                        time.perf_counter() - t_f0,
                                        worker=addr, **attrs)
 
+            if self._fabric is not None:
+                self._fabric.begin(addr)
             try:
                 faults.fire(faults.WORKER_FORWARD, addr=addr, path=path)
+                if self._fabric is not None:
+                    # cell-crash chaos seam — same taxonomy as the
+                    # threaded transport: replay-safe "error", re-hash
+                    faults.fire(faults.FRONT_L2_CRASH, cell=addr,
+                                path=path)
                 status, rhdrs, rbody = await self._pool.request(
                     req.method, url, body=body, headers=hdrs,
                     timeout=timeout, deadline=dl)
@@ -758,6 +879,9 @@ class RoutingFront:
                     isinstance(e, TimeoutError) or \
                     "timed out" in str(e).lower()
                 return ("timeout" if timed_out else "error", str(e))
+            finally:
+                if self._fabric is not None:
+                    self._fabric.end(addr)
             # ANY worker answer — 2xx or an error status — is authoritative
             # (the threaded handler's urlopen/HTTPError split, merged)
             self._note_success(addr)
@@ -910,6 +1034,8 @@ class RoutingFront:
                     pass
             self._aio.stop()
             self._aio = None
+        if self._fabric is not None:
+            self._fabric.close()  # flush/close the durable ring journal
 
     @property
     def address(self) -> str:

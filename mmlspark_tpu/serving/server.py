@@ -1396,9 +1396,19 @@ class ServingServer:
             if self._slo is not None:
                 hooks.setdefault("arrival_buckets",
                                  self._slo.arrival_buckets)
+            warm_plan = hooks.pop("warm_plan", None)
             self._fleet = make_fleet(
                 self._fleet_spec, predict_ms=predict, slo=self._slo,
                 brownout=self._brownout, hooks=hooks)
+            if warm_plan and self._fleet is not None:
+                # shipped capacity plan (knob-shipping snapshot): publish
+                # it at /_mmlspark/capacity until the first local plan
+                # outranks it, so a fresh pod advertises tuned capacity
+                # from its first scrape
+                try:
+                    self._fleet.warm_start(warm_plan)
+                except Exception:  # noqa: BLE001 — warm start best-effort
+                    pass
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -1684,10 +1694,16 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
         fleet_hooks = {}
         cache_path = None
         cache_write = True
+        cache_store = None
         if isinstance(fleet, dict):
             cache_path = fleet.get("cache_path")
             cache_write = bool(fleet.get("cache_write", True))
-        if cache_path and hasattr(stage, "attach_persistent_cache"):
+            # object-store backend (fleet/objstore.py): a directory path
+            # or an ObjectStore instance — entries and the knob-shipping
+            # snapshot ride the store instead of the pod-local cache_path
+            cache_store = fleet.get("cache_store")
+        if (cache_path or cache_store) \
+                and hasattr(stage, "attach_persistent_cache"):
             from .fleet import PersistentCompileCache
 
             def _knobs(_t=tuner):
@@ -1700,11 +1716,40 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                         return {}
                 return {}
 
-            tier = PersistentCompileCache(cache_path, write=cache_write,
-                                          knobs_provider=_knobs)
+            tier = PersistentCompileCache(cache_path or "",
+                                          write=cache_write,
+                                          knobs_provider=_knobs,
+                                          store=cache_store)
             # attach + AOT-warm: deserialize previously-seen executables
             # into the in-process cache BEFORE the first request arrives
             stage.attach_persistent_cache(tier)
+            # knob shipping (docs/front_fabric.md): adopt the fleet's
+            # shipped KnobSet NOW — journaled "warm_start" with one-step
+            # rollback — and hand the capacity plan to the controller, so
+            # the pod serves tuned from its first request (zero
+            # relearning, the zero-compile warm's control-plane twin)
+            snap = tier.load_snapshot()
+            if snap:
+                if tuner is not None and snap.get("knobs"):
+                    try:
+                        tuner.warm_start(snap["knobs"])
+                    except Exception:  # noqa: BLE001 — just relearn
+                        pass
+                if snap.get("capacity_plan"):
+                    fleet_hooks["warm_plan"] = dict(snap["capacity_plan"])
+
+            def _snapshot(plan=None, _tier=tier, _t=tuner):
+                # refreshed by the controller on every plan; byte-identical
+                # snapshots dedup inside the tier
+                knobs = None
+                if _t is not None:
+                    try:
+                        knobs = _t.knobs.to_dict()
+                    except Exception:  # noqa: BLE001
+                        knobs = None
+                _tier.put_snapshot(knobs=knobs, capacity_plan=plan)
+
+            fleet_hooks["snapshot"] = _snapshot
         if hasattr(stage, "set_tuning"):
             def _set_mega_k(k, _stage=stage):
                 # the controller's single K fans out to the heavy planned

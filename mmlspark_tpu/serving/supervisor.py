@@ -422,6 +422,7 @@ class HedgeTracker:
         self.suppressed = 0       # hedge launch blocked (injected fault)
         self.wins_primary = 0
         self.wins_hedge = 0
+        self.wins_retry = 0       # non-hedged retry walk won the race
         self.both_failed = 0
 
     def observe(self, latency_s: float) -> None:
@@ -456,6 +457,8 @@ class HedgeTracker:
         with self._lock:
             if role == "hedge":
                 self.wins_hedge += 1
+            elif role == "retry":
+                self.wins_retry += 1
             else:
                 self.wins_primary += 1
 
@@ -472,6 +475,7 @@ class HedgeTracker:
                    "suppressed": self.suppressed,
                    "wins_primary": self.wins_primary,
                    "wins_hedge": self.wins_hedge,
+                   "wins_retry": self.wins_retry,
                    "both_failed": self.both_failed,
                    "hedge_fraction": round(
                        self.hedged / self.requests, 4)

@@ -1513,6 +1513,210 @@ def _coldstart_section():
                 "is identical in both arms and excluded)"}
 
 
+def _fabric_child(store_dir, mode):
+    """One fresh-process pod start over a shared OBJECT STORE
+    (serving/fleet/objstore.py) for the knob-shipping A/B. Modes:
+
+      seed  populate: compile + persist the chain's executables, run the
+            tuner's real measure->refit->apply calibration, ship the
+            tuned KnobSet + a capacity plan as the store snapshot
+      cold  the relearning arm: an EMPTY store — every signature
+            jit-compiles, knobs start at defaults (tuning would engage
+            only after the every-N serving calibration window)
+      warm  the shipped arm: AOT-warm from the store and warm_start the
+            shipped knobs BEFORE the first request
+
+    Prints the evidence JSON (counters + knob state + reply digest) on
+    stdout for the parent to pair."""
+    import hashlib
+
+    from mmlspark_tpu.core.tune import Tuner
+    from mmlspark_tpu.serving.fleet import PersistentCompileCache
+
+    t0 = time.perf_counter()
+    fused, model, df, n_rows = _make_autotune_chain()
+    tier = PersistentCompileCache("", store=store_dir)
+    warm = fused.attach_persistent_cache(tier)
+    tuner = Tuner(fused=fused, model=model)
+    knobs_active_at_setup = False
+    if mode == "warm":
+        snap = tier.load_snapshot()
+        if snap and snap.get("knobs"):
+            knobs_active_at_setup = tuner.warm_start(snap["knobs"])
+    t_setup = time.perf_counter() - t0
+    out = fused.transform(df)
+    t_first = time.perf_counter() - t0
+    if mode == "seed":
+        # real calibration, not invented knobs: measured warm passes ->
+        # refit -> apply, then ship the result
+        def run_once():
+            t = time.perf_counter()
+            fused.transform(df)
+            return n_rows / (time.perf_counter() - t)
+
+        run_once()
+        tuner.tune(lambda: run_once(), steps=2)
+        tier.put_snapshot(knobs=tuner.knobs.to_dict(),
+                          capacity_plan={"replicas": 1, "inflight": 2,
+                                         "reason": "shipped"})
+    h = hashlib.sha256()
+    for v in out.column(out.columns[-1]):
+        h.update(np.ascontiguousarray(np.asarray(v)).tobytes())
+    cs = fused.compile_cache.stats()
+    print(json.dumps({
+        "mode": mode,
+        "t_setup_s": round(t_setup, 4),
+        "t_first_reply_s": round(t_first, 4),
+        "memory": {k: cs.get(k) for k in
+                   ("hits", "misses", "compile_time_s", "entries")},
+        "tier": cs.get("persistent"),
+        "warm": warm,
+        "knobs_active_at_setup": knobs_active_at_setup,
+        "knobs": tuner.knobs.to_dict(),
+        "tuner_journal": [e["action"] for e in tuner.journal],
+        "reply_sha256": h.hexdigest()}))
+
+
+def _front_fabric_section(n: int = 40, tenants: int = 6):
+    """Federated front fabric A/B (serving/fabric/, docs/front_fabric.md),
+    three paired claims:
+
+    - ``parity``: the same tenant-tagged request stream through a single
+      front vs an L1 + 2 L2-cell fabric — replies must be BITWISE
+      identical; the latency delta prices the extra L1 hop honestly.
+    - ``kill_one_l2``: stop one of the two cells under the stream — the
+      dead cell's tenants re-hash to the survivor with zero failed
+      requests and bitwise-identical replies.
+    - ``knob_shipping``: fresh-process pods over an object store
+      (``--fabric-child``): the relearning arm (empty store) jit-compiles
+      everything and starts on default knobs; the shipped arm AOT-warms
+      and ``warm_start``s the journaled tuned knobs before its first
+      request — zero compiles AND zero relearning, reply digest bitwise
+      the seeding pod's."""
+    import subprocess
+    import sys
+    import tempfile
+
+    from mmlspark_tpu.serving import (RoutingFront, ServingServer,
+                                      register_worker)
+    from mmlspark_tpu.serving.stages import parse_request
+
+    def echo(df):
+        parsed = parse_request(df, "data", parse="json")
+        return parsed.with_column(
+            "reply", lambda p: [float(np.sum(v)) for v in p["data"]])
+
+    bodies = [(json.dumps({"data": [i, i + 1]}).encode(),
+               {"Content-Type": "application/json",
+                "X-MMLSpark-Tenant": "tenant-%d" % (i % tenants)})
+              for i in range(n)]
+
+    def run_stream(url):
+        replies, lat = [], []
+        for body, hdrs in bodies:
+            req = urllib.request.Request(url, data=body, headers=hdrs,
+                                         method="POST")
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                replies.append(resp.read())
+            lat.append((time.perf_counter() - t0) * 1e3)
+        a = np.asarray(lat)
+        return replies, {"p50_ms": round(float(np.percentile(a, 50)), 3),
+                         "mean_ms": round(float(a.mean()), 3), "n": n}
+
+    out = {}
+
+    # -- parity + hop cost: single front vs L1 + 2 cells -----------------
+    with ServingServer(echo, port=0, max_wait_ms=2.0) as w, \
+            RoutingFront(port=0) as single:
+        register_worker(single.address, w.address)
+        run_stream(single.address)  # warm
+        ref_replies, single_lat = run_stream(single.address)
+    with ServingServer(echo, port=0, max_wait_ms=2.0) as wa, \
+            ServingServer(echo, port=0, max_wait_ms=2.0) as wb, \
+            RoutingFront(port=0) as l2a, RoutingFront(port=0) as l2b, \
+            RoutingFront(port=0, fabric=True) as l1:
+        register_worker(l2a.address, wa.address)
+        register_worker(l2b.address, wb.address)
+        register_worker(l1.address, l2a.address)
+        register_worker(l1.address, l2b.address)
+        run_stream(l1.address)  # warm
+        fab_replies, fab_lat = run_stream(l1.address)
+
+        # -- kill one cell under the same stream -------------------------
+        pre_ring = json.loads(urllib.request.urlopen(
+            l1.address.rstrip("/") + "/_mmlspark/ring",
+            timeout=10).read())
+        l2a.stop()
+        failed = 0
+        post_replies = []
+        t0 = time.perf_counter()
+        for body, hdrs in bodies:
+            req = urllib.request.Request(l1.address, data=body,
+                                         headers=hdrs, method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    post_replies.append(resp.read())
+            except Exception:  # noqa: BLE001 — the claim counts failures
+                failed += 1
+                post_replies.append(None)
+        recovery_wall = time.perf_counter() - t0
+        post_ring = json.loads(urllib.request.urlopen(
+            l1.address.rstrip("/") + "/_mmlspark/ring",
+            timeout=10).read())
+    out["parity"] = {
+        "single_front": single_lat,
+        "l1_l2_fabric": fab_lat,
+        "bitwise_identical_replies": fab_replies == ref_replies,
+        "hop_cost_ratio": round(fab_lat["mean_ms"] /
+                                single_lat["mean_ms"], 4)
+        if single_lat["mean_ms"] else None}
+    out["kill_one_l2"] = {
+        "requests": n, "failed": failed,
+        "bitwise_identical_replies": post_replies == ref_replies,
+        "rehashes": post_ring["rehashes"] - pre_ring["rehashes"],
+        "wall_s": round(recovery_wall, 3)}
+
+    # -- knob shipping: fresh pods over an object store ------------------
+    def child(store_dir, mode):
+        r = subprocess.run(
+            [sys.executable, __file__, "--fabric-child", store_dir, mode],
+            capture_output=True, text=True, timeout=600, check=True)
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    with tempfile.TemporaryDirectory() as d_empty, \
+            tempfile.TemporaryDirectory() as d_shipped:
+        seed = child(d_shipped, "seed")
+        cold = child(d_empty, "cold")
+        warmed = child(d_shipped, "warm")
+    out["knob_shipping"] = {
+        "seed": seed, "relearn": cold, "shipped": warmed,
+        "shipped_zero_compiles": warmed["memory"]["misses"] == 0
+        and warmed["memory"]["compile_time_s"] == 0,
+        "shipped_knobs_active_at_setup": warmed["knobs_active_at_setup"],
+        "relearn_knobs_active_at_setup": cold["knobs_active_at_setup"],
+        "shipped_knobs_match_seed": warmed["knobs"] == seed["knobs"],
+        "bitwise_identical_reply":
+            warmed["reply_sha256"] == seed["reply_sha256"],
+        "t_first_reply_speedup": round(
+            cold["t_first_reply_s"] / warmed["t_first_reply_s"], 3)
+        if warmed["t_first_reply_s"] else None,
+        "time_to_tuned_s": {
+            "shipped": warmed["t_setup_s"],
+            "relearn": None}}
+
+    out["note"] = (
+        "CPU host, every server sharing cores with the client: the "
+        "fabric hop_cost_ratio prices one extra local HTTP forward plus "
+        "scheduling noise, not network fan-out; the claims are the "
+        "bitwise parity bits, failed == 0 after the cell kill, and the "
+        "shipped pod's counter-verified zero compiles + warm_start knobs "
+        "(time_to_tuned_s.relearn is null because the relearning arm "
+        "only tunes after its every-N serving calibration window — it "
+        "never reaches tuned knobs within this run).")
+    return out
+
+
 def _sharding_child():
     """Paired 1-shard vs N-shard A/B inside a forced multi-device CPU
     backend (the parent sets XLA_FLAGS=--xla_force_host_platform_device_count
@@ -1667,7 +1871,8 @@ def main():
     ap.add_argument("--only",
                     choices=["all", "load_async", "obs_overhead", "wire",
                              "autotune", "hedging", "ingest", "coldstart",
-                             "sharding", "canary", "compiler_search"],
+                             "sharding", "canary", "compiler_search",
+                             "front_fabric"],
                     default="all",
                     help="load_async: run just the overlapped-executor A/B "
                          "section; obs_overhead: just the observability "
@@ -1682,15 +1887,24 @@ def main():
                          "recovery A/B (merge into an existing artifact); "
                          "compiler_search: just the stitch + kernel-variant "
                          "A/B (split-vs-stitched GBDT chain, forest "
-                         "gather/gemm, hist chunk trials)")
+                         "gather/gemm, hist chunk trials); front_fabric: "
+                         "just the single-front vs L1+L2 parity, "
+                         "kill-one-cell recovery, and knob-shipped vs "
+                         "relearning fresh-pod A/B")
     ap.add_argument("--coldstart-child", metavar="CACHE_DIR",
                     help=argparse.SUPPRESS)
     ap.add_argument("--sharding-child", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--fabric-child", nargs=2,
+                    metavar=("STORE_DIR", "MODE"), help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.coldstart_child:
         _coldstart_child(args.coldstart_child)
+        return
+
+    if args.fabric_child:
+        _fabric_child(args.fabric_child[0], args.fabric_child[1])
         return
 
     if args.sharding_child:
@@ -1736,6 +1950,12 @@ def main():
         print(json.dumps({
             "backend": platform,
             "canary": _canary_section()}))
+        return
+
+    if args.only == "front_fabric":
+        print(json.dumps({
+            "backend": platform,
+            "front_fabric": _front_fabric_section()}))
         return
 
     if args.only == "ingest":

@@ -45,6 +45,7 @@ PACKAGES=(
   "tests/test_autotune.py"
   "tests/test_ingest_zero_copy.py"
   "tests/test_fleet.py"
+  "tests/test_front_fabric.py"
   "tests/test_lifecycle.py"
   "tests/test_benchmarks_extended.py"
   "tests/test_sharding.py"
@@ -68,7 +69,7 @@ if [ "$stage" = "chaos" ] || [ "$stage" = "all" ]; then
   # schedules, not just the default seed's (docs/faults.md)
   for seed in 0 7 1337; do
     echo "--- chaos seed $seed ---"
-    MMLSPARK_CHAOS_SEED=$seed python -m pytest tests/test_faults.py -q -m faults || rc=1
+    MMLSPARK_CHAOS_SEED=$seed python -m pytest tests/test_faults.py tests/test_front_fabric.py -q -m faults || rc=1
   done
   [ "$stage" = "chaos" ] && exit $rc
 fi
