@@ -64,31 +64,50 @@ def _is_jit_expr(node: ast.expr) -> bool:
     return False
 
 
-def _jitted_names(tree: ast.AST) -> Dict[str, int]:
-    """{function name: reporting line} for every module-local name that is
-    jitted or registered as a DeviceFn body."""
+def _jitted_names(tree: ast.AST) -> "Tuple[Dict[str, int], Set[str]]":
+    """(jitted, pallas): {function name: reporting line} for every
+    module-local name that is jitted, registered as a DeviceFn body (dense
+    ``fn`` or the CSR-capable ``sparse_fn``), or passed as a Pallas kernel
+    (``pl.pallas_call`` bodies trace on-core — the same host-call rules
+    apply). ``pallas`` names the kernel subset: ``out_ref[...] = ...``
+    Ref stores are how a Pallas kernel WRITES its output, so the
+    parameter-mutation rule is waived for them (host calls are not)."""
     jitted: Dict[str, int] = {}
+    pallas: Set[str] = set()
 
-    def mark(arg: ast.expr) -> None:
+    def mark(arg: ast.expr, kernel: bool = False) -> None:
         if isinstance(arg, ast.Name):
             jitted.setdefault(arg.id, arg.lineno)
+            if kernel:
+                pallas.add(arg.id)
+        elif isinstance(arg, ast.Call):
+            # functools.partial(kernel, ...) — the pallas_call grid idiom
+            fname = dotted_name(arg.func)
+            if fname in ("functools.partial", "partial") and arg.args:
+                mark(arg.args[0], kernel=kernel)
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):
             if _is_jit_expr(node.func) and node.args:
                 mark(node.args[0])
             callee = dotted_name(node.func) or ""
-            if callee.rsplit(".", 1)[-1] == "DeviceFn":
+            tail = callee.rsplit(".", 1)[-1]
+            if tail == "pallas_call" and node.args:
+                mark(node.args[0], kernel=True)
+            if tail == "DeviceFn":
                 kw = call_keyword(node, "fn")
                 if kw is not None:
                     mark(kw)
                 elif len(node.args) > _DEVICEFN_FN_POS:
                     mark(node.args[_DEVICEFN_FN_POS])
+                sfn = call_keyword(node, "sparse_fn")
+                if sfn is not None:
+                    mark(sfn)
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for dec in node.decorator_list:
                 if _is_jit_expr(dec):
                     jitted.setdefault(node.name, node.lineno)
-    return jitted
+    return jitted, pallas
 
 
 def _transpiled_names(tree: ast.AST) -> Dict[str, int]:
@@ -244,7 +263,7 @@ class DevicePurityPass(AnalysisPass):
         if sf.tree is None:
             return findings
         findings.extend(self._check_staging(sf))
-        jitted = _jitted_names(sf.tree)
+        jitted, pallas = _jitted_names(sf.tree)
         transpiled = _transpiled_names(sf.tree)
         for name, line in transpiled.items():
             jitted.setdefault(name, line)
@@ -286,6 +305,8 @@ class DevicePurityPass(AnalysisPass):
                             f"{reason} inside jittable '{node.name}' — "
                             f"device functions must be trace-pure"))
                 elif isinstance(inner, (ast.Assign, ast.AugAssign)):
+                    if node.name in pallas:
+                        continue  # Ref stores ARE the kernel's output path
                     targets = inner.targets if isinstance(
                         inner, ast.Assign) else [inner.target]
                     for t in targets:

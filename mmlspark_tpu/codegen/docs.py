@@ -82,6 +82,18 @@ _EXTRA_INDEX = [
     "`serve_pipeline(..., lifecycle=...)`), `OnlineTrainer` / "
     "`FeedbackJournal` / `VWOnlineAdapter` / `GBDTRefitAdapter` "
     "(journaled train-on-serve with bitwise-replayable checkpoints)",
+    "- sparse end-to-end (`mmlspark_tpu.gbdt.pallas_sparse` + the CSR "
+    "wire/staging seams, hand-maintained guide in "
+    "[docs/sparse.md](../sparse.md)): `encode_csr_columns` / "
+    "`decode_csr_columns` / `validate_csr_triple` / `CSR_SUFFIXES` "
+    "(io/binary.py: CSR triples as validated frame sub-columns, hostile "
+    "frames rejected all-or-nothing), `csr_gather` / "
+    "`sparse_histogram_mxu` / `used_features` / `remap_ensemble` (the "
+    "Pallas sparse kernels behind the `forest.csr` / `hist.csr` "
+    "variants), `SegmentCostModel.observe_nnz` / `nnz_bytes` / "
+    "`choose_layout` (the nnz-predicted, journaled `layout` knob), and "
+    "`split_csr_rows` / `ragged_allgather_bytes` (shardplan's row-split "
+    "`csr_row` partition spec)",
 ]
 
 

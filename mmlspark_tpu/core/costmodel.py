@@ -193,6 +193,10 @@ class SegmentCostModel:
         # (segment, bucket, variant id) -> [ewma wall ms, n] — measured
         # kernel-variant trials ("default" tracks the incumbent baseline)
         self._variant: Dict[Tuple[str, int, str], List[float]] = {}
+        # segment -> [ewma nnz-per-row, ewma width, n] — sparse density
+        # observations (docs/sparse.md): staging bytes scale with nnz, not
+        # rows x width, so the layout decision needs its own term
+        self._nnz: Dict[str, List[float]] = {}
 
     # -- feeding ---------------------------------------------------------
     def peaks(self) -> Dict[str, Any]:
@@ -734,6 +738,72 @@ class SegmentCostModel:
                     best_id, best_ms = vid, rec[0]
         return best_id
 
+    def observe_nnz(self, segment: str, rows: int, nnz: int,
+                    width: int) -> None:
+        """Fold one sparse-column staging observation (rows of the
+        partition, total nonzeros, declared feature width) — fed by the
+        executor's CSR/densify staging and by bench harnesses. The EWMA
+        tracks nnz PER ROW so the prediction scales to any batch size."""
+        if rows <= 0 or nnz < 0 or width <= 0:
+            return
+        per_row = float(nnz) / float(rows)
+        with self._lock:
+            cur = self._nnz.get(str(segment))
+            if cur is None:
+                self._nnz[str(segment)] = [per_row, float(width), 1]
+            else:
+                cur[0] = (1 - self.ewma) * cur[0] + self.ewma * per_row
+                cur[1] = (1 - self.ewma) * cur[1] + self.ewma * float(width)
+                cur[2] += 1
+
+    def nnz_bytes(self, segment: str, batch: int) -> Optional[float]:
+        """Predicted CSR wire bytes for one ``batch``-row staging of the
+        segment's sparse column: values (f32) + indices (i32) per nonzero
+        plus the i32 indptr — bytes ≈ f(nnz), not N x F. None until an
+        ``observe_nnz`` has been folded (the roofline's nnz-aware bound
+        and the layout decision both gate on it)."""
+        if batch <= 0:
+            return None
+        with self._lock:
+            rec = self._nnz.get(str(segment))
+        if rec is None:
+            return None
+        return batch * rec[0] * 8.0 + (batch + 1) * 4.0
+
+    def dense_bytes(self, segment: str, batch: int) -> Optional[float]:
+        """Densified staging bytes for the same batch (rows x observed
+        width x f32) — the side the CSR prediction must undercut."""
+        if batch <= 0:
+            return None
+        with self._lock:
+            rec = self._nnz.get(str(segment))
+        if rec is None:
+            return None
+        return batch * rec[1] * 4.0
+
+    def choose_layout(self, segment: str,
+                      margin: float = 0.5) -> Optional[str]:
+        """Should the executor stage this segment's sparse columns as CSR
+        wire triples? ``"csr"`` when the predicted per-row wire bytes
+        (8·nnz/row + indptr) undercut the densified row (width x f32) by
+        at least ``margin`` — sparse enough that the transfer and gather
+        win is robust to the density EWMA drifting. None (keep densify)
+        otherwise, and ALWAYS None until the segment is calibrated AND the
+        density term has ``min_obs`` observations: an uncalibrated model
+        changes nothing, so cold start stays bitwise-identical."""
+        seg = str(segment)
+        if not self.calibrated(seg):
+            return None
+        with self._lock:
+            rec = self._nnz.get(seg)
+        if rec is None or rec[2] < self.min_obs or rec[1] <= 0:
+            return None
+        csr_row = rec[0] * 8.0 + 4.0
+        dense_row = rec[1] * 4.0
+        if csr_row < dense_row * float(margin):
+            return "csr"
+        return None
+
     # -- introspection / serialization -----------------------------------
     def host_ms_per_row(self, stage: str) -> Optional[float]:
         with self._lock:
@@ -775,6 +845,9 @@ class SegmentCostModel:
             n_analytic = len(self._analytic)
             variants = {f"{s}:{b}:{v}": {"ms": round(rec[0], 6), "n": rec[1]}
                         for (s, b, v), rec in sorted(self._variant.items())}
+            nnz = {s: {"nnz_per_row": round(rec[0], 4),
+                       "width": round(rec[1], 2), "n": int(rec[2])}
+                   for s, rec in sorted(self._nnz.items())}
         segs = self.segments()
         out = {"segments": segs,
                "calibrated": {s: self.calibrated(s) for s in segs},
@@ -784,6 +857,8 @@ class SegmentCostModel:
                "peak_source": self.peaks().get("peak_source")}
         if variants:  # key absent when unused: stats payload parity
             out["variant_trials"] = variants
+        if nnz:  # key absent when no sparse data seen: payload parity
+            out["nnz"] = nnz
         return out
 
     def to_dict(self) -> Dict[str, Any]:
@@ -806,6 +881,9 @@ class SegmentCostModel:
                 out["variants"] = {f"{s}\x00{b}\x00{v}": list(rec)
                                    for (s, b, v), rec in
                                    self._variant.items()}
+            if self._nnz:  # key absent when no sparse data seen
+                out["nnz"] = {s: list(rec)
+                              for s, rec in self._nnz.items()}
             return out
 
     @classmethod
@@ -833,4 +911,6 @@ class SegmentCostModel:
         for key, rec in (d.get("variants") or {}).items():
             seg, b, vid = key.rsplit("\x00", 2)
             m._variant[(seg, int(b), vid)] = [float(rec[0]), int(rec[1])]
+        for seg, rec in (d.get("nnz") or {}).items():
+            m._nnz[seg] = [float(rec[0]), float(rec[1]), int(rec[2])]
         return m

@@ -125,10 +125,25 @@ class DeviceFn:
     device_finalize_outputs: Tuple[str, ...] = ()
     finalize_stitched: Optional[Callable] = None
     finalize_tolerance: Optional[float] = None
+    # --- sparse capability (docs/sparse.md) ------------------------------
+    # sparse_cols: input columns this stage can consume as a CSR triple
+    # instead of a densified [B, F] matrix. For a capable column ``c`` the
+    # executor stages four env keys — ``{c}:indptr`` (i32 [B+1]),
+    # ``{c}:indices`` (i32 [nnz_pad]), ``{c}:values`` (f32 [nnz_pad]) and
+    # ``{c}:width`` (i32 scalar) — and calls ``sparse_fn`` in place of
+    # ``fn``. The CSR path is opt-in per segment (the tuner's journaled
+    # ``layout`` knob); with the knob off, a capable stage still takes the
+    # densify path, so declaring the capability alone changes nothing.
+    sparse_cols: Tuple[str, ...] = ()
+    # sparse_fn(params, env): the traceable CSR body — must produce outputs
+    # bitwise-equal (or within the kernel's declared tolerance) to ``fn``
+    # over the densified equivalent of the same triple.
+    sparse_fn: Optional[Callable] = None
 
     def __post_init__(self):
         self.in_cols = tuple(self.in_cols)
         self.out_cols = tuple(self.out_cols)
+        self.sparse_cols = tuple(self.sparse_cols)
         if self.device_outputs is None:
             self.device_outputs = self.out_cols
         else:

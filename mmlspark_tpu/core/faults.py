@@ -240,13 +240,20 @@ RING_REBALANCE = "ring.rebalance"
 # unavailable object (accounted recompile, exactly like PR 13)
 STORE_PUT = "store.put"
 STORE_GET = "store.get"
+# core/fusion CSR staging, fired before a sparse column is assembled into
+# its (indptr, indices, values) wire triple: a raising plan degrades THAT
+# column to the accounted densify fallback (IngestStats.note_densify) —
+# output stays bitwise-equal to the dense path, the waste is just counted.
+# Fires on the CSR path only — densify-path parity is never perturbed.
+SPARSE_STAGE = "sparse.stage"
 
 ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
               JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE,
               WORKER_DISPATCH_HANG, WORKER_CRASH, FRONT_HEDGE,
               COMPILECACHE_LOAD, COMPILECACHE_STORE, MESH_CHIP_WEDGE,
               LIFECYCLE_SWAP, LIFECYCLE_CHECKPOINT, TUNER_KERNEL_APPLY,
-              FRONT_L2_CRASH, RING_REBALANCE, STORE_PUT, STORE_GET)
+              FRONT_L2_CRASH, RING_REBALANCE, STORE_PUT, STORE_GET,
+              SPARSE_STAGE)
 
 
 class InjectedFault(OSError):

@@ -306,10 +306,13 @@ def plan(stages: Sequence[Transformer], schema: Schema,
 # ---------------------------------------------------------------------------
 
 
-def _stack_col(col: np.ndarray, allow_sparse: bool) -> np.ndarray:
+def _stack_col(col: np.ndarray, allow_sparse: bool, stats=None) -> np.ndarray:
     """Valid-subset column -> dense [n, ...] array, preserving the wire
     dtype (uint8 pixels stay uint8); f64/i64 narrow exactly like the
-    unfused Minibatcher's stack_rows(float32)/device ingestion do."""
+    unfused Minibatcher's stack_rows(float32)/device ingestion do. A
+    densified sparse column books its waste into ``stats``
+    (``IngestStats.note_densify``): the dense bytes materialized vs the
+    CSR bytes the same rows actually hold."""
     from ..parallel.batching import densify_sparse, is_sparse_row, sparse_width
 
     if col.dtype != object:
@@ -325,6 +328,12 @@ def _stack_col(col: np.ndarray, allow_sparse: bool) -> np.ndarray:
             if width > (1 << 22):
                 raise _HostFallback(f"sparse width {width} too large")
             arr = densify_sparse(col, width, dtype=np.float32)
+            if stats is not None:
+                nnz = sum(len(np.atleast_1d(v["values"]))
+                          for v in col if v is not None)
+                # CSR bytes: f32 values + i32 indices per nnz, i32 indptr
+                nnz_bytes = nnz * 8 + (len(col) + 1) * 4
+                stats.note_densify(arr.nbytes, nnz_bytes)
         else:
             rows = [np.asarray(v) for v in col]
             shapes = {r.shape for r in rows}
@@ -341,17 +350,72 @@ def _stack_col(col: np.ndarray, allow_sparse: bool) -> np.ndarray:
 
 
 def _probe_info(col: np.ndarray) -> Dict[str, Any]:
+    """Classify one column for the runtime dtype gates. Scans EVERY
+    non-null row for sparseness — a partition whose first row is dense but
+    a later row sparse (or vice versa) must read as ``mixed`` and take the
+    clean host fallback, not mis-classify off row 0 and crash the stack."""
     from ..parallel.batching import is_sparse_row
 
     if col.dtype != object:
-        return {"dtype": col.dtype, "ndim": col.ndim - 1, "sparse": False}
-    probe = next((v for v in col if v is not None), None)
+        return {"dtype": col.dtype, "ndim": col.ndim - 1, "sparse": False,
+                "mixed": False}
+    probe = None
+    n_sparse = n_rows = 0
+    for v in col:
+        if v is None:
+            continue
+        if probe is None:
+            probe = v
+        n_rows += 1
+        if is_sparse_row(v):
+            n_sparse += 1
     if probe is None:
-        return {"dtype": None, "ndim": None, "sparse": False}
-    if is_sparse_row(probe):
-        return {"dtype": np.dtype(np.float32), "ndim": 1, "sparse": True}
+        return {"dtype": None, "ndim": None, "sparse": False, "mixed": False}
+    if n_sparse:
+        return {"dtype": np.dtype(np.float32), "ndim": 1, "sparse": True,
+                "mixed": n_sparse != n_rows}
     arr = np.asarray(probe)
-    return {"dtype": arr.dtype, "ndim": arr.ndim, "sparse": False}
+    return {"dtype": arr.dtype, "ndim": arr.ndim, "sparse": False,
+            "mixed": False}
+
+
+def _csr_from_rows(col: np.ndarray, width: int
+                   ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Sparse object column -> (indptr i32 [n+1], indices i32 [nnz],
+    values f32 [nnz]). Semantics match ``densify_sparse`` exactly so the
+    CSR path stays bitwise-equal to the densify path: indices >= width
+    drop (VW masking), duplicate indices keep the LAST value (numpy fancy
+    assignment), explicit zeros stay (they densify to the 0.0 fill), and
+    per-row indices sort ascending (the gather kernel's key order). None
+    = ineligible (a negative index — only hostile producers emit those;
+    the caller densifies instead)."""
+    indptr = np.zeros(len(col) + 1, dtype=np.int32)
+    idx_parts: List[np.ndarray] = []
+    val_parts: List[np.ndarray] = []
+    for i, v in enumerate(col):
+        if v is None:
+            indptr[i + 1] = indptr[i]
+            continue
+        idx = np.atleast_1d(np.asarray(v["indices"], dtype=np.int64))
+        vals = np.atleast_1d(np.asarray(v["values"], dtype=np.float32))
+        if idx.size and int(idx.min()) < 0:
+            return None
+        keep = idx < width
+        idx, vals = idx[keep], vals[keep]
+        order = np.argsort(idx, kind="stable")
+        idx, vals = idx[order], vals[order]
+        if idx.size > 1:
+            last = np.ones(idx.size, dtype=bool)
+            last[:-1] = idx[1:] != idx[:-1]
+            idx, vals = idx[last], vals[last]
+        idx_parts.append(idx.astype(np.int32))
+        val_parts.append(vals)
+        indptr[i + 1] = indptr[i] + idx.size
+    indices = np.concatenate(idx_parts) if idx_parts \
+        else np.zeros(0, dtype=np.int32)
+    values = np.concatenate(val_parts) if val_parts \
+        else np.zeros(0, dtype=np.float32)
+    return indptr, indices, values
 
 
 def _default_finalize(outs: Dict[str, np.ndarray], ctx: Dict) -> Dict[str, np.ndarray]:
@@ -376,7 +440,8 @@ class SegmentExecutor:
     def __init__(self, segment: Segment, cache: Optional[CompileCache] = None,
                  buckets: Optional[Tuple[int, ...]] = None,
                  cost_model=None, slot_pool=None, mega_k: int = 1,
-                 sharding=None, kernel_variants=None, stitch=None):
+                 sharding=None, kernel_variants=None, stitch=None,
+                 layout: Optional[str] = None):
         self.segment = segment
         self.cache = cache if cache is not None else compile_cache()
         self.fallbacks: List[str] = []
@@ -410,6 +475,11 @@ class SegmentExecutor:
                 kv["*"] = str(v)
         self.kernel_variants = kv
         self.stitch = {str(k): bool(v) for k, v in (stitch or {}).items()}
+        # sparse layout knob (auto-tuner via costmodel.choose_layout):
+        # "csr" stages capable sparse columns as (indptr, indices, values)
+        # triples instead of densifying; None = the densify path, byte-
+        # for-byte today's code (docs/sparse.md)
+        self.layout = str(layout) if layout else None
         # transpiled finalizers: every stage the PLAN stitched the segment
         # across, plus any stage the stitch knob names directly (a terminal
         # segment tail with no downstream to merge — the transpile alone
@@ -506,7 +576,8 @@ class SegmentExecutor:
         meta = {k: v for k, v in chained.metadata.items() if k in types}
         return DataFrame(out_parts, Schema(types, meta))
 
-    def _prep_partition(self, part: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    def _prep_partition(self, part: Dict[str, np.ndarray],
+                        stats=None) -> Dict[str, Any]:
         """Host-side prep for one partition — validity masks, per-stage
         prepare hooks, dtype/sparse/null gates, dense stacking — everything
         up to (but excluding) device dispatch. Raises _HostFallback when the
@@ -567,11 +638,32 @@ class SegmentExecutor:
                 valid[idx[keep]] = True
                 n_valid = int(valid.sum())
 
-        # runtime dtype gates
+        # runtime dtype gates. A mixed sparse/dense column (first row dense,
+        # later rows sparse or vice versa) can satisfy no stacking contract:
+        # clean host fallback instead of a mis-classified crash downstream.
         probes = {c: _probe_info(sub[c]) for c in ext}
+        mixed = sorted(c for c, p in probes.items() if p.get("mixed"))
+        if mixed:
+            raise _HostFallback(f"mixed sparse/dense rows in {mixed}")
+        csr_cols = self._csr_capable(probes)
+        # density term (costmodel.observe_nnz): fed for EVERY sparse
+        # external column — including ones about to take the reject_sparse
+        # host fallback — so choose_layout can calibrate while the layout
+        # knob is still off. Observation only; outputs are untouched.
+        if self.cost_model is not None and n_valid > 0:
+            from ..parallel.batching import sparse_width
+
+            for c in ext:
+                if probes[c]["sparse"]:
+                    nnz = sum(len(np.atleast_1d(v["values"]))
+                              for v in sub[c] if v is not None)
+                    self.cost_model.observe_nnz(
+                        seg.label, n_valid, nnz, sparse_width(sub[c]))
         for dfn, stage in zip(seg.dfns, seg.stages):
             mine = {c: probes[c] for c in dfn.in_cols if c in probes}
-            if mine and dfn.reject_sparse and any(p["sparse"] for p in mine.values()):
+            if mine and dfn.reject_sparse and any(
+                    p["sparse"] for c2, p in mine.items()
+                    if c2 not in csr_cols):
                 raise _HostFallback("sparse rows")
             if mine and dfn.accepts is not None and not dfn.accepts(mine):
                 raise _HostFallback(f"{type(stage).__name__} dtype gate")
@@ -579,13 +671,23 @@ class SegmentExecutor:
         readback = seg.readback_plan(self._transpiled)
         state: Dict[str, Any] = {
             "part": part, "sub": sub, "ctx": ctx, "valid": valid, "n": n,
-            "n_valid": n_valid, "ext": ext, "readback": readback,
-            "keys": [k for k, _ in readback]}
+            "n_valid": n_valid, "ext": ext, "staged_cols": list(ext),
+            "readback": readback, "keys": [k for k, _ in readback]}
         if n_valid > 0:
             allow_sparse = all(not d.reject_sparse for d in seg.dfns)
             dense: Dict[str, np.ndarray] = {}
             deposit: Dict[str, List[np.ndarray]] = {}
+            csr: Dict[str, Tuple] = {}
             for c in ext:
+                if c in csr_cols:
+                    triple = self._stage_csr(sub[c], stats)
+                    if triple is not None:
+                        csr[c] = triple
+                        continue
+                    # ineligible / injected sparse.stage fault: accounted
+                    # densify fallback — bitwise-equal to the dense path
+                    dense[c] = _stack_col(sub[c], True, stats=stats)
+                    continue
                 rows = self._deposit_rows(sub[c]) \
                     if self.slot_pool is not None else None
                 if rows is not None:
@@ -594,10 +696,67 @@ class SegmentExecutor:
                     # one host copy); everything else stacks here as before
                     deposit[c] = rows
                 else:
-                    dense[c] = _stack_col(sub[c], allow_sparse)
+                    dense[c] = _stack_col(sub[c], allow_sparse, stats=stats)
             state["dense"] = dense
             state["deposit"] = deposit
+            if csr:
+                state["csr"] = csr
+                staged: List[str] = []
+                for c in ext:
+                    if c in csr:
+                        staged += [f"{c}:indptr", f"{c}:indices",
+                                   f"{c}:values", f"{c}:width"]
+                    else:
+                        staged.append(c)
+                state["staged_cols"] = staged
         return state
+
+    def _csr_capable(self, probes: Dict[str, Dict[str, Any]]) -> set:
+        """External columns eligible for CSR staging: the layout knob says
+        "csr" for this segment, the column's rows are (uniformly) sparse,
+        and EVERY consuming stage declares the capability
+        (``DeviceFn.sparse_cols`` + ``sparse_fn``). The CSR x sharding
+        combination is explicitly gated off — sharded segments keep the
+        densify path (shardplan's row-split CSR spec is priced host-side
+        only for now, docs/sparse.md)."""
+        if self.layout != "csr" or self.sharding is not None:
+            return set()
+        out = set()
+        for c, p in probes.items():
+            if not p["sparse"] or p.get("mixed"):
+                continue
+            consumers = [d for d in self.segment.dfns if c in d.in_cols]
+            if consumers and all(c in d.sparse_cols
+                                 and d.sparse_fn is not None
+                                 for d in consumers):
+                out.add(c)
+        return out
+
+    def _stage_csr(self, col: np.ndarray, stats=None) -> Optional[Tuple]:
+        """One sparse column -> (indptr, indices, values, width), or None
+        to take the accounted densify fallback (zero-width column, an i32
+        composite-key overflow, a negative index, or an injected
+        ``sparse.stage`` fault)."""
+        from ..parallel.batching import sparse_width
+
+        from . import faults
+
+        width = sparse_width(col)
+        # the gather kernel's composite keys are row*width + index in i32
+        if width <= 0 or self.segment.batch_size() * width >= (1 << 31):
+            return None
+        try:
+            faults.fire(faults.SPARSE_STAGE)
+        except faults.InjectedFault:
+            return None
+        triple = _csr_from_rows(col, width)
+        if triple is None:
+            return None
+        indptr, indices, values = triple
+        if stats is not None:
+            stats.note_csr(int(indptr[-1]) * 8 + indptr.nbytes,
+                           len(col) * width * 4)
+        return indptr, indices, values, width
 
     @staticmethod
     def _deposit_rows(col: np.ndarray) -> Optional[List[np.ndarray]]:
@@ -636,6 +795,7 @@ class SegmentExecutor:
         batch_size = self.segment.batch_size()
         dense, ext = state["dense"], state["ext"]
         deposit = state.get("deposit") or {}
+        csr = state.get("csr") or {}
         n_valid = state["n_valid"]
         # sharded over the mesh's data axis: every padded batch must split
         # evenly across the shards, so targets round UP to a shard multiple
@@ -650,6 +810,29 @@ class SegmentExecutor:
                 target = -(-target // shards) * shards
             arrays = {c: pad_batch(dense[c][start:stop], target)
                       for c in dense}
+            for c, (indptr, indices, values, width) in csr.items():
+                # CSR window slice: rebase the indptr to this window and pad
+                # row-wise by REPEATING the last offset (pad rows are empty),
+                # nnz-wise to a power-of-two bucket with zeros. Padded nnz
+                # entries resolve to row `target` in the gather kernel's
+                # composite-key space (key >= target*width), past every real
+                # query — they can never alias a live cell. docs/sparse.md.
+                base = int(indptr[start])
+                nnz_b = int(indptr[stop]) - base
+                ip = (indptr[start:stop + 1] - base).astype(np.int32)
+                if m < target:
+                    ip = np.pad(ip, (0, target - m), mode="edge")
+                nnz_pad = next_bucket(max(1, nnz_b))
+                idx = np.pad(np.asarray(indices[base:base + nnz_b],
+                                        dtype=np.int32),
+                             (0, nnz_pad - nnz_b))
+                val = np.pad(np.asarray(values[base:base + nnz_b],
+                                        dtype=np.float32),
+                             (0, nnz_pad - nnz_b))
+                arrays[f"{c}:indptr"] = ip
+                arrays[f"{c}:indices"] = idx
+                arrays[f"{c}:values"] = val
+                arrays[f"{c}:width"] = np.asarray(width, dtype=np.int32)
             lease = None
             if deposit:
                 spec = {c: ((target,) + rows[0].shape, rows[0].dtype)
@@ -723,7 +906,9 @@ class SegmentExecutor:
         """Dispatch closure: staged batch -> (device outputs, num_valid).
         Non-blocking (jax dispatch is async); executables come from the
         shared CompileCache keyed by (segment, shape signature)."""
-        seg, ext, keys = self.segment, state["ext"], state["keys"]
+        seg, keys = self.segment, state["keys"]
+        staged_cols = state.get("staged_cols") or state["ext"]
+        csr_cols = frozenset(state.get("csr") or ())
         sh = self.sharding
         # a sharded executable is a DIFFERENT program (GSPMD-partitioned,
         # collectives inserted): key it apart from the single-device one,
@@ -734,10 +919,17 @@ class SegmentExecutor:
         key_tail = key_tail + self._stitch_tail
         shape_pre = (sh.shape_prefix() if sh is not None else "") + \
             self._stitch_pre
+        if csr_cols:
+            # a CSR-staged program traces sparse_fn bodies over the wire
+            # triple: key it apart, and prefix the shape key so
+            # bucket_of_shape skips its cost records (the nnz bucket is
+            # data- not batch-shaped)
+            key_tail = key_tail + (("layout", "csr"),)
+            shape_pre = "layout=csr;" + shape_pre
 
         def step(staged):
             x, m = staged
-            sig = self._sig_of(x, ext)
+            sig = self._sig_of(x, staged_cols)
             # a kernel variant is a DIFFERENT compiled program for the same
             # (segment, signature): key it apart, and decorate the shape
             # key (variant=<id>;) so bucket_of_shape skips its cost record
@@ -746,7 +938,8 @@ class SegmentExecutor:
             pre = (f"variant={vid};" if vid else "") + shape_pre
             compiled = self.cache.get(
                 (seg.key, sig) + tail,
-                lambda: self._build(params_dev, x, keys, variant=vid),
+                lambda: self._build(params_dev, x, keys, variant=vid,
+                                    csr_cols=csr_cols),
                 label=seg.label, shape=pre + self._shape_key_of(sig))
             with profiling.annotate(f"fused:{seg.label}"):
                 return compiled(params_dev, x), m
@@ -759,26 +952,31 @@ class SegmentExecutor:
         The shape key is prefixed so the cost model's bucket parser skips
         mega records (their flops are K batches' worth — folding them into
         a single-batch bucket would skew the analytic roofline)."""
-        seg, ext, keys = self.segment, state["ext"], state["keys"]
+        seg, keys = self.segment, state["keys"]
+        staged_cols = state.get("staged_cols") or state["ext"]
+        csr_cols = frozenset(state.get("csr") or ())
         sh = self.sharding
         key_tail = (sh.cache_key(),) if sh is not None else ()
         key_tail = key_tail + self._stitch_tail
         shape_pre = (sh.shape_prefix() if sh is not None else "") + \
             self._stitch_pre
+        if csr_cols:
+            key_tail = key_tail + (("layout", "csr"),)
+            shape_pre = "layout=csr;" + shape_pre
 
         def mega(group):
             xs = [x for (x, _m), _t in group]
-            sig = self._sig_of(xs[0], ext)
+            sig = self._sig_of(xs[0], staged_cols)
             vid = self._variant_for(sig)
             tail = key_tail + ((("variant", vid),) if vid else ())
             pre = (f"variant={vid};" if vid else "") + shape_pre
             compiled = self.cache.get(
                 (seg.key, sig, ("mega", k)) + tail,
                 lambda: self._build_mega(params_dev, xs[0], keys, k,
-                                         variant=vid),
+                                         variant=vid, csr_cols=csr_cols),
                 label=seg.label,
                 shape=f"{pre}mega{k};{self._shape_key_of(sig)}")
-            cols_seq = tuple({c: x[c] for c in ext} for x in xs)
+            cols_seq = tuple({c: x[c] for c in staged_cols} for x in xs)
             with profiling.annotate(f"fused:{seg.label}:mega{k}"):
                 return compiled(params_dev, cols_seq)
 
@@ -807,7 +1005,7 @@ class SegmentExecutor:
                        stats) -> Dict[str, np.ndarray]:
         from ..parallel.ingest import TransferRing
 
-        state = self._prep_partition(part)
+        state = self._prep_partition(part, stats)
         collected: Dict[str, List[np.ndarray]] = {k: []
                                                   for k in state["keys"]}
         if state["n_valid"] > 0:
@@ -851,7 +1049,7 @@ class SegmentExecutor:
         pendings: List[Tuple[str, Any, Any]] = []
         for part in df.partitions:
             try:
-                state = self._prep_partition(dict(part))
+                state = self._prep_partition(dict(part), stats)
                 handles = []
                 if state["n_valid"] > 0:
                     step = self._make_step(params_dev, state)
@@ -931,7 +1129,7 @@ class SegmentExecutor:
         is split evenly across the K timings (the amortization the
         bottleneck attribution shows), with ``timing.mega_k`` tagging the
         share so the cost model can de-amortize it."""
-        ext = state["ext"]
+        ext = state.get("staged_cols") or state["ext"]
         mega = self._make_mega_step(params_dev, state, k)
 
         def flush(group):
@@ -1015,10 +1213,14 @@ class SegmentExecutor:
         return out_part
 
     def _build(self, params_dev, x: Dict[str, Any], keys: List[str],
-               variant: Optional[str] = None):
+               variant: Optional[str] = None,
+               csr_cols: frozenset = frozenset()):
         """AOT-compile the fused program for one shape signature. A kernel
         ``variant`` id is activated around the trace (core/kernels.py) so
-        variant-aware call sites resolve it as a static parameter."""
+        variant-aware call sites resolve it as a static parameter. A stage
+        whose input column was CSR-staged (``csr_cols``) traces its
+        ``sparse_fn`` body over the wire-triple env keys instead of
+        ``fn`` — the only point where the two bodies diverge."""
         import jax
 
         from . import kernels as _kernels
@@ -1029,7 +1231,10 @@ class SegmentExecutor:
         def fused(params_tuple, cols):
             env = dict(cols)
             for i, (dfn, p) in enumerate(zip(seg.dfns, params_tuple)):
-                env.update(dfn.fn(p, env))
+                if dfn.sparse_fn is not None and csr_cols & set(dfn.in_cols):
+                    env.update(dfn.sparse_fn(p, env))
+                else:
+                    env.update(dfn.fn(p, env))
                 if i in transpiled:
                     env.update(dfn.device_finalize(p, env))
             return tuple(env[k] for k in keys)
@@ -1064,7 +1269,8 @@ class SegmentExecutor:
                 return call
 
     def _build_mega(self, params_dev, x: Dict[str, Any], keys: List[str],
-                    k: int, variant: Optional[str] = None):
+                    k: int, variant: Optional[str] = None,
+                    csr_cols: frozenset = frozenset()):
         """AOT-compile the K-step mega program: K replicas of ``_build``'s
         per-batch fused body, traced over a K-tuple of same-shape input
         dicts in one callable — one Python dispatch executes K queued
@@ -1083,7 +1289,11 @@ class SegmentExecutor:
             for cols in cols_seq:
                 env = dict(cols)
                 for i, (dfn, p) in enumerate(zip(seg.dfns, params_tuple)):
-                    env.update(dfn.fn(p, env))
+                    if dfn.sparse_fn is not None \
+                            and csr_cols & set(dfn.in_cols):
+                        env.update(dfn.sparse_fn(p, env))
+                    else:
+                        env.update(dfn.fn(p, env))
                     if i in transpiled:
                         env.update(dfn.device_finalize(p, env))
                 outs.append(tuple(env[kk] for kk in keys))
@@ -1158,6 +1368,10 @@ class FusedPipelineModel(PipelineModel):
         self._shard_mesh = None
         self._sharding_overrides: Dict[str, str] = {}
         self._seg_sharding: Dict[str, Any] = {}
+        # sparse layout knob (docs/sparse.md): per-segment-label "csr"
+        # stages capable sparse columns as wire triples (tuner knob via
+        # costmodel.choose_layout). Default OFF — densify, bitwise today.
+        self._layout_overrides: Dict[str, str] = {}
         # pre-allocated H2D staging (parallel/ingest.py SlotPool), shared
         # across segments/executors; ``slot_staging=False`` pins the legacy
         # allocating path (the bench A/B arm)
@@ -1173,7 +1387,8 @@ class FusedPipelineModel(PipelineModel):
                    mega_k: Optional[Dict[str, int]] = None,
                    sharding: Optional[Dict[str, str]] = None,
                    kernel_variants: Optional[Dict[str, Dict[Any, str]]] = None,
-                   stitch: Optional[Dict[str, bool]] = None) -> None:
+                   stitch: Optional[Dict[str, bool]] = None,
+                   layout: Optional[Dict[str, str]] = None) -> None:
         """Apply tuned knobs (Tuner.apply): per-segment-label bucket sets,
         fuse-vs-demote overrides, per-segment K-step mega-dispatch factors,
         per-segment partition-spec names (sharding over the ``set_mesh``
@@ -1201,6 +1416,9 @@ class FusedPipelineModel(PipelineModel):
         if sharding is not None:
             self._sharding_overrides = {str(k): str(v)
                                         for k, v in sharding.items() if v}
+        if layout is not None:
+            self._layout_overrides = {str(k): str(v)
+                                      for k, v in layout.items() if v}
         if cost_model is not None:
             self._cost_model = cost_model
         self._plans.clear()
@@ -1303,7 +1521,8 @@ class FusedPipelineModel(PipelineModel):
             mega_k=self._mega_k_overrides.get(node.label, 1),
             sharding=self._sharding_for(node),
             kernel_variants=self._variant_overrides.get(node.label),
-            stitch=self._stitch_overrides or None)
+            stitch=self._stitch_overrides or None,
+            layout=self._layout_overrides.get(node.label))
 
     def _host_node(self, node: HostStage, df: DataFrame) -> DataFrame:
         """Run one host plan node, feeding its wall time to the cost model
@@ -1409,7 +1628,8 @@ class FusedPipelineModel(PipelineModel):
             roofline = attribute_segments(
                 per_segment, costs,
                 sharding=self._seg_sharding or None,
-                cost_model=self._cost_model)
+                cost_model=self._cost_model,
+                layout=self._layout_overrides or None)
         except Exception:  # noqa: BLE001 — attribution must not break stats
             roofline = {}
         out = {
@@ -1423,7 +1643,8 @@ class FusedPipelineModel(PipelineModel):
         }
         if (self._bucket_overrides or self._fuse_overrides
                 or self._mega_k_overrides or self._sharding_overrides
-                or self._variant_overrides or self._stitch_overrides):
+                or self._variant_overrides or self._stitch_overrides
+                or self._layout_overrides):
             out["tuning"] = {
                 "buckets": {k: list(v)
                             for k, v in self._bucket_overrides.items()},
@@ -1438,6 +1659,8 @@ class FusedPipelineModel(PipelineModel):
                     for label, kv in self._variant_overrides.items()}
             if self._stitch_overrides:
                 out["tuning"]["stitch"] = dict(self._stitch_overrides)
+            if self._layout_overrides:
+                out["tuning"]["layout"] = dict(self._layout_overrides)
         stitched: Dict[str, List[str]] = {}
         for n in nodes:
             if not isinstance(n, Segment):

@@ -177,3 +177,16 @@ register(KernelVariant(id="forest.gather", kernel="forest",
                        params={"impl": "gather"}, tolerance=None))
 register(KernelVariant(id="forest.gemm", kernel="forest",
                        params={"impl": "gemm"}, tolerance=None))
+
+# Sparse/CSR kernels (gbdt/pallas_sparse.py, docs/sparse.md):
+#   hist.csr — the sparse engine's flat-ragged-bin histogram as a one-hot
+#   MXU contraction over nnz chunks; chunk order changes the f32 summation
+#   order versus the prefix-sum path, so it shares the histogram tolerance.
+#   forest.csr — forest traversal over the CSR-gathered used-feature
+#   matrix, with the gather itself on the MXU; every output cell of the
+#   gather receives at most one nonzero, so the variant is exact-compute.
+register(KernelVariant(id="hist.csr", kernel="hist",
+                       params={"layout": "csr"}, tolerance=_HIST_TOL))
+register(KernelVariant(id="forest.csr", kernel="forest",
+                       params={"impl": "gather", "csr_gather": "pallas"},
+                       tolerance=None))

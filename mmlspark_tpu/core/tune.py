@@ -77,10 +77,15 @@ class KnobSet:
     #: per-stage-class-name cross-segment stitch flags (core/fusion.py
     #: plan(); absent name = never merge across that boundary)
     stitch: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    #: per-segment-label sparse staging layouts ("csr" stages capable
+    #: sparse columns as wire triples, docs/sparse.md; absent label = the
+    #: densify path, byte-for-byte the untuned behaviour)
+    layout: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def is_default(self) -> bool:
         return not (self.buckets or self.fuse or self.mega_k or
                     self.sharding or self.kernel_variants or self.stitch or
+                    self.layout or
                     self.window_seed_ms is not None or
                     self.inflight is not None or self.replicas is not None)
 
@@ -101,6 +106,8 @@ class KnobSet:
                 for label, kv in self.kernel_variants.items()}
         if self.stitch:
             out["stitch"] = {k: bool(v) for k, v in self.stitch.items()}
+        if self.layout:
+            out["layout"] = {k: str(v) for k, v in self.layout.items()}
         for k in ("window_seed_ms", "inflight", "replicas"):
             v = getattr(self, k)
             if v is not None:
@@ -122,6 +129,8 @@ class KnobSet:
                 for label, kv in (d.get("kernel_variants") or {}).items()},
             stitch={k: bool(v)
                     for k, v in (d.get("stitch") or {}).items()},
+            layout={k: str(v)
+                    for k, v in (d.get("layout") or {}).items()},
             window_seed_ms=d.get("window_seed_ms"),
             inflight=d.get("inflight"), replicas=d.get("replicas"))
 
@@ -254,6 +263,9 @@ class Tuner:
             variants = self._variants_for(label)
             if variants:
                 knobs.kernel_variants[label] = variants
+            lay = self._layout_for(label)
+            if lay:
+                knobs.layout[label] = lay
             pred = self.model.predict(label, batch=cap)
             if pred is not None:
                 trailing_ms = pred["ms"]
@@ -349,6 +361,19 @@ class Tuner:
             return {}
         return out
 
+    def _layout_for(self, label: str) -> Optional[str]:
+        """Cost-model staging-layout choice for one segment ("csr" stages
+        sparse columns as wire triples, docs/sparse.md). None — the
+        densify default — from a model without nnz support, an
+        uncalibrated nnz term, or bytes that do not favour CSR."""
+        chooser = getattr(self.model, "choose_layout", None)
+        if not callable(chooser):
+            return None
+        try:
+            return chooser(label)
+        except Exception:  # noqa: BLE001 — proposal must never raise out
+            return None
+
     def _stitch_proposals(self) -> Dict[str, bool]:
         """Stitch flags for the plan's adjacent (Segment, Segment)
         boundaries split by a TERMINAL tail stage that carries a transpiled
@@ -424,23 +449,35 @@ class Tuner:
             fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse,
                              mega_k=knobs.mega_k, sharding=knobs.sharding,
                              kernel_variants=knobs.kernel_variants,
-                             stitch=knobs.stitch)
+                             stitch=knobs.stitch, layout=knobs.layout)
         except TypeError:
-            try:  # older fused models without the compiler-search knobs
+            try:  # older fused models without the staging-layout knob
                 fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse,
                                  mega_k=knobs.mega_k,
-                                 sharding=knobs.sharding)
+                                 sharding=knobs.sharding,
+                                 kernel_variants=knobs.kernel_variants,
+                                 stitch=knobs.stitch)
             except TypeError:
-                try:  # ... without the sharding knob
-                    fused.set_tuning(buckets=knobs.buckets,
-                                     fuse=knobs.fuse, mega_k=knobs.mega_k)
-                except TypeError:  # ... or without the K knob either
-                    fused.set_tuning(buckets=knobs.buckets,
-                                     fuse=knobs.fuse)
+                Tuner._push_legacy(fused, knobs)
+
+    @staticmethod
+    def _push_legacy(fused, knobs: KnobSet) -> None:
+        try:  # older fused models without the compiler-search knobs
+            fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse,
+                             mega_k=knobs.mega_k,
+                             sharding=knobs.sharding)
+        except TypeError:
+            try:  # ... without the sharding knob
+                fused.set_tuning(buckets=knobs.buckets,
+                                 fuse=knobs.fuse, mega_k=knobs.mega_k)
+            except TypeError:  # ... or without the K knob either
+                fused.set_tuning(buckets=knobs.buckets,
+                                 fuse=knobs.fuse)
 
     def apply(self, knobs: KnobSet, reason: str = "apply") -> None:
         """Push a KnobSet into the wired layers, remembering the previous
-        set for one-step rollback. A kernel-variant/stitch swap that fails
+        set for one-step rollback. A kernel-variant/stitch/layout swap
+        that fails
         MID-SWAP (the ``tuner.kernel_apply`` chaos seam, or any push
         failure) restores the incumbent knob set — replies stay bitwise
         those of the incumbent variant."""
@@ -453,7 +490,8 @@ class Tuner:
             # compile spike) before judging the new knobs
             self._e2e_skip = 2
         variant_change = knobs.kernel_variants != prev.kernel_variants
-        swap_change = variant_change or knobs.stitch != prev.stitch
+        swap_change = (variant_change or knobs.stitch != prev.stitch
+                       or knobs.layout != prev.layout)
         fused = self.fused
         try:
             if swap_change:

@@ -370,6 +370,14 @@ def _flat_histogram(dev, g_bs, h_bs, node_mask_rows):
     if "nnz_valid" in dev:
         m = m * dev["nnz_valid"]
     data = jnp.stack([g_bs * m, h_bs * m, m], axis=0)   # [3, nnz]
+    # hist.csr kernel variant (core/kernels.py): the same sums as a one-hot
+    # MXU contraction over nnz chunks (gbdt/pallas_sparse.py). Resolved at
+    # trace time; None = the default prefix-sum path, byte-for-byte.
+    from .pallas_sparse import flat_hist_dispatch
+
+    hist_p = flat_hist_dispatch(dev, data)
+    if hist_p is not None:
+        return hist_p
     cs, cs_i = _prefix_sum(data, int_channel=2)
     hist = (jnp.take(cs, dev["bin_end"], axis=1)
             - jnp.take(cs, dev["bin_start"], axis=1))   # [3, TB]

@@ -10,7 +10,7 @@ machinery has no equivalent here: SPMD + psum replaces it (histogram.py docstrin
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -507,7 +507,44 @@ class _LightGBMModelBase(Model, HasFeaturesCol):
                 raise FusionUnsupported(f"features must be [N, F], got {X.shape}")
             return {raw_key: f(X.astype(jnp.float32))}
 
-        return feats, raw_key, fn
+        # CSR capability (docs/sparse.md): when the executor's layout knob
+        # stages the features column as a wire triple, this body replaces
+        # the [N, width] densify with a [N, U] gather of the forest's used
+        # feature columns and traverses a position-remapped ensemble —
+        # bitwise-equal raw scores to fn over the densified matrix (the
+        # gather replicates take_along_axis's out-of-range clamp, and leaf
+        # markers / GEMM pad slots stay inert under the remap).
+        cell: Dict[str, Any] = {}
+
+        def sparse_fn(params, env):
+            from ..core import kernels as _kernels
+
+            from . import pallas_sparse
+
+            if ens.cat_vals is not None:
+                # categorical SET membership reads raw category values the
+                # used-feature compaction preserves, but the knob-off
+                # sparse path (predict_csr) rejects categorical models —
+                # keep both paths aligned
+                raise FusionUnsupported("categorical splits need dense rows")
+            if "remap" not in cell:
+                used = pallas_sparse.used_features(ens)
+                cell["remap"] = (used,
+                                 pallas_sparse.remap_ensemble(ens, used))
+            used, rens = cell["remap"]
+            var = _kernels.active("forest")
+            f = (rens.device_forward(var.params) if var is not None
+                 else rens.device_forward())
+            if f is None:
+                raise FusionUnsupported("forest has no device path")
+            x_used = pallas_sparse.csr_gather(
+                env[f"{feats}:indptr"], env[f"{feats}:indices"],
+                env[f"{feats}:values"], env[f"{feats}:width"], used,
+                pallas=(var is not None
+                        and var.params.get("csr_gather") == "pallas"))
+            return {raw_key: f(x_used)}
+
+        return feats, raw_key, fn, sparse_fn
 
     def _score_device_fn(self, finalize, extra_out_cols, **stitch_caps):
         """Build the terminal DeviceFn shared by the model subclasses:
@@ -520,15 +557,20 @@ class _LightGBMModelBase(Model, HasFeaturesCol):
         base = self._device_scores()
         if base is None:
             return None
-        feats, raw_key, fn = base
+        feats, raw_key, fn, sparse_fn = base
         return DeviceFn(
             key=(type(self).__name__, self.uid, feats),
             in_cols=(feats,), out_cols=tuple(extra_out_cols), fn=fn,
             device_outputs=(raw_key,), finalize=finalize,
             **stitch_caps,
             # nulls/sparse rows take the unfused path (CSR predict / the
-            # host error), identically to the per-stage chain
+            # host error), identically to the per-stage chain — UNLESS the
+            # executor's layout knob stages the features column as a CSR
+            # wire triple, which this capability pair opts into
+            # (docs/sparse.md; reject_sparse stays True for every other
+            # sparse shape, so the knob-off path is byte-for-byte)
             null_policy="fallback", reject_sparse=True,
+            sparse_cols=(feats,), sparse_fn=sparse_fn,
             terminal=True, heavy=True,
             # pod-scale planner declaration (parallel/shardplan.py): the
             # [N, F] features matrix may shard its feature dim over the

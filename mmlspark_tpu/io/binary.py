@@ -367,3 +367,123 @@ def deposit_frame(buf: Union[bytes, bytearray, memoryview],
         res[name] = dst
         off += plen
     return res
+
+
+# ---------------------------------------------------------------------------
+# CSR columns on the wire (docs/sparse.md)
+# ---------------------------------------------------------------------------
+
+#: reserved sub-column suffixes a CSR triple ships under; a frame column
+#: named ``{c}:indptr`` declares sparse column ``c`` and requires its three
+#: siblings (``:width`` rides along so decode never guesses the feature
+#: count from the data)
+CSR_SUFFIXES = (":indptr", ":indices", ":values", ":width")
+
+
+def encode_csr_columns(name: str, indptr: np.ndarray, indices: np.ndarray,
+                       values: np.ndarray, width: int
+                       ) -> Dict[str, np.ndarray]:
+    """One host CSR column -> the four wire sub-columns ``encode_frame``
+    ships (i32 indptr / i32 indices / f32 values / 0-d i32 width). The
+    triple is validated before encoding — a malformed CSR never leaves the
+    encoder, so every reject lives in one place (``validate_csr_triple``)."""
+    cols = {
+        f"{name}:indptr": np.ascontiguousarray(indptr, dtype=np.int32),
+        f"{name}:indices": np.ascontiguousarray(indices, dtype=np.int32),
+        f"{name}:values": np.ascontiguousarray(values, dtype=np.float32),
+        f"{name}:width": np.asarray(int(width), dtype=np.int32),
+    }
+    validate_csr_triple(name, cols[f"{name}:indptr"],
+                        cols[f"{name}:indices"], cols[f"{name}:values"],
+                        int(width))
+    return cols
+
+
+def validate_csr_triple(name: str, indptr: np.ndarray, indices: np.ndarray,
+                        values: np.ndarray, width: int,
+                        rows: Optional[int] = None) -> None:
+    """Reject a hostile CSR triple with ``FrameError`` (all-or-nothing:
+    callers validate EVERY declared triple before materializing any).
+    Checked: rank-1 i32 indptr anchored at 0, non-decreasing, closing
+    exactly on len(indices) == len(values); every index in [0, width);
+    positive width; the row count when the caller knows it."""
+    if indptr.ndim != 1 or indices.ndim != 1 or values.ndim != 1:
+        raise FrameError(f"sparse column {name!r}: CSR parts must be rank-1")
+    if len(indptr) < 1:
+        raise FrameError(f"sparse column {name!r}: empty indptr")
+    if rows is not None and len(indptr) != int(rows) + 1:
+        raise FrameError(
+            f"sparse column {name!r}: indptr rows {len(indptr) - 1} != "
+            f"frame rows {rows}")
+    if int(width) <= 0:
+        raise FrameError(f"sparse column {name!r}: width must be positive")
+    ip = np.asarray(indptr, dtype=np.int64)
+    if ip[0] != 0:
+        raise FrameError(f"sparse column {name!r}: indptr[0] != 0")
+    if len(ip) > 1 and np.any(np.diff(ip) < 0):
+        raise FrameError(f"sparse column {name!r}: non-monotone indptr")
+    if int(ip[-1]) != len(indices) or len(indices) != len(values):
+        raise FrameError(
+            f"sparse column {name!r}: indptr[-1] {int(ip[-1])} != nnz "
+            f"{len(indices)}/{len(values)}")
+    if len(indices) and (int(np.min(indices)) < 0
+                         or int(np.max(indices)) >= int(width)):
+        raise FrameError(
+            f"sparse column {name!r}: index out of [0, {int(width)})")
+
+
+def decode_csr_columns(columns: Dict[str, np.ndarray]
+                       ) -> Dict[str, np.ndarray]:
+    """Decoded frame columns -> ingest rows, materializing each declared
+    CSR group as one object column of per-row ``{"indices", "values",
+    "size"}`` dicts — the sparse-row form the whole host stack consumes
+    (parallel/ingest.py, gbdt/sparse.py ``rows_to_csr``).
+
+    All-or-nothing, like ``deposit_frame``: EVERY declared triple is
+    validated (complete sibling set, ``validate_csr_triple``, equal row
+    counts across groups and against any dense column) before the first
+    row dict is built, so a hostile triple raises ``FrameError`` with
+    nothing materialized. Dense columns pass through untouched; a frame
+    with no ``:indptr`` columns returns byte-identical input."""
+    bases = [c[:-len(":indptr")] for c in columns if c.endswith(":indptr")]
+    if not bases:
+        return columns
+    rows: Optional[int] = None
+    for c, v in columns.items():
+        if any(c.endswith(s) for s in CSR_SUFFIXES):
+            continue
+        n = len(v) if np.ndim(v) else None
+        if n is not None:
+            if rows is not None and n != rows:
+                raise FrameError("dense columns disagree on row count")
+            rows = n
+    triples: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray, int]] = {}
+    for base in sorted(bases):
+        parts = {}
+        for suffix in CSR_SUFFIXES:
+            part = columns.get(base + suffix)
+            if part is None:
+                raise FrameError(
+                    f"sparse column {base!r}: missing {suffix} sibling")
+            parts[suffix] = part
+        if parts[":width"].ndim != 0:
+            raise FrameError(f"sparse column {base!r}: width must be 0-d")
+        width = int(parts[":width"])
+        validate_csr_triple(base, parts[":indptr"], parts[":indices"],
+                            parts[":values"], width, rows=rows)
+        if rows is None:
+            rows = len(parts[":indptr"]) - 1
+        triples[base] = (np.asarray(parts[":indptr"], dtype=np.int64),
+                         parts[":indices"], parts[":values"], width)
+    out: Dict[str, np.ndarray] = {
+        c: v for c, v in columns.items()
+        if not any(c.endswith(s) for s in CSR_SUFFIXES)}
+    for base, (ip, idx, val, width) in triples.items():
+        col = np.empty(rows or 0, dtype=object)
+        for i in range(rows or 0):
+            lo, hi = int(ip[i]), int(ip[i + 1])
+            col[i] = {"indices": np.asarray(idx[lo:hi], dtype=np.int64),
+                      "values": np.asarray(val[lo:hi], dtype=np.float64),
+                      "size": width}
+        out[base] = col
+    return out

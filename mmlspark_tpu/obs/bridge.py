@@ -114,7 +114,20 @@ def _ingest_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
                ("mmlspark_ingest_slot_overlap_ratio", "gauge",
                 "slot_overlap_ratio",
                 "fraction of slot H2D time overlapped with the next "
-                "slot's fill (double-buffered staging)"))
+                "slot's fill (double-buffered staging)"),
+               ("mmlspark_ingest_densified_bytes_total", "counter",
+                "densified_bytes",
+                "dense bytes materialized densifying sparse columns"),
+               ("mmlspark_ingest_densify_ratio", "gauge",
+                "densify_ratio",
+                "densified bytes per CSR byte the same rows hold "
+                "(layout-knob headroom; absent with no sparse data)"),
+               ("mmlspark_ingest_csr_batches_total", "counter",
+                "csr_batches",
+                "batches staged as CSR triples without densifying"),
+               ("mmlspark_ingest_csr_bytes_total", "counter",
+                "csr_nnz_bytes",
+                "CSR triple bytes staged host->device"))
     for mname, mtype, key, help in scalars:
         f = _num(summary.get(key))
         if f is not None:

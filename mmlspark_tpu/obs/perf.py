@@ -195,7 +195,9 @@ def attribute_segments(per_segment: Dict[str, Dict[str, Any]],
                        costs: Dict[str, Dict[str, Dict[str, Any]]],
                        peaks: Optional[Dict[str, Any]] = None,
                        sharding: Optional[Dict[str, Dict[str, Any]]] = None,
-                       cost_model=None) -> Dict[str, Dict[str, Any]]:
+                       cost_model=None,
+                       layout: Optional[Dict[str, str]] = None
+                       ) -> Dict[str, Dict[str, Any]]:
     """Join per-segment ingest decompositions with per-(segment, shape)
     XLA costs into the roofline report.
 
@@ -212,9 +214,18 @@ def attribute_segments(per_segment: Dict[str, Dict[str, Any]],
     when ``cost_model`` has calibrated collective probes — the measured
     per-batch collective time is attributed (``collective_ms_per_batch``).
     With ``sharding=None`` the report is byte-identical to the unsharded
-    one."""
+    one.
+
+    ``layout`` ({label: "csr"}, the tuned staging-layout knob) marks
+    segments running CSR staging: the record carries ``layout``, and —
+    when ``cost_model`` has a calibrated nnz term — the bandwidth side of
+    the roofline bound uses the fitted nnz bytes (bytes ≈ f(nnz), not
+    N·F: the whole point of staging sparse) as
+    ``nnz_bytes_per_batch``. With ``layout=None`` the report is
+    byte-identical to the dense one."""
     peaks = peaks if peaks is not None else device_peaks()
     sharding = sharding or {}
+    layout = layout or {}
     out: Dict[str, Dict[str, Any]] = {}
     for label, s in per_segment.items():
         n = int(s.get("n_batches") or 0)
@@ -229,6 +240,9 @@ def attribute_segments(per_segment: Dict[str, Dict[str, Any]],
         if shard:
             rec["spec"] = shard.get("spec")
             rec["shards"] = int(shard.get("shards", 1) or 1)
+        lay = layout.get(label)
+        if lay:
+            rec["layout"] = str(lay)
         # dominant bottleneck from the measured stage decomposition alone
         shares: Dict[str, float] = {}
         for key, bn in _BOTTLENECK_OF:
@@ -255,12 +269,27 @@ def attribute_segments(per_segment: Dict[str, Dict[str, Any]],
             rec["bytes_per_batch"] = round(nbytes, 1)
         if peak_mem is not None:
             rec["peak_memory_bytes"] = round(peak_mem, 1)
+        # a CSR-staged segment's bandwidth bound comes from the fitted
+        # nnz bytes, not the XLA dense-buffer report: the staged payload
+        # IS f(nnz), so pricing it as N·F would overstate the bound
+        nnz_bytes = None
+        if lay == "csr" and cost_model is not None:
+            nnz_fn = getattr(cost_model, "nnz_bytes", None)
+            rows = _num_or_none(s.get("rows"))
+            if callable(nnz_fn) and rows:
+                try:
+                    nnz_bytes = _num_or_none(nnz_fn(label, rows / n))
+                except Exception:  # noqa: BLE001 — estimate only
+                    nnz_bytes = None
+            if nnz_bytes is not None:
+                rec["nnz_bytes_per_batch"] = round(nnz_bytes, 1)
         # roofline: bound time = max(compute-bound, bandwidth-bound) per
         # batch; ratio = bound / measured (1.0 = running at the bound, the
         # ~250x image-chain gap shows up as ~0.004 here)
-        if (flops or nbytes) and wall and wall > 0:
+        if (flops or nbytes or nnz_bytes) and wall and wall > 0:
             t_flops = (flops or 0.0) / seg_peaks["flops"]
-            t_mem = (nbytes or 0.0) / seg_peaks["bytes_per_s"]
+            band_bytes = nnz_bytes if nnz_bytes is not None else nbytes
+            t_mem = (band_bytes or 0.0) / seg_peaks["bytes_per_s"]
             bound_s = max(t_flops, t_mem)
             if bound_s > 0:
                 rec["bound_ms_per_batch"] = round(bound_s * 1e3, 6)
@@ -329,6 +358,11 @@ def segment_families(fusion: Dict[str, Any]) -> List[MetricFamily]:
         extra = {}
         if rec.get("spec"):
             extra = {"sharded": "1", "spec": str(rec["spec"])}
+        if rec.get("layout"):
+            # CSR-staged segments carry layout= so an nnz-bound series
+            # never aliases the dense-bound one (same no-alias contract
+            # as spec=); dense samples keep the historical label set
+            extra = {**extra, "layout": str(rec["layout"])}
         for fam, key in ((ratio, "roofline_ratio"),
                          (bound, "bound_ms_per_batch"),
                          (measured, "measured_ms_per_batch")):

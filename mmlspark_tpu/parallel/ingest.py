@@ -200,6 +200,18 @@ class IngestStats:
         self.slot_transfer_s: float = 0.0
         self.slot_overlap_s: float = 0.0
         self.slot_transfers: int = 0
+        # sparse-layout accounting (docs/sparse.md): bytes a densify
+        # materialized vs the CSR bytes the same rows would have shipped
+        # (mmlspark_ingest_densified_bytes_total / _densify_ratio), and the
+        # CSR-through counterpart (bytes actually staged as triples vs the
+        # dense-equivalent bytes avoided). All zero — and absent from
+        # summary() — until sparse data is seen.
+        self.densified_bytes: int = 0
+        self.densify_nnz_bytes: int = 0
+        self.densifies: int = 0
+        self.csr_nnz_bytes: int = 0
+        self.csr_dense_bytes: int = 0
+        self.csr_batches: int = 0
 
     def record(self, t: BatchTiming) -> None:
         self.records.append(t)
@@ -242,6 +254,21 @@ class IngestStats:
         else:
             self.copied_batches += 1
 
+    def note_densify(self, densified_bytes: int, nnz_bytes: int) -> None:
+        """One sparse column densified on the host path: the dense bytes it
+        materialized vs the CSR bytes the same rows hold — the measured
+        waste the layout knob exists to remove."""
+        self.densified_bytes += int(densified_bytes)
+        self.densify_nnz_bytes += int(nnz_bytes)
+        self.densifies += 1
+
+    def note_csr(self, nnz_bytes: int, dense_bytes: int) -> None:
+        """One batch staged as a CSR triple: the triple's actual bytes vs
+        the dense-equivalent bytes the densify path would have shipped."""
+        self.csr_nnz_bytes += int(nnz_bytes)
+        self.csr_dense_bytes += int(dense_bytes)
+        self.csr_batches += 1
+
     def note_slot(self, fill_s: float, transfer_s: float,
                   overlap_s: float) -> None:
         """One slot cycle: host fill seconds, H2D transfer seconds, and the
@@ -272,6 +299,12 @@ class IngestStats:
         self.slot_transfer_s += other.slot_transfer_s
         self.slot_overlap_s += other.slot_overlap_s
         self.slot_transfers += other.slot_transfers
+        self.densified_bytes += other.densified_bytes
+        self.densify_nnz_bytes += other.densify_nnz_bytes
+        self.densifies += other.densifies
+        self.csr_nnz_bytes += other.csr_nnz_bytes
+        self.csr_dense_bytes += other.csr_dense_bytes
+        self.csr_batches += other.csr_batches
 
     @property
     def num_batches(self) -> int:
@@ -315,6 +348,19 @@ class IngestStats:
             out["slot_overlap_ratio"] = round(
                 self.slot_overlap_s / self.slot_transfer_s, 4) \
                 if self.slot_transfer_s > 0 else None
+        if self.densifies:
+            out["densifies"] = self.densifies
+            out["densified_bytes"] = self.densified_bytes
+            out["densify_nnz_bytes"] = self.densify_nnz_bytes
+            # dense bytes materialized per CSR byte the rows actually hold
+            # (the layout knob's headroom; 1.0 = densify was free)
+            out["densify_ratio"] = round(
+                self.densified_bytes / self.densify_nnz_bytes, 4) \
+                if self.densify_nnz_bytes > 0 else None
+        if self.csr_batches:
+            out["csr_batches"] = self.csr_batches
+            out["csr_nnz_bytes"] = self.csr_nnz_bytes
+            out["csr_dense_bytes"] = self.csr_dense_bytes
         return out
 
     def summary(self) -> Dict[str, Any]:

@@ -53,11 +53,13 @@ from .mesh import (DATA_AXIS, TENSOR_AXIS, MeshSpec, data_sharding,
 __all__ = ["ShardCandidate", "SegmentSharding", "MeshSupervision",
            "candidates", "sharding_for", "tuner_candidates",
            "measure_collectives", "shard_groups", "group_of",
-           "submesh_excluding", "donation_supported", "mesh_topology"]
+           "submesh_excluding", "donation_supported", "mesh_topology",
+           "split_csr_rows", "ragged_allgather_bytes"]
 
 #: candidate partitioning names (the values of the ``sharding`` tuner knob)
 SPEC_DATA = "data"
 SPEC_FEATURE = "feature"
+SPEC_CSR_ROW = "csr_row"
 
 
 # ---------------------------------------------------------------------------
@@ -108,6 +110,18 @@ def candidates(segment, mesh) -> List[ShardCandidate]:
     if n_data > 1 and ext:
         out.append(ShardCandidate(
             name=SPEC_DATA, axis=DATA_AXIS, shards=n_data,
+            in_dims=tuple((c, 0) for c in ext), out_dim=0,
+            collective="all_gather"))
+    if n_data > 1 and ext and any(
+            getattr(dfn, "sparse_fn", None) is not None
+            and getattr(dfn, "sparse_cols", ()) for dfn in segment.dfns):
+        # row-split CSR over the data axis: each shard takes a contiguous
+        # row range of the CSR triple (rebased indptr + its nnz slice) —
+        # per-shard nnz is RAGGED, so the readback gather pads to the
+        # ragged max and the cost model prices it from the nnz term
+        # (``nnz_bytes``), not the dense N·F bytes
+        out.append(ShardCandidate(
+            name=SPEC_CSR_ROW, axis=DATA_AXIS, shards=n_data,
             in_dims=tuple((c, 0) for c in ext), out_dim=0,
             collective="all_gather"))
     n_tensor = int(shape.get(TENSOR_AXIS, 1))
@@ -161,9 +175,63 @@ def tuner_candidates(segment, mesh, model=None, batch: Optional[int] = None
                     nbytes = float(fn(label, "output_bytes") or 0.0)
                 except Exception:  # noqa: BLE001 — estimate only
                     nbytes = 0.0
+        if cand.name == SPEC_CSR_ROW and model is not None and batch:
+            # the csr_row gather moves the RAGGED per-shard nnz payload,
+            # not dense rows: price it from the fitted nnz term when the
+            # model has one (falls back to the dense output estimate)
+            fn = getattr(model, "nnz_bytes", None)
+            if callable(fn):
+                try:
+                    nb = fn(label, int(batch))
+                    if nb is not None:
+                        nbytes = float(nb)
+                except Exception:  # noqa: BLE001 — estimate only
+                    pass
         out.append({"name": cand.name, "shards": cand.shards,
                     "op": cand.collective, "collective_bytes": nbytes})
     return out
+
+
+def split_csr_rows(indptr, indices, values, shards: int
+                   ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Row-split one CSR column into ``shards`` contiguous row ranges —
+    the host side of the ``csr_row`` partition spec. Each shard gets a
+    REBASED indptr (``ip[0] == 0``) plus exactly its rows' (indices,
+    values) slice, so per-shard nnz is ragged. Concatenating the shards'
+    predictions in order is bitwise the unsplit prediction: row splitting
+    never reorders or duplicates entries (tests/test_sparse_e2e.py)."""
+    indptr = np.asarray(indptr, dtype=np.int64)
+    indices = np.asarray(indices)
+    values = np.asarray(values)
+    n = len(indptr) - 1
+    shards = max(1, int(shards))
+    bounds = [round(i * n / shards) for i in range(shards + 1)]
+    out: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+    for lo, hi in zip(bounds, bounds[1:]):
+        base = int(indptr[lo])
+        end = int(indptr[hi])
+        ip = (indptr[lo:hi + 1] - base).astype(np.int32)
+        out.append((ip, np.asarray(indices[base:end], dtype=np.int32),
+                    np.asarray(values[base:end], dtype=np.float32)))
+    return out
+
+
+def ragged_allgather_bytes(nnz_per_shard: Sequence[int],
+                           rows_per_shard: Optional[Sequence[int]] = None
+                           ) -> float:
+    """All-gather payload for a ragged row-split CSR batch. all_gather is
+    rectangular, so every shard's (indices, values) pair pads to the
+    ragged max nnz before the gather — the term the cost model fits
+    against measured collective seconds, and why a skewed nnz
+    distribution erodes the csr_row spec's win even when total nnz is
+    small."""
+    nnz = [int(x) for x in nnz_per_shard]
+    if not nnz:
+        return 0.0
+    pad = max(max(nnz), 1)
+    bytes_iv = len(nnz) * pad * 8.0  # i32 indices + f32 values per slot
+    rows = sum(int(r) for r in (rows_per_shard or []))
+    return bytes_iv + (rows + len(nnz)) * 4.0  # + rebased indptr slices
 
 
 # ---------------------------------------------------------------------------

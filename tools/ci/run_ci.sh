@@ -49,6 +49,7 @@ PACKAGES=(
   "tests/test_lifecycle.py"
   "tests/test_benchmarks_extended.py"
   "tests/test_sharding.py"
+  "tests/test_sparse_e2e.py"
   "tests/test_multiprocess.py"
   "tests/test_examples.py"
 )
@@ -69,7 +70,7 @@ if [ "$stage" = "chaos" ] || [ "$stage" = "all" ]; then
   # schedules, not just the default seed's (docs/faults.md)
   for seed in 0 7 1337; do
     echo "--- chaos seed $seed ---"
-    MMLSPARK_CHAOS_SEED=$seed python -m pytest tests/test_faults.py tests/test_front_fabric.py -q -m faults || rc=1
+    MMLSPARK_CHAOS_SEED=$seed python -m pytest tests/test_faults.py tests/test_front_fabric.py tests/test_sparse_e2e.py -q -m faults || rc=1
   done
   [ "$stage" = "chaos" ] && exit $rc
 fi

@@ -54,9 +54,12 @@ COLUMNS = (("segment", "segment"), ("batches", "n_batches"),
            ("bottleneck", "bottleneck"), ("disp%", "dispatch_share"),
            ("spec", "partition_spec"),
            ("variant", "variant"), ("stitched", "stitched"),
+           ("layout", "layout"),
            ("coll ms", "collective_ms_per_batch"),
            ("flops/batch", "flops_per_batch"),
-           ("bytes/batch", "bytes_per_batch"), ("exemplars", "exemplars"))
+           ("bytes/batch", "bytes_per_batch"),
+           ("nnz bytes", "nnz_bytes_per_batch"),
+           ("exemplars", "exemplars"))
 
 
 def _fmt(v: Any) -> str:
@@ -152,7 +155,7 @@ def render_tuner(tuner: Dict[str, Any]) -> str:
                    {"buckets", "window_seed_ms", "inflight", "replicas"})
     cells = [["knob", "default", "chosen"]]
     for name in names:
-        if name in ("fuse", "kernel_variants", "stitch") \
+        if name in ("fuse", "kernel_variants", "stitch", "layout") \
                 and not knobs.get(name):
             continue
         chosen = knobs.get(name)
@@ -169,6 +172,10 @@ def render_tuner(tuner: Dict[str, Any]) -> str:
         elif name == "stitch":
             chosen = "; ".join(sorted(k for k, v in chosen.items() if v))
             dflt = "(split)"
+        elif name == "layout":
+            chosen = "; ".join(f"{k}={v}"
+                               for k, v in sorted(chosen.items()))
+            dflt = "(densify)"
         else:
             dflt = _fmt(default.get(name, "(static)")) \
                 if name in default else "(static)"
