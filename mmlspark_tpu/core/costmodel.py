@@ -50,6 +50,13 @@ The public surface the Tuner (core/tune.py) consumes:
     batch prediction plus its collective term — returning the winning
     spec name, or None (= stay unsharded, the bitwise default) until BOTH
     the segment and the collectives are calibrated.
+  - ``predict_pipelined_ms(stage_labels, batch)`` prices a pipeline as its
+    slowest stage paid ``M + S - 1`` ticks (the GPipe fill/drain bubble)
+    plus the fitted ``pipe_handoff`` transfer term, and
+    ``choose_pipe_depth(chain_labels, batch, max_depth)`` picks the depth
+    whose best contiguous stage grouping undercuts the serial wall — or
+    None (= stay serial, the bitwise default), gated on calibration
+    exactly like ``choose_sharding``.
 
 Everything is host-side Python (no jax import), thread-safe under one lock,
 and serializable (``to_dict``/``from_dict``) so a tuned model survives a
@@ -96,6 +103,24 @@ def bucket_of_shape(shape_key: str) -> Optional[int]:
         return int(parts[0])
     except (IndexError, ValueError):
         return None
+
+
+def _min_max_contiguous(costs: Sequence[float], k: int) -> float:
+    """Minimum achievable max-stage-sum over contiguous splits of ``costs``
+    into ``k`` groups — the pipeline clock of the best-balanced contiguous
+    stage assignment (chains are short, so enumerate cut placements)."""
+    vals = [float(c) for c in costs]
+    n = len(vals)
+    k = max(1, min(int(k), n))
+    if k == 1:
+        return sum(vals)
+    import itertools
+    best = float("inf")
+    for cuts in itertools.combinations(range(1, n), k - 1):
+        bounds = (0,) + cuts + (n,)
+        clock = max(sum(vals[a:b]) for a, b in zip(bounds, bounds[1:]))
+        best = min(best, clock)
+    return best
 
 
 class _BucketRecord:
@@ -659,6 +684,84 @@ class SegmentCostModel:
                 best_ms = ms
                 best_name = str(cand.get("name"))
         return best_name
+
+    def predict_pipelined_ms(self, stage_labels: Sequence[str], batch: int,
+                             microbatches: int = 8,
+                             handoff_bytes: float = 0.0,
+                             op: str = "pipe_handoff") -> Optional[float]:
+        """Predicted wall ms for streaming ``microbatches`` micro-batches
+        of ``batch`` rows through pipeline stages whose segment labels are
+        ``stage_labels``: the pipeline clock is its slowest stage, paid
+        ``M + S - 1`` ticks (the GPipe fill/drain bubble), plus the fitted
+        inter-stage transfer term for the ``M * (S - 1)`` device-to-device
+        handoffs. Gated exactly like :meth:`choose_sharding`: None unless
+        EVERY stage is calibrated and a nonzero handoff payload has a
+        fitted transfer cost — an unpriced pipeline must not look free, so
+        cold start stays bitwise-identical to the unpipelined path."""
+        labels = [str(s) for s in stage_labels]
+        if not labels:
+            return None
+        per: list = []
+        for lab in labels:
+            if not self.calibrated(lab):
+                return None
+            ms = self.predict_ms(lab, batch=int(batch))
+            if ms is None:
+                return None
+            per.append(ms)
+        n_stages = len(per)
+        hand = 0.0
+        if handoff_bytes > 0 and n_stages > 1:
+            fitted = self.collective_ms(op, handoff_bytes)
+            if fitted is None:
+                return None
+            hand = fitted
+        m = max(1, int(microbatches))
+        return (m + n_stages - 1) * max(per) + m * (n_stages - 1) * hand
+
+    def choose_pipe_depth(self, chain_labels: Sequence[str], batch: int,
+                          max_depth: int, microbatches: int = 8,
+                          handoff_bytes: float = 0.0,
+                          op: str = "pipe_handoff",
+                          margin: float = 0.95) -> Optional[int]:
+        """Pipeline depth for a chainable segment run: the best contiguous
+        grouping of ``chain_labels`` into 2..``max_depth`` stages (each
+        stage's cost is the sum of its members, the clock their max) whose
+        predicted pipelined wall undercuts the serial wall by at least
+        ``1 - margin``. None keeps the chain serial. Gated on every label
+        being ``calibrated`` and — for a nonzero handoff payload — on a
+        fitted ``op`` transfer term, mirroring :meth:`choose_sharding` so
+        an uncalibrated model changes nothing."""
+        labels = [str(s) for s in chain_labels]
+        if len(labels) < 2 or int(max_depth) < 2:
+            return None
+        per: list = []
+        for lab in labels:
+            if not self.calibrated(lab):
+                return None
+            ms = self.predict_ms(lab, batch=int(batch))
+            if ms is None:
+                return None
+            per.append(ms)
+        hand = 0.0
+        if handoff_bytes > 0:
+            if not self.collective_calibrated(op):
+                return None
+            fitted = self.collective_ms(op, handoff_bytes)
+            if fitted is None:
+                return None
+            hand = fitted
+        m = max(1, int(microbatches))
+        serial = m * sum(per)
+        best_depth: Optional[int] = None
+        best_ms = serial * float(margin)
+        for depth in range(2, min(int(max_depth), len(per)) + 1):
+            clock = _min_max_contiguous(per, depth)
+            total = (m + depth - 1) * clock + m * (depth - 1) * hand
+            if total < best_ms:
+                best_ms = total
+                best_depth = depth
+        return best_depth
 
     def _modal_record(self, segment: str) -> Optional[_BucketRecord]:
         """Most-observed measured record of a segment when it clears

@@ -246,6 +246,14 @@ STORE_GET = "store.get"
 # output stays bitwise-equal to the dense path, the waste is just counted.
 # Fires on the CSR path only — densify-path parity is never perturbed.
 SPARSE_STAGE = "sparse.stage"
+# parallel/pipeplan PipeRunner, fired per micro-batch before each stage's
+# dispatch (ctx: stage=<index>): a raising plan simulates a stage's whole
+# sub-mesh dropping out mid-stream — the model quarantines the stage and
+# re-plans at depth N-1 over the surviving sub-meshes, re-running the
+# in-flight partition (no request dropped); delay_s wedges the stream for
+# the watchdog. Fires on the PIPELINED path only — with the pipe_depth
+# knob off an armed plan never perturbs the serial bitwise-parity path.
+PIPE_STAGE_WEDGE = "pipe.stage_wedge"
 
 ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
               JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE,
@@ -253,7 +261,7 @@ ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
               COMPILECACHE_LOAD, COMPILECACHE_STORE, MESH_CHIP_WEDGE,
               LIFECYCLE_SWAP, LIFECYCLE_CHECKPOINT, TUNER_KERNEL_APPLY,
               FRONT_L2_CRASH, RING_REBALANCE, STORE_PUT, STORE_GET,
-              SPARSE_STAGE)
+              SPARSE_STAGE, PIPE_STAGE_WEDGE)
 
 
 class InjectedFault(OSError):

@@ -1372,6 +1372,16 @@ class FusedPipelineModel(PipelineModel):
         # stages capable sparse columns as wire triples (tuner knob via
         # costmodel.choose_layout). Default OFF — densify, bitwise today.
         self._layout_overrides: Dict[str, str] = {}
+        # pipeline-parallel depth knob (parallel/pipeplan.py, tuner knob
+        # via costmodel.choose_pipe_depth): > 1 places a chainable segment
+        # run on disjoint pipe-axis sub-meshes and streams micro-batches
+        # through them. Default OFF (None) — serial, bitwise today.
+        self._pipe_depth: Optional[int] = None
+        self._pipe_stats: Optional[Dict[str, Any]] = None
+        self._pipe_replans = 0
+        self._pipe_requeues: Dict[int, int] = {}
+        self._pipe_wedge_handler = None
+        self._pipe_supervision = None
         # pre-allocated H2D staging (parallel/ingest.py SlotPool), shared
         # across segments/executors; ``slot_staging=False`` pins the legacy
         # allocating path (the bench A/B arm)
@@ -1388,15 +1398,21 @@ class FusedPipelineModel(PipelineModel):
                    sharding: Optional[Dict[str, str]] = None,
                    kernel_variants: Optional[Dict[str, Dict[Any, str]]] = None,
                    stitch: Optional[Dict[str, bool]] = None,
-                   layout: Optional[Dict[str, str]] = None) -> None:
+                   layout: Optional[Dict[str, str]] = None,
+                   pipe_depth: Optional[int] = None) -> None:
         """Apply tuned knobs (Tuner.apply): per-segment-label bucket sets,
         fuse-vs-demote overrides, per-segment K-step mega-dispatch factors,
         per-segment partition-spec names (sharding over the ``set_mesh``
         mesh), per-segment kernel-variant maps ({label: {bucket|"*":
-        variant id}}), per-stage-name stitch flags, and/or the cost model
-        itself. Passing None leaves a knob unchanged; passing {} clears it.
-        Cached plans are invalidated (compiled executables survive in the
+        variant id}}), per-stage-name stitch flags, the pipeline depth
+        (``pipe_depth`` > 1 streams a chainable segment run over pipe-axis
+        sub-meshes; <= 1 clears), and/or the cost model itself. Passing
+        None leaves a knob unchanged; passing {} clears it. Cached plans
+        are invalidated (compiled executables survive in the
         CompileCache)."""
+        if pipe_depth is not None:
+            self._pipe_depth = int(pipe_depth) \
+                if int(pipe_depth) > 1 else None
         if kernel_variants is not None:
             self._variant_overrides = {
                 str(k): dict(v) for k, v in kernel_variants.items() if v}
@@ -1547,6 +1563,21 @@ class FusedPipelineModel(PipelineModel):
         self._last_plan = nodes
         self._seg_stats = {}
         self._last_fallbacks = []
+        self._pipe_stats = None
+        pplan = self._pipe_plan_for(nodes)
+        if pplan is not None:
+            from ..parallel.pipeplan import StageWedged
+
+            try:
+                return self._transform_pipelined(df, nodes, pplan)
+            except StageWedged as e:
+                # a stage's sub-mesh died mid-stream: quarantine it,
+                # re-plan at depth N-1 on the survivors, and re-run the
+                # in-flight DataFrame — bitwise-identical either way, so
+                # no request is dropped (depth strictly decreases, so the
+                # recursion is bounded by the original depth)
+                self._pipe_replan_after_wedge(pplan, e.stage)
+                return self.transform(df, fused=True)
         for node in nodes:
             if isinstance(node, Segment):
                 stats = IngestStats()
@@ -1557,6 +1588,122 @@ class FusedPipelineModel(PipelineModel):
             else:
                 df = self._host_node(node, df)
         return df
+
+    def _pipe_plan_for(self, nodes: List[Any]):
+        """Resolve the pipe_depth knob into a PipePlan (None = serial:
+        knob off/<= 1, no mesh, no chainable run, or any resolution
+        failure — wrong pipelining must never fail a transform). An
+        active CSR layout override keeps the plan serial: wire triples
+        are staged per-partition on host, which the device-resident
+        handoff never materializes (the same explicit exclusion as
+        ``_csr_capable``'s sharding gate)."""
+        depth = self._pipe_depth
+        if not depth or depth <= 1 or self._shard_mesh is None \
+                or self._layout_overrides:
+            return None
+        try:
+            from ..parallel.pipeplan import build_pipe_plan
+
+            pplan = build_pipe_plan(nodes, self._shard_mesh, depth,
+                                    model=self._cost_model)
+        except Exception:  # noqa: BLE001 — degrade to serial
+            return None
+        if pplan is not None and self._pipe_supervision is not None:
+            try:
+                self._pipe_supervision.register(pplan)
+            except Exception:  # noqa: BLE001 — registration best-effort
+                pass
+        return pplan
+
+    def _make_pipe_executor(self, node: Segment,
+                            sharding) -> SegmentExecutor:
+        """Executor for one pipelined segment: the ordinary
+        SegmentExecutor with the stage placement as its sharding. Mega-
+        dispatch is forced off (the stream IS the dispatch amortization)
+        and the CSR layout is excluded by ``_pipe_plan_for``."""
+        return SegmentExecutor(
+            node, self._cache,
+            buckets=self._bucket_overrides.get(node.label),
+            cost_model=self._cost_model,
+            slot_pool=self._get_slot_pool(),
+            mega_k=1,
+            sharding=sharding,
+            kernel_variants=self._variant_overrides.get(node.label),
+            stitch=self._stitch_overrides or None,
+            layout=None)
+
+    def _transform_pipelined(self, df: DataFrame, nodes: List[Any],
+                             pplan) -> DataFrame:
+        """Execute the plan with its chainable run pipelined: nodes
+        before and after the run go through the ordinary serial loop;
+        the run's segments stream micro-batches across their stage
+        sub-meshes (parallel/pipeplan.py PipeRunner). StageWedged
+        escapes to transform(), which re-plans and re-runs. The plan
+        indices refer to the PIPELINE VIEW (``split_segments`` re-cut the
+        fused chain at d2d boundaries), so that view is what runs —
+        serial semantics are identical node-for-node."""
+        from ..parallel.ingest import IngestStats
+        from ..parallel.pipeplan import PipeRunner, stage_sharding_for
+
+        if pplan.nodes is not None:
+            nodes = pplan.nodes
+
+        def serial_node(node, frame):
+            if isinstance(node, Segment):
+                stats = IngestStats()
+                self._seg_stats[node.label] = stats
+                ex = self._make_executor(node)
+                frame = ex.run(frame, stats)
+                self._last_fallbacks.extend(ex.fallbacks)
+                return frame
+            return self._host_node(node, frame)
+
+        for node in nodes[:pplan.first]:
+            df = serial_node(node, df)
+        execs, stats = [], []
+        for offset, node in enumerate(nodes[pplan.first:pplan.last]):
+            stage = pplan.stages[pplan.stage_of[pplan.first + offset]]
+            sh = stage_sharding_for(
+                node, stage, pplan.depth,
+                spec_name=self._sharding_overrides.get(node.label))
+            if sh.inner is not None:
+                self._seg_sharding[node.label] = sh.inner.describe()
+            seg_stats = IngestStats()
+            self._seg_stats[node.label] = seg_stats
+            stats.append(seg_stats)
+            execs.append(self._make_pipe_executor(node, sh))
+        runner = PipeRunner(pplan, execs, stats,
+                            cost_model=self._cost_model)
+        df = runner.run(df)
+        for ex in execs:
+            self._last_fallbacks.extend(ex.fallbacks)
+        self._pipe_stats = runner.stats_dict(
+            requeues=self._pipe_requeues, replans=self._pipe_replans)
+        for node in nodes[pplan.last:]:
+            df = serial_node(node, df)
+        return df
+
+    def _pipe_replan_after_wedge(self, pplan, stage_index: int) -> None:
+        """Quarantine a wedged stage and re-arm at depth N-1: through the
+        registered supervision hook (PipeSupervision — supervisor
+        quarantine + mesh degrade) when one is attached, else the local
+        degrade. N-1 == 1 clears the knob (serial on the survivors)."""
+        self._pipe_replans += 1
+        self._pipe_requeues[int(stage_index)] = \
+            self._pipe_requeues.get(int(stage_index), 0) + 1
+        handler = self._pipe_wedge_handler
+        if handler is not None:
+            try:
+                handler(pplan, int(stage_index))
+                return
+            except Exception:  # noqa: BLE001 — fall back to local replan
+                pass
+        from ..parallel.pipeplan import degrade_after_wedge
+
+        mesh, depth = degrade_after_wedge(self._shard_mesh, pplan,
+                                          stage_index)
+        self.set_mesh(mesh)
+        self.set_tuning(pipe_depth=depth if depth > 1 else 1)
 
     def transform_submit(self, df: DataFrame):
         """Non-blocking transform: run host stages and all but a TRAILING
@@ -1572,6 +1719,9 @@ class FusedPipelineModel(PipelineModel):
         self._last_plan = nodes
         self._seg_stats = {}
         self._last_fallbacks = []
+        # the submit split stays serial: its contract is a single trailing
+        # dispatched segment, not a stream (pipeline stats never linger)
+        self._pipe_stats = None
         tail = nodes[-1] if nodes and isinstance(nodes[-1], Segment) else None
         body = nodes[:-1] if tail is not None else nodes
         for node in body:
@@ -1684,6 +1834,8 @@ class FusedPipelineModel(PipelineModel):
                              for k, v in self._seg_sharding.items()}}
         if self._slot_pool is not None:
             out["slot_pool"] = self._slot_pool.stats()
+        if self._pipe_stats:  # key absent when no pipe plan ran: parity
+            out["pipeline"] = dict(self._pipe_stats)
         return out
 
     @property

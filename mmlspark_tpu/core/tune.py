@@ -81,13 +81,19 @@ class KnobSet:
     #: sparse columns as wire triples, docs/sparse.md; absent label = the
     #: densify path, byte-for-byte the untuned behaviour)
     layout: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: pipeline depth for the fused plan's chainable segment run
+    #: (parallel/pipeplan.py; None/<=1 = the serial path, byte-for-byte
+    #: the untuned behaviour)
+    pipe_depth: Optional[int] = None
 
     def is_default(self) -> bool:
         return not (self.buckets or self.fuse or self.mega_k or
                     self.sharding or self.kernel_variants or self.stitch or
                     self.layout or
                     self.window_seed_ms is not None or
-                    self.inflight is not None or self.replicas is not None)
+                    self.inflight is not None or
+                    self.replicas is not None or
+                    self.pipe_depth is not None)
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
@@ -108,7 +114,7 @@ class KnobSet:
             out["stitch"] = {k: bool(v) for k, v in self.stitch.items()}
         if self.layout:
             out["layout"] = {k: str(v) for k, v in self.layout.items()}
-        for k in ("window_seed_ms", "inflight", "replicas"):
+        for k in ("window_seed_ms", "inflight", "replicas", "pipe_depth"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v
@@ -132,7 +138,8 @@ class KnobSet:
             layout={k: str(v)
                     for k, v in (d.get("layout") or {}).items()},
             window_seed_ms=d.get("window_seed_ms"),
-            inflight=d.get("inflight"), replicas=d.get("replicas"))
+            inflight=d.get("inflight"), replicas=d.get("replicas"),
+            pipe_depth=d.get("pipe_depth"))
 
 
 class Tuner:
@@ -273,6 +280,9 @@ class Tuner:
         stitch = self._stitch_proposals()
         if stitch:
             knobs.stitch = stitch
+        depth = self._pipe_depth_for(caps)
+        if depth is not None and depth > 1:
+            knobs.pipe_depth = int(depth)
         if trailing_ms is not None:
             compute = (parts or {}).get("compute_ms")
             knobs.window_seed_ms = round(
@@ -374,6 +384,38 @@ class Tuner:
         except Exception:  # noqa: BLE001 — proposal must never raise out
             return None
 
+    def _pipe_depth_for(self, caps: Dict[str, int]) -> Optional[int]:
+        """Cost-model pipeline depth for the fused plan's longest
+        chainable segment run (parallel/pipeplan.py ``chainable_runs`` +
+        ``costmodel.choose_pipe_depth``). None — the serial default —
+        without a mesh whose pipe axis is > 1, a >= 2-segment chainable
+        run, or full calibration of every run member (the chooser's
+        gate)."""
+        mesh = getattr(self.fused, "shard_mesh", None)
+        chooser = getattr(self.model, "choose_pipe_depth", None)
+        if mesh is None or not callable(chooser):
+            return None
+        try:
+            from ..parallel.mesh import PIPE_AXIS
+            from ..parallel.pipeplan import chainable_runs, split_segments
+
+            p = int(dict(getattr(mesh, "shape", {}) or {})
+                    .get(PIPE_AXIS, 1))
+            if p < 2:
+                return None
+            # propose over the PIPELINE VIEW of the plan — the same
+            # re-cut build_pipe_plan will execute
+            runs = chainable_runs(split_segments(
+                getattr(self.fused, "_last_plan", None) or []))
+            if not runs:
+                return None
+            run = max(runs, key=len)
+            labels = [seg.label for _, seg in run]
+            batch = min(int(caps.get(lab, 256)) for lab in labels)
+            return chooser(labels, batch, p)
+        except Exception:  # noqa: BLE001 — proposal must never raise out
+            return None
+
     def _stitch_proposals(self) -> Dict[str, bool]:
         """Stitch flags for the plan's adjacent (Segment, Segment)
         boundaries split by a TERMINAL tail stage that carries a transpiled
@@ -444,21 +486,32 @@ class Tuner:
     @staticmethod
     def _push(fused, knobs: KnobSet) -> None:
         """set_tuning with the full knob surface, degrading for older
-        fused models (newest kwargs dropped first)."""
+        fused models (newest kwargs dropped first). ``pipe_depth`` ships
+        as 1 when unset — set_tuning's <= 1 CLEARS the knob, so rolling
+        back to a default set restores the serial path bitwise."""
         try:
             fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse,
                              mega_k=knobs.mega_k, sharding=knobs.sharding,
                              kernel_variants=knobs.kernel_variants,
-                             stitch=knobs.stitch, layout=knobs.layout)
+                             stitch=knobs.stitch, layout=knobs.layout,
+                             pipe_depth=knobs.pipe_depth or 1)
         except TypeError:
-            try:  # older fused models without the staging-layout knob
+            try:  # older fused models without the pipeline-depth knob
                 fused.set_tuning(buckets=knobs.buckets, fuse=knobs.fuse,
                                  mega_k=knobs.mega_k,
                                  sharding=knobs.sharding,
                                  kernel_variants=knobs.kernel_variants,
-                                 stitch=knobs.stitch)
+                                 stitch=knobs.stitch, layout=knobs.layout)
             except TypeError:
-                Tuner._push_legacy(fused, knobs)
+                try:  # ... without the staging-layout knob either
+                    fused.set_tuning(buckets=knobs.buckets,
+                                     fuse=knobs.fuse,
+                                     mega_k=knobs.mega_k,
+                                     sharding=knobs.sharding,
+                                     kernel_variants=knobs.kernel_variants,
+                                     stitch=knobs.stitch)
+                except TypeError:
+                    Tuner._push_legacy(fused, knobs)
 
     @staticmethod
     def _push_legacy(fused, knobs: KnobSet) -> None:
@@ -491,7 +544,8 @@ class Tuner:
             self._e2e_skip = 2
         variant_change = knobs.kernel_variants != prev.kernel_variants
         swap_change = (variant_change or knobs.stitch != prev.stitch
-                       or knobs.layout != prev.layout)
+                       or knobs.layout != prev.layout
+                       or knobs.pipe_depth != prev.pipe_depth)
         fused = self.fused
         try:
             if swap_change:

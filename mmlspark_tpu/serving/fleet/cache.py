@@ -108,6 +108,24 @@ def env_fingerprint(mesh: Any = None) -> Dict[str, Any]:
         fp["mesh"] = mesh_topology(mesh)
     except Exception:  # noqa: BLE001 — no mesh machinery: single-device
         fp["mesh"] = "none"
+    try:
+        shape = dict(getattr(mesh, "shape", {}) or {})
+        p = int(shape.get("pipe", 1))
+        if p > 1:
+            # pipelined executables compile per-STAGE on a pipe sub-mesh
+            # (parallel/pipeplan.py pipe_submeshes): a stage keeps every
+            # non-pipe axis and owns a slice of the pipe axis, so the
+            # layout a stage executable hard-codes is (non-pipe shape,
+            # pipe extent). Folding that in makes a different pipe layout
+            # a clean counted miss. The key exists ONLY when the mesh has
+            # a pipe axis to split: every non-pipe fingerprint — and so
+            # every pre-pipeline content address — stays byte-identical.
+            fp["pipe_submesh"] = ";".join(
+                f"{a}={int(shape.get(a, 1))}"
+                for a in ("data", "fsdp", "tensor", "seq", "expert")
+            ) + f";pipe={p}"
+    except Exception:  # noqa: BLE001 — shape-less mesh object
+        pass
     return fp
 
 

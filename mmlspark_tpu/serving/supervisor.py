@@ -120,6 +120,10 @@ class ReplicaSupervisor:
         # the frozen group of indices that fail TOGETHER (one mesh slice).
         # Empty = every replica is its own group (the pre-mesh behavior).
         self._groups: Dict[int, Tuple[int, ...]] = {}
+        # pipeline awareness (parallel/pipeplan.py PipeSupervision): the
+        # registered stage device groups, in stage order. Empty until a
+        # pipe plan registers — nothing changes for unpipelined serving.
+        self._pipe_stages: List[Tuple[int, ...]] = []
 
     def set_shard_groups(self, groups) -> None:
         """Register the mesh's shard groups (a list of index lists): when a
@@ -136,6 +140,45 @@ class ReplicaSupervisor:
     def shard_group(self, index: int) -> Tuple[int, ...]:
         with self._lock:
             return self._groups.get(int(index), (int(index),))
+
+    def set_pipe_stages(self, stages) -> None:
+        """Register a pipe plan's stage device groups (a list of index
+        lists, parallel/pipeplan.py PipeSupervision.register): the same
+        fail-together semantics as shard groups — a wedged stage loses
+        its whole sub-mesh, so every member quarantines with it. Stage
+        groups are kept alongside any shard groups; ``pipe_stage(i)``
+        reads them back and ``note_stage_wedged`` quarantines one whole
+        stage. Call with () to clear."""
+        with self._lock:
+            self._pipe_stages = [tuple(int(i) for i in grp)
+                                 for grp in stages or ()]
+            for members in self._pipe_stages:
+                for i in members:
+                    # a stage IS a fail-together group: reuse the shard-
+                    # group ejection fabric for its members
+                    self._groups.setdefault(i, members)
+
+    def pipe_stage(self, stage_index: int) -> Tuple[int, ...]:
+        with self._lock:
+            stages = getattr(self, "_pipe_stages", [])
+            if 0 <= int(stage_index) < len(stages):
+                return stages[int(stage_index)]
+            return ()
+
+    def note_stage_wedged(self, stage_index: int) -> None:
+        """A pipeline stage's whole sub-mesh wedged mid-stream: every
+        member device index quarantines NOW (the stage's devices fail
+        together — the pipe-stage analogue of ``note_wedged``'s
+        shard-group ejection). Unknown stage indices are a no-op."""
+        members = self.pipe_stage(stage_index)
+        with self._lock:
+            for i in members:
+                h = self._get(i)
+                h.timeouts += 1
+                h.consecutive += 1
+                self._score(h, 0.0)
+                if h.state == HEALTHY:
+                    self._eject(h, f"pipe_stage:{int(stage_index)}")
 
     def _eject_peers(self, index: int, reason: str) -> None:
         """Quarantine the healthy remainder of ``index``'s shard group

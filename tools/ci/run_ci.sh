@@ -50,6 +50,7 @@ PACKAGES=(
   "tests/test_benchmarks_extended.py"
   "tests/test_sharding.py"
   "tests/test_sparse_e2e.py"
+  "tests/test_pipeline_mesh.py"
   "tests/test_multiprocess.py"
   "tests/test_examples.py"
 )
@@ -70,7 +71,7 @@ if [ "$stage" = "chaos" ] || [ "$stage" = "all" ]; then
   # schedules, not just the default seed's (docs/faults.md)
   for seed in 0 7 1337; do
     echo "--- chaos seed $seed ---"
-    MMLSPARK_CHAOS_SEED=$seed python -m pytest tests/test_faults.py tests/test_front_fabric.py tests/test_sparse_e2e.py -q -m faults || rc=1
+    MMLSPARK_CHAOS_SEED=$seed python -m pytest tests/test_faults.py tests/test_front_fabric.py tests/test_sparse_e2e.py tests/test_pipeline_mesh.py -q -m faults || rc=1
   done
   [ "$stage" = "chaos" ] && exit $rc
 fi
@@ -94,6 +95,8 @@ if [ "$stage" = "multichip" ] || [ "$stage" = "all" ]; then
   python -c "import __graft_entry__ as g; g.dryrun_multichip(4)" || rc=1
   echo "=== sharded-execution bench (1-shard vs N-shard A/B) ==="
   python tools/bench_serving.py --only sharding || rc=1
+  echo "=== pipeline-parallel bench (serial vs pipe=2 A/B) ==="
+  python tools/bench_serving.py --only pipeline || rc=1
   [ "$stage" = "multichip" ] && exit $rc
 fi
 

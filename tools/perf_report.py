@@ -208,6 +208,40 @@ def render_tuner(tuner: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_pipeline(pipe: Dict[str, Any]) -> str:
+    """Pipeline section (``fusion_stats()["pipeline"]``): stream shape +
+    GPipe bubble ratio, then one row per stage — member segments, its
+    sub-mesh size, occupancy (busy / stream wall), and the inter-stage
+    d2d transfer it paid. Callers gate on the key itself: no pipe plan
+    ran -> no section (and with --json, no ``pipeline`` key at all), so
+    an unpipelined report is byte-identical to one from a build that
+    never heard of pipelines."""
+    lines = [
+        f"Pipeline: depth={pipe.get('depth')} "
+        f"micro_batches={pipe.get('micro_batches')} "
+        f"bubble_ratio={_fmt(pipe.get('bubble_ratio'))} "
+        f"handoff={_fmt(pipe.get('handoff_ms'))}ms/"
+        f"{pipe.get('handoff_bytes')}B "
+        f"serial_fallbacks={pipe.get('serial_fallback_partitions')} "
+        f"replans={pipe.get('replans')}"]
+    cells = [["stage", "segments", "devices", "occupancy", "handoff ms",
+              "handoff B", "requeues"]]
+    for st in pipe.get("stages") or []:
+        devs = st.get("devices") or []
+        cells.append([
+            str(st.get("index")), "|".join(st.get("segments") or []),
+            str(len(devs)), _fmt(st.get("busy_ratio")),
+            _fmt(st.get("handoff_ms")), _fmt(st.get("handoff_bytes")),
+            _fmt(st.get("requeues"))])
+    widths = [max(len(r[i]) for r in cells) for i in range(len(cells[0]))]
+    for j, row in enumerate(cells):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths))
+                     .rstrip())
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def render_fleet(fleet: Optional[Dict[str, Any]],
                  cache: Optional[Dict[str, Any]]) -> str:
     """Fleet section: planner recommendation vs live config (from the
@@ -407,7 +441,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
 
-    slo = tuner = fleet = cache = lifecycle = None
+    slo = tuner = fleet = cache = lifecycle = pipeline = None
     if args.url:
         url = args.url.rstrip("/") + "/_mmlspark/stats"
         with urllib.request.urlopen(url, timeout=args.timeout) as resp:
@@ -418,17 +452,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         fleet = stats.get("fleet")
         cache = (stats.get("fusion") or {}).get("compile_cache")
         lifecycle = stats.get("lifecycle")
+        pipeline = (stats.get("fusion") or {}).get("pipeline")
     elif args.trace:
         rows = rows_from_trace(args.trace)
     else:
         rows, tuner = demo_rows()
 
     if args.as_json:
-        print(json.dumps({"segments": rows, "slo": slo, "tuner": tuner,
-                          "fleet": fleet, "compile_cache": cache,
-                          "lifecycle": lifecycle}))
+        payload = {"segments": rows, "slo": slo, "tuner": tuner,
+                   "fleet": fleet, "compile_cache": cache,
+                   "lifecycle": lifecycle}
+        if pipeline:
+            # key only when a pipe plan ran: unpipelined JSON stays
+            # byte-identical to the pre-pipeline report
+            payload["pipeline"] = pipeline
+        print(json.dumps(payload))
         return 0
     print(render_table(rows))
+    if pipeline:
+        print()
+        print(render_pipeline(pipeline))
     if tuner:
         print()
         print(render_tuner(tuner))
