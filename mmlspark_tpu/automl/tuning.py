@@ -8,6 +8,7 @@ keep the best; automl/EvaluationUtils.scala — metric name -> ordering.
 
 from __future__ import annotations
 
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -22,6 +23,33 @@ from .params import GridSpace, ParamSpace
 _HIGHER_BETTER = {"accuracy", "precision", "recall", "AUC", "R^2"}
 _LOWER_BETTER = {"mean_squared_error", "root_mean_squared_error",
                  "mean_absolute_error", "log_loss"}
+
+
+def _trial_instruments():
+    """Per-candidate trial instruments on the process-default registry
+    (obs/metrics.py), created lazily INSIDE fit so the
+    ``mmlspark_automl_trial_*`` families exist only once tuning has
+    actually run (the absent-when-unused exposition contract). Returns
+    None when obs is unavailable — tuning never depends on it."""
+    try:
+        from ..obs.metrics import default_registry
+
+        reg = default_registry()
+        return (
+            reg.histogram(
+                "mmlspark_automl_trial_seconds",
+                "wall seconds per tuning candidate (k folds: fit + score)",
+                ("estimator",)),
+            reg.gauge(
+                "mmlspark_automl_trial_metric",
+                "last candidate's cross-validated eval metric",
+                ("estimator", "metric")),
+            reg.counter(
+                "mmlspark_automl_trials_total",
+                "tuning candidates evaluated", ("estimator",)),
+        )
+    except Exception:  # noqa: BLE001 — obs must never fail a fit
+        return None
 
 
 def metric_is_higher_better(metric: str) -> bool:
@@ -112,8 +140,11 @@ class TuneHyperparameters(Estimator, HasEvaluationMetric):
                         if e is est or e is None or type(e) is type(est)}
                 candidates.append((est, pmap))
 
+        instruments = _trial_instruments()
+
         def run_candidate(args):
             est, pmap = args
+            t0 = time.perf_counter()
             vals = []
             for i in range(n_folds):
                 train_parts = [folds[j] for j in range(n_folds) if j != i]
@@ -124,7 +155,22 @@ class TuneHyperparameters(Estimator, HasEvaluationMetric):
                 model = stage.fit(train_df)
                 scored = model.transform(folds[i])
                 vals.append(evaluator.evaluate(scored))
-            return float(np.mean(vals))
+            result = float(np.mean(vals))
+            if instruments is not None:
+                # per-candidate wall seconds + eval metric (H002 families,
+                # absent while automl is unused — created above, not at
+                # import); instruments are thread-safe under parallelism
+                try:
+                    wall_h, metric_g, trials_c = instruments
+                    name = type(est).__name__
+                    wall_h.labels(estimator=name).observe(
+                        time.perf_counter() - t0)
+                    metric_g.labels(estimator=name, metric=metric).set(
+                        result)
+                    trials_c.labels(estimator=name).inc()
+                except Exception:  # noqa: BLE001 — obs never fails a fit
+                    pass
+            return result
 
         par = self.get("parallelism")
         if par > 1:

@@ -454,6 +454,16 @@ class SegmentCostModel:
         rec = self.predict(segment, batch=batch, shape=shape)
         return None if rec is None else rec["ms"]
 
+    def per_row_ms(self, segment: str, batch: int = 32) -> Optional[float]:
+        """Predicted per-ROW service at bucket ``batch`` — the packing key
+        of the multimodel planner (``predict_ms x forecast_rps``,
+        serving/fleet/planner.py pack_models). None while uncalibrated:
+        the planner gives the model a measured-probe slot instead."""
+        if batch <= 0:
+            return None
+        ms = self.predict_ms(segment, batch=int(batch))
+        return None if ms is None else ms / int(batch)
+
     def confidence(self, segment: str) -> float:
         """Calibration confidence for a segment: the best single-bucket
         confidence (0.0 = unknown, >= 0.5 once min_obs batches measured)."""

@@ -254,6 +254,18 @@ SPARSE_STAGE = "sparse.stage"
 # the watchdog. Fires on the PIPELINED path only — with the pipe_depth
 # knob off an armed plan never perturbs the serial bitwise-parity path.
 PIPE_STAGE_WEDGE = "pipe.stage_wedge"
+# serving/multimodel ModelMall trial/version promotion, fired BEFORE the
+# per-model registry swap mutates (the mall's analogue of LIFECYCLE_SWAP,
+# with model= in the context): a raising plan is a crash mid-promotion and
+# must leave the model's incumbent version serving bitwise
+MALL_SWAP = "mall.swap"
+# serving/multimodel ModelMall cold-model eviction, fired AFTER the plane
+# is parked to the persistent/object-store tier but BEFORE the resident
+# copy is dropped: a raising plan is a crash mid-evict — the resident copy
+# is lost either way, but the tier copy (written first) survives, so the
+# model stays servable through an accounted re-warm on its next request;
+# a model is never stranded half-evicted
+MALL_EVICT = "mall.evict"
 
 ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
               JOURNAL_COMMIT, TRAIN_STEP, TUNER_MEASURE,
@@ -261,7 +273,7 @@ ALL_POINTS = (HTTP_SEND, WORKER_FORWARD, INGEST_H2D, JOURNAL_WRITE,
               COMPILECACHE_LOAD, COMPILECACHE_STORE, MESH_CHIP_WEDGE,
               LIFECYCLE_SWAP, LIFECYCLE_CHECKPOINT, TUNER_KERNEL_APPLY,
               FRONT_L2_CRASH, RING_REBALANCE, STORE_PUT, STORE_GET,
-              SPARSE_STAGE, PIPE_STAGE_WEDGE)
+              SPARSE_STAGE, PIPE_STAGE_WEDGE, MALL_SWAP, MALL_EVICT)
 
 
 class InjectedFault(OSError):

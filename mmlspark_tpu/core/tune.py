@@ -467,6 +467,16 @@ class Tuner:
                 total = (total or 0.0) + float(pred["ms"])
         return total
 
+    def predict_row_ms(self, bucket: int = 32) -> Optional[float]:
+        """Per-ROW service estimate at ``bucket`` — the multimodel
+        planner's packing key for THIS pipeline (the ``predict_ms`` mall
+        hook). None while uncalibrated, so the mall falls back to its own
+        measured EWMA (the probe-slot graduation path)."""
+        if bucket <= 0:
+            return None
+        ms = self.predict_batch_ms(int(bucket))
+        return None if ms is None else ms / int(bucket)
+
     def _replica_suggestion(self, compute_ms: float,
                             transfer_ms: float) -> Optional[int]:
         """Compute-bound segments scale across local devices; transfer-bound

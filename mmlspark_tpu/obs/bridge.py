@@ -748,6 +748,93 @@ def _lifecycle_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
     yield rolls
 
 
+def _mall_families(summary: Dict[str, Any]) -> Iterable[MetricFamily]:
+    """Model-mall state (serving/multimodel): per-model residency and
+    traffic, eviction / re-warm accounting, packing idle share, and the
+    idle-capacity AutoML trial counters — mmlspark_mall_* per
+    docs/multimodel.md. Absent entirely while multimodel=None (the
+    bitwise-parity contract)."""
+    models = summary.get("models") or {}
+    info = MetricFamily(
+        "mmlspark_mall_model_info", "gauge",
+        "admitted models (1 per model; residency state as a label)")
+    reqs = MetricFamily(
+        "mmlspark_mall_requests_total", "counter",
+        "rows routed per model")
+    svc = MetricFamily(
+        "mmlspark_mall_service_ms", "gauge",
+        "measured per-row service EWMA (ms) per model — the packing "
+        "planner's probe-graduated cost input")
+    rewarms = MetricFamily(
+        "mmlspark_mall_rewarms_total", "counter",
+        "tier restores per model (evicted model taking traffic again)")
+    rewarm_s = MetricFamily(
+        "mmlspark_mall_rewarm_seconds_total", "counter",
+        "accounted wall seconds spent re-warming per model")
+    for name, m in models.items():
+        lbl = {"model": str(name)}
+        info.add(1.0, {**lbl, "state": str(m.get("state")),
+                       "default": "true" if m.get("default") else "false"})
+        for fam, key in ((reqs, "requests"), (svc, "service_ms"),
+                         (rewarms, "rewarms"),
+                         (rewarm_s, "rewarm_seconds")):
+            f = _num(m.get(key))
+            if f is not None:
+                fam.add(f, lbl)
+    yield info
+    yield reqs
+    yield svc
+    yield rewarms
+    yield rewarm_s
+    counters = summary.get("counters") or {}
+    ev = MetricFamily(
+        "mmlspark_mall_evictions_total", "counter",
+        "models parked to the persistent/object-store tier by outcome "
+        "(clean / crashed — crashed means the mall.evict seam fired "
+        "mid-evict and the tier copy now serves)")
+    f = _num(counters.get("evictions"))
+    crashed = _num(counters.get("evict_crashes")) or 0.0
+    if f is not None:
+        ev.add(max(0.0, f - crashed), {"outcome": "clean"})
+        ev.add(crashed, {"outcome": "crashed"})
+    yield ev
+    for key, mname, doc in (
+            ("swaps", "mmlspark_mall_swaps_total",
+             "per-model live-pointer promotions applied by the mall"),
+            ("unknown_requests", "mmlspark_mall_unknown_requests_total",
+             "rows naming a model the mall never admitted (shed 404)")):
+        f = _num(counters.get(key))
+        if f is not None:
+            yield MetricFamily(mname, "counter", doc).add(f)
+    packing = summary.get("packing") or {}
+    current = packing.get("current") or {}
+    f = _num(current.get("idle_share"))
+    if f is not None:
+        yield MetricFamily(
+            "mmlspark_mall_packing_idle_share", "gauge",
+            "fraction of fleet service capacity the current packing plan "
+            "leaves idle (the AutoML trial budget)").add(f)
+    f = _num(packing.get("plans_total"))
+    if f is not None:
+        yield MetricFamily(
+            "mmlspark_mall_packing_plans_total", "counter",
+            "packing plans journaled (each with one-step rollback)").add(f)
+    automl = summary.get("automl")
+    if automl:
+        trials = MetricFamily(
+            "mmlspark_mall_trials_total", "counter",
+            "idle-capacity AutoML trials by outcome (started / promoted "
+            "/ shed / rolled_back)")
+        for key, outcome in (("trials_started", "started"),
+                             ("trials_promoted", "promoted"),
+                             ("trials_shed", "shed"),
+                             ("trials_rolled_back", "rolled_back")):
+            f = _num(automl.get(key))
+            if f is not None:
+                trials.add(f, {"outcome": outcome})
+        yield trials
+
+
 def fold_server(registry: MetricsRegistry, server: Any) -> None:
     """Register collectors reading a ServingServer's live stats surfaces:
     LatencyStats window + shed counters, the admission queue, wire-format
@@ -797,6 +884,11 @@ def fold_server(registry: MetricsRegistry, server: Any) -> None:
             try:
                 fams.extend(_lifecycle_families(server._lifecycle.summary()))
             except Exception:  # noqa: BLE001 — rollout mid-transition
+                pass
+        if getattr(server, "_multimodel", None) is not None:
+            try:
+                fams.extend(_mall_families(server._multimodel.summary()))
+            except Exception:  # noqa: BLE001 — mall mid-evict
                 pass
         if server.ingest_stats is not None:
             try:

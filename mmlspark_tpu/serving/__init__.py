@@ -22,15 +22,20 @@ from .supervisor import (BrownoutController, BrownoutStep, DispatchWatchdog,
 from .lifecycle import (CanaryConfig, CanaryController, LifecyclePlane,
                         ModelRegistry, ModelVersion, OnlineTrainer,
                         make_lifecycle)
+from .multimodel import (MODEL_HEADER, AutoMLScheduler, MallConfig,
+                         ModelMall, make_multimodel)
 
 __all__ = ["AdaptiveBatchController", "AsyncConnectionPool",
-           "AsyncHTTPServer", "BrownoutController", "BrownoutStep",
+           "AsyncHTTPServer", "AutoMLScheduler", "BrownoutController",
+           "BrownoutStep",
            "CanaryConfig", "CanaryController", "DispatchWatchdog",
-           "HedgeConfig", "HedgeTracker", "LifecyclePlane", "ModelRegistry",
+           "HedgeConfig", "HedgeTracker", "LifecyclePlane",
+           "MODEL_HEADER", "MallConfig", "ModelMall", "ModelRegistry",
            "ModelVersion", "OnlineTrainer",
            "PipelinedExecutor", "PortForwarder",
            "Replica", "ReplicaSet", "ReplicaSupervisor", "RequestJournal",
            "RoutingFront", "ServingServer", "TENANT_HEADER",
            "TenantAdmission", "build_ssh_command", "make_lifecycle",
+           "make_multimodel",
            "make_reply", "parse_request", "register_worker", "reply_to",
            "serve_pipeline", "tenants_from_spec"]

@@ -454,6 +454,16 @@ class PipelinedExecutor:
         with self._lock:
             return list(self._timeline)
 
+    def idle_fraction(self) -> float:
+        """Instantaneous idle-capacity estimate: the fraction of replicas
+        with no batch in flight right now. The multimodel plane clamps its
+        AutoML budget with this — a saturated pipeline vetoes trials even
+        when the arrival forecast reads calm."""
+        n = max(1, len(self.replicas.replicas))
+        with self._lock:
+            active = min(self._active, n)
+        return max(0.0, 1.0 - active / n)
+
     def _enter_pipe(self) -> None:
         with self._lock:
             if self._active == 0:

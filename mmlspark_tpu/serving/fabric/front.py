@@ -23,7 +23,8 @@ import time
 from typing import Dict, List, Mapping, Optional
 
 from ...core import faults
-from ..tenants import TenantAdmission, DEFAULT_TENANT
+from ..tenants import (TenantAdmission, DEFAULT_TENANT, MODEL_HEADER,
+                       header_lookup)
 from .ring import HashRing, RingEpochError
 
 #: fallback affinity headers when no tenant header is present (session or
@@ -32,11 +33,17 @@ SESSION_HEADERS = ("x-mmlspark-session", "x-mmlspark-trace")
 
 
 def affinity_key_of(headers: Optional[Mapping[str, str]]) -> str:
-    """The ring key for a request: tenant header first, then session/trace
-    id, then the default tenant (all anonymous traffic shares one cell)."""
+    """The ring key for a request: tenant header first, then the model
+    header, then session/trace id, then the default tenant (all anonymous
+    default-model traffic shares one cell). The model rung keeps every
+    request for one model landing on the same cell, so that cell's mall
+    keeps the model resident instead of N cells each paying a re-warm."""
     tenant = TenantAdmission.tenant_of(headers)
     if tenant != DEFAULT_TENANT:
         return tenant
+    model = header_lookup(headers, MODEL_HEADER) if headers else None
+    if model:
+        return f"model:{model}"
     if headers:
         lowered = {str(k).lower(): v for k, v in headers.items()}
         for h in SESSION_HEADERS:

@@ -37,12 +37,14 @@ from .cache import PersistentCompileCache, content_key
 from .controller import FleetController, FleetSpec, make_fleet
 from .objstore import (CallbackStore, LocalDirStore, ObjectStore,
                        make_store)
-from .planner import (CapacityPlan, CapacityPlanner, PlannerConfig,
-                      forecast_rps, plan_capacity)
+from .planner import (CapacityPlan, CapacityPlanner, ModelDemand,
+                      PackingPlan, PackingPlanner, PlannerConfig,
+                      forecast_rps, pack_models, plan_capacity)
 
 __all__ = [
     "PersistentCompileCache", "content_key",
     "CapacityPlan", "CapacityPlanner", "PlannerConfig",
+    "ModelDemand", "PackingPlan", "PackingPlanner", "pack_models",
     "forecast_rps", "plan_capacity",
     "FleetController", "FleetSpec", "make_fleet",
     "ObjectStore", "LocalDirStore", "CallbackStore", "make_store",

@@ -289,3 +289,185 @@ class CapacityPlanner:
             return {"plans_total": self.plans_total,
                     "config": dataclasses.asdict(self.cfg),
                     "latest": dict(latest) if latest else None}
+
+
+# ---------------------------------------------------------------------------
+# Model packing (serving/multimodel): bin-pack N models onto R replicas
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ModelDemand:
+    """One model's claim on worker capacity. ``predict_ms`` is the cost
+    model's per-request service estimate (None = uncalibrated — the model
+    gets a measured-probe slot, never an invented load number);
+    ``forecast_rps`` is the Holt forecast over that model's own traffic."""
+
+    model: str
+    predict_ms: Optional[float]
+    forecast_rps: float
+
+    @property
+    def calibrated(self) -> bool:
+        return self.predict_ms is not None and self.predict_ms > 0
+
+    @property
+    def load(self) -> Optional[float]:
+        """Demanded compute, ms of service per wall second — the packing
+        key ``predict_ms x forecast_rps`` from the issue/ROADMAP."""
+        if not self.calibrated:
+            return None
+        return float(self.predict_ms) * max(0.0, float(self.forecast_rps))
+
+
+@dataclasses.dataclass(frozen=True)
+class PackingPlan:
+    """One packing decision: model -> replica placements plus the idle
+    share the AutoML scheduler is allowed to spend on trials."""
+
+    placements: Tuple[Tuple[str, int], ...]   # (model, replica) pairs
+    replica_load: Tuple[float, ...]           # ms/s packed per replica
+    probes: Tuple[str, ...]                   # uncalibrated models probing
+    idle_replicas: Tuple[int, ...]            # replicas below probe load
+    idle_share: float                         # 0..1 of total capacity free
+    capacity_ms: float                        # per-replica ms/s budget
+    reason: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"placements": [list(p) for p in self.placements],
+                "replica_load": [round(x, 4) for x in self.replica_load],
+                "probes": list(self.probes),
+                "idle_replicas": list(self.idle_replicas),
+                "idle_share": round(self.idle_share, 4),
+                "capacity_ms": round(self.capacity_ms, 2),
+                "reason": self.reason}
+
+    def replica_of(self, model: str) -> Optional[int]:
+        for m, r in self.placements:
+            if m == model:
+                return r
+        return None
+
+
+def pack_models(demands: Iterable[ModelDemand], replicas: int,
+                cfg: Optional[PlannerConfig] = None,
+                probe_ms: float = 25.0) -> PackingPlan:
+    """Pure deterministic first-fit-decreasing bin-pack of models onto
+    replicas by ``predict_ms x forecast_rps`` (ms of demanded service per
+    wall second against a per-replica budget of ``1000 x
+    utilization_cap``).
+
+    Ties and ordering are fully deterministic: models sort by
+    (-load, name), so the same demands always produce the same plan (the
+    determinism tests diff plans byte-for-byte). Uncalibrated models are
+    NOT packed by a guessed load — each is placed on the currently
+    least-loaded replica with a nominal ``probe_ms`` reservation and
+    listed in ``probes``; the mall measures them there and the next plan
+    packs them for real ("uncalibrated changes nothing" applied to
+    placement). When every replica overflows its budget the plan still
+    places every model (serving beats purity) with
+    ``reason="saturated"``."""
+    cfg = cfg if cfg is not None else PlannerConfig()
+    replicas = max(1, int(replicas))
+    budget = 1000.0 * cfg.utilization_cap
+    calibrated = sorted(
+        (d for d in demands if d.calibrated),
+        key=lambda d: (-(d.load or 0.0), d.model))
+    probing = sorted((d for d in demands if not d.calibrated),
+                     key=lambda d: d.model)
+    loads = [0.0] * replicas
+    placements: List[Tuple[str, int]] = []
+    saturated = False
+    for d in calibrated:
+        want = d.load or 0.0
+        slot = None
+        for r in range(replicas):          # first fit over replica order
+            if loads[r] + want <= budget:
+                slot = r
+                break
+        if slot is None:                   # overflow: least-loaded replica
+            slot = min(range(replicas), key=lambda r: (loads[r], r))
+            saturated = True
+        loads[slot] += want
+        placements.append((d.model, slot))
+    for d in probing:
+        slot = min(range(replicas), key=lambda r: (loads[r], r))
+        loads[slot] += probe_ms
+        placements.append((d.model, slot))
+    total = budget * replicas
+    used = sum(loads)
+    idle = [r for r in range(replicas) if loads[r] <= probe_ms]
+    return PackingPlan(
+        placements=tuple(placements),
+        replica_load=tuple(round(x, 4) for x in loads),
+        probes=tuple(d.model for d in probing),
+        idle_replicas=tuple(idle),
+        idle_share=max(0.0, 1.0 - used / total) if total > 0 else 0.0,
+        capacity_ms=budget,
+        reason="saturated" if saturated else "packed")
+
+
+class PackingPlanner:
+    """Journaled wrapper around ``pack_models`` with the tuner-style
+    one-step rollback: every plan is appended to a bounded journal, and
+    ``rollback()`` restores exactly the previous placement (the mall
+    re-applies it) — the same contract as CapacityPlanner/Tuner."""
+
+    def __init__(self, cfg: Optional[PlannerConfig] = None,
+                 probe_ms: float = 25.0, journal_cap: int = 256):
+        self.cfg = cfg if cfg is not None else PlannerConfig()
+        self.probe_ms = float(probe_ms)
+        self._lock = threading.Lock()
+        self._journal: "deque[Dict[str, Any]]" = deque(maxlen=journal_cap)
+        self._current: Optional[PackingPlan] = None
+        self._prev: Optional[PackingPlan] = None
+        self.plans_total = 0
+        self.rollbacks = 0
+
+    @property
+    def current(self) -> Optional[PackingPlan]:
+        with self._lock:
+            return self._current
+
+    def plan(self, demands: Iterable[ModelDemand],
+             replicas: int) -> PackingPlan:
+        demands = list(demands)
+        p = pack_models(demands, replicas, self.cfg, probe_ms=self.probe_ms)
+        with self._lock:
+            self.plans_total += 1
+            self._prev = self._current
+            self._current = p
+            self._journal.append({
+                "t": round(time.time(), 3), "action": "pack",
+                "demands": [{"model": d.model,
+                             "predict_ms": d.predict_ms,
+                             "forecast_rps": round(d.forecast_rps, 4)}
+                            for d in demands],
+                "replicas": int(replicas),
+                "plan": p.to_dict()})
+        return p
+
+    def rollback(self, reason: str = "rollback") -> Optional[PackingPlan]:
+        """Restore the previous plan (one step, like the Tuner). Returns
+        the restored plan, or None when there is no prior decision."""
+        with self._lock:
+            if self._prev is None:
+                return None
+            restored, self._current, self._prev = \
+                self._prev, self._prev, None
+            self.rollbacks += 1
+            self._journal.append({"t": round(time.time(), 3),
+                                  "action": "rollback", "reason": reason,
+                                  "plan": restored.to_dict()})
+            return restored
+
+    def journal(self, last: int = 20) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._journal)[-int(last):]
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"plans_total": self.plans_total,
+                    "rollbacks": self.rollbacks,
+                    "probe_ms": self.probe_ms,
+                    "current": self._current.to_dict()
+                    if self._current else None}

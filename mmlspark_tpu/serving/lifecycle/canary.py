@@ -376,7 +376,8 @@ class LifecyclePlane:
             target=cfg.slo_target, windows_s=tuple(cfg.slo_windows_s))
         self.registry = ModelRegistry(slo_config=slo_cfg,
                                       journal_cap=cfg.journal_cap,
-                                      clock=clock)
+                                      clock=clock,
+                                      namespace=self._hooks.get("namespace"))
         self.controller = CanaryController(
             self.registry, cfg, apply_swap=self._apply_swap,
             warm=self._hooks.get("warm"), clock=clock)

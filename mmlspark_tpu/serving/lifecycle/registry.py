@@ -168,7 +168,12 @@ class ModelRegistry:
     """
 
     def __init__(self, slo_config: Optional[obs_perf.SLOConfig] = None,
-                 journal_cap: int = 256, clock=time.monotonic):
+                 journal_cap: int = 256, clock=time.monotonic,
+                 namespace: Optional[str] = None):
+        #: journal namespace (serving/multimodel: the owning model's name,
+        #: stamped as ``ns`` on every entry so N registries' journals stay
+        #: attributable after aggregation); None = the single-model plane
+        self.namespace = namespace
         self._slo_config = slo_config
         self._clock = clock
         self._lock = threading.Lock()
@@ -185,6 +190,8 @@ class ModelRegistry:
     # -- journal ---------------------------------------------------------
     def _log(self, action: str, **info: Any) -> None:
         entry = {"action": action, "t": round(self._clock(), 3), **info}
+        if self.namespace is not None:
+            entry["ns"] = self.namespace
         if len(self.journal) >= self._journal_cap:
             del self.journal[: self._journal_cap // 4]
         self.journal.append(entry)

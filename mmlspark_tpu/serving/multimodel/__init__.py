@@ -1,0 +1,14 @@
+"""Dense multi-model serving: the ModelMall plane (docs/multimodel.md).
+
+One worker, N independent fitted pipelines behind the existing substrate:
+per-model lifecycle planes routed by ``X-MMLSpark-Model``, cost-packed
+onto replicas, brownout-aware eviction to the persistent tier, and AutoML
+trials scheduled onto idle capacity.
+"""
+
+from .mall import (MODEL_HEADER, MallConfig, ModelMall, make_multimodel,
+                   model_from_body)
+from .automl import AutoMLScheduler, make_automl
+
+__all__ = ["MODEL_HEADER", "MallConfig", "ModelMall", "make_multimodel",
+           "model_from_body", "AutoMLScheduler", "make_automl"]

@@ -1,0 +1,221 @@
+"""AutoML-on-serve: hyperparameter trials scheduled onto idle capacity.
+
+``TuneHyperparameters`` (automl/tuning.py) searches a param grid offline —
+fit, fold-evaluate, pick. This module runs the SAME search continuously
+against live traffic: each grid point becomes a *trial candidate* deployed
+as a shadow version on the target model's lifecycle plane, scored by the
+existing divergence/burn gates and promoted through the canary ramp —
+population-based train-on-serve, the TVM measure->select loop applied to
+the model population instead of the kernel population (PAPERS.md, same
+framing as the compiler-search PR).
+
+The capacity contract (the acceptance criterion): a trial may only START
+while the packing plan's ``idle_share`` is at or above ``min_idle_share``,
+and it is INSTANTLY shed (``controller.rollback(..., "traffic_reclaim")``)
+the moment idle capacity falls below ``shed_idle_share`` — live-model
+traffic never pays for a trial. Shadow duplicates already ride the plane's
+bounded drop-don't-block queue, so even a running trial adds zero serving
+latency; the shed rule bounds the *compute* it may consume. Every launch,
+promotion, shed and rollback is journaled (bounded, tuner idiom).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from ..lifecycle.registry import CANARY, LIVE, ROLLED_BACK, SHADOWING
+
+__all__ = ["AutoMLScheduler", "make_automl"]
+
+
+def _param_dicts(grid: Any) -> Iterator[Dict[str, Any]]:
+    """Normalize a trial source into plain ``{param: value}`` dicts:
+    GridSpace/ParamSpace yield ``[(est, name, value), ...]`` lists from
+    ``param_maps()``; a plain iterable of dicts passes through."""
+    maps = grid.param_maps() if hasattr(grid, "param_maps") else iter(grid)
+    for pm in maps:
+        if isinstance(pm, dict):
+            yield dict(pm)
+        else:
+            yield {name: value for (_est, name, value) in pm}
+
+
+class AutoMLScheduler:
+    """Turn a param grid into canary-gated trials on idle capacity.
+
+    ``grid``   GridSpace / ParamSpace (automl/params.py) or an iterable of
+               ``{param: value}`` dicts — the trial population.
+    ``build``  callable(params) -> fitted transform for one candidate (the
+               caller owns training; the scheduler owns scheduling).
+    ``model``  target model name in the mall (None = the default model).
+
+    One trial is in flight at a time (the lifecycle plane's one-rollout
+    invariant); ``max_trials`` bounds the population (defaults to the
+    grid's ``space_size()`` when it has one, else 8).
+    """
+
+    def __init__(self, grid: Any, build: Callable[[Dict[str, Any]], Any],
+                 *, model: Optional[str] = None,
+                 min_idle_share: float = 0.25,
+                 shed_idle_share: float = 0.10,
+                 max_trials: Optional[int] = None,
+                 version_prefix: str = "trial-",
+                 journal_cap: int = 256,
+                 clock: Callable[[], float] = time.monotonic):
+        if not callable(build):
+            raise TypeError("automl build hook must be callable")
+        if not 0.0 <= shed_idle_share <= min_idle_share <= 1.0:
+            raise ValueError("need 0 <= shed_idle_share <= min_idle_share "
+                             "<= 1")
+        self.grid = grid
+        self.build = build
+        self.model = model
+        self.min_idle_share = float(min_idle_share)
+        self.shed_idle_share = float(shed_idle_share)
+        if max_trials is None:
+            size = getattr(grid, "space_size", None)
+            max_trials = int(size()) if callable(size) else 8
+        self.max_trials = int(max_trials)
+        self.version_prefix = str(version_prefix)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._params = _param_dicts(grid)
+        self._active: Optional[Dict[str, Any]] = None
+        self._exhausted = False
+        self.trials_started = 0
+        self.trials_promoted = 0
+        self.trials_shed = 0
+        self.trials_rolled_back = 0
+        self._journal_cap = max(8, int(journal_cap))
+        self.journal: List[Dict[str, Any]] = []
+
+    def _log(self, action: str, **info: Any) -> None:
+        entry = {"action": action, "t": round(self._clock(), 3), **info}
+        if len(self.journal) >= self._journal_cap:
+            del self.journal[: self._journal_cap // 4]
+        self.journal.append(entry)
+
+    @property
+    def active(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._active) if self._active else None
+
+    # -- the scheduling tick ---------------------------------------------
+    def tick(self, plane: Any, idle_share: float) -> Optional[str]:
+        """One scheduling decision against the target model's lifecycle
+        plane. Returns the action taken ("launch"/"shed"/"promoted"/
+        "rolled_back") or None. Never raises — a failing candidate is a
+        journaled rollback, not a serving failure."""
+        if plane is None:
+            return None
+        with self._lock:
+            active = self._active
+        if active is not None:
+            return self._settle_or_shed(plane, active, idle_share)
+        return self._maybe_launch(plane, idle_share)
+
+    def _settle_or_shed(self, plane: Any, active: Dict[str, Any],
+                        idle_share: float) -> Optional[str]:
+        try:
+            ver = plane.registry.get(active["version"])
+        except KeyError:
+            with self._lock:
+                self._active = None
+            return None
+        wall = round(self._clock() - active["t0"], 3)
+        if ver.state == LIVE:
+            with self._lock:
+                self._active = None
+                self.trials_promoted += 1
+            self._log("promoted", version=ver.version,
+                      params=active["params"], wall_s=wall)
+            return "promoted"
+        if ver.state == ROLLED_BACK:
+            with self._lock:
+                self._active = None
+                self.trials_rolled_back += 1
+            self._log("rolled_back", version=ver.version,
+                      params=active["params"], wall_s=wall)
+            return "rolled_back"
+        if idle_share < self.shed_idle_share and \
+                ver.state in (SHADOWING, CANARY):
+            # real traffic reclaimed the capacity: shed the trial NOW —
+            # the plane's public rollback, with the reclaim on the record
+            try:
+                plane.controller.rollback(ver, "traffic_reclaim",
+                                          idle_share=round(idle_share, 4))
+            except Exception:  # noqa: BLE001 — shedding must not raise
+                pass
+            with self._lock:
+                self._active = None
+                self.trials_shed += 1
+            self._log("shed", version=ver.version, params=active["params"],
+                      idle_share=round(idle_share, 4), wall_s=wall)
+            return "shed"
+        return None
+
+    def _maybe_launch(self, plane: Any, idle_share: float) -> Optional[str]:
+        with self._lock:
+            if self._exhausted or self.trials_started >= self.max_trials:
+                return None
+        if idle_share < self.min_idle_share:
+            return None
+        # the plane runs one rollout at a time; respect an operator rollout
+        if plane.controller.active_version() is not None:
+            return None
+        params = next(self._params, None)
+        if params is None:
+            with self._lock:
+                self._exhausted = True
+            self._log("exhausted", trials=self.trials_started)
+            return None
+        with self._lock:
+            self.trials_started += 1
+            n = self.trials_started
+        version = f"{self.version_prefix}{n}"
+        try:
+            transform = self.build(params)
+            ver = plane.deploy(transform, version=version)
+        except Exception as e:  # noqa: BLE001 — a broken candidate is
+            # search evidence, not a serving failure
+            self._log("launch_failed", version=version, params=params,
+                      error=str(e)[:200])
+            return None
+        with self._lock:
+            self._active = {"version": ver.version, "params": params,
+                            "t0": self._clock(),
+                            "idle_share": round(idle_share, 4)}
+        self._log("launch", version=ver.version, params=params,
+                  idle_share=round(idle_share, 4))
+        return "launch"
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"trials_started": self.trials_started,
+                    "trials_promoted": self.trials_promoted,
+                    "trials_shed": self.trials_shed,
+                    "trials_rolled_back": self.trials_rolled_back,
+                    "max_trials": self.max_trials,
+                    "exhausted": self._exhausted,
+                    "min_idle_share": self.min_idle_share,
+                    "shed_idle_share": self.shed_idle_share,
+                    "model": self.model,
+                    "active": dict(self._active) if self._active else None,
+                    "journal": list(self.journal[-16:])}
+
+
+def make_automl(spec: Any,
+                clock: Callable[[], float] = time.monotonic
+                ) -> Optional[AutoMLScheduler]:
+    """Coerce the mall's ``automl`` knob: None/False -> off, dict ->
+    AutoMLScheduler kwargs (``grid`` + ``build`` required), a built
+    scheduler passes through."""
+    if spec is None or spec is False:
+        return None
+    if isinstance(spec, AutoMLScheduler):
+        return spec
+    if isinstance(spec, dict):
+        return AutoMLScheduler(clock=clock, **spec)
+    raise TypeError(f"automl: cannot coerce {type(spec).__name__}")

@@ -1,0 +1,776 @@
+"""ModelMall: N independent fitted pipelines behind one worker.
+
+The reference framework's whole point is many models behind one substrate,
+but a ``ServingServer`` hosts exactly ONE transform. The mall closes that
+gap: it installs itself AS the server's transform (the lifecycle-plane
+idiom) and routes each ingress row to one of N *per-model* lifecycle
+planes by the ``X-MMLSpark-Model`` header (or an in-band ``"model"`` JSON
+column; absent -> the default model). Every model keeps its own
+``LifecyclePlane`` — registry, canary controller, shadow scoring, SLO
+buckets — with a per-model journal namespace (``ns=<model>`` on every
+registry entry), all sharing the worker's existing TransferRing/SlotPool/
+CompileCache/PersistentCompileCache substrate.
+
+Control loops (all journaled, all one-step-rollback, all off the hot
+path — ticked from the server's batch heartbeat):
+
+  - **Packing** — a ``PackingPlanner`` (serving/fleet/planner.py)
+    bin-packs models onto replicas by ``predict_ms x forecast_rps``;
+    uncalibrated models get a measured-probe slot, never an invented
+    load number. The plan's ``idle_share`` is the AutoML budget.
+  - **Eviction** — cold models (no traffic for ``evict_idle_s``) are
+    parked to the persistent/object-store tier when residency exceeds
+    ``max_resident`` (halved while the brownout controller has a
+    degradation step applied — memory pressure sheds first); a model
+    receiving traffic is never evicted while it is the last live copy.
+    The next request restores it with an accounted AOT re-warm; new
+    models are warmed BEFORE they become routable (warm-before-admit).
+    The ``mall.evict`` chaos seam fires after the tier park and before
+    the resident drop: a crash mid-evict leaves the model servable from
+    the tier through the same accounted re-warm.
+  - **AutoML** — an ``AutoMLScheduler`` (multimodel/automl.py) deploys
+    grid candidates as shadow versions while the plan marks capacity
+    idle, and sheds them instantly when traffic reclaims it. Promotion
+    runs through the per-model canary ramp; the ``mall.swap`` seam fires
+    before the registry swap, so a crash mid-promotion leaves the
+    model's incumbent serving.
+
+``multimodel=None`` (the server default) constructs nothing: replies and
+metrics exposition stay bitwise-identical to a mall-less build —
+test-enforced like every prior plane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ...core import faults
+from ...core.dataframe import DataFrame
+from ..tenants import MODEL_HEADER, header_lookup
+from ..lifecycle.canary import CanaryConfig, LifecyclePlane
+from ..fleet.planner import (ModelDemand, PackingPlanner, PlannerConfig,
+                             forecast_rps)
+from .automl import AutoMLScheduler, make_automl
+
+__all__ = ["MODEL_HEADER", "MallConfig", "ModelMall", "make_multimodel"]
+
+#: in-band body sniff cap: bodies larger than this are never parsed for a
+#: ``"model"`` column (the header is the fast path; in-band is a courtesy)
+_INBAND_MAX_BYTES = 65536
+
+
+def model_from_body(value: Any) -> Optional[str]:
+    """Best-effort in-band model extraction: a JSON object body with a
+    top-level ``"model"`` string. Anything else (non-JSON, oversized,
+    malformed, non-object) reads as "no in-band model" — never an error."""
+    try:
+        if isinstance(value, (bytes, bytearray, memoryview)):
+            raw = bytes(value)
+        elif isinstance(value, str):
+            raw = value.encode("utf-8", "ignore")
+        else:
+            return None
+        if len(raw) > _INBAND_MAX_BYTES:
+            return None
+        head = raw.lstrip()
+        if not head.startswith(b"{") or b'"model"' not in raw:
+            return None
+        obj = json.loads(raw)
+        if not isinstance(obj, dict):
+            return None
+        m = obj.get("model")
+        m = str(m).strip() if m is not None else ""
+        return m or None
+    except Exception:  # noqa: BLE001 — sniffing never fails a request
+        return None
+
+
+@dataclasses.dataclass
+class MallConfig:
+    """The mall's envelope (``multimodel=`` dict keys on the server)."""
+
+    default_model: str = "default"
+    max_resident: int = 4
+    evict_idle_s: float = 30.0
+    check_interval_s: float = 1.0
+    probe_ms: float = 25.0
+    service_alpha: float = 0.3
+    journal_cap: int = 512
+    #: per-model lifecycle spec: None -> CanaryConfig defaults, dict ->
+    #: CanaryConfig kwargs, CanaryConfig -> shared by every model
+    lifecycle: Any = None
+    #: AutoML spec: None -> off, dict -> AutoMLScheduler kwargs,
+    #: AutoMLScheduler -> as-is (multimodel/automl.py)
+    automl: Any = None
+    #: packing envelope: None -> PlannerConfig defaults, dict ->
+    #: PlannerConfig kwargs, PlannerConfig -> as-is
+    packing: Any = None
+
+    def __post_init__(self):
+        if not str(self.default_model).strip():
+            raise ValueError("default_model must be non-empty")
+        if int(self.max_resident) < 1:
+            raise ValueError("max_resident must be >= 1")
+        if float(self.evict_idle_s) < 0:
+            raise ValueError("evict_idle_s must be >= 0")
+
+    def canary_config(self) -> CanaryConfig:
+        lc = self.lifecycle
+        if isinstance(lc, CanaryConfig):
+            return lc
+        if isinstance(lc, dict):
+            return CanaryConfig(**lc)
+        return CanaryConfig()
+
+    def planner_config(self) -> PlannerConfig:
+        p = self.packing
+        if isinstance(p, PlannerConfig):
+            return p
+        if isinstance(p, dict):
+            return PlannerConfig(**p)
+        return PlannerConfig()
+
+
+class _ModelHost:
+    """The per-model stand-in for the server that a LifecyclePlane binds
+    to: just a transform and a reply column. ``_executor`` is None on
+    purpose — promotions inside a model mutate only that model's
+    registry; the MALL stays the executor's installed transform, so no
+    executor flip is needed (the plane routes via ``registry.live`` per
+    batch)."""
+
+    __slots__ = ("transform", "reply_col", "_executor")
+
+    def __init__(self, transform: Callable, reply_col: str):
+        self.transform = transform
+        self.reply_col = reply_col
+        self._executor = None
+
+
+class _ModelEntry:
+    """Mall-side bookkeeping for one admitted model."""
+
+    __slots__ = ("name", "plane", "host", "state", "token", "admitted_s",
+                 "last_used_s", "evicted_s", "requests", "service_ms",
+                 "rewarms", "rewarm_seconds", "_buckets")
+
+    def __init__(self, name: str, plane: LifecyclePlane, host: _ModelHost,
+                 now: float):
+        self.name = name
+        self.plane: Optional[LifecyclePlane] = plane
+        self.host = host
+        self.state = "resident"            # "resident" | "evicted"
+        self.token: Any = None             # tier park token while evicted
+        self.admitted_s = now
+        self.last_used_s = now
+        self.evicted_s: Optional[float] = None
+        self.requests = 0
+        #: measured per-row service EWMA (ms) — the probe measurement that
+        #: graduates an uncalibrated model into real packing
+        self.service_ms: Optional[float] = None
+        self.rewarms = 0
+        self.rewarm_seconds = 0.0
+        #: per-second (second, total, breaches) arrival triples, the
+        #: forecast_rps input shape (obs SLOTracker bucket contract)
+        self._buckets: List[List[float]] = []
+
+    def note_arrival(self, rows: int, now: float,
+                     max_history_s: int = 600) -> None:
+        sec = int(now)
+        if self._buckets and self._buckets[-1][0] == sec:
+            self._buckets[-1][1] += rows
+        else:
+            self._buckets.append([sec, float(rows), 0.0])
+            while self._buckets and sec - self._buckets[0][0] > max_history_s:
+                self._buckets.pop(0)
+        self.last_used_s = now
+        self.requests += rows
+
+    def observe_service(self, per_row_ms: float, alpha: float) -> None:
+        if per_row_ms <= 0:
+            return
+        if self.service_ms is None:
+            self.service_ms = per_row_ms
+        else:
+            self.service_ms = alpha * per_row_ms \
+                + (1.0 - alpha) * self.service_ms
+
+    def arrival_snapshot(self) -> List[Tuple[float, float, float]]:
+        return [tuple(b) for b in self._buckets]
+
+
+class ModelMall:
+    """The model-fleet plane, installed AS the server's transform.
+
+    Hooks (all optional):
+      ``warm(model, version)``        AOT-warm a model's executables
+                                      (warm-before-admit + re-warm)
+      ``evict_store(model, plane)``   park a plane to the persistent /
+                                      object-store tier, return a token
+      ``evict_load(model, token)``    restore a parked plane
+      ``predict_ms(model)``           cost model's per-row estimate (None
+                                      -> the mall's own measured EWMA)
+      ``replicas()``                  packing width (default: the live
+                                      executor's replica count, else 1)
+      ``live_copies(model)``          fleet-wide live copies of a model
+                                      (default 1 — never evict a model
+                                      receiving traffic on a lone worker)
+      ``live_version``/``live_stage``/``live_cost``
+                                      bootstrap identity of the default
+                                      model (the lifecycle hook trio)
+    """
+
+    def __init__(self, config: Optional[MallConfig] = None, *,
+                 hooks: Optional[Dict[str, Any]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config if config is not None else MallConfig()
+        self._hooks = dict(hooks or {})
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._server: Any = None
+        self._reply_col = "reply"
+        self._models: Dict[str, _ModelEntry] = {}
+        self.planner = PackingPlanner(self.config.planner_config(),
+                                      probe_ms=self.config.probe_ms,
+                                      journal_cap=self.config.journal_cap)
+        self.automl: Optional[AutoMLScheduler] = \
+            make_automl(self.config.automl, clock=clock)
+        self._last_tick = 0.0
+        self._started = False
+        self.evictions = 0
+        self.evict_crashes = 0
+        self.rewarms = 0
+        self.swaps = 0
+        self.unknown_requests = 0
+        self._journal_cap = max(8, int(self.config.journal_cap))
+        self.journal: List[Dict[str, Any]] = []
+
+    # -- journal (per-model namespace: every entry carries model=) -------
+    def _log(self, action: str, model: Optional[str] = None,
+             **info: Any) -> None:
+        entry = {"action": action, "t": round(self._clock(), 3), **info}
+        if model is not None:
+            entry["model"] = model
+        with self._lock:
+            if len(self.journal) >= self._journal_cap:
+                del self.journal[: self._journal_cap // 4]
+            self.journal.append(entry)
+
+    def journal_for(self, model: str, last: int = 32) -> List[Dict[str, Any]]:
+        """One model's slice of the mall journal (its registry journal —
+        stamped ``ns=<model>`` — lives on the plane itself)."""
+        with self._lock:
+            ours = [dict(e) for e in self.journal
+                    if e.get("model") == model]
+        return ours[-int(last):]
+
+    # -- attribute forwarding: fleet/tuner introspection through the
+    # default model (mega_k, set_mega_k, snapshot hooks, ...)
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        models = self.__dict__.get("_models") or {}
+        cfg = self.__dict__.get("config")
+        entry = models.get(cfg.default_model) if cfg is not None else None
+        if entry is None or entry.plane is None:
+            raise AttributeError(name)
+        return getattr(entry.plane, name)
+
+    # -- wiring -----------------------------------------------------------
+    def bind(self, server: Any) -> "ModelMall":
+        """Adopt ``server.transform`` as the DEFAULT model and return the
+        mall (the server installs the return value as its transform)."""
+        self._server = server
+        self._reply_col = getattr(server, "reply_col", "reply")
+        if self.config.default_model not in self._models:
+            self._admit(self.config.default_model, server.transform,
+                        version=self._hooks.get("live_version"),
+                        stage=self._hooks.get("live_stage"),
+                        cost=self._hooks.get("live_cost"),
+                        warm=False)  # the incumbent is already warm
+        return self
+
+    def start(self) -> None:
+        with self._lock:
+            self._started = True
+            planes = [e.plane for e in self._models.values()
+                      if e.plane is not None]
+        for p in planes:
+            p.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._started = False
+            planes = [e.plane for e in self._models.values()
+                      if e.plane is not None]
+        for p in planes:
+            try:
+                p.stop()
+            except Exception:  # noqa: BLE001 — shutdown stays best-effort
+                pass
+
+    # -- model admission ---------------------------------------------------
+    def _make_plane(self, name: str, transform: Callable, *,
+                    version: Optional[str], stage: Any,
+                    cost: Optional[dict]
+                    ) -> Tuple[LifecyclePlane, _ModelHost]:
+        hooks: Dict[str, Any] = {"namespace": name,
+                                 "live_version": version,
+                                 "live_stage": stage,
+                                 "live_cost": cost}
+        warm = self._hooks.get("warm")
+        if warm is not None:
+            hooks["warm"] = lambda ver, _m=name: warm(_m, ver)
+        plane = LifecyclePlane(self.config.canary_config(), hooks=hooks,
+                               clock=self._clock)
+        host = _ModelHost(transform, self._reply_col)
+        plane.bind(host)
+        # promotion apply: the mall's chaos seam instead of an executor
+        # flip (the mall stays the executor's transform; sub-plane swaps
+        # only move that model's registry.live pointer)
+        plane.controller._apply_swap = \
+            lambda new, old, _m=name, _h=host: \
+            self._apply_model_swap(_m, _h, new, old)
+        return plane, host
+
+    def _apply_model_swap(self, model: str, host: _ModelHost,
+                          new: Any, old: Any) -> None:
+        """swap_live's ``apply`` for a per-model promotion: the seam fires
+        BEFORE any state mutates — a raising plan aborts the swap with the
+        incumbent version serving (registry.swap_live's contract)."""
+        faults.fire(faults.MALL_SWAP, model=model, version=new.version,
+                    incumbent=old.version if old is not None else None)
+        host.transform = new.transform
+        with self._lock:
+            self.swaps += 1
+        self._log("swap", model=model, version=new.version,
+                  incumbent=old.version if old is not None else None)
+
+    def _admit(self, name: str, transform: Callable, *,
+               version: Optional[str] = None, stage: Any = None,
+               cost: Optional[dict] = None,
+               warm: bool = True) -> LifecyclePlane:
+        plane, host = self._make_plane(name, transform, version=version,
+                                       stage=stage, cost=cost)
+        warm_s = 0.0
+        if warm:
+            # warm-before-admit: AOT-warm the executables BEFORE the model
+            # becomes routable, so its first request never pays a compile
+            hook = self._hooks.get("warm")
+            if hook is not None:
+                t0 = time.perf_counter()
+                try:
+                    hook(name, plane.registry.live)
+                except Exception:  # noqa: BLE001 — a failed warm admits
+                    # cold (accounted), it never blocks admission
+                    pass
+                warm_s = time.perf_counter() - t0
+        now = self._clock()
+        with self._lock:
+            if name in self._models:
+                raise ValueError(f"model {name!r} already admitted")
+            entry = _ModelEntry(name, plane, host, now)
+            self._models[name] = entry
+            started = self._started
+        if started:
+            plane.start()
+        self._log("admit", model=name, warm_s=round(warm_s, 6),
+                  version=plane.registry.live.version
+                  if plane.registry.live else None)
+        self._evict_pass(now)
+        return plane
+
+    def add_model(self, name: str, transform: Callable, *,
+                  version: Optional[str] = None, stage: Any = None,
+                  cost: Optional[dict] = None) -> LifecyclePlane:
+        """Admit a fitted pipeline under ``name`` (warm-before-admit).
+        Returns that model's lifecycle plane."""
+        name = str(name).strip()
+        if not name:
+            raise ValueError("model name must be non-empty")
+        return self._admit(name, transform, version=version, stage=stage,
+                           cost=cost, warm=True)
+
+    def models(self) -> Dict[str, str]:
+        """{model: state} for every admitted model."""
+        with self._lock:
+            return {n: e.state for n, e in self._models.items()}
+
+    def has_model(self, name: str) -> bool:
+        """Servable (resident OR parked in the tier — a request re-warms)."""
+        with self._lock:
+            return name in self._models
+
+    def plane_for(self, name: str) -> Optional[LifecyclePlane]:
+        with self._lock:
+            e = self._models.get(name)
+            return e.plane if e is not None else None
+
+    # -- routing key -------------------------------------------------------
+    def model_of(self, headers: Any, value: Any = None) -> Optional[str]:
+        """The request's explicit model key: ``X-MMLSpark-Model`` header
+        first, then the in-band JSON ``"model"`` column; None when the
+        request names no model (-> the default model)."""
+        try:
+            m = header_lookup(headers, MODEL_HEADER)
+        except Exception:  # noqa: BLE001 — a weird headers shape routes
+            m = None       # to the default model, never errors
+        if m is not None:
+            return m
+        return model_from_body(value)
+
+    # -- data path ----------------------------------------------------------
+    def __call__(self, df: Any) -> Any:
+        if "headers" not in getattr(df, "columns", ()):
+            # non-ingress frame (warmup probe, direct call): default model
+            return self._dispatch(self.config.default_model, df,
+                                  int(getattr(df, "count", lambda: 1)()))
+        data = df.collect()
+        headers = data.get("headers")
+        values = data.get("value")
+        n = len(headers) if headers is not None else 0
+        default = self.config.default_model
+        groups: Dict[str, List[int]] = {}
+        unknown: List[int] = []
+        for i in range(n):
+            h = headers[i]
+            m = self.model_of(h, values[i] if values is not None else None)
+            m = m if m is not None else default
+            if self.has_model(m):
+                groups.setdefault(m, []).append(i)
+            else:
+                unknown.append(i)
+        if unknown:
+            self._shed_unknown(data, unknown)
+        if not groups:
+            return DataFrame.from_dict({"id": [], self._reply_col: []})
+        if not unknown and len(groups) == 1:
+            # whole batch is one model: route the frame untouched (the
+            # single-model fast path — bitwise-identical to a mall-less
+            # server when only the default model exists)
+            (name, idxs), = groups.items()
+            return self._dispatch(name, df, len(idxs))
+        outs = []
+        for name in sorted(groups):          # deterministic merge order
+            idxs = groups[name]
+            sub = DataFrame.from_dict(
+                {k: [data[k][i] for i in idxs] for k in data})
+            outs.append(self._dispatch(name, sub, len(idxs)))
+        return self._merge(outs)
+
+    def submit(self, df: Any):
+        """Async-dispatch face: the mall declines (returns None) so the
+        executor falls back to the synchronous ``run`` path — per-row
+        routing needs the materialized frame."""
+        return None
+
+    def _shed_unknown(self, data: Dict[str, Any],
+                      unknown: List[int]) -> None:
+        srv = self._server
+        ids = data.get("id")
+        with self._lock:
+            self.unknown_requests += len(unknown)
+        if srv is None or ids is None:
+            return
+        body = b'{"error": "unknown model"}'
+        for i in unknown:
+            try:
+                srv.stats.record_shed(404, "unknown_model")
+                srv._fulfill(int(ids[i]), 404, body,
+                             content_type="application/json")
+            except Exception:  # noqa: BLE001 — shedding never kills a batch
+                pass
+
+    def _dispatch(self, name: str, df: Any, rows: int) -> Any:
+        entry = self._ensure_resident(name)
+        now = self._clock()
+        with self._lock:
+            entry.note_arrival(max(1, rows), now)
+        plane = entry.plane
+        t0 = time.perf_counter()
+        out = plane(df)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            entry.observe_service(dur_ms / max(1, rows),
+                                  self.config.service_alpha)
+        return out
+
+    def _merge(self, outs: List[Any]) -> Any:
+        cols = [o.collect() if hasattr(o, "collect") else dict(o)
+                for o in outs]
+        keys = set(cols[0])
+        for c in cols[1:]:
+            keys &= set(c)
+        merged: Dict[str, List[Any]] = {k: [] for k in sorted(keys)}
+        for c in cols:
+            for k in merged:
+                merged[k].extend(list(c[k]))
+        return DataFrame.from_dict(merged)
+
+    # -- eviction / re-warm --------------------------------------------------
+    def _ensure_resident(self, name: str) -> _ModelEntry:
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError(f"unknown model {name!r}")
+            if entry.state == "resident":
+                return entry
+            t0 = time.perf_counter()
+            load = self._hooks.get("evict_load")
+            plane = load(name, entry.token) if load is not None \
+                else entry.token
+            if plane is None:
+                raise RuntimeError(f"model {name!r} lost from the tier")
+            # AOT re-warm BEFORE the model takes traffic again
+            warm = self._hooks.get("warm")
+            if warm is not None:
+                try:
+                    warm(name, plane.registry.live)
+                except Exception:  # noqa: BLE001 — accounted cold serve
+                    pass
+            entry.plane = plane
+            entry.token = None
+            entry.state = "resident"
+            entry.evicted_s = None
+            wall = time.perf_counter() - t0
+            entry.rewarms += 1
+            entry.rewarm_seconds += wall
+            self.rewarms += 1
+            started = self._started
+        if started:
+            plane.start()
+        self._log("rewarm", model=name, wall_s=round(wall, 6))
+        return entry
+
+    def _brownout_active(self) -> bool:
+        ctrl = getattr(self._server, "_brownout", None) \
+            if self._server is not None else None
+        if ctrl is None:
+            return False
+        try:
+            return int(ctrl.step) > 0
+        except Exception:  # noqa: BLE001 — a broken controller reads calm
+            return False
+
+    def _evict_pass(self, now: float) -> int:
+        limit = int(self.config.max_resident)
+        if self._brownout_active():
+            # brownout-aware: a degradation step means the worker is under
+            # pressure — halve residency so cold models shed memory first
+            limit = max(1, limit // 2)
+        with self._lock:
+            resident = [e for e in self._models.values()
+                        if e.state == "resident"]
+            if len(resident) <= limit:
+                return 0
+            live_copies = self._hooks.get("live_copies")
+            cands = []
+            for e in resident:
+                if e.name == self.config.default_model:
+                    continue  # the incumbent transform is never parked
+                hot = (now - e.last_used_s) < self.config.evict_idle_s
+                if hot:
+                    try:
+                        copies = int(live_copies(e.name)) \
+                            if live_copies is not None else 1
+                    except Exception:  # noqa: BLE001 — unknown reads lone
+                        copies = 1
+                    if copies <= 1:
+                        # never evict the last live copy of a model that
+                        # is receiving traffic
+                        continue
+                cands.append(e)
+            cands.sort(key=lambda e: (e.last_used_s, e.name))  # coldest 1st
+            excess = len(resident) - limit
+            victims = cands[:max(0, excess)]
+        evicted = 0
+        for e in victims:
+            if self._evict(e, now):
+                evicted += 1
+        return evicted
+
+    def _evict(self, entry: _ModelEntry, now: float) -> bool:
+        plane = entry.plane
+        if plane is None:
+            return False
+        store = self._hooks.get("evict_store")
+        try:
+            # park to the tier FIRST — the tier copy is the safety net a
+            # mid-evict crash falls back on
+            token = store(entry.name, plane) if store is not None else plane
+        except Exception:  # noqa: BLE001 — an unwritable tier means the
+            # model simply stays resident (accounted skip, PR 13 idiom)
+            self._log("evict_skipped", model=entry.name,
+                      reason="store_failed")
+            return False
+        crashed = False
+        try:
+            faults.fire(faults.MALL_EVICT, model=entry.name)
+        except Exception:  # noqa: BLE001 — injected crash mid-evict: the
+            # resident copy is gone either way; the tier copy serves
+            crashed = True
+        try:
+            plane.stop()
+        except Exception:  # noqa: BLE001 — a wedged shadow thread must
+            # not block the eviction pass
+            pass
+        with self._lock:
+            entry.plane = None
+            entry.token = token
+            entry.state = "evicted"
+            entry.evicted_s = now
+            self.evictions += 1
+            if crashed:
+                self.evict_crashes += 1
+        self._log("evict", model=entry.name, crashed=crashed,
+                  idle_s=round(now - entry.last_used_s, 3))
+        return True
+
+    # -- control loop ---------------------------------------------------------
+    def tick(self, e2e_s: float) -> None:
+        """The server's batch heartbeat: tick every resident plane (their
+        canary controllers rate-limit internally), then — at most every
+        ``check_interval_s`` — refresh the packing plan, run the eviction
+        pass and give the AutoML scheduler its capacity decision."""
+        with self._lock:
+            planes = [e.plane for e in self._models.values()
+                      if e.plane is not None]
+        for p in planes:
+            try:
+                p.tick(e2e_s)
+            except Exception:  # noqa: BLE001 — a model's controller error
+                # must not stall the others
+                pass
+        now = self._clock()
+        with self._lock:
+            if now - self._last_tick < self.config.check_interval_s:
+                return
+            self._last_tick = now
+        try:
+            plan = self._plan_tick(now)
+            self._evict_pass(now)
+            if self.automl is not None:
+                idle = self._idle_share(plan)
+                target = self.automl.model or self.config.default_model
+                with self._lock:
+                    e = self._models.get(target)
+                    plane = e.plane if e is not None \
+                        and e.state == "resident" else None
+                act = self.automl.tick(plane, idle)
+                if act is not None:
+                    self._log("automl", model=target, event=act,
+                              idle_share=round(idle, 4))
+        except Exception:  # noqa: BLE001 — the control loop never kills
+            # the batch path it is riding
+            pass
+
+    def _replicas(self) -> int:
+        hook = self._hooks.get("replicas")
+        if hook is not None:
+            try:
+                return max(1, int(hook()))
+            except Exception:  # noqa: BLE001 — fall through to the live set
+                pass
+        srv = self._server
+        ex = getattr(srv, "_executor", None) if srv is not None else None
+        if ex is not None:
+            try:
+                return max(1, len(ex.replicas.replicas))
+            except Exception:  # noqa: BLE001 — executor mid-teardown
+                pass
+        return max(1, int(getattr(srv, "replicas", 1) or 1))
+
+    def _plan_tick(self, now: float):
+        predict = self._hooks.get("predict_ms")
+        demands = []
+        with self._lock:
+            entries = list(self._models.values())
+        for e in entries:
+            pm = None
+            if predict is not None:
+                try:
+                    pm = predict(e.name)
+                except Exception:  # noqa: BLE001 — no estimate is "probe"
+                    pm = None
+            if pm is None:
+                pm = e.service_ms  # the measured-probe graduation path
+            fc = forecast_rps(e.arrival_snapshot(), now=now)
+            demands.append(ModelDemand(model=e.name, predict_ms=pm,
+                                       forecast_rps=fc["forecast_rps"]))
+        plan = self.planner.plan(demands, self._replicas())
+        self._log("pack", models=len(demands),
+                  idle_share=round(plan.idle_share, 4),
+                  probes=list(plan.probes), reason=plan.reason)
+        return plan
+
+    def _idle_share(self, plan: Any) -> float:
+        """The AutoML budget: the plan's idle share, clamped by the live
+        executor's own idleness when one is attached — a saturated
+        executor vetoes trials even if the forecast looks calm."""
+        idle = float(plan.idle_share)
+        ex = getattr(self._server, "_executor", None) \
+            if self._server is not None else None
+        fn = getattr(ex, "idle_fraction", None)
+        if callable(fn):
+            try:
+                idle = min(idle, float(fn()))
+            except Exception:  # noqa: BLE001 — introspection best-effort
+                pass
+        return idle
+
+    # -- introspection -----------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            models: Dict[str, Any] = {}
+            for name, e in self._models.items():
+                d: Dict[str, Any] = {
+                    "state": e.state,
+                    "default": name == self.config.default_model,
+                    "requests": e.requests,
+                    "service_ms": round(e.service_ms, 4)
+                    if e.service_ms is not None else None,
+                    "rewarms": e.rewarms,
+                    "rewarm_seconds": round(e.rewarm_seconds, 6),
+                }
+                if e.plane is not None:
+                    d["lifecycle"] = e.plane.summary()
+                models[name] = d
+            counters = {"evictions": self.evictions,
+                        "evict_crashes": self.evict_crashes,
+                        "rewarms": self.rewarms,
+                        "swaps": self.swaps,
+                        "unknown_requests": self.unknown_requests}
+            journal = [dict(j) for j in self.journal[-16:]]
+        out = {"default_model": self.config.default_model,
+               "max_resident": self.config.max_resident,
+               "models": models,
+               "packing": self.planner.summary(),
+               "counters": counters,
+               "journal": journal}
+        if self.automl is not None:
+            out["automl"] = self.automl.summary()
+        return out
+
+
+def make_multimodel(spec: Any, hooks: Optional[Dict[str, Any]] = None,
+                    clock: Callable[[], float] = time.monotonic
+                    ) -> Optional[ModelMall]:
+    """Coerce the server's ``multimodel=`` knob: None/False -> off (the
+    bitwise-parity default), True -> MallConfig defaults, dict ->
+    MallConfig kwargs, MallConfig -> configured, a ModelMall passes
+    through (pre-wired malls keep their hooks)."""
+    if spec is None or spec is False:
+        return None
+    if isinstance(spec, ModelMall):
+        return spec
+    if spec is True:
+        return ModelMall(MallConfig(), hooks=hooks, clock=clock)
+    if isinstance(spec, MallConfig):
+        return ModelMall(spec, hooks=hooks, clock=clock)
+    if isinstance(spec, dict):
+        return ModelMall(MallConfig(**spec), hooks=hooks, clock=clock)
+    raise TypeError(f"multimodel: cannot coerce {type(spec).__name__}")

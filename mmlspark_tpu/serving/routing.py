@@ -151,6 +151,10 @@ class RoutingFront:
         self._workers: List[str] = []
         self._circuits: Dict[str, _WorkerCircuit] = {}
         self._capacity: Dict[str, int] = {}
+        # per-worker admitted-model lists (multimodel workers): purely
+        # informational capacity lines on /_mmlspark/workers — absent from
+        # the payload entirely while no worker registers models
+        self._models_by_worker: Dict[str, List[str]] = {}
         self._lock = threading.Lock()
         self._rr = itertools.count()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -194,15 +198,23 @@ class RoutingFront:
                              breach=True if status >= 500 else None)
 
     # -- worker management ------------------------------------------------
-    def register(self, address: str, capacity: int = 1) -> None:
+    def register(self, address: str, capacity: int = 1,
+                 models: Optional[List[str]] = None) -> None:
         """``capacity`` is the worker's concurrent-batch hint (its replica
         count under the async executor — ServingServer.capacity): weighted
-        round-robin sends a worker with R replicas R slots per cycle."""
+        round-robin sends a worker with R replicas R slots per cycle.
+        ``models`` (multimodel workers) lists the worker's admitted models
+        for the per-model capacity view on ``/_mmlspark/workers``."""
         with self._lock:
             if address not in self._workers:
                 self._workers.append(address)
             self._circuits[address] = _WorkerCircuit()
             self._capacity[address] = max(1, int(capacity))
+            if models:
+                self._models_by_worker[address] = \
+                    sorted({str(m) for m in models})
+            else:
+                self._models_by_worker.pop(address, None)
         if self._fabric is not None:
             # a journaled ring epoch (re-registration refreshes are not
             # epochs; a ring.rebalance crash is absorbed — previous epoch
@@ -215,6 +227,7 @@ class RoutingFront:
                 self._workers.remove(address)
             self._circuits.pop(address, None)
             self._capacity.pop(address, None)
+            self._models_by_worker.pop(address, None)
         if self._fabric is not None:
             self._fabric.note_deregister(address)
 
@@ -508,7 +521,8 @@ class RoutingFront:
             try:
                 msg = json.loads(body.decode())
                 self.register(msg["address"],
-                              capacity=int(msg.get("capacity", 1)))
+                              capacity=int(msg.get("capacity", 1)),
+                              models=msg.get("models"))
                 return (200, "application/json", b"{}")
             except Exception as e:  # noqa: BLE001
                 return (400, "application/json",
@@ -517,6 +531,25 @@ class RoutingFront:
             payload = {"workers": self.workers,
                        "states": self.worker_states,
                        "capacity": self.worker_capacities}
+            with self._lock:
+                by_worker = {w: list(ms)
+                             for w, ms in self._models_by_worker.items()}
+            if by_worker:
+                # per-model capacity lines (multimodel workers only — the
+                # section is absent while nobody registers models): for
+                # each model, which workers serve it and their summed
+                # routable capacity
+                per_model: Dict[str, Dict[str, Any]] = {}
+                caps = self.worker_capacities
+                states = self.worker_states
+                for w, ms in sorted(by_worker.items()):
+                    for m in ms:
+                        line = per_model.setdefault(
+                            m, {"workers": [], "capacity": 0})
+                        line["workers"].append(w)
+                        if states.get(w) != OPEN:
+                            line["capacity"] += caps.get(w, 1)
+                payload["models"] = per_model
             if self._hedge is not None:
                 payload["hedge"] = self._hedge.summary()
             if self._fabric is not None:
@@ -1050,14 +1083,20 @@ class RoutingFront:
 
 def register_worker(front_address: str, worker_address: str,
                     timeout: float = 10.0, token: Optional[str] = None,
-                    capacity: int = 1) -> None:
+                    capacity: int = 1,
+                    models: Optional[List[str]] = None) -> None:
     """Worker-side registration call (ServiceInfo POST parity).
 
     ``capacity``: concurrent-batch hint for weighted routing — pass the
-    worker's ``ServingServer.capacity`` (replica count under async_exec)."""
+    worker's ``ServingServer.capacity`` (replica count under async_exec).
+    ``models``: the worker's admitted model list (multimodel workers) for
+    the per-model capacity view on ``/_mmlspark/workers``."""
     from .server import _post_json
 
     parts = urlsplit(front_address)
     url = f"{parts.scheme}://{parts.netloc}{RoutingFront.REGISTER_PATH}"
-    _post_json(url, {"address": worker_address, "capacity": int(capacity)},
-               timeout=timeout, token=token)
+    msg: Dict[str, Any] = {"address": worker_address,
+                           "capacity": int(capacity)}
+    if models:
+        msg["models"] = [str(m) for m in models]
+    _post_json(url, msg, timeout=timeout, token=token)

@@ -275,6 +275,9 @@ class ServingServer:
     #: batched labeled-feedback ingress for train-on-serve (POST
     #: {"rows": [...], "labels": [...]}) — 404 when the plane is off
     FEEDBACK_PATH = "/_mmlspark/feedback"
+    #: model-mall view (serving/multimodel): admitted models, residency,
+    #: packing plan, AutoML trials — 404 when the multimodel plane is off
+    MALL_PATH = "/_mmlspark/mall"
 
     def __init__(self, transform: Callable[[DataFrame], DataFrame],
                  host: str = "127.0.0.1", port: int = 8898,
@@ -305,7 +308,8 @@ class ServingServer:
                  probe_fn: Optional[Callable] = None,
                  brownout=None, brownout_hooks=None,
                  fleet=None, fleet_hooks=None,
-                 lifecycle=None, lifecycle_hooks=None):
+                 lifecycle=None, lifecycle_hooks=None,
+                 multimodel=None, multimodel_hooks=None):
         self.transform = transform
         # optional provider of the device-ingest decomposition (queue/h2d/
         # compute/readback — parallel/ingest.IngestStats.summary) merged into
@@ -399,6 +403,17 @@ class ServingServer:
         self._lifecycle_spec = lifecycle
         self._lifecycle_hooks = dict(lifecycle_hooks or {})
         self._lifecycle = None
+        # model mall (serving/multimodel): N independent fitted pipelines
+        # routed by X-MMLSpark-Model through per-model lifecycle planes,
+        # cost-packed onto replicas, with idle-capacity AutoML trials.
+        # None/False = off (the default — multimodel=None stays
+        # bitwise-identical in replies AND metrics exposition). Built in
+        # start() BEFORE the replica set, like the lifecycle plane; when
+        # both knobs are set the mall owns the per-model planes and the
+        # lifecycle spec becomes every model's canary config.
+        self._multimodel_spec = multimodel
+        self._multimodel_hooks = dict(multimodel_hooks or {})
+        self._multimodel = None
         self._executor = None
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         # wake latch: set on every enqueue and on stop(), so the batcher's
@@ -548,6 +563,11 @@ class ServingServer:
                     summary["lifecycle"] = self._lifecycle.summary()
                 except Exception as e:  # noqa: BLE001
                     summary["lifecycle"] = {"error": str(e)}
+            if self._multimodel is not None:
+                try:
+                    summary["multimodel"] = self._multimodel.summary()
+                except Exception as e:  # noqa: BLE001
+                    summary["multimodel"] = {"error": str(e)}
             if self._lat_hist is not None:
                 # bucket counts + trace-id exemplars, ALWAYS here (the
                 # exposition carries them only behind metrics_exemplars)
@@ -598,6 +618,19 @@ class ServingServer:
             try:
                 payload = json.dumps(
                     self._lifecycle.summary()).encode("utf-8")
+            except Exception as e:  # noqa: BLE001
+                return (500, "application/json", json.dumps(
+                    {"error": str(e)}).encode("utf-8"), None)
+            return (200, "application/json", payload, None)
+        if path == ServingServer.MALL_PATH:
+            # model-mall view (serving/multimodel): admitted models,
+            # residency state, the current packing plan, and AutoML trials
+            if self._multimodel is None:
+                return (404, "application/json",
+                        b'{"error": "multimodel disabled"}', None)
+            try:
+                payload = json.dumps(
+                    self._multimodel.summary()).encode("utf-8")
             except Exception as e:  # noqa: BLE001
                 return (500, "application/json", json.dumps(
                     {"error": str(e)}).encode("utf-8"), None)
@@ -661,6 +694,16 @@ class ServingServer:
                     {"error": f"bad frame: {e}"}).encode("utf-8"), None),
                     None, None, None, 0.0)
             frame_dur = time.perf_counter() - t0
+        if self._multimodel is not None:
+            # unknown-model 404 BEFORE admission: a request naming a model
+            # the mall never admitted must not burn a queue slot or a
+            # tenant's weighted-fair share
+            m = self._multimodel.model_of(headers, body)
+            if m is not None and not self._multimodel.has_model(m):
+                self.stats.record_shed(404, "unknown_model", tenant=tenant)
+                return ((404, "application/json",
+                         b'{"error": "unknown model"}', None),
+                        None, None, None, 0.0)
         if self._tenants is not None:
             if not self._tenants.try_admit(
                     tenant, self._queue.qsize(), self.max_queue):
@@ -1102,6 +1145,11 @@ class ServingServer:
                 self._lifecycle.tick(e2e_s)
             except Exception:  # noqa: BLE001 — rollout control must never
                 pass           # kill serving
+        if self._multimodel is not None:
+            try:
+                self._multimodel.tick(e2e_s)
+            except Exception:  # noqa: BLE001 — packing/eviction/trials must
+                pass           # never kill serving
 
     def _fleet_live_config(self) -> Dict[str, Any]:
         """The fleet controller's view of the live knob vector (its
@@ -1291,7 +1339,27 @@ class ServingServer:
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingServer":
-        if self._lifecycle_spec and self._lifecycle is None:
+        if self._multimodel_spec and self._multimodel is None:
+            from .multimodel import make_multimodel
+
+            # built FIRST (even before the lifecycle plane): the mall owns
+            # one LifecyclePlane PER model and replaces the transform with
+            # its router, so the replica set below captures the mall. A
+            # standalone lifecycle= spec folds in as every model's canary
+            # config rather than building a second, competing plane.
+            spec = self._multimodel_spec
+            if self._lifecycle_spec and self._lifecycle_spec is not True:
+                if spec is True:
+                    spec = {"lifecycle": self._lifecycle_spec}
+                elif isinstance(spec, dict) and "lifecycle" not in spec:
+                    spec = dict(spec, lifecycle=self._lifecycle_spec)
+            mall = make_multimodel(spec, hooks=self._multimodel_hooks)
+            if mall is not None:
+                self.transform = mall.bind(self)
+                mall.start()
+                self._multimodel = mall
+        if self._lifecycle_spec and self._lifecycle is None \
+                and self._multimodel is None:
             from .lifecycle import make_lifecycle
 
             # built FIRST: the plane adopts the configured transform as the
@@ -1442,6 +1510,11 @@ class ServingServer:
                 self._lifecycle.stop()
             except Exception:  # noqa: BLE001 — shutdown stays best-effort
                 pass
+        if self._multimodel is not None:
+            try:
+                self._multimodel.stop()
+            except Exception:  # noqa: BLE001 — shutdown stays best-effort
+                pass
         for t in self._threads:
             if t.name.endswith("-batcher"):
                 t.join(timeout=5)
@@ -1529,7 +1602,7 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                    supervise: bool = True,
                    watchdog_budget_s: Optional[float] = None,
                    brownout=None, fleet=False,
-                   lifecycle=False) -> ServingServer:
+                   lifecycle=False, multimodel=False) -> ServingServer:
     """Serve a fitted Transformer: request body -> ``input_col`` -> stage ->
     ``reply_col`` (IOImplicits fluent sugar parity, io/IOImplicits.scala:182-213).
 
@@ -1606,6 +1679,19 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
     mounted the promotion warm hook stages a candidate's executables into
     the persistent compile cache BEFORE it takes traffic (zero-compile
     promotion).
+
+    ``multimodel`` (off by default — disabled serving stays
+    bitwise-identical in replies AND metrics exposition) enables the
+    model mall (serving/multimodel, docs/multimodel.md): ``True`` for
+    defaults or a dict of MallConfig kwargs. The configured stage becomes
+    the DEFAULT model; further fitted pipelines admitted via
+    ``server.transform.add_model(name, fn)`` route by the
+    ``X-MMLSpark-Model`` header (or in-band ``"model"`` JSON column),
+    each behind its own per-model lifecycle plane. Models are cost-packed
+    onto replicas (``/_mmlspark/mall`` shows the plan), cold models park
+    to the tier with accounted re-warm, and an ``automl`` spec schedules
+    grid trials on idle capacity. A standalone ``lifecycle`` spec folds
+    in as every model's canary config.
     """
     from ..core.pipeline import PipelineModel
     from .stages import parse_request
@@ -1787,6 +1873,37 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
 
         lifecycle_hooks["warm"] = _warm
 
+    multimodel_hooks = None
+    if multimodel:
+        # the mall adopts the configured stage as the DEFAULT model. Its
+        # warm hook is the per-model twin of the lifecycle one: with a
+        # persistent compile-cache tier mounted, admitting / re-warming a
+        # model AOT-stages its executables BEFORE it takes traffic
+        # (warm-before-admit). The cost hook feeds the packing planner the
+        # tuner's calibrated per-row estimate for the default model; other
+        # models graduate through the mall's measured-probe EWMA.
+        multimodel_hooks = {"live_stage": stage}
+
+        def _mm_warm(model, ver, _tier=tier):
+            st = getattr(ver, "stage", None)
+            if st is None or not hasattr(st, "attach_persistent_cache"):
+                return "no stage cache"
+            if _tier is None:
+                return "no persistent tier"
+            st.attach_persistent_cache(_tier)
+            return "warmed"
+
+        multimodel_hooks["warm"] = _mm_warm
+        if tuner is not None:
+            _default = "default"
+            if isinstance(multimodel, dict):
+                _default = str(multimodel.get("default_model", "default"))
+
+            def _mm_predict(model, _t=tuner, _d=_default):
+                return _t.predict_row_ms() if model == _d else None
+
+            multimodel_hooks["predict_ms"] = _mm_predict
+
     return ServingServer(transform, host=host, port=port, api_path=api_path,
                          reply_col=reply_col, max_batch_size=max_batch_size,
                          max_wait_ms=max_wait_ms, token=token,
@@ -1809,4 +1926,6 @@ def serve_pipeline(stage, input_col: str, reply_col: str = "reply",
                          brownout_hooks=brownout_hooks,
                          fleet=fleet, fleet_hooks=fleet_hooks,
                          lifecycle=lifecycle,
-                         lifecycle_hooks=lifecycle_hooks)
+                         lifecycle_hooks=lifecycle_hooks,
+                         multimodel=multimodel,
+                         multimodel_hooks=multimodel_hooks)

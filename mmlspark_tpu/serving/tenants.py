@@ -25,11 +25,39 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Mapping, Optional
 
-__all__ = ["TENANT_HEADER", "TenantAdmission", "tenants_from_spec"]
+__all__ = ["TENANT_HEADER", "MODEL_HEADER", "TenantAdmission",
+           "tenants_from_spec", "header_lookup"]
 
 #: request header naming the admission class (absent -> "default")
 TENANT_HEADER = "X-MMLSpark-Tenant"
 DEFAULT_TENANT = "default"
+#: request header naming the target model in a multi-model worker
+#: (serving/multimodel; absent -> the mall's default model). Lives here —
+#: next to the other identity header — so the fabric's affinity fold and
+#: the mall share one constant without an import cycle.
+MODEL_HEADER = "X-MMLSpark-Model"
+
+
+def header_lookup(headers: Optional[Mapping[str, str]],
+                  name: str) -> Optional[str]:
+    """Case-insensitive single-header lookup (the ``tenant_of`` /
+    ``deadline_from_headers`` convention, factored out): exact and
+    lowercase keys first, then a linear scan; empty values read as
+    absent."""
+    if not headers:
+        return None
+    get = getattr(headers, "get", None)
+    v = None
+    if get is not None:
+        v = get(name) or get(name.lower())
+    if v is None:
+        low = name.lower()
+        for k in headers:
+            if str(k).lower() == low:
+                v = headers[k]
+                break
+    v = str(v).strip() if v is not None else ""
+    return v or None
 
 
 class TenantAdmission:
@@ -70,20 +98,7 @@ class TenantAdmission:
     def tenant_of(headers: Optional[Mapping[str, str]]) -> str:
         """Case-insensitive ``X-MMLSpark-Tenant`` lookup (same convention as
         ``deadline_from_headers``); absent or empty -> ``default``."""
-        if not headers:
-            return DEFAULT_TENANT
-        get = getattr(headers, "get", None)
-        v = None
-        if get is not None:
-            v = get(TENANT_HEADER) or get(TENANT_HEADER.lower())
-        if v is None:
-            low = TENANT_HEADER.lower()
-            for k in headers:
-                if str(k).lower() == low:
-                    v = headers[k]
-                    break
-        v = str(v).strip() if v is not None else ""
-        return v or DEFAULT_TENANT
+        return header_lookup(headers, TENANT_HEADER) or DEFAULT_TENANT
 
     def weight(self, tenant: str) -> float:
         return self.weights.get(tenant, self.default_weight)

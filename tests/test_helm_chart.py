@@ -285,6 +285,34 @@ class TestOptions:
         assert env["MMLSPARK_LIFECYCLE_STEPS"] == "0.01,0.05,0.25,1.0"
         assert env["MMLSPARK_LIFECYCLE_BURN_GATE"] == "1.0"
 
+    def test_multimodel_defaults_off(self):
+        # defaults: no mall env, and the bootstrap passes
+        # multimodel=None (bitwise-identical serving)
+        _, docs = render_docs()
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        wc = worker["spec"]["template"]["spec"]["containers"][0]
+        env = [e["name"] for e in wc["env"]]
+        assert "MMLSPARK_MULTIMODEL" not in env
+        assert "multimodel=multimodel" in wc["args"][0]
+
+    def test_multimodel_env_plumbing(self):
+        _, docs = render_docs({"multimodel": {
+            "enabled": True, "defaultModel": "ranker",
+            "maxResident": 2}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MMLSPARK_MULTIMODEL"] == "true"
+        assert env["MMLSPARK_MULTIMODEL_DEFAULT_MODEL"] == "ranker"
+        assert env["MMLSPARK_MULTIMODEL_MAX_RESIDENT"] == "2"
+        # defaults survive a bare enabled=true
+        _, docs = render_docs({"multimodel": {"enabled": True}})
+        worker = by_kind_name(docs, "Deployment", "-worker")
+        env = {e["name"]: e.get("value") for e in
+               worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MMLSPARK_MULTIMODEL_DEFAULT_MODEL"] == "default"
+        assert env["MMLSPARK_MULTIMODEL_MAX_RESIDENT"] == "4"
+
     def test_bootstrap_python_compiles(self):
         """The pod commands are Python source built by the templates; a
         template expression the renderer can't evaluate (the old

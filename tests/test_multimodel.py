@@ -1,0 +1,833 @@
+"""Model-mall suite (serving/multimodel, docs/multimodel.md).
+
+Covers the routing key contract (header / in-band / default), mall
+admission and the warm-before-admit journal, the deterministic packing
+planner (FFD by predict_ms x forecast_rps, probe slots for uncalibrated
+models, journaled one-step rollback), brownout-aware eviction with the
+accounted AOT re-warm (bitwise replies across the park/restore cycle),
+the AutoML-on-idle scheduler (never launches below the idle floor, sheds
+the instant traffic reclaims capacity), per-model journal namespaces,
+and the serving wiring: ``/_mmlspark/mall``, the stats section, the
+``mmlspark_mall_*`` metric families, unknown-model 404 at preflight,
+and ``multimodel=False`` bitwise parity. The ``mall.swap``/``mall.evict``
+chaos classes run in the CI chaos-seeds lane (``-m faults``).
+"""
+
+import json
+import os
+import random
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from mmlspark_tpu.core import faults  # noqa: E402
+from mmlspark_tpu.core.dataframe import DataFrame  # noqa: E402
+from mmlspark_tpu.core.faults import FaultInjector, InjectedFault  # noqa: E402
+from mmlspark_tpu.serving.fleet import (  # noqa: E402
+    ModelDemand,
+    PackingPlanner,
+    pack_models,
+)
+from mmlspark_tpu.serving.fleet.planner import PlannerConfig  # noqa: E402
+from mmlspark_tpu.serving.lifecycle import (  # noqa: E402
+    CANARY,
+    LIVE,
+    ROLLED_BACK,
+    SHADOWING,
+    CanaryConfig,
+    LifecyclePlane,
+)
+from mmlspark_tpu.serving.multimodel import (  # noqa: E402
+    MODEL_HEADER,
+    AutoMLScheduler,
+    MallConfig,
+    ModelMall,
+    make_multimodel,
+)
+from mmlspark_tpu.serving.multimodel.automl import make_automl  # noqa: E402
+from mmlspark_tpu.serving.multimodel.mall import model_from_body  # noqa: E402
+
+#: CI chaos lane replays the fault classes under several seeds
+CHAOS_SEED = int(os.environ.get("MMLSPARK_CHAOS_SEED", "0"))
+
+
+def _echo(df):
+    return df.with_column("reply", lambda p: p["value"])
+
+
+def _echo_twin(df):
+    """A distinct callable with byte-identical behavior."""
+    return df.with_column("reply", lambda p: p["value"])
+
+
+def _upper(df):
+    return df.with_column(
+        "reply", lambda p: [b"B:" + bytes(v) for v in p["value"]])
+
+
+def _df(ids, values, headers=None):
+    n = len(ids)
+    h = np.empty(n, dtype=object)
+    for i in range(n):
+        h[i] = (headers[i] if headers is not None else {})
+    return DataFrame.from_dict({
+        "id": np.asarray(ids, dtype=np.int64),
+        "value": np.asarray(values, dtype=object),
+        "headers": h,
+    })
+
+
+class _Clock:
+    """A hand-cranked monotonic clock for eviction/packing tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _Srv:
+    """Minimal server stand-in the mall can bind to."""
+
+    def __init__(self, transform, brownout_step=None):
+        self.transform = transform
+        self.reply_col = "reply"
+        if brownout_step is not None:
+            class _B:
+                step = brownout_step
+            self._brownout = _B()
+
+
+def _mall(cfg=None, transform=_echo, hooks=None, clock=None, srv=None):
+    clk = clock if clock is not None else _Clock()
+    mall = ModelMall(cfg if cfg is not None else MallConfig(),
+                     hooks=hooks, clock=clk)
+    mall.bind(srv if srv is not None else _Srv(transform))
+    return mall
+
+
+def _post(address, body, headers=None):
+    req = urllib.request.Request(address, data=body, method="POST",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return resp.status, resp.read()
+
+
+# ---------------------------------------------------------------------------
+# Routing key
+# ---------------------------------------------------------------------------
+
+class TestRoutingKey:
+    def test_header_routes(self):
+        mall = _mall()
+        assert mall.model_of({MODEL_HEADER: "b"}) == "b"
+
+    def test_header_case_insensitive(self):
+        mall = _mall()
+        assert mall.model_of({"x-mmlspark-model": "b"}) == "b"
+        assert mall.model_of({"X-MMLSPARK-MODEL": "c"}) == "c"
+
+    def test_header_beats_in_band(self):
+        mall = _mall()
+        body = b'{"model": "inband", "x": 1}'
+        assert mall.model_of({MODEL_HEADER: "hdr"}, body) == "hdr"
+
+    def test_in_band_model_column(self):
+        mall = _mall()
+        assert mall.model_of({}, b'{"model": "m1", "x": 1}') == "m1"
+        assert mall.model_of({}, '{"model": "m2"}') == "m2"
+
+    def test_absent_means_default(self):
+        mall = _mall()
+        assert mall.model_of({}, b'{"x": 1}') is None
+        assert mall.model_of(None, None) is None
+
+    def test_weird_headers_never_error(self):
+        mall = _mall()
+        # a non-mapping headers shape routes to the default, not a 500
+        assert mall.model_of("not-a-dict", b'{"x": 1}') is None
+
+    def test_model_from_body_edges(self):
+        assert model_from_body(b'{"model": "a"}') == "a"
+        assert model_from_body(b"not json {") is None
+        assert model_from_body(b'["model"]') is None
+        assert model_from_body(b'{"model": ""}') is None
+        assert model_from_body(b'{"model": null}') is None
+        assert model_from_body(12345) is None
+        # oversized bodies are never sniffed (the 64KiB courtesy cap)
+        big = b'{"model": "a", "pad": "' + b"x" * 70_000 + b'"}'
+        assert model_from_body(big) is None
+
+
+# ---------------------------------------------------------------------------
+# Config / coercion
+# ---------------------------------------------------------------------------
+
+class TestMallConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            MallConfig(default_model="  ")
+        with pytest.raises(ValueError):
+            MallConfig(max_resident=0)
+        with pytest.raises(ValueError):
+            MallConfig(evict_idle_s=-1.0)
+
+    def test_make_multimodel_coercion(self):
+        assert make_multimodel(None) is None
+        assert make_multimodel(False) is None
+        assert isinstance(make_multimodel(True), ModelMall)
+        m = make_multimodel({"default_model": "d", "max_resident": 2})
+        assert m.config.default_model == "d"
+        cfg = MallConfig(max_resident=3)
+        assert make_multimodel(cfg).config is cfg
+        pre = ModelMall(MallConfig())
+        assert make_multimodel(pre) is pre
+        with pytest.raises(TypeError):
+            make_multimodel(42)
+
+    def test_make_automl_coercion(self):
+        assert make_automl(None) is None
+        assert make_automl(False) is None
+        s = make_automl({"grid": [{"k": 1}], "build": lambda p: _echo})
+        assert isinstance(s, AutoMLScheduler)
+        assert make_automl(s) is s
+        with pytest.raises(TypeError):
+            make_automl("grid")
+
+
+# ---------------------------------------------------------------------------
+# Mall: admission + data path
+# ---------------------------------------------------------------------------
+
+class TestMallDataPath:
+    def test_bind_admits_default(self):
+        mall = _mall()
+        assert mall.models() == {"default": "resident"}
+        assert mall.has_model("default")
+        assert not mall.has_model("nope")
+        # bind adopted the incumbent without a warm (already warm)
+        admit = [e for e in mall.journal if e["action"] == "admit"]
+        assert admit and admit[0]["model"] == "default"
+        assert admit[0]["warm_s"] == 0.0
+
+    def test_add_model_and_header_routing(self):
+        mall = _mall()
+        mall.add_model("b", _upper)
+        out = mall(_df([1, 2], [b"x", b"y"],
+                       [{}, {MODEL_HEADER: "b"}])).collect()
+        replies = dict(zip(out["id"], out["reply"]))
+        assert replies[1] == b"x"
+        assert replies[2] == b"B:y"
+
+    def test_in_band_routing(self):
+        mall = _mall()
+        mall.add_model("b", _upper)
+        body = b'{"model": "b", "x": 1}'
+        out = mall(_df([1], [body], [{}])).collect()
+        assert out["reply"][0] == b"B:" + body
+
+    def test_single_model_fast_path_bitwise(self):
+        """A default-only mall routes whole frames untouched — replies
+        byte-identical to calling the transform directly."""
+        mall = _mall()
+        df = _df([1, 2, 3], [b"a", b"bb", b"ccc"])
+        direct = _echo(df).collect()["reply"]
+        via = mall(df).collect()["reply"]
+        assert list(direct) == list(via)
+
+    def test_unknown_model_counted_and_dropped(self):
+        mall = _mall()
+        out = mall(_df([1, 2], [b"x", b"y"],
+                       [{}, {MODEL_HEADER: "ghost"}])).collect()
+        assert list(out["id"]) == [1]
+        assert mall.unknown_requests == 1
+
+    def test_non_ingress_frame_goes_default(self):
+        """A frame without a headers column (warmup probe, direct call)
+        dispatches to the default model."""
+        mall = _mall()
+        df = DataFrame.from_dict({
+            "id": np.asarray([7], dtype=np.int64),
+            "value": np.asarray([b"probe"], dtype=object)})
+        assert mall(df).collect()["reply"][0] == b"probe"
+        assert mall._models["default"].requests == 1
+
+    def test_submit_declines_async(self):
+        assert _mall().submit(_df([1], [b"x"])) is None
+
+    def test_duplicate_admission_rejected(self):
+        mall = _mall()
+        mall.add_model("b", _upper)
+        with pytest.raises(ValueError):
+            mall.add_model("b", _upper)
+        with pytest.raises(ValueError):
+            mall.add_model("   ", _upper)
+
+    def test_per_model_journal_namespace(self):
+        """Every registry entry of a model's plane carries ns=<model>,
+        and the mall journal slices per model."""
+        mall = _mall()
+        plane_b = mall.add_model("b", _upper)
+        entries = plane_b.registry.summary()["journal"]
+        assert entries and all(e.get("ns") == "b" for e in entries)
+        plane_d = mall.plane_for("default")
+        d_entries = plane_d.registry.summary()["journal"]
+        assert d_entries and all(e.get("ns") == "default"
+                                 for e in d_entries)
+        ours = mall.journal_for("b")
+        assert ours and all(e["model"] == "b" for e in ours)
+        assert any(e["action"] == "admit" for e in ours)
+
+
+# ---------------------------------------------------------------------------
+# Packing
+# ---------------------------------------------------------------------------
+
+class TestPacking:
+    def _demands(self):
+        return [ModelDemand("a", 50.0, 10.0),   # load 500
+                ModelDemand("b", 30.0, 10.0),   # load 300
+                ModelDemand("probe", None, 1.0)]
+
+    def test_deterministic_and_order_independent(self):
+        d = self._demands()
+        p1 = pack_models(d, 2).to_dict()
+        p2 = pack_models(d, 2).to_dict()
+        shuffled = list(d)
+        random.Random(3).shuffle(shuffled)
+        p3 = pack_models(shuffled, 2).to_dict()
+        assert p1 == p2 == p3
+
+    def test_ffd_placement_and_budget(self):
+        # budget = 1000 * 0.7 = 700 ms/s per replica: a (500) fits r0,
+        # b (300) overflows r0 -> first-fit lands on r1
+        plan = pack_models([ModelDemand("a", 50.0, 10.0),
+                            ModelDemand("b", 30.0, 10.0)], 2)
+        assert plan.replica_of("a") == 0
+        assert plan.replica_of("b") == 1
+        assert plan.reason == "packed"
+        assert plan.capacity_ms == pytest.approx(700.0)
+        assert plan.replica_load == (500.0, 300.0)
+
+    def test_saturated_still_places_everyone(self):
+        plan = pack_models([ModelDemand("a", 80.0, 10.0),
+                            ModelDemand("b", 80.0, 10.0)], 1)
+        assert plan.reason == "saturated"
+        assert {m for m, _ in plan.placements} == {"a", "b"}
+        assert plan.idle_share == 0.0
+
+    def test_uncalibrated_gets_probe_slot(self):
+        plan = pack_models(self._demands(), 2, probe_ms=25.0)
+        assert plan.probes == ("probe",)
+        # the probe rides the least-loaded replica with a nominal charge
+        assert plan.replica_of("probe") == 1
+        assert plan.replica_load[1] == pytest.approx(325.0)
+
+    def test_idle_share_math(self):
+        plan = pack_models([ModelDemand("a", 10.0, 10.0)], 2)  # 100 of 1400
+        assert plan.idle_share == pytest.approx(1.0 - 100.0 / 1400.0)
+        assert plan.idle_replicas == (1,)
+        empty = pack_models([], 2)
+        assert empty.idle_share == 1.0
+
+    def test_planner_journal_and_one_step_rollback(self):
+        pl = PackingPlanner(PlannerConfig())
+        p1 = pl.plan([ModelDemand("a", 50.0, 10.0)], 2)
+        p2 = pl.plan([ModelDemand("a", 50.0, 10.0),
+                      ModelDemand("b", 30.0, 10.0)], 2)
+        assert pl.current is p2 and pl.plans_total == 2
+        acts = [e["action"] for e in pl.journal()]
+        assert acts == ["pack", "pack"]
+        restored = pl.rollback("operator")
+        assert restored.to_dict() == p1.to_dict()
+        assert pl.current.to_dict() == p1.to_dict()
+        assert pl.rollbacks == 1
+        assert pl.journal()[-1]["action"] == "rollback"
+        # one step only: a second rollback has nothing to restore
+        assert pl.rollback() is None
+
+
+# ---------------------------------------------------------------------------
+# Eviction / re-warm
+# ---------------------------------------------------------------------------
+
+class TestEviction:
+    def test_cold_model_parks_and_rewarms_bitwise(self):
+        clk = _Clock()
+        mall = _mall(MallConfig(max_resident=1, evict_idle_s=5.0,
+                                check_interval_s=0.0), clock=clk)
+        mall.add_model("b", _upper)
+        frame = lambda i: _df([i], [b"v"], [{MODEL_HEADER: "b"}])  # noqa: E731
+        before = mall(frame(1)).collect()["reply"][0]
+        assert mall.models()["b"] == "resident"  # hot -> not parked
+        clk.advance(10.0)
+        mall.tick(0.01)  # eviction pass: b is now cold and over budget
+        assert mall.models()["b"] == "evicted"
+        assert mall.has_model("b")  # parked is still servable
+        assert mall.evictions == 1
+        after = mall(frame(2)).collect()["reply"][0]
+        assert after == before == b"B:v"
+        assert mall.models()["b"] == "resident"
+        assert mall.rewarms == 1
+        entry = mall._models["b"]
+        assert entry.rewarms == 1 and entry.rewarm_seconds > 0.0
+        rewarm = [e for e in mall.journal if e["action"] == "rewarm"]
+        assert rewarm and rewarm[0]["model"] == "b"
+        assert rewarm[0]["wall_s"] >= 0.0
+
+    def test_default_model_never_parked(self):
+        clk = _Clock()
+        mall = _mall(MallConfig(max_resident=1, evict_idle_s=0.0,
+                                check_interval_s=0.0), clock=clk)
+        mall.add_model("b", _upper)  # admit's evict pass runs immediately
+        assert mall.models() == {"default": "resident", "b": "evicted"}
+
+    def test_last_live_copy_with_traffic_never_evicted(self):
+        clk = _Clock()
+        mall = _mall(MallConfig(max_resident=1, evict_idle_s=100.0,
+                                check_interval_s=0.0), clock=clk)
+        mall.add_model("b", _upper)
+        mall(_df([1], [b"v"], [{MODEL_HEADER: "b"}]))
+        mall._evict_pass(clk())
+        # over budget, but b is hot and this is its only live copy
+        assert mall.models()["b"] == "resident"
+
+    def test_fleet_copies_allow_hot_eviction(self):
+        clk = _Clock()
+        mall = _mall(MallConfig(max_resident=1, evict_idle_s=100.0,
+                                check_interval_s=0.0),
+                     hooks={"live_copies": lambda m: 2}, clock=clk)
+        mall.add_model("b", _upper)
+        mall(_df([1], [b"v"], [{MODEL_HEADER: "b"}]))
+        mall._evict_pass(clk())
+        assert mall.models()["b"] == "evicted"
+
+    def test_brownout_halves_residency(self):
+        clk = _Clock()
+        calm = _mall(MallConfig(max_resident=2, evict_idle_s=0.0,
+                                check_interval_s=0.0),
+                     srv=_Srv(_echo, brownout_step=0), clock=clk)
+        calm.add_model("b", _upper)
+        assert calm.models()["b"] == "resident"
+        hot = _mall(MallConfig(max_resident=2, evict_idle_s=0.0,
+                               check_interval_s=0.0),
+                    srv=_Srv(_echo, brownout_step=1), clock=clk)
+        hot.add_model("b", _upper)
+        assert hot.models()["b"] == "evicted"
+
+    def test_store_failure_skips_eviction(self):
+        def bad_store(model, plane):
+            raise IOError("tier unwritable")
+
+        clk = _Clock()
+        mall = _mall(MallConfig(max_resident=1, evict_idle_s=0.0,
+                                check_interval_s=0.0),
+                     hooks={"evict_store": bad_store}, clock=clk)
+        mall.add_model("b", _upper)
+        # an unwritable tier means the model stays resident, accounted
+        assert mall.models()["b"] == "resident"
+        assert mall.evictions == 0
+        skipped = [e for e in mall.journal
+                   if e["action"] == "evict_skipped"]
+        assert skipped and skipped[0]["reason"] == "store_failed"
+
+    def test_evict_store_load_round_trip(self):
+        tier = {}
+
+        def store(model, plane):
+            tier[model] = plane
+            return f"tok:{model}"
+
+        def load(model, token):
+            assert token == f"tok:{model}"
+            return tier.pop(model)
+
+        clk = _Clock()
+        mall = _mall(MallConfig(max_resident=1, evict_idle_s=0.0,
+                                check_interval_s=0.0),
+                     hooks={"evict_store": store, "evict_load": load},
+                     clock=clk)
+        mall.add_model("b", _upper)
+        assert mall.models()["b"] == "evicted" and "b" in tier
+        out = mall(_df([1], [b"v"], [{MODEL_HEADER: "b"}])).collect()
+        assert out["reply"][0] == b"B:v"
+        assert "b" not in tier and mall.rewarms == 1
+
+
+# ---------------------------------------------------------------------------
+# AutoML on idle capacity
+# ---------------------------------------------------------------------------
+
+def _plane(clk):
+    plane = LifecyclePlane(CanaryConfig(), clock=clk)
+    plane.bind(_Srv(_echo))
+    return plane
+
+
+class TestAutoML:
+    def test_never_launches_below_idle_floor(self):
+        """The acceptance criterion: a trial may only start on idle
+        capacity — below min_idle_share nothing ever launches."""
+        clk = _Clock()
+        plane = _plane(clk)
+        sched = AutoMLScheduler([{"k": 1}], lambda p: _echo_twin,
+                                min_idle_share=0.25, clock=clk)
+        for idle in (0.0, 0.1, 0.2, 0.2499):
+            assert sched.tick(plane, idle) is None
+        assert sched.trials_started == 0
+        assert plane.controller.active_version() is None
+
+    def test_launch_on_idle_capacity(self):
+        clk = _Clock()
+        plane = _plane(clk)
+        built = []
+        sched = AutoMLScheduler([{"k": 1}, {"k": 2}],
+                                lambda p: built.append(p) or _echo_twin,
+                                clock=clk)
+        assert sched.tick(plane, 0.5) == "launch"
+        assert built == [{"k": 1}]
+        ver = plane.registry.get("trial-1")
+        assert ver.state == SHADOWING
+        assert sched.trials_started == 1
+        assert sched.active["params"] == {"k": 1}
+        # one trial at a time: the next tick settles, never stacks
+        assert sched.tick(plane, 0.9) is None
+        assert sched.trials_started == 1
+
+    def test_respects_operator_rollout(self):
+        clk = _Clock()
+        plane = _plane(clk)
+        plane.deploy(_echo_twin, version="operator")
+        sched = AutoMLScheduler([{"k": 1}], lambda p: _echo_twin,
+                                clock=clk)
+        assert sched.tick(plane, 1.0) is None
+        assert sched.trials_started == 0
+
+    def test_shed_when_traffic_reclaims(self):
+        clk = _Clock()
+        plane = _plane(clk)
+        sched = AutoMLScheduler([{"k": 1}], lambda p: _echo_twin,
+                                min_idle_share=0.25,
+                                shed_idle_share=0.10, clock=clk)
+        assert sched.tick(plane, 0.5) == "launch"
+        ver = plane.registry.get("trial-1")
+        # idle collapses below the shed floor: the trial dies NOW
+        assert sched.tick(plane, 0.05) == "shed"
+        assert ver.state == ROLLED_BACK
+        assert sched.trials_shed == 1
+        shed = [e for e in sched.journal if e["action"] == "shed"]
+        assert shed and shed[0]["version"] == "trial-1"
+        # the reclaim is on the plane's record too
+        reasons = [e.get("reason") for e in
+                   plane.registry.summary()["journal"]]
+        assert "traffic_reclaim" in reasons
+
+    def test_promoted_trial_settles(self):
+        clk = _Clock()
+        mall = _mall(MallConfig(automl={"grid": [{"k": 1}],
+                                        "build": lambda p: _echo_twin}),
+                     clock=clk)
+        sched = mall.automl
+        plane = mall.plane_for("default")
+        assert sched.tick(plane, 1.0) == "launch"
+        # drive the trial through the ramp by hand (gate mechanics are
+        # test_lifecycle's subject; here only the settle matters)
+        plane.registry.transition("trial-1", CANARY)
+        plane.registry.swap_live("trial-1",
+                                 apply=plane.controller._apply_swap)
+        assert plane.registry.get("trial-1").state == LIVE
+        assert sched.tick(plane, 1.0) == "promoted"
+        assert sched.trials_promoted == 1
+        assert mall.swaps == 1  # the mall's apply flipped the host
+        # the promoted candidate serves bitwise through the mall
+        out = mall(_df([1], [b"x"])).collect()
+        assert out["reply"][0] == b"x"
+
+    def test_rolled_back_trial_settles_then_next_launches(self):
+        clk = _Clock()
+        plane = _plane(clk)
+        sched = AutoMLScheduler([{"k": 1}, {"k": 2}],
+                                lambda p: _echo_twin, clock=clk)
+        assert sched.tick(plane, 0.5) == "launch"
+        ver = plane.registry.get("trial-1")
+        plane.controller.rollback(ver, "divergence")
+        assert sched.tick(plane, 0.5) == "rolled_back"
+        assert sched.trials_rolled_back == 1
+        assert sched.tick(plane, 0.5) == "launch"
+        assert plane.registry.get("trial-2").state == SHADOWING
+
+    def test_exhausted_grid_journaled(self):
+        clk = _Clock()
+        plane = _plane(clk)
+        sched = AutoMLScheduler([{"k": 1}], lambda p: _echo_twin,
+                                max_trials=8, clock=clk)
+        assert sched.tick(plane, 0.5) == "launch"
+        plane.controller.rollback(plane.registry.get("trial-1"), "x")
+        assert sched.tick(plane, 0.5) == "rolled_back"
+        assert sched.tick(plane, 0.5) is None
+        assert sched.summary()["exhausted"] is True
+        assert any(e["action"] == "exhausted" for e in sched.journal)
+
+    def test_mall_tick_drives_scheduler(self):
+        clk = _Clock()
+        mall = _mall(MallConfig(check_interval_s=0.0,
+                                automl={"grid": [{"k": 1}],
+                                        "build": lambda p: _echo_twin}),
+                     clock=clk)
+        clk.advance(1.0)
+        mall.tick(0.01)  # plan (all idle) -> launch on the default plane
+        assert mall.automl.trials_started == 1
+        acts = [e for e in mall.journal if e["action"] == "automl"]
+        assert acts and acts[0]["event"] == "launch"
+        assert any(e["action"] == "pack" for e in mall.journal)
+
+    def test_idle_share_clamped_by_executor(self):
+        class _Ex:
+            def idle_fraction(self):
+                return 0.2
+
+        class _Plan:
+            idle_share = 0.9
+
+        mall = _mall()
+        mall._server._executor = _Ex()
+        # a saturated executor vetoes trials even on a calm forecast
+        assert mall._idle_share(_Plan()) == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: mall.swap / mall.evict (CI chaos-seeds lane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+class TestMallChaos:
+    def test_swap_crash_leaves_incumbent_serving(self):
+        """A mall.swap crash mid-promotion aborts the swap with ZERO state
+        change: the incumbent version stays live, the host transform is
+        untouched, replies stay bitwise."""
+        clk = _Clock()
+        mall = _mall(clock=clk)
+        plane = mall.plane_for("default")
+        reg = plane.registry
+        reg.register(_echo_twin, version="cand")
+        reg.transition("cand", SHADOWING)
+        reg.transition("cand", CANARY)
+        live0 = reg.live.version
+        host0 = mall._models["default"].host.transform
+        before = mall(_df([1], [b"x"])).collect()["reply"][0]
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.MALL_SWAP, at=(1,)) as inj:
+            with pytest.raises(InjectedFault):
+                reg.swap_live("cand", apply=plane.controller._apply_swap)
+            assert len(inj.fired(faults.MALL_SWAP)) == 1
+        assert reg.live.version == live0
+        assert reg.get("cand").state == CANARY  # retriable, not terminal
+        assert mall._models["default"].host.transform is host0
+        assert mall.swaps == 0
+        after = mall(_df([2], [b"x"])).collect()["reply"][0]
+        assert after == before
+
+    def test_swap_succeeds_without_injection(self):
+        clk = _Clock()
+        mall = _mall(clock=clk)
+        plane = mall.plane_for("default")
+        reg = plane.registry
+        reg.register(_echo_twin, version="cand")
+        reg.transition("cand", SHADOWING)
+        reg.transition("cand", CANARY)
+        reg.swap_live("cand", apply=plane.controller._apply_swap)
+        assert reg.live.version == "cand"
+        assert mall.swaps == 1
+        swaps = [e for e in mall.journal_for("default")
+                 if e["action"] == "swap"]
+        assert swaps and swaps[0]["version"] == "cand"
+
+    def test_evict_crash_model_survives_in_tier(self):
+        """A mall.evict crash AFTER the tier park completes the eviction
+        (accounted as a crash) — the model stays servable through the
+        same re-warm path, replies bitwise."""
+        clk = _Clock()
+        mall = _mall(MallConfig(max_resident=1, evict_idle_s=5.0,
+                                check_interval_s=0.0), clock=clk)
+        mall.add_model("b", _upper)
+        frame = lambda i: _df([i], [b"v"], [{MODEL_HEADER: "b"}])  # noqa: E731
+        before = mall(frame(1)).collect()["reply"][0]
+        clk.advance(10.0)
+        with FaultInjector(seed=CHAOS_SEED).plan(
+                faults.MALL_EVICT, every=1) as inj:
+            mall.tick(0.01)
+            assert len(inj.fired(faults.MALL_EVICT)) == 1
+        assert mall.models()["b"] == "evicted"
+        assert mall.evictions == 1 and mall.evict_crashes == 1
+        ev = [e for e in mall.journal if e["action"] == "evict"]
+        assert ev and ev[0]["crashed"] is True
+        after = mall(frame(2)).collect()["reply"][0]
+        assert after == before == b"B:v"
+        assert mall.rewarms == 1
+
+
+# ---------------------------------------------------------------------------
+# Serving integration
+# ---------------------------------------------------------------------------
+
+class TestServingIntegration:
+    def test_header_and_in_band_routing_live(self):
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=1.0,
+                            multimodel=True)
+        with srv:
+            assert srv._multimodel is not None
+            assert srv.transform is srv._multimodel
+            srv._multimodel.add_model("b", _upper)
+            assert _post(srv.address, b"plain") == (200, b"plain")
+            status, reply = _post(srv.address, b"routed",
+                                  headers={MODEL_HEADER: "b"})
+            assert (status, reply) == (200, b"B:routed")
+            body = b'{"model": "b", "x": 1}'
+            status, reply = _post(srv.address, body)
+            assert (status, reply) == (200, b"B:" + body)
+
+    def test_unknown_model_404_at_preflight(self):
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=1.0,
+                            multimodel=True)
+        with srv:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(srv.address, b"x", headers={MODEL_HEADER: "ghost"})
+            assert e.value.code == 404
+            assert json.loads(e.value.read())["error"] == "unknown model"
+            # the mall still serves known traffic afterwards
+            assert _post(srv.address, b"ok") == (200, b"ok")
+
+    def test_mall_endpoint_stats_and_metrics(self):
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=1.0,
+                            multimodel=True)
+        with srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            _post(srv.address, b"x")
+            mall = json.loads(urllib.request.urlopen(
+                base + "/_mmlspark/mall", timeout=15).read())
+            stats = json.loads(urllib.request.urlopen(
+                base + "/_mmlspark/stats", timeout=15).read())
+            metrics = urllib.request.urlopen(
+                base + "/_mmlspark/metrics", timeout=15).read().decode()
+        assert mall["default_model"] == "default"
+        assert mall["models"]["default"]["state"] == "resident"
+        assert "packing" in mall and "counters" in mall
+        assert "multimodel" in stats
+        assert stats["multimodel"]["models"]["default"]["requests"] >= 1
+        assert "mmlspark_mall_model_info" in metrics
+        assert "mmlspark_mall_requests_total" in metrics
+
+    def test_mall_404_when_disabled(self):
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=1.0)
+        with srv:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/_mmlspark/mall",
+                    timeout=15)
+            assert e.value.code == 404
+
+    def test_multimodel_false_is_bitwise_identical(self):
+        """multimodel=False (the default) serves byte-identical replies
+        and an identical stats/metrics surface to a server built without
+        the knob — the conditional-emission parity contract."""
+        from mmlspark_tpu.serving.server import ServingServer
+
+        bodies = [json.dumps({"i": i}).encode() for i in range(4)]
+
+        def collect(srv):
+            replies = []
+            with srv:
+                for b in bodies:
+                    replies.append(_post(srv.address, b)[1])
+                base = f"http://127.0.0.1:{srv.port}"
+                stats = json.loads(urllib.request.urlopen(
+                    base + "/_mmlspark/stats", timeout=15).read())
+                metrics = urllib.request.urlopen(
+                    base + "/_mmlspark/metrics",
+                    timeout=15).read().decode()
+            return replies, stats, metrics
+
+        off = ServingServer(_echo, port=0, max_wait_ms=1.0,
+                            multimodel=False)
+        plain = ServingServer(_echo, port=0, max_wait_ms=1.0)
+        r_off, s_off, m_off = collect(off)
+        r_plain, _s_plain, m_plain = collect(plain)
+        assert r_off == r_plain
+        assert off._multimodel is None
+        assert "multimodel" not in s_off
+        assert "mmlspark_mall_" not in m_off
+
+        def names(exposition):
+            return sorted(ln.split("{")[0].split(" ")[0]
+                          for ln in exposition.splitlines()
+                          if ln and not ln.startswith("#"))
+
+        assert names(m_off) == names(m_plain)
+
+    def test_mixed_batch_fulfills_every_row(self):
+        """Concurrent requests naming different models all complete with
+        the right model's bytes (the sub-frame merge path)."""
+        import threading
+        from mmlspark_tpu.serving.server import ServingServer
+
+        srv = ServingServer(_echo, port=0, max_wait_ms=50.0,
+                            multimodel=True)
+        with srv:
+            srv._multimodel.add_model("b", _upper)
+            results = {}
+
+            def hit(i):
+                if i % 2:
+                    results[i] = _post(srv.address, b"m-%d" % i,
+                                       headers={MODEL_HEADER: "b"})
+                else:
+                    results[i] = _post(srv.address, b"m-%d" % i)
+
+            threads = [threading.Thread(target=hit, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for i, (status, reply) in results.items():
+            assert status == 200
+            want = b"B:m-%d" % i if i % 2 else b"m-%d" % i
+            assert reply == want
+
+    def test_serve_pipeline_multimodel_knob(self):
+        """serve_pipeline(multimodel=True) builds the mall with the
+        worker's predict_ms/warm hooks attached."""
+        from mmlspark_tpu.serving import serve_pipeline
+
+        class _Echo:
+            def transform(self, df):
+                return df.with_column(
+                    "reply",
+                    lambda p: [json.dumps(np.asarray(v).tolist()).encode()
+                               for v in p["data"]])
+
+        srv = serve_pipeline(_Echo(), "data", parse="json", port=0,
+                             max_wait_ms=1.0, multimodel=True)
+        with srv:
+            assert srv._multimodel is not None
+            status, reply = _post(srv.address, b'{"data": [1, 2]}')
+            assert status == 200 and json.loads(reply) == [1, 2]
+            summary = srv._multimodel.summary()
+            assert summary["models"]["default"]["state"] == "resident"

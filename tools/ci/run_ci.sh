@@ -51,6 +51,7 @@ PACKAGES=(
   "tests/test_sharding.py"
   "tests/test_sparse_e2e.py"
   "tests/test_pipeline_mesh.py"
+  "tests/test_multimodel.py"
   "tests/test_multiprocess.py"
   "tests/test_examples.py"
 )
@@ -71,7 +72,7 @@ if [ "$stage" = "chaos" ] || [ "$stage" = "all" ]; then
   # schedules, not just the default seed's (docs/faults.md)
   for seed in 0 7 1337; do
     echo "--- chaos seed $seed ---"
-    MMLSPARK_CHAOS_SEED=$seed python -m pytest tests/test_faults.py tests/test_front_fabric.py tests/test_sparse_e2e.py tests/test_pipeline_mesh.py -q -m faults || rc=1
+    MMLSPARK_CHAOS_SEED=$seed python -m pytest tests/test_faults.py tests/test_front_fabric.py tests/test_sparse_e2e.py tests/test_pipeline_mesh.py tests/test_multimodel.py -q -m faults || rc=1
   done
   [ "$stage" = "chaos" ] && exit $rc
 fi
